@@ -1,0 +1,22 @@
+"""nornicdb_tpu — a TPU-native graph database framework.
+
+A brand-new framework with the capabilities of NornicDB (Neo4j-compatible
+graph store + hybrid BM25/vector search), designed TPU-first:
+
+- Storage: composable engine decorators (Memory/Disk -> WAL -> Async ->
+  Namespaced), mirroring the contract of the reference's storage layer
+  (reference: pkg/storage/types.go:363-422).
+- Device data plane: JAX/XLA/Pallas kernels over capacity-padded
+  HBM-resident embedding matrices (cosine top-k, k-means, graph
+  aggregations) replacing the reference's Metal/CUDA/Vulkan/OpenCL
+  backends (reference: pkg/gpu).
+- Search: BM25 + brute-force/HNSW vector search + RRF hybrid fusion
+  (reference: pkg/search).
+- Query: Cypher engine with streaming fast paths (reference: pkg/cypher).
+- Models: flax bge-m3-style encoder served with jit/pjit over a device
+  mesh (reference: pkg/embed + pkg/localllm, llama.cpp path).
+"""
+
+__version__ = "0.1.0"
+
+from nornicdb_tpu.db import DB, open  # noqa: F401,E402  (public facade)

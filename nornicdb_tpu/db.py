@@ -1,0 +1,214 @@
+"""DB facade: Open/Store/Recall/Cypher over the composed engine chain.
+
+Reference: pkg/nornicdb/db.go:742 ``Open`` and the public API surface
+(Store :1951, Recall :2107, Remember :2026, Link :2251, Neighbors :2299,
+Forget :2378, Cypher :2222). Round-1 facade — search/cypher services are
+wired in as those layers land.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+from nornicdb_tpu.storage import (
+    AsyncEngine,
+    DurableEngine,
+    Direction,
+    Edge,
+    Engine,
+    ListenableEngine,
+    MemoryEngine,
+    NamespacedEngine,
+    Node,
+)
+
+
+class DB:
+    """One logical NornicDB-style database instance."""
+
+    def __init__(
+        self,
+        data_dir: Optional[str] = None,
+        database: str = "neo4j",
+        async_writes: bool = False,
+        sync_every_write: bool = False,
+        embedder: Optional[Any] = None,
+        auto_embed: bool = False,
+    ):
+        # engine chain: Durable/Memory -> [Async] -> Listenable -> Namespaced
+        # (reference chain order: db.go:742-947)
+        if data_dir:
+            base: Engine = DurableEngine(data_dir, sync_every_write=sync_every_write)
+        else:
+            base = MemoryEngine()
+        self._base = base
+        chain: Engine = base
+        if async_writes:
+            chain = AsyncEngine(chain)
+        self._listenable = ListenableEngine(chain)
+        self.storage = NamespacedEngine(self._listenable, database)
+        self.database = database
+        self._lock = threading.Lock()
+        self._closed = False
+
+        # lazily-built services (per logical DB)
+        self._executor = None
+        self._search = None
+        self._embedder = embedder
+        self._embed_queue = None
+        self._decay = None
+        self._inference = None
+        if auto_embed and embedder is not None:
+            self._start_embed_queue()
+
+    # -- service accessors ----------------------------------------------
+
+    @property
+    def executor(self):
+        if self._executor is None:
+            from nornicdb_tpu.query.executor import CypherExecutor
+
+            self._executor = CypherExecutor(self.storage)
+            if self._search is not None:
+                self._executor.set_search_service(self._search)
+        return self._executor
+
+    @property
+    def search(self):
+        if self._search is None:
+            from nornicdb_tpu.search.service import SearchService
+
+            self._search = SearchService(self.storage, embedder=self._embedder)
+            if self._executor is not None:
+                self._executor.set_search_service(self._search)
+        return self._search
+
+    @property
+    def decay(self):
+        if self._decay is None:
+            from nornicdb_tpu.decay import DecayManager
+
+            self._decay = DecayManager(self.storage)
+        return self._decay
+
+    @property
+    def inference(self):
+        if self._inference is None:
+            from nornicdb_tpu.inference import InferenceEngine
+
+            self._inference = InferenceEngine(self.storage, self.search)
+        return self._inference
+
+    def _start_embed_queue(self):
+        from nornicdb_tpu.embed.queue import EmbedQueue
+
+        self._embed_queue = EmbedQueue(
+            self.storage, self._embedder, on_embedded=self._on_embedded
+        )
+        self._listenable.add_listener(self._embed_queue)
+        self._embed_queue.start()
+
+    def _on_embedded(self, node: Node) -> None:
+        if self._search is not None:
+            self._search.index_node(node)
+
+    # -- public API ------------------------------------------------------
+
+    def store(
+        self,
+        content: str,
+        labels: Optional[Sequence[str]] = None,
+        properties: Optional[Dict[str, Any]] = None,
+        node_id: Optional[str] = None,
+        embedding: Optional[List[float]] = None,
+        auto_link: bool = False,
+    ) -> Node:
+        """Store a memory node (reference: db.go:1951 Store)."""
+        nid = node_id or str(uuid.uuid4())
+        props = dict(properties or {})
+        props.setdefault("content", content)
+        node = Node(
+            id=nid,
+            labels=list(labels or ["Memory"]),
+            properties=props,
+            embedding=embedding,
+        )
+        self.storage.create_node(node)
+        if auto_link and embedding is not None:
+            self.inference.on_store(node)
+        return self.storage.get_node(nid)
+
+    def recall(self, query: str, limit: int = 10, **kw) -> List[Dict[str, Any]]:
+        """Hybrid search over stored memories (reference: db.go:2107 Recall)."""
+        return self.search.search(query, limit=limit, **kw)
+
+    def remember(self, node_id: str) -> Node:
+        """Fetch a node and record the access for decay/temporal tracking
+        (reference: db.go:2026 Remember)."""
+        node = self.storage.get_node(node_id)
+        if self._decay is not None:
+            self._decay.record_access(node_id)
+        return node
+
+    def link(
+        self,
+        from_id: str,
+        to_id: str,
+        rel_type: str = "RELATES_TO",
+        properties: Optional[Dict[str, Any]] = None,
+        edge_id: Optional[str] = None,
+    ) -> Edge:
+        eid = edge_id or str(uuid.uuid4())
+        edge = Edge(
+            id=eid,
+            type=rel_type,
+            start_node=from_id,
+            end_node=to_id,
+            properties=dict(properties or {}),
+        )
+        self.storage.create_edge(edge)
+        return self.storage.get_edge(eid)
+
+    def neighbors(self, node_id: str, direction: str = Direction.BOTH) -> List[Node]:
+        ids = self.storage.neighbors(node_id, direction)
+        return [n for n in self.storage.batch_get_nodes(ids) if n is not None]
+
+    def forget(self, node_id: str) -> None:
+        self.storage.delete_node(node_id)
+        if self._search is not None:
+            self._search.remove_node(node_id)
+
+    def cypher(
+        self, query: str, params: Optional[Dict[str, Any]] = None
+    ) -> "Any":
+        """Execute a Cypher query (reference: db.go:2222 Cypher)."""
+        return self.executor.execute(query, params or {})
+
+    def flush(self) -> None:
+        if self._embed_queue is not None:
+            self._embed_queue.drain()
+        self.storage.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._embed_queue is not None:
+            self._embed_queue.stop()
+        if self._decay is not None:
+            self._decay.stop()
+        self.storage.close()
+
+    def __enter__(self) -> "DB":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def open(data_dir: Optional[str] = None, **kw) -> DB:  # noqa: A001
+    """Open a database (reference: pkg/nornicdb/db.go:742 Open)."""
+    return DB(data_dir=data_dir, **kw)

@@ -1,0 +1,33 @@
+"""Common error types (reference: pkg/storage/types.go error vars)."""
+
+
+class NornicError(Exception):
+    """Base class for all nornicdb_tpu errors."""
+
+
+class NotFoundError(NornicError, KeyError):
+    """Node or edge not found."""
+
+
+class AlreadyExistsError(NornicError):
+    """Node or edge with this ID already exists."""
+
+
+class ConstraintViolationError(NornicError):
+    """Schema constraint violated."""
+
+
+class ClosedError(NornicError):
+    """Operation on a closed engine/DB."""
+
+
+class CypherSyntaxError(NornicError):
+    """Cypher query failed to parse."""
+
+
+class CypherRuntimeError(NornicError):
+    """Cypher query failed during execution."""
+
+
+class WALCorruptionError(NornicError):
+    """WAL segment failed checksum/parse validation."""

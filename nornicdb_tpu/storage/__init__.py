@@ -1,0 +1,23 @@
+"""Storage layer: composable engine decorators.
+
+Production chain (reference: pkg/nornicdb/db.go:742-947):
+``DurableEngine (Memory+WAL) -> [AsyncEngine] -> NamespacedEngine``.
+"""
+
+from nornicdb_tpu.storage.types import (  # noqa: F401
+    Direction,
+    Edge,
+    EdgeID,
+    Engine,
+    EngineDecorator,
+    ListenableEngine,
+    MutationListener,
+    Node,
+    NodeID,
+    now_ms,
+)
+from nornicdb_tpu.storage.memory import MemoryEngine  # noqa: F401
+from nornicdb_tpu.storage.wal import WAL, ReplayResult  # noqa: F401
+from nornicdb_tpu.storage.wal_engine import DurableEngine, WALEngine  # noqa: F401
+from nornicdb_tpu.storage.async_engine import AsyncEngine, FlushResult  # noqa: F401
+from nornicdb_tpu.storage.namespaced import DEFAULT_DB, NamespacedEngine  # noqa: F401

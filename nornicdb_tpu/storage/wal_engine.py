@@ -1,0 +1,205 @@
+"""WAL engine decorator: log every mutation before applying it.
+
+Reference: pkg/storage/wal_engine.go:56 ``NewWALEngine`` plus auto-compaction
+snapshots (wired at pkg/nornicdb/db.go:899 ``EnableAutoCompaction``).
+
+``DurableEngine`` composes ``MemoryEngine + WAL`` into the framework's
+persistent store: on open it restores the newest snapshot and replays the
+tail, giving Badger-equivalent durability semantics (crash recovery via
+snapshot + WAL replay — reference pkg/nornicdb/db.go:838-858) with an
+in-RAM working set.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from nornicdb_tpu.errors import NornicError, WALCorruptionError
+from nornicdb_tpu.storage.memory import MemoryEngine
+from nornicdb_tpu.storage.types import Edge, EdgeID, Engine, EngineDecorator, Node, NodeID
+from nornicdb_tpu.storage.wal import WAL, ReplayResult
+
+
+class WALEngine(EngineDecorator):
+    """Applies each mutation to ``inner`` (which validates it), then appends
+    it to the WAL, atomically under a mutation lock so the log order matches
+    the applied order. The write is only acked to the caller after the WAL
+    append, and for the production ``DurableEngine`` the inner engine is
+    volatile RAM, so apply-before-log preserves the durability contract
+    while guaranteeing a failed (invalid) mutation never poisons the log."""
+
+    def __init__(
+        self,
+        inner: Engine,
+        wal: WAL,
+        auto_compact_every: int = 0,
+    ):
+        super().__init__(inner)
+        self.wal = wal
+        self.auto_compact_every = auto_compact_every
+        self._since_compact = 0
+        self._lock = threading.Lock()
+        self._mut = threading.Lock()
+
+    # -- replay plumbing -------------------------------------------------
+
+    def apply_record(self, op: str, data: Dict[str, Any]) -> None:
+        """Apply one WAL record to the inner engine (used during replay and
+        by replication followers)."""
+        try:
+            if op == "create_node":
+                self.inner.create_node(Node.from_dict(data))
+            elif op == "update_node":
+                self.inner.update_node(Node.from_dict(data))
+            elif op == "delete_node":
+                self.inner.delete_node(data["id"])
+            elif op == "create_edge":
+                self.inner.create_edge(Edge.from_dict(data))
+            elif op == "update_edge":
+                self.inner.update_edge(Edge.from_dict(data))
+            elif op == "delete_edge":
+                self.inner.delete_edge(data["id"])
+            elif op == "delete_by_prefix":
+                self.inner.delete_by_prefix(data["prefix"])
+        except (KeyError, NornicError):
+            # replaying over a snapshot that already contains the mutation,
+            # or a delete of an already-deleted entity — idempotent replay
+            pass
+
+    def recover(self) -> ReplayResult:
+        """Restore snapshot state into inner, then replay the WAL tail.
+
+        If snapshot files exist on disk but none is readable, recovery
+        refuses to proceed: older segments were pruned at snapshot time,
+        so replaying from seq 0 would silently open a near-empty store
+        (reference analog: degraded mode, wal_degraded.go:6)."""
+        state, snap_seq = self.wal.load_snapshot()
+        if state is None and self.wal.has_snapshots():
+            raise WALCorruptionError(
+                "snapshot files exist but none is readable; refusing to "
+                "recover from WAL tail alone (pre-snapshot segments were "
+                "pruned). Restore a snapshot or remove snapshot files to "
+                "force tail-only recovery."
+            )
+        if state is not None:
+            self._load_state(state)
+        return self.wal.replay(self.apply_record, from_seq=snap_seq)
+
+    def _load_state(self, state: Dict[str, Any]) -> None:
+        for nd in state.get("nodes", []):
+            try:
+                self.inner.create_node(Node.from_dict(nd))
+            except Exception:
+                pass
+        for ed in state.get("edges", []):
+            try:
+                self.inner.create_edge(Edge.from_dict(ed))
+            except Exception:
+                pass
+
+    def _dump_state(self) -> Dict[str, Any]:
+        return {
+            "nodes": [n.to_dict() for n in self.inner.all_nodes()],
+            "edges": [e.to_dict() for e in self.inner.all_edges()],
+        }
+
+    def snapshot(self) -> str:
+        """Write a full-state snapshot, pruning old segments."""
+        return self.wal.write_snapshot(self._dump_state())
+
+    def _maybe_compact(self) -> None:
+        if self.auto_compact_every <= 0:
+            return
+        with self._lock:
+            self._since_compact += 1
+            if self._since_compact < self.auto_compact_every:
+                return
+            self._since_compact = 0
+        self.snapshot()
+
+    # -- mutations (apply-validates, then log; atomic so WAL order == applied order)
+
+    def create_node(self, node: Node) -> None:
+        with self._mut:
+            self.inner.create_node(node)
+            self.wal.append("create_node", node.to_dict())
+        self._maybe_compact()
+
+    def update_node(self, node: Node) -> None:
+        with self._mut:
+            self.inner.update_node(node)
+            self.wal.append("update_node", node.to_dict())
+        self._maybe_compact()
+
+    def delete_node(self, node_id: NodeID) -> None:
+        with self._mut:
+            self.inner.delete_node(node_id)
+            self.wal.append("delete_node", {"id": node_id})
+        self._maybe_compact()
+
+    def create_edge(self, edge: Edge) -> None:
+        with self._mut:
+            self.inner.create_edge(edge)
+            self.wal.append("create_edge", edge.to_dict())
+        self._maybe_compact()
+
+    def update_edge(self, edge: Edge) -> None:
+        with self._mut:
+            self.inner.update_edge(edge)
+            self.wal.append("update_edge", edge.to_dict())
+        self._maybe_compact()
+
+    def delete_edge(self, edge_id: EdgeID) -> None:
+        with self._mut:
+            self.inner.delete_edge(edge_id)
+            self.wal.append("delete_edge", {"id": edge_id})
+        self._maybe_compact()
+
+    def delete_by_prefix(self, prefix: str) -> Tuple[int, int]:
+        with self._mut:
+            result = self.inner.delete_by_prefix(prefix)
+            self.wal.append("delete_by_prefix", {"prefix": prefix})
+        return result
+
+    def flush(self) -> None:
+        self.wal.flush()
+        self.inner.flush()
+
+    def close(self) -> None:
+        self.wal.close()
+        self.inner.close()
+
+
+class DurableEngine(WALEngine):
+    """Persistent engine: RAM working set + WAL durability + snapshots.
+
+    Opens (or creates) a data directory, restores the last snapshot, and
+    replays the WAL tail. This is the framework's stand-in for the
+    reference's BadgerEngine LSM store (pkg/storage/badger.go:70) — the
+    durability contract (every acked mutation survives restart) is the
+    same; the working set lives in RAM which suits the TPU design where
+    hot data is columnarized onto the device anyway.
+    """
+
+    def __init__(
+        self,
+        data_dir: str,
+        sync_every_write: bool = False,
+        auto_compact_every: int = 50_000,
+        max_segment_bytes: int = 16 * 1024 * 1024,
+    ):
+        wal = WAL(
+            data_dir,
+            max_segment_bytes=max_segment_bytes,
+            sync_every_write=sync_every_write,
+        )
+        super().__init__(MemoryEngine(), wal, auto_compact_every=auto_compact_every)
+        self.replay_result: Optional[ReplayResult] = self.recover()
+
+    def close(self) -> None:
+        try:
+            self.snapshot()
+        except Exception:
+            pass
+        super().close()

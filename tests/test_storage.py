@@ -1,0 +1,383 @@
+"""Storage layer tests: engine contract, decorators, WAL durability.
+
+Mirrors the reference's test strategy (SURVEY.md §4): MemoryEngine wrapped
+in NamespacedEngine as the universal fixture, plus WAL
+corruption/truncation/replay regressions (reference:
+pkg/storage/wal_corruption_test.go, wal_durability_test.go).
+"""
+
+import os
+import threading
+
+import pytest
+
+from nornicdb_tpu.errors import AlreadyExistsError, NotFoundError
+from nornicdb_tpu.storage import (
+    WAL,
+    AsyncEngine,
+    Direction,
+    DurableEngine,
+    Edge,
+    MemoryEngine,
+    NamespacedEngine,
+    Node,
+    WALEngine,
+)
+
+
+def _mk(engine, nid="n1", labels=("Person",), **props):
+    node = Node(id=nid, labels=list(labels), properties=dict(props))
+    engine.create_node(node)
+    return node
+
+
+class TestMemoryEngine:
+    def test_node_crud(self):
+        eng = MemoryEngine()
+        _mk(eng, "n1", name="alice")
+        got = eng.get_node("n1")
+        assert got.properties["name"] == "alice"
+        assert got.created_at > 0
+
+        got.properties["age"] = 30
+        eng.update_node(got)
+        assert eng.get_node("n1").properties["age"] == 30
+
+        with pytest.raises(AlreadyExistsError):
+            _mk(eng, "n1")
+        eng.delete_node("n1")
+        with pytest.raises(NotFoundError):
+            eng.get_node("n1")
+
+    def test_label_index_follows_updates(self):
+        eng = MemoryEngine()
+        _mk(eng, "n1", labels=["Person", "Admin"])
+        assert {n.id for n in eng.get_nodes_by_label("Admin")} == {"n1"}
+        n = eng.get_node("n1")
+        n.labels = ["Person"]
+        eng.update_node(n)
+        assert eng.get_nodes_by_label("Admin") == []
+        assert {n.id for n in eng.get_nodes_by_label("Person")} == {"n1"}
+
+    def test_edges_and_degree(self):
+        eng = MemoryEngine()
+        _mk(eng, "a")
+        _mk(eng, "b")
+        _mk(eng, "c")
+        eng.create_edge(Edge(id="e1", type="KNOWS", start_node="a", end_node="b"))
+        eng.create_edge(Edge(id="e2", type="KNOWS", start_node="c", end_node="a"))
+        assert eng.degree("a", Direction.OUTGOING) == 1
+        assert eng.degree("a", Direction.INCOMING) == 1
+        assert eng.degree("a", Direction.BOTH) == 2
+        assert sorted(eng.neighbors("a")) == ["b", "c"]
+        assert {e.id for e in eng.get_edges_by_type("KNOWS")} == {"e1", "e2"}
+
+    def test_edge_requires_endpoints(self):
+        eng = MemoryEngine()
+        _mk(eng, "a")
+        with pytest.raises(NotFoundError):
+            eng.create_edge(Edge(id="e1", type="T", start_node="a", end_node="zzz"))
+
+    def test_delete_node_cascades_edges(self):
+        eng = MemoryEngine()
+        _mk(eng, "a")
+        _mk(eng, "b")
+        eng.create_edge(Edge(id="e1", type="T", start_node="a", end_node="b"))
+        eng.delete_node("a")
+        assert eng.count_edges() == 0
+        assert eng.degree("b") == 0
+
+    def test_returned_copies_are_isolated(self):
+        eng = MemoryEngine()
+        _mk(eng, "n1", name="alice")
+        got = eng.get_node("n1")
+        got.properties["name"] = "mutated"
+        assert eng.get_node("n1").properties["name"] == "alice"
+
+    def test_batch_get(self):
+        eng = MemoryEngine()
+        _mk(eng, "a")
+        _mk(eng, "b")
+        got = eng.batch_get_nodes(["a", "missing", "b"])
+        assert got[0].id == "a" and got[1] is None and got[2].id == "b"
+
+    def test_concurrent_writes(self):
+        eng = MemoryEngine()
+
+        def writer(start):
+            for i in range(100):
+                _mk(eng, f"n{start + i}")
+
+        threads = [threading.Thread(target=writer, args=(k * 100,)) for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert eng.count_nodes() == 800
+
+
+class TestNamespacedEngine:
+    def test_isolation_between_databases(self):
+        base = MemoryEngine()
+        db1 = NamespacedEngine(base, "db1")
+        db2 = NamespacedEngine(base, "db2")
+        _mk(db1, "n1", name="one")
+        _mk(db2, "n1", name="two")
+        assert db1.get_node("n1").properties["name"] == "one"
+        assert db2.get_node("n1").properties["name"] == "two"
+        assert db1.count_nodes() == 1
+        assert base.count_nodes() == 2
+        assert base.list_namespaces() == ["db1", "db2"]
+
+    def test_edges_namespaced(self):
+        base = MemoryEngine()
+        db1 = NamespacedEngine(base, "db1")
+        _mk(db1, "a")
+        _mk(db1, "b")
+        db1.create_edge(Edge(id="e1", type="T", start_node="a", end_node="b"))
+        e = db1.get_edge("e1")
+        assert e.start_node == "a" and e.end_node == "b"
+        raw = list(base.all_edges())[0]
+        assert raw.start_node == "db1:a"
+
+    def test_drop_database(self):
+        base = MemoryEngine()
+        db1 = NamespacedEngine(base, "db1")
+        db2 = NamespacedEngine(base, "db2")
+        _mk(db1, "a")
+        _mk(db2, "a")
+        nodes, _ = db1.drop_database()
+        assert nodes == 1
+        assert db1.count_nodes() == 0
+        assert db2.count_nodes() == 1
+
+    def test_label_scoped(self):
+        base = MemoryEngine()
+        db1 = NamespacedEngine(base, "db1")
+        db2 = NamespacedEngine(base, "db2")
+        _mk(db1, "a", labels=["Person"])
+        _mk(db2, "b", labels=["Person"])
+        assert {n.id for n in db1.get_nodes_by_label("Person")} == {"a"}
+
+
+class TestWAL:
+    def test_append_and_replay(self, tmp_path):
+        wal = WAL(str(tmp_path))
+        wal.append("create_node", {"id": "a"})
+        wal.append("create_node", {"id": "b"})
+        wal.close()
+
+        wal2 = WAL(str(tmp_path))
+        seen = []
+        res = wal2.replay(lambda op, d: seen.append((op, d["id"])))
+        assert res.records_applied == 2
+        assert seen == [("create_node", "a"), ("create_node", "b")]
+        assert wal2.last_seq == 2
+
+    def test_torn_tail_repair(self, tmp_path):
+        wal = WAL(str(tmp_path))
+        wal.append("create_node", {"id": "a"})
+        wal.append("create_node", {"id": "b"})
+        wal.close()
+        # corrupt the tail: append garbage bytes (reference: wal_corruption_test.go)
+        seg = [p for p in os.listdir(tmp_path) if p.startswith("wal-")][0]
+        with open(tmp_path / seg, "ab") as f:
+            f.write(b"\x07\x00\x00\x00garbage!!")
+
+        wal2 = WAL(str(tmp_path))
+        seen = []
+        res = wal2.replay(lambda op, d: seen.append(d["id"]))
+        assert res.records_applied == 2
+        assert res.torn_tail_repaired
+        assert not res.degraded
+        # after repair, a fresh replay is clean
+        res2 = WAL(str(tmp_path)).replay(lambda op, d: None)
+        assert not res2.torn_tail_repaired
+
+    def test_snapshot_prunes_and_restores(self, tmp_path):
+        wal = WAL(str(tmp_path), retained_segments=0)
+        for i in range(10):
+            wal.append("create_node", {"id": f"n{i}"})
+        wal.write_snapshot({"nodes": [{"id": "snapshot-state"}], "edges": []})
+        wal.append("create_node", {"id": "after-snap"})
+        wal.close()
+
+        wal2 = WAL(str(tmp_path))
+        state, seq = wal2.load_snapshot()
+        assert state["nodes"][0]["id"] == "snapshot-state"
+        assert seq == 10
+        applied = []
+        res = wal2.replay(lambda op, d: applied.append(d["id"]), from_seq=seq)
+        assert applied == ["after-snap"]
+        assert res.last_seq == 11
+
+    def test_segment_rotation(self, tmp_path):
+        wal = WAL(str(tmp_path), max_segment_bytes=256)
+        for i in range(50):
+            wal.append("create_node", {"id": f"node-{i}", "pad": "x" * 50})
+        wal.close()
+        segs = [p for p in os.listdir(tmp_path) if p.startswith("wal-")]
+        assert len(segs) > 1
+        res = WAL(str(tmp_path)).replay(lambda op, d: None)
+        assert res.records_applied == 50
+
+
+class TestDurableEngine:
+    def test_survives_restart(self, tmp_path):
+        eng = DurableEngine(str(tmp_path))
+        _mk(eng, "a", name="alice")
+        _mk(eng, "b")
+        eng.create_edge(Edge(id="e1", type="T", start_node="a", end_node="b"))
+        eng.delete_node("b")
+        eng.close()  # writes a snapshot
+
+        eng2 = DurableEngine(str(tmp_path))
+        assert eng2.get_node("a").properties["name"] == "alice"
+        assert eng2.count_nodes() == 1
+        assert eng2.count_edges() == 0
+        eng2.close()
+
+    def test_crash_without_snapshot(self, tmp_path):
+        eng = DurableEngine(str(tmp_path))
+        _mk(eng, "a")
+        eng.wal.flush()
+        # simulate crash: no close/snapshot
+        eng2 = DurableEngine(str(tmp_path))
+        assert eng2.count_nodes() == 1
+        eng2.close()
+
+    def test_replay_idempotent_over_snapshot(self, tmp_path):
+        eng = DurableEngine(str(tmp_path))
+        _mk(eng, "a")
+        eng.snapshot()
+        _mk(eng, "b")
+        eng.wal.flush()
+        eng2 = DurableEngine(str(tmp_path))
+        assert eng2.count_nodes() == 2
+        eng2.close()
+
+    def test_wal_engine_over_memory(self, tmp_path):
+        wal = WAL(str(tmp_path))
+        eng = WALEngine(MemoryEngine(), wal)
+        _mk(eng, "x")
+        eng.close()
+        # fresh engine, replay only
+        wal2 = WAL(str(tmp_path))
+        eng2 = WALEngine(MemoryEngine(), wal2)
+        eng2.recover()
+        assert eng2.count_nodes() == 1
+
+
+class TestAsyncEngine:
+    def test_read_your_writes_before_flush(self):
+        eng = AsyncEngine(MemoryEngine(), flush_interval_s=0)  # manual flush
+        _mk(eng, "a", name="alice")
+        assert eng.get_node("a").properties["name"] == "alice"
+        assert eng.count_nodes() == 1
+        eng.flush_pending()
+        assert eng.inner.count_nodes() == 1
+        assert eng.count_nodes() == 1
+
+    def test_delete_before_flush(self):
+        eng = AsyncEngine(MemoryEngine(), flush_interval_s=0)
+        _mk(eng, "a")
+        eng.delete_node("a")
+        with pytest.raises(NotFoundError):
+            eng.get_node("a")
+        assert eng.count_nodes() == 0
+        eng.flush_pending()
+        assert eng.inner.count_nodes() == 0
+
+    def test_count_flush_race_regression(self):
+        """Counts must stay correct while a flush races concurrent writes
+        (reference: async_engine_count_flush_race_test.go)."""
+        eng = AsyncEngine(MemoryEngine(), flush_interval_s=0.001)
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                _mk(eng, f"w{i}")
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(50):
+                eng.flush_pending()
+        finally:
+            stop.set()
+            t.join()
+        eng.flush_pending()
+        eng.flush_pending()
+        assert eng.count_nodes() == eng.inner.count_nodes()
+        eng.close()
+
+    def test_edges_overlay(self):
+        eng = AsyncEngine(MemoryEngine(), flush_interval_s=0)
+        _mk(eng, "a")
+        _mk(eng, "b")
+        eng.create_edge(Edge(id="e1", type="T", start_node="a", end_node="b"))
+        assert eng.degree("a", Direction.OUTGOING) == 1
+        eng.flush_pending()
+        assert eng.inner.count_edges() == 1
+        eng.delete_node("a")
+        assert eng.degree("b") == 0
+        eng.flush_pending()
+        assert eng.inner.count_edges() == 0
+
+
+class TestReviewRegressions:
+    """Regressions from the round-1 code review findings."""
+
+    def test_duplicate_create_does_not_poison_wal(self, tmp_path):
+        eng = DurableEngine(str(tmp_path))
+        _mk(eng, "a")
+        with pytest.raises(AlreadyExistsError):
+            _mk(eng, "a")
+        eng.wal.flush()
+        # crash-restart must succeed (no poison record in the WAL)
+        eng2 = DurableEngine(str(tmp_path))
+        assert eng2.count_nodes() == 1
+        eng2.close()
+
+    def test_async_create_duplicate_raises(self):
+        eng = AsyncEngine(MemoryEngine(), flush_interval_s=0)
+        _mk(eng, "x", v=1)
+        with pytest.raises(AlreadyExistsError):
+            _mk(eng, "x", v=2)
+        eng.flush_pending()
+        with pytest.raises(AlreadyExistsError):
+            _mk(eng, "x", v=3)
+        assert eng.get_node("x").properties["v"] == 1
+
+    def test_async_create_edge_validates_endpoints(self):
+        eng = AsyncEngine(MemoryEngine(), flush_interval_s=0)
+        _mk(eng, "a")
+        with pytest.raises(NotFoundError):
+            eng.create_edge(Edge(id="e", type="T", start_node="a", end_node="no"))
+
+    def test_namespaced_id_prefix_no_aliasing(self):
+        base = MemoryEngine()
+        db1 = NamespacedEngine(base, "db1")
+        _mk(db1, "x", v=1)
+        _mk(db1, "db1:x", v=2)  # must be a distinct node, not an alias
+        assert db1.get_node("x").properties["v"] == 1
+        assert db1.get_node("db1:x").properties["v"] == 2
+        db1.delete_node("db1:x")
+        assert db1.get_node("x").properties["v"] == 1
+
+    def test_unreadable_snapshot_refuses_silent_recovery(self, tmp_path):
+        from nornicdb_tpu.errors import WALCorruptionError
+
+        eng = DurableEngine(str(tmp_path))
+        _mk(eng, "a")
+        eng.snapshot()
+        eng.close()
+        # corrupt the only snapshot
+        snaps = [p for p in os.listdir(tmp_path) if p.startswith("snapshot-")]
+        with open(tmp_path / snaps[0], "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff" * 16)
+        with pytest.raises(WALCorruptionError):
+            DurableEngine(str(tmp_path))
