@@ -1,0 +1,16 @@
+"""Device data plane: JAX/XLA/Pallas kernels over HBM-resident matrices.
+
+This package replaces the reference's four GPU backends
+(Metal/CUDA/Vulkan/OpenCL — pkg/gpu) and its SIMD layer (pkg/simd) with
+ONE code path: jitted XLA computations (+ Pallas kernels for fused ops)
+that run identically on TPU and on the CPU backend used as the test
+double (reference parity-test pattern: pkg/gpu/*_stub_test.go).
+"""
+
+from nornicdb_tpu.ops.similarity import (  # noqa: F401
+    cosine_topk,
+    cosine_topk_chunked,
+    l2_normalize,
+    pad_dim,
+)
+from nornicdb_tpu.ops.kmeans import KMeansResult, kmeans_assign, kmeans_fit  # noqa: F401

@@ -1,0 +1,165 @@
+"""Cosine similarity + top-k kernels — the hot path of vector search.
+
+Replaces the reference's per-backend kernels (CUDA cuda_kernels.cu:263-420
+cosine/topk, Metal shaders_darwin.metal:43-360, Vulkan shaders/*.comp,
+pkg/simd BatchCosineSimilarity simd.go:149) with jitted XLA:
+
+- one [B,D] x [D,C] matmul lands on the MXU;
+- capacity-padded buffers + validity masks keep shapes static so XLA
+  never recompiles as the index grows (SURVEY.md §7 "dynamic shapes");
+- a chunked lax.scan variant bounds HBM for very large C by never
+  materializing the full [B,C] score matrix.
+
+All functions are pure and jit-cached per (shape, k) signature.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def pad_dim(n: int, minimum: int = 256) -> int:
+    """Round capacity up to the next power-of-two multiple of `minimum`
+    (a lane-friendly size) so jit caches stay small as the index grows."""
+    if n <= minimum:
+        return minimum
+    capacity = minimum
+    while capacity < n:
+        capacity *= 2
+    return capacity
+
+
+@jax.jit
+def l2_normalize(x: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    """Row-normalize so cosine similarity reduces to a dot product
+    (reference: normalize kernels, cuda_kernels.cu:206)."""
+    norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    return x / jnp.maximum(norm, eps)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _cosine_topk_impl(
+    queries: jnp.ndarray,  # [B, D] (normalized)
+    matrix: jnp.ndarray,  # [C, D] (normalized, capacity-padded)
+    valid: jnp.ndarray,  # [C] bool
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    scores = queries @ matrix.T  # [B, C] — MXU
+    scores = jnp.where(valid[None, :], scores, NEG_INF)
+    return jax.lax.top_k(scores, k)
+
+
+def cosine_topk(
+    queries: jnp.ndarray,
+    matrix: jnp.ndarray,
+    valid: jnp.ndarray,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact cosine top-k. Inputs must be L2-normalized. Returns
+    (scores [B,k], indices [B,k]); masked-out rows score NEG_INF."""
+    k = min(k, matrix.shape[0])
+    return _cosine_topk_impl(queries, matrix, valid, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def _cosine_topk_chunked_impl(
+    queries: jnp.ndarray,
+    matrix: jnp.ndarray,
+    valid: jnp.ndarray,
+    k: int,
+    chunk: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    b = queries.shape[0]
+    c = matrix.shape[0]
+    n_chunks = c // chunk  # capacity is a multiple of chunk by construction
+
+    def step(carry, i):
+        best_s, best_i = carry
+        rows = jax.lax.dynamic_slice_in_dim(matrix, i * chunk, chunk, axis=0)
+        vmask = jax.lax.dynamic_slice_in_dim(valid, i * chunk, chunk, axis=0)
+        s = queries @ rows.T  # [B, chunk]
+        s = jnp.where(vmask[None, :], s, NEG_INF)
+        idx = i * chunk + jnp.arange(chunk, dtype=jnp.int32)
+        cat_s = jnp.concatenate([best_s, s], axis=1)
+        cat_i = jnp.concatenate([best_i, jnp.broadcast_to(idx, (b, chunk))], axis=1)
+        top_s, pos = jax.lax.top_k(cat_s, k)
+        top_i = jnp.take_along_axis(cat_i, pos, axis=1)
+        return (top_s, top_i), None
+
+    init = (
+        jnp.full((b, k), NEG_INF, dtype=queries.dtype),
+        jnp.zeros((b, k), dtype=jnp.int32),
+    )
+    (best_s, best_i), _ = jax.lax.scan(
+        step, init, jnp.arange(n_chunks, dtype=jnp.int32)
+    )
+    return best_s, best_i
+
+
+def cosine_topk_chunked(
+    queries: jnp.ndarray,
+    matrix: jnp.ndarray,
+    valid: jnp.ndarray,
+    k: int,
+    chunk: int = 16384,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact cosine top-k without materializing the [B,C] score matrix:
+    scans C in chunks, keeping a running [B,k] best set. Use when
+    B*C*4 bytes would pressure HBM (e.g. C ~ 1M)."""
+    c = matrix.shape[0]
+    k = min(k, c)
+    if c <= chunk:
+        return _cosine_topk_impl(queries, matrix, valid, k)
+    chunk = min(chunk, c)
+    # pad_dim capacities are power-of-two multiples of 256, so a power-of-two
+    # chunk divides them; for other capacities fall back to dense rather
+    # than degrading to a tiny-chunk scan
+    while c % chunk != 0 and chunk >= 512:
+        chunk //= 2
+    if c % chunk != 0:
+        return _cosine_topk_impl(queries, matrix, valid, k)
+    return _cosine_topk_chunked_impl(queries, matrix, valid, k, chunk)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def euclidean_topk(
+    queries: jnp.ndarray,
+    matrix: jnp.ndarray,
+    valid: jnp.ndarray,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k by (negated) squared euclidean distance
+    (reference: euclidean_distance kernel, shaders_darwin.metal)."""
+    q2 = jnp.sum(queries * queries, axis=1, keepdims=True)  # [B,1]
+    m2 = jnp.sum(matrix * matrix, axis=1)  # [C]
+    d2 = q2 + m2[None, :] - 2.0 * (queries @ matrix.T)
+    d2 = jnp.where(valid[None, :], -d2, NEG_INF)
+    neg_d, idx = jax.lax.top_k(d2, k)
+    return -neg_d, idx
+
+
+@jax.jit
+def batch_dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise dot products (reference: batch_dot kernel)."""
+    return jnp.sum(a * b, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("threshold_is_min",))
+def filter_by_similarity(
+    query: jnp.ndarray,  # [D]
+    matrix: jnp.ndarray,  # [C, D]
+    valid: jnp.ndarray,  # [C]
+    threshold: float,
+    threshold_is_min: bool = True,
+) -> jnp.ndarray:
+    """Boolean mask of rows whose cosine similarity clears the threshold
+    (reference: filter_by_similarity kernel, shaders_darwin.metal)."""
+    scores = matrix @ query
+    ok = scores >= threshold if threshold_is_min else scores <= threshold
+    return ok & valid

@@ -18,3 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
+
+# The container's sitecustomize registers the axon TPU plugin and forces
+# jax.config jax_platforms="axon,cpu", which overrides the env var — force
+# it back to cpu so tests run on the virtual 8-device CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

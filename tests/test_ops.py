@@ -1,0 +1,206 @@
+"""Device data plane tests (run on the 8-device virtual CPU mesh).
+
+Parity pattern from the reference: every kernel is checked against a
+straightforward NumPy implementation (pkg/gpu/*_stub_test.go CPU-fallback
+parity tests).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nornicdb_tpu.ops import (
+    cosine_topk,
+    cosine_topk_chunked,
+    kmeans_assign,
+    kmeans_fit,
+    l2_normalize,
+    pad_dim,
+)
+from nornicdb_tpu.ops.similarity import batch_dot, euclidean_topk, filter_by_similarity
+from nornicdb_tpu.parallel import best_mesh, data_mesh, make_mesh, sharded_cosine_topk
+
+
+def _np_cosine_topk(q, m, valid, k):
+    qn = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+    mn = m / np.maximum(np.linalg.norm(m, axis=1, keepdims=True), 1e-12)
+    scores = qn @ mn.T
+    scores[:, ~valid] = -np.inf
+    idx = np.argsort(-scores, axis=1)[:, :k]
+    return np.take_along_axis(scores, idx, axis=1), idx
+
+
+class TestPadDim:
+    def test_growth(self):
+        assert pad_dim(10) == 256
+        assert pad_dim(256) == 256
+        assert pad_dim(257) == 512
+        assert pad_dim(100_000) == 131072
+
+
+class TestCosineTopK:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        m = rng.standard_normal((200, 32)).astype(np.float32)
+        q = rng.standard_normal((5, 32)).astype(np.float32)
+        cap = pad_dim(200)
+        padded = np.zeros((cap, 32), dtype=np.float32)
+        padded[:200] = m
+        valid = np.zeros((cap,), dtype=bool)
+        valid[:200] = True
+
+        s, i = cosine_topk(
+            l2_normalize(jnp.asarray(q)), l2_normalize(jnp.asarray(padded)),
+            jnp.asarray(valid), 10,
+        )
+        ref_s, ref_i = _np_cosine_topk(q, m, valid[:200][: 200], 10)
+        np.testing.assert_allclose(np.asarray(s), ref_s, rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), ref_i)
+
+    def test_chunked_matches_dense(self):
+        rng = np.random.default_rng(1)
+        cap = 1024
+        m = l2_normalize(jnp.asarray(rng.standard_normal((cap, 16)).astype(np.float32)))
+        q = l2_normalize(jnp.asarray(rng.standard_normal((3, 16)).astype(np.float32)))
+        valid = jnp.asarray(rng.random(cap) > 0.1)
+        s1, i1 = cosine_topk(q, m, valid, 7)
+        s2, i2 = cosine_topk_chunked(q, m, valid, 7, chunk=128)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_all_invalid_rows_never_returned(self):
+        m = l2_normalize(jnp.ones((256, 8)))
+        valid = jnp.zeros((256,), dtype=bool).at[5].set(True)
+        q = l2_normalize(jnp.ones((1, 8)))
+        s, i = cosine_topk(q, m, valid, 3)
+        assert int(i[0, 0]) == 5
+        assert float(s[0, 1]) < -1e29  # padding slots score NEG_INF
+
+    def test_k_clamped(self):
+        m = l2_normalize(jnp.ones((4, 8)))
+        q = l2_normalize(jnp.ones((1, 8)))
+        s, i = cosine_topk(q, m, jnp.ones(4, dtype=bool), 100)
+        assert s.shape == (1, 4)
+
+    def test_euclidean(self):
+        rng = np.random.default_rng(2)
+        m = rng.standard_normal((300, 8)).astype(np.float32)
+        q = m[42:43] + 0.001
+        cap = pad_dim(300)
+        padded = np.zeros((cap, 8), dtype=np.float32)
+        padded[:300] = m
+        valid = np.zeros((cap,), dtype=bool)
+        valid[:300] = True
+        d, i = euclidean_topk(jnp.asarray(q), jnp.asarray(padded), jnp.asarray(valid), 1)
+        assert int(i[0, 0]) == 42
+
+    def test_batch_dot_and_filter(self):
+        a = jnp.asarray([[1.0, 0.0], [0.0, 2.0]])
+        b = jnp.asarray([[1.0, 1.0], [1.0, 1.0]])
+        np.testing.assert_allclose(np.asarray(batch_dot(a, b)), [1.0, 2.0])
+        m = l2_normalize(jnp.asarray([[1.0, 0.0], [0.0, 1.0], [1.0, 0.01]]))
+        mask = filter_by_similarity(
+            jnp.asarray([1.0, 0.0]), m, jnp.ones(3, dtype=bool), 0.9
+        )
+        assert list(np.asarray(mask)) == [True, False, True]
+
+
+class TestKMeans:
+    def test_separated_clusters_recovered(self):
+        rng = np.random.default_rng(3)
+        c1 = rng.standard_normal((100, 16)) * 0.05 + np.array([5.0] + [0.0] * 15)
+        c2 = rng.standard_normal((100, 16)) * 0.05 + np.array([0.0, 5.0] + [0.0] * 14)
+        c3 = rng.standard_normal((100, 16)) * 0.05 - np.array([0.0, 0.0, 5.0] + [0.0] * 13)
+        x = np.concatenate([c1, c2, c3]).astype(np.float32)
+        res = kmeans_fit(x, k=3, seed=0)
+        assert res.converged
+        # all members of a ground-truth cluster share a label
+        for lo, hi in [(0, 100), (100, 200), (200, 300)]:
+            assert len(set(res.assignments[lo:hi].tolist())) == 1
+        assert len(set(res.assignments.tolist())) == 3
+
+    def test_seeded_init_biases_selection(self):
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((500, 8)).astype(np.float32)
+        res = kmeans_fit(x, k=8, preferred_seed_indices=[1, 2, 3], seed=1)
+        assert res.centroids.shape == (8, 8)
+        assert res.iterations >= 1
+
+    def test_assign_matches_fit(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((200, 8)).astype(np.float32)
+        res = kmeans_fit(x, k=4, seed=2)
+        a = kmeans_assign(
+            l2_normalize(jnp.asarray(x)),
+            jnp.ones(200, dtype=bool),
+            jnp.asarray(res.centroids),
+        )
+        np.testing.assert_array_equal(np.asarray(a), res.assignments)
+
+    def test_invalid_rows_excluded(self):
+        x = np.ones((50, 4), dtype=np.float32)
+        valid = np.zeros((50,), dtype=bool)
+        valid[:10] = True
+        res = kmeans_fit(x, k=2, valid=valid)
+        assert (res.assignments[10:] == -1).all()
+
+
+class TestShardedTopK:
+    def test_matches_single_device(self):
+        assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+        rng = np.random.default_rng(6)
+        cap = 2048  # divisible by 8
+        n = 1500
+        m = np.zeros((cap, 32), dtype=np.float32)
+        m[:n] = rng.standard_normal((n, 32))
+        valid = np.zeros((cap,), dtype=bool)
+        valid[:n] = True
+        q = rng.standard_normal((4, 32)).astype(np.float32)
+
+        mj = l2_normalize(jnp.asarray(m))
+        qj = l2_normalize(jnp.asarray(q))
+        vj = jnp.asarray(valid)
+
+        s_ref, i_ref = cosine_topk(qj, mj, vj, 10)
+        mesh = data_mesh()
+        s, i = sharded_cosine_topk(qj, mj, vj, 10, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(s_ref), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+    def test_mesh_spec(self):
+        spec = best_mesh(8)
+        assert spec.size == 8
+        mesh = make_mesh(spec)
+        assert set(mesh.axis_names) == {"dp", "tp", "sp"}
+
+
+class TestOpsReviewRegressions:
+    def test_kmeans_k_clamped_to_valid_rows(self):
+        x = np.random.default_rng(0).standard_normal((50, 4)).astype(np.float32)
+        valid = np.zeros((50,), dtype=bool)
+        valid[:3] = True
+        res = kmeans_fit(x, k=8, valid=valid, init="random", seed=0)
+        assert res.centroids.shape[0] == 3  # clamped; no padding-row centroids
+
+    def test_sharded_topk_k_exceeds_shard_rows(self):
+        rng = np.random.default_rng(7)
+        cap = 256  # 32 rows/shard on 8 devices
+        m = l2_normalize(jnp.asarray(rng.standard_normal((cap, 16)).astype(np.float32)))
+        q = l2_normalize(jnp.asarray(rng.standard_normal((2, 16)).astype(np.float32)))
+        valid = jnp.ones((cap,), dtype=bool)
+        k = 50  # > 32 rows per shard
+        s_ref, i_ref = cosine_topk(q, m, valid, k)
+        s, i = sharded_cosine_topk(q, m, valid, k, mesh=data_mesh())
+        assert s.shape == (2, 50)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(s_ref), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+    def test_chunked_odd_capacity_falls_back_dense(self):
+        rng = np.random.default_rng(8)
+        m = l2_normalize(jnp.asarray(rng.standard_normal((1001, 8)).astype(np.float32)))
+        q = l2_normalize(jnp.asarray(rng.standard_normal((1, 8)).astype(np.float32)))
+        valid = jnp.ones((1001,), dtype=bool)
+        s, i = cosine_topk_chunked(q, m, valid, 5, chunk=512)
+        s_ref, i_ref = cosine_topk(q, m, valid, 5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
