@@ -1,0 +1,13 @@
+"""Hybrid search stack: BM25 + vector (brute/HNSW) + RRF fusion.
+
+Reference: pkg/search (search.go Service), fulltext_index_v2.go (BM25 v2),
+hnsw_index.go, bm25_seed_provider.go (seeded builds), vector_index.go.
+Design split: BM25 and the HNSW graph walk are pointer-chasing and stay on
+CPU; all bulk distance math runs on device via nornicdb_tpu.ops.
+"""
+
+from nornicdb_tpu.search.bm25 import BM25Index, tokenize  # noqa: F401
+from nornicdb_tpu.search.vector_index import BruteForceIndex  # noqa: F401
+from nornicdb_tpu.search.hnsw import HNSWIndex  # noqa: F401
+from nornicdb_tpu.search.rrf import rrf_fuse  # noqa: F401
+from nornicdb_tpu.search.service import SearchService, SearchResult  # noqa: F401

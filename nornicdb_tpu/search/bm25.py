@@ -1,0 +1,253 @@
+"""BM25 fulltext index (Okapi BM25, compact postings).
+
+Re-expresses the reference's BM25 v2 engine (pkg/search/fulltext_index_v2.go:51
+``FulltextIndexV2``: compact postings, top-k pruning, batch indexing) and its
+tokenizer (pkg/indexing/config.go ``TokenizeForBM25``). Pointer-chasing
+stays on CPU; scoring is vectorized with NumPy over postings arrays.
+
+Also provides the BM25 seed-selection used to order HNSW builds and to
+sample k-means training sets (reference: bm25_seed_provider.go:12
+``bm25SeedDocIDs``, docs/release-notes-since-v1.0.11.md:75-151 — lexically
+discriminative docs first → 2.7x faster 1M-vector HNSW build).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+# minimal english stopword set (reference keeps indexing light-weight)
+STOPWORDS = frozenset(
+    """a an and are as at be by for from has he in is it its of on that the
+    to was were will with this these those i you your not or but if then
+    than so we they them there here what which who whom when where how"""
+    .split()
+)
+
+K1 = 1.2
+B = 0.75
+
+
+def tokenize(text: str, min_len: int = 2, max_len: int = 40) -> List[str]:
+    """Lowercase alphanumeric tokens, stopword- and length-filtered."""
+    out = []
+    for tok in _TOKEN_RE.findall(text.lower()):
+        if len(tok) < min_len or len(tok) > max_len:
+            continue
+        if tok in STOPWORDS:
+            continue
+        out.append(tok)
+    return out
+
+
+class _Posting:
+    __slots__ = ("doc_ids", "tfs")
+
+    def __init__(self):
+        self.doc_ids: List[int] = []
+        self.tfs: List[int] = []
+
+
+class BM25Index:
+    """Incremental BM25 index over (doc_id -> text). Thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._postings: Dict[str, _Posting] = {}
+        self._doc_len: List[int] = []  # internal idx -> token count
+        self._ext_ids: List[str] = []  # internal idx -> external id
+        self._int_of: Dict[str, int] = {}
+        self._alive: List[bool] = []
+        self._total_len = 0
+        self._n_alive = 0
+
+    # -- indexing --------------------------------------------------------
+
+    def index(self, doc_id: str, text: str) -> None:
+        with self._lock:
+            if doc_id in self._int_of:
+                self._remove_locked(doc_id)
+            self._maybe_compact_locked()
+            toks = tokenize(text)
+            idx = len(self._ext_ids)
+            self._ext_ids.append(doc_id)
+            self._int_of[doc_id] = idx
+            self._doc_len.append(len(toks))
+            self._alive.append(True)
+            self._total_len += len(toks)
+            self._n_alive += 1
+            counts: Dict[str, int] = {}
+            for t in toks:
+                counts[t] = counts.get(t, 0) + 1
+            for t, c in counts.items():
+                p = self._postings.get(t)
+                if p is None:
+                    p = self._postings[t] = _Posting()
+                p.doc_ids.append(idx)
+                p.tfs.append(c)
+
+    def index_batch(self, docs: Sequence[Tuple[str, str]]) -> None:
+        """Reference: IndexBatch (fulltext_index_v2.go:114)."""
+        for doc_id, text in docs:
+            self.index(doc_id, text)
+
+    def _remove_locked(self, doc_id: str) -> None:
+        idx = self._int_of.pop(doc_id, None)
+        if idx is None or not self._alive[idx]:
+            return
+        self._alive[idx] = False
+        self._total_len -= self._doc_len[idx]
+        self._n_alive -= 1
+
+    def remove(self, doc_id: str) -> None:
+        with self._lock:
+            self._remove_locked(doc_id)
+
+    def _maybe_compact_locked(self) -> None:
+        """Re-indexing tombstones the old slot; without compaction a
+        hot-update workload grows slots and postings without bound. Rebuild
+        in place once dead slots dominate."""
+        n_slots = len(self._ext_ids)
+        if n_slots < 1024 or self._n_alive * 2 > n_slots:
+            return
+        remap: Dict[int, int] = {}
+        new_ext: List[str] = []
+        new_len: List[int] = []
+        for old_idx, ext in enumerate(self._ext_ids):
+            if self._alive[old_idx]:
+                remap[old_idx] = len(new_ext)
+                new_ext.append(ext)
+                new_len.append(self._doc_len[old_idx])
+        new_postings: Dict[str, _Posting] = {}
+        for t, p in self._postings.items():
+            np_post = _Posting()
+            for did, tf in zip(p.doc_ids, p.tfs):
+                new_idx = remap.get(did)
+                if new_idx is not None:
+                    np_post.doc_ids.append(new_idx)
+                    np_post.tfs.append(tf)
+            if np_post.doc_ids:
+                new_postings[t] = np_post
+        self._ext_ids = new_ext
+        self._doc_len = new_len
+        self._alive = [True] * len(new_ext)
+        self._int_of = {e: i for i, e in enumerate(new_ext)}
+        self._postings = new_postings
+
+    def __contains__(self, doc_id: str) -> bool:
+        with self._lock:
+            idx = self._int_of.get(doc_id)
+            return idx is not None and self._alive[idx]
+
+    def __len__(self) -> int:
+        return self._n_alive
+
+    # -- scoring ---------------------------------------------------------
+
+    def _idf(self, df: int) -> float:
+        n = max(self._n_alive, 1)
+        return math.log(1.0 + (n - df + 0.5) / (df + 0.5))
+
+    def search(self, query: str, k: int = 10) -> List[Tuple[str, float]]:
+        """Top-k (doc_id, bm25_score). Accumulates scores over the query
+        terms' postings with NumPy (vectorized tf normalization)."""
+        with self._lock:
+            toks = set(tokenize(query))
+            if not toks or self._n_alive == 0:
+                return []
+            n_docs = len(self._ext_ids)
+            avgdl = max(self._total_len / max(self._n_alive, 1), 1.0)
+            scores = np.zeros(n_docs, dtype=np.float32)
+            doc_len = np.asarray(self._doc_len, dtype=np.float32)
+            touched = np.zeros(n_docs, dtype=bool)
+            for t in toks:
+                p = self._postings.get(t)
+                if p is None:
+                    continue
+                ids = np.asarray(p.doc_ids, dtype=np.int64)
+                tfs = np.asarray(p.tfs, dtype=np.float32)
+                df = len(ids)
+                idf = self._idf(df)
+                dl = doc_len[ids]
+                tf_norm = tfs * (K1 + 1.0) / (tfs + K1 * (1.0 - B + B * dl / avgdl))
+                scores[ids] += idf * tf_norm
+                touched[ids] = True
+            alive = np.asarray(self._alive, dtype=bool)
+            mask = touched & alive
+            cand = np.nonzero(mask)[0]
+            if cand.size == 0:
+                return []
+            order = cand[np.argsort(-scores[cand], kind="stable")][:k]
+            return [(self._ext_ids[i], float(scores[i])) for i in order]
+
+    # -- seed selection (BM25-seeded builds) ------------------------------
+
+    def seed_doc_ids(
+        self, max_seeds: int = 2048, n_terms: int = 256, per_term: Optional[int] = None
+    ) -> List[str]:
+        """Lexically discriminative docs: take the `n_terms` highest-IDF
+        terms (ignoring hapax noise) and collect their top-tf docs, up to
+        `max_seeds`, highest-signal first. These anchor HNSW insertion
+        order and k-means init (reference: search.go:3785-3871)."""
+        with self._lock:
+            if self._n_alive == 0:
+                return []
+            ranked_terms = []
+            for t, p in self._postings.items():
+                df = len(p.doc_ids)
+                if df < 2:  # hapax terms don't discriminate clusters
+                    continue
+                ranked_terms.append((self._idf(df), t))
+            ranked_terms.sort(reverse=True)
+            per_term = per_term or max(1, max_seeds // max(n_terms, 1))
+            seen: Dict[int, None] = {}
+            for _, t in ranked_terms[:n_terms]:
+                p = self._postings[t]
+                order = np.argsort(-np.asarray(p.tfs))[:per_term]
+                for j in order:
+                    idx = p.doc_ids[int(j)]
+                    if self._alive[idx]:
+                        seen.setdefault(idx, None)
+                if len(seen) >= max_seeds:
+                    break
+            return [self._ext_ids[i] for i in list(seen)[:max_seeds]]
+
+    # -- persistence ------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "ext_ids": list(self._ext_ids),
+                "doc_len": list(self._doc_len),
+                "alive": [bool(a) for a in self._alive],
+                "postings": {
+                    t: {"ids": list(p.doc_ids), "tfs": list(p.tfs)}
+                    for t, p in self._postings.items()
+                },
+            }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BM25Index":
+        idx = cls()
+        idx._ext_ids = list(d["ext_ids"])
+        idx._doc_len = list(d["doc_len"])
+        idx._alive = list(d["alive"])
+        idx._int_of = {
+            e: i for i, e in enumerate(idx._ext_ids) if idx._alive[i]
+        }
+        for t, p in d["postings"].items():
+            post = _Posting()
+            post.doc_ids = list(p["ids"])
+            post.tfs = list(p["tfs"])
+            idx._postings[t] = post
+        idx._total_len = sum(
+            l for l, a in zip(idx._doc_len, idx._alive) if a
+        )
+        idx._n_alive = sum(1 for a in idx._alive if a)
+        return idx

@@ -1,0 +1,30 @@
+"""Reciprocal Rank Fusion of BM25 and vector result lists.
+
+Reference: pkg/search RRF fusion inside Service.Search (search.go:2841).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+DEFAULT_RRF_K = 60
+
+
+def rrf_fuse(
+    result_lists: Sequence[List[Tuple[str, float]]],
+    weights: Sequence[float] = (),
+    k: int = DEFAULT_RRF_K,
+    limit: int = 10,
+) -> List[Tuple[str, float]]:
+    """Fuse ranked lists of (id, score) by reciprocal rank.
+
+    score(id) = sum_i w_i / (k + rank_i(id)); ids absent from a list
+    contribute nothing for it. Returns top ``limit`` by fused score."""
+    if not weights:
+        weights = [1.0] * len(result_lists)
+    fused: Dict[str, float] = {}
+    for w, results in zip(weights, result_lists):
+        for rank, (doc_id, _score) in enumerate(results):
+            fused[doc_id] = fused.get(doc_id, 0.0) + w / (k + rank + 1)
+    ranked = sorted(fused.items(), key=lambda kv: (-kv[1], kv[0]))
+    return ranked[:limit]

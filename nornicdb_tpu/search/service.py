@@ -1,0 +1,249 @@
+"""Hybrid search service: BM25 + vector + RRF, with strategy state machine.
+
+Reference: pkg/search/search.go ``Service`` (:417-524), ``Search`` (:2841),
+``BuildIndexes`` (:2246), ``IndexNode`` (:1785), strategy state machine
+bruteCPU <-> bruteGPU <-> HNSW (:528-535). TPU design: the "GPU" strategy
+is simply the device-backed BruteForceIndex (ops dispatch to whatever
+backend JAX has); HNSW kicks in above ``hnsw_threshold`` with a
+BM25-seeded build.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nornicdb_tpu.search.bm25 import BM25Index
+from nornicdb_tpu.search.hnsw import HNSWIndex
+from nornicdb_tpu.search.rrf import rrf_fuse
+from nornicdb_tpu.search.vector_index import BruteForceIndex
+from nornicdb_tpu.storage.types import Engine, Node
+
+TEXT_PROPERTIES = ("content", "title", "name", "description", "text", "summary")
+
+
+def extract_text(node: Node) -> str:
+    """Searchable text from a node (reference: pkg/indexing
+    ExtractSearchableText — title/content-ish properties + labels)."""
+    parts: List[str] = []
+    for key in TEXT_PROPERTIES:
+        v = node.properties.get(key)
+        if isinstance(v, str) and v:
+            parts.append(v)
+    parts.extend(node.labels)
+    return " ".join(parts)
+
+
+@dataclass
+class SearchResult:
+    node_id: str
+    score: float
+    node: Optional[Node] = None
+    bm25_score: Optional[float] = None
+    vector_score: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"id": self.node_id, "score": self.score}
+        if self.bm25_score is not None:
+            d["bm25_score"] = self.bm25_score
+        if self.vector_score is not None:
+            d["vector_score"] = self.vector_score
+        if self.node is not None:
+            d["labels"] = self.node.labels
+            d["properties"] = self.node.properties
+        return d
+
+
+@dataclass
+class SearchStats:
+    indexed_docs: int = 0
+    indexed_vectors: int = 0
+    strategy: str = "brute"
+    searches: int = 0
+    hnsw_builds: int = 0
+
+
+class SearchService:
+    """One search service per logical database
+    (reference: per-DB instances, pkg/nornicdb/search_services.go:68)."""
+
+    def __init__(
+        self,
+        storage: Optional[Engine] = None,
+        embedder: Optional[Any] = None,
+        hnsw_threshold: int = 10_000,
+        hnsw_m: int = 16,
+        hnsw_ef_search: int = 64,
+    ):
+        self.storage = storage
+        self.embedder = embedder
+        self.hnsw_threshold = hnsw_threshold
+        self._lock = threading.RLock()
+        self.bm25 = BM25Index()
+        self.vectors = BruteForceIndex()
+        self.hnsw: Optional[HNSWIndex] = None
+        self._hnsw_m = hnsw_m
+        self._hnsw_ef = hnsw_ef_search
+        self.stats = SearchStats()
+
+    # -- indexing ---------------------------------------------------------
+
+    def index_node(self, node: Node) -> None:
+        """Index one node's text + embedding
+        (reference: Service.IndexNode search.go:1785)."""
+        text = extract_text(node)
+        with self._lock:
+            if text:
+                self.bm25.index(node.id, text)
+            else:
+                self.bm25.remove(node.id)  # update cleared the text
+            vec = node.embedding
+            if vec is None and node.chunk_embeddings:
+                # whole-doc vector = mean of chunks (best-of-chunks is used
+                # at query time by inference; mean anchors doc search)
+                vec = list(np.mean(np.asarray(node.chunk_embeddings), axis=0))
+            if vec is not None:
+                self.vectors.add(node.id, vec)
+                if self.hnsw is not None:
+                    self.hnsw.add(node.id, vec)
+            else:
+                # update removed the embedding: drop stale vectors
+                self.vectors.remove(node.id)
+                if self.hnsw is not None:
+                    self.hnsw.remove(node.id)
+            self.stats.indexed_docs = len(self.bm25)
+            self.stats.indexed_vectors = len(self.vectors)
+            self._maybe_switch_strategy()
+
+    def remove_node(self, node_id: str) -> None:
+        with self._lock:
+            self.bm25.remove(node_id)
+            self.vectors.remove(node_id)
+            if self.hnsw is not None:
+                self.hnsw.remove(node_id)
+                if self.hnsw.should_rebuild():
+                    self._rebuild_hnsw()
+            self.stats.indexed_docs = len(self.bm25)
+            self.stats.indexed_vectors = len(self.vectors)
+
+    def build_indexes(self) -> int:
+        """Index every node in storage (reference: BuildIndexes :2246).
+        Returns count indexed."""
+        if self.storage is None:
+            return 0
+        n = 0
+        for node in self.storage.all_nodes():
+            self.index_node(node)
+            n += 1
+        return n
+
+    # -- strategy state machine -------------------------------------------
+
+    def _maybe_switch_strategy(self) -> None:
+        if self.hnsw is None and len(self.vectors) >= self.hnsw_threshold:
+            self._rebuild_hnsw()
+
+    def _rebuild_hnsw(self) -> None:
+        """(Re)build HNSW from the brute index, BM25 seeds first."""
+        items = []
+        matrix, valid, ext_ids = self.vectors.snapshot()
+        for slot, eid in enumerate(ext_ids):
+            if eid is not None and valid[slot]:
+                items.append((eid, matrix[slot]))
+        seeds = self.bm25.seed_doc_ids()
+        idx = HNSWIndex(m=self._hnsw_m, ef_search=self._hnsw_ef)
+        idx.build(items, seed_ids=seeds)
+        self.hnsw = idx
+        self.stats.hnsw_builds += 1
+        self.stats.strategy = "hnsw"
+
+    # -- search -----------------------------------------------------------
+
+    def _query_embedding(self, query: str) -> Optional[np.ndarray]:
+        if self.embedder is None:
+            return None
+        try:
+            return np.asarray(self.embedder.embed(query), dtype=np.float32)
+        except Exception:
+            return None  # fail-open: hybrid degrades to text-only
+
+    def vector_search_candidates(
+        self, query_vec: Sequence[float], k: int = 10, exact: bool = False
+    ) -> List[Tuple[str, float]]:
+        """Raw vector candidates (reference: VectorSearchCandidates
+        search.go:3045). Strategy: HNSW if built (unless exact), else brute."""
+        with self._lock:
+            hnsw = self.hnsw
+        if hnsw is not None and not exact:
+            return hnsw.search(query_vec, k)
+        return self.vectors.search(query_vec, k)
+
+    def search(
+        self,
+        query: str = "",
+        limit: int = 10,
+        query_embedding: Optional[Sequence[float]] = None,
+        mode: str = "hybrid",
+        min_score: float = 0.0,
+        enrich: bool = True,
+        labels: Optional[Sequence[str]] = None,
+    ) -> List[Dict[str, Any]]:
+        """Hybrid search (reference: Service.Search search.go:2841):
+        BM25 + vector candidate lists fused with RRF, enriched from storage."""
+        self.stats.searches += 1
+        overfetch = max(limit * 3, 30)
+        bm25_hits: List[Tuple[str, float]] = []
+        vec_hits: List[Tuple[str, float]] = []
+        if mode in ("hybrid", "text") and query:
+            bm25_hits = self.bm25.search(query, overfetch)
+        if mode in ("hybrid", "vector"):
+            qv = (
+                np.asarray(query_embedding, dtype=np.float32)
+                if query_embedding is not None
+                else (self._query_embedding(query) if query.strip() else None)
+            )
+            if qv is not None and len(self.vectors) > 0:
+                vec_hits = self.vector_search_candidates(qv, overfetch)
+
+        if bm25_hits and vec_hits:
+            fused = rrf_fuse([bm25_hits, vec_hits], limit=overfetch)
+        elif bm25_hits:
+            fused = bm25_hits[:overfetch]
+        else:
+            fused = vec_hits[:overfetch]
+
+        bm = dict(bm25_hits)
+        vs = dict(vec_hits)
+        out: List[Dict[str, Any]] = []
+        for node_id, score in fused:
+            # min_score filters on the raw similarity scores (cosine and/or
+            # BM25), NOT the fused RRF value — fused magnitudes depend on
+            # which lists fired and are not comparable across modes. A hit
+            # survives if ANY of its raw scores clears the threshold (a
+            # strong text match must not be vetoed by a negative cosine).
+            v_sc, b_sc = vs.get(node_id), bm.get(node_id)
+            gates = [g for g in (v_sc, b_sc) if g is not None]
+            if gates and max(gates) < min_score:
+                continue
+            res = SearchResult(
+                node_id=node_id,
+                score=score,
+                bm25_score=b_sc,
+                vector_score=v_sc,
+            )
+            if (enrich or labels) and self.storage is not None:
+                try:
+                    node = self.storage.get_node(node_id)
+                except KeyError:
+                    continue  # deleted since indexing; drop stale hit
+                if labels and not set(labels) & set(node.labels):
+                    continue
+                if enrich:
+                    res.node = node
+            out.append(res.to_dict())
+            if len(out) >= limit:
+                break
+        return out

@@ -1,0 +1,180 @@
+"""Brute-force exact kNN index with a device-resident matrix.
+
+The TPU analog of the reference's GPUEmbeddingIndex
+(pkg/gpu/accelerator.go:290-843 Add/Sync/Search): a host NumPy mirror is
+the source of truth; a capacity-padded [C,D] normalized matrix is synced
+to device HBM lazily (dirty-flag) and queried with one MXU matmul + top-k
+(nornicdb_tpu.ops.similarity). Growth re-pads to the next power-of-two
+capacity so jit never sees a new shape per insert.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from nornicdb_tpu.ops.similarity import (
+    cosine_topk,
+    cosine_topk_chunked,
+    l2_normalize,
+    pad_dim,
+)
+
+# above this row count, use the chunked kernel to bound HBM
+CHUNKED_THRESHOLD = 262_144
+
+
+class BruteForceIndex:
+    """Exact cosine kNN over (id -> vector). Thread-safe."""
+
+    def __init__(self, dims: Optional[int] = None, use_device: bool = True):
+        self.dims = dims
+        self.use_device = use_device
+        self._lock = threading.RLock()
+        self._capacity = 0
+        self._count = 0  # high-water mark of used slots
+        self._matrix: Optional[np.ndarray] = None  # [cap, D] normalized f32
+        self._valid: Optional[np.ndarray] = None  # [cap] bool
+        self._ext_ids: List[Optional[str]] = []
+        self._slot_of: Dict[str, int] = {}
+        self._free: List[int] = []  # recycled slots (deletes)
+        self._n_alive = 0
+        # device cache
+        self._dev_matrix = None
+        self._dev_valid = None
+        self._dirty = True
+
+    def __len__(self) -> int:
+        return self._n_alive
+
+    def __contains__(self, ext_id: str) -> bool:
+        with self._lock:
+            return ext_id in self._slot_of
+
+    @staticmethod
+    def _normalize(v: np.ndarray) -> np.ndarray:
+        n = np.linalg.norm(v)
+        return v / n if n > 1e-12 else v
+
+    def _ensure_capacity(self, needed: int, dims: int) -> None:
+        if self.dims is None:
+            self.dims = dims
+        if dims != self.dims:
+            raise ValueError(f"dims mismatch: index={self.dims}, vector={dims}")
+        if needed <= self._capacity:
+            return
+        new_cap = pad_dim(needed)
+        new_m = np.zeros((new_cap, self.dims), dtype=np.float32)
+        new_v = np.zeros((new_cap,), dtype=bool)
+        if self._matrix is not None:
+            new_m[: self._capacity] = self._matrix
+            new_v[: self._capacity] = self._valid
+        self._matrix = new_m
+        self._valid = new_v
+        self._ext_ids.extend([None] * (new_cap - len(self._ext_ids)))
+        self._capacity = new_cap
+        self._dirty = True
+
+    # -- mutation ---------------------------------------------------------
+
+    def add(self, ext_id: str, vector: Sequence[float]) -> None:
+        v = np.asarray(vector, dtype=np.float32)
+        with self._lock:
+            if ext_id in self._slot_of:
+                slot = self._slot_of[ext_id]
+                self._matrix[slot] = self._normalize(v)
+                self._dirty = True
+                return
+            self._ensure_capacity(self._count + (0 if self._free else 1), v.shape[0])
+            if self._free:
+                slot = self._free.pop()
+            else:
+                slot = self._count
+                self._count += 1
+            self._matrix[slot] = self._normalize(v)
+            self._valid[slot] = True
+            self._ext_ids[slot] = ext_id
+            self._slot_of[ext_id] = slot
+            self._n_alive += 1
+            self._dirty = True
+
+    def add_batch(self, items: Sequence[Tuple[str, Sequence[float]]]) -> None:
+        with self._lock:
+            for ext_id, vec in items:
+                self.add(ext_id, vec)
+
+    def remove(self, ext_id: str) -> bool:
+        with self._lock:
+            slot = self._slot_of.pop(ext_id, None)
+            if slot is None:
+                return False
+            self._valid[slot] = False
+            self._ext_ids[slot] = None
+            self._free.append(slot)
+            self._n_alive -= 1
+            self._dirty = True
+            return True
+
+    def get(self, ext_id: str) -> Optional[np.ndarray]:
+        with self._lock:
+            slot = self._slot_of.get(ext_id)
+            if slot is None:
+                return None
+            return self._matrix[slot].copy()
+
+    # -- search -----------------------------------------------------------
+
+    def _device_arrays(self):
+        if self._dirty or self._dev_matrix is None:
+            self._dev_matrix = jnp.asarray(self._matrix)
+            self._dev_valid = jnp.asarray(self._valid)
+            self._dirty = False
+        return self._dev_matrix, self._dev_valid
+
+    def search(
+        self, query: Sequence[float], k: int = 10
+    ) -> List[Tuple[str, float]]:
+        return self.search_batch(np.asarray([query], dtype=np.float32), k)[0]
+
+    def search_batch(
+        self, queries: np.ndarray, k: int = 10
+    ) -> List[List[Tuple[str, float]]]:
+        """Batched exact search; returns per-query [(ext_id, cosine)]."""
+        with self._lock:
+            if self._n_alive == 0:
+                return [[] for _ in range(len(queries))]
+            k_eff = min(k, self._n_alive)
+            m, valid = self._device_arrays()
+            ext_ids = list(self._ext_ids)
+        q = l2_normalize(jnp.asarray(queries, dtype=jnp.float32))
+        if m.shape[0] > CHUNKED_THRESHOLD:
+            s, i = cosine_topk_chunked(q, m, valid, k_eff)
+        else:
+            s, i = cosine_topk(q, m, valid, k_eff)
+        s = np.asarray(s)
+        i = np.asarray(i)
+        out: List[List[Tuple[str, float]]] = []
+        for row in range(s.shape[0]):
+            hits = []
+            for col in range(s.shape[1]):
+                if s[row, col] < -1e29:
+                    break
+                eid = ext_ids[int(i[row, col])]
+                if eid is not None:
+                    hits.append((eid, float(s[row, col])))
+            out.append(hits)
+        return out
+
+    # -- bulk access (for HNSW/kmeans builds) ------------------------------
+
+    def snapshot(self) -> Tuple[np.ndarray, np.ndarray, List[Optional[str]]]:
+        """(matrix[cap,D], valid[cap], ext_ids) — normalized, host-side."""
+        with self._lock:
+            return self._matrix.copy(), self._valid.copy(), list(self._ext_ids)
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return [e for e in self._ext_ids if e is not None]

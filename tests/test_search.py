@@ -1,0 +1,384 @@
+"""Search stack tests: BM25, brute-force index, HNSW, RRF, hybrid service.
+
+Recall methodology mirrors the reference's eval harness thresholds
+(pkg/eval/harness.go:175-272).
+"""
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.search import (
+    BM25Index,
+    BruteForceIndex,
+    HNSWIndex,
+    SearchService,
+    rrf_fuse,
+    tokenize,
+)
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine, Node
+
+
+class TestTokenize:
+    def test_basic(self):
+        assert tokenize("The Quick brown-fox, jumps!") == ["quick", "brown", "fox", "jumps"]
+
+    def test_stopwords_and_length(self):
+        assert tokenize("a I x yz hello") == ["yz", "hello"]
+
+
+class TestBM25:
+    def _idx(self):
+        idx = BM25Index()
+        idx.index_batch(
+            [
+                ("d1", "graph database with vector search"),
+                ("d2", "vector search on tpu hardware"),
+                ("d3", "cooking pasta with tomato sauce"),
+                ("d4", "tpu systolic array matmul hardware"),
+            ]
+        )
+        return idx
+
+    def test_relevance_ordering(self):
+        idx = self._idx()
+        hits = idx.search("tpu hardware", k=4)
+        ids = [h[0] for h in hits]
+        assert ids[0] in ("d2", "d4")
+        assert "d3" not in ids
+
+    def test_remove(self):
+        idx = self._idx()
+        idx.remove("d2")
+        ids = [h[0] for h in idx.search("tpu hardware", k=4)]
+        assert "d2" not in ids and "d4" in ids
+        assert len(idx) == 3
+
+    def test_reindex_updates(self):
+        idx = self._idx()
+        idx.index("d3", "tpu accelerators everywhere tpu tpu")
+        hits = idx.search("tpu", k=4)
+        assert hits[0][0] == "d3"
+
+    def test_idf_rare_terms_win(self):
+        idx = BM25Index()
+        for i in range(20):
+            idx.index(f"c{i}", "common words everywhere common")
+        idx.index("rare", "common words plus zyzzyva")
+        assert idx.search("zyzzyva", k=3)[0][0] == "rare"
+
+    def test_seed_doc_ids(self):
+        idx = BM25Index()
+        # two lexical clusters + noise
+        for i in range(10):
+            idx.index(f"a{i}", "kubernetes cluster deployment pods")
+        for i in range(10):
+            idx.index(f"b{i}", "genome sequencing dna biology")
+        idx.index("noise", "asdf qwer")
+        seeds = idx.seed_doc_ids(max_seeds=8)
+        assert 0 < len(seeds) <= 8
+        assert all(s.startswith(("a", "b")) for s in seeds)
+
+    def test_roundtrip_persistence(self):
+        idx = self._idx()
+        idx.remove("d3")
+        clone = BM25Index.from_dict(idx.to_dict())
+        assert len(clone) == 3
+        assert [h[0] for h in clone.search("vector search", k=2)] == [
+            h[0] for h in idx.search("vector search", k=2)
+        ]
+
+
+class TestBruteForceIndex:
+    def test_add_search_remove(self):
+        idx = BruteForceIndex()
+        rng = np.random.default_rng(0)
+        vecs = rng.standard_normal((50, 16)).astype(np.float32)
+        for i, v in enumerate(vecs):
+            idx.add(f"n{i}", v)
+        assert len(idx) == 50
+        hits = idx.search(vecs[7], k=3)
+        assert hits[0][0] == "n7"
+        assert hits[0][1] == pytest.approx(1.0, abs=1e-4)
+        idx.remove("n7")
+        hits = idx.search(vecs[7], k=3)
+        assert hits[0][0] != "n7"
+        assert len(idx) == 49
+
+    def test_update_in_place(self):
+        idx = BruteForceIndex()
+        idx.add("a", [1.0, 0.0])
+        idx.add("b", [0.0, 1.0])
+        idx.add("a", [0.0, 1.0])  # update
+        hits = idx.search([0.0, 1.0], k=2)
+        assert {h[0] for h in hits} == {"a", "b"}
+        assert len(idx) == 2
+
+    def test_slot_recycling_after_remove(self):
+        idx = BruteForceIndex()
+        for i in range(10):
+            idx.add(f"n{i}", np.eye(16)[i % 16])
+        idx.remove("n3")
+        idx.add("new", np.ones(16))
+        assert len(idx) == 10
+        assert idx.search(np.ones(16), k=1)[0][0] == "new"
+
+    def test_growth_past_capacity(self):
+        idx = BruteForceIndex()
+        rng = np.random.default_rng(1)
+        for i in range(300):  # crosses the 256 pad boundary
+            idx.add(f"n{i}", rng.standard_normal(8).astype(np.float32))
+        assert len(idx) == 300
+        assert len(idx.search(np.ones(8), k=5)) == 5
+
+    def test_batch_queries(self):
+        idx = BruteForceIndex()
+        idx.add("x", [1.0, 0.0])
+        idx.add("y", [0.0, 1.0])
+        res = idx.search_batch(np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32), k=1)
+        assert res[0][0][0] == "x" and res[1][0][0] == "y"
+
+
+class TestHNSW:
+    def test_recall_vs_brute(self):
+        rng = np.random.default_rng(2)
+        n, d = 2000, 32
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+        brute = BruteForceIndex()
+        hnsw = HNSWIndex(m=16, ef_construction=100, ef_search=80)
+        for i in range(n):
+            brute.add(f"n{i}", vecs[i])
+            hnsw.add(f"n{i}", vecs[i])
+        hits = 0
+        trials = 20
+        for t in range(trials):
+            q = rng.standard_normal(d).astype(np.float32)
+            truth = {h[0] for h in brute.search(q, k=10)}
+            approx = {h[0] for h in hnsw.search(q, k=10)}
+            hits += len(truth & approx)
+        recall = hits / (10 * trials)
+        assert recall >= 0.9, f"HNSW recall@10 = {recall}"
+
+    def test_exact_hit_returns_itself(self):
+        rng = np.random.default_rng(3)
+        vecs = rng.standard_normal((500, 16)).astype(np.float32)
+        hnsw = HNSWIndex()
+        for i, v in enumerate(vecs):
+            hnsw.add(f"n{i}", v)
+        for probe in (0, 100, 499):
+            assert hnsw.search(vecs[probe], k=1)[0][0] == f"n{probe}"
+
+    def test_tombstones_not_returned(self):
+        rng = np.random.default_rng(4)
+        vecs = rng.standard_normal((100, 8)).astype(np.float32)
+        hnsw = HNSWIndex()
+        for i, v in enumerate(vecs):
+            hnsw.add(f"n{i}", v)
+        hnsw.remove("n5")
+        assert all(h[0] != "n5" for h in hnsw.search(vecs[5], k=10))
+        assert hnsw.should_rebuild() is False
+
+    def test_rebuild_threshold(self):
+        hnsw = HNSWIndex(rebuild_threshold=0.2)
+        rng = np.random.default_rng(5)
+        for i in range(20):
+            hnsw.add(f"n{i}", rng.standard_normal(4).astype(np.float32))
+        for i in range(6):
+            hnsw.remove(f"n{i}")
+        assert hnsw.should_rebuild()
+
+    def test_seeded_build_order(self):
+        rng = np.random.default_rng(6)
+        items = [(f"n{i}", rng.standard_normal(8).astype(np.float32)) for i in range(50)]
+        hnsw = HNSWIndex()
+        hnsw.build(items, seed_ids=["n40", "n41"])
+        # seeds inserted first -> they occupy slots 0 and 1
+        assert hnsw._ext_ids[0] == "n40" and hnsw._ext_ids[1] == "n41"
+        assert len(hnsw) == 50
+
+    def test_save_load_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(7)
+        vecs = rng.standard_normal((200, 16)).astype(np.float32)
+        hnsw = HNSWIndex()
+        for i, v in enumerate(vecs):
+            hnsw.add(f"n{i}", v)
+        hnsw.remove("n10")
+        path = str(tmp_path / "hnsw.npz")
+        hnsw.save(path)
+        loaded = HNSWIndex.load(path)
+        assert len(loaded) == 199
+        q = vecs[55]
+        assert loaded.search(q, k=1)[0][0] == "n55"
+
+
+class TestRRF:
+    def test_fusion_prefers_agreement(self):
+        a = [("x", 5.0), ("y", 4.0), ("z", 3.0)]
+        b = [("y", 0.9), ("x", 0.8), ("w", 0.7)]
+        fused = rrf_fuse([a, b], limit=4)
+        ids = [f[0] for f in fused]
+        assert set(ids[:2]) == {"x", "y"}
+        assert ids.index("w") > ids.index("y")
+
+    def test_weights(self):
+        a = [("x", 1.0)]
+        b = [("y", 1.0)]
+        fused = rrf_fuse([a, b], weights=[2.0, 1.0], limit=2)
+        assert fused[0][0] == "x"
+
+
+class _StubEmbedder:
+    """Deterministic text-hash embedder for tests."""
+
+    dims = 32
+
+    def embed(self, text: str):
+        rng = np.random.default_rng(abs(hash(text)) % (2**32))
+        v = rng.standard_normal(self.dims)
+        return (v / np.linalg.norm(v)).astype(np.float32)
+
+
+class TestSearchService:
+    def _service(self):
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        svc = SearchService(eng, embedder=_StubEmbedder())
+        return eng, svc
+
+    def test_hybrid_end_to_end(self):
+        eng, svc = self._service()
+        emb = _StubEmbedder()
+        docs = {
+            "n1": "graph databases store nodes and edges",
+            "n2": "vector search finds similar embeddings",
+            "n3": "tomato pasta recipe with basil",
+        }
+        for nid, text in docs.items():
+            node = Node(id=nid, labels=["Doc"], properties={"content": text},
+                        embedding=list(emb.embed(text)))
+            eng.create_node(node)
+            svc.index_node(eng.get_node(nid))
+        res = svc.search("vector search embeddings", limit=2)
+        assert res[0]["id"] == "n2"
+        assert "properties" in res[0]
+
+    def test_stale_hits_dropped(self):
+        eng, svc = self._service()
+        node = Node(id="gone", labels=[], properties={"content": "unique zebra"})
+        eng.create_node(node)
+        svc.index_node(eng.get_node("gone"))
+        eng.delete_node("gone")
+        assert svc.search("unique zebra", limit=5) == []
+
+    def test_label_filter(self):
+        eng, svc = self._service()
+        for nid, lbl in [("a", "Person"), ("b", "Animal")]:
+            node = Node(id=nid, labels=[lbl], properties={"content": "zebra stripes"})
+            eng.create_node(node)
+            svc.index_node(eng.get_node(nid))
+        res = svc.search("zebra", limit=5, labels=["Animal"])
+        assert [r["id"] for r in res] == ["b"]
+
+    def test_vector_only_mode(self):
+        eng, svc = self._service()
+        emb = _StubEmbedder()
+        for nid in ("v1", "v2"):
+            node = Node(id=nid, labels=[], properties={},
+                        embedding=list(emb.embed(nid)))
+            eng.create_node(node)
+            svc.index_node(eng.get_node(nid))
+        res = svc.search(query_embedding=list(emb.embed("v1")), mode="vector", limit=1)
+        assert res[0]["id"] == "v1"
+
+    def test_strategy_switches_to_hnsw(self):
+        eng, svc = self._service()
+        svc.hnsw_threshold = 50
+        rng = np.random.default_rng(8)
+        for i in range(60):
+            node = Node(id=f"n{i}", labels=[], properties={"content": f"doc {i}"},
+                        embedding=list(rng.standard_normal(16).astype(np.float32)))
+            eng.create_node(node)
+            svc.index_node(eng.get_node(f"n{i}"))
+        assert svc.stats.strategy == "hnsw"
+        assert svc.hnsw is not None and len(svc.hnsw) == 60
+
+    def test_chunk_embeddings_mean_indexed(self):
+        eng, svc = self._service()
+        node = Node(id="c1", labels=[], properties={},
+                    chunk_embeddings=[[1.0, 0.0], [0.0, 1.0]])
+        eng.create_node(node)
+        svc.index_node(eng.get_node("c1"))
+        res = svc.search(query_embedding=[1.0, 1.0], mode="vector", limit=1)
+        assert res[0]["id"] == "c1"
+
+    def test_build_indexes_from_storage(self):
+        eng, svc = self._service()
+        for i in range(5):
+            eng.create_node(Node(id=f"n{i}", labels=[], properties={"content": f"text {i}"}))
+        assert svc.build_indexes() == 5
+        assert len(svc.bm25) == 5
+
+
+class TestSearchReviewRegressions:
+    def test_update_clearing_text_removes_from_bm25(self):
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        svc = SearchService(eng)
+        eng.create_node(Node(id="n", labels=[], properties={"content": "zebra"}))
+        svc.index_node(eng.get_node("n"))
+        assert svc.search("zebra", limit=5)
+        node = eng.get_node("n")
+        node.properties["content"] = ""
+        eng.update_node(node)
+        svc.index_node(eng.get_node("n"))
+        assert svc.search("zebra", limit=5) == []
+
+    def test_update_removing_embedding_drops_vector(self):
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        svc = SearchService(eng)
+        eng.create_node(Node(id="n", labels=[], properties={}, embedding=[1.0, 0.0]))
+        svc.index_node(eng.get_node("n"))
+        assert len(svc.vectors) == 1
+        node = eng.get_node("n")
+        node.embedding = None
+        eng.update_node(node)
+        svc.index_node(eng.get_node("n"))
+        assert len(svc.vectors) == 0
+
+    def test_labels_filter_applies_without_enrich(self):
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        svc = SearchService(eng)
+        for nid, lbl in [("a", "Person"), ("b", "Animal")]:
+            eng.create_node(Node(id=nid, labels=[lbl], properties={"content": "zebra"}))
+            svc.index_node(eng.get_node(nid))
+        res = svc.search("zebra", limit=5, labels=["Animal"], enrich=False)
+        assert [r["id"] for r in res] == ["b"]
+        assert "properties" not in res[0]
+
+    def test_hnsw_update_relinks(self):
+        rng = np.random.default_rng(9)
+        hnsw = HNSWIndex(m=8, ef_construction=50, ef_search=50)
+        vecs = rng.standard_normal((200, 16)).astype(np.float32)
+        for i, v in enumerate(vecs):
+            hnsw.add(f"n{i}", v)
+        # move n0 to the opposite side of the space; it must remain findable
+        new_v = -vecs[0]
+        hnsw.add("n0", new_v)
+        assert hnsw.search(new_v, k=1)[0][0] == "n0"
+
+    def test_hnsw_short_results_with_tombstones(self):
+        rng = np.random.default_rng(10)
+        hnsw = HNSWIndex(m=8, ef_search=10, rebuild_threshold=0.5)
+        vecs = rng.standard_normal((100, 8)).astype(np.float32)
+        for i, v in enumerate(vecs):
+            hnsw.add(f"n{i}", v)
+        for i in range(0, 30):
+            hnsw.remove(f"n{i}")
+        q = rng.standard_normal(8).astype(np.float32)
+        assert len(hnsw.search(q, k=10)) == 10
+
+    def test_bm25_compaction_bounds_slots(self):
+        idx = BM25Index()
+        for round_ in range(30):
+            for i in range(60):
+                idx.index(f"d{i}", f"document body number {i} round {round_}")
+        assert len(idx) == 60
+        assert len(idx._ext_ids) < 3000  # compaction kicked in
+        assert idx.search("document", k=5)
