@@ -1,0 +1,11 @@
+"""Cypher query layer.
+
+Reference: pkg/cypher (78k LoC) — StorageExecutor.Execute routing
+(executor.go:517-700), the nornic string-routing parser (parser.go:24),
+streaming fast paths (optimized_executors.go), ~200 builtin functions,
+CALL procedures, EXPLAIN/PROFILE. The TPU design keeps parsing/routing on
+CPU and vectorizes aggregation shapes over columnar snapshots dispatched
+to XLA (fastpaths.py).
+"""
+
+from nornicdb_tpu.query.executor import CypherExecutor, CypherResult  # noqa: F401

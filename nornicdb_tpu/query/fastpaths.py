@@ -1,0 +1,85 @@
+"""Streaming fast paths: shape-specialized executors that bypass the
+general pipeline.
+
+Reference: the reference's perf story is mostly *avoiding* general
+execution — tryFastPathCompoundQuery (executor.go:1421), ExecuteOptimized
+(optimized_executors.go:25-282), fast aggregations
+(traversal_fast_agg.go:15,57), namespace-bypass (storage_fastpaths.go).
+Here the detection works on the parsed AST (cheaper to keep correct than
+regex shape-matching) and the counting shapes hit the storage engine's
+O(1)/indexed paths directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from nornicdb_tpu.query import ast as A
+
+
+def try_fast_path(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
+    from nornicdb_tpu.query.executor import CypherResult
+
+    clauses = q.clauses
+    if len(clauses) != 2:
+        return None
+    m, r = clauses[0], clauses[1]
+    if not isinstance(m, A.MatchClause) or not isinstance(r, A.ReturnClause):
+        return None
+    if m.optional or m.where is not None or len(m.paths) != 1:
+        return None
+    if r.distinct or r.order_by or r.skip or r.limit or r.star:
+        return None
+    if len(r.items) != 1:
+        return None
+    item = r.items[0]
+    e = item.expr
+    if not (isinstance(e, A.FuncCall) and e.name == "count" and not e.distinct):
+        return None
+    path = m.paths[0]
+    col = item.alias or item.text
+
+    # MATCH (n[:Label]) RETURN count(n|*)
+    if len(path.nodes) == 1 and not path.rels:
+        pn = path.nodes[0]
+        if pn.props is not None:
+            return None
+        if not (
+            e.star
+            or (len(e.args) == 1 and isinstance(e.args[0], A.Var)
+                and e.args[0].name == pn.var)
+        ):
+            return None
+        if not pn.labels:
+            # O(1) engine count (reference: count fast path)
+            return CypherResult(columns=[col], rows=[[ctx.storage.count_nodes()]])
+        if len(pn.labels) == 1:
+            n = len(ctx.storage.get_nodes_by_label(pn.labels[0]))
+            return CypherResult(columns=[col], rows=[[n]])
+        return None
+
+    # MATCH ()-[r[:TYPE]]->() RETURN count(r|*)
+    if len(path.nodes) == 2 and len(path.rels) == 1:
+        pr = path.rels[0]
+        n0, n1 = path.nodes
+        if (
+            n0.labels or n1.labels or n0.props or n1.props or pr.props
+            or n0.var or n1.var
+        ):
+            return None
+        if pr.min_hops != 1 or pr.max_hops != 1:
+            return None
+        if pr.direction == "both":
+            return None  # both-direction counts each edge twice; general path
+        counts_ok = e.star or (
+            len(e.args) == 1 and isinstance(e.args[0], A.Var)
+            and e.args[0].name == pr.var
+        )
+        if not counts_ok:
+            return None
+        if not pr.types:
+            return CypherResult(columns=[col], rows=[[ctx.storage.count_edges()]])
+        total = sum(len(ctx.storage.get_edges_by_type(t)) for t in pr.types)
+        return CypherResult(columns=[col], rows=[[total]])
+
+    return None

@@ -1,0 +1,340 @@
+"""Cypher builtin scalar functions.
+
+Reference: pkg/cypher/functions_eval_functions.go (2,211 LoC) + registry
+pkg/cypher/fn/registry.go, builtins_core.go (~200 builtins). This module
+covers the high-traffic core; APOC-namespaced functions register through
+the same table (nornicdb_tpu.query.apoc).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+import uuid as _uuid
+from datetime import datetime, timezone
+from typing import Any, Callable, Dict, List, Optional
+
+from nornicdb_tpu.errors import CypherRuntimeError
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+class PathValue:
+    """A matched path: alternating nodes and relationships."""
+
+    def __init__(self, nodes: List[Node], rels: List[Edge]):
+        self.nodes = nodes
+        self.rels = rels
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, PathValue)
+            and [n.id for n in self.nodes] == [n.id for n in other.nodes]
+            and [r.id for r in self.rels] == [r.id for r in other.rels]
+        )
+
+    def __len__(self):
+        return len(self.rels)
+
+
+FunctionImpl = Callable[..., Any]
+REGISTRY: Dict[str, FunctionImpl] = {}
+
+
+def register(name: str, fn: FunctionImpl) -> None:
+    REGISTRY[name.lower()] = fn
+
+
+def lookup(name: str) -> Optional[FunctionImpl]:
+    return REGISTRY.get(name.lower())
+
+
+def _num(x: Any) -> float:
+    if isinstance(x, bool) or not isinstance(x, (int, float)):
+        raise CypherRuntimeError(f"expected number, got {type(x).__name__}")
+    return x
+
+
+# -- entity functions ----------------------------------------------------
+
+
+def _id(x):
+    if isinstance(x, (Node, Edge)):
+        return x.id
+    return None
+
+
+def _labels(n):
+    if isinstance(n, Node):
+        return list(n.labels)
+    if n is None:
+        return None
+    raise CypherRuntimeError("labels() expects a node")
+
+
+def _type(r):
+    if isinstance(r, Edge):
+        return r.type
+    if r is None:
+        return None
+    raise CypherRuntimeError("type() expects a relationship")
+
+
+def _properties(x):
+    if isinstance(x, (Node, Edge)):
+        return dict(x.properties)
+    if isinstance(x, dict):
+        return dict(x)
+    if x is None:
+        return None
+    raise CypherRuntimeError("properties() expects a node/relationship/map")
+
+
+def _start_node(r):
+    return r.start_node if isinstance(r, Edge) else None
+
+
+def _end_node(r):
+    return r.end_node if isinstance(r, Edge) else None
+
+
+def _keys(x):
+    if isinstance(x, (Node, Edge)):
+        return sorted(x.properties.keys())
+    if isinstance(x, dict):
+        return sorted(x.keys())
+    if x is None:
+        return None
+    raise CypherRuntimeError("keys() expects a node/relationship/map")
+
+
+# -- list / size ---------------------------------------------------------
+
+
+def _size(x):
+    if x is None:
+        return None
+    if isinstance(x, (list, str, dict)):
+        return len(x)
+    raise CypherRuntimeError("size() expects a list/string/map")
+
+
+def _length(x):
+    if x is None:
+        return None
+    if isinstance(x, PathValue):
+        return len(x)
+    if isinstance(x, (list, str)):
+        return len(x)
+    raise CypherRuntimeError("length() expects a path")
+
+
+def _range(start, end, step=1):
+    start, end, step = int(start), int(end), int(step)
+    if step == 0:
+        raise CypherRuntimeError("range() step must not be zero")
+    out = []
+    i = start
+    if step > 0:
+        while i <= end:
+            out.append(i)
+            i += step
+    else:
+        while i >= end:
+            out.append(i)
+            i += step
+    return out
+
+
+def _coalesce(*args):
+    for a in args:
+        if a is not None:
+            return a
+    return None
+
+
+def _head(lst):
+    if lst is None:
+        return None
+    return lst[0] if lst else None
+
+
+def _last(lst):
+    if lst is None:
+        return None
+    return lst[-1] if lst else None
+
+
+def _tail(lst):
+    if lst is None:
+        return None
+    return list(lst[1:])
+
+
+def _reverse(x):
+    if x is None:
+        return None
+    if isinstance(x, str):
+        return x[::-1]
+    return list(reversed(x))
+
+
+def _nodes(p):
+    if isinstance(p, PathValue):
+        return list(p.nodes)
+    return None
+
+
+def _relationships(p):
+    if isinstance(p, PathValue):
+        return list(p.rels)
+    return None
+
+
+# -- string --------------------------------------------------------------
+
+
+def _to_string(x):
+    if x is None:
+        return None
+    if isinstance(x, bool):
+        return "true" if x else "false"
+    if isinstance(x, float) and x.is_integer():
+        return f"{x:.1f}"
+    return str(x)
+
+
+def _substring(s, start, length=None):
+    if s is None:
+        return None
+    start = int(start)
+    if length is None:
+        return s[start:]
+    return s[start : start + int(length)]
+
+
+def _split(s, delim):
+    if s is None:
+        return None
+    return s.split(delim)
+
+
+def _replace(s, search, repl):
+    if s is None:
+        return None
+    return s.replace(search, repl)
+
+
+def _left(s, n):
+    return None if s is None else s[: int(n)]
+
+
+def _right(s, n):
+    return None if s is None else (s[-int(n):] if int(n) > 0 else "")
+
+
+# -- numeric -------------------------------------------------------------
+
+
+def _to_integer(x):
+    if x is None:
+        return None
+    try:
+        if isinstance(x, str):
+            return int(float(x)) if x.strip() else None
+        if isinstance(x, bool):
+            return 1 if x else 0
+        return int(x)
+    except (ValueError, TypeError):
+        return None
+
+
+def _to_float(x):
+    if x is None:
+        return None
+    try:
+        if isinstance(x, bool):
+            return 1.0 if x else 0.0
+        return float(x)
+    except (ValueError, TypeError):
+        return None
+
+
+def _round(x, precision=0):
+    if x is None:
+        return None
+    p = int(precision)
+    # Cypher rounds half away from zero
+    scaled = _num(x) * (10 ** p)
+    r = math.floor(abs(scaled) + 0.5) * (1 if scaled >= 0 else -1)
+    out = r / (10 ** p)
+    return out if p > 0 else float(out)
+
+
+def _install_core():
+    register("id", _id)
+    register("elementId", _id)
+    register("labels", _labels)
+    register("type", _type)
+    register("properties", _properties)
+    register("startNode", _start_node)
+    register("endNode", _end_node)
+    register("keys", _keys)
+    register("size", _size)
+    register("length", _length)
+    register("range", _range)
+    register("coalesce", _coalesce)
+    register("head", _head)
+    register("last", _last)
+    register("tail", _tail)
+    register("reverse", _reverse)
+    register("nodes", _nodes)
+    register("relationships", _relationships)
+
+    register("toString", _to_string)
+    register("toUpper", lambda s: None if s is None else s.upper())
+    register("toLower", lambda s: None if s is None else s.lower())
+    register("trim", lambda s: None if s is None else s.strip())
+    register("ltrim", lambda s: None if s is None else s.lstrip())
+    register("rtrim", lambda s: None if s is None else s.rstrip())
+    register("substring", _substring)
+    register("split", _split)
+    register("replace", _replace)
+    register("left", _left)
+    register("right", _right)
+
+    register("abs", lambda x: None if x is None else abs(_num(x)))
+    register("ceil", lambda x: None if x is None else float(math.ceil(_num(x))))
+    register("floor", lambda x: None if x is None else float(math.floor(_num(x))))
+    register("round", _round)
+    register("sqrt", lambda x: None if x is None else math.sqrt(_num(x)))
+    register("sign", lambda x: None if x is None else (0 if x == 0 else (1 if x > 0 else -1)))
+    register("exp", lambda x: None if x is None else math.exp(_num(x)))
+    register("log", lambda x: None if x is None else math.log(_num(x)))
+    register("log10", lambda x: None if x is None else math.log10(_num(x)))
+    register("sin", lambda x: None if x is None else math.sin(_num(x)))
+    register("cos", lambda x: None if x is None else math.cos(_num(x)))
+    register("tan", lambda x: None if x is None else math.tan(_num(x)))
+    register("atan", lambda x: None if x is None else math.atan(_num(x)))
+    register("atan2", lambda y, x: math.atan2(_num(y), _num(x)))
+    register("acos", lambda x: None if x is None else math.acos(_num(x)))
+    register("asin", lambda x: None if x is None else math.asin(_num(x)))
+    register("pi", lambda: math.pi)
+    register("e", lambda: math.e)
+    register("rand", lambda: random.random())
+    register("toInteger", _to_integer)
+    register("toFloat", _to_float)
+    register("toBoolean", lambda x: None if x is None else (
+        x if isinstance(x, bool) else
+        (x.lower() == "true" if isinstance(x, str) and x.lower() in ("true", "false") else None)))
+
+    register("timestamp", lambda: int(time.time() * 1000))
+    register("randomUUID", lambda: str(_uuid.uuid4()))
+    register("date", lambda s=None: (
+        datetime.now(timezone.utc).strftime("%Y-%m-%d") if s is None else str(s)))
+    register("datetime", lambda s=None: (
+        datetime.now(timezone.utc).isoformat() if s is None
+        else str(s)))
+
+
+_install_core()

@@ -1,0 +1,110 @@
+"""CALL procedure implementations.
+
+Reference: pkg/cypher/call.go:613 executeCall dispatch + the db.*/dbms.*
+surface (call_vector.go:19 db.index.vector.queryNodes, call_fulltext.go,
+executor_show.go).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List
+
+from nornicdb_tpu.errors import CypherRuntimeError
+
+
+def run_procedure(
+    executor, name: str, args: List[Any], ctx
+) -> Iterator[Dict[str, Any]]:
+    name = name.lower()
+    storage = ctx.storage
+
+    if name == "db.labels":
+        seen = {}
+        for n in storage.all_nodes():
+            for l in n.labels:
+                seen[l] = None
+        for l in sorted(seen):
+            yield {"label": l}
+        return
+
+    if name == "db.relationshiptypes":
+        seen = {}
+        for e in storage.all_edges():
+            seen[e.type] = None
+        for t in sorted(seen):
+            yield {"relationshipType": t}
+        return
+
+    if name == "db.propertykeys":
+        seen = {}
+        for n in storage.all_nodes():
+            for k in n.properties:
+                seen[k] = None
+        for e in storage.all_edges():
+            for k in e.properties:
+                seen[k] = None
+        for k in sorted(seen):
+            yield {"propertyKey": k}
+        return
+
+    if name == "db.schema.visualization":
+        labels = {}
+        for n in storage.all_nodes():
+            for l in n.labels:
+                labels[l] = None
+        yield {"nodes": sorted(labels), "relationships": []}
+        return
+
+    if name in ("dbms.components",):
+        from nornicdb_tpu import __version__
+
+        yield {
+            "name": "nornicdb-tpu",
+            "versions": [__version__],
+            "edition": "tpu",
+        }
+        return
+
+    if name == "db.index.vector.querynodes":
+        # (indexName, k, queryVector) — reference call_vector.go:19
+        if len(args) < 3:
+            raise CypherRuntimeError(
+                "db.index.vector.queryNodes(indexName, k, vector)"
+            )
+        _index_name, k, vector = args[0], int(args[1]), args[2]
+        svc = executor._search
+        if svc is None:
+            raise CypherRuntimeError("no search service wired")
+        for node_id, score in svc.vector_search_candidates(vector, k):
+            try:
+                node = storage.get_node(node_id)
+            except KeyError:
+                continue
+            yield {"node": node, "score": float(score)}
+        return
+
+    if name == "db.index.fulltext.querynodes":
+        if len(args) < 2:
+            raise CypherRuntimeError(
+                "db.index.fulltext.queryNodes(indexName, query[, k])"
+            )
+        _index_name, query = args[0], args[1]
+        k = int(args[2]) if len(args) > 2 else 10
+        svc = executor._search
+        if svc is None:
+            raise CypherRuntimeError("no search service wired")
+        for node_id, score in svc.bm25.search(query, k):
+            try:
+                node = storage.get_node(node_id)
+            except KeyError:
+                continue
+            yield {"node": node, "score": float(score)}
+        return
+
+    if name.startswith("apoc."):
+        from nornicdb_tpu.query.apoc import run_apoc_procedure
+
+        yield from run_apoc_procedure(executor, name, args, ctx)
+        return
+
+    raise CypherRuntimeError(f"unknown procedure {name}")

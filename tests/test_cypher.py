@@ -1,0 +1,514 @@
+"""Cypher engine tests.
+
+Fixture pattern mirrors the reference: MemoryEngine + NamespacedEngine
+(reference: setupChaosExecutor, pkg/cypher/chaos_injection_test.go:15-21).
+"""
+
+import pytest
+
+from nornicdb_tpu.errors import CypherRuntimeError, CypherSyntaxError
+from nornicdb_tpu.query import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture()
+def ex():
+    return CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+
+
+def _seed_social(ex):
+    ex.execute(
+        """
+        CREATE (alice:Person {name: 'Alice', age: 30}),
+               (bob:Person {name: 'Bob', age: 25}),
+               (carol:Person {name: 'Carol', age: 35}),
+               (d:Company {name: 'Initech'}),
+               (alice)-[:KNOWS {since: 2019}]->(bob),
+               (bob)-[:KNOWS {since: 2021}]->(carol),
+               (alice)-[:WORKS_AT]->(d),
+               (bob)-[:WORKS_AT]->(d)
+        """
+    )
+
+
+class TestCreateAndMatch:
+    def test_create_return(self, ex):
+        r = ex.execute("CREATE (n:Person {name: 'Neo'}) RETURN n.name")
+        assert r.columns == ["n.name"]
+        assert r.rows == [["Neo"]]
+        assert r.stats.nodes_created == 1
+
+    def test_match_by_label_and_prop(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH (p:Person {name: 'Alice'}) RETURN p.age")
+        assert r.rows == [[30]]
+
+    def test_match_where(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (p:Person) WHERE p.age > 26 RETURN p.name ORDER BY p.name"
+        )
+        assert [row[0] for row in r.rows] == ["Alice", "Carol"]
+
+    def test_relationship_match(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (a:Person)-[:KNOWS]->(b:Person) RETURN a.name, b.name ORDER BY a.name"
+        )
+        assert r.rows == [["Alice", "Bob"], ["Bob", "Carol"]]
+
+    def test_incoming_direction(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH (b)<-[:KNOWS]-(a) RETURN a.name ORDER BY a.name")
+        assert [row[0] for row in r.rows] == ["Alice", "Bob"]
+
+    def test_undirected(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (p:Person {name: 'Bob'})-[:KNOWS]-(x) RETURN x.name ORDER BY x.name"
+        )
+        assert [row[0] for row in r.rows] == ["Alice", "Carol"]
+
+    def test_rel_properties(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (:Person)-[k:KNOWS]->(:Person) WHERE k.since > 2020 RETURN k.since"
+        )
+        assert r.rows == [[2021]]
+
+    def test_multi_pattern_join(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (a:Person)-[:WORKS_AT]->(c:Company), (b:Person)-[:WORKS_AT]->(c)
+               WHERE a.name < b.name RETURN a.name, b.name"""
+        )
+        assert r.rows == [["Alice", "Bob"]]
+
+    def test_var_length_path(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (a:Person {name:'Alice'})-[:KNOWS*1..2]->(x) RETURN x.name ORDER BY x.name"
+        )
+        assert [row[0] for row in r.rows] == ["Bob", "Carol"]
+
+    def test_path_variable(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH p = (a {name:'Alice'})-[:KNOWS*]->(c {name:'Carol'}) RETURN length(p)"
+        )
+        assert r.rows == [[2]]
+
+    def test_optional_match(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (p:Person) OPTIONAL MATCH (p)-[:KNOWS]->(f)
+               RETURN p.name, f.name ORDER BY p.name"""
+        )
+        assert r.rows == [["Alice", "Bob"], ["Bob", "Carol"], ["Carol", None]]
+
+    def test_anonymous_nodes(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH ()-[r:KNOWS]->() RETURN count(r)")
+        assert r.rows == [[2]]
+
+
+class TestAggregation:
+    def test_count_group_by(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (p:Person)-[:WORKS_AT]->(c:Company)
+               RETURN c.name AS company, count(p) AS headcount"""
+        )
+        assert r.rows == [["Initech", 2]]
+
+    def test_sum_avg_min_max(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (p:Person) RETURN sum(p.age), avg(p.age), min(p.age), max(p.age)"
+        )
+        assert r.rows == [[90, 30.0, 25, 35]]
+
+    def test_collect(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (p:Person) RETURN collect(p.name) AS names ORDER BY names"
+        )
+        assert sorted(r.rows[0][0]) == ["Alice", "Bob", "Carol"]
+
+    def test_count_distinct(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (p:Person)-[:WORKS_AT]->(c) RETURN count(DISTINCT c) AS n"
+        )
+        assert r.rows == [[1]]
+
+    def test_count_empty_is_zero(self, ex):
+        r = ex.execute("MATCH (n:Nothing) RETURN count(n)")
+        assert r.rows == [[0]]
+
+    def test_agg_with_arithmetic(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH (p:Person) RETURN count(p) * 2 AS double")
+        assert r.rows == [[6]]
+
+
+class TestWithChaining:
+    def test_with_filter(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (p:Person) WITH p, p.age AS age WHERE age >= 30
+               RETURN p.name ORDER BY p.name"""
+        )
+        assert [row[0] for row in r.rows] == ["Alice", "Carol"]
+
+    def test_with_aggregation_then_filter(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (p:Person)-[:WORKS_AT]->(c:Company)
+               WITH c, count(p) AS n WHERE n > 1
+               RETURN c.name, n"""
+        )
+        assert r.rows == [["Initech", 2]]
+
+    def test_with_order_limit(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (p:Person) WITH p ORDER BY p.age DESC LIMIT 1
+               RETURN p.name"""
+        )
+        assert r.rows == [["Carol"]]
+
+    def test_unwind(self, ex):
+        r = ex.execute("UNWIND [1, 2, 3] AS x RETURN x * 10 AS y")
+        assert [row[0] for row in r.rows] == [10, 20, 30]
+
+    def test_unwind_param(self, ex):
+        r = ex.execute("UNWIND $items AS i RETURN i.name", {"items": [{"name": "a"}, {"name": "b"}]})
+        assert [row[0] for row in r.rows] == ["a", "b"]
+
+
+class TestMutation:
+    def test_set_property(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH (p:Person {name:'Bob'}) SET p.age = 26 RETURN p.age")
+        assert r.rows == [[26]]
+        assert ex.execute("MATCH (p {name:'Bob'}) RETURN p.age").rows == [[26]]
+
+    def test_set_label_and_remove(self, ex):
+        _seed_social(ex)
+        ex.execute("MATCH (p:Person {name:'Alice'}) SET p:Admin")
+        assert ex.execute("MATCH (a:Admin) RETURN a.name").rows == [["Alice"]]
+        ex.execute("MATCH (p:Admin) REMOVE p:Admin")
+        assert ex.execute("MATCH (a:Admin) RETURN count(a)").rows == [[0]]
+
+    def test_set_merge_map(self, ex):
+        _seed_social(ex)
+        ex.execute("MATCH (p {name:'Alice'}) SET p += {city: 'Oslo', age: 31}")
+        r = ex.execute("MATCH (p {name:'Alice'}) RETURN p.city, p.age")
+        assert r.rows == [["Oslo", 31]]
+
+    def test_delete_requires_detach(self, ex):
+        _seed_social(ex)
+        with pytest.raises(CypherRuntimeError):
+            ex.execute("MATCH (p:Person {name:'Alice'}) DELETE p")
+        r = ex.execute("MATCH (p:Person {name:'Alice'}) DETACH DELETE p")
+        assert r.stats.nodes_deleted == 1
+        assert ex.execute("MATCH (p:Person) RETURN count(p)").rows == [[2]]
+
+    def test_delete_relationship(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH (:Person)-[k:KNOWS]->(:Person) DELETE k")
+        assert r.stats.relationships_deleted == 2
+
+    def test_merge_creates_once(self, ex):
+        r1 = ex.execute("MERGE (n:Tag {name: 'x'}) RETURN n.name")
+        r2 = ex.execute("MERGE (n:Tag {name: 'x'}) RETURN n.name")
+        assert r1.stats.nodes_created == 1
+        assert r2.stats.nodes_created == 0
+        assert ex.execute("MATCH (t:Tag) RETURN count(t)").rows == [[1]]
+
+    def test_merge_on_create_on_match(self, ex):
+        ex.execute(
+            "MERGE (n:Cnt {k:'a'}) ON CREATE SET n.times = 1 ON MATCH SET n.times = n.times + 1"
+        )
+        ex.execute(
+            "MERGE (n:Cnt {k:'a'}) ON CREATE SET n.times = 1 ON MATCH SET n.times = n.times + 1"
+        )
+        assert ex.execute("MATCH (n:Cnt) RETURN n.times").rows == [[2]]
+
+    def test_merge_relationship(self, ex):
+        _seed_social(ex)
+        ex.execute(
+            """MATCH (a {name:'Alice'}), (c {name:'Carol'})
+               MERGE (a)-[:KNOWS]->(c)"""
+        )
+        ex.execute(
+            """MATCH (a {name:'Alice'}), (c {name:'Carol'})
+               MERGE (a)-[:KNOWS]->(c)"""
+        )
+        r = ex.execute("MATCH (:Person)-[k:KNOWS]->(:Person) RETURN count(k)")
+        assert r.rows == [[3]]
+
+    def test_create_from_unwind_params(self, ex):
+        ex.execute(
+            "UNWIND $rows AS row CREATE (n:Item {name: row.name, qty: row.qty})",
+            {"rows": [{"name": "a", "qty": 1}, {"name": "b", "qty": 2}]},
+        )
+        r = ex.execute("MATCH (i:Item) RETURN sum(i.qty)")
+        assert r.rows == [[3]]
+
+
+class TestExpressions:
+    def test_arithmetic_and_precedence(self, ex):
+        assert ex.execute("RETURN 2 + 3 * 4").rows == [[14]]
+        assert ex.execute("RETURN (2 + 3) * 4").rows == [[20]]
+        assert ex.execute("RETURN 2 ^ 3").rows == [[8.0]]
+        assert ex.execute("RETURN 7 / 2").rows == [[3]]
+        assert ex.execute("RETURN 7.0 / 2").rows == [[3.5]]
+        assert ex.execute("RETURN 7 % 3").rows == [[1]]
+
+    def test_string_ops(self, ex):
+        assert ex.execute("RETURN 'abc' + 'def'").rows == [["abcdef"]]
+        assert ex.execute("RETURN 'hello' STARTS WITH 'he'").rows == [[True]]
+        assert ex.execute("RETURN 'hello' ENDS WITH 'lo'").rows == [[True]]
+        assert ex.execute("RETURN 'hello' CONTAINS 'ell'").rows == [[True]]
+        assert ex.execute("RETURN 'abc123' =~ '[a-z]+\\\\d+'").rows == [[True]]
+
+    def test_null_semantics(self, ex):
+        assert ex.execute("RETURN null = null").rows == [[None]]
+        assert ex.execute("RETURN null IS NULL").rows == [[True]]
+        assert ex.execute("RETURN 1 + null").rows == [[None]]
+        assert ex.execute("RETURN null AND false").rows == [[False]]
+        assert ex.execute("RETURN null OR true").rows == [[True]]
+        assert ex.execute("RETURN NOT null").rows == [[None]]
+
+    def test_in_list(self, ex):
+        assert ex.execute("RETURN 2 IN [1, 2, 3]").rows == [[True]]
+        assert ex.execute("RETURN 5 IN [1, 2, 3]").rows == [[False]]
+
+    def test_case(self, ex):
+        r = ex.execute(
+            "UNWIND [1,2,3] AS x RETURN CASE WHEN x > 2 THEN 'big' ELSE 'small' END AS s"
+        )
+        assert [row[0] for row in r.rows] == ["small", "small", "big"]
+        r = ex.execute("RETURN CASE 2 WHEN 1 THEN 'one' WHEN 2 THEN 'two' END")
+        assert r.rows == [["two"]]
+
+    def test_list_ops(self, ex):
+        assert ex.execute("RETURN [1,2,3][1]").rows == [[2]]
+        assert ex.execute("RETURN [1,2,3,4][1..3]").rows == [[[2, 3]]]
+        assert ex.execute("RETURN size([1,2,3])").rows == [[3]]
+        assert ex.execute("RETURN head([1,2]), last([1,2]), tail([1,2])").rows == [[1, 2, [2]]]
+        assert ex.execute("RETURN range(1, 5, 2)").rows == [[[1, 3, 5]]]
+
+    def test_list_comprehension(self, ex):
+        r = ex.execute("RETURN [x IN range(1,5) WHERE x % 2 = 0 | x * 10] AS l")
+        assert r.rows == [[[20, 40]]]
+
+    def test_functions(self, ex):
+        assert ex.execute("RETURN toUpper('abc'), toLower('ABC')").rows == [["ABC", "abc"]]
+        assert ex.execute("RETURN coalesce(null, 'x')").rows == [["x"]]
+        assert ex.execute("RETURN abs(-5), sqrt(16.0)").rows == [[5, 4.0]]
+        assert ex.execute("RETURN split('a,b', ',')").rows == [[["a", "b"]]]
+        assert ex.execute("RETURN toInteger('42'), toFloat('1.5')").rows == [[42, 1.5]]
+
+    def test_entity_functions(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (p:Person {name:'Alice'})-[k:KNOWS]->() RETURN labels(p), type(k)"
+        )
+        assert r.rows == [[["Person"], "KNOWS"]]
+        r = ex.execute("MATCH (p:Person {name:'Alice'}) RETURN keys(p)")
+        assert r.rows == [[["age", "name"]]]
+
+    def test_label_predicate(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH (n) WHERE n:Company RETURN n.name")
+        assert r.rows == [["Initech"]]
+
+    def test_exists_pattern(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (p:Person) WHERE EXISTS((p)-[:KNOWS]->())
+               RETURN p.name ORDER BY p.name"""
+        )
+        assert [row[0] for row in r.rows] == ["Alice", "Bob"]
+
+    def test_parameters(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "MATCH (p:Person) WHERE p.age > $min RETURN count(p)", {"min": 28}
+        )
+        assert r.rows == [[2]]
+
+    def test_missing_param_errors(self, ex):
+        with pytest.raises(CypherRuntimeError):
+            ex.execute("RETURN $missing")
+
+
+class TestReturnModifiers:
+    def test_distinct(self, ex):
+        r = ex.execute("UNWIND [1,1,2] AS x RETURN DISTINCT x")
+        assert [row[0] for row in r.rows] == [1, 2]
+
+    def test_order_skip_limit(self, ex):
+        r = ex.execute("UNWIND [3,1,2,5,4] AS x RETURN x ORDER BY x DESC SKIP 1 LIMIT 2")
+        assert [row[0] for row in r.rows] == [4, 3]
+
+    def test_order_by_nulls_last(self, ex):
+        ex.execute("CREATE (:T {v: 2}), (:T), (:T {v: 1})")
+        r = ex.execute("MATCH (t:T) RETURN t.v ORDER BY t.v")
+        assert [row[0] for row in r.rows] == [1, 2, None]
+
+    def test_union(self, ex):
+        r = ex.execute("RETURN 1 AS x UNION RETURN 1 AS x UNION RETURN 2 AS x")
+        assert sorted(row[0] for row in r.rows) == [1, 2]
+        r = ex.execute("RETURN 1 AS x UNION ALL RETURN 1 AS x")
+        assert [row[0] for row in r.rows] == [1, 1]
+
+    def test_return_star(self, ex):
+        r = ex.execute("UNWIND [1,2] AS x RETURN *")
+        assert r.columns == ["x"]
+        assert [row[0] for row in r.rows] == [1, 2]
+
+
+class TestCallProcedures:
+    def test_db_labels(self, ex):
+        _seed_social(ex)
+        r = ex.execute("CALL db.labels()")
+        assert r.columns == ["label"]
+        assert [row[0] for row in r.rows] == ["Company", "Person"]
+
+    def test_db_relationship_types(self, ex):
+        _seed_social(ex)
+        r = ex.execute("CALL db.relationshipTypes() YIELD relationshipType RETURN relationshipType")
+        assert [row[0] for row in r.rows] == ["KNOWS", "WORKS_AT"]
+
+    def test_apoc_meta_stats(self, ex):
+        _seed_social(ex)
+        r = ex.execute("CALL apoc.meta.stats() YIELD nodeCount RETURN nodeCount")
+        assert r.rows == [[4]]
+
+    def test_apoc_functions(self, ex):
+        assert ex.execute("RETURN apoc.coll.sum([1,2,3])").rows == [[6.0]]
+        assert ex.execute("RETURN apoc.text.join(['a','b'], '-')").rows == [["a-b"]]
+        assert ex.execute("RETURN apoc.map.merge({a:1}, {b:2})").rows == [[{"a": 1, "b": 2}]]
+
+    def test_pagerank(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "CALL apoc.algo.pageRank() YIELD node, score RETURN node.name, score LIMIT 2"
+        )
+        assert len(r.rows) == 2
+        assert r.rows[0][1] >= r.rows[1][1]
+
+
+class TestShortestPath:
+    def test_shortest_path(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (a:Person {name:'Alice'}), (c:Person {name:'Carol'})
+               RETURN length(shortestPath((a)-[:KNOWS*]->(c))) AS hops"""
+        )
+        assert r.rows == [[2]]
+
+    def test_no_path_is_null(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            """MATCH (c:Person {name:'Carol'}), (a:Person {name:'Alice'})
+               RETURN shortestPath((c)-[:KNOWS*]->(a)) AS p"""
+        )
+        assert r.rows == [[None]]
+
+
+class TestFastPaths:
+    def test_count_all_nodes(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH (n) RETURN count(n)")
+        assert r.rows == [[4]]
+
+    def test_count_star(self, ex):
+        _seed_social(ex)
+        assert ex.execute("MATCH (n) RETURN count(*)").rows == [[4]]
+
+    def test_count_label(self, ex):
+        _seed_social(ex)
+        assert ex.execute("MATCH (p:Person) RETURN count(p)").rows == [[3]]
+
+    def test_count_edges_typed(self, ex):
+        _seed_social(ex)
+        assert ex.execute("MATCH ()-[r:KNOWS]->() RETURN count(r)").rows == [[2]]
+
+    def test_fastpath_matches_general(self, ex):
+        """Parity: fast path and general executor agree
+        (reference: parser_comparison_test.go pattern)."""
+        _seed_social(ex)
+        fast = ex.execute("MATCH (p:Person) RETURN count(p) AS n").rows
+        general = ex.execute(
+            "MATCH (p:Person) WHERE true RETURN count(p) AS n"
+        ).rows
+        assert fast == general
+
+
+class TestErrorsAndChaos:
+    def test_syntax_error(self, ex):
+        with pytest.raises(CypherSyntaxError):
+            ex.execute("MATCH (n RETURN n")
+
+    def test_unknown_function(self, ex):
+        with pytest.raises(CypherRuntimeError):
+            ex.execute("RETURN no_such_fn(1)")
+
+    def test_unicode_and_injection(self, ex):
+        """Reference: chaos_injection_test.go — unicode, quotes, emptiness."""
+        ex.execute("CREATE (n:Person {name: 'Röbert \\'quoted\\' 🚀'})")
+        r = ex.execute("MATCH (n:Person) RETURN n.name")
+        assert r.rows == [["Röbert 'quoted' 🚀"]]
+
+    def test_empty_string_prop(self, ex):
+        ex.execute("CREATE (n:T {s: ''})")
+        assert ex.execute("MATCH (n:T) RETURN n.s").rows == [[""]]
+
+    def test_division_by_zero(self, ex):
+        with pytest.raises(CypherRuntimeError):
+            ex.execute("RETURN 1 / 0")
+
+    def test_deep_nesting(self, ex):
+        assert ex.execute("RETURN ((((1 + 2))))").rows == [[3]]
+
+
+class TestCypherReviewRegressions:
+    def test_parenthesized_arithmetic_not_pattern(self, ex):
+        assert ex.execute("RETURN (1+2)-(3+4) AS x").rows == [[-4]]
+        assert ex.execute("RETURN (1)-(2) AS x").rows == [[-1]]
+
+    def test_rel_uniqueness_across_comma_paths(self, ex):
+        ex.execute("CREATE (a:N {k:'a'})-[:R]->(b:N {k:'b'})")
+        r = ex.execute("MATCH (x)-[r1]->(y), (z)-[r2]->(w) RETURN r1, r2")
+        assert r.rows == []  # single edge cannot bind both rels
+
+    def test_agg_nested_in_index_and_map(self, ex):
+        _seed_social(ex)
+        r = ex.execute("MATCH (p:Person) RETURN collect(p.name)[0] AS first")
+        assert r.rows[0][0] in ("Alice", "Bob", "Carol")
+        r = ex.execute("MATCH (p:Person) RETURN {total: count(*)} AS m")
+        assert r.rows == [[{"total": 3}]]
+
+    def test_float_division_by_zero_is_infinity(self, ex):
+        assert ex.execute("RETURN 1.0/0.0 AS x").rows == [[float("inf")]]
+        assert ex.execute("RETURN -1.0/0.0 AS x").rows == [[float("-inf")]]
+
+    def test_all_shortest_paths_parallel_edges(self, ex):
+        ex.execute("""CREATE (a:S {k:'a'}), (m:S {k:'m'}), (d:S {k:'d'}),
+                      (a)-[:R]->(m), (a)-[:R]->(m), (m)-[:R]->(d)""")
+        r = ex.execute(
+            """MATCH (a:S {k:'a'}), (d:S {k:'d'})
+               WITH allShortestPaths((a)-[:R*]->(d)) AS ps
+               RETURN size(ps)"""
+        )
+        assert r.rows == [[2]]
+
+    def test_duplicate_return_columns_stay_positional(self, ex):
+        ex.execute("CREATE (:D {a: 1, b: 2})")
+        r = ex.execute("MATCH (n:D) RETURN n.a AS x, n.b AS x")
+        assert r.rows == [[1, 2]]

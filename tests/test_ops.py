@@ -204,3 +204,28 @@ class TestOpsReviewRegressions:
         s, i = cosine_topk_chunked(q, m, valid, 5, chunk=512)
         s_ref, i_ref = cosine_topk(q, m, valid, 5)
         np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+class TestGraphOps:
+    def test_pagerank_star_graph(self):
+        from nornicdb_tpu.ops.graph import pagerank_arrays
+        # star: everyone points at node 0
+        src = np.asarray([1, 2, 3, 4], np.int32)
+        dst = np.asarray([0, 0, 0, 0], np.int32)
+        scores = pagerank_arrays(src, dst, 5, iters=30)
+        assert scores[0] == max(scores)
+        assert scores.sum() == pytest.approx(1.0, abs=1e-3)
+
+    def test_pagerank_empty_graph(self):
+        from nornicdb_tpu.ops.graph import pagerank_arrays
+        scores = pagerank_arrays(np.zeros(0, np.int32), np.zeros(0, np.int32), 3)
+        np.testing.assert_allclose(scores, [1 / 3] * 3)
+
+    def test_degree_counts(self):
+        from nornicdb_tpu.ops.graph import degree_counts
+        import jax.numpy as jnp
+        out_d, in_d = degree_counts(
+            jnp.asarray([0, 0, 1], jnp.int32), jnp.asarray([1, 2, 2], jnp.int32), 3
+        )
+        assert list(np.asarray(out_d)) == [2, 1, 0]
+        assert list(np.asarray(in_d)) == [0, 1, 2]
