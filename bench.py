@@ -1,0 +1,78 @@
+"""Benchmark: brute-force cosine kNN throughput over 10k x 1024 embeddings.
+
+Matches BASELINE.json config[0] ("Cosine kNN brute-force over 10k bge-m3
+embeddings") and compares against the reference's highest-throughput
+search surface, REST search at 10,296 ops/s (testing/e2e/README.md —
+BASELINE.md row "E2E endpoint bench: REST search"; that number is itself
+a concurrent-load throughput figure). Measured here: sustained
+single-stream throughput of batch=1 queries with async pipelined
+dispatch — back-to-back requests as a loaded server sees them. Each
+query is a distinct device-resident [1, D] tensor; no batching.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BASELINE_REST_SEARCH_OPS = 10_296.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from nornicdb_tpu.ops import cosine_topk, l2_normalize, pad_dim
+
+    n, d, k = 10_000, 1024, 10
+    rng = np.random.default_rng(0)
+    cap = pad_dim(n)
+    m = np.zeros((cap, d), np.float32)
+    m[:n] = rng.standard_normal((n, d), dtype=np.float32)
+    valid = np.zeros(cap, bool)
+    valid[:n] = True
+
+    mj = l2_normalize(jnp.asarray(m))
+    vj = jnp.asarray(valid)
+    queries = l2_normalize(
+        jnp.asarray(rng.standard_normal((64, d), dtype=np.float32))
+    )
+
+    # pre-stage 64 distinct single-query device arrays (a server keeps the
+    # incoming query on device; re-slicing per request would measure host
+    # transfer, not search)
+    qs = [queries[j : j + 1] for j in range(64)]
+    for q in qs:
+        q.block_until_ready()
+
+    # warmup / compile
+    s, i = cosine_topk(qs[0], mj, vj, k)
+    s.block_until_ready()
+
+    iters = 2000
+    t0 = time.perf_counter()
+    for it in range(iters):
+        s, i = cosine_topk(qs[it % 64], mj, vj, k)
+    s.block_until_ready()
+    dt = time.perf_counter() - t0
+    qps = iters / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "knn_throughput_b1_10k_x_1024",
+                "value": round(qps, 1),
+                "unit": "queries/s",
+                "vs_baseline": round(qps / BASELINE_REST_SEARCH_OPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""Model layer: the TPU-native embedding/inference stack.
+
+Replaces the reference's llama.cpp path (pkg/localllm, vendored GGUF
+inference with CUDA/Metal offload — llama.go:35-56) and its bge-m3
+embedding pipeline (pkg/embed/local_gguf.go) with a flax encoder served
+via jit/pjit over a device mesh.
+"""
+
+from nornicdb_tpu.models.encoder import Encoder, EncoderConfig  # noqa: F401
+from nornicdb_tpu.models.train import (  # noqa: F401
+    TrainState,
+    contrastive_train_step,
+    create_train_state,
+    make_sharded_train_step,
+)
