@@ -37,8 +37,9 @@ class DB:
         embedder: Optional[Any] = None,
         auto_embed: bool = False,
     ):
-        # engine chain: Durable/Memory -> [Async] -> Listenable -> Namespaced
-        # (reference chain order: db.go:742-947)
+        # engine chain: Durable/Memory -> [Async] -> Namespaced -> Listenable
+        # (reference chain order: db.go:742-947; the listener layer sits on
+        # top so mutation callbacks carry LOGICAL node ids)
         if data_dir:
             base: Engine = DurableEngine(data_dir, sync_every_write=sync_every_write)
         else:
@@ -47,8 +48,8 @@ class DB:
         chain: Engine = base
         if async_writes:
             chain = AsyncEngine(chain)
-        self._listenable = ListenableEngine(chain)
-        self.storage = NamespacedEngine(self._listenable, database)
+        self._listenable = ListenableEngine(NamespacedEngine(chain, database))
+        self.storage = self._listenable
         self.database = database
         self._lock = threading.Lock()
         self._closed = False
@@ -59,6 +60,7 @@ class DB:
         self._embedder = embedder
         self._embed_queue = None
         self._decay = None
+        self._temporal = None
         self._inference = None
         if auto_embed and embedder is not None:
             self._start_embed_queue()
@@ -92,6 +94,14 @@ class DB:
 
             self._decay = DecayManager(self.storage)
         return self._decay
+
+    @property
+    def temporal(self):
+        if self._temporal is None:
+            from nornicdb_tpu.temporal import TemporalTracker
+
+            self._temporal = TemporalTracker()
+        return self._temporal
 
     @property
     def inference(self):
@@ -148,8 +158,8 @@ class DB:
         """Fetch a node and record the access for decay/temporal tracking
         (reference: db.go:2026 Remember)."""
         node = self.storage.get_node(node_id)
-        if self._decay is not None:
-            self._decay.record_access(node_id)
+        self.decay.record_access(node_id)
+        self.temporal.record_access(node_id)
         return node
 
     def link(

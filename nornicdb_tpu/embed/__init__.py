@@ -1,0 +1,16 @@
+"""Embedding pipeline: tokenizer, embedders, background embed queue.
+
+Reference: pkg/embed (Embedder interface embed.go:71, providers Ollama/
+OpenAI/local GGUF) + the embed queue worker (pkg/nornicdb/embed_queue.go).
+The local path swaps llama.cpp-CUDA for the JAX encoder so ingest ->
+embed -> index is TPU end-to-end (BASELINE.json north star).
+"""
+
+from nornicdb_tpu.embed.embedder import (  # noqa: F401
+    CachedEmbedder,
+    Embedder,
+    HashEmbedder,
+    JaxEncoderEmbedder,
+)
+from nornicdb_tpu.embed.tokenizer import HashTokenizer, chunk_tokens  # noqa: F401
+from nornicdb_tpu.embed.queue import EmbedQueue  # noqa: F401

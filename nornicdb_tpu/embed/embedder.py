@@ -1,0 +1,193 @@
+"""Embedder implementations.
+
+Reference: pkg/embed — ``Embedder`` interface (embed.go:71), the local
+GGUF/llama.cpp provider (local_gguf.go:57) with crash recovery, and the
+cached decorator (cached_embedder.go). The TPU-native local provider is
+``JaxEncoderEmbedder``: the flax encoder jitted once per (batch, width)
+bucket, batched, padded to stable shapes so XLA never recompiles.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Protocol, Sequence
+
+import numpy as np
+
+from nornicdb_tpu.embed.tokenizer import CHUNK_OVERLAP, CHUNK_SIZE, HashTokenizer, chunk_tokens
+
+
+class Embedder(Protocol):
+    dims: int
+
+    def embed(self, text: str) -> List[float]: ...
+
+    def embed_batch(self, texts: Sequence[str]) -> List[List[float]]: ...
+
+
+class HashEmbedder:
+    """Deterministic, dependency-free embedder (test double + offline
+    default). Token-hash bag-of-features, L2-normalized — similar texts
+    share tokens, so cosine behaves sensibly."""
+
+    def __init__(self, dims: int = 256):
+        self.dims = dims
+        self._tok = HashTokenizer(vocab_size=1 << 22)
+
+    def embed(self, text: str) -> List[float]:
+        v = np.zeros(self.dims, dtype=np.float32)
+        ids = self._tok.encode(text, max_len=4096)[1:]  # drop CLS
+        for tid in ids:
+            v[tid % self.dims] += 1.0
+            v[(tid >> 8) % self.dims] += 0.5
+        n = np.linalg.norm(v)
+        if n > 1e-12:
+            v /= n
+        return v.tolist()
+
+    def embed_batch(self, texts: Sequence[str]) -> List[List[float]]:
+        return [self.embed(t) for t in texts]
+
+
+class JaxEncoderEmbedder:
+    """Local TPU embedder over the flax encoder.
+
+    - pads token widths to power-of-two buckets (jit cache stays small);
+    - batches up to ``max_batch`` texts per device call;
+    - long texts are chunked 512/50 and mean-pooled (whole-doc vector);
+      per-chunk vectors available via embed_chunks (reference
+      ChunkEmbeddings, db.go:224).
+    """
+
+    def __init__(
+        self,
+        model=None,
+        params=None,
+        cfg=None,
+        max_batch: int = 64,
+        seed: int = 0,
+    ):
+        import jax
+
+        from nornicdb_tpu.models.encoder import Encoder, EncoderConfig
+
+        if cfg is None:
+            cfg = EncoderConfig()
+        if model is None:
+            model = Encoder(cfg)
+        if params is None:
+            params = model.init(
+                jax.random.PRNGKey(seed),
+                np.ones((1, 8), np.int32),
+            )["params"]
+        self.cfg = cfg
+        self.model = model
+        self.params = params
+        self.dims = cfg.hidden_size
+        self.max_batch = max_batch
+        self.tokenizer = HashTokenizer(cfg.vocab_size)
+        self._jit = jax.jit(
+            lambda p, ids: model.apply({"params": p}, ids)
+        )
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _bucket_width(w: int) -> int:
+        b = 16
+        while b < w:
+            b *= 2
+        return b
+
+    def _run(self, id_lists: List[List[int]]) -> np.ndarray:
+        import jax.numpy as jnp
+
+        width = self._bucket_width(max(len(x) for x in id_lists))
+        width = min(width, self.cfg.max_len)
+        arr = np.zeros((len(id_lists), width), np.int32)
+        for i, ids in enumerate(id_lists):
+            ids = ids[:width]
+            arr[i, : len(ids)] = ids
+        with self._lock:
+            out = self._jit(self.params, jnp.asarray(arr))
+        return np.asarray(out, dtype=np.float32)
+
+    def embed_batch(self, texts: Sequence[str]) -> List[List[float]]:
+        out: List[List[float]] = []
+        for start in range(0, len(texts), self.max_batch):
+            batch = texts[start : start + self.max_batch]
+            id_lists = [
+                self.tokenizer.encode(t, max_len=self.cfg.max_len) for t in batch
+            ]
+            vecs = self._run(id_lists)
+            out.extend(v.tolist() for v in vecs)
+        return out
+
+    def embed(self, text: str) -> List[float]:
+        return self.embed_batch([text])[0]
+
+    def embed_chunks(self, text: str) -> List[List[float]]:
+        """Per-chunk embeddings for long documents (512/50 windows)."""
+        ids = self.tokenizer.encode(text, max_len=1_000_000)
+        chunks = chunk_tokens(
+            ids, min(CHUNK_SIZE, self.cfg.max_len), CHUNK_OVERLAP
+        )
+        vecs: List[List[float]] = []
+        for start in range(0, len(chunks), self.max_batch):
+            vecs.extend(
+                v.tolist() for v in self._run(chunks[start : start + self.max_batch])
+            )
+        return vecs
+
+
+class CachedEmbedder:
+    """LRU cache decorator (reference: cached_embedder.go)."""
+
+    def __init__(self, inner: Embedder, capacity: int = 10_000):
+        self.inner = inner
+        self.capacity = capacity
+        self.dims = inner.dims
+        self._cache: "OrderedDict[str, List[float]]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        # expose the inner chunk path (uncached: chunk texts rarely repeat)
+        if hasattr(inner, "embed_chunks"):
+            self.embed_chunks = inner.embed_chunks
+
+    def embed(self, text: str) -> List[float]:
+        with self._lock:
+            if text in self._cache:
+                self._cache.move_to_end(text)
+                self.hits += 1
+                return list(self._cache[text])
+        v = self.inner.embed(text)
+        with self._lock:
+            self.misses += 1
+            self._cache[text] = list(v)
+            while len(self._cache) > self.capacity:
+                self._cache.popitem(last=False)
+        return v
+
+    def embed_batch(self, texts: Sequence[str]) -> List[List[float]]:
+        with self._lock:
+            # dedupe: repeated texts must cost one device call, not N
+            missing = list(dict.fromkeys(t for t in texts if t not in self._cache))
+        if missing:
+            fresh = self.inner.embed_batch(missing)
+            with self._lock:
+                self.misses += len(missing)
+                for t, v in zip(missing, fresh):
+                    self._cache[t] = list(v)
+                while len(self._cache) > self.capacity:
+                    self._cache.popitem(last=False)
+        out = []
+        with self._lock:
+            for t in texts:
+                v = self._cache.get(t)
+                if v is None:  # evicted between batches; recompute
+                    v = self.inner.embed(t)
+                else:
+                    self._cache.move_to_end(t)
+                out.append(list(v))
+        return out

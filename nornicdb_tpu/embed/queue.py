@@ -1,0 +1,239 @@
+"""Background embed queue: embeds un-embedded nodes and triggers indexing.
+
+Reference: pkg/nornicdb/embed_queue.go — ``EmbedWorker`` (:21), batch
+processing with retry (:498), debounced k-means/clustering trigger (:330),
+periodic rescan (15 min), text assembly (:886 buildEmbeddingText).
+Implements the MutationListener hook so the ListenableEngine feeds it
+(reference wiring: db.go:1076-1080).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+from nornicdb_tpu.storage.types import Engine, MutationListener, Node
+
+logger = logging.getLogger(__name__)
+
+CHUNK_THRESHOLD_CHARS = 2000  # texts longer than this get chunk embeddings
+
+
+def build_embedding_text(node: Node) -> str:
+    """Reference: buildEmbeddingText (embed_queue.go:886)."""
+    from nornicdb_tpu.search.service import extract_text
+
+    return extract_text(node)
+
+
+class EmbedQueue(MutationListener):
+    def __init__(
+        self,
+        storage: Engine,
+        embedder,
+        on_embedded: Optional[Callable[[Node], None]] = None,
+        batch_size: int = 16,
+        max_retries: int = 3,
+        rescan_interval_s: float = 900.0,
+        cluster_debounce_s: float = 30.0,
+        on_cluster: Optional[Callable[[], None]] = None,
+    ):
+        self.storage = storage
+        self.embedder = embedder
+        self.on_embedded = on_embedded
+        self.batch_size = batch_size
+        self.max_retries = max_retries
+        self.rescan_interval_s = rescan_interval_s
+        self.cluster_debounce_s = cluster_debounce_s
+        self.on_cluster = on_cluster
+        self._q: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._pending = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._rescanner: Optional[threading.Thread] = None
+        self._cluster_timer: Optional[threading.Timer] = None
+        self.embedded_count = 0
+        self.failed_count = 0
+
+    # -- MutationListener ------------------------------------------------
+
+    def on_node_upsert(self, node: Node) -> None:
+        if node.embedding is None and build_embedding_text(node):
+            self.enqueue(node.id)
+
+    def on_node_delete(self, node_id: str) -> None:
+        with self._lock:
+            self._pending.discard(node_id)
+
+    # -- queue -----------------------------------------------------------
+
+    def enqueue(self, node_id: str) -> None:
+        with self._lock:
+            if node_id in self._pending:
+                return
+            self._pending.add(node_id)
+        self._q.put(node_id)
+
+    def start(self) -> None:
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._run, name="embed-queue", daemon=True
+            )
+            self._worker.start()
+        if self._rescanner is None and self.rescan_interval_s > 0:
+            self._rescanner = threading.Thread(
+                target=self._rescan_loop, name="embed-rescan", daemon=True
+            )
+            self._rescanner.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._q.put(None)
+        if self._worker is not None:
+            self._worker.join(timeout=10)
+        if self._cluster_timer is not None:
+            self._cluster_timer.cancel()
+
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Block until all currently-pending nodes are embedded."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                if not self._pending:
+                    return
+            time.sleep(0.02)
+
+    # -- worker ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch: List[str] = []
+            try:
+                item = self._q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if item is None:
+                break
+            batch.append(item)
+            while len(batch) < self.batch_size:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._stop.set()
+                    break
+                batch.append(nxt)
+            try:
+                self._process_batch(batch)
+            except Exception:
+                logger.exception("embed batch failed")
+
+    def _process_batch(self, node_ids: List[str]) -> None:
+        nodes = []
+        for nid in node_ids:
+            try:
+                node = self.storage.get_node(nid)
+            except KeyError:
+                with self._lock:
+                    self._pending.discard(nid)
+                continue
+            if node.embedding is not None:
+                with self._lock:
+                    self._pending.discard(nid)
+                continue
+            nodes.append(node)
+        if not nodes:
+            return
+        texts = [build_embedding_text(n) for n in nodes]
+        vectors = self._embed_with_retry(texts)
+        if vectors is None:
+            self.failed_count += len(nodes)
+            for n in nodes:
+                with self._lock:
+                    self._pending.discard(n.id)
+            return
+        for node, text, vec in zip(nodes, texts, vectors):
+            # per-node isolation: one failing write must not wedge the rest
+            # of the batch in _pending (they'd never re-enqueue)
+            try:
+                try:
+                    fresh = self.storage.get_node(node.id)
+                except KeyError:
+                    continue
+                fresh.embedding = list(vec)
+                if len(text) > CHUNK_THRESHOLD_CHARS and hasattr(
+                    self.embedder, "embed_chunks"
+                ):
+                    try:
+                        fresh.chunk_embeddings = self.embedder.embed_chunks(text)
+                    except Exception:
+                        logger.exception("chunk embed failed for %s", node.id)
+                try:
+                    self.storage.update_node(fresh)
+                except KeyError:
+                    continue  # deleted concurrently
+                self.embedded_count += 1
+                if self.on_embedded is not None:
+                    try:
+                        self.on_embedded(fresh)
+                    except Exception:
+                        logger.exception("on_embedded callback failed")
+            except Exception:
+                logger.exception("embed write failed for %s", node.id)
+                self.failed_count += 1
+            finally:
+                with self._lock:
+                    self._pending.discard(node.id)
+        self._schedule_clustering()
+
+    def _embed_with_retry(self, texts: List[str]):
+        """Reference: embedBatchWithRetry + llama crash recovery
+        (local_gguf.go:202-254) — retries with backoff, fail-open."""
+        delay = 0.1
+        for attempt in range(self.max_retries):
+            try:
+                return self.embedder.embed_batch(texts)
+            except Exception:
+                logger.exception("embed attempt %d failed", attempt + 1)
+                if attempt + 1 < self.max_retries:  # no sleep after the last try
+                    time.sleep(delay)
+                    delay *= 4
+        return None
+
+    # -- clustering debounce + rescan -------------------------------------
+
+    def _schedule_clustering(self) -> None:
+        """Debounced clustering trigger (reference:
+        scheduleClusteringDebounced, embed_queue.go:330)."""
+        if self.on_cluster is None:
+            return
+        with self._lock:
+            if self._cluster_timer is not None:
+                self._cluster_timer.cancel()
+            self._cluster_timer = threading.Timer(
+                self.cluster_debounce_s, self._fire_cluster
+            )
+            self._cluster_timer.daemon = True
+            self._cluster_timer.start()
+
+    def _fire_cluster(self) -> None:
+        try:
+            self.on_cluster()
+        except Exception:
+            logger.exception("clustering trigger failed")
+
+    def _rescan_loop(self) -> None:
+        """Periodic sweep for nodes that missed the event path
+        (reference: 15-min rescan, embed_queue.go)."""
+        while not self._stop.wait(self.rescan_interval_s):
+            try:
+                for node in self.storage.all_nodes():
+                    if node.embedding is None and build_embedding_text(node):
+                        self.enqueue(node.id)
+            except Exception:
+                logger.exception("rescan failed")

@@ -1,0 +1,80 @@
+"""Tokenization + chunking for the embedding pipeline.
+
+The reference chunks long documents at 512 tokens with 50-token overlap
+(pkg/nornicdb/db.go:1046-1047; embed_queue.go:774 embedChunksInBatches).
+Without network access to real bge-m3 vocab files, the default tokenizer
+hashes whitespace/punctuation-split subwords into a fixed id space — fully
+deterministic, vocabulary-free, and adequate for the encoder until real
+weights/vocab are loaded (the Embedder interface hides the choice).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import List, Sequence, Tuple
+
+_WORD_RE = re.compile(r"[a-z0-9]+|[^\sa-z0-9]")
+
+CHUNK_SIZE = 512
+CHUNK_OVERLAP = 50
+
+
+class HashTokenizer:
+    """Deterministic hash tokenizer: token -> stable id in [2, vocab)."""
+
+    PAD_ID = 0
+    CLS_ID = 1
+
+    def __init__(self, vocab_size: int = 30522):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str, max_len: int = CHUNK_SIZE) -> List[int]:
+        ids = [self.CLS_ID]
+        for tok in _WORD_RE.findall(text.lower()):
+            h = int.from_bytes(
+                hashlib.blake2s(tok.encode("utf-8"), digest_size=4).digest(),
+                "little",
+            )
+            ids.append(2 + h % (self.vocab_size - 2))
+            if len(ids) >= max_len:
+                break
+        return ids
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int = CHUNK_SIZE
+    ) -> Tuple[List[List[int]], int]:
+        """Returns (padded id lists, width)."""
+        encoded = [self.encode(t, max_len) for t in texts]
+        width = max((len(e) for e in encoded), default=1)
+        return [e + [self.PAD_ID] * (width - len(e)) for e in encoded], width
+
+
+def chunk_tokens(
+    ids: List[int],
+    chunk_size: int = CHUNK_SIZE,
+    overlap: int = CHUNK_OVERLAP,
+) -> List[List[int]]:
+    """Sliding-window chunking (512/50 default, reference db.go:1046)."""
+    if len(ids) <= chunk_size:
+        return [ids]
+    step = max(chunk_size - overlap, 1)
+    chunks = []
+    for start in range(0, len(ids), step):
+        chunk = ids[start : start + chunk_size]
+        if not chunk:
+            break
+        chunks.append(chunk)
+        if start + chunk_size >= len(ids):
+            break
+    return chunks
+
+
+def chunk_text(
+    text: str,
+    tokenizer: HashTokenizer,
+    chunk_size: int = CHUNK_SIZE,
+    overlap: int = CHUNK_OVERLAP,
+) -> List[List[int]]:
+    ids = tokenizer.encode(text, max_len=1_000_000)
+    return chunk_tokens(ids, chunk_size, overlap)

@@ -1,0 +1,170 @@
+"""Auto-relationship inference engine.
+
+Reference: pkg/inference — Engine (inference.go:219), OnStoreBestOfChunks
+(:544, similarity via injected vector search), OnAccess co-access windows
+(:778), SuggestTransitive (:835), cooldown table (cooldown.go), evidence
+buffer (evidence.go). Suggested edges are created best-effort with typed
+provenance properties, exactly like the reference's Store() wiring
+(db.go:1997-2016).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from nornicdb_tpu.storage.types import Edge, Engine, Node
+
+SIMILAR_TO = "SIMILAR_TO"
+CO_ACCESSED_WITH = "CO_ACCESSED_WITH"
+RELATES_TO = "RELATES_TO"
+
+
+@dataclass
+class Suggestion:
+    from_id: str
+    to_id: str
+    rel_type: str
+    confidence: float
+    reason: str
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        storage: Engine,
+        search_service=None,
+        similarity_threshold: float = 0.75,
+        max_links_per_store: int = 3,
+        cooldown_s: float = 300.0,
+        min_confidence: float = 0.5,
+    ):
+        self.storage = storage
+        self.search = search_service
+        self.similarity_threshold = similarity_threshold
+        self.max_links_per_store = max_links_per_store
+        self.cooldown_s = cooldown_s
+        self.min_confidence = min_confidence
+        self._cooldown: Dict[Tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+        self.created_count = 0
+
+    # -- cooldown (reference: cooldown.go) --------------------------------
+
+    def _on_cooldown(self, a: str, b: str) -> bool:
+        key = (min(a, b), max(a, b))
+        with self._lock:
+            t = self._cooldown.get(key)
+            if t is not None and time.time() - t < self.cooldown_s:
+                return True
+            self._cooldown[key] = time.time()
+            return False
+
+    def _already_linked(self, a: str, b: str) -> bool:
+        for e in self.storage.get_node_edges(a):
+            if b in (e.start_node, e.end_node):
+                return True
+        return False
+
+    # -- on store: similarity links (reference: OnStoreBestOfChunks :544) --
+
+    def on_store(self, node: Node) -> List[Suggestion]:
+        """Suggest (and create) SIMILAR_TO edges for a newly stored node.
+        Uses best-of-chunks similarity when chunk embeddings exist."""
+        if self.search is None:
+            return []
+        query_vectors: List[List[float]] = []
+        if node.chunk_embeddings:
+            query_vectors = list(node.chunk_embeddings)
+        elif node.embedding is not None:
+            query_vectors = [node.embedding]
+        if not query_vectors:
+            return []
+        # best-of-chunks: keep each candidate's best similarity over chunks
+        best: Dict[str, float] = {}
+        for qv in query_vectors:
+            for nid, score in self.search.vector_search_candidates(
+                qv, k=self.max_links_per_store * 3
+            ):
+                if nid == node.id:
+                    continue
+                if score > best.get(nid, -1.0):
+                    best[nid] = score
+        suggestions: List[Suggestion] = []
+        for nid, score in sorted(best.items(), key=lambda kv: -kv[1]):
+            if len(suggestions) >= self.max_links_per_store:
+                break
+            if score < self.similarity_threshold:
+                continue
+            if self._on_cooldown(node.id, nid) or self._already_linked(node.id, nid):
+                continue
+            sug = Suggestion(node.id, nid, SIMILAR_TO, float(score), "similarity")
+            if self._create(sug):
+                suggestions.append(sug)
+        return suggestions
+
+    # -- on access: co-access links (reference: OnAccess :778) --------------
+
+    def on_access(self, temporal_tracker, node_id: str, min_count: int = 3) -> List[Suggestion]:
+        out: List[Suggestion] = []
+        for other, count in temporal_tracker.co_accessed(node_id):
+            if count < min_count:
+                continue
+            if self._on_cooldown(node_id, other) or self._already_linked(node_id, other):
+                continue
+            conf = min(0.5 + count / 20.0, 0.95)
+            sug = Suggestion(node_id, other, CO_ACCESSED_WITH, conf, "co-access")
+            if self._create(sug):
+                out.append(sug)
+        return out
+
+    # -- transitive (reference: SuggestTransitive :835) ---------------------
+
+    def suggest_transitive(self, node_id: str, limit: int = 5) -> List[Suggestion]:
+        """A-[SIMILAR]->B-[SIMILAR]->C implies A~C (not auto-created —
+        lower confidence; the caller decides)."""
+        out: List[Suggestion] = []
+        first_hop = set()
+        for e in self.storage.get_node_edges(node_id):
+            other = e.end_node if e.start_node == node_id else e.start_node
+            if e.type in (SIMILAR_TO, RELATES_TO):
+                first_hop.add(other)
+        seen = set(first_hop) | {node_id}
+        for mid in first_hop:
+            for e in self.storage.get_node_edges(mid):
+                far = e.end_node if e.start_node == mid else e.start_node
+                if far in seen or e.type not in (SIMILAR_TO, RELATES_TO):
+                    continue
+                seen.add(far)
+                out.append(
+                    Suggestion(node_id, far, RELATES_TO, 0.4, f"transitive via {mid}")
+                )
+                if len(out) >= limit:
+                    return out
+        return out
+
+    # -- edge creation ------------------------------------------------------
+
+    def _create(self, sug: Suggestion) -> bool:
+        if sug.confidence < self.min_confidence:
+            return False
+        edge = Edge(
+            id=str(uuid.uuid4()),
+            type=sug.rel_type,
+            start_node=sug.from_id,
+            end_node=sug.to_id,
+            properties={
+                "confidence": sug.confidence,
+                "inferred": True,
+                "reason": sug.reason,
+            },
+        )
+        try:
+            self.storage.create_edge(edge)
+            self.created_count += 1
+            return True
+        except KeyError:
+            return False

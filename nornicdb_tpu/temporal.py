@@ -1,0 +1,102 @@
+"""Temporal access tracking: velocity, sessions, co-access patterns.
+
+Reference: pkg/temporal — Tracker (tracker.go:216), RecordAccess (:419),
+session detection, pattern detector, relationship evolution (3,347 LoC).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from nornicdb_tpu.filters import VelocityKalmanFilter
+
+SESSION_GAP_S = 1800.0  # 30 min of silence ends a session
+CO_ACCESS_WINDOW_S = 300.0  # accesses within 5 min are "together"
+
+
+@dataclass
+class AccessRecord:
+    node_id: str
+    at: float
+
+
+@dataclass
+class NodeAccessStats:
+    count: int = 0
+    first_at: float = 0.0
+    last_at: float = 0.0
+    velocity: float = 0.0  # accesses/hour trend (Kalman-smoothed)
+
+
+class TemporalTracker:
+    def __init__(self, history_limit: int = 10_000):
+        self._lock = threading.Lock()
+        self._history: Deque[AccessRecord] = deque(maxlen=history_limit)
+        self._stats: Dict[str, NodeAccessStats] = {}
+        self._filters: Dict[str, VelocityKalmanFilter] = {}
+        self._session_id = 0
+        self._session_start: Optional[float] = None
+        self._session_last: Optional[float] = None
+        self._session_nodes: List[str] = []
+
+    # -- recording ---------------------------------------------------------
+
+    def record_access(self, node_id: str, at: Optional[float] = None) -> None:
+        at = at if at is not None else time.time()
+        with self._lock:
+            self._history.append(AccessRecord(node_id, at))
+            st = self._stats.setdefault(node_id, NodeAccessStats(first_at=at))
+            st.count += 1
+            st.last_at = at
+            kf = self._filters.setdefault(node_id, VelocityKalmanFilter())
+            _, vel = kf.update(float(st.count), at)
+            st.velocity = vel * 3600.0  # per hour
+            # session tracking
+            if self._session_last is None or at - self._session_last > SESSION_GAP_S:
+                self._session_id += 1
+                self._session_start = at
+                self._session_nodes = []
+            self._session_last = at
+            self._session_nodes.append(node_id)
+
+    # -- queries -----------------------------------------------------------
+
+    def stats(self, node_id: str) -> Optional[NodeAccessStats]:
+        with self._lock:
+            st = self._stats.get(node_id)
+            return NodeAccessStats(**vars(st)) if st else None
+
+    @property
+    def session(self) -> Tuple[int, List[str]]:
+        with self._lock:
+            return self._session_id, list(self._session_nodes)
+
+    def co_accessed(
+        self, node_id: str, window_s: float = CO_ACCESS_WINDOW_S
+    ) -> List[Tuple[str, int]]:
+        """Nodes accessed within ``window_s`` of any access to ``node_id``,
+        with co-occurrence counts (feeds inference co-access suggestions)."""
+        with self._lock:
+            times = [r.at for r in self._history if r.node_id == node_id]
+            if not times:
+                return []
+            counts: Dict[str, int] = {}
+            for r in self._history:
+                if r.node_id == node_id:
+                    continue
+                if any(abs(r.at - t) <= window_s for t in times):
+                    counts[r.node_id] = counts.get(r.node_id, 0) + 1
+            return sorted(counts.items(), key=lambda kv: -kv[1])
+
+    def hot_nodes(self, limit: int = 10) -> List[Tuple[str, float]]:
+        """Highest access-velocity nodes."""
+        with self._lock:
+            ranked = sorted(
+                ((nid, st.velocity) for nid, st in self._stats.items()),
+                key=lambda kv: -kv[1],
+            )
+            return ranked[:limit]

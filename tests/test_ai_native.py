@@ -1,0 +1,401 @@
+"""Tests for embed pipeline + AI-native subsystems (decay, temporal,
+inference, linkpredict, filters)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.decay import DAY_MS, DecayManager, Tier
+from nornicdb_tpu.embed import (
+    CachedEmbedder,
+    EmbedQueue,
+    HashEmbedder,
+    HashTokenizer,
+    JaxEncoderEmbedder,
+    chunk_tokens,
+)
+from nornicdb_tpu.filters import AdaptiveKalmanFilter, KalmanFilter, VelocityKalmanFilter
+from nornicdb_tpu.inference import InferenceEngine
+from nornicdb_tpu.linkpredict import (
+    AdjacencySnapshot,
+    adamic_adar,
+    jaccard,
+    predict_links,
+)
+from nornicdb_tpu.search.service import SearchService
+from nornicdb_tpu.storage import (
+    Edge,
+    ListenableEngine,
+    MemoryEngine,
+    NamespacedEngine,
+    Node,
+    now_ms,
+)
+from nornicdb_tpu.temporal import TemporalTracker
+
+
+class TestTokenizer:
+    def test_deterministic(self):
+        tok = HashTokenizer()
+        assert tok.encode("hello world") == tok.encode("hello world")
+        assert tok.encode("hello") != tok.encode("goodbye")
+
+    def test_chunking_512_50(self):
+        ids = list(range(1200))
+        chunks = chunk_tokens(ids, 512, 50)
+        assert chunks[0] == ids[:512]
+        assert chunks[1][0] == ids[462]  # 512 - 50 overlap
+        assert chunks[-1][-1] == ids[-1]
+
+    def test_short_text_single_chunk(self):
+        assert chunk_tokens(list(range(100)), 512, 50) == [list(range(100))]
+
+
+class TestEmbedders:
+    def test_hash_embedder_similarity(self):
+        emb = HashEmbedder(dims=128)
+        a = np.asarray(emb.embed("the quick brown fox jumps"))
+        b = np.asarray(emb.embed("the quick brown fox leaps"))
+        c = np.asarray(emb.embed("completely unrelated text about databases"))
+        assert a @ b > a @ c
+
+    def test_jax_encoder_embedder(self):
+        from nornicdb_tpu.models.encoder import EncoderConfig
+
+        emb = JaxEncoderEmbedder(cfg=EncoderConfig.tiny())
+        vecs = emb.embed_batch(["hello world", "another text"])
+        assert len(vecs) == 2 and len(vecs[0]) == emb.dims
+        np.testing.assert_allclose(np.linalg.norm(vecs[0]), 1.0, atol=1e-3)
+        # determinism
+        np.testing.assert_allclose(emb.embed("hello world"), vecs[0], atol=1e-5)
+
+    def test_jax_embedder_chunks(self):
+        from nornicdb_tpu.models.encoder import EncoderConfig
+
+        emb = JaxEncoderEmbedder(cfg=EncoderConfig.tiny())
+        long_text = " ".join(f"word{i}" for i in range(500))
+        chunks = emb.embed_chunks(long_text)
+        assert len(chunks) >= 2
+
+    def test_cached_embedder(self):
+        inner = HashEmbedder(dims=32)
+        cached = CachedEmbedder(inner, capacity=2)
+        v1 = cached.embed("a")
+        v2 = cached.embed("a")
+        assert v1 == v2 and cached.hits == 1 and cached.misses == 1
+        cached.embed_batch(["b", "c", "a"])  # 'a' may be evicted by cap 2
+        assert cached.embed("b") is not None
+
+
+class TestEmbedQueue:
+    def _setup(self):
+        eng = ListenableEngine(NamespacedEngine(MemoryEngine(), "test"))
+        embedded = []
+        q = EmbedQueue(
+            eng, HashEmbedder(dims=32), on_embedded=embedded.append,
+            rescan_interval_s=0,
+        )
+        eng.add_listener(q)
+        q.start()
+        return eng, q, embedded
+
+    def test_embeds_on_upsert(self):
+        eng, q, embedded = self._setup()
+        try:
+            eng.create_node(Node(id="n1", labels=[], properties={"content": "hello"}))
+            # listener sees the namespaced node; queue should still resolve it
+            q.drain(5)
+            node = eng.get_node("n1")
+            assert node.embedding is not None
+            assert len(embedded) == 1
+        finally:
+            q.stop()
+
+    def test_long_text_gets_chunks(self):
+        eng = ListenableEngine(NamespacedEngine(MemoryEngine(), "test"))
+        from nornicdb_tpu.models.encoder import EncoderConfig
+
+        q = EmbedQueue(eng, JaxEncoderEmbedder(cfg=EncoderConfig.tiny()),
+                       rescan_interval_s=0)
+        eng.add_listener(q)
+        q.start()
+        try:
+            text = " ".join(f"tok{i}" for i in range(3000))
+            eng.create_node(Node(id="long", labels=[], properties={"content": text}))
+            q.drain(30)
+            node = eng.get_node("long")
+            assert node.embedding is not None
+            assert node.chunk_embeddings and len(node.chunk_embeddings) >= 2
+        finally:
+            q.stop()
+
+    def test_failed_embedder_fails_open(self):
+        eng = ListenableEngine(NamespacedEngine(MemoryEngine(), "test"))
+
+        class Broken:
+            dims = 8
+
+            def embed_batch(self, texts):
+                raise RuntimeError("boom")
+
+        q = EmbedQueue(eng, Broken(), max_retries=2, rescan_interval_s=0)
+        eng.add_listener(q)
+        q.start()
+        try:
+            eng.create_node(Node(id="x", labels=[], properties={"content": "y"}))
+            q.drain(5)
+            assert q.failed_count == 1
+            assert eng.get_node("x").embedding is None
+        finally:
+            q.stop()
+
+
+class TestDecay:
+    def test_tier_half_lives(self):
+        eng = NamespacedEngine(MemoryEngine(), "t")
+        mgr = DecayManager(eng, use_kalman=False)
+        assert mgr.half_life(Tier.EPISODIC) == 7 * DAY_MS
+        assert mgr.half_life(Tier.SEMANTIC) == 69 * DAY_MS
+        assert mgr.half_life(Tier.PROCEDURAL) == 693 * DAY_MS
+
+    def test_recency_decays(self):
+        eng = NamespacedEngine(MemoryEngine(), "t")
+        mgr = DecayManager(eng, use_kalman=False)
+        now = now_ms()
+        eng.create_node(Node(id="old", labels=[], properties={},
+                             created_at=now - 30 * DAY_MS, updated_at=now - 30 * DAY_MS))
+        eng.create_node(Node(id="new", labels=[], properties={},
+                             created_at=now, updated_at=now))
+        s_old = mgr.score(eng.get_node("old"), now)
+        s_new = mgr.score(eng.get_node("new"), now)
+        assert s_new.score > s_old.score
+        assert s_old.recency == pytest.approx(0.5 ** (30 / 7), rel=1e-3)
+
+    def test_promotion(self):
+        eng = NamespacedEngine(MemoryEngine(), "t")
+        mgr = DecayManager(eng)
+        for _ in range(5):
+            mgr.record_access("n")
+        assert mgr.tier_of("n") == Tier.SEMANTIC
+        for _ in range(25):
+            mgr.record_access("n")
+        assert mgr.tier_of("n") == Tier.PROCEDURAL
+
+    def test_sweep_archives(self):
+        eng = NamespacedEngine(MemoryEngine(), "t")
+        mgr = DecayManager(eng, use_kalman=False, archive_threshold=0.2)
+        now = now_ms()
+        eng.create_node(Node(id="stale", labels=[],
+                             properties={"importance": 0.0},
+                             created_at=now - 300 * DAY_MS,
+                             updated_at=now - 300 * DAY_MS))
+        scored, archived = mgr.sweep(now)
+        assert scored == 1 and archived == 1
+        assert eng.get_node("stale").properties["_archived"] is True
+
+
+class TestTemporal:
+    def test_velocity_and_sessions(self):
+        tr = TemporalTracker()
+        t0 = 1000.0
+        for i in range(5):
+            tr.record_access("a", t0 + i * 10)
+        st = tr.stats("a")
+        assert st.count == 5 and st.velocity > 0
+        sid1, nodes = tr.session
+        assert "a" in nodes
+        # a 31-minute gap starts a new session
+        tr.record_access("b", t0 + 50 + 1900)
+        sid2, nodes2 = tr.session
+        assert sid2 == sid1 + 1 and nodes2 == ["b"]
+
+    def test_co_access(self):
+        tr = TemporalTracker()
+        for i in range(3):
+            tr.record_access("x", 100.0 + i)
+            tr.record_access("y", 100.5 + i)
+        tr.record_access("z", 99999.0)
+        co = dict(tr.co_accessed("x"))
+        assert co.get("y", 0) >= 3 and "z" not in co
+
+
+class TestInference:
+    def _setup(self):
+        eng = NamespacedEngine(MemoryEngine(), "t")
+        svc = SearchService(eng)
+        inf = InferenceEngine(eng, svc, similarity_threshold=0.8)
+        return eng, svc, inf
+
+    def test_similarity_autolink(self):
+        eng, svc, inf = self._setup()
+        v = [1.0, 0.0, 0.0]
+        for nid, vec in [("a", v), ("b", [0.99, 0.1, 0.0]), ("c", [0.0, 1.0, 0.0])]:
+            eng.create_node(Node(id=nid, labels=[], properties={}, embedding=vec))
+            svc.index_node(eng.get_node(nid))
+        node = eng.get_node("a")
+        sugs = inf.on_store(node)
+        assert any(s.to_id == "b" for s in sugs)
+        assert all(s.to_id != "c" for s in sugs)
+        edges = eng.get_node_edges("a")
+        assert any(e.properties.get("inferred") for e in edges)
+
+    def test_cooldown_blocks_repeat(self):
+        eng, svc, inf = self._setup()
+        for nid in ("a", "b"):
+            eng.create_node(Node(id=nid, labels=[], properties={},
+                                 embedding=[1.0, 0.0]))
+            svc.index_node(eng.get_node(nid))
+        n = eng.get_node("a")
+        first = inf.on_store(n)
+        # delete the edge; cooldown should still block instant re-creation
+        for e in eng.get_node_edges("a"):
+            eng.delete_edge(e.id)
+        second = inf.on_store(n)
+        assert first and not second
+
+    def test_best_of_chunks(self):
+        eng, svc, inf = self._setup()
+        eng.create_node(Node(id="t", labels=[], properties={}, embedding=[0.0, 1.0]))
+        svc.index_node(eng.get_node("t"))
+        chunky = Node(id="c", labels=[], properties={},
+                      chunk_embeddings=[[1.0, 0.0], [0.05, 1.0]])
+        eng.create_node(chunky)
+        sugs = inf.on_store(eng.get_node("c"))
+        assert any(s.to_id == "t" for s in sugs)  # second chunk matches
+
+    def test_transitive(self):
+        eng, svc, inf = self._setup()
+        for nid in ("a", "b", "c"):
+            eng.create_node(Node(id=nid, labels=[], properties={}))
+        eng.create_edge(Edge(id="e1", type="SIMILAR_TO", start_node="a", end_node="b"))
+        eng.create_edge(Edge(id="e2", type="SIMILAR_TO", start_node="b", end_node="c"))
+        sugs = inf.suggest_transitive("a")
+        assert len(sugs) == 1 and sugs[0].to_id == "c"
+
+
+class TestLinkPredict:
+    def _graph(self):
+        eng = NamespacedEngine(MemoryEngine(), "t")
+        for nid in "abcdz":
+            eng.create_node(Node(id=nid, labels=[], properties={}))
+        # a-b, a-c, b-d, c-d: a and d share neighbors b, c
+        for i, (s, t) in enumerate([("a", "b"), ("a", "c"), ("b", "d"), ("c", "d")]):
+            eng.create_edge(Edge(id=f"e{i}", type="T", start_node=s, end_node=t))
+        return eng
+
+    def test_scores(self):
+        eng = self._graph()
+        snap = AdjacencySnapshot(eng)
+        assert jaccard(snap, "a", "d") == 1.0  # identical neighbor sets
+        assert adamic_adar(snap, "a", "d") > 0
+
+    def test_predict_links_excludes_existing(self):
+        eng = self._graph()
+        preds = predict_links(eng, "a")
+        ids = [p[0] for p in preds]
+        assert "d" in ids and "b" not in ids and "z" not in ids
+
+
+class TestKalman:
+    def test_basic_converges(self):
+        kf = KalmanFilter(measurement_noise=0.5)
+        for _ in range(100):
+            est = kf.update(10.0)
+        assert est == pytest.approx(10.0, abs=0.1)
+
+    def test_adaptive_tracks_noise(self):
+        kf = AdaptiveKalmanFilter()
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            kf.update(5.0 + rng.standard_normal() * 2)
+        assert kf.measurement_noise > 1e-3
+
+    def test_velocity_filter(self):
+        kf = VelocityKalmanFilter(measurement_noise=1e-3)
+        for i in range(50):
+            pos, vel = kf.update(float(i * 2), float(i))
+        assert vel == pytest.approx(2.0, abs=0.3)
+
+
+class TestAiNativeReviewRegressions:
+    def test_batch_not_wedged_by_one_failure(self):
+        """One node's write failure must not leave siblings stuck in
+        _pending (they would never re-embed)."""
+        eng = ListenableEngine(NamespacedEngine(MemoryEngine(), "test"))
+
+        class FlakyStorage:
+            def __init__(self, inner):
+                self.inner = inner
+                self.fail_ids = set()
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+            def update_node(self, node):
+                if node.id in self.fail_ids:
+                    raise RuntimeError("disk full")
+                return self.inner.update_node(node)
+
+        flaky = FlakyStorage(eng)
+        q = EmbedQueue(flaky, HashEmbedder(dims=16), rescan_interval_s=0,
+                       batch_size=4)
+        flaky.fail_ids.add("bad")
+        eng.create_node(Node(id="bad", labels=[], properties={"content": "x"}))
+        eng.create_node(Node(id="good", labels=[], properties={"content": "y"}))
+        q.enqueue("bad")
+        q.enqueue("good")
+        q.start()
+        try:
+            q.drain(5)
+            assert eng.get_node("good").embedding is not None
+            assert q.failed_count == 1
+            with q._lock:
+                assert not q._pending  # nothing wedged
+        finally:
+            q.stop()
+
+    def test_velocity_filter_prior_covariance(self):
+        kf = VelocityKalmanFilter()
+        kf.update(0.0, 10.0)
+        kf.update(2.0, 11.0)
+        assert abs(kf.p10 - kf.p01) < 1e-9  # covariance stays symmetric
+
+    def test_velocity_filter_t_zero_start(self):
+        kf = VelocityKalmanFilter(measurement_noise=1e-3)
+        kf.update(5.0, 0.0)
+        _, vel = kf.update(7.0, 1.0)
+        assert vel > 0.5  # not collapsed by dt=1e-9
+
+    def test_decay_non_numeric_importance(self):
+        eng = NamespacedEngine(MemoryEngine(), "t")
+        mgr = DecayManager(eng, use_kalman=False)
+        eng.create_node(Node(id="n", labels=[], properties={"importance": "high"}))
+        s = mgr.score(eng.get_node("n"))
+        assert s.importance == 0.5
+
+    def test_cached_embedder_dedupes_batch(self):
+        calls = []
+
+        class Counting:
+            dims = 8
+
+            def embed(self, t):
+                return [1.0] * 8
+
+            def embed_batch(self, texts):
+                calls.append(list(texts))
+                return [[1.0] * 8 for _ in texts]
+
+        cached = CachedEmbedder(Counting())
+        cached.embed_batch(["a", "a", "b", "a"])
+        assert calls == [["a", "b"]]
+
+    def test_cached_embedder_exposes_chunks(self):
+        from nornicdb_tpu.models.encoder import EncoderConfig
+
+        inner = JaxEncoderEmbedder(cfg=EncoderConfig.tiny())
+        cached = CachedEmbedder(inner)
+        assert hasattr(cached, "embed_chunks")
+        long_text = " ".join(f"w{i}" for i in range(500))
+        assert len(cached.embed_chunks(long_text)) >= 2

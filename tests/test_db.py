@@ -1,0 +1,81 @@
+"""DB facade integration tests: the full embedded-API surface
+(reference: pkg/nornicdb public API, db.go:1951-2378)."""
+
+import time
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.embed import HashEmbedder
+
+
+class TestFacade:
+    def test_store_recall_roundtrip(self):
+        db = nornicdb_tpu.open(embedder=HashEmbedder(dims=64))
+        try:
+            db.store("TPUs multiply matrices fast", node_id="a")
+            db.store("cooking pasta in salted water", node_id="b")
+            db.search.embedder = db._embedder
+            db.search.build_indexes()
+            res = db.recall("multiply matrices")
+            assert res and res[0]["id"] == "a"
+        finally:
+            db.close()
+
+    def test_auto_embed_pipeline(self):
+        db = nornicdb_tpu.open(embedder=HashEmbedder(dims=64), auto_embed=True)
+        try:
+            db.search  # instantiate so on_embedded indexes into it
+            db.store("graph databases store nodes", node_id="g")
+            db.flush()  # drains the embed queue
+            node = db.storage.get_node("g")
+            assert node.embedding is not None
+            assert "g" in db.search.vectors
+            res = db.recall("graph nodes")
+            assert res and res[0]["id"] == "g"
+        finally:
+            db.close()
+
+    def test_remember_tracks_access(self):
+        db = nornicdb_tpu.open()
+        try:
+            db.store("x", node_id="n")
+            db.remember("n")
+            db.remember("n")
+            assert db.temporal.stats("n").count == 2
+        finally:
+            db.close()
+
+    def test_auto_link_on_store(self):
+        db = nornicdb_tpu.open()
+        try:
+            db.search  # wire search
+            db.store("first", node_id="a", embedding=[1.0, 0.0])
+            db.search.index_node(db.storage.get_node("a"))
+            db.store("second", node_id="b", embedding=[0.99, 0.05], auto_link=True)
+            edges = db.storage.get_node_edges("b")
+            assert any(e.properties.get("inferred") for e in edges)
+        finally:
+            db.close()
+
+    def test_cypher_and_storage_share_view(self):
+        db = nornicdb_tpu.open()
+        try:
+            db.store("hello", node_id="h", labels=["Memory"])
+            r = db.cypher("MATCH (m:Memory) RETURN m.content")
+            assert r.rows == [["hello"]]
+        finally:
+            db.close()
+
+    def test_durable_facade_with_async(self, tmp_path):
+        db = nornicdb_tpu.open(str(tmp_path), async_writes=True)
+        try:
+            db.store("persist me", node_id="p")
+            db.flush()
+        finally:
+            db.close()
+        db2 = nornicdb_tpu.open(str(tmp_path))
+        try:
+            assert db2.storage.get_node("p").properties["content"] == "persist me"
+        finally:
+            db2.close()
