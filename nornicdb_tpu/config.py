@@ -1,0 +1,415 @@
+"""Layered configuration + runtime feature flags.
+
+Re-expresses the reference's config stack (pkg/config/config.go:83-107:
+defaults -> YAML -> ``NORNICDB_*`` env vars -> CLI flags; runtime-mutable
+feature flags at pkg/config/feature_flags.go:118-501; per-database
+overrides under pkg/config/dbconfig/) in one module. Precedence, lowest
+to highest: built-in defaults, YAML file, environment, explicit
+overrides (CLI flags pass through ``overrides``).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import threading
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, Dict, List, Optional
+
+ENV_PREFIX = "NORNICDB_"
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(ENV_PREFIX + name, default)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(ENV_PREFIX + name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_int(name: str, default: int = 0) -> int:
+    v = os.environ.get(ENV_PREFIX + name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float = 0.0) -> float:
+    v = os.environ.get(ENV_PREFIX + name)
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# Config sections (reference: pkg/config/config.go:83-107 — Auth/Database/
+# Server/Memory/EmbeddingWorker/Compliance/Logging/Features)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AuthConfig:
+    enabled: bool = False
+    jwt_secret: str = ""
+    token_ttl_seconds: int = 3600
+    allow_anonymous_reads: bool = False
+    admin_user: str = "neo4j"
+    admin_password: str = ""
+
+
+@dataclass
+class DatabaseConfig:
+    data_dir: str = ""
+    default_database: str = "neo4j"
+    async_writes: bool = False
+    sync_every_write: bool = False
+    encryption_enabled: bool = False
+    encryption_passphrase: str = ""
+    wal_snapshot_interval_s: int = 300
+    wal_max_segment_mb: int = 16
+    max_databases: int = 64
+
+
+@dataclass
+class ServerConfig:
+    http_host: str = "127.0.0.1"
+    http_port: int = 7474
+    bolt_port: int = 7687
+    grpc_port: int = 6334
+    cluster_port: int = 7688
+    enable_bolt: bool = True
+    enable_graphql: bool = True
+    enable_mcp: bool = True
+    enable_qdrant_grpc: bool = False
+    rate_limit_per_minute: int = 0  # 0 = unlimited
+    request_timeout_s: int = 30
+
+
+@dataclass
+class MemoryConfig:
+    """AI-native memory behavior (decay tiers, auto-linking)."""
+
+    decay_enabled: bool = True
+    decay_interval_s: int = 3600
+    episodic_half_life_days: float = 7.0
+    semantic_half_life_days: float = 69.0
+    procedural_half_life_days: float = 693.0
+    archive_threshold: float = 0.05
+    auto_link: bool = True
+    auto_link_threshold: float = 0.82
+    auto_link_max_per_node: int = 5
+
+
+@dataclass
+class EmbeddingConfig:
+    provider: str = "local"  # local | http | none
+    endpoint: str = ""
+    model: str = "bge-m3"
+    dims: int = 1024
+    chunk_size: int = 512
+    chunk_overlap: int = 50
+    batch_size: int = 16
+    workers: int = 2
+    rescan_interval_s: int = 900
+    cluster_debounce_s: int = 30
+
+
+@dataclass
+class SearchConfig:
+    ann_quality: str = "balanced"  # fast | balanced | accurate | compressed
+    gpu_enabled: bool = True  # device (TPU) acceleration
+    gpu_threshold: int = 1024  # below this N, stay on host brute force
+    hnsw_m: int = 16
+    hnsw_ef_construction: int = 200
+    hnsw_ef_search: int = 64
+    rrf_k: int = 60
+    rerank: str = "none"  # none | local | llm
+    result_cache_size: int = 1024
+    result_cache_ttl_s: int = 60
+
+
+@dataclass
+class ComplianceConfig:
+    audit_enabled: bool = False
+    audit_path: str = ""
+    retention_days: int = 0  # 0 = keep forever
+    gdpr_export_enabled: bool = True
+
+
+@dataclass
+class LoggingConfig:
+    level: str = "info"
+    json: bool = False
+
+
+@dataclass
+class ReplicationConfig:
+    """Reference: pkg/replication/config.go:104-142."""
+
+    mode: str = "standalone"  # standalone | ha_standby | raft | multi_region
+    sync_mode: str = "async"  # async | quorum
+    node_id: str = ""
+    listen: str = ""
+    peers: List[str] = field(default_factory=list)
+    heartbeat_interval_s: float = 1.0
+    election_timeout_s: float = 5.0
+
+
+@dataclass
+class Config:
+    auth: AuthConfig = field(default_factory=AuthConfig)
+    database: DatabaseConfig = field(default_factory=DatabaseConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
+    memory: MemoryConfig = field(default_factory=MemoryConfig)
+    embedding: EmbeddingConfig = field(default_factory=EmbeddingConfig)
+    search: SearchConfig = field(default_factory=SearchConfig)
+    compliance: ComplianceConfig = field(default_factory=ComplianceConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+    replication: ReplicationConfig = field(default_factory=ReplicationConfig)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return _dataclass_to_dict(self)
+
+    def copy(self) -> "Config":
+        return copy.deepcopy(self)
+
+
+def _dataclass_to_dict(obj: Any) -> Any:
+    if is_dataclass(obj):
+        return {f.name: _dataclass_to_dict(getattr(obj, f.name)) for f in fields(obj)}
+    if isinstance(obj, list):
+        return [_dataclass_to_dict(x) for x in obj]
+    return obj
+
+
+def _apply_dict(obj: Any, data: Dict[str, Any]) -> None:
+    """Merge a nested dict into a dataclass tree (unknown keys ignored)."""
+    if not is_dataclass(obj) or not isinstance(data, dict):
+        return
+    by_name = {f.name: f for f in fields(obj)}
+    for key, value in data.items():
+        key = key.replace("-", "_")
+        if key not in by_name:
+            continue
+        cur = getattr(obj, key)
+        if is_dataclass(cur):
+            _apply_dict(cur, value)
+        elif value is not None:
+            setattr(obj, key, _coerce_like(cur, value))
+
+
+def _coerce_like(current: Any, value: Any) -> Any:
+    """Coerce a YAML/override value to the field's existing type; a value
+    that can't be coerced keeps the current setting (config must not plant
+    type bombs for downstream consumers)."""
+    try:
+        if isinstance(current, bool):
+            if isinstance(value, bool):
+                return value
+            return str(value).strip().lower() in ("1", "true", "yes", "on")
+        if isinstance(current, int) and not isinstance(current, bool):
+            return int(value)
+        if isinstance(current, float):
+            return float(value)
+        if isinstance(current, str):
+            return str(value)
+        if isinstance(current, list) and isinstance(value, (list, tuple)):
+            return list(value)
+    except (TypeError, ValueError):
+        return current
+    return value
+
+
+# env var name -> (section attr, field attr, parser)
+_ENV_MAP = {
+    "AUTH_ENABLED": ("auth", "enabled", env_bool),
+    "JWT_SECRET": ("auth", "jwt_secret", env_str),
+    "ADMIN_PASSWORD": ("auth", "admin_password", env_str),
+    "DATA_DIR": ("database", "data_dir", env_str),
+    "DEFAULT_DATABASE": ("database", "default_database", env_str),
+    "ASYNC_WRITES": ("database", "async_writes", env_bool),
+    "SYNC_EVERY_WRITE": ("database", "sync_every_write", env_bool),
+    "ENCRYPTION_ENABLED": ("database", "encryption_enabled", env_bool),
+    "ENCRYPTION_PASSPHRASE": ("database", "encryption_passphrase", env_str),
+    "HTTP_HOST": ("server", "http_host", env_str),
+    "HTTP_PORT": ("server", "http_port", env_int),
+    "BOLT_PORT": ("server", "bolt_port", env_int),
+    "GRPC_PORT": ("server", "grpc_port", env_int),
+    "CLUSTER_PORT": ("server", "cluster_port", env_int),
+    "RATE_LIMIT_PER_MINUTE": ("server", "rate_limit_per_minute", env_int),
+    "DECAY_ENABLED": ("memory", "decay_enabled", env_bool),
+    "AUTO_LINK": ("memory", "auto_link", env_bool),
+    "AUTO_LINK_THRESHOLD": ("memory", "auto_link_threshold", env_float),
+    "EMBEDDING_PROVIDER": ("embedding", "provider", env_str),
+    "EMBEDDING_ENDPOINT": ("embedding", "endpoint", env_str),
+    "EMBEDDING_MODEL": ("embedding", "model", env_str),
+    "EMBEDDING_DIMS": ("embedding", "dims", env_int),
+    "EMBEDDING_CHUNK_SIZE": ("embedding", "chunk_size", env_int),
+    "EMBEDDING_CHUNK_OVERLAP": ("embedding", "chunk_overlap", env_int),
+    "EMBEDDING_WORKERS": ("embedding", "workers", env_int),
+    "VECTOR_ANN_QUALITY": ("search", "ann_quality", env_str),
+    "GPU_ENABLED": ("search", "gpu_enabled", env_bool),
+    "GPU_THRESHOLD": ("search", "gpu_threshold", env_int),
+    "RERANK": ("search", "rerank", env_str),
+    "AUDIT_ENABLED": ("compliance", "audit_enabled", env_bool),
+    "AUDIT_PATH": ("compliance", "audit_path", env_str),
+    "RETENTION_DAYS": ("compliance", "retention_days", env_int),
+    "LOG_LEVEL": ("logging", "level", env_str),
+    "REPLICATION_MODE": ("replication", "mode", env_str),
+    "REPLICATION_SYNC_MODE": ("replication", "sync_mode", env_str),
+    "REPLICATION_NODE_ID": ("replication", "node_id", env_str),
+    "REPLICATION_LISTEN": ("replication", "listen", env_str),
+}
+
+
+def load_config(
+    yaml_path: Optional[str] = None,
+    overrides: Optional[Dict[str, Any]] = None,
+    env: bool = True,
+) -> Config:
+    """Build a Config with full precedence chain (reference:
+    pkg/config/config.go:83-107)."""
+    cfg = Config()
+    if yaml_path and os.path.exists(yaml_path):
+        import yaml  # baked-in
+
+        with open(yaml_path, "r", encoding="utf-8") as f:
+            data = yaml.safe_load(f) or {}
+        _apply_dict(cfg, data)
+    if env:
+        for name, (section, attr, parser) in _ENV_MAP.items():
+            if ENV_PREFIX + name in os.environ:
+                section_obj = getattr(cfg, section)
+                # malformed values keep the layered default, not the
+                # parser's zero value
+                setattr(section_obj, attr, parser(name, getattr(section_obj, attr)))
+        peers = env_str("REPLICATION_PEERS")
+        if peers:
+            cfg.replication.peers = [p.strip() for p in peers.split(",") if p.strip()]
+    if overrides:
+        _apply_dict(cfg, overrides)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Runtime feature flags (reference: pkg/config/feature_flags.go:118-501 —
+# runtime-mutable, incl. parser mode, Kalman, AutoTLP, cooldown)
+# ---------------------------------------------------------------------------
+
+_FLAG_DEFAULTS: Dict[str, Any] = {
+    "parser": "nornic",  # nornic | strict (reference: feature_flags.go:118,214)
+    "kalman_decay": True,
+    "auto_tlp": True,  # topology link prediction feeding inference
+    "inference_cooldown": True,
+    "query_cache": True,
+    "fast_paths": True,
+    "parallel_execution": True,
+    "seed_hnsw_from_bm25": True,
+    "search_diag_timings": False,
+}
+
+
+class FeatureFlags:
+    """Thread-safe runtime-mutable flags. Env ``NORNICDB_FLAG_*`` (e.g.
+    NORNICDB_FLAG_PARSER=strict) is consulted live on each read so import
+    order doesn't freeze values; an explicit ``set()`` wins over env."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._explicit: Dict[str, Any] = {}
+
+    def _from_env(self, name: str, default: Any) -> Any:
+        raw = os.environ.get(ENV_PREFIX + "FLAG_" + name.upper())
+        if raw is None:
+            return default
+        if isinstance(default, bool):
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return raw
+
+    def get(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            if name in self._explicit:
+                return self._explicit[name]
+        return self._from_env(name, _FLAG_DEFAULTS.get(name, default))
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._explicit[name] = value
+
+    def reset(self, name: Optional[str] = None) -> None:
+        """Drop explicit overrides (all, or one flag) back to env/defaults."""
+        with self._lock:
+            if name is None:
+                self._explicit.clear()
+            else:
+                self._explicit.pop(name, None)
+
+    def all(self) -> Dict[str, Any]:
+        return {k: self.get(k) for k in _FLAG_DEFAULTS}
+
+
+flags = FeatureFlags()
+
+
+# ---------------------------------------------------------------------------
+# Per-database overrides (reference: pkg/config/dbconfig/ + admin API
+# server_dbconfig.go) — a keyed bag of section overrides applied on top of
+# the global config when a DB-scoped service asks for its view.
+# ---------------------------------------------------------------------------
+
+
+class DBConfigRegistry:
+    def __init__(self, base: Config):
+        self._base = base
+        self._overrides: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def set_override(self, database: str, override: Dict[str, Any]) -> None:
+        with self._lock:
+            merged = self._overrides.setdefault(database, {})
+            _deep_merge(merged, override)
+
+    def clear_override(self, database: str) -> None:
+        with self._lock:
+            self._overrides.pop(database, None)
+
+    def for_database(self, database: str) -> Config:
+        with self._lock:
+            override = copy.deepcopy(self._overrides.get(database, {}))
+        cfg = self._base.copy()
+        _apply_dict(cfg, override)
+        return cfg
+
+    def overrides(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return copy.deepcopy(self._overrides)
+
+
+def decay_half_life_ms(mem: MemoryConfig) -> Dict[str, int]:
+    """Translate MemoryConfig half-life days into the tier->ms map
+    DecayManager consumes, so YAML/env half-life settings actually take
+    effect (DecayManager(half_life_ms=decay_half_life_ms(cfg.memory)))."""
+    from nornicdb_tpu.decay import DAY_MS, Tier
+
+    return {
+        Tier.EPISODIC: int(mem.episodic_half_life_days * DAY_MS),
+        Tier.SEMANTIC: int(mem.semantic_half_life_days * DAY_MS),
+        Tier.PROCEDURAL: int(mem.procedural_half_life_days * DAY_MS),
+    }
+
+
+def _deep_merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v

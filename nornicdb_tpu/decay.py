@@ -66,6 +66,7 @@ class DecayManager:
         importance_weight: float = 0.2,
         archive_threshold: float = 0.05,
         use_kalman: bool = True,
+        half_life_ms: Optional[Dict[str, int]] = None,
     ):
         self.storage = storage
         self.w_recency = recency_weight
@@ -73,6 +74,7 @@ class DecayManager:
         self.w_importance = importance_weight
         self.archive_threshold = archive_threshold
         self.use_kalman = use_kalman
+        self.half_life_ms = dict(half_life_ms or HALF_LIFE_MS)
         self._state: Dict[str, _NodeState] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -106,7 +108,7 @@ class DecayManager:
             st = self._state.setdefault(node.id, _NodeState())
             last = st.last_access_ms or node.updated_at or node.created_at or now
             age_ms = max(now - last, 0)
-            half_life = HALF_LIFE_MS[st.tier]
+            half_life = self.half_life_ms[st.tier]
             recency = math.pow(0.5, age_ms / half_life)
             frequency = 1.0 - math.exp(-st.access_count / 10.0)
             try:
@@ -150,7 +152,7 @@ class DecayManager:
 
     def half_life(self, tier: str) -> int:
         """Reference: HalfLife (decay.go:977)."""
-        return HALF_LIFE_MS[tier]
+        return self.half_life_ms[tier]
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,113 @@
+"""Config layering + feature flags (reference: pkg/config)."""
+
+import os
+
+from nornicdb_tpu.config import (
+    Config,
+    DBConfigRegistry,
+    FeatureFlags,
+    load_config,
+)
+
+
+def test_defaults():
+    cfg = load_config(env=False)
+    assert cfg.server.http_port == 7474
+    assert cfg.server.bolt_port == 7687
+    assert cfg.database.default_database == "neo4j"
+    assert cfg.memory.episodic_half_life_days == 7.0
+    assert cfg.memory.semantic_half_life_days == 69.0
+    assert cfg.memory.procedural_half_life_days == 693.0
+
+
+def test_yaml_layer(tmp_path):
+    p = tmp_path / "nornicdb.yaml"
+    p.write_text("server:\n  http_port: 9999\ndatabase:\n  data_dir: /tmp/x\n")
+    cfg = load_config(yaml_path=str(p), env=False)
+    assert cfg.server.http_port == 9999
+    assert cfg.database.data_dir == "/tmp/x"
+    # untouched sections keep defaults
+    assert cfg.server.bolt_port == 7687
+
+
+def test_env_overrides_yaml(tmp_path, monkeypatch):
+    p = tmp_path / "nornicdb.yaml"
+    p.write_text("server:\n  http_port: 9999\n")
+    monkeypatch.setenv("NORNICDB_HTTP_PORT", "8888")
+    monkeypatch.setenv("NORNICDB_AUTH_ENABLED", "true")
+    monkeypatch.setenv("NORNICDB_AUTO_LINK_THRESHOLD", "0.9")
+    cfg = load_config(yaml_path=str(p))
+    assert cfg.server.http_port == 8888
+    assert cfg.auth.enabled is True
+    assert abs(cfg.memory.auto_link_threshold - 0.9) < 1e-9
+
+
+def test_explicit_overrides_win(monkeypatch):
+    monkeypatch.setenv("NORNICDB_HTTP_PORT", "8888")
+    cfg = load_config(overrides={"server": {"http_port": 7777}})
+    assert cfg.server.http_port == 7777
+
+
+def test_replication_peers_env(monkeypatch):
+    monkeypatch.setenv("NORNICDB_REPLICATION_PEERS", "a:7688, b:7688")
+    cfg = load_config()
+    assert cfg.replication.peers == ["a:7688", "b:7688"]
+
+
+def test_feature_flags_env(monkeypatch):
+    monkeypatch.setenv("NORNICDB_FLAG_PARSER", "strict")
+    monkeypatch.setenv("NORNICDB_FLAG_QUERY_CACHE", "false")
+    ff = FeatureFlags()
+    assert ff.get("parser") == "strict"
+    assert ff.get("query_cache") is False
+    ff.set("parser", "nornic")
+    assert ff.get("parser") == "nornic"
+    assert "fast_paths" in ff.all()
+
+
+def test_malformed_env_keeps_default(monkeypatch):
+    monkeypatch.setenv("NORNICDB_HTTP_PORT", "7474x")
+    cfg = load_config()
+    assert cfg.server.http_port == 7474
+
+
+def test_yaml_null_and_mistyped_values(tmp_path):
+    p = tmp_path / "nornicdb.yaml"
+    p.write_text("server:\n  http_port:\n  bolt_port: '7999'\n")
+    cfg = load_config(yaml_path=str(p), env=False)
+    assert cfg.server.http_port == 7474  # null keeps default
+    assert cfg.server.bolt_port == 7999  # string coerced to int
+
+
+def test_flags_read_env_live(monkeypatch):
+    ff = FeatureFlags()
+    assert ff.get("parser") == "nornic"
+    monkeypatch.setenv("NORNICDB_FLAG_PARSER", "strict")
+    assert ff.get("parser") == "strict"  # env read after construction
+    ff.set("parser", "nornic")
+    assert ff.get("parser") == "nornic"  # explicit set wins over env
+    ff.reset("parser")
+    assert ff.get("parser") == "strict"
+
+
+def test_decay_half_life_wiring():
+    from nornicdb_tpu.config import MemoryConfig, decay_half_life_ms
+    from nornicdb_tpu.decay import DecayManager, Tier
+    from nornicdb_tpu.storage import MemoryEngine
+
+    mem = MemoryConfig(episodic_half_life_days=1.0)
+    mgr = DecayManager(MemoryEngine(), half_life_ms=decay_half_life_ms(mem))
+    assert mgr.half_life(Tier.EPISODIC) == 86_400_000
+    assert mgr.half_life(Tier.SEMANTIC) == 69 * 86_400_000
+
+
+def test_per_db_overrides():
+    reg = DBConfigRegistry(Config())
+    reg.set_override("tenant1", {"search": {"ann_quality": "accurate"}})
+    assert reg.for_database("tenant1").search.ann_quality == "accurate"
+    assert reg.for_database("other").search.ann_quality == "balanced"
+    reg.set_override("tenant1", {"search": {"rrf_k": 10}})
+    c = reg.for_database("tenant1")
+    assert c.search.ann_quality == "accurate" and c.search.rrf_k == 10
+    reg.clear_override("tenant1")
+    assert reg.for_database("tenant1").search.ann_quality == "balanced"
