@@ -1,0 +1,37 @@
+"""Build the native nornickv shared library with g++.
+
+Invoked automatically (and cached) by nornicdb_tpu.storage.disk on first
+import; also runnable directly: ``python native/build.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "nornickv.cpp")
+OUT = os.path.join(HERE, "libnornickv.so")
+
+
+def build(force: bool = False) -> str:
+    """Compile if the .so is missing or older than the source. Returns the
+    library path; raises on compiler failure."""
+    if (
+        not force
+        and os.path.exists(OUT)
+        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
+    ):
+        return OUT
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "-o", OUT + ".tmp", SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(OUT + ".tmp", OUT)
+    return OUT
+
+
+if __name__ == "__main__":
+    print(build(force="--force" in sys.argv))

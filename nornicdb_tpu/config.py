@@ -222,8 +222,13 @@ def _coerce_like(current: Any, value: Any) -> Any:
             return float(value)
         if isinstance(current, str):
             return str(value)
-        if isinstance(current, list) and isinstance(value, (list, tuple)):
-            return list(value)
+        if isinstance(current, list):
+            if isinstance(value, (list, tuple)):
+                return list(value)
+            if isinstance(value, str):
+                # match NORNICDB_REPLICATION_PEERS-style comma lists
+                return [p.strip() for p in value.split(",") if p.strip()]
+            return current
     except (TypeError, ValueError):
         return current
     return value

@@ -36,12 +36,26 @@ class DB:
         sync_every_write: bool = False,
         embedder: Optional[Any] = None,
         auto_embed: bool = False,
+        engine: str = "auto",  # auto | native | python | memory
     ):
-        # engine chain: Durable/Memory -> [Async] -> Namespaced -> Listenable
-        # (reference chain order: db.go:742-947; the listener layer sits on
-        # top so mutation callbacks carry LOGICAL node ids)
-        if data_dir:
-            base: Engine = DurableEngine(data_dir, sync_every_write=sync_every_write)
+        # engine chain: Disk/Durable/Memory -> [Async] -> Namespaced ->
+        # Listenable (reference chain order: db.go:742-947; the listener
+        # layer sits on top so mutation callbacks carry LOGICAL node ids)
+        if engine not in ("auto", "native", "python", "memory"):
+            raise ValueError(f"unknown engine {engine!r}")
+        if engine in ("native", "python") and not data_dir:
+            raise ValueError(f"engine={engine!r} requires data_dir")
+        if data_dir and engine != "memory":
+            if engine == "python":
+                base: Engine = DurableEngine(data_dir, sync_every_write=sync_every_write)
+            elif engine == "native":
+                from nornicdb_tpu.storage.disk import DiskEngine
+
+                base = DiskEngine(data_dir, sync_every_write=sync_every_write)
+            else:
+                from nornicdb_tpu.storage import make_persistent_engine
+
+                base = make_persistent_engine(data_dir, sync_every_write=sync_every_write)
         else:
             base = MemoryEngine()
         self._base = base

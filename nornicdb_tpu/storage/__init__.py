@@ -21,3 +21,34 @@ from nornicdb_tpu.storage.wal import WAL, ReplayResult  # noqa: F401
 from nornicdb_tpu.storage.wal_engine import DurableEngine, WALEngine  # noqa: F401
 from nornicdb_tpu.storage.async_engine import AsyncEngine, FlushResult  # noqa: F401
 from nornicdb_tpu.storage.namespaced import DEFAULT_DB, NamespacedEngine  # noqa: F401
+
+
+def make_persistent_engine(data_dir: str, sync_every_write: bool = False):
+    """Best persistent base engine available, honoring whatever format is
+    already on disk: a dir with WAL/snapshot files reopens as the
+    pure-Python DurableEngine, a dir with a native kv/ store reopens as
+    the C++ DiskEngine. Fresh dirs prefer native when the toolchain can
+    build it. Open failures of an EXISTING store propagate — corruption
+    must not silently masquerade as an empty database."""
+    import glob
+    import os
+
+    has_python_format = bool(
+        glob.glob(os.path.join(data_dir, "wal-*.log"))
+        or glob.glob(os.path.join(data_dir, "snapshot-*.bin"))
+    )
+    has_native_format = os.path.isdir(os.path.join(data_dir, "kv"))
+    if has_python_format and not has_native_format:
+        return DurableEngine(data_dir, sync_every_write=sync_every_write)
+    if has_native_format:
+        from nornicdb_tpu.storage.disk import DiskEngine
+
+        return DiskEngine(data_dir, sync_every_write=sync_every_write)
+    # fresh directory: pick native if buildable, else pure Python
+    try:
+        from nornicdb_tpu.storage.disk import DiskEngine, native_available
+    except ImportError:
+        return DurableEngine(data_dir, sync_every_write=sync_every_write)
+    if native_available():
+        return DiskEngine(data_dir, sync_every_write=sync_every_write)
+    return DurableEngine(data_dir, sync_every_write=sync_every_write)

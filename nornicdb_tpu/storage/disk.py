@@ -1,0 +1,420 @@
+"""Native persistent storage engine over the nornickv C++ KV store.
+
+TPU-native equivalent of the reference's BadgerEngine (reference:
+pkg/storage/badger.go:70; key-space layout mirrors badger_nodes.go /
+badger_edges.go / badger_queries.go): node/edge records plus secondary
+key spaces for label, edge-type, and adjacency lookups, all inside one
+log-structured store (native/nornickv.cpp, loaded via ctypes — no
+pybind11 in this image). Values are msgpack.
+
+Key spaces:
+  ``n:<id>``                     node record
+  ``e:<id>``                     edge record
+  ``l:<label>\\x00<id>``          label index (empty value)
+  ``t:<type>\\x00<id>``           edge-type index
+  ``a:<node>\\x00o\\x00<edge>``    outgoing adjacency
+  ``a:<node>\\x00i\\x00<edge>``    incoming adjacency
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import msgpack
+
+from nornicdb_tpu.storage.types import Direction, Edge, EdgeID, Engine, Node, NodeID, now_ms
+
+_SEP = b"\x00"
+
+
+def _load_lib() -> ctypes.CDLL:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    so = os.path.join(here, "native", "libnornickv.so")
+    if not os.path.exists(so):
+        import sys
+
+        sys.path.insert(0, os.path.join(here, "native"))
+        from build import build  # type: ignore
+
+        so = build()
+    lib = ctypes.CDLL(so)
+    lib.nkv_open.restype = ctypes.c_void_p
+    lib.nkv_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_long]
+    lib.nkv_put.restype = ctypes.c_int
+    lib.nkv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                            ctypes.c_char_p, ctypes.c_int]
+    lib.nkv_get.restype = ctypes.c_int
+    lib.nkv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int)]
+    lib.nkv_has.restype = ctypes.c_int
+    lib.nkv_has.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.nkv_delete.restype = ctypes.c_int
+    lib.nkv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.nkv_count.restype = ctypes.c_long
+    lib.nkv_count.argtypes = [ctypes.c_void_p]
+    lib.nkv_count_prefix.restype = ctypes.c_long
+    lib.nkv_count_prefix.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.nkv_live_bytes.restype = ctypes.c_long
+    lib.nkv_live_bytes.argtypes = [ctypes.c_void_p]
+    lib.nkv_dead_bytes.restype = ctypes.c_long
+    lib.nkv_dead_bytes.argtypes = [ctypes.c_void_p]
+    lib.nkv_repaired.restype = ctypes.c_int
+    lib.nkv_repaired.argtypes = [ctypes.c_void_p]
+    lib.nkv_sync.restype = ctypes.c_int
+    lib.nkv_sync.argtypes = [ctypes.c_void_p]
+    lib.nkv_compact.restype = ctypes.c_int
+    lib.nkv_compact.argtypes = [ctypes.c_void_p]
+    lib.nkv_scan.restype = ctypes.c_void_p
+    lib.nkv_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.nkv_scan_next.restype = ctypes.c_int
+    lib.nkv_scan_next.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+                                  ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int)]
+    lib.nkv_scan_free.argtypes = [ctypes.c_void_p]
+    lib.nkv_free.argtypes = [ctypes.c_void_p]
+    lib.nkv_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            _lib = _load_lib()
+        return _lib
+
+
+def native_available() -> bool:
+    try:
+        get_lib()
+        return True
+    except Exception:
+        return False
+
+
+class DiskKV:
+    """Thin Python handle over one nornickv store directory."""
+
+    def __init__(self, directory: str, sync_every_write: bool = False,
+                 max_segment_bytes: int = 64 * 1024 * 1024):
+        self._lib = get_lib()
+        os.makedirs(directory, exist_ok=True)
+        self._h = self._lib.nkv_open(directory.encode(), 1 if sync_every_write else 0,
+                                     max_segment_bytes)
+        if not self._h:
+            raise IOError(f"nkv_open failed for {directory}")
+        self._closed = False
+
+    def put(self, key: bytes, value: bytes) -> None:
+        if self._lib.nkv_put(self._h, key, len(key), value, len(value)) != 0:
+            raise IOError("nkv_put failed")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        val = ctypes.c_void_p()
+        vlen = ctypes.c_int()
+        rc = self._lib.nkv_get(self._h, key, len(key), ctypes.byref(val), ctypes.byref(vlen))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise IOError("nkv_get failed")
+        try:
+            return ctypes.string_at(val, vlen.value)
+        finally:
+            self._lib.nkv_free(val)
+
+    def has(self, key: bytes) -> bool:
+        return self._lib.nkv_has(self._h, key, len(key)) == 1
+
+    def delete(self, key: bytes) -> bool:
+        rc = self._lib.nkv_delete(self._h, key, len(key))
+        if rc < 0:
+            raise IOError("nkv_delete failed")
+        return rc == 0
+
+    def count(self) -> int:
+        return self._lib.nkv_count(self._h)
+
+    def count_prefix(self, prefix: bytes) -> int:
+        return self._lib.nkv_count_prefix(self._h, prefix, len(prefix))
+
+    def scan(self, prefix: bytes) -> Iterable[Tuple[bytes, bytes]]:
+        it = self._lib.nkv_scan(self._h, prefix, len(prefix))
+        try:
+            while True:
+                k = ctypes.c_void_p()
+                klen = ctypes.c_int()
+                v = ctypes.c_void_p()
+                vlen = ctypes.c_int()
+                rc = self._lib.nkv_scan_next(it, ctypes.byref(k), ctypes.byref(klen),
+                                             ctypes.byref(v), ctypes.byref(vlen))
+                if rc == 1:
+                    return
+                if rc != 0:
+                    raise IOError("nkv_scan_next failed")
+                key = ctypes.string_at(k, klen.value)
+                val = ctypes.string_at(v, vlen.value)
+                self._lib.nkv_free(k)
+                self._lib.nkv_free(v)
+                yield key, val
+        finally:
+            self._lib.nkv_scan_free(it)
+
+    def sync(self) -> None:
+        self._lib.nkv_sync(self._h)
+
+    def compact(self) -> None:
+        if self._lib.nkv_compact(self._h) != 0:
+            raise IOError("nkv_compact failed")
+
+    @property
+    def live_bytes(self) -> int:
+        return self._lib.nkv_live_bytes(self._h)
+
+    @property
+    def dead_bytes(self) -> int:
+        return self._lib.nkv_dead_bytes(self._h)
+
+    @property
+    def repaired(self) -> int:
+        return self._lib.nkv_repaired(self._h)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.nkv_close(self._h)
+
+
+class DiskEngine(Engine):
+    """Engine over DiskKV with Badger-style secondary key spaces.
+
+    Compacts automatically when dead bytes exceed both 64MB and half of
+    live bytes (Badger value-log GC analog).
+    """
+
+    def __init__(self, data_dir: str, sync_every_write: bool = False,
+                 auto_compact: bool = True):
+        self.kv = DiskKV(os.path.join(data_dir, "kv"), sync_every_write=sync_every_write)
+        self.auto_compact = auto_compact
+        self._lock = threading.Lock()  # serializes multi-key mutations
+
+    # -- helpers --------------------------------------------------------
+
+    @staticmethod
+    def _nk(node_id: str) -> bytes:
+        return b"n:" + node_id.encode()
+
+    @staticmethod
+    def _ek(edge_id: str) -> bytes:
+        return b"e:" + edge_id.encode()
+
+    @staticmethod
+    def _lk(label: str, node_id: str) -> bytes:
+        return b"l:" + label.encode() + _SEP + node_id.encode()
+
+    @staticmethod
+    def _tk(edge_type: str, edge_id: str) -> bytes:
+        return b"t:" + edge_type.encode() + _SEP + edge_id.encode()
+
+    @staticmethod
+    def _ak(node_id: str, direction: bytes, edge_id: str) -> bytes:
+        return b"a:" + node_id.encode() + _SEP + direction + _SEP + edge_id.encode()
+
+    def _maybe_compact(self) -> None:
+        if not self.auto_compact:
+            return
+        dead = self.kv.dead_bytes
+        if dead > 64 * 1024 * 1024 and dead > self.kv.live_bytes // 2:
+            self.kv.compact()
+
+    # -- nodes ----------------------------------------------------------
+
+    def create_node(self, node: Node) -> None:
+        with self._lock:
+            key = self._nk(node.id)
+            if self.kv.has(key):
+                raise ValueError(f"node exists: {node.id}")
+            n = node.copy()
+            ts = now_ms()
+            n.created_at = n.created_at or ts
+            n.updated_at = ts
+            self.kv.put(key, msgpack.packb(n.to_dict(), use_bin_type=True))
+            for label in n.labels:
+                self.kv.put(self._lk(label, n.id), b"")
+
+    def get_node(self, node_id: NodeID) -> Node:
+        raw = self.kv.get(self._nk(node_id))
+        if raw is None:
+            raise KeyError(node_id)
+        return Node.from_dict(msgpack.unpackb(raw, raw=False))
+
+    def update_node(self, node: Node) -> None:
+        with self._lock:
+            raw = self.kv.get(self._nk(node.id))
+            if raw is None:
+                raise KeyError(node.id)
+            old = Node.from_dict(msgpack.unpackb(raw, raw=False))
+            n = node.copy()
+            n.created_at = old.created_at
+            n.updated_at = now_ms()
+            for label in set(old.labels) - set(n.labels):
+                self.kv.delete(self._lk(label, n.id))
+            for label in set(n.labels) - set(old.labels):
+                self.kv.put(self._lk(label, n.id), b"")
+            self.kv.put(self._nk(n.id), msgpack.packb(n.to_dict(), use_bin_type=True))
+        self._maybe_compact()
+
+    def delete_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            raw = self.kv.get(self._nk(node_id))
+            if raw is None:
+                raise KeyError(node_id)
+            node = Node.from_dict(msgpack.unpackb(raw, raw=False))
+            for eid in [e.id for e in self._node_edges_locked(node_id, Direction.BOTH)]:
+                self._delete_edge_locked(eid)
+            for label in node.labels:
+                self.kv.delete(self._lk(label, node_id))
+            self.kv.delete(self._nk(node_id))
+        self._maybe_compact()
+
+    def get_nodes_by_label(self, label: str) -> List[Node]:
+        prefix = b"l:" + label.encode() + _SEP
+        ids = [k[len(prefix):].decode() for k, _ in self.kv.scan(prefix)]
+        return [n for n in self.batch_get_nodes(ids) if n is not None]
+
+    def all_nodes(self) -> Iterable[Node]:
+        for _, raw in self.kv.scan(b"n:"):
+            yield Node.from_dict(msgpack.unpackb(raw, raw=False))
+
+    def batch_get_nodes(self, node_ids: Sequence[NodeID]) -> List[Optional[Node]]:
+        out: List[Optional[Node]] = []
+        for nid in node_ids:
+            raw = self.kv.get(self._nk(nid))
+            out.append(None if raw is None else Node.from_dict(msgpack.unpackb(raw, raw=False)))
+        return out
+
+    def has_node(self, node_id: NodeID) -> bool:
+        return self.kv.has(self._nk(node_id))
+
+    # -- edges ----------------------------------------------------------
+
+    def create_edge(self, edge: Edge) -> None:
+        with self._lock:
+            key = self._ek(edge.id)
+            if self.kv.has(key):
+                raise ValueError(f"edge exists: {edge.id}")
+            if not self.kv.has(self._nk(edge.start_node)):
+                raise KeyError(edge.start_node)
+            if not self.kv.has(self._nk(edge.end_node)):
+                raise KeyError(edge.end_node)
+            e = edge.copy()
+            ts = now_ms()
+            e.created_at = e.created_at or ts
+            e.updated_at = ts
+            self.kv.put(key, msgpack.packb(e.to_dict(), use_bin_type=True))
+            self.kv.put(self._tk(e.type, e.id), b"")
+            self.kv.put(self._ak(e.start_node, b"o", e.id), b"")
+            self.kv.put(self._ak(e.end_node, b"i", e.id), b"")
+
+    def get_edge(self, edge_id: EdgeID) -> Edge:
+        raw = self.kv.get(self._ek(edge_id))
+        if raw is None:
+            raise KeyError(edge_id)
+        return Edge.from_dict(msgpack.unpackb(raw, raw=False))
+
+    def update_edge(self, edge: Edge) -> None:
+        with self._lock:
+            raw = self.kv.get(self._ek(edge.id))
+            if raw is None:
+                raise KeyError(edge.id)
+            old = Edge.from_dict(msgpack.unpackb(raw, raw=False))
+            e = edge.copy()
+            e.created_at = old.created_at
+            e.updated_at = now_ms()
+            # endpoints/type are immutable in the reference; enforce the
+            # same semantics as MemoryEngine so engine choice is invisible
+            e.start_node, e.end_node, e.type = old.start_node, old.end_node, old.type
+            self.kv.put(self._ek(e.id), msgpack.packb(e.to_dict(), use_bin_type=True))
+        self._maybe_compact()
+
+    def _delete_edge_locked(self, edge_id: EdgeID) -> None:
+        raw = self.kv.get(self._ek(edge_id))
+        if raw is None:
+            raise KeyError(edge_id)
+        edge = Edge.from_dict(msgpack.unpackb(raw, raw=False))
+        self.kv.delete(self._tk(edge.type, edge_id))
+        self.kv.delete(self._ak(edge.start_node, b"o", edge_id))
+        self.kv.delete(self._ak(edge.end_node, b"i", edge_id))
+        self.kv.delete(self._ek(edge_id))
+
+    def delete_edge(self, edge_id: EdgeID) -> None:
+        with self._lock:
+            self._delete_edge_locked(edge_id)
+        self._maybe_compact()
+
+    def get_edges_by_type(self, edge_type: str) -> List[Edge]:
+        prefix = b"t:" + edge_type.encode() + _SEP
+        out = []
+        for k, _ in self.kv.scan(prefix):
+            raw = self.kv.get(self._ek(k[len(prefix):].decode()))
+            if raw is not None:
+                out.append(Edge.from_dict(msgpack.unpackb(raw, raw=False)))
+        return out
+
+    def all_edges(self) -> Iterable[Edge]:
+        for _, raw in self.kv.scan(b"e:"):
+            yield Edge.from_dict(msgpack.unpackb(raw, raw=False))
+
+    def _node_edges_locked(self, node_id: NodeID, direction: str) -> List[Edge]:
+        dirs = []
+        if direction in (Direction.OUTGOING, Direction.BOTH):
+            dirs.append(b"o")
+        if direction in (Direction.INCOMING, Direction.BOTH):
+            dirs.append(b"i")
+        out: List[Edge] = []
+        seen = set()
+        for d in dirs:
+            prefix = b"a:" + node_id.encode() + _SEP + d + _SEP
+            for k, _ in self.kv.scan(prefix):
+                eid = k[len(prefix):].decode()
+                if eid in seen:
+                    continue
+                seen.add(eid)
+                raw = self.kv.get(self._ek(eid))
+                if raw is not None:
+                    out.append(Edge.from_dict(msgpack.unpackb(raw, raw=False)))
+        return out
+
+    def get_node_edges(self, node_id: NodeID, direction: str = Direction.BOTH) -> List[Edge]:
+        return self._node_edges_locked(node_id, direction)
+
+    def has_edge(self, edge_id: EdgeID) -> bool:
+        return self.kv.has(self._ek(edge_id))
+
+    # -- counts / maintenance -------------------------------------------
+
+    def count_nodes(self) -> int:
+        return self.kv.count_prefix(b"n:")
+
+    def count_edges(self) -> int:
+        return self.kv.count_prefix(b"e:")
+
+    def compact(self) -> None:
+        self.kv.compact()
+
+    @property
+    def repaired(self) -> int:
+        """Torn-tail truncations performed during open (crash recovery)."""
+        return self.kv.repaired
+
+    def flush(self) -> None:
+        self.kv.sync()
+
+    def close(self) -> None:
+        self.kv.close()

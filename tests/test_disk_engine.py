@@ -1,0 +1,284 @@
+"""Native C++ DiskEngine (nornickv) — engine contract, durability,
+crash/torn-tail recovery, compaction. Mirrors the reference's Badger
+engine tests (pkg/storage/badger_*_test.go) plus WAL corruption repair
+(wal_corruption_test.go)."""
+
+import glob
+import os
+import threading
+
+import pytest
+
+from nornicdb_tpu.storage import NamespacedEngine
+from nornicdb_tpu.storage.disk import DiskEngine, DiskKV, native_available
+from nornicdb_tpu.storage.types import Direction, Edge, Node
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+def mknode(nid, labels=None, **props):
+    return Node(id=nid, labels=labels or ["Memory"], properties=props)
+
+
+class TestDiskKV:
+    def test_put_get_delete(self, tmp_path):
+        kv = DiskKV(str(tmp_path / "kv"))
+        kv.put(b"a", b"1")
+        kv.put(b"b", b"2")
+        assert kv.get(b"a") == b"1"
+        assert kv.get(b"missing") is None
+        assert kv.count() == 2
+        assert kv.delete(b"a") is True
+        assert kv.delete(b"a") is False
+        assert kv.get(b"a") is None
+        kv.close()
+
+    def test_overwrite_and_scan_prefix(self, tmp_path):
+        kv = DiskKV(str(tmp_path / "kv"))
+        kv.put(b"n:1", b"x")
+        kv.put(b"n:1", b"y")
+        kv.put(b"n:2", b"z")
+        kv.put(b"e:1", b"w")
+        assert kv.get(b"n:1") == b"y"
+        assert dict(kv.scan(b"n:")) == {b"n:1": b"y", b"n:2": b"z"}
+        assert kv.count_prefix(b"n:") == 2
+        kv.close()
+
+    def test_restart_rebuilds_index(self, tmp_path):
+        kv = DiskKV(str(tmp_path / "kv"))
+        for i in range(100):
+            kv.put(f"k{i}".encode(), f"v{i}".encode())
+        kv.delete(b"k50")
+        kv.close()
+        kv2 = DiskKV(str(tmp_path / "kv"))
+        assert kv2.count() == 99
+        assert kv2.get(b"k7") == b"v7"
+        assert kv2.get(b"k50") is None
+        kv2.close()
+
+    def test_torn_tail_repair(self, tmp_path):
+        kv = DiskKV(str(tmp_path / "kv"))
+        kv.put(b"good", b"value")
+        kv.close()
+        [seg] = glob.glob(str(tmp_path / "kv" / "kv-*.log"))
+        with open(seg, "ab") as f:
+            f.write(b"\xde\xad\xbe\xef garbage torn record")
+        kv2 = DiskKV(str(tmp_path / "kv"))
+        assert kv2.repaired == 1
+        assert kv2.get(b"good") == b"value"
+        # store still writable after repair
+        kv2.put(b"after", b"repair")
+        kv2.close()
+        kv3 = DiskKV(str(tmp_path / "kv"))
+        assert kv3.get(b"after") == b"repair"
+        kv3.close()
+
+    def test_compaction_reclaims_dead_bytes(self, tmp_path):
+        kv = DiskKV(str(tmp_path / "kv"))
+        for i in range(50):
+            kv.put(b"hot", b"x" * 1000)  # 49 dead versions
+        dead_before = kv.dead_bytes
+        assert dead_before > 0
+        kv.compact()
+        assert kv.dead_bytes == 0
+        assert kv.get(b"hot") == b"x" * 1000
+        kv.close()
+        kv2 = DiskKV(str(tmp_path / "kv"))
+        assert kv2.get(b"hot") == b"x" * 1000
+        assert kv2.count() == 1
+        kv2.close()
+
+    def test_segment_rotation(self, tmp_path):
+        kv = DiskKV(str(tmp_path / "kv"), max_segment_bytes=4096)
+        for i in range(100):
+            kv.put(f"k{i}".encode(), b"v" * 200)
+        kv.close()
+        segs = glob.glob(str(tmp_path / "kv" / "kv-*.log"))
+        assert len(segs) > 1
+        kv2 = DiskKV(str(tmp_path / "kv"))
+        assert kv2.count() == 100
+        kv2.close()
+
+    def test_concurrent_writers(self, tmp_path):
+        kv = DiskKV(str(tmp_path / "kv"))
+        errors = []
+
+        def work(base):
+            try:
+                for i in range(200):
+                    kv.put(f"t{base}:{i}".encode(), b"v")
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,)) for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert kv.count() == 1600
+        kv.close()
+
+
+class TestDiskEngine:
+    def test_node_crud_and_label_index(self, tmp_path):
+        eng = DiskEngine(str(tmp_path))
+        eng.create_node(mknode("a", labels=["Person"], name="Ada"))
+        with pytest.raises(ValueError):
+            eng.create_node(mknode("a"))
+        n = eng.get_node("a")
+        assert n.properties["name"] == "Ada"
+        assert n.created_at > 0
+        n.labels = ["Robot"]
+        eng.update_node(n)
+        assert [x.id for x in eng.get_nodes_by_label("Robot")] == ["a"]
+        assert eng.get_nodes_by_label("Person") == []
+        eng.delete_node("a")
+        with pytest.raises(KeyError):
+            eng.get_node("a")
+        eng.close()
+
+    def test_edges_adjacency_and_cascade(self, tmp_path):
+        eng = DiskEngine(str(tmp_path))
+        eng.create_node(mknode("a"))
+        eng.create_node(mknode("b"))
+        with pytest.raises(KeyError):
+            eng.create_edge(Edge(id="x", type="KNOWS", start_node="a", end_node="ghost"))
+        eng.create_edge(Edge(id="e1", type="KNOWS", start_node="a", end_node="b"))
+        assert eng.degree("a", Direction.OUTGOING) == 1
+        assert eng.degree("b", Direction.INCOMING) == 1
+        assert [e.id for e in eng.get_edges_by_type("KNOWS")] == ["e1"]
+        assert eng.neighbors("a") == ["b"]
+        eng.delete_node("b")  # cascades e1
+        assert eng.count_edges() == 0
+        assert eng.degree("a") == 0
+        eng.close()
+
+    def test_self_loop_counted_once(self, tmp_path):
+        eng = DiskEngine(str(tmp_path))
+        eng.create_node(mknode("a"))
+        eng.create_edge(Edge(id="s", type="SELF", start_node="a", end_node="a"))
+        assert len(eng.get_node_edges("a", Direction.BOTH)) == 1
+        eng.close()
+
+    def test_survives_restart_with_embedding(self, tmp_path):
+        eng = DiskEngine(str(tmp_path))
+        eng.create_node(
+            Node(id="v", labels=["Doc"], properties={"content": "hi"},
+                 embedding=[0.1, 0.2, 0.3], chunk_embeddings=[[0.1] * 3, [0.2] * 3])
+        )
+        eng.create_node(mknode("w"))
+        eng.create_edge(Edge(id="e", type="REL", start_node="v", end_node="w"))
+        eng.close()
+        eng2 = DiskEngine(str(tmp_path))
+        n = eng2.get_node("v")
+        assert n.embedding == pytest.approx([0.1, 0.2, 0.3])
+        assert len(n.chunk_embeddings) == 2
+        assert eng2.get_edge("e").type == "REL"
+        assert eng2.count_nodes() == 2 and eng2.count_edges() == 1
+        # secondary indexes rebuilt from the log as well
+        assert [x.id for x in eng2.get_nodes_by_label("Doc")] == ["v"]
+        assert eng2.degree("v", Direction.OUTGOING) == 1
+        eng2.close()
+
+    def test_edge_endpoints_and_type_immutable(self, tmp_path):
+        # parity with MemoryEngine: endpoints/type pinned on update
+        eng = DiskEngine(str(tmp_path))
+        for nid in ("a", "b", "c"):
+            eng.create_node(mknode(nid))
+        eng.create_edge(Edge(id="e", type="OLD", start_node="a", end_node="b"))
+        e = eng.get_edge("e")
+        e.type = "NEW"
+        e.start_node = "c"
+        e.properties["w"] = 1
+        eng.update_edge(e)
+        got = eng.get_edge("e")
+        assert got.type == "OLD" and got.start_node == "a"
+        assert got.properties["w"] == 1
+        assert [x.id for x in eng.get_edges_by_type("OLD")] == ["e"]
+        assert eng.degree("a", Direction.OUTGOING) == 1
+        assert eng.degree("c", Direction.OUTGOING) == 0
+        eng.close()
+
+    def test_namespaced_over_disk(self, tmp_path):
+        eng = NamespacedEngine(DiskEngine(str(tmp_path)), "dbA")
+        eng.create_node(mknode("1"))
+        assert eng.get_node("1").id == "1"
+        assert eng.count_nodes() == 1
+        eng.close()
+
+    def test_delete_by_prefix(self, tmp_path):
+        eng = DiskEngine(str(tmp_path))
+        for nid in ("dbA:1", "dbA:2", "dbB:1"):
+            eng.create_node(mknode(nid))
+        eng.create_edge(Edge(id="dbA:e", type="R", start_node="dbA:1", end_node="dbA:2"))
+        nodes, edges = eng.delete_by_prefix("dbA:")
+        assert (nodes, edges) == (2, 1)
+        assert eng.count_nodes() == 1
+        eng.close()
+
+
+class TestFormatDetection:
+    def test_python_format_dir_reopens_as_durable(self, tmp_path):
+        import nornicdb_tpu
+        from nornicdb_tpu.storage import DurableEngine, make_persistent_engine
+
+        db = nornicdb_tpu.open(str(tmp_path), engine="python")
+        db.store("old data", node_id="n1")
+        db.close()
+        eng = make_persistent_engine(str(tmp_path))
+        assert isinstance(eng, DurableEngine)
+        eng.close()
+        db2 = nornicdb_tpu.open(str(tmp_path))  # auto must see old data
+        assert db2.storage.get_node("n1").properties["content"] == "old data"
+        db2.close()
+
+    def test_native_format_dir_reopens_as_disk(self, tmp_path):
+        from nornicdb_tpu.storage import make_persistent_engine
+
+        eng = make_persistent_engine(str(tmp_path))
+        assert isinstance(eng, DiskEngine)
+        eng.create_node(mknode("x"))
+        eng.close()
+        eng2 = make_persistent_engine(str(tmp_path))
+        assert isinstance(eng2, DiskEngine)
+        assert eng2.has_node("x")
+        eng2.close()
+
+    def test_live_bytes_stable_across_restart(self, tmp_path):
+        # regression: replayed put-over-put must not inflate live_bytes
+        kv = DiskKV(str(tmp_path / "kv"))
+        for _ in range(10):
+            kv.put(b"hot", b"x" * 1000)
+        live_before, dead_before = kv.live_bytes, kv.dead_bytes
+        kv.close()
+        kv2 = DiskKV(str(tmp_path / "kv"))
+        assert kv2.live_bytes == live_before
+        assert kv2.dead_bytes == dead_before
+        kv2.close()
+
+
+class TestDBWithNativeEngine:
+    def test_engine_arg_validation(self, tmp_path):
+        import nornicdb_tpu
+
+        with pytest.raises(ValueError):
+            nornicdb_tpu.open(engine="native")  # no data_dir
+        with pytest.raises(ValueError):
+            nornicdb_tpu.open(str(tmp_path), engine="ntaive")
+
+
+    def test_db_open_uses_native(self, tmp_path):
+        import nornicdb_tpu
+        from nornicdb_tpu.storage.disk import DiskEngine as DE
+
+        db = nornicdb_tpu.open(str(tmp_path / "data"), engine="native")
+        assert isinstance(db._base, DE)
+        db.store("hello native", node_id="n1")
+        db.link("n1", "n1", "SELF")
+        db.close()
+        db2 = nornicdb_tpu.open(str(tmp_path / "data"), engine="native")
+        assert db2.storage.get_node("n1").properties["content"] == "hello native"
+        db2.close()
