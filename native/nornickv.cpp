@@ -192,7 +192,12 @@ int append_locked(Store* s, uint8_t op, const char* k, uint32_t klen,
   memcpy(rec.data() + sizeof hdr, k, klen);
   if (vlen) memcpy(rec.data() + sizeof hdr + klen, v, vlen);
   memcpy(rec.data() + sizeof hdr + klen + vlen, &crc, 4);
-  if (!write_all(s->active_fd, rec.data(), rec.size())) return -1;
+  if (!write_all(s->active_fd, rec.data(), rec.size())) {
+    // a partial write (ENOSPC etc.) must not desync active_off from real
+    // EOF: roll the file back to the last good record boundary
+    ::ftruncate(s->active_fd, (off_t)s->active_off);
+    return -1;
+  }
   uint64_t off = s->active_off;
   s->active_off += rec.size();
   if (s->sync_every_write) ::fsync(s->active_fd);

@@ -1,0 +1,103 @@
+"""Generic LRU+TTL cache with write-generation invalidation.
+
+Reference: pkg/cache/query_cache.go (LRU+TTL query result cache) and its
+use by the Cypher read-cache probe (pkg/cypher/executor.go:634) with
+invalidation on writes (cache_policy.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Generic, Hashable, Optional, Tuple, TypeVar
+
+V = TypeVar("V")
+
+
+class LRUCache(Generic[V]):
+    """Thread-safe LRU with per-entry TTL and hit/miss stats."""
+
+    def __init__(self, max_size: int = 1024, ttl_seconds: float = 0.0):
+        self.max_size = max(1, max_size)
+        self.ttl = ttl_seconds
+        self._data: "OrderedDict[Hashable, Tuple[V, float]]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: Hashable, default: Optional[V] = None) -> Optional[V]:
+        now = time.monotonic()
+        with self._lock:
+            item = self._data.get(key)
+            if item is None:
+                self.misses += 1
+                return default
+            value, expires = item
+            if expires and now > expires:
+                del self._data[key]
+                self.misses += 1
+                return default
+            self._data.move_to_end(key)
+            self.hits += 1
+            return value
+
+    def put(self, key: Hashable, value: V, ttl_seconds: Optional[float] = None) -> None:
+        ttl = self.ttl if ttl_seconds is None else ttl_seconds
+        expires = time.monotonic() + ttl if ttl else 0.0
+        with self._lock:
+            self._data[key] = (value, expires)
+            self._data.move_to_end(key)
+            while len(self._data) > self.max_size:
+                self._data.popitem(last=False)
+
+    def delete(self, key: Hashable) -> bool:
+        with self._lock:
+            return self._data.pop(key, None) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "size": len(self._data), "max_size": self.max_size,
+                "hits": self.hits, "misses": self.misses,
+                "hit_rate": self.hits / total if total else 0.0,
+            }
+
+    def get_or_compute(self, key: Hashable, compute: Callable[[], V],
+                       ttl_seconds: Optional[float] = None) -> V:
+        sentinel = object()
+        v = self.get(key, sentinel)  # type: ignore[arg-type]
+        if v is not sentinel:
+            return v  # type: ignore[return-value]
+        value = compute()
+        self.put(key, value, ttl_seconds)
+        return value
+
+
+class GenerationalCache(LRUCache[V]):
+    """LRU+TTL cache whose entries are invalidated wholesale by bumping a
+    write generation — the Cypher read-cache policy (reference:
+    cache_policy.go: any write invalidates cached read results)."""
+
+    def __init__(self, max_size: int = 1024, ttl_seconds: float = 0.0):
+        super().__init__(max_size, ttl_seconds)
+        self._generation = 0
+
+    def bump_generation(self) -> None:
+        with self._lock:
+            self._generation += 1
+            self._data.clear()
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation

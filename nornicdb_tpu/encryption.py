@@ -1,0 +1,125 @@
+"""At-rest and field-level encryption.
+
+Reference: pkg/encryption — AES-256-GCM with PBKDF2-derived keys
+(600k iterations, random salt persisted beside the data dir; key
+derivation wired at pkg/nornicdb/db.go:776-805) plus field-level
+property encryption (db_privacy.go). Uses the baked-in ``cryptography``
+package's AESGCM primitive.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import secrets
+from typing import Any, Dict, Iterable, Optional
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+PBKDF2_ITERS = 600_000
+SALT_FILE = "encryption.salt"
+_PREFIX = "enc:v1:"
+
+
+class EncryptionError(Exception):
+    pass
+
+
+def derive_key(passphrase: str, salt: bytes,
+               iterations: int = PBKDF2_ITERS) -> bytes:
+    """PBKDF2-SHA256 -> 32-byte AES-256 key (reference: DeriveKey used at
+    db.go:800)."""
+    return hashlib.pbkdf2_hmac("sha256", passphrase.encode(), salt, iterations, 32)
+
+
+def load_or_create_salt(data_dir: str) -> bytes:
+    """Salt file persisted beside the store (reference: salt file in the
+    data dir)."""
+    os.makedirs(data_dir, exist_ok=True)
+    path = os.path.join(data_dir, SALT_FILE)
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            salt = f.read()
+        if len(salt) != 16:
+            raise EncryptionError(f"corrupt salt file: {path}")
+        return salt
+    salt = secrets.token_bytes(16)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(salt)
+    os.replace(tmp, path)
+    return salt
+
+
+class Encryptor:
+    """AES-256-GCM encrypt/decrypt for byte payloads and node property
+    fields."""
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise EncryptionError("key must be 32 bytes (AES-256)")
+        self._aead = AESGCM(key)
+
+    @classmethod
+    def from_passphrase(cls, passphrase: str, data_dir: str,
+                        iterations: int = PBKDF2_ITERS) -> "Encryptor":
+        salt = load_or_create_salt(data_dir)
+        return cls(derive_key(passphrase, salt, iterations))
+
+    # -- bytes -----------------------------------------------------------
+
+    def encrypt(self, plaintext: bytes, aad: bytes = b"") -> bytes:
+        nonce = secrets.token_bytes(12)
+        return nonce + self._aead.encrypt(nonce, plaintext, aad or None)
+
+    def decrypt(self, blob: bytes, aad: bytes = b"") -> bytes:
+        if len(blob) < 13:
+            raise EncryptionError("ciphertext too short")
+        try:
+            return self._aead.decrypt(blob[:12], blob[12:], aad or None)
+        except Exception as e:
+            raise EncryptionError("decryption failed") from e
+
+    # -- field-level (reference: db_privacy.go encryptProperties) --------
+
+    def encrypt_field(self, value: str) -> str:
+        blob = self.encrypt(value.encode())
+        return _PREFIX + base64.b64encode(blob).decode()
+
+    def decrypt_field(self, value: str) -> str:
+        if not value.startswith(_PREFIX):
+            return value
+        return self.decrypt(base64.b64decode(value[len(_PREFIX):])).decode()
+
+    @staticmethod
+    def is_encrypted_field(value: Any) -> bool:
+        return isinstance(value, str) and value.startswith(_PREFIX)
+
+    def encrypt_properties(self, props: Dict[str, Any],
+                           fields: Iterable[str]) -> Dict[str, Any]:
+        """Encrypt the named string fields in-place-style (returns a new
+        dict); non-string/missing fields pass through untouched."""
+        out = dict(props)
+        for f in fields:
+            v = out.get(f)
+            if isinstance(v, str) and not self.is_encrypted_field(v):
+                out[f] = self.encrypt_field(v)
+        return out
+
+    def decrypt_properties(self, props: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(props)
+        for k, v in out.items():
+            if self.is_encrypted_field(v):
+                try:
+                    out[k] = self.decrypt_field(v)
+                except EncryptionError:
+                    pass  # wrong key: leave ciphertext visible, don't crash
+        return out
+
+
+def make_encryptor(passphrase: Optional[str], data_dir: str,
+                   iterations: int = PBKDF2_ITERS) -> Optional[Encryptor]:
+    if not passphrase:
+        return None
+    return Encryptor.from_passphrase(passphrase, data_dir, iterations)

@@ -1,0 +1,180 @@
+"""Multi-database manager over one shared base engine.
+
+Reference: pkg/multidb/manager.go:43 ``DatabaseManager`` with
+CreateDatabase/DropDatabase/GetStorage (manager.go:300,339,388), per-DB
+limits & enforcement (limits.go, enforcement.go), routing (routing.go).
+Databases share one physical store via NamespacedEngine prefixes
+(``dbname:``), so create/drop are metadata ops plus a prefix sweep.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.storage import Engine, ListenableEngine, NamespacedEngine
+
+SYSTEM_DB = "system"
+DEFAULT_DB = "neo4j"
+
+_NAME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9_.-]{0,62}$")
+
+
+class DatabaseError(ValueError):
+    pass
+
+
+class DatabaseLimitExceeded(DatabaseError):
+    """Reference: pkg/multidb/limits.go enforcement."""
+
+
+@dataclass
+class DatabaseLimits:
+    """Per-database quotas (reference: limits.go). 0 = unlimited."""
+
+    max_nodes: int = 0
+    max_edges: int = 0
+
+
+@dataclass
+class DatabaseInfo:
+    name: str
+    status: str = "online"  # online | offline
+    default: bool = False
+    system: bool = False
+    limits: DatabaseLimits = field(default_factory=DatabaseLimits)
+
+
+class LimitedEngine(NamespacedEngine):
+    """NamespacedEngine that enforces per-DB node/edge quotas on create
+    (reference: pkg/multidb/enforcement.go)."""
+
+    def __init__(self, inner: Engine, database: str, limits: DatabaseLimits):
+        super().__init__(inner, database)
+        self._limits = limits
+
+    def create_node(self, node):
+        if self._limits.max_nodes and self.count_nodes() >= self._limits.max_nodes:
+            raise DatabaseLimitExceeded(
+                f"database node limit {self._limits.max_nodes} reached")
+        super().create_node(node)
+
+    def create_edge(self, edge):
+        if self._limits.max_edges and self.count_edges() >= self._limits.max_edges:
+            raise DatabaseLimitExceeded(
+                f"database edge limit {self._limits.max_edges} reached")
+        super().create_edge(edge)
+
+
+class DatabaseManager:
+    """Create/drop/list logical databases over one base engine."""
+
+    def __init__(self, base: Engine, default_database: str = DEFAULT_DB,
+                 max_databases: int = 64):
+        self._base = base
+        self._max = max_databases
+        self._lock = threading.Lock()
+        self._dbs: Dict[str, DatabaseInfo] = {}
+        self._engines: Dict[str, ListenableEngine] = {}
+        self._dbs[SYSTEM_DB] = DatabaseInfo(name=SYSTEM_DB, system=True)
+        self._dbs[default_database] = DatabaseInfo(name=default_database, default=True)
+        # adopt pre-existing namespaces found in the store (restart path)
+        for ns in base.list_namespaces():
+            if ns not in self._dbs and _NAME_RE.match(ns):
+                self._dbs[ns] = DatabaseInfo(name=ns)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def create_database(self, name: str, limits: Optional[DatabaseLimits] = None,
+                        if_not_exists: bool = False) -> DatabaseInfo:
+        with self._lock:
+            if not _NAME_RE.match(name):
+                raise DatabaseError(f"invalid database name: {name!r}")
+            if name in self._dbs:
+                if self._dbs[name].status == "dropping":
+                    raise DatabaseError(f"database being dropped: {name}")
+                if if_not_exists:
+                    return self._dbs[name]
+                raise DatabaseError(f"database exists: {name}")
+            user_dbs = sum(1 for d in self._dbs.values() if not d.system)
+            if self._max and user_dbs >= self._max:
+                raise DatabaseLimitExceeded(f"max databases ({self._max}) reached")
+            info = DatabaseInfo(name=name, limits=limits or DatabaseLimits())
+            self._dbs[name] = info
+            return info
+
+    def drop_database(self, name: str, if_exists: bool = False) -> bool:
+        with self._lock:
+            info = self._dbs.get(name)
+            if info is None:
+                if if_exists:
+                    return False
+                raise NotFoundError(f"database not found: {name}")
+            if info.system:
+                raise DatabaseError("cannot drop system database")
+            if info.default:
+                raise DatabaseError("cannot drop default database")
+            if info.status == "dropping":
+                raise DatabaseError(f"database already being dropped: {name}")
+            # keep the entry as a tombstone until the sweep finishes so a
+            # concurrent create_database(name) can't race the deletion
+            info.status = "dropping"
+            self._engines.pop(name, None)
+        try:
+            # prefix sweep outside the lock — can be large
+            self._base.delete_by_prefix(name + ":")
+        finally:
+            with self._lock:
+                self._dbs.pop(name, None)
+        return True
+
+    def list_databases(self) -> List[DatabaseInfo]:
+        with self._lock:
+            return sorted(self._dbs.values(), key=lambda d: d.name)
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._dbs
+
+    def get_info(self, name: str) -> DatabaseInfo:
+        with self._lock:
+            info = self._dbs.get(name)
+        if info is None:
+            raise NotFoundError(f"database not found: {name}")
+        return info
+
+    def set_status(self, name: str, status: str) -> None:
+        if status not in ("online", "offline"):
+            raise DatabaseError(f"invalid status: {status}")
+        info = self.get_info(name)
+        info.status = status
+
+    def set_limits(self, name: str, limits: DatabaseLimits) -> None:
+        info = self.get_info(name)
+        with self._lock:
+            info.limits = limits
+            self._engines.pop(name, None)  # rebuild with new limits
+
+    # -- routing (reference: routing.go) ---------------------------------
+
+    def get_storage(self, name: str) -> ListenableEngine:
+        """Namespaced, limit-enforcing, listenable view of one database
+        (reference: manager.go:388 GetStorage)."""
+        with self._lock:
+            info = self._dbs.get(name)
+            if info is None:
+                raise NotFoundError(f"database not found: {name}")
+            if info.status != "online":
+                raise DatabaseError(f"database offline: {name}")
+            eng = self._engines.get(name)
+            if eng is None:
+                eng = ListenableEngine(LimitedEngine(self._base, name, info.limits))
+                self._engines[name] = eng
+            return eng
+
+    def counts(self, name: str) -> Dict[str, int]:
+        eng = self.get_storage(name)
+        return {"nodes": eng.count_nodes(), "edges": eng.count_edges()}

@@ -1,0 +1,129 @@
+"""Data retention policies.
+
+Reference: pkg/retention/retention.go — label/age-based retention rules
+swept periodically; nodes past their window are archived or deleted.
+Also carries the GDPR delete/export helpers the HTTP admin surface uses
+(reference: pkg/server GDPR export/delete routes).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from nornicdb_tpu.storage.types import Engine, Node, now_ms
+
+
+@dataclass
+class RetentionPolicy:
+    """Delete-or-archive rule for one label (empty label = all nodes)."""
+
+    name: str
+    max_age_days: float
+    label: str = ""
+    action: str = "archive"  # archive | delete
+    property_filter: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SweepResult:
+    scanned: int = 0
+    archived: int = 0
+    deleted: int = 0
+
+
+class RetentionManager:
+    def __init__(self, storage: Engine):
+        self.storage = storage
+        self._policies: Dict[str, RetentionPolicy] = {}
+        self._lock = threading.Lock()
+
+    def add_policy(self, policy: RetentionPolicy) -> None:
+        if policy.action not in ("archive", "delete"):
+            raise ValueError(f"invalid action: {policy.action}")
+        with self._lock:
+            self._policies[policy.name] = policy
+
+    def remove_policy(self, name: str) -> bool:
+        with self._lock:
+            return self._policies.pop(name, None) is not None
+
+    def policies(self) -> List[RetentionPolicy]:
+        with self._lock:
+            return list(self._policies.values())
+
+    def _matches(self, policy: RetentionPolicy, node: Node, now: int) -> bool:
+        if policy.label and policy.label not in node.labels:
+            return False
+        for k, v in policy.property_filter.items():
+            if node.properties.get(k) != v:
+                return False
+        ts = node.updated_at or node.created_at
+        if not ts:
+            return False
+        return (now - ts) > policy.max_age_days * 86_400_000
+
+    def sweep(self, now: Optional[int] = None) -> SweepResult:
+        now = now if now is not None else now_ms()
+        res = SweepResult()
+        with self._lock:
+            policies = list(self._policies.values())
+        if not policies:
+            return res
+        for node in list(self.storage.all_nodes()):
+            res.scanned += 1
+            for p in policies:
+                if not self._matches(p, node, now):
+                    continue
+                if p.action == "delete":
+                    try:
+                        self.storage.delete_node(node.id)
+                        res.deleted += 1
+                    except KeyError:
+                        pass
+                elif not node.properties.get("_archived"):
+                    node.properties["_archived"] = True
+                    node.properties["_archived_at"] = now
+                    try:
+                        self.storage.update_node(node)
+                        res.archived += 1
+                    except KeyError:
+                        pass
+                break  # first matching policy wins
+        return res
+
+
+# -- GDPR helpers (reference: pkg/server GDPR export/delete) ----------------
+
+
+def gdpr_export(storage: Engine, match_property: str, match_value: Any) -> Dict[str, Any]:
+    """Export every node (and its edges) whose property matches — the
+    data-subject access request path."""
+    nodes = [n for n in storage.all_nodes()
+             if n.properties.get(match_property) == match_value]
+    ids = {n.id for n in nodes}
+    edges = [e for e in storage.all_edges()
+             if e.start_node in ids or e.end_node in ids]
+    return {
+        "exported_at_ms": int(time.time() * 1000),
+        "match": {match_property: match_value},
+        "nodes": [n.to_dict() for n in nodes],
+        "edges": [e.to_dict() for e in edges],
+    }
+
+
+def gdpr_delete(storage: Engine, match_property: str, match_value: Any) -> int:
+    """Hard-delete all matching nodes (edges cascade). Returns count."""
+    ids = [n.id for n in storage.all_nodes()
+           if n.properties.get(match_property) == match_value]
+    deleted = 0
+    for nid in ids:
+        try:
+            storage.delete_node(nid)
+            deleted += 1
+        except KeyError:
+            pass
+    return deleted

@@ -21,6 +21,14 @@ from nornicdb_tpu.storage.wal import WAL, ReplayResult  # noqa: F401
 from nornicdb_tpu.storage.wal_engine import DurableEngine, WALEngine  # noqa: F401
 from nornicdb_tpu.storage.async_engine import AsyncEngine, FlushResult  # noqa: F401
 from nornicdb_tpu.storage.namespaced import DEFAULT_DB, NamespacedEngine  # noqa: F401
+from nornicdb_tpu.storage.schema import (  # noqa: F401
+    ConstrainedEngine,
+    Constraint,
+    ConstraintViolation,
+    Receipt,
+    ReceiptLedger,
+    SchemaManager,
+)
 
 
 def make_persistent_engine(data_dir: str, sync_every_write: bool = False):
@@ -38,7 +46,13 @@ def make_persistent_engine(data_dir: str, sync_every_write: bool = False):
         or glob.glob(os.path.join(data_dir, "snapshot-*.bin"))
     )
     has_native_format = os.path.isdir(os.path.join(data_dir, "kv"))
-    if has_python_format and not has_native_format:
+    if has_python_format and has_native_format:
+        raise RuntimeError(
+            f"{data_dir} holds BOTH pure-Python (wal-*/snapshot-*) and "
+            "native (kv/) stores; refusing to guess — open explicitly with "
+            "engine='python' or engine='native'"
+        )
+    if has_python_format:
         return DurableEngine(data_dir, sync_every_write=sync_every_write)
     if has_native_format:
         from nornicdb_tpu.storage.disk import DiskEngine

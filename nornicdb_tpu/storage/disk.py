@@ -200,6 +200,19 @@ class DiskEngine(Engine):
 
     def __init__(self, data_dir: str, sync_every_write: bool = False,
                  auto_compact: bool = True):
+        import glob
+
+        # refuse to create a native store beside pure-Python DurableEngine
+        # data — that would shadow the existing database as empty
+        if not os.path.isdir(os.path.join(data_dir, "kv")) and (
+            glob.glob(os.path.join(data_dir, "wal-*.log"))
+            or glob.glob(os.path.join(data_dir, "snapshot-*.bin"))
+        ):
+            raise ValueError(
+                f"{data_dir} holds pure-Python engine data; open it with "
+                "engine='python' (or migrate) instead of creating a native "
+                "store beside it"
+            )
         self.kv = DiskKV(os.path.join(data_dir, "kv"), sync_every_write=sync_every_write)
         self.auto_compact = auto_compact
         self._lock = threading.Lock()  # serializes multi-key mutations
@@ -404,6 +417,15 @@ class DiskEngine(Engine):
 
     def count_edges(self) -> int:
         return self.kv.count_prefix(b"e:")
+
+    def count_nodes_with_prefix(self, prefix: str) -> int:
+        """O(log n + k) namespaced count via the ordered key index —
+        NamespacedEngine probes for this (namespaced.py) so per-DB counts
+        and quota checks don't scan the store."""
+        return self.kv.count_prefix(b"n:" + prefix.encode())
+
+    def count_edges_with_prefix(self, prefix: str) -> int:
+        return self.kv.count_prefix(b"e:" + prefix.encode())
 
     def compact(self) -> None:
         self.kv.compact()

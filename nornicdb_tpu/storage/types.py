@@ -278,10 +278,20 @@ class Engine(ABC):
 
 
 class EngineDecorator(Engine):
-    """Base for decorator engines: forwards everything to ``inner``."""
+    """Base for decorator engines: forwards everything to ``inner``.
+
+    Optional extension methods (count_nodes_with_prefix, …) are forwarded
+    via __getattr__ so a decorator chain stays transparent to getattr
+    probes (reference: optional extension interfaces like
+    PrefixStatsEngine, types.go:432)."""
 
     def __init__(self, inner: Engine):
         self.inner = inner
+
+    def __getattr__(self, name: str):
+        if name == "inner":  # not yet set during __init__
+            raise AttributeError(name)
+        return getattr(self.inner, name)
 
     def create_node(self, node: Node) -> None:
         self.inner.create_node(node)

@@ -247,6 +247,32 @@ class TestFormatDetection:
         assert eng2.has_node("x")
         eng2.close()
 
+    def test_mixed_format_dir_refused(self, tmp_path):
+        import nornicdb_tpu
+        from nornicdb_tpu.storage import make_persistent_engine
+
+        db = nornicdb_tpu.open(str(tmp_path), engine="python")
+        db.store("old", node_id="n1")
+        db.close()
+        # creating a native store beside python data is refused
+        with pytest.raises(ValueError):
+            DiskEngine(str(tmp_path))
+        # if both formats somehow exist, auto refuses to guess
+        (tmp_path / "kv").mkdir()
+        with pytest.raises(RuntimeError):
+            make_persistent_engine(str(tmp_path))
+
+    def test_prefix_counts_fast_path(self, tmp_path):
+        eng = DiskEngine(str(tmp_path))
+        for nid in ("dbA:1", "dbA:2", "dbB:1"):
+            eng.create_node(mknode(nid))
+        eng.create_edge(Edge(id="dbA:e", type="R", start_node="dbA:1", end_node="dbA:2"))
+        assert eng.count_nodes_with_prefix("dbA:") == 2
+        assert eng.count_edges_with_prefix("dbA:") == 1
+        ns = NamespacedEngine(eng, "dbA")
+        assert ns.count_nodes() == 2 and ns.count_edges() == 1
+        eng.close()
+
     def test_live_bytes_stable_across_restart(self, tmp_path):
         # regression: replayed put-over-put must not inflate live_bytes
         kv = DiskKV(str(tmp_path / "kv"))

@@ -1,0 +1,204 @@
+"""Schema constraints, receipts, and the multi-database manager.
+
+Reference: pkg/storage constraint_validation.go / receipt.go,
+pkg/multidb/manager.go.
+"""
+
+import pytest
+
+from nornicdb_tpu.multidb import (
+    DatabaseError,
+    DatabaseLimitExceeded,
+    DatabaseLimits,
+    DatabaseManager,
+)
+from nornicdb_tpu.storage import (
+    ConstrainedEngine,
+    Constraint,
+    ConstraintViolation,
+    MemoryEngine,
+    ReceiptLedger,
+    SchemaManager,
+)
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+def mknode(nid, labels=None, **props):
+    return Node(id=nid, labels=labels or ["Person"], properties=props)
+
+
+class TestConstraints:
+    def setup_method(self):
+        self.sm = SchemaManager()
+        self.eng = ConstrainedEngine(MemoryEngine(), self.sm)
+
+    def test_unique(self):
+        self.sm.add(Constraint(name="u", kind="unique", label="Person", property="email"))
+        self.eng.create_node(mknode("a", email="x@y.z"))
+        with pytest.raises(ConstraintViolation):
+            self.eng.create_node(mknode("b", email="x@y.z"))
+        self.eng.create_node(mknode("c", email="other@y.z"))
+        # updating a node to keep its own value is fine
+        n = self.eng.get_node("a")
+        n.properties["name"] = "Ada"
+        self.eng.update_node(n)
+        # updating to collide is not
+        n = self.eng.get_node("c")
+        n.properties["email"] = "x@y.z"
+        with pytest.raises(ConstraintViolation):
+            self.eng.update_node(n)
+
+    def test_exists_and_type(self):
+        self.sm.add(Constraint(name="e", kind="exists", label="Person", property="name"))
+        self.sm.add(Constraint(name="t", kind="type", label="Person",
+                               property="age", property_type="int"))
+        with pytest.raises(ConstraintViolation):
+            self.eng.create_node(mknode("a"))
+        self.eng.create_node(mknode("a", name="Ada", age=36))
+        with pytest.raises(ConstraintViolation):
+            self.eng.create_node(mknode("b", name="Bob", age="old"))
+        with pytest.raises(ConstraintViolation):
+            self.eng.create_node(mknode("c", name="Eve", age=True))  # bool != int
+
+    def test_rel_endpoints(self):
+        self.sm.add(Constraint(name="r", kind="rel_endpoints", rel_type="WORKS_AT",
+                               start_label="Person", end_label="Company"))
+        self.eng.create_node(mknode("p", labels=["Person"]))
+        self.eng.create_node(mknode("c", labels=["Company"]))
+        self.eng.create_node(mknode("x", labels=["Robot"]))
+        self.eng.create_edge(Edge(id="ok", type="WORKS_AT", start_node="p", end_node="c"))
+        with pytest.raises(ConstraintViolation):
+            self.eng.create_edge(Edge(id="bad", type="WORKS_AT", start_node="x", end_node="c"))
+        # other types unconstrained
+        self.eng.create_edge(Edge(id="any", type="KNOWS", start_node="x", end_node="p"))
+
+    def test_temporal_interval(self):
+        self.sm.add(Constraint(name="iv", kind="temporal", label="Event",
+                               property="start", property2="end"))
+        self.eng.create_node(mknode("ok", labels=["Event"], start=1, end=5))
+        with pytest.raises(ConstraintViolation):
+            self.eng.create_node(mknode("bad", labels=["Event"], start=9, end=5))
+
+    def test_validate_existing(self):
+        self.eng.create_node(mknode("a", email="dup"))
+        self.eng.create_node(mknode("b", email="dup"))
+        self.sm.add(Constraint(name="u", kind="unique", label="Person", property="email"))
+        problems = self.eng.validate_existing()
+        assert len(problems) == 2  # each node sees the other as duplicate
+
+    def test_persistence(self, tmp_path):
+        path = str(tmp_path / "schema.json")
+        sm = SchemaManager(path)
+        sm.add(Constraint(name="u", kind="unique", label="L", property="p"))
+        sm2 = SchemaManager(path)
+        assert [c.name for c in sm2.list()] == ["u"]
+        sm2.drop("u")
+        assert SchemaManager(path).list() == []
+
+
+class TestReceipts:
+    def test_chain_and_verify(self):
+        ledger = ReceiptLedger()
+        r1 = ledger.record("create_node", "a")
+        r2 = ledger.record("delete_node", "a")
+        assert r2.prev_hash == r1.hash
+        ok, bad = ledger.verify()
+        assert ok and bad == -1
+
+    def test_tamper_detected(self):
+        ledger = ReceiptLedger()
+        for i in range(5):
+            ledger.record("create_node", f"n{i}")
+        ledger.all()  # copies — tamper with internals directly
+        ledger._receipts[2].entity_id = "evil"
+        ok, bad = ledger.verify()
+        assert not ok and bad == 2
+
+
+class TestDatabaseManager:
+    def setup_method(self):
+        self.mgr = DatabaseManager(MemoryEngine())
+
+    def test_defaults_present(self):
+        names = [d.name for d in self.mgr.list_databases()]
+        assert "system" in names and "neo4j" in names
+
+    def test_create_drop(self):
+        self.mgr.create_database("tenant1")
+        eng = self.mgr.get_storage("tenant1")
+        eng.create_node(Node(id="x", labels=["T"]))
+        assert self.mgr.counts("tenant1") == {"nodes": 1, "edges": 0}
+        # isolation from default DB
+        assert self.mgr.get_storage("neo4j").count_nodes() == 0
+        assert self.mgr.drop_database("tenant1") is True
+        with pytest.raises(KeyError):
+            self.mgr.get_storage("tenant1")
+        # data swept from the shared store
+        self.mgr.create_database("tenant1")
+        assert self.mgr.get_storage("tenant1").count_nodes() == 0
+
+    def test_invalid_names_and_duplicates(self):
+        with pytest.raises(DatabaseError):
+            self.mgr.create_database("9starts-with-digit")
+        with pytest.raises(DatabaseError):
+            self.mgr.create_database("neo4j")
+        assert self.mgr.create_database("neo4j", if_not_exists=True).default
+
+    def test_cannot_drop_system_or_default(self):
+        with pytest.raises(DatabaseError):
+            self.mgr.drop_database("system")
+        with pytest.raises(DatabaseError):
+            self.mgr.drop_database("neo4j")
+
+    def test_limits_enforced(self):
+        self.mgr.create_database("small", limits=DatabaseLimits(max_nodes=2, max_edges=1))
+        eng = self.mgr.get_storage("small")
+        eng.create_node(Node(id="1"))
+        eng.create_node(Node(id="2"))
+        with pytest.raises(DatabaseLimitExceeded):
+            eng.create_node(Node(id="3"))
+        eng.create_edge(Edge(id="e1", type="R", start_node="1", end_node="2"))
+        with pytest.raises(DatabaseLimitExceeded):
+            eng.create_edge(Edge(id="e2", type="R", start_node="2", end_node="1"))
+
+    def test_offline_status_blocks_routing(self):
+        self.mgr.create_database("t")
+        self.mgr.set_status("t", "offline")
+        with pytest.raises(DatabaseError):
+            self.mgr.get_storage("t")
+        self.mgr.set_status("t", "online")
+        assert self.mgr.get_storage("t") is not None
+
+    def test_adopts_existing_namespaces_on_restart(self):
+        base = MemoryEngine()
+        mgr = DatabaseManager(base)
+        mgr.create_database("t1")
+        mgr.get_storage("t1").create_node(Node(id="n"))
+        # simulate restart: new manager over same base
+        mgr2 = DatabaseManager(base)
+        assert mgr2.exists("t1")
+        assert mgr2.get_storage("t1").count_nodes() == 1
+
+    def test_unique_index_tracks_mutations(self):
+        from nornicdb_tpu.storage import ConstrainedEngine as CE
+
+        sm = SchemaManager()
+        sm.add(Constraint(name="u", kind="unique", label="Person", property="email"))
+        eng = CE(MemoryEngine(), sm)
+        eng.create_node(mknode("a", email="x@y.z"))
+        # freeing the value by updating lets another node take it
+        n = eng.get_node("a")
+        n.properties["email"] = "new@y.z"
+        eng.update_node(n)
+        eng.create_node(mknode("b", email="x@y.z"))
+        # deleting frees the value too
+        eng.delete_node("b")
+        eng.create_node(mknode("c", email="x@y.z"))
+        with pytest.raises(ConstraintViolation):
+            eng.create_node(mknode("d", email="new@y.z"))
+
+    def test_max_databases(self):
+        mgr = DatabaseManager(MemoryEngine(), max_databases=2)
+        mgr.create_database("a")  # neo4j counts as user db #1
+        with pytest.raises(DatabaseLimitExceeded):
+            mgr.create_database("b")
