@@ -1,0 +1,5 @@
+"""API surfaces: Bolt, HTTP, GraphQL, MCP, gRPC.
+
+Reference: pkg/bolt, pkg/server, pkg/graphql, pkg/mcp, pkg/qdrantgrpc,
+pkg/nornicgrpc — the five protocol surfaces around one DB.
+"""

@@ -1,0 +1,599 @@
+"""HTTP server: Neo4j HTTP API, REST search, admin, metrics, health.
+
+Reference: pkg/server — router (server_router.go:59-314), server.New
+(server.go:921), Neo4j transactional HTTP API (`/db/{name}/tx/commit`),
+REST search/similar/decay/embed endpoints (server_nornicdb.go), auth
+(JWT bearer + basic), Prometheus /metrics (server_public.go:195-216),
+/health + /status, GDPR export/delete, rate limiting, multi-database
+admin. Built on stdlib ThreadingHTTPServer (no flask in this image).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from nornicdb_tpu.audit import ADMIN_ACTION, AUTH, DATA_WRITE, GDPR, AuditLog
+from nornicdb_tpu.auth import ADMIN, READ, WRITE, AuthError, PermissionDenied
+from nornicdb_tpu.storage.txn import TransactionManager
+
+SERVER_NAME = "nornicdb-tpu"
+API_VERSION = "1.0"
+
+
+class _Metrics:
+    """Hand-rolled Prometheus text exposition
+    (reference: server_public.go:195-216)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.started_at = time.time()
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def render(self, extra: Dict[str, float]) -> str:
+        lines = []
+        with self._lock:
+            counters = dict(self.counters)
+        counters["uptime_seconds"] = time.time() - self.started_at
+        counters.update(extra)
+        for name, value in sorted(counters.items()):
+            metric = f"nornicdb_{name}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value}")
+        return "\n".join(lines) + "\n"
+
+
+class _RateLimiter:
+    """Fixed-window per-client limiter (reference: rate limiting in
+    pkg/server)."""
+
+    def __init__(self, per_minute: int):
+        self.per_minute = per_minute
+        self._windows: Dict[str, Tuple[int, int]] = {}
+        self._lock = threading.Lock()
+
+    def allow(self, client: str) -> bool:
+        if not self.per_minute:
+            return True
+        window = int(time.time() // 60)
+        with self._lock:
+            w, n = self._windows.get(client, (window, 0))
+            if w != window:
+                w, n = window, 0
+            if n >= self.per_minute:
+                self._windows[client] = (w, n)
+                return False
+            self._windows[client] = (w, n + 1)
+            return True
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(message)
+        self.status = status
+        self.code = code
+        self.message = message
+
+
+class HttpServer:
+    """One HTTP surface over a DB (+ optional multidb manager, auth,
+    audit)."""
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 7474,
+                 authenticator=None, database_manager=None,
+                 audit: Optional[AuditLog] = None,
+                 rate_limit_per_minute: int = 0):
+        self.db = db
+        self.host = host
+        self.port = port
+        self.authenticator = authenticator
+        self.database_manager = database_manager
+        self.audit = audit or AuditLog(enabled=False)
+        self.metrics = _Metrics()
+        self.rate_limiter = _RateLimiter(rate_limit_per_minute)
+        self.tx_manager = TransactionManager(timeout_seconds=60.0)
+        self.default_database = getattr(db, "database", "neo4j")
+        self._executors: Dict[str, Any] = {}
+        self._tx_executors: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._mcp = None  # lazily-mounted MCP endpoint (/mcp)
+
+    @property
+    def mcp(self):
+        if self._mcp is None:
+            from nornicdb_tpu.api.mcp import McpServer
+
+            self._mcp = McpServer(self.db)
+        return self._mcp
+
+    # -- routing helpers -------------------------------------------------
+
+    def storage_for(self, database: str):
+        if self.database_manager is not None and database != self.default_database:
+            return self.database_manager.get_storage(database)
+        if database != self.default_database:
+            raise HTTPError(404, "Neo.ClientError.Database.DatabaseNotFound",
+                            f"database {database!r} not found")
+        return self.db.storage
+
+    def executor_for(self, database: str):
+        if database == self.default_database:
+            return self.db.executor
+        with self._lock:
+            ex = self._executors.get(database)
+            if ex is None:
+                from nornicdb_tpu.query.executor import CypherExecutor
+
+                ex = CypherExecutor(self.storage_for(database))
+                self._executors[database] = ex
+            return ex
+
+    # -- auth ------------------------------------------------------------
+
+    def authenticate(self, headers) -> Optional[str]:
+        """Returns username or None (anonymous). Raises HTTPError(401)."""
+        if self.authenticator is None:
+            return None
+        header = headers.get("Authorization", "")
+        try:
+            if header.startswith("Bearer "):
+                claims = self.authenticator.verify_token(header[7:])
+                return claims.get("sub")
+            if header.startswith("Basic "):
+                raw = base64.b64decode(header[6:]).decode()
+                username, _, password = raw.partition(":")
+                self.authenticator.login(username, password)
+                return username
+        except AuthError as e:
+            self.audit.record(AUTH, "reject", success=False, reason=str(e))
+            raise HTTPError(401, "Neo.ClientError.Security.Unauthorized", str(e))
+        if self.authenticator.allow_anonymous_reads:
+            return None
+        raise HTTPError(401, "Neo.ClientError.Security.Unauthorized",
+                        "authentication required")
+
+    def authorize(self, username: Optional[str], database: str, privilege: str) -> None:
+        if self.authenticator is None:
+            return
+        try:
+            self.authenticator.check(username, database, privilege)
+        except PermissionDenied as e:
+            raise HTTPError(403, "Neo.ClientError.Security.Forbidden", str(e))
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "HttpServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # silence stdlib logging
+                pass
+
+            def _dispatch(self, method: str) -> None:
+                outer.metrics.inc("http_requests_total")
+                client = self.client_address[0]
+                if not outer.rate_limiter.allow(client):
+                    self._reply(429, {"error": "rate limit exceeded"})
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                try:
+                    status, payload = outer.route(
+                        method, self.path, body, self.headers)
+                except HTTPError as e:
+                    outer.metrics.inc("http_errors_total")
+                    self._reply(e.status, {"errors": [
+                        {"code": e.code, "message": e.message}]})
+                    return
+                except Exception as e:  # noqa: BLE001 — surface boundary
+                    outer.metrics.inc("http_errors_total")
+                    self._reply(500, {"errors": [
+                        {"code": "Neo.DatabaseError.General.UnknownError",
+                         "message": str(e)}]})
+                    return
+                if isinstance(payload, str):
+                    data = payload.encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="http-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # -- router (reference: server_router.go:59-314) ---------------------
+
+    def route(self, method: str, path: str, body: bytes,
+              headers) -> Tuple[int, Any]:
+        parsed = urlparse(path)
+        segments = [s for s in parsed.path.split("/") if s]
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        payload: Dict[str, Any] = {}
+        if body:
+            try:
+                payload = json.loads(body)
+            except json.JSONDecodeError:
+                raise HTTPError(400, "Neo.ClientError.Request.InvalidFormat",
+                                "request body must be JSON")
+
+        # public endpoints (no auth)
+        if parsed.path == "/health":
+            return 200, {"status": "ok"}
+        if parsed.path == "/metrics":
+            return 200, self.metrics.render(self._metric_snapshot())
+        if parsed.path == "/" and method == "GET":
+            return 200, {"server": SERVER_NAME, "version": API_VERSION,
+                         "bolt": "bolt://", "transaction": "/db/{name}/tx"}
+        if parsed.path == "/auth/login" and method == "POST":
+            return self._login(payload)
+
+        username = self.authenticate(headers)
+
+        # MCP JSON-RPC endpoint (reference: pkg/mcp streamable HTTP)
+        if parsed.path == "/mcp" and method == "POST":
+            self.authorize(username, self.default_database, WRITE)
+            response = self.mcp.handle_jsonrpc(payload)
+            return (200, response) if response is not None else (202, {})
+
+        if parsed.path == "/status":
+            return 200, self._status()
+
+        # Neo4j transactional HTTP API: /db/{name}/tx[/commit|/{txid}...]
+        if segments[:1] == ["db"] and len(segments) >= 3:
+            return self._db_routes(method, segments, payload, username)
+
+        # REST convenience API (reference: server_nornicdb.go)
+        if segments[:1] == ["nornicdb"]:
+            return self._nornicdb_routes(method, segments, payload, query, username)
+
+        # admin
+        if segments[:1] == ["admin"]:
+            return self._admin_routes(method, segments, payload, username)
+
+        raise HTTPError(404, "Neo.ClientError.Request.Invalid",
+                        f"no route for {method} {parsed.path}")
+
+    def _metric_snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        try:
+            out["nodes_total"] = float(self.db.storage.count_nodes())
+            out["edges_total"] = float(self.db.storage.count_edges())
+        except Exception:
+            pass
+        return out
+
+    def _status(self) -> Dict[str, Any]:
+        dbs: List[str] = [self.default_database]
+        if self.database_manager is not None:
+            dbs = [d.name for d in self.database_manager.list_databases()]
+        return {
+            "server": SERVER_NAME, "version": API_VERSION,
+            "databases": dbs,
+            "counts": {"nodes": self.db.storage.count_nodes(),
+                       "edges": self.db.storage.count_edges()},
+        }
+
+    def _login(self, payload: Dict[str, Any]) -> Tuple[int, Any]:
+        if self.authenticator is None:
+            raise HTTPError(400, "Neo.ClientError.Request.Invalid",
+                            "auth disabled")
+        try:
+            token = self.authenticator.login(
+                payload.get("username", ""), payload.get("password", ""))
+        except AuthError as e:
+            self.audit.record(AUTH, "login", actor=payload.get("username", ""),
+                              success=False)
+            raise HTTPError(401, "Neo.ClientError.Security.Unauthorized", str(e))
+        self.audit.record(AUTH, "login", actor=payload.get("username", ""))
+        return 200, {"token": token}
+
+    # -- Neo4j transactional HTTP API ------------------------------------
+
+    def _db_routes(self, method: str, segments: List[str],
+                   payload: Dict[str, Any],
+                   username: Optional[str]) -> Tuple[int, Any]:
+        database = segments[1]
+        if segments[2] != "tx":
+            raise HTTPError(404, "Neo.ClientError.Request.Invalid", "unknown route")
+        statements = payload.get("statements", [])
+        writes = any(_is_write(s.get("statement", "")) for s in statements)
+        self.authorize(username, database, WRITE if writes else READ)
+
+        # POST /db/{name}/tx/commit — one-shot
+        if len(segments) == 4 and segments[3] == "commit":
+            executor = self.executor_for(database)
+            if writes:
+                self.metrics.inc("cypher_writes_total")
+                self.audit.record(DATA_WRITE, "cypher", actor=username or "",
+                                  database=database)
+            return 200, self._run_statements(executor, statements)
+
+        # POST /db/{name}/tx — open explicit tx
+        if len(segments) == 3 and method == "POST":
+            tx_id = uuid.uuid4().hex[:16]
+            storage = self.storage_for(database)
+            tx = self.tx_manager.begin(tx_id, storage)
+            from nornicdb_tpu.query.executor import CypherExecutor
+
+            ex = CypherExecutor(tx)
+            with self._lock:
+                self._tx_executors[tx_id] = ex
+            result = self._run_statements(ex, statements)
+            result["commit"] = f"/db/{database}/tx/{tx_id}/commit"
+            result["transaction"] = {"id": tx_id}
+            return 201, result
+
+        # /db/{name}/tx/{txid}[/commit]
+        tx_id = segments[3]
+        tx = self.tx_manager.get(tx_id)
+        with self._lock:
+            ex = self._tx_executors.get(tx_id)
+        if tx is None or ex is None:
+            raise HTTPError(404, "Neo.ClientError.Transaction.TransactionNotFound",
+                            f"transaction {tx_id} not found")
+        if len(segments) == 5 and segments[4] == "commit":
+            result = self._run_statements(ex, statements)
+            self.tx_manager.commit(tx_id)
+            with self._lock:
+                self._tx_executors.pop(tx_id, None)
+            return 200, result
+        if method == "DELETE":
+            self.tx_manager.rollback(tx_id)
+            with self._lock:
+                self._tx_executors.pop(tx_id, None)
+            return 200, {"results": [], "errors": []}
+        if method == "POST":
+            return 200, self._run_statements(ex, statements)
+        raise HTTPError(405, "Neo.ClientError.Request.Invalid", "bad method")
+
+    def _run_statements(self, executor, statements) -> Dict[str, Any]:
+        results, errors = [], []
+        for stmt in statements:
+            q = stmt.get("statement", "")
+            params = stmt.get("parameters", {}) or {}
+            try:
+                r = executor.execute(q, params)
+            except Exception as e:  # noqa: BLE001 — per-statement errors
+                errors.append({"code": _http_error_code(e), "message": str(e)})
+                break  # Neo4j stops at first error
+            results.append({
+                "columns": r.columns,
+                "data": [{"row": [_jsonable(v) for v in row], "meta": []}
+                         for row in r.rows],
+                "stats": r.stats.to_dict() if hasattr(r.stats, "to_dict") else {},
+            })
+        return {"results": results, "errors": errors}
+
+    # -- REST convenience API --------------------------------------------
+
+    def _nornicdb_routes(self, method: str, segments: List[str],
+                         payload: Dict[str, Any], query: Dict[str, str],
+                         username: Optional[str]) -> Tuple[int, Any]:
+        database = query.get("db", self.default_database)
+        action = segments[1] if len(segments) > 1 else ""
+
+        if action == "search" and method == "POST":
+            self.authorize(username, database, READ)
+            self.metrics.inc("search_requests_total")
+            q = payload.get("query", "")
+            limit = int(payload.get("limit", 10))
+            results = self.db.search.search(q, limit=limit)
+            return 200, {"results": _jsonable(results)}
+
+        if action == "similar" and method == "POST":
+            self.authorize(username, database, READ)
+            node_id = payload.get("node_id", "")
+            limit = int(payload.get("limit", 10))
+            results = self.db.search.similar(node_id, limit=limit)
+            return 200, {"results": _jsonable(results)}
+
+        if action == "store" and method == "POST":
+            self.authorize(username, database, WRITE)
+            node = self.db.store(
+                payload.get("content", ""),
+                labels=payload.get("labels"),
+                properties=payload.get("properties"),
+                node_id=payload.get("id"),
+                embedding=payload.get("embedding"),
+            )
+            self.audit.record(DATA_WRITE, "store", actor=username or "",
+                              database=database, target=node.id)
+            return 201, {"id": node.id}
+
+        if action == "decay" and method == "GET":
+            self.authorize(username, database, READ)
+            scores = self.db.decay.scores()
+            return 200, {"scores": [
+                {"node_id": s.node_id, "score": s.score, "tier": s.tier}
+                for s in scores]}
+
+        if action == "embed" and method == "POST":
+            self.authorize(username, database, WRITE)
+            if self.db._embedder is None:
+                raise HTTPError(400, "Neo.ClientError.Request.Invalid",
+                                "no embedder configured")
+            vectors = self.db._embedder.embed_batch(payload.get("texts", []))
+            return 200, {"embeddings": [list(map(float, v)) for v in vectors]}
+
+        if action == "gdpr" and len(segments) > 2:
+            from nornicdb_tpu.retention import gdpr_delete, gdpr_export
+
+            prop = payload.get("property", "")
+            value = payload.get("value")
+            if segments[2] == "export" and method == "POST":
+                self.authorize(username, database, READ)
+                self.audit.record(GDPR, "export", actor=username or "")
+                return 200, gdpr_export(self.db.storage, prop, value)
+            if segments[2] == "delete" and method == "POST":
+                self.authorize(username, database, ADMIN)
+                n = gdpr_delete(self.db.storage, prop, value)
+                self.audit.record(GDPR, "delete", actor=username or "",
+                                  details={"deleted": n})
+                return 200, {"deleted": n}
+
+        raise HTTPError(404, "Neo.ClientError.Request.Invalid",
+                        f"no route /nornicdb/{action}")
+
+    # -- admin -----------------------------------------------------------
+
+    def _admin_routes(self, method: str, segments: List[str],
+                      payload: Dict[str, Any],
+                      username: Optional[str]) -> Tuple[int, Any]:
+        self.authorize(username, "system", ADMIN)
+        action = segments[1] if len(segments) > 1 else ""
+
+        if action == "databases":
+            if self.database_manager is None:
+                raise HTTPError(400, "Neo.ClientError.Request.Invalid",
+                                "multi-database not enabled")
+            if method == "GET":
+                return 200, {"databases": [
+                    {"name": d.name, "status": d.status, "default": d.default}
+                    for d in self.database_manager.list_databases()]}
+            if method == "POST":
+                name = payload.get("name", "")
+                self.database_manager.create_database(name)
+                self.audit.record(ADMIN_ACTION, "create_database",
+                                  actor=username or "", target=name)
+                return 201, {"name": name}
+            if method == "DELETE" and len(segments) > 2:
+                self.database_manager.drop_database(segments[2])
+                self.audit.record(ADMIN_ACTION, "drop_database",
+                                  actor=username or "", target=segments[2])
+                return 200, {"dropped": segments[2]}
+
+        if action == "backup" and method == "POST":
+            target = payload.get("path", "")
+            if not target:
+                raise HTTPError(400, "Neo.ClientError.Request.Invalid",
+                                "path required")
+            n = _backup(self.db.storage, target)
+            self.audit.record(ADMIN_ACTION, "backup", actor=username or "",
+                              details={"records": n})
+            return 200, {"records": n, "path": target}
+
+        if action == "flags":
+            from nornicdb_tpu.config import flags
+
+            if method == "GET":
+                return 200, flags.all()
+            if method == "PUT":
+                for k, v in payload.items():
+                    flags.set(k, v)
+                return 200, flags.all()
+
+        raise HTTPError(404, "Neo.ClientError.Request.Invalid",
+                        f"no route /admin/{action}")
+
+
+_WRITE_RE = re.compile(
+    r"\b(CREATE|MERGE|DELETE|DETACH|SET|REMOVE|DROP|LOAD\s+CSV)\b", re.I)
+
+
+def _is_write(query: str) -> bool:
+    return bool(_WRITE_RE.search(query))
+
+
+def _http_error_code(e: Exception) -> str:
+    from nornicdb_tpu.errors import CypherSyntaxError
+
+    if isinstance(e, CypherSyntaxError):
+        return "Neo.ClientError.Statement.SyntaxError"
+    return "Neo.DatabaseError.Statement.ExecutionFailed"
+
+
+def _jsonable(value: Any) -> Any:
+    from nornicdb_tpu.storage.types import Edge, Node
+
+    if isinstance(value, Node):
+        return {"id": value.id, "labels": value.labels,
+                "properties": _jsonable(value.properties)}
+    if isinstance(value, Edge):
+        return {"id": value.id, "type": value.type,
+                "start": value.start_node, "end": value.end_node,
+                "properties": _jsonable(value.properties)}
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    try:
+        import numpy as np
+
+        if isinstance(value, np.integer):
+            return int(value)
+        if isinstance(value, np.floating):
+            return float(value)
+        if isinstance(value, np.ndarray):
+            return value.tolist()
+    except ImportError:  # pragma: no cover
+        pass
+    return value
+
+
+def _backup(storage, target_path: str) -> int:
+    """Write a JSONL backup of all nodes+edges (reference:
+    badger_backup.go + /admin/backup route)."""
+    import os
+
+    os.makedirs(os.path.dirname(os.path.abspath(target_path)), exist_ok=True)
+    n = 0
+    tmp = target_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        for node in storage.all_nodes():
+            f.write(json.dumps({"kind": "node", **node.to_dict()}) + "\n")
+            n += 1
+        for edge in storage.all_edges():
+            f.write(json.dumps({"kind": "edge", **edge.to_dict()}) + "\n")
+            n += 1
+    os.replace(tmp, target_path)
+    return n

@@ -264,7 +264,8 @@ class Authenticator:
         try:
             self.check(username, database, privilege)
             return True
-        except PermissionDenied:
+        except (PermissionDenied, AuthError):
+            # unknown/deleted user is a denial, not a crash
             return False
 
 

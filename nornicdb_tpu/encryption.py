@@ -10,6 +10,7 @@ package's AESGCM primitive.
 from __future__ import annotations
 
 import base64
+import binascii
 import hashlib
 import os
 import secrets
@@ -90,7 +91,11 @@ class Encryptor:
     def decrypt_field(self, value: str) -> str:
         if not value.startswith(_PREFIX):
             return value
-        return self.decrypt(base64.b64decode(value[len(_PREFIX):])).decode()
+        try:
+            blob = base64.b64decode(value[len(_PREFIX):], validate=True)
+        except (ValueError, binascii.Error) as e:
+            raise EncryptionError("malformed ciphertext encoding") from e
+        return self.decrypt(blob).decode("utf-8", errors="replace")
 
     @staticmethod
     def is_encrypted_field(value: Any) -> bool:

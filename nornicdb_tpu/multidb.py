@@ -126,9 +126,14 @@ class DatabaseManager:
         try:
             # prefix sweep outside the lock — can be large
             self._base.delete_by_prefix(name + ":")
-        finally:
-            with self._lock:
-                self._dbs.pop(name, None)
+        except BaseException:
+            # failed sweep: keep the tombstone so the undeleted rows can't
+            # reappear inside a freshly recreated database; a retry of
+            # drop_database is blocked with "already being dropped" until
+            # an operator resolves it, which is the safe failure mode
+            raise
+        with self._lock:
+            self._dbs.pop(name, None)
         return True
 
     def list_databases(self) -> List[DatabaseInfo]:

@@ -170,6 +170,33 @@ class SearchService:
         except Exception:
             return None  # fail-open: hybrid degrades to text-only
 
+    def similar(self, node_id: str, limit: int = 10) -> List[Dict[str, Any]]:
+        """Nodes nearest to a stored node's embedding (reference: the REST
+        /similar endpoint, server_nornicdb.go). Empty when the node has no
+        vector yet."""
+        try:
+            node = self.storage.get_node(node_id)
+        except KeyError:
+            return []
+        emb = node.embedding or (
+            node.chunk_embeddings[0] if node.chunk_embeddings else None)
+        if emb is None:
+            return []
+        hits = self.vector_search_candidates(emb, limit + 1)
+        out: List[Dict[str, Any]] = []
+        for nid, score in hits:
+            if nid == node_id:
+                continue
+            res = SearchResult(node_id=nid, score=score, vector_score=score)
+            try:
+                res.node = self.storage.get_node(nid)
+            except KeyError:
+                continue
+            out.append(res.to_dict())
+            if len(out) >= limit:
+                break
+        return out
+
     def vector_search_candidates(
         self, query_vec: Sequence[float], k: int = 10, exact: bool = False
     ) -> List[Tuple[str, float]]:

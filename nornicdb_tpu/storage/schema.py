@@ -115,6 +115,15 @@ class SchemaManager:
             return [c for c in self._constraints.values()
                     if c.label == label or not c.label]
 
+    def applicable(self, labels: List[str]) -> List[Constraint]:
+        """Constraints that apply to a node with these labels — global
+        (label="") constraints apply to every node, even label-less ones."""
+        lset = set(labels)
+        with self._lock:
+            return [c for c in self._constraints.values()
+                    if c.kind != "rel_endpoints"
+                    and (not c.label or c.label in lset)]
+
     def for_rel_type(self, rel_type: str) -> List[Constraint]:
         with self._lock:
             return [c for c in self._constraints.values()
@@ -124,50 +133,52 @@ class SchemaManager:
 def _check_node(storage: Engine, sm: SchemaManager, node: Node,
                 exclude_id: Optional[str] = None,
                 unique_index: Optional["UniqueIndex"] = None) -> None:
-    for label in node.labels:
-        for c in sm.for_label(label):
-            if c.kind == "exists":
-                if node.properties.get(c.property) is None:
-                    raise ConstraintViolation(
-                        f"{c.name}: {label}.{c.property} must exist")
-            elif c.kind == "type":
-                v = node.properties.get(c.property)
-                want = PROPERTY_TYPES.get(c.property_type)
-                if v is not None and want is not None and not isinstance(v, want):
-                    # bool is an int subclass; an int constraint must
-                    # still reject True/False
-                    raise ConstraintViolation(
-                        f"{c.name}: {label}.{c.property} must be {c.property_type}")
-                if (v is not None and c.property_type == "int"
-                        and isinstance(v, bool)):
-                    raise ConstraintViolation(
-                        f"{c.name}: {label}.{c.property} must be int")
-            elif c.kind == "unique":
-                v = node.properties.get(c.property)
-                if v is None:
-                    continue
-                owner = unique_index.lookup(c, v) if unique_index is not None else None
-                if unique_index is None:
-                    # no index available: fall back to a label scan
-                    for other in storage.get_nodes_by_label(label):
-                        if other.id != (exclude_id or node.id) \
-                                and other.properties.get(c.property) == v:
-                            owner = other.id
-                            break
-                if owner is not None and owner != (exclude_id or node.id):
-                    raise ConstraintViolation(
-                        f"{c.name}: duplicate {label}.{c.property}={v!r}")
-            elif c.kind == "temporal":
-                start = node.properties.get(c.property)
-                end = node.properties.get(c.property2)
-                if start is not None and end is not None:
-                    try:
-                        if start > end:
-                            raise ConstraintViolation(
-                                f"{c.name}: interval {c.property} > {c.property2}")
-                    except TypeError:
+    for c in sm.applicable(node.labels):
+        label = c.label or "(any)"
+        if c.kind == "exists":
+            if node.properties.get(c.property) is None:
+                raise ConstraintViolation(
+                    f"{c.name}: {label}.{c.property} must exist")
+        elif c.kind == "type":
+            v = node.properties.get(c.property)
+            want = PROPERTY_TYPES.get(c.property_type)
+            if v is not None and want is not None and not isinstance(v, want):
+                raise ConstraintViolation(
+                    f"{c.name}: {label}.{c.property} must be {c.property_type}")
+            if (v is not None and c.property_type == "int"
+                    and isinstance(v, bool)):
+                # bool is an int subclass; an int constraint must still
+                # reject True/False
+                raise ConstraintViolation(
+                    f"{c.name}: {label}.{c.property} must be int")
+        elif c.kind == "unique":
+            v = node.properties.get(c.property)
+            if v is None:
+                continue
+            owner = unique_index.lookup(c, v) if unique_index is not None else None
+            if unique_index is None:
+                # no index available: fall back to a scan
+                others = (storage.get_nodes_by_label(c.label) if c.label
+                          else storage.all_nodes())
+                for other in others:
+                    if other.id != (exclude_id or node.id) \
+                            and other.properties.get(c.property) == v:
+                        owner = other.id
+                        break
+            if owner is not None and owner != (exclude_id or node.id):
+                raise ConstraintViolation(
+                    f"{c.name}: duplicate {label}.{c.property}={v!r}")
+        elif c.kind == "temporal":
+            start = node.properties.get(c.property)
+            end = node.properties.get(c.property2)
+            if start is not None and end is not None:
+                try:
+                    if start > end:
                         raise ConstraintViolation(
-                            f"{c.name}: interval endpoints not comparable")
+                            f"{c.name}: interval {c.property} > {c.property2}")
+                except TypeError:
+                    raise ConstraintViolation(
+                        f"{c.name}: interval endpoints not comparable")
 
 
 def _check_edge(storage: Engine, sm: SchemaManager, edge: Edge) -> None:
@@ -193,36 +204,45 @@ class UniqueIndex:
 
     def __init__(self, storage: Engine):
         self._storage = storage
+        # forward: key -> {value: node_id}; reverse: key -> {node_id: value}
+        # — the reverse map makes per-mutation eviction O(1) instead of a
+        # full value-map scan
         self._maps: Dict[Tuple[str, str], Dict[Any, str]] = {}
+        self._owners: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self._lock = threading.Lock()
 
     def _key(self, c: Constraint) -> Tuple[str, str]:
         return (c.label, c.property)
+
+    @staticmethod
+    def _hashable(v: Any) -> Any:
+        try:
+            hash(v)
+            return v
+        except TypeError:
+            return repr(v)
 
     def _ensure(self, c: Constraint) -> Dict[Any, str]:
         key = self._key(c)
         m = self._maps.get(key)
         if m is None:
             m = {}
+            owners: Dict[str, Any] = {}
             nodes = (self._storage.get_nodes_by_label(c.label) if c.label
                      else list(self._storage.all_nodes()))
             for n in nodes:
                 v = n.properties.get(c.property)
                 if v is not None:
-                    try:
-                        m[v] = n.id
-                    except TypeError:
-                        m[repr(v)] = n.id  # unhashable values keyed by repr
+                    hv = self._hashable(v)
+                    m[hv] = n.id
+                    owners[n.id] = hv
             self._maps[key] = m
+            self._owners[key] = owners
         return m
 
     def lookup(self, c: Constraint, value: Any) -> Optional[str]:
         with self._lock:
-            m = self._ensure(c)
-            try:
-                return m.get(value)
-            except TypeError:
-                return m.get(repr(value))
+            return self._ensure(c).get(self._hashable(value))
 
     def on_upsert(self, constraints: List[Constraint], node: Node) -> None:
         with self._lock:
@@ -231,26 +251,28 @@ class UniqueIndex:
                     continue
                 if c.label and c.label not in node.labels:
                     continue
-                m = self._maps.get(self._key(c))
+                key = self._key(c)
+                m = self._maps.get(key)
                 if m is None:
                     continue  # not built yet; next lookup scans fresh
-                # drop any stale value this node previously owned
-                for v, owner in list(m.items()):
-                    if owner == node.id:
-                        del m[v]
+                owners = self._owners[key]
+                old = owners.pop(node.id, None)
+                if old is not None and m.get(old) == node.id:
+                    del m[old]
                 v = node.properties.get(c.property)
                 if v is not None:
-                    try:
-                        m[v] = node.id
-                    except TypeError:
-                        m[repr(v)] = node.id
+                    hv = self._hashable(v)
+                    m[hv] = node.id
+                    owners[node.id] = hv
 
     def on_delete(self, node_id: str) -> None:
         with self._lock:
-            for m in self._maps.values():
-                for v, owner in list(m.items()):
-                    if owner == node_id:
-                        del m[v]
+            for key, owners in self._owners.items():
+                old = owners.pop(node_id, None)
+                if old is not None:
+                    m = self._maps[key]
+                    if m.get(old) == node_id:
+                        del m[old]
 
 
 class ConstrainedEngine(EngineDecorator):

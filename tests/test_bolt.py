@@ -1,0 +1,297 @@
+"""PackStream codec + Bolt server protocol tests.
+
+Reference: pkg/bolt/packstream.go, server.go. Codec checked against
+hand-computed byte sequences (not just round-trips) so a self-consistent
+but wrong encoding can't pass; server driven by a raw socket client.
+"""
+
+import socket
+import struct
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.api.bolt import (
+    BOLT_MAGIC,
+    MSG_BEGIN,
+    MSG_COMMIT,
+    MSG_FAILURE,
+    MSG_HELLO,
+    MSG_PULL,
+    MSG_RECORD,
+    MSG_RESET,
+    MSG_ROLLBACK,
+    MSG_RUN,
+    MSG_SUCCESS,
+    BoltServer,
+    read_message,
+    write_message,
+)
+from nornicdb_tpu.api.packstream import (
+    Packer,
+    Structure,
+    node_structure,
+    pack,
+    unpack,
+    unpack_all,
+)
+from nornicdb_tpu.auth import Authenticator
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.txn import TransactionManager, TransactionOverlay
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+class TestPackStreamWireFormat:
+    """Exact byte layouts from the PackStream spec."""
+
+    def test_null_bool(self):
+        assert pack(None) == b"\xc0"
+        assert pack(True) == b"\xc3"
+        assert pack(False) == b"\xc2"
+
+    def test_integers(self):
+        assert pack(1) == b"\x01"
+        assert pack(127) == b"\x7f"
+        assert pack(-1) == b"\xff"
+        assert pack(-16) == b"\xf0"
+        assert pack(-17) == b"\xc8\xef"
+        assert pack(128) == b"\xc9\x00\x80"
+        assert pack(-32769) == b"\xca\xff\xff\x7f\xff"
+        assert pack(2**31) == b"\xcb\x00\x00\x00\x00\x80\x00\x00\x00"
+
+    def test_float(self):
+        assert pack(1.1) == b"\xc1" + struct.pack(">d", 1.1)
+
+    def test_strings(self):
+        assert pack("") == b"\x80"
+        assert pack("a") == b"\x81a"
+        assert pack("hello") == b"\x85hello"
+        s = "x" * 20
+        assert pack(s) == b"\xd0\x14" + s.encode()
+
+    def test_list_map(self):
+        assert pack([1, 2]) == b"\x92\x01\x02"
+        assert pack({"a": 1}) == b"\xa1\x81a\x01"
+
+    def test_struct(self):
+        s = Structure(0x4E, [1, ["L"], {}])
+        assert pack(s) == b"\xb3\x4e\x01\x91\x81L\xa0"
+
+    def test_roundtrip_nested(self):
+        value = {"list": [1, -200, 3.5, "str", None, True],
+                 "map": {"k": [{"deep": "v"}]}, "big": 2**40}
+        assert unpack(pack(value)) == value
+
+    def test_unpack_all_and_truncation(self):
+        data = pack(1) + pack("two")
+        assert unpack_all(data) == [1, "two"]
+        with pytest.raises(ValueError):
+            unpack(b"\xd1\x00")  # truncated string header
+
+    def test_node_structure(self):
+        n = Node(id="abc", labels=["Person"], properties={"name": "Ada"})
+        s = node_structure(n)
+        assert s.tag == 0x4E
+        assert isinstance(s.fields[0], int) and s.fields[0] < 2**53
+        assert s.fields[1] == ["Person"]
+        assert s.fields[2]["name"] == "Ada"
+        assert s.fields[2]["_id"] == "abc"  # real string id preserved
+
+
+class TestTransactionOverlay:
+    def test_commit_applies(self):
+        base = MemoryEngine()
+        tx = TransactionOverlay(base)
+        tx.create_node(Node(id="a"))
+        tx.create_node(Node(id="b"))
+        tx.create_edge(Edge(id="e", type="R", start_node="a", end_node="b"))
+        assert base.count_nodes() == 0  # invisible before commit
+        assert tx.count_nodes() == 2  # read-your-writes
+        tx.commit()
+        assert base.count_nodes() == 2 and base.count_edges() == 1
+
+    def test_rollback_discards(self):
+        base = MemoryEngine()
+        base.create_node(Node(id="keep"))
+        tx = TransactionOverlay(base)
+        tx.create_node(Node(id="gone"))
+        tx.delete_node("keep")
+        assert not tx.has_node("keep")
+        tx.rollback()
+        assert base.has_node("keep") and not base.has_node("gone")
+        with pytest.raises(RuntimeError):
+            tx.commit()  # already closed
+
+    def test_overlay_sees_inner_and_updates(self):
+        base = MemoryEngine()
+        base.create_node(Node(id="n", properties={"v": 1}))
+        tx = TransactionOverlay(base)
+        n = tx.get_node("n")
+        n.properties["v"] = 2
+        tx.update_node(n)
+        assert tx.get_node("n").properties["v"] == 2
+        assert base.get_node("n").properties["v"] == 1
+        tx.commit()
+        assert base.get_node("n").properties["v"] == 2
+
+    def test_manager_reaps(self):
+        mgr = TransactionManager(timeout_seconds=0.0)
+        tx = mgr.begin("s1", MemoryEngine())
+        assert mgr.get("s1") is tx
+        assert mgr.reap_expired() == 1
+        assert mgr.get("s1") is None
+
+
+# ---------------------------------------------------------------------------
+# Bolt server integration via raw socket client
+# ---------------------------------------------------------------------------
+
+
+class BoltClient:
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.sock.sendall(struct.pack(">I", BOLT_MAGIC))
+        # propose 4.4 then zeros
+        self.sock.sendall(struct.pack(">I", (4 << 8) | 4) + b"\x00" * 12)
+        chosen = struct.unpack(">I", self.sock.recv(4))[0]
+        assert chosen & 0xFF == 4, f"unexpected version {chosen:#x}"
+
+    def send(self, sig, *fields):
+        p = Packer()
+        p.pack(Structure(sig, list(fields)))
+        write_message(self.sock, p.data())
+
+    def recv(self):
+        from nornicdb_tpu.api.packstream import Unpacker
+
+        msg = Unpacker(read_message(self.sock)).unpack()
+        return msg.tag, msg.fields
+
+    def recv_until_success_or_failure(self):
+        records = []
+        while True:
+            tag, fields = self.recv()
+            if tag == MSG_RECORD:
+                records.append(fields[0])
+            else:
+                return tag, fields, records
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture
+def server():
+    db = nornicdb_tpu.open()
+    srv = BoltServer(db, port=0).start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+@pytest.fixture
+def client(server):
+    c = BoltClient(server.port)
+    c.send(MSG_HELLO, {"user_agent": "test/1.0", "scheme": "none"})
+    tag, fields = c.recv()
+    assert tag == MSG_SUCCESS and "server" in fields[0]
+    yield c
+    c.close()
+
+
+class TestBoltServer:
+    def test_run_pull_create_and_match(self, client):
+        client.send(MSG_RUN, "CREATE (n:Person {name: 'Ada'}) RETURN n", {}, {})
+        tag, fields = client.recv()
+        assert tag == MSG_SUCCESS and fields[0]["fields"] == ["n"]
+        client.send(MSG_PULL, {"n": -1})
+        tag, fields, records = client.recv_until_success_or_failure()
+        assert tag == MSG_SUCCESS and len(records) == 1
+        node = records[0][0]
+        assert node.tag == 0x4E and node.fields[1] == ["Person"]
+        assert "bookmark" in fields[0]
+
+        client.send(MSG_RUN, "MATCH (n:Person) RETURN n.name AS name", {}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        tag, fields, records = client.recv_until_success_or_failure()
+        assert records == [["Ada"]]
+
+    def test_pull_batching_has_more(self, client):
+        client.send(MSG_RUN, "UNWIND range(1, 5) AS x RETURN x", {}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": 2})
+        tag, fields, records = client.recv_until_success_or_failure()
+        assert fields[0].get("has_more") is True and len(records) == 2
+        client.send(MSG_PULL, {"n": -1})
+        tag, fields, records = client.recv_until_success_or_failure()
+        assert len(records) == 3 and "has_more" not in fields[0]
+
+    def test_parameters(self, client):
+        client.send(MSG_RUN, "RETURN $x + 1 AS y", {"x": 41}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        _, _, records = client.recv_until_success_or_failure()
+        assert records == [[42]]
+
+    def test_failure_then_ignored_then_reset(self, client):
+        client.send(MSG_RUN, "THIS IS NOT CYPHER", {}, {})
+        tag, fields = client.recv()
+        assert tag == MSG_FAILURE
+        assert fields[0]["code"].startswith("Neo.ClientError")
+        # messages are IGNORED until RESET
+        client.send(MSG_RUN, "RETURN 1", {}, {})
+        tag, _ = client.recv()
+        assert tag == 0x7E  # IGNORED
+        client.send(MSG_RESET)
+        tag, _ = client.recv()
+        assert tag == MSG_SUCCESS
+        client.send(MSG_RUN, "RETURN 1 AS one", {}, {})
+        tag, _ = client.recv()
+        assert tag == MSG_SUCCESS
+
+    def test_explicit_transaction_commit(self, server, client):
+        client.send(MSG_BEGIN, {})
+        assert client.recv()[0] == MSG_SUCCESS
+        client.send(MSG_RUN, "CREATE (n:Tx {v: 1})", {}, {})
+        assert client.recv()[0] == MSG_SUCCESS
+        client.send(MSG_PULL, {"n": -1})
+        client.recv_until_success_or_failure()
+        # not visible outside the tx yet
+        assert server.db.cypher("MATCH (n:Tx) RETURN count(n)").value() == 0
+        client.send(MSG_COMMIT)
+        tag, fields = client.recv()
+        assert tag == MSG_SUCCESS and "bookmark" in fields[0]
+        assert server.db.cypher("MATCH (n:Tx) RETURN count(n)").value() == 1
+
+    def test_explicit_transaction_rollback(self, server, client):
+        client.send(MSG_BEGIN, {})
+        client.recv()
+        client.send(MSG_RUN, "CREATE (n:Gone)", {}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        client.recv_until_success_or_failure()
+        client.send(MSG_ROLLBACK)
+        assert client.recv()[0] == MSG_SUCCESS
+        assert server.db.cypher("MATCH (n:Gone) RETURN count(n)").value() == 0
+
+    def test_auth_required(self):
+        db = nornicdb_tpu.open()
+        auth = Authenticator()
+        auth.create_user("ada", "pw", roles=["admin"])
+        srv = BoltServer(db, port=0, authenticator=auth).start()
+        try:
+            c = BoltClient(srv.port)
+            c.send(MSG_HELLO, {"scheme": "basic", "principal": "ada",
+                               "credentials": "wrong"})
+            tag, fields = c.recv()
+            assert tag == MSG_FAILURE
+            c.close()
+            c2 = BoltClient(srv.port)
+            c2.send(MSG_HELLO, {"scheme": "basic", "principal": "ada",
+                                "credentials": "pw"})
+            assert c2.recv()[0] == MSG_SUCCESS
+            c2.close()
+        finally:
+            srv.stop()
+            db.close()

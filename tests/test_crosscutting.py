@@ -119,6 +119,10 @@ class TestAuth:
         with pytest.raises(PermissionDenied):
             auth.check(None, "neo4j", WRITE)
 
+    def test_allowed_unknown_user_is_denial(self):
+        auth = Authenticator()
+        assert auth.allowed("ghost", "neo4j", READ) is False
+
     def test_bootstrap_admin(self):
         auth = Authenticator()
         pw = bootstrap_admin(auth, "neo4j")
@@ -197,6 +201,11 @@ class TestEncryption:
         # double-encrypt guarded
         again = enc.encrypt_properties(out, ["ssn"])
         assert again["ssn"] == out["ssn"]
+
+    def test_malformed_ciphertext_does_not_crash_reads(self):
+        enc = Encryptor(b"k" * 32)
+        props = enc.decrypt_properties({"x": "enc:v1:not-base64!!", "y": 1})
+        assert props["x"] == "enc:v1:not-base64!!" and props["y"] == 1
 
     def test_from_passphrase_roundtrip(self, tmp_path):
         e1 = Encryptor.from_passphrase("pw", str(tmp_path), iterations=1000)

@@ -1,0 +1,272 @@
+"""HTTP server (Neo4j tx API, REST, admin, metrics) + MCP endpoint.
+
+Reference: pkg/server (server_router.go), pkg/mcp (tools.go).
+"""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.api.http_server import HttpServer
+from nornicdb_tpu.auth import Authenticator, bootstrap_admin
+from nornicdb_tpu.multidb import DatabaseManager
+from nornicdb_tpu.storage import MemoryEngine
+
+
+def req(port, path, method="GET", body=None, headers=None, expect_error=False):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type": "application/json",
+                                        **(headers or {})})
+    try:
+        with urllib.request.urlopen(r, timeout=5) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            parsed = json.loads(raw) if "json" in ctype else raw.decode()
+            return resp.status, parsed
+    except urllib.error.HTTPError as e:
+        if not expect_error:
+            raise AssertionError(f"{method} {path} -> {e.code}: {e.read()!r}")
+        raw = e.read()
+        try:
+            return e.code, json.loads(raw)
+        except json.JSONDecodeError:
+            return e.code, raw.decode()
+
+
+@pytest.fixture
+def server():
+    db = nornicdb_tpu.open()
+    srv = HttpServer(db, port=0).start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+class TestHttpBasics:
+    def test_health_root_status(self, server):
+        assert req(server.port, "/health")[1]["status"] == "ok"
+        assert req(server.port, "/")[1]["server"] == "nornicdb-tpu"
+        status = req(server.port, "/status")[1]
+        assert "neo4j" in status["databases"]
+
+    def test_metrics_prometheus_text(self, server):
+        code, text = req(server.port, "/metrics")
+        assert code == 200
+        assert "nornicdb_http_requests_total" in text
+        assert "nornicdb_uptime_seconds" in text
+
+    def test_404(self, server):
+        code, body = req(server.port, "/nope", expect_error=True)
+        assert code == 404 and body["errors"][0]["code"].startswith("Neo.")
+
+
+class TestTransactionalAPI:
+    def test_tx_commit_oneshot(self, server):
+        code, body = req(server.port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [
+                {"statement": "CREATE (n:Person {name: $n}) RETURN n.name",
+                 "parameters": {"n": "Ada"}},
+                {"statement": "MATCH (n:Person) RETURN count(n) AS c"},
+            ]})
+        assert code == 200 and body["errors"] == []
+        assert body["results"][0]["data"][0]["row"] == ["Ada"]
+        assert body["results"][1]["data"][0]["row"] == [1]
+
+    def test_tx_statement_error_reported(self, server):
+        code, body = req(server.port, "/db/neo4j/tx/commit", "POST", {
+            "statements": [{"statement": "NOT CYPHER"}]})
+        assert code == 200
+        assert body["errors"] and "code" in body["errors"][0]
+
+    def test_explicit_tx_lifecycle(self, server):
+        # open
+        code, body = req(server.port, "/db/neo4j/tx", "POST", {
+            "statements": [{"statement": "CREATE (n:TxNode) RETURN 1"}]})
+        assert code == 201
+        commit_url = body["commit"]
+        # not yet visible
+        assert server.db.cypher("MATCH (n:TxNode) RETURN count(n)").value() == 0
+        # commit
+        code, body = req(server.port, commit_url.replace("http://", "/"), "POST",
+                         {"statements": []})
+        assert code == 200
+        assert server.db.cypher("MATCH (n:TxNode) RETURN count(n)").value() == 1
+
+    def test_explicit_tx_rollback(self, server):
+        code, body = req(server.port, "/db/neo4j/tx", "POST", {
+            "statements": [{"statement": "CREATE (n:Doomed)"}]})
+        tx_id = body["transaction"]["id"]
+        code, _ = req(server.port, f"/db/neo4j/tx/{tx_id}", "DELETE")
+        assert code == 200
+        assert server.db.cypher("MATCH (n:Doomed) RETURN count(n)").value() == 0
+        # tx gone afterwards
+        code, _ = req(server.port, f"/db/neo4j/tx/{tx_id}", "POST",
+                      {"statements": []}, expect_error=True)
+        assert code == 404
+
+    def test_unknown_database_404(self, server):
+        code, _ = req(server.port, "/db/ghost/tx/commit", "POST",
+                      {"statements": []}, expect_error=True)
+        assert code == 404
+
+
+class TestRestAPI:
+    def test_store_and_search(self, server):
+        code, body = req(server.port, "/nornicdb/store", "POST",
+                         {"content": "the mitochondria is the powerhouse",
+                          "labels": ["Fact"]})
+        assert code == 201 and body["id"]
+        server.db.search.build_indexes()
+        code, body = req(server.port, "/nornicdb/search", "POST",
+                         {"query": "mitochondria powerhouse", "limit": 5})
+        assert code == 200
+        assert body["results"] and body["results"][0]["id"]
+
+    def test_decay_endpoint(self, server):
+        req(server.port, "/nornicdb/store", "POST", {"content": "x"})
+        code, body = req(server.port, "/nornicdb/decay")
+        assert code == 200 and len(body["scores"]) == 1
+        assert 0 <= body["scores"][0]["score"] <= 1.5
+
+    def test_gdpr_export_delete(self, server):
+        req(server.port, "/nornicdb/store", "POST",
+            {"content": "pii", "properties": {"email": "a@x.com"}})
+        code, body = req(server.port, "/nornicdb/gdpr/export", "POST",
+                         {"property": "email", "value": "a@x.com"})
+        assert code == 200 and len(body["nodes"]) == 1
+        code, body = req(server.port, "/nornicdb/gdpr/delete", "POST",
+                         {"property": "email", "value": "a@x.com"})
+        assert code == 200 and body["deleted"] == 1
+
+
+class TestAuthAndAdmin:
+    @pytest.fixture
+    def secured(self):
+        db = nornicdb_tpu.open()
+        auth = Authenticator()
+        pw = bootstrap_admin(auth, "root")
+        auth.create_user("reader", "rpw", roles=["reader"])
+        base = MemoryEngine()
+        mgr = DatabaseManager(base)
+        srv = HttpServer(db, port=0, authenticator=auth,
+                         database_manager=mgr).start()
+        yield srv, pw
+        srv.stop()
+        db.close()
+
+    def _basic(self, user, pw):
+        return {"Authorization": "Basic "
+                + base64.b64encode(f"{user}:{pw}".encode()).decode()}
+
+    def test_unauthenticated_rejected(self, secured):
+        srv, _ = secured
+        code, _ = req(srv.port, "/status", expect_error=True)
+        assert code == 401
+
+    def test_login_then_bearer(self, secured):
+        srv, pw = secured
+        code, body = req(srv.port, "/auth/login", "POST",
+                         {"username": "root", "password": pw})
+        assert code == 200
+        token = body["token"]
+        code, _ = req(srv.port, "/status",
+                      headers={"Authorization": f"Bearer {token}"})
+        assert code == 200
+
+    def test_rbac_write_denied_for_reader(self, secured):
+        srv, _ = secured
+        code, _ = req(srv.port, "/db/neo4j/tx/commit", "POST",
+                      {"statements": [{"statement": "CREATE (n:X)"}]},
+                      headers=self._basic("reader", "rpw"), expect_error=True)
+        assert code == 403
+        code, _ = req(srv.port, "/db/neo4j/tx/commit", "POST",
+                      {"statements": [{"statement": "MATCH (n) RETURN count(n)"}]},
+                      headers=self._basic("reader", "rpw"))
+        assert code == 200
+
+    def test_admin_databases(self, secured):
+        srv, pw = secured
+        hdrs = self._basic("root", pw)
+        code, _ = req(srv.port, "/admin/databases", "POST",
+                      {"name": "tenant1"}, headers=hdrs)
+        assert code == 201
+        code, body = req(srv.port, "/admin/databases", headers=hdrs)
+        assert "tenant1" in [d["name"] for d in body["databases"]]
+        code, _ = req(srv.port, "/admin/databases/tenant1", "DELETE", headers=hdrs)
+        assert code == 200
+        # reader may not administer
+        code, _ = req(srv.port, "/admin/databases", headers=self._basic("reader", "rpw"),
+                      expect_error=True)
+        assert code == 403
+
+    def test_admin_backup_and_flags(self, secured, tmp_path):
+        srv, pw = secured
+        hdrs = self._basic("root", pw)
+        srv.db.store("backup me", node_id="b1")
+        code, body = req(srv.port, "/admin/backup", "POST",
+                         {"path": str(tmp_path / "backup.jsonl")}, headers=hdrs)
+        assert code == 200 and body["records"] == 1
+        code, body = req(srv.port, "/admin/flags", headers=hdrs)
+        assert "fast_paths" in body
+
+
+class TestMcp:
+    def _rpc(self, port, method, params=None, id=1):
+        payload = {"jsonrpc": "2.0", "id": id, "method": method}
+        if params is not None:
+            payload["params"] = params
+        return req(port, "/mcp", "POST", payload)
+
+    def test_initialize_and_list(self, server):
+        code, body = self._rpc(server.port, "initialize")
+        assert code == 200
+        assert body["result"]["serverInfo"]["name"] == "nornicdb-tpu"
+        code, body = self._rpc(server.port, "tools/list")
+        names = {t["name"] for t in body["result"]["tools"]}
+        assert {"store", "recall", "discover", "link", "task", "tasks"} <= names
+
+    def test_store_link_discover_flow(self, server):
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "store", "arguments": {"content": "graph databases rock",
+                                           "labels": ["Fact"]}})
+        n1 = json.loads(body["result"]["content"][0]["text"])["id"]
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "store", "arguments": {"content": "tpus are fast"}})
+        n2 = json.loads(body["result"]["content"][0]["text"])["id"]
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "link", "arguments": {"from_id": n1, "to_id": n2}})
+        assert json.loads(body["result"]["content"][0]["text"])["type"] == "RELATES_TO"
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "discover", "arguments": {"node_id": n1}})
+        d = json.loads(body["result"]["content"][0]["text"])
+        assert d["node"]["id"] == n1 and len(d["relationships"]) == 1
+
+    def test_task_lifecycle(self, server):
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "task", "arguments": {"title": "write tests"}})
+        tid = json.loads(body["result"]["content"][0]["text"])["id"]
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "task", "arguments": {"title": "write tests", "id": tid,
+                                          "status": "done"}})
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "tasks", "arguments": {"status": "done"}})
+        tasks = json.loads(body["result"]["content"][0]["text"])
+        assert [t["id"] for t in tasks] == [tid]
+
+    def test_cypher_tool_readonly(self, server):
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "cypher", "arguments": {"query": "RETURN 1 AS x"}})
+        assert json.loads(body["result"]["content"][0]["text"])["rows"] == [[1]]
+        code, body = self._rpc(server.port, "tools/call", {
+            "name": "cypher", "arguments": {"query": "CREATE (n:Evil)"}})
+        assert "error" in body
+
+    def test_unknown_method(self, server):
+        code, body = self._rpc(server.port, "bogus/method")
+        assert body["error"]["code"] == -32601

@@ -86,6 +86,14 @@ class TestConstraints:
         problems = self.eng.validate_existing()
         assert len(problems) == 2  # each node sees the other as duplicate
 
+    def test_global_constraint_applies_to_labelless_nodes(self):
+        self.sm.add(Constraint(name="g", kind="unique", label="", property="email"))
+        self.eng.create_node(Node(id="a", labels=[], properties={"email": "x"}))
+        with pytest.raises(ConstraintViolation):
+            self.eng.create_node(Node(id="b", labels=[], properties={"email": "x"}))
+        with pytest.raises(ConstraintViolation):
+            self.eng.create_node(mknode("c", email="x"))  # labeled too
+
     def test_persistence(self, tmp_path):
         path = str(tmp_path / "schema.json")
         sm = SchemaManager(path)
@@ -178,6 +186,19 @@ class TestDatabaseManager:
         mgr2 = DatabaseManager(base)
         assert mgr2.exists("t1")
         assert mgr2.get_storage("t1").count_nodes() == 1
+
+    def test_failed_sweep_keeps_tombstone(self):
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("t")
+        mgr.get_storage("t").create_node(Node(id="n"))
+        orig = mgr._base.delete_by_prefix
+        mgr._base.delete_by_prefix = lambda p: (_ for _ in ()).throw(IOError("disk"))
+        with pytest.raises(IOError):
+            mgr.drop_database("t")
+        mgr._base.delete_by_prefix = orig
+        # tombstone blocks recreation until resolved — no data leak
+        with pytest.raises(DatabaseError):
+            mgr.create_database("t")
 
     def test_unique_index_tracks_mutations(self):
         from nornicdb_tpu.storage import ConstrainedEngine as CE
