@@ -63,6 +63,7 @@ class CypherResult:
     columns: List[str] = field(default_factory=list)
     rows: List[List[Any]] = field(default_factory=list)
     stats: QueryStats = field(default_factory=QueryStats)
+    plan: Optional[Dict[str, Any]] = None  # EXPLAIN/PROFILE plan tree
 
     def records(self) -> List[Dict[str, Any]]:
         return [dict(zip(self.columns, r)) for r in self.rows]
@@ -76,9 +77,14 @@ class CypherResult:
 
 
 class _Ctx:
-    def __init__(self, executor: "CypherExecutor", params: Dict[str, Any]):
+    def __init__(
+        self,
+        executor: "CypherExecutor",
+        params: Dict[str, Any],
+        storage: Optional[Engine] = None,
+    ):
         self.ex = executor
-        self.storage = executor.storage
+        self.storage = storage if storage is not None else executor.storage
         self.params = params
         self.stats = QueryStats()
 
@@ -108,8 +114,23 @@ class CypherExecutor:
     def execute(
         self, query: str, params: Optional[Dict[str, Any]] = None
     ) -> CypherResult:
-        uq = parse(query)
-        ctx = _Ctx(self, params or {})
+        stripped = query.lstrip()
+        head = stripped[:7].upper()
+        rest = stripped[7:]
+        boundary = rest[:1] == "" or rest[:1].isspace()
+        if head == "EXPLAIN" and boundary:
+            return self._execute_explain(rest, params)
+        if head == "PROFILE" and boundary:
+            return self._execute_profile(rest, params)
+        return self._execute_parsed(parse(query), params)
+
+    def _execute_parsed(
+        self,
+        uq: "A.UnionQuery",
+        params: Optional[Dict[str, Any]],
+        storage: Optional[Engine] = None,
+    ) -> CypherResult:
+        ctx = _Ctx(self, params or {}, storage=storage)
         result: Optional[CypherResult] = None
         for i, part in enumerate(uq.parts):
             r = self._run_query(part, ctx)
@@ -130,6 +151,38 @@ class CypherExecutor:
                     result.rows = deduped
         result = result or CypherResult()
         result.stats = ctx.stats
+        return result
+
+    def _execute_explain(
+        self, query: str, params: Optional[Dict[str, Any]]
+    ) -> CypherResult:
+        """EXPLAIN: build and return the plan without executing
+        (reference: executeExplain, explain.go:95)."""
+        from nornicdb_tpu.query.explain import build_plan, plan_rows
+
+        uq = parse(query)
+        plan = build_plan(self.storage, uq)
+        cols, rows = plan_rows(plan, profiled=False)
+        return CypherResult(columns=cols, rows=rows, plan=plan.to_dict())
+
+    def _execute_profile(
+        self, query: str, params: Optional[Dict[str, Any]]
+    ) -> CypherResult:
+        """PROFILE: execute through a db-hit-counting storage proxy and
+        attach actuals to the plan (reference: executeProfile,
+        explain.go:110)."""
+        from nornicdb_tpu.query.explain import CountingEngine, build_plan
+
+        uq = parse(query)
+        plan = build_plan(self.storage, uq)
+        counting = CountingEngine(self.storage)
+        result = self._execute_parsed(uq, params, storage=counting)
+        root = plan.children[0] if plan.children else plan
+        root.db_hits = counting.hits
+        plan.actual_rows = root.actual_rows = len(result.rows)
+        # Neo4j semantics: PROFILE returns the query's records; the
+        # profiled plan rides on the result (summary-equivalent).
+        result.plan = plan.to_dict()
         return result
 
     def _run_query(self, q: A.Query, ctx: _Ctx) -> CypherResult:

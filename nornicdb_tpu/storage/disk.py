@@ -427,6 +427,10 @@ class DiskEngine(Engine):
     def count_edges_with_prefix(self, prefix: str) -> int:
         return self.kv.count_prefix(b"e:" + prefix.encode())
 
+    def count_nodes_by_label(self, label: str) -> int:
+        """Key-only count over the label index (no node fetches)."""
+        return self.kv.count_prefix(b"l:" + label.encode() + _SEP)
+
     def compact(self) -> None:
         self.kv.compact()
 

@@ -89,6 +89,12 @@ class MemoryEngine(Engine):
             ids = self._by_label.get(label, set())
             return [self._nodes[i].copy() for i in ids if i in self._nodes]
 
+    def count_nodes_by_label(self, label: str) -> int:
+        """Label cardinality without materializing nodes (EXPLAIN
+        row estimates probe this optionally)."""
+        with self._lock:
+            return len(self._by_label.get(label, ()))
+
     def all_nodes(self) -> Iterable[Node]:
         with self._lock:
             return [n.copy() for n in self._nodes.values()]

@@ -512,3 +512,69 @@ class TestCypherReviewRegressions:
         ex.execute("CREATE (:D {a: 1, b: 2})")
         r = ex.execute("MATCH (n:D) RETURN n.a AS x, n.b AS x")
         assert r.rows == [[1, 2]]
+
+
+class TestExplainProfile:
+    """Reference: pkg/cypher/explain.go:95,110 (EXPLAIN/PROFILE routing)."""
+
+    def test_explain_does_not_execute(self, ex):
+        r = ex.execute("EXPLAIN CREATE (n:Person {name: 'X'}) RETURN n")
+        assert r.plan is not None
+        assert r.plan["operator"] == "ProduceResults"
+        # nothing was created
+        check = ex.execute("MATCH (n:Person) RETURN count(n) AS c")
+        assert check.rows == [[0]]
+
+    def test_explain_plan_operators(self, ex):
+        _seed_social(ex)
+        r = ex.execute(
+            "EXPLAIN MATCH (p:Person)-[:KNOWS]->(q) "
+            "RETURN p.name ORDER BY p.name LIMIT 5"
+        )
+        ops = [row[0].lstrip("+") for row in r.rows]
+        assert "NodeByLabelScan" in ops
+        assert any(op.startswith("Expand") for op in ops)
+        assert "Limit" in ops and "Sort" in ops
+
+    def test_explain_aggregation_operator(self, ex):
+        r = ex.execute("EXPLAIN MATCH (n) RETURN count(n)")
+        ops = [row[0].lstrip("+") for row in r.rows]
+        assert "EagerAggregation" in ops
+
+    def test_profile_executes_and_counts_hits(self, ex):
+        _seed_social(ex)
+        r = ex.execute("PROFILE MATCH (p:Person) RETURN count(p) AS c")
+        assert r.plan is not None
+        # Neo4j semantics: PROFILE returns the query's records, the
+        # profiled plan rides on result.plan
+        assert r.columns == ["c"]
+        assert r.rows == [[3]]
+        root = r.plan["children"][0]
+        assert root["db_hits"] > 0
+        assert r.plan["actual_rows"] == 1
+
+    def test_profile_write_applies(self, ex):
+        r = ex.execute("PROFILE CREATE (n:Thing) RETURN n")
+        assert r.stats.nodes_created == 1
+        check = ex.execute("MATCH (n:Thing) RETURN count(n) AS c")
+        assert check.rows == [[1]]
+
+    def test_explain_requires_word_boundary(self, ex):
+        with pytest.raises((CypherSyntaxError, CypherRuntimeError)):
+            ex.execute("EXPLAINMATCH (n) RETURN n")
+        with pytest.raises((CypherSyntaxError, CypherRuntimeError)):
+            ex.execute("PROFILEMATCH (n) DETACH DELETE n")
+
+    def test_profile_concurrent_safe(self, ex):
+        """PROFILE must not mutate shared executor state."""
+        _seed_social(ex)
+        ex.execute("PROFILE MATCH (p:Person) RETURN count(p)")
+        from nornicdb_tpu.query.explain import CountingEngine
+        assert not isinstance(ex.storage, CountingEngine)
+
+    def test_explain_multihop_expand_sources(self, ex):
+        r = ex.execute(
+            "EXPLAIN MATCH (a:P)-[:X]->(b)-[:Y]->(c) RETURN c"
+        )
+        details = " ".join(str(row[1]) for row in r.rows)
+        assert "(b)-->[:Y](c)" in details
