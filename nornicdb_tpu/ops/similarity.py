@@ -102,6 +102,24 @@ def _cosine_topk_chunked_impl(
     return best_s, best_i
 
 
+# above this row count, route to the chunked kernel to bound HBM
+CHUNKED_THRESHOLD = 262_144
+
+
+def cosine_topk_auto(
+    queries: jnp.ndarray,
+    matrix: jnp.ndarray,
+    valid: jnp.ndarray,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense below CHUNKED_THRESHOLD rows, chunked above — the single
+    routing point so every caller (and every fallback) bounds HBM the
+    same way."""
+    if matrix.shape[0] > CHUNKED_THRESHOLD:
+        return cosine_topk_chunked(queries, matrix, valid, k)
+    return cosine_topk(queries, matrix, valid, k)
+
+
 def cosine_topk_chunked(
     queries: jnp.ndarray,
     matrix: jnp.ndarray,

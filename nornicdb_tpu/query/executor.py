@@ -162,7 +162,7 @@ class CypherExecutor:
 
         uq = parse(query)
         plan = build_plan(self.storage, uq)
-        cols, rows = plan_rows(plan, profiled=False)
+        cols, rows = plan_rows(plan)
         return CypherResult(columns=cols, rows=rows, plan=plan.to_dict())
 
     def _execute_profile(

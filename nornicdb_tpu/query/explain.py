@@ -53,7 +53,8 @@ class CountingEngine:
         "get_node", "get_edge", "get_nodes_by_label", "get_edges_by_type",
         "all_nodes", "all_edges", "get_node_edges", "neighbors", "degree",
         "batch_get_nodes", "has_node", "has_edge", "count_nodes",
-        "count_edges",
+        "count_edges", "count_nodes_by_label", "count_nodes_with_prefix",
+        "count_edges_with_prefix",
     }
     _WRITES = {
         "create_node", "update_node", "delete_node", "create_edge",
@@ -265,16 +266,12 @@ def _is_aggregating(e: A.Expr) -> bool:
     return _contains_agg(e)
 
 
-def plan_rows(plan: PlanNode, profiled: bool) -> Tuple[List[str], List[List[Any]]]:
-    """Render the plan tree as the tabular EXPLAIN/PROFILE output."""
+def plan_rows(plan: PlanNode) -> Tuple[List[str], List[List[Any]]]:
+    """Render the plan tree as the tabular EXPLAIN output. (PROFILE
+    returns the query's records; its plan rides on CypherResult.plan.)"""
     cols = ["Operator", "Details", "EstimatedRows"]
-    if profiled:
-        cols += ["Rows", "DbHits"]
     rows: List[List[Any]] = []
     for depth, n in plan.flatten():
         op = ("+" * depth) + n.operator if depth else n.operator
-        row: List[Any] = [op, n.details, n.estimated_rows]
-        if profiled:
-            row += [n.actual_rows, n.db_hits]
-        rows.append(row)
+        rows.append([op, n.details, n.estimated_rows])
     return cols, rows

@@ -54,7 +54,11 @@ def try_fast_path(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
             # O(1) engine count (reference: count fast path)
             return CypherResult(columns=[col], rows=[[ctx.storage.count_nodes()]])
         if len(pn.labels) == 1:
-            n = len(ctx.storage.get_nodes_by_label(pn.labels[0]))
+            counter = getattr(ctx.storage, "count_nodes_by_label", None)
+            if counter is not None:
+                n = counter(pn.labels[0])
+            else:
+                n = len(ctx.storage.get_nodes_by_label(pn.labels[0]))
             return CypherResult(columns=[col], rows=[[n]])
         return None
 

@@ -17,14 +17,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from nornicdb_tpu.ops.similarity import (
+    CHUNKED_THRESHOLD,
     cosine_topk,
+    cosine_topk_auto,
     cosine_topk_chunked,
     l2_normalize,
     pad_dim,
 )
 
-# above this row count, use the chunked kernel to bound HBM
-CHUNKED_THRESHOLD = 262_144
+
+def _use_pallas() -> bool:
+    """Opt-in fused Pallas top-k (NORNICDB_PALLAS_TOPK=1). Off by
+    default: on the single-chip bench the XLA matmul+top_k path is
+    dispatch-bound and already optimal; the fused kernel targets
+    large-batch / large-corpus servers."""
+    import os
+
+    return os.environ.get("NORNICDB_PALLAS_TOPK", "0") == "1"
 
 
 class BruteForceIndex:
@@ -150,10 +159,12 @@ class BruteForceIndex:
             m, valid = self._device_arrays()
             ext_ids = list(self._ext_ids)
         q = l2_normalize(jnp.asarray(queries, dtype=jnp.float32))
-        if m.shape[0] > CHUNKED_THRESHOLD:
-            s, i = cosine_topk_chunked(q, m, valid, k_eff)
+        if _use_pallas():
+            from nornicdb_tpu.ops.pallas_topk import fused_cosine_topk
+
+            s, i = fused_cosine_topk(q, m, valid, k_eff)
         else:
-            s, i = cosine_topk(q, m, valid, k_eff)
+            s, i = cosine_topk_auto(q, m, valid, k_eff)
         s = np.asarray(s)
         i = np.asarray(i)
         out: List[List[Tuple[str, float]]] = []
