@@ -37,6 +37,7 @@ class DB:
         embedder: Optional[Any] = None,
         auto_embed: bool = False,
         engine: str = "auto",  # auto | native | python | memory
+        replication: Optional[Any] = None,  # ReplicationConfig
     ):
         # engine chain: Disk/Durable/Memory -> [Async] -> Namespaced ->
         # Listenable (reference chain order: db.go:742-947; the listener
@@ -62,6 +63,16 @@ class DB:
         chain: Engine = base
         if async_writes:
             chain = AsyncEngine(chain)
+        self.replicator = None
+        self._cluster_transport = None
+        if replication is not None and replication.mode != "standalone":
+            try:
+                chain = self._enable_replication(chain, replication)
+            except Exception:
+                # don't leak the already-open engine chain (file locks,
+                # async flush thread) when replication wiring fails
+                chain.close()
+                raise
         self._listenable = ListenableEngine(NamespacedEngine(chain, database))
         self.storage = self._listenable
         self.database = database
@@ -78,6 +89,60 @@ class DB:
         self._inference = None
         if auto_embed and embedder is not None:
             self._start_embed_queue()
+
+    def _enable_replication(self, chain: Engine, cfg: Any) -> Engine:
+        """Insert the ReplicatedEngine into the chain (reference:
+        maybeEnableReplication, db.go:931,1261 — chain position
+        …→[Async]→[Replicated]→Namespaced). HA modes stream the base
+        WALEngine's log; Raft applies committed entries to the chain."""
+        from nornicdb_tpu.replication import (
+            ClusterTransport,
+            HAPrimary,
+            HAStandby,
+            RaftNode,
+            ReplicatedEngine,
+        )
+        from nornicdb_tpu.replication.replicator import decode_op_args
+        from nornicdb_tpu.storage.wal_engine import WALEngine
+
+        transport = ClusterTransport(cfg.node_id, cfg.listen)
+        transport.start()
+        self._cluster_transport = transport
+        if cfg.mode in ("ha_standby", "multi_region"):
+            if not isinstance(self._base, WALEngine):
+                transport.close()
+                raise ValueError(
+                    f"replication mode {cfg.mode!r} requires a WAL-backed "
+                    "engine (open with data_dir and engine='python')"
+                )
+            if not isinstance(chain, WALEngine):
+                # HA replicators write to the base WALEngine directly;
+                # an AsyncEngine overlay would be silently bypassed
+                transport.close()
+                raise ValueError(
+                    "async_writes cannot be combined with HA replication "
+                    "(writes route through the WAL primary directly)"
+                )
+            if cfg.ha_role == "primary":
+                rep = HAPrimary(self._base, transport, cfg)
+                rep.start()
+            else:
+                rep = HAStandby(
+                    self._base, transport, cfg,
+                    primary_addr=cfg.primary_addr,
+                )
+                rep.start()
+        elif cfg.mode == "raft":
+            def apply_fn(op, data, _chain=chain):
+                getattr(_chain, op)(*decode_op_args(op, data))
+
+            rep = RaftNode(transport, cfg, apply_fn)
+            rep.start()
+        else:
+            transport.close()
+            raise ValueError(f"unknown replication mode {cfg.mode!r}")
+        self.replicator = rep
+        return ReplicatedEngine(chain, rep)
 
     # -- service accessors ----------------------------------------------
 
@@ -224,6 +289,10 @@ class DB:
             self._embed_queue.stop()
         if self._decay is not None:
             self._decay.stop()
+        if self.replicator is not None:
+            self.replicator.close()
+        if self._cluster_transport is not None:
+            self._cluster_transport.close()
         self.storage.close()
 
     def __enter__(self) -> "DB":

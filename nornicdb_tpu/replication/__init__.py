@@ -1,0 +1,38 @@
+"""Replication: control plane for HA standby, Raft, and multi-region.
+
+Reference: pkg/replication — modes standalone/ha_standby/raft/multi_region
+(config.go:104-129), sync modes async/quorum (config.go:133-142), Raft
+elections (raft.go:14-60), HA standby WAL streaming + heartbeat + fencing
++ auto-failover (ha_standby.go:170-779), ReplicatedEngine
+(replicated_engine.go), custom TCP cluster transport (transport.go:53-158).
+
+TPU-native split (SURVEY.md §5 "Distributed communication backend"):
+the consensus/metadata control plane stays on the host CPU over this TCP
+mesh; bulk vector data movement (index shard rebuilds, replica embedding
+sync, multi-chip search fan-out) rides XLA collectives over ICI/DCN —
+see nornicdb_tpu.parallel.mesh (sharded kNN psum/all_gather paths).
+"""
+
+from nornicdb_tpu.replication.transport import ClusterTransport, ClusterMessage
+from nornicdb_tpu.replication.replicator import (
+    NotPrimaryError,
+    ReplicationConfig,
+    Replicator,
+    Role,
+)
+from nornicdb_tpu.replication.replicated_engine import ReplicatedEngine
+from nornicdb_tpu.replication.ha_standby import HAPrimary, HAStandby
+from nornicdb_tpu.replication.raft import RaftNode
+
+__all__ = [
+    "ClusterMessage",
+    "ClusterTransport",
+    "HAPrimary",
+    "HAStandby",
+    "NotPrimaryError",
+    "RaftNode",
+    "ReplicatedEngine",
+    "ReplicationConfig",
+    "Replicator",
+    "Role",
+]

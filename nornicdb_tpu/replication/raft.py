@@ -1,0 +1,309 @@
+"""Raft consensus: leader election + log replication over the cluster mesh.
+
+Reference: pkg/replication/raft.go:14-60 (Raft mode) — terms, randomized
+election timeouts, RequestVote / AppendEntries, majority commit, state
+machine apply. The state machine here is a storage engine: committed
+entries are {op, data} mutations applied through the same vocabulary as
+WAL records, so a Raft cluster and an HA pair converge via identical
+replay code.
+
+Single-process multi-node testing: construct N RaftNodes sharing loopback
+transports (or call handlers directly), as the reference's replication
+tests do (replication_test.go, scenario_test.go).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nornicdb_tpu.replication.replicator import (
+    NotPrimaryError,
+    ReplicationConfig,
+    Replicator,
+    Role,
+)
+from nornicdb_tpu.replication.transport import ClusterMessage, ClusterTransport
+
+
+class RaftNode(Replicator):
+    """One Raft participant. States: follower (STANDBY), candidate,
+    leader (PRIMARY)."""
+
+    def __init__(
+        self,
+        transport: ClusterTransport,
+        config: ReplicationConfig,
+        apply_fn: Callable[[str, Dict[str, Any]], None],
+    ):
+        self.transport = transport
+        self.config = config
+        self.apply_fn = apply_fn
+
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.log: List[Dict[str, Any]] = []  # {term, op, data}
+        self.commit_index = 0  # 1-based count of committed entries
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+
+        self._state = Role.STANDBY
+        self._lock = threading.Lock()
+        self._commit_cv = threading.Condition(self._lock)
+        self._last_leader_contact = time.monotonic()
+        self._closed = threading.Event()
+        # leader bookkeeping: next log index to send each peer (1-based)
+        self._next_index: Dict[Tuple[str, int], int] = {}
+
+        transport.register_handler("request_vote", self.handle_request_vote)
+        transport.register_handler("append_entries", self.handle_append_entries)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        threading.Thread(
+            target=self._election_loop, daemon=True,
+            name=f"raft-elect-{self.config.node_id}",
+        ).start()
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._commit_cv:
+            self._commit_cv.notify_all()
+
+    # -- replicator ------------------------------------------------------
+
+    @property
+    def role(self) -> Role:
+        with self._lock:
+            return self._state
+
+    def apply(self, op: str, data: Dict[str, Any]) -> None:
+        """Append to the leader's log, replicate, wait for majority
+        commit, then apply. Raises NotPrimaryError on followers."""
+        with self._lock:
+            if self._state is not Role.PRIMARY:
+                raise NotPrimaryError(self.leader_id)
+            entry = {"term": self.term, "op": op, "data": data}
+            self.log.append(entry)
+            target = len(self.log)
+        self._replicate_once()
+        deadline = time.monotonic() + 5.0
+        with self._commit_cv:
+            while self.commit_index < target:
+                if self._state is not Role.PRIMARY:
+                    raise NotPrimaryError(self.leader_id)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("raft commit timeout")
+                self._commit_cv.wait(timeout=min(remaining, 0.2))
+
+    # -- election --------------------------------------------------------
+
+    def _election_timeout(self) -> float:
+        lo, hi = self.config.election_timeout
+        return random.uniform(lo, hi)
+
+    def _election_loop(self) -> None:
+        timeout = self._election_timeout()
+        while not self._closed.is_set():
+            self._closed.wait(0.05)
+            with self._lock:
+                state = self._state
+                silent = time.monotonic() - self._last_leader_contact
+            if state is Role.PRIMARY:
+                self._heartbeat()
+                self._closed.wait(self.config.heartbeat_interval)
+            elif silent > timeout:
+                self._run_election()
+                timeout = self._election_timeout()
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self._state = Role.CANDIDATE
+            self.term += 1
+            self.voted_for = self.config.node_id
+            term = self.term
+            last_idx = len(self.log)
+            last_term = self.log[-1]["term"] if self.log else 0
+            self._last_leader_contact = time.monotonic()
+        votes = 1
+        replies = self.transport.broadcast(
+            self.config.peers,
+            {
+                "type": "request_vote",
+                "term": term,
+                "candidate": self.config.node_id,
+                "last_log_index": last_idx,
+                "last_log_term": last_term,
+            },
+            timeout=max(self.config.election_timeout[0] / 2, 0.3),
+        )
+        for r in replies.values():
+            if r is None:
+                continue
+            if r.get("term", 0) > term:
+                with self._lock:
+                    self._step_down(r["term"])
+                return
+            if r.get("vote_granted"):
+                votes += 1
+        need = (len(self.config.peers) + 1) // 2 + 1
+        with self._lock:
+            if self._state is Role.CANDIDATE and self.term == term and votes >= need:
+                self._state = Role.PRIMARY
+                self.leader_id = self.config.node_id
+                self._next_index = {
+                    tuple(p): len(self.log) + 1 for p in self.config.peers
+                }
+        if self.role is Role.PRIMARY:
+            self._heartbeat()
+
+    def _step_down(self, term: int) -> None:
+        """Caller holds the lock."""
+        self.term = term
+        self._state = Role.STANDBY
+        self.voted_for = None
+
+    # -- replication -----------------------------------------------------
+
+    def _entries_for(self, peer: Tuple[str, int]) -> ClusterMessage:
+        """Caller holds the lock."""
+        nxt = self._next_index.get(peer, len(self.log) + 1)
+        prev_idx = nxt - 1
+        prev_term = self.log[prev_idx - 1]["term"] if prev_idx >= 1 and self.log else 0
+        return {
+            "type": "append_entries",
+            "term": self.term,
+            "leader": self.config.node_id,
+            "prev_log_index": prev_idx,
+            "prev_log_term": prev_term,
+            "entries": self.log[prev_idx:],
+            "leader_commit": self.commit_index,
+        }
+
+    def _heartbeat(self) -> None:
+        self._replicate_once()
+
+    def _replicate_once(self) -> None:
+        with self._lock:
+            if self._state is not Role.PRIMARY:
+                return
+            peers = [tuple(p) for p in self.config.peers]
+            msgs = {p: self._entries_for(p) for p in peers}
+            term = self.term
+        match_counts: Dict[int, int] = {}
+        for p in peers:
+            try:
+                r = self.transport.request(
+                    p, msgs[p], timeout=self.config.heartbeat_interval
+                )
+            except ConnectionError:
+                continue
+            if r.get("term", 0) > term:
+                with self._lock:
+                    self._step_down(r["term"])
+                return
+            with self._lock:
+                if r.get("ok"):
+                    matched = r.get("match_index", 0)
+                    self._next_index[p] = matched + 1
+                    match_counts[matched] = match_counts.get(matched, 0) + 1
+                else:
+                    # log inconsistency: back off and retry next round
+                    self._next_index[p] = max(1, self._next_index.get(p, 1) - 1)
+        self._advance_commit(match_counts)
+
+    def _advance_commit(self, match_counts: Dict[int, int]) -> None:
+        with self._commit_cv:
+            if self._state is not Role.PRIMARY:
+                return
+            need = (len(self.config.peers) + 1) // 2 + 1
+            for idx in sorted(match_counts, reverse=True):
+                # count of replicas (leader + peers at >= idx)
+                replicas = 1 + sum(
+                    c for m, c in match_counts.items() if m >= idx
+                )
+                if (
+                    idx > self.commit_index
+                    and replicas >= need
+                    and self.log[idx - 1]["term"] == self.term
+                ):
+                    self.commit_index = idx
+                    break
+            self._apply_committed()
+            self._commit_cv.notify_all()
+
+    def _apply_committed(self) -> None:
+        """Caller holds the lock."""
+        while self.last_applied < self.commit_index:
+            entry = self.log[self.last_applied]
+            self.last_applied += 1
+            try:
+                self.apply_fn(entry["op"], entry["data"])
+            except Exception:
+                pass  # state-machine apply must not wedge consensus
+
+    # -- handlers (directly callable in tests) ---------------------------
+
+    def handle_request_vote(self, msg: ClusterMessage) -> ClusterMessage:
+        with self._lock:
+            term = msg.get("term", 0)
+            if term < self.term:
+                return {"term": self.term, "vote_granted": False}
+            if term > self.term:
+                self._step_down(term)
+            up_to_date = (
+                msg.get("last_log_term", 0),
+                msg.get("last_log_index", 0),
+            ) >= (
+                self.log[-1]["term"] if self.log else 0,
+                len(self.log),
+            )
+            if (
+                self.voted_for in (None, msg.get("candidate"))
+                and up_to_date
+            ):
+                self.voted_for = msg.get("candidate")
+                self._last_leader_contact = time.monotonic()
+                return {"term": self.term, "vote_granted": True}
+            return {"term": self.term, "vote_granted": False}
+
+    def handle_append_entries(self, msg: ClusterMessage) -> ClusterMessage:
+        with self._commit_cv:
+            term = msg.get("term", 0)
+            if term < self.term:
+                return {"term": self.term, "ok": False}
+            if term > self.term or self._state is not Role.STANDBY:
+                self._step_down(term)
+            self.term = term
+            self.leader_id = msg.get("leader")
+            self._last_leader_contact = time.monotonic()
+
+            prev_idx = msg.get("prev_log_index", 0)
+            prev_term = msg.get("prev_log_term", 0)
+            if prev_idx > len(self.log):
+                return {"term": self.term, "ok": False}
+            if prev_idx >= 1 and self.log[prev_idx - 1]["term"] != prev_term:
+                return {"term": self.term, "ok": False}
+            # append entries, truncating only on an actual term conflict
+            # (a stale/heartbeat AppendEntries must never drop good
+            # entries past prev_idx)
+            entries = msg.get("entries", [])
+            idx = prev_idx
+            for e in entries:
+                if idx < len(self.log):
+                    if self.log[idx]["term"] != e.get("term"):
+                        self.log = self.log[:idx]
+                        self.log.append(e)
+                else:
+                    self.log.append(e)
+                idx += 1
+            match_index = prev_idx + len(entries)
+            leader_commit = msg.get("leader_commit", 0)
+            if leader_commit > self.commit_index:
+                self.commit_index = min(leader_commit, len(self.log))
+                self._apply_committed()
+            return {"term": self.term, "ok": True, "match_index": match_index}

@@ -1,0 +1,49 @@
+"""ReplicatedEngine: storage decorator routing writes through a Replicator.
+
+Reference: pkg/replication/replicated_engine.go — writes go through
+Replicator.Apply (replicator.go:53) so they are sequenced/streamed to
+replicas; reads hit the local engine. The op/data vocabulary matches the
+WAL record format (storage/wal_engine.py apply_record) so followers can
+replay the stream through the identical code path used for crash
+recovery.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from nornicdb_tpu.replication.replicator import Replicator
+from nornicdb_tpu.storage.types import Edge, EngineDecorator, Engine, Node
+
+
+class ReplicatedEngine(EngineDecorator):
+    def __init__(self, inner: Engine, replicator: Replicator):
+        super().__init__(inner)
+        self.replicator = replicator
+
+    # -- mutations route through the replicator --------------------------
+
+    def create_node(self, node: Node) -> None:
+        self.replicator.apply("create_node", node.to_dict())
+
+    def update_node(self, node: Node) -> None:
+        self.replicator.apply("update_node", node.to_dict())
+
+    def delete_node(self, node_id: str) -> None:
+        self.replicator.apply("delete_node", {"id": node_id})
+
+    def create_edge(self, edge: Edge) -> None:
+        self.replicator.apply("create_edge", edge.to_dict())
+
+    def update_edge(self, edge: Edge) -> None:
+        self.replicator.apply("update_edge", edge.to_dict())
+
+    def delete_edge(self, edge_id: str) -> None:
+        self.replicator.apply("delete_edge", {"id": edge_id})
+
+    def delete_by_prefix(self, prefix: str) -> Tuple[int, int]:
+        # count what will go for the caller, then replicate the logical op
+        n = sum(1 for node in self.inner.all_nodes() if node.id.startswith(prefix))
+        e = sum(1 for edge in self.inner.all_edges() if edge.id.startswith(prefix))
+        self.replicator.apply("delete_by_prefix", {"prefix": prefix})
+        return n, e

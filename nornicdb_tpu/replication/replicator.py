@@ -1,0 +1,78 @@
+"""Replicator contract + configuration.
+
+Reference: pkg/replication/replicator.go:53 (Replicator.Apply — every
+write on a replicated node routes through the replicator), config.go:
+104-142 (modes standalone/ha_standby/raft/multi_region; sync modes
+async/quorum).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Role(str, enum.Enum):
+    PRIMARY = "primary"
+    STANDBY = "standby"
+    CANDIDATE = "candidate"  # raft only
+
+
+class NotPrimaryError(RuntimeError):
+    """Raised when a write lands on a non-primary replica; carries the
+    current leader hint so API layers can redirect."""
+
+    def __init__(self, leader: Optional[str] = None):
+        super().__init__(
+            "not primary" + (f" (leader: {leader})" if leader else "")
+        )
+        self.leader = leader
+
+
+@dataclass
+class ReplicationConfig:
+    """Reference: config.go:104-142."""
+
+    mode: str = "standalone"  # standalone | ha_standby | raft | multi_region
+    sync: str = "async"  # async | quorum
+    node_id: str = "node-0"
+    listen: Tuple[str, int] = ("127.0.0.1", 0)
+    peers: List[Tuple[str, int]] = field(default_factory=list)
+    heartbeat_interval: float = 0.5
+    election_timeout: Tuple[float, float] = (1.5, 3.0)  # randomized range
+    failover_timeout: float = 3.0  # missed-heartbeat window before takeover
+    ha_role: str = "primary"  # primary | standby (ha_standby/multi_region)
+    primary_addr: Optional[Tuple[str, int]] = None  # standby's upstream
+
+
+class Replicator:
+    """Base: applies mutations locally and replicates them. Subclasses:
+    HAPrimary/HAStandby (ha_standby.py), RaftNode (raft.py)."""
+
+    def apply(self, op: str, data: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    @property
+    def role(self) -> Role:
+        raise NotImplementedError
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+
+def decode_op_args(op: str, data: Dict[str, Any]) -> tuple:
+    """Decode a replicated op payload into engine-call args (same
+    op/data vocabulary as WAL records, storage/wal_engine.py
+    apply_record)."""
+    from nornicdb_tpu.storage.types import Edge, Node
+
+    if op in ("create_node", "update_node"):
+        return (Node.from_dict(data),)
+    if op in ("create_edge", "update_edge"):
+        return (Edge.from_dict(data),)
+    if op in ("delete_node", "delete_edge"):
+        return (data["id"],)
+    if op == "delete_by_prefix":
+        return (data["prefix"],)
+    raise ValueError(f"unknown replicated op {op}")

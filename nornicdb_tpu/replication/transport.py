@@ -1,0 +1,213 @@
+"""Cluster transport: length-prefixed JSON request/response over TCP.
+
+Reference: pkg/replication/transport.go:53-158 (ClusterTransport /
+ClusterMessage / MessageHandler), connection management (transport.go:375+),
+TLS (transport_security.go). Frame format: ``uint32 big-endian payload
+length | JSON payload``. Every request gets a response frame (possibly an
+empty ack) so callers can implement quorum waits.
+
+Handlers are registered per message type and run on the connection's
+reader thread; they must be fast or hand off to their own executor.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import ssl
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+ClusterMessage = Dict[str, Any]
+MessageHandler = Callable[[ClusterMessage], Optional[ClusterMessage]]
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class TransportError(ConnectionError):
+    pass
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> ClusterMessage:
+    (length,) = _LEN.unpack(_read_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise TransportError(f"frame too large: {length}")
+    return json.loads(_read_exact(sock, length).decode("utf-8"))
+
+
+def write_frame(sock: socket.socket, msg: ClusterMessage) -> None:
+    payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+class _Conn(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        transport: "ClusterTransport" = self.server.transport  # type: ignore[attr-defined]
+        sock = self.request
+        try:
+            while not transport._closed.is_set():
+                msg = read_frame(sock)
+                resp = transport._dispatch(msg)
+                write_frame(sock, resp if resp is not None else {"ok": True})
+        except (TransportError, OSError, json.JSONDecodeError):
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ClusterTransport:
+    """One node's endpoint in the cluster mesh. Thread-safe.
+
+    - ``register_handler(type, fn)`` — serve requests of that type.
+    - ``request(addr, msg)`` — synchronous RPC to a peer (pooled conns).
+    - ``broadcast(addrs, msg)`` — best-effort fan-out, returns replies.
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        listen_addr: Tuple[str, int] = ("127.0.0.1", 0),
+        ssl_server: Optional[ssl.SSLContext] = None,
+        ssl_client: Optional[ssl.SSLContext] = None,
+    ):
+        self.node_id = node_id
+        self._handlers: Dict[str, MessageHandler] = {}
+        self._pool: Dict[Tuple[str, int], socket.socket] = {}
+        self._pool_lock = threading.Lock()
+        self._handlers_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._ssl_server = ssl_server
+        self._ssl_client = ssl_client
+        self._server = _Server(listen_addr, _Conn, bind_and_activate=False)
+        self._server.transport = self  # type: ignore[attr-defined]
+        if ssl_server is not None:
+            self._server.socket = ssl_server.wrap_socket(
+                self._server.socket, server_side=True
+            )
+        self._server.server_bind()
+        self._server.server_activate()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return self._server.socket.getsockname()[:2]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"cluster-{self.node_id}",
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._server.shutdown()
+        self._server.server_close()
+        with self._pool_lock:
+            for sock in self._pool.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._pool.clear()
+
+    def register_handler(self, msg_type: str, fn: MessageHandler) -> None:
+        with self._handlers_lock:
+            self._handlers[msg_type] = fn
+
+    def _dispatch(self, msg: ClusterMessage) -> Optional[ClusterMessage]:
+        with self._handlers_lock:
+            fn = self._handlers.get(msg.get("type", ""))
+        if fn is None:
+            return {"ok": False, "error": f"no handler for {msg.get('type')}"}
+        try:
+            return fn(msg)
+        except Exception as e:  # handler bugs become error replies
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    # -- client side -----------------------------------------------------
+
+    def _connect(self, addr: Tuple[str, int], timeout: float) -> socket.socket:
+        sock = socket.create_connection(addr, timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._ssl_client is not None:
+            sock = self._ssl_client.wrap_socket(sock, server_hostname=addr[0])
+        return sock
+
+    def request(
+        self,
+        addr: Tuple[str, int],
+        msg: ClusterMessage,
+        timeout: float = 5.0,
+    ) -> ClusterMessage:
+        """Send one message and wait for its response frame. Connections
+        are pooled per peer; a broken pooled connection is retried once
+        on a fresh socket."""
+        msg = dict(msg)
+        msg.setdefault("from", self.node_id)
+        key = tuple(addr)
+        for attempt in (0, 1):
+            with self._pool_lock:
+                sock = self._pool.pop(key, None)
+            try:
+                if sock is None:
+                    sock = self._connect(key, timeout)
+                sock.settimeout(timeout)
+                write_frame(sock, msg)
+                resp = read_frame(sock)
+                with self._pool_lock:
+                    if not self._closed.is_set():
+                        self._pool[key] = sock
+                return resp
+            except (OSError, TransportError, json.JSONDecodeError):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                if attempt == 1:
+                    raise TransportError(f"request to {addr} failed")
+        raise TransportError(f"request to {addr} failed")  # unreachable
+
+    def broadcast(
+        self,
+        addrs: list,
+        msg: ClusterMessage,
+        timeout: float = 5.0,
+    ) -> Dict[Tuple[str, int], Optional[ClusterMessage]]:
+        """Parallel best-effort fan-out; unreachable peers map to None."""
+        results: Dict[Tuple[str, int], Optional[ClusterMessage]] = {}
+        lock = threading.Lock()
+
+        def one(addr):
+            try:
+                r = self.request(tuple(addr), msg, timeout)
+            except TransportError:
+                r = None
+            with lock:
+                results[tuple(addr)] = r
+
+        threads = [
+            threading.Thread(target=one, args=(a,), daemon=True) for a in addrs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout + 1.0)
+        return results
