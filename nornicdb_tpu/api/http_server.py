@@ -296,6 +296,18 @@ class HttpServer:
         if segments[:1] == ["nornicdb"]:
             return self._nornicdb_routes(method, segments, payload, query, username)
 
+        # Qdrant-compatible REST surface (reference: pkg/qdrantgrpc
+        # translated onto storage+search; REST here speaks the Qdrant
+        # HTTP wire format)
+        if segments[:1] == ["collections"]:
+            self.authorize(
+                username, self.default_database,
+                WRITE if method in ("PUT", "DELETE") or
+                (len(segments) >= 3 and segments[2] == "points" and
+                 segments[-1] in ("delete",)) else READ,
+            )
+            return self._qdrant_routes(method, segments, payload, query)
+
         # admin
         if segments[:1] == ["admin"]:
             return self._admin_routes(method, segments, payload, username)
@@ -483,6 +495,90 @@ class HttpServer:
 
         raise HTTPError(404, "Neo.ClientError.Request.Invalid",
                         f"no route /nornicdb/{action}")
+
+    # -- qdrant-compatible REST ------------------------------------------
+
+    @property
+    def qdrant(self):
+        return self.db.qdrant_compat
+
+    def _qdrant_routes(self, method: str, segments: List[str],
+                       payload: Dict[str, Any],
+                       query: Dict[str, str]) -> Tuple[int, Any]:
+        """Qdrant REST wire format: every response is
+        {"result": ..., "status": "ok", "time": seconds}."""
+        from nornicdb_tpu.api.qdrant import QdrantError
+
+        t0 = time.time()
+
+        def ok(result: Any, status: int = 200) -> Tuple[int, Any]:
+            return status, {"result": result, "status": "ok",
+                            "time": time.time() - t0}
+
+        try:
+            q = self.qdrant
+            if len(segments) == 1 and method == "GET":
+                return ok({"collections": [
+                    {"name": n} for n in q.list_collections()
+                ]})
+            name = segments[1] if len(segments) > 1 else ""
+            if len(segments) == 2:
+                if method == "PUT":
+                    return ok(q.create_collection(
+                        name, payload.get("vectors")))
+                if method == "DELETE":
+                    return ok(q.delete_collection(name))
+                if method == "GET":
+                    return ok(q.get_collection(name))
+            if len(segments) >= 3 and segments[2] == "points":
+                action = segments[3] if len(segments) > 3 else ""
+                if method == "PUT" and not action:
+                    n = q.upsert_points(name, payload.get("points", []))
+                    return ok({"operation_id": n, "status": "completed"})
+                if method == "POST" and not action:
+                    return ok(q.retrieve_points(
+                        name, payload.get("ids", []),
+                        with_payload=payload.get("with_payload", True),
+                        with_vector=payload.get("with_vector", False)))
+                if method == "POST" and action == "search":
+                    return ok(q.search_points(
+                        name, payload.get("vector", []),
+                        limit=int(payload.get("limit", 10)),
+                        with_payload=payload.get("with_payload", True),
+                        with_vector=payload.get("with_vector", False),
+                        score_threshold=payload.get("score_threshold"),
+                        query_filter=payload.get("filter")))
+                if method == "POST" and action == "query":
+                    # universal query API subset: nearest by raw vector
+                    qv = payload.get("query")
+                    if isinstance(qv, dict):
+                        qv = qv.get("nearest")
+                    pts = q.search_points(
+                        name, qv or [],
+                        limit=int(payload.get("limit", 10)),
+                        with_payload=payload.get("with_payload", True),
+                        with_vector=payload.get("with_vector", False),
+                        query_filter=payload.get("filter"))
+                    return ok({"points": pts})
+                if method == "POST" and action == "delete":
+                    n = q.delete_points(
+                        name,
+                        payload.get("points", payload.get("ids", [])))
+                    return ok({"operation_id": n, "status": "completed"})
+                if method == "POST" and action == "count":
+                    return ok({"count": q.count_points(name)})
+                if method == "POST" and action == "scroll":
+                    return ok(q.scroll_points(
+                        name,
+                        offset=payload.get("offset"),
+                        limit=int(payload.get("limit", 10)),
+                        with_payload=payload.get("with_payload", True),
+                        with_vector=payload.get("with_vector", False)))
+        except QdrantError as e:
+            return e.status, {"status": {"error": str(e)},
+                              "time": time.time() - t0}
+        raise HTTPError(404, "Neo.ClientError.Request.Invalid",
+                        f"no qdrant route {method} /{'/'.join(segments)}")
 
     # -- admin -----------------------------------------------------------
 

@@ -1,0 +1,402 @@
+"""Qdrant-compatible translation layer: collections/points onto storage+search.
+
+Reference: pkg/qdrantgrpc — Collections/Points services translated onto
+NornicDB storage + search (points_service.go, collections_service.go),
+per-collection vector index cache (vector_index_cache.go), embedding-
+ownership rule (COMPAT.md:12-14: vectors supplied by the client are
+authoritative; NornicDB never re-embeds them).
+
+Exposed over two surfaces: the Qdrant REST wire format
+(api/http_server.py `/collections/...` routes) and gRPC
+(api/grpc_server.py). Collections are persisted as meta nodes and points
+as labeled nodes, so they survive restart; per-collection brute-force
+device indexes are rebuilt lazily on first search.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.search.vector_index import BruteForceIndex
+from nornicdb_tpu.storage.types import Node, now_ms
+
+_META_PREFIX = "qdrant-meta/"
+_POINT_PREFIX = "qdrant/"
+_COLLECTION_LABEL = "_QdrantCollection"
+
+
+class QdrantError(ValueError):
+    def __init__(self, message: str, status: int = 400):
+        super().__init__(message)
+        self.status = status
+
+
+def _point_node_id(collection: str, point_id: Any) -> str:
+    return f"{_POINT_PREFIX}{collection}/{point_id}"
+
+
+class QdrantCompat:
+    """Collection + point operations with Qdrant semantics."""
+
+    def __init__(self, storage):
+        self.storage = storage
+        self._indexes: Dict[str, BruteForceIndex] = {}
+        self._lock = threading.Lock()
+
+    # -- collections -----------------------------------------------------
+
+    def create_collection(
+        self, name: str, vectors: Optional[Dict[str, Any]] = None
+    ) -> bool:
+        """PUT /collections/{name}. vectors: {"size": N, "distance": "Cosine"}."""
+        meta_id = _META_PREFIX + name
+        if self.storage.has_node(meta_id):
+            raise QdrantError(f"collection `{name}` already exists")
+        distance = (vectors or {}).get("distance", "Cosine")
+        if distance not in ("Cosine", "Dot", "Euclid"):
+            raise QdrantError(f"unsupported distance {distance!r}")
+        cfg = {
+            "size": int((vectors or {}).get("size", 0)),
+            "distance": distance,
+        }
+        self.storage.create_node(Node(
+            id=meta_id,
+            labels=[_COLLECTION_LABEL],
+            properties={"name": name, "config": cfg,
+                        "created_at": now_ms()},
+        ))
+        with self._lock:
+            self._indexes[name] = BruteForceIndex()
+        return True
+
+    def delete_collection(self, name: str) -> bool:
+        meta_id = _META_PREFIX + name
+        if not self.storage.has_node(meta_id):
+            return False
+        for node in self.storage.get_nodes_by_label(self._label(name)):
+            self.storage.delete_node(node.id)
+        self.storage.delete_node(meta_id)
+        with self._lock:
+            self._indexes.pop(name, None)
+        return True
+
+    def list_collections(self) -> List[str]:
+        return sorted(
+            n.properties.get("name", "")
+            for n in self.storage.get_nodes_by_label(_COLLECTION_LABEL)
+        )
+
+    def get_collection(self, name: str) -> Dict[str, Any]:
+        meta = self._meta(name)
+        return {
+            "status": "green",
+            "optimizer_status": "ok",
+            "points_count": self.count_points(name),
+            "indexed_vectors_count": len(self._index(name)),
+            "segments_count": 1,
+            "config": {
+                "params": {"vectors": meta.properties.get("config", {})},
+            },
+        }
+
+    def _meta(self, name: str) -> Node:
+        try:
+            return self.storage.get_node(_META_PREFIX + name)
+        except (KeyError, NotFoundError):
+            raise QdrantError(f"collection `{name}` not found", status=404)
+
+    @staticmethod
+    def _label(name: str) -> str:
+        return f"_Qdrant:{name}"
+
+    # -- index cache (reference: vector_index_cache.go) -------------------
+
+    def _index(self, name: str) -> BruteForceIndex:
+        with self._lock:
+            idx = self._indexes.get(name)
+            if idx is not None:
+                return idx
+        # lazy rebuild from storage (post-restart)
+        self._meta(name)  # raises if collection doesn't exist
+        idx = BruteForceIndex()
+        for node in self.storage.get_nodes_by_label(self._label(name)):
+            vec = node.properties.get("_vector")
+            if vec:
+                idx.add(node.id, vec)
+        with self._lock:
+            return self._indexes.setdefault(name, idx)
+
+    # -- points ----------------------------------------------------------
+
+    def upsert_points(
+        self, name: str, points: Sequence[Dict[str, Any]]
+    ) -> int:
+        """PUT /collections/{name}/points. Client vectors are
+        authoritative (embedding-ownership rule, COMPAT.md:12-14).
+        The whole batch is validated before any write so a bad point
+        never leaves a partially-applied batch."""
+        meta = self._meta(name)
+        want = meta.properties.get("config", {}).get("size", 0)
+        idx = self._index(name)
+        if not want:
+            want = idx.dims or 0
+        # pass 1: validate everything
+        for p in points:
+            if "id" not in p:
+                raise QdrantError("point missing id")
+            vec = p.get("vector") or []
+            if vec:
+                if want and len(vec) != want:
+                    raise QdrantError(
+                        f"vector size {len(vec)} != collection size {want}"
+                    )
+                want = want or len(vec)
+        # pass 2: apply
+        n = 0
+        for p in points:
+            vec = p.get("vector") or []
+            nid = _point_node_id(name, p["id"])
+            node = Node(
+                id=nid,
+                labels=[self._label(name)],
+                properties={
+                    "_point_id": p["id"],
+                    "_vector": list(map(float, vec)),
+                    "payload": p.get("payload") or {},
+                },
+            )
+            if self.storage.has_node(nid):
+                self.storage.update_node(node)
+            else:
+                self.storage.create_node(node)
+            if vec:
+                idx.add(nid, vec)
+            n += 1
+        return n
+
+    def retrieve_points(
+        self,
+        name: str,
+        ids: Sequence[Any],
+        with_payload: bool = True,
+        with_vector: bool = False,
+    ) -> List[Dict[str, Any]]:
+        self._meta(name)
+        out = []
+        for pid in ids:
+            try:
+                node = self.storage.get_node(_point_node_id(name, pid))
+            except (KeyError, NotFoundError):
+                continue
+            out.append(self._point_dict(node, with_payload, with_vector))
+        return out
+
+    def delete_points(self, name: str, ids: Sequence[Any]) -> int:
+        self._meta(name)
+        idx = self._index(name)
+        n = 0
+        for pid in ids:
+            nid = _point_node_id(name, pid)
+            if self.storage.has_node(nid):
+                self.storage.delete_node(nid)
+                idx.remove(nid)
+                n += 1
+        return n
+
+    def count_points(self, name: str) -> int:
+        self._meta(name)
+        counter = getattr(self.storage, "count_nodes_by_label", None)
+        if counter is not None:
+            return counter(self._label(name))
+        return len(self.storage.get_nodes_by_label(self._label(name)))
+
+    def scroll_points(
+        self,
+        name: str,
+        offset: Optional[Any] = None,
+        limit: int = 10,
+        with_payload: bool = True,
+        with_vector: bool = False,
+    ) -> Dict[str, Any]:
+        self._meta(name)
+        nodes = sorted(
+            self.storage.get_nodes_by_label(self._label(name)),
+            key=lambda n: str(n.properties.get("_point_id")),
+        )
+        if offset is not None:
+            nodes = [
+                n for n in nodes
+                if str(n.properties.get("_point_id")) >= str(offset)
+            ]
+        page = nodes[:limit]
+        next_off = (
+            str(nodes[limit].properties.get("_point_id"))
+            if len(nodes) > limit else None
+        )
+        return {
+            "points": [
+                self._point_dict(n, with_payload, with_vector) for n in page
+            ],
+            "next_page_offset": next_off,
+        }
+
+    def search_points(
+        self,
+        name: str,
+        vector: Sequence[float],
+        limit: int = 10,
+        with_payload: bool = True,
+        with_vector: bool = False,
+        score_threshold: Optional[float] = None,
+        query_filter: Optional[Dict[str, Any]] = None,
+    ) -> List[Dict[str, Any]]:
+        """POST /collections/{name}/points/search — brute-force device
+        kNN over the collection's index (reference: search path
+        points_service.go via SearchServiceProvider, server.go:167).
+
+        Distance semantics follow the collection config: Cosine rides
+        the normalized device index; Dot/Euclid score the raw client
+        vectors (magnitudes preserved; Euclid scores are negated
+        distances so higher-is-better ordering holds uniformly, with
+        score_threshold compared on the true distance)."""
+        if not vector:
+            raise QdrantError("search vector is required")
+        meta = self._meta(name)
+        distance = meta.properties.get("config", {}).get("distance", "Cosine")
+        if distance == "Cosine":
+            ranked = self._ranked_cosine(name, vector)
+        else:
+            ranked = self._ranked_raw(name, vector, distance)
+        out = []
+        for nid, score in ranked:
+            if score_threshold is not None:
+                true_score = -score if distance == "Euclid" else score
+                if distance == "Euclid":
+                    if true_score > score_threshold:
+                        continue
+                elif true_score < score_threshold:
+                    continue
+            try:
+                node = self.storage.get_node(nid)
+            except (KeyError, NotFoundError):
+                continue
+            if query_filter is not None and not _match_filter(
+                node.properties.get("payload") or {}, query_filter
+            ):
+                continue
+            d = self._point_dict(node, with_payload, with_vector)
+            d["score"] = float(-score if distance == "Euclid" else score)
+            out.append(d)
+            if len(out) >= limit:
+                break
+        return out
+
+    def _ranked_cosine(self, name: str, vector: Sequence[float]):
+        """Yield (node_id, cosine) best-first, progressively widening the
+        kNN so selective filters still fill `limit` (a fixed 4x
+        oversample starves on rare payloads)."""
+        idx = self._index(name)
+        total = len(idx)
+        k = 40
+        seen = 0
+        q = np.asarray(vector, dtype=np.float32)
+        while True:
+            hits = idx.search(q, k=min(k, total) if total else k)
+            for nid, score in hits[seen:]:
+                yield nid, score
+            seen = len(hits)
+            if seen >= total or len(hits) < k:
+                return
+            k *= 4
+
+    def _ranked_raw(self, name: str, vector: Sequence[float], distance: str):
+        """Dot / Euclid over the raw (unnormalized) client vectors.
+        Euclid yields NEGATED distances so callers sort uniformly
+        best-first."""
+        q = np.asarray(vector, dtype=np.float32)
+        ids: List[str] = []
+        rows: List[List[float]] = []
+        for node in self.storage.get_nodes_by_label(self._label(name)):
+            vec = node.properties.get("_vector")
+            if vec and len(vec) == len(q):
+                ids.append(node.id)
+                rows.append(vec)
+        if not ids:
+            return
+        m = np.asarray(rows, dtype=np.float32)
+        if distance == "Dot":
+            scores = m @ q
+        else:  # Euclid
+            scores = -np.linalg.norm(m - q[None, :], axis=1)
+        for i in np.argsort(-scores):
+            yield ids[int(i)], float(scores[int(i)])
+
+    @staticmethod
+    def _point_dict(
+        node: Node, with_payload: bool, with_vector: bool
+    ) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"id": node.properties.get("_point_id"),
+                             "version": 0}
+        if with_payload:
+            d["payload"] = node.properties.get("payload") or {}
+        if with_vector:
+            d["vector"] = node.properties.get("_vector") or []
+        return d
+
+
+def _match_filter(payload: Dict[str, Any], flt: Dict[str, Any]) -> bool:
+    """Qdrant filter subset: must / should / must_not with
+    match.value / match.any / range conditions on payload keys."""
+    for cond in flt.get("must", []):
+        if not _match_condition(payload, cond):
+            return False
+    for cond in flt.get("must_not", []):
+        if _match_condition(payload, cond):
+            return False
+    should = flt.get("should", [])
+    if should and not any(_match_condition(payload, c) for c in should):
+        return False
+    return True
+
+
+def _match_condition(payload: Dict[str, Any], cond: Dict[str, Any]) -> bool:
+    if "filter" in cond:  # nested filter
+        return _match_filter(payload, cond["filter"])
+    key = cond.get("key")
+    if key is None:
+        return True
+    value = payload
+    for part in str(key).split("."):
+        if isinstance(value, dict) and part in value:
+            value = value[part]
+        else:
+            return False
+    match = cond.get("match")
+    if match is not None:
+        if "value" in match:
+            return value == match["value"]
+        if "any" in match:
+            return value in match["any"]
+        if "text" in match:
+            return str(match["text"]).lower() in str(value).lower()
+    rng = cond.get("range")
+    if rng is not None:
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return False
+        if "gt" in rng and not v > rng["gt"]:
+            return False
+        if "gte" in rng and not v >= rng["gte"]:
+            return False
+        if "lt" in rng and not v < rng["lt"]:
+            return False
+        if "lte" in rng and not v <= rng["lte"]:
+            return False
+        return True
+    return True

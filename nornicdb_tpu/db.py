@@ -167,6 +167,17 @@ class DB:
         return self._search
 
     @property
+    def qdrant_compat(self):
+        """Single shared Qdrant translation layer per DB — the REST and
+        gRPC surfaces must share one per-collection index cache or
+        cross-surface writes go stale."""
+        if getattr(self, "_qdrant_compat", None) is None:
+            from nornicdb_tpu.api.qdrant import QdrantCompat
+
+            self._qdrant_compat = QdrantCompat(self.storage)
+        return self._qdrant_compat
+
+    @property
     def decay(self):
         if self._decay is None:
             from nornicdb_tpu.decay import DecayManager

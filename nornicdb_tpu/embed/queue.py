@@ -29,6 +29,14 @@ def build_embedding_text(node: Node) -> str:
     return extract_text(node)
 
 
+def embed_exempt(node: Node) -> bool:
+    """System-owned nodes the queue must never embed: any label starting
+    with ``_`` (Qdrant collections/points, internal meta). The Qdrant
+    surface's vectors are client-authoritative (embedding-ownership
+    rule, reference pkg/qdrantgrpc COMPAT.md:12-14)."""
+    return any(lbl.startswith("_") for lbl in node.labels)
+
+
 class EmbedQueue(MutationListener):
     def __init__(
         self,
@@ -62,7 +70,11 @@ class EmbedQueue(MutationListener):
     # -- MutationListener ------------------------------------------------
 
     def on_node_upsert(self, node: Node) -> None:
-        if node.embedding is None and build_embedding_text(node):
+        if (
+            node.embedding is None
+            and not embed_exempt(node)
+            and build_embedding_text(node)
+        ):
             self.enqueue(node.id)
 
     def on_node_delete(self, node_id: str) -> None:
@@ -233,7 +245,11 @@ class EmbedQueue(MutationListener):
         while not self._stop.wait(self.rescan_interval_s):
             try:
                 for node in self.storage.all_nodes():
-                    if node.embedding is None and build_embedding_text(node):
+                    if (
+                        node.embedding is None
+                        and not embed_exempt(node)
+                        and build_embedding_text(node)
+                    ):
                         self.enqueue(node.id)
             except Exception:
                 logger.exception("rescan failed")

@@ -94,6 +94,11 @@ class SearchService:
     def index_node(self, node: Node) -> None:
         """Index one node's text + embedding
         (reference: Service.IndexNode search.go:1785)."""
+        if any(lbl.startswith("_") for lbl in node.labels):
+            # system-owned nodes (Qdrant collections/points, meta) stay
+            # out of the native hybrid index — they have their own
+            # per-collection indexes (api/qdrant.py)
+            return
         text = extract_text(node)
         with self._lock:
             if text:

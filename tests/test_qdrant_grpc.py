@@ -1,0 +1,367 @@
+"""Qdrant-compatible surface + native gRPC service tests.
+
+Reference: pkg/qdrantgrpc tests (collections_service_test.go,
+points_service_test.go, points_extended_test.go) and pkg/nornicgrpc.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.api.qdrant import QdrantCompat, QdrantError, _match_filter
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture()
+def compat():
+    return QdrantCompat(NamespacedEngine(MemoryEngine(), "test"))
+
+
+def _mk_points(n, dims=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "id": str(i),
+            "vector": list(map(float, rng.standard_normal(dims))),
+            "payload": {"city": "oslo" if i % 2 == 0 else "bergen",
+                        "rank": i},
+        }
+        for i in range(n)
+    ]
+
+
+class TestQdrantCompat:
+    def test_collection_lifecycle(self, compat):
+        assert compat.create_collection("docs", {"size": 8,
+                                                 "distance": "Cosine"})
+        assert compat.list_collections() == ["docs"]
+        info = compat.get_collection("docs")
+        assert info["points_count"] == 0
+        assert info["config"]["params"]["vectors"]["size"] == 8
+        with pytest.raises(QdrantError):
+            compat.create_collection("docs")
+        assert compat.delete_collection("docs")
+        assert compat.list_collections() == []
+
+    def test_upsert_search_roundtrip(self, compat):
+        compat.create_collection("docs", {"size": 8})
+        pts = _mk_points(20)
+        assert compat.upsert_points("docs", pts) == 20
+        assert compat.count_points("docs") == 20
+        # searching with point 3's own vector returns it first
+        hits = compat.search_points("docs", pts[3]["vector"], limit=3)
+        assert hits[0]["id"] == "3"
+        assert hits[0]["score"] > 0.99
+        assert hits[0]["payload"]["rank"] == 3
+
+    def test_upsert_rejects_wrong_dims(self, compat):
+        compat.create_collection("docs", {"size": 8})
+        with pytest.raises(QdrantError):
+            compat.upsert_points("docs", [{"id": "1", "vector": [1.0, 2.0]}])
+
+    def test_filtered_search(self, compat):
+        compat.create_collection("docs", {"size": 8})
+        pts = _mk_points(20)
+        compat.upsert_points("docs", pts)
+        hits = compat.search_points(
+            "docs", pts[0]["vector"], limit=5,
+            query_filter={"must": [{"key": "city",
+                                    "match": {"value": "bergen"}}]},
+        )
+        assert hits and all(h["payload"]["city"] == "bergen" for h in hits)
+
+    def test_retrieve_delete_scroll(self, compat):
+        compat.create_collection("docs", {"size": 8})
+        compat.upsert_points("docs", _mk_points(10))
+        got = compat.retrieve_points("docs", ["1", "5", "nope"])
+        assert {p["id"] for p in got} == {"1", "5"}
+        assert compat.delete_points("docs", ["1"]) == 1
+        assert compat.count_points("docs") == 9
+        page = compat.scroll_points("docs", limit=4)
+        assert len(page["points"]) == 4
+        assert page["next_page_offset"] is not None
+
+    def test_index_rebuilt_after_restart(self, compat):
+        """Collection + points persist in storage; index rebuilds lazily
+        (reference: vector_index_cache.go)."""
+        compat.create_collection("docs", {"size": 8})
+        pts = _mk_points(5)
+        compat.upsert_points("docs", pts)
+        fresh = QdrantCompat(compat.storage)  # same storage, empty cache
+        hits = fresh.search_points("docs", pts[2]["vector"], limit=1)
+        assert hits[0]["id"] == "2"
+
+    def test_missing_collection_404(self, compat):
+        with pytest.raises(QdrantError) as ei:
+            compat.count_points("ghost")
+        assert ei.value.status == 404
+
+
+class TestQdrantFilters:
+    def test_range_and_must_not(self):
+        p = {"rank": 7, "city": "oslo"}
+        assert _match_filter(p, {"must": [{"key": "rank",
+                                           "range": {"gte": 5, "lt": 10}}]})
+        assert not _match_filter(p, {"must_not": [
+            {"key": "city", "match": {"value": "oslo"}}]})
+        assert _match_filter(p, {"should": [
+            {"key": "city", "match": {"any": ["oslo", "bergen"]}}]})
+
+    def test_nested_key(self):
+        p = {"meta": {"lang": "no"}}
+        assert _match_filter(p, {"must": [{"key": "meta.lang",
+                                           "match": {"value": "no"}}]})
+
+
+class TestQdrantREST:
+    @pytest.fixture()
+    def server(self):
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        db = nornicdb_tpu.open()
+        srv = HttpServer(db, port=0).start()
+        yield srv
+        srv.stop()
+        db.close()
+
+    def _req(self, server, method, path, body=None):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_rest_roundtrip(self, server):
+        st, r = self._req(server, "PUT", "/collections/docs",
+                          {"vectors": {"size": 4, "distance": "Cosine"}})
+        assert st == 200 and r["result"] is True and r["status"] == "ok"
+        st, r = self._req(server, "GET", "/collections")
+        assert [c["name"] for c in r["result"]["collections"]] == ["docs"]
+        st, r = self._req(server, "PUT", "/collections/docs/points", {
+            "points": [
+                {"id": 1, "vector": [1, 0, 0, 0], "payload": {"t": "a"}},
+                {"id": 2, "vector": [0, 1, 0, 0], "payload": {"t": "b"}},
+            ]
+        })
+        assert st == 200 and r["result"]["status"] == "completed"
+        st, r = self._req(server, "POST", "/collections/docs/points/search",
+                          {"vector": [1, 0, 0, 0], "limit": 1})
+        assert st == 200
+        assert r["result"][0]["id"] == 1 or str(r["result"][0]["id"]) == "1"
+        st, r = self._req(server, "POST", "/collections/docs/points/count", {})
+        assert r["result"]["count"] == 2
+        st, r = self._req(server, "GET", "/collections/ghost")
+        assert st == 404
+
+    def test_rest_query_api(self, server):
+        self._req(server, "PUT", "/collections/q",
+                  {"vectors": {"size": 4}})
+        self._req(server, "PUT", "/collections/q/points", {
+            "points": [{"id": "a", "vector": [0, 0, 1, 0]}]})
+        st, r = self._req(server, "POST", "/collections/q/points/query",
+                          {"query": [0, 0, 1, 0], "limit": 1})
+        assert st == 200 and r["result"]["points"][0]["id"] == "a"
+
+
+class TestGrpcServices:
+    @pytest.fixture()
+    def setup(self):
+        import grpc
+
+        from nornicdb_tpu.api.grpc_server import GrpcServer
+        from nornicdb_tpu.api.proto import nornic_pb2 as pb
+
+        db = nornicdb_tpu.open()
+        srv = GrpcServer(db, port=0).start()
+        channel = grpc.insecure_channel(srv.address)
+        yield db, srv, channel, pb
+        channel.close()
+        srv.stop()
+        db.close()
+
+    def _call(self, channel, service, method, request, resp_cls):
+        rpc = channel.unary_unary(
+            f"/nornic.v1.{service}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return rpc(request, timeout=10)
+
+    def test_qdrant_grpc_roundtrip(self, setup):
+        db, srv, channel, pb = setup
+        r = self._call(channel, "QdrantService", "CreateCollection",
+                       pb.CreateCollectionRequest(collection="g",
+                                                  vector_size=4),
+                       pb.AckResponse)
+        assert r.ok
+        r = self._call(channel, "QdrantService", "Upsert",
+                       pb.UpsertRequest(collection="g", points=[
+                           pb.Point(id="p1", vector=[1, 0, 0, 0],
+                                    payload_json='{"k": 1}'),
+                           pb.Point(id="p2", vector=[0, 1, 0, 0]),
+                       ]), pb.AckResponse)
+        assert r.ok
+        r = self._call(channel, "QdrantService", "SearchPoints",
+                       pb.SearchPointsRequest(collection="g",
+                                              vector=[1, 0, 0, 0],
+                                              limit=1, with_payload=True),
+                       pb.SearchPointsResponse)
+        assert r.points[0].id == "p1"
+        assert json.loads(r.points[0].payload_json) == {"k": 1}
+        r = self._call(channel, "QdrantService", "CountPoints",
+                       pb.CollectionRequest(collection="g"),
+                       pb.CountResponse)
+        assert r.count == 2
+        r = self._call(channel, "QdrantService", "ListCollections",
+                       pb.Empty(), pb.ListCollectionsResponse)
+        assert list(r.collections) == ["g"]
+
+    def test_native_search_grpc(self, setup):
+        db, srv, channel, pb = setup
+        rng = np.random.default_rng(0)
+        for i in range(10):
+            db.store(f"text {i}", node_id=f"n{i}",
+                     embedding=list(map(float, rng.standard_normal(16))))
+        db.search.build_indexes()
+        target = db.storage.get_node("n4").embedding
+        r = self._call(channel, "SearchService", "Search",
+                       pb.SearchRequest(vector=target, limit=3),
+                       pb.SearchResponse)
+        assert r.hits[0].node_id == "n4"
+        assert r.hits[0].score > 0.99
+
+
+class TestReviewRegressions:
+    def test_points_exempt_from_embed_queue_and_native_search(self):
+        """Embedding-ownership rule: qdrant nodes are never queued for
+        embedding nor indexed into the native hybrid search."""
+        from nornicdb_tpu.embed.queue import embed_exempt
+        from nornicdb_tpu.search.service import SearchService
+        from nornicdb_tpu.storage.types import Node
+
+        point = Node(id="qdrant/c/1", labels=["_Qdrant:c"],
+                     properties={"payload": {"x": 1}})
+        assert embed_exempt(point)
+        eng = NamespacedEngine(MemoryEngine(), "t")
+        svc = SearchService(eng)
+        svc.index_node(point)
+        assert len(svc.vectors) == 0
+        assert svc.stats.indexed_docs == 0
+
+    def test_shared_compat_across_surfaces(self):
+        """REST and gRPC must share one index cache (stale-cache bug)."""
+        db = nornicdb_tpu.open()
+        try:
+            from nornicdb_tpu.api.http_server import HttpServer
+            from nornicdb_tpu.api.grpc_server import GrpcServer
+
+            http = HttpServer(db, port=0)
+            g = GrpcServer(db, port=0)
+            assert http.qdrant is g.qdrant_servicer.compat
+        finally:
+            db.close()
+
+    def test_dot_and_euclid_distances(self, compat):
+        compat.create_collection("dot", {"size": 2, "distance": "Dot"})
+        compat.upsert_points("dot", [
+            {"id": "small", "vector": [1.0, 0.0]},
+            {"id": "big", "vector": [10.0, 0.0]},
+        ])
+        hits = compat.search_points("dot", [1.0, 0.0], limit=2)
+        # dot product rewards magnitude; cosine would tie these
+        assert hits[0]["id"] == "big"
+        assert hits[0]["score"] == pytest.approx(10.0)
+
+        compat.create_collection("eu", {"size": 2, "distance": "Euclid"})
+        compat.upsert_points("eu", [
+            {"id": "near", "vector": [1.0, 1.0]},
+            {"id": "far", "vector": [5.0, 5.0]},
+        ])
+        hits = compat.search_points("eu", [0.0, 0.0], limit=2,
+                                    score_threshold=3.0)
+        # threshold is a max distance for Euclid: 'far' is excluded
+        assert [h["id"] for h in hits] == ["near"]
+
+    def test_unsupported_distance_rejected(self, compat):
+        with pytest.raises(QdrantError):
+            compat.create_collection("bad", {"size": 2,
+                                             "distance": "Manhattan"})
+
+    def test_upsert_batch_atomic_validation(self, compat):
+        compat.create_collection("atomic", {"size": 2})
+        with pytest.raises(QdrantError):
+            compat.upsert_points("atomic", [
+                {"id": "1", "vector": [1.0, 0.0]},
+                {"id": "2", "vector": [1.0, 0.0, 0.0]},  # bad dims
+            ])
+        assert compat.count_points("atomic") == 0  # nothing applied
+
+    def test_upsert_infers_dims_when_unconfigured(self, compat):
+        compat.create_collection("nodim")
+        with pytest.raises(QdrantError):
+            compat.upsert_points("nodim", [
+                {"id": "1", "vector": [1.0, 0.0]},
+                {"id": "2", "vector": [1.0]},  # inconsistent
+            ])
+
+    def test_selective_filter_fills_limit(self, compat):
+        """Progressive widening: a 10%-selective filter must still fill
+        the requested limit."""
+        import numpy as np
+
+        compat.create_collection("wide", {"size": 4})
+        rng = np.random.default_rng(0)
+        pts = [
+            {"id": str(i),
+             "vector": list(map(float, rng.standard_normal(4))),
+             "payload": {"rare": i % 10 == 0}}
+            for i in range(500)
+        ]
+        compat.upsert_points("wide", pts)
+        hits = compat.search_points(
+            "wide", pts[0]["vector"], limit=20,
+            query_filter={"must": [{"key": "rare",
+                                    "match": {"value": True}}]})
+        assert len(hits) == 20
+        assert all(h["payload"]["rare"] for h in hits)
+
+    def test_empty_vector_is_validation_error(self, compat):
+        compat.create_collection("v", {"size": 2})
+        with pytest.raises(QdrantError):
+            compat.search_points("v", [], limit=1)
+
+    def test_grpc_auth_token(self):
+        import grpc
+
+        from nornicdb_tpu.api.grpc_server import GrpcServer
+        from nornicdb_tpu.api.proto import nornic_pb2 as pb
+
+        db = nornicdb_tpu.open()
+        srv = GrpcServer(db, port=0, auth_token="s3cret").start()
+        try:
+            ch = grpc.insecure_channel(srv.address)
+            rpc = ch.unary_unary(
+                "/nornic.v1.QdrantService/ListCollections",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ListCollectionsResponse.FromString)
+            with pytest.raises(grpc.RpcError) as ei:
+                rpc(pb.Empty(), timeout=5)
+            assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+            # with the token it works
+            r = rpc(pb.Empty(), timeout=5,
+                    metadata=(("authorization", "Bearer s3cret"),))
+            assert list(r.collections) == []
+            ch.close()
+        finally:
+            srv.stop()
+            db.close()
