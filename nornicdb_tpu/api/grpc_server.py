@@ -22,6 +22,17 @@ import numpy as np
 from nornicdb_tpu.api.proto import nornic_pb2 as pb
 
 
+def _abort_qdrant(context, e) -> None:
+    """Map QdrantError to a gRPC status — a missing collection or a
+    validation failure must not masquerade as an empty result."""
+    import grpc
+
+    code = (grpc.StatusCode.NOT_FOUND
+            if getattr(e, "status", 400) == 404
+            else grpc.StatusCode.INVALID_ARGUMENT)
+    context.abort(code, str(e))
+
+
 def _unary(fn, req_cls):
     import grpc
 
@@ -166,8 +177,8 @@ class QdrantServicer:
                     if request.filter_json else None
                 ),
             )
-        except QdrantError:
-            hits = []
+        except QdrantError as e:
+            _abort_qdrant(context, e)
         return pb.SearchPointsResponse(
             points=[
                 pb.ScoredPoint(
@@ -191,8 +202,8 @@ class QdrantServicer:
         try:
             return pb.CountResponse(count=self.compat.count_points(
                 request.collection))
-        except QdrantError:
-            return pb.CountResponse(count=0)
+        except QdrantError as e:
+            _abort_qdrant(context, e)
 
     def handlers(self):
         import grpc
@@ -231,8 +242,12 @@ def _token_interceptor(token: str):
             self._abort = grpc.unary_unary_rpc_method_handler(abort)
 
         def intercept_service(self, continuation, details):
+            import hmac
+
             md = dict(details.invocation_metadata)
-            if md.get("authorization") == f"Bearer {token}":
+            if hmac.compare_digest(
+                md.get("authorization", ""), f"Bearer {token}"
+            ):
                 return continuation(details)
             return self._abort
 

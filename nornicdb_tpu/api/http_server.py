@@ -206,22 +206,21 @@ class HttpServer:
                         {"code": "Neo.DatabaseError.General.UnknownError",
                          "message": str(e)}]})
                     return
-                if isinstance(payload, str):
-                    data = payload.encode()
-                    ctype = "text/plain; version=0.0.4"
-                else:
-                    data = json.dumps(payload).encode()
-                    ctype = "application/json"
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                self._reply(status, payload)
 
             def _reply(self, status: int, payload: Dict[str, Any]) -> None:
-                data = json.dumps(payload).encode()
+                if isinstance(payload, str):
+                    # pre-rendered text bodies: playground HTML, or the
+                    # Prometheus exposition format (/metrics)
+                    ctype = ("text/html; charset=utf-8"
+                             if payload.lstrip().startswith("<") else
+                             "text/plain; version=0.0.4")
+                    data = payload.encode()
+                else:
+                    ctype = "application/json"
+                    data = json.dumps(payload).encode()
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -284,6 +283,29 @@ class HttpServer:
             self.authorize(username, self.default_database, WRITE)
             response = self.mcp.handle_jsonrpc(payload)
             return (200, response) if response is not None else (202, {})
+
+        # GraphQL endpoint + playground (reference: pkg/graphql mount)
+        if parsed.path == "/graphql":
+            if method == "GET":
+                from nornicdb_tpu.api.graphql import PLAYGROUND_HTML
+
+                return 200, PLAYGROUND_HTML
+            if method == "POST":
+                from nornicdb_tpu.api.graphql import GraphQLAPI, GraphQLError
+
+                q = payload.get("query", "")
+                op_name = payload.get("operationName")
+                try:
+                    kind = GraphQLAPI.operation_kind(q, op_name)
+                except GraphQLError as e:
+                    return 200, {"data": None,
+                                 "errors": [{"message": str(e)}]}
+                self.authorize(
+                    username, self.default_database,
+                    WRITE if kind == "mutation" else READ,
+                )
+                return 200, self.graphql.execute(
+                    q, payload.get("variables"), op_name)
 
         if parsed.path == "/status":
             return 200, self._status()
@@ -501,6 +523,14 @@ class HttpServer:
     @property
     def qdrant(self):
         return self.db.qdrant_compat
+
+    @property
+    def graphql(self):
+        if getattr(self, "_graphql", None) is None:
+            from nornicdb_tpu.api.graphql import GraphQLAPI
+
+            self._graphql = GraphQLAPI(self.db)
+        return self._graphql
 
     def _qdrant_routes(self, method: str, segments: List[str],
                        payload: Dict[str, Any],

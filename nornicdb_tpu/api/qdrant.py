@@ -46,6 +46,9 @@ class QdrantCompat:
     def __init__(self, storage):
         self.storage = storage
         self._indexes: Dict[str, BruteForceIndex] = {}
+        # raw (unnormalized) vectors for Dot/Euclid collections:
+        # name -> (ids, [N,D] matrix); invalidated on any point mutation
+        self._raw: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     # -- collections -----------------------------------------------------
@@ -83,6 +86,7 @@ class QdrantCompat:
         self.storage.delete_node(meta_id)
         with self._lock:
             self._indexes.pop(name, None)
+            self._raw.pop(name, None)
         return True
 
     def list_collections(self) -> List[str]:
@@ -177,6 +181,8 @@ class QdrantCompat:
             if vec:
                 idx.add(nid, vec)
             n += 1
+        if n:
+            self._invalidate_raw(name)
         return n
 
     def retrieve_points(
@@ -206,6 +212,8 @@ class QdrantCompat:
                 self.storage.delete_node(nid)
                 idx.remove(nid)
                 n += 1
+        if n:
+            self._invalidate_raw(name)
         return n
 
     def count_points(self, name: str) -> int:
@@ -314,21 +322,40 @@ class QdrantCompat:
                 return
             k *= 4
 
+    def _raw_matrix(self, name: str, dims: int):
+        """Cached (ids, [N,D]) raw-vector matrix for Dot/Euclid — the
+        analog of the normalized index cache; rebuilt only after a point
+        mutation invalidates it (a per-query storage scan would be O(N)
+        reads on every search)."""
+        with self._lock:
+            cached = self._raw.get(name)
+        if cached is not None and cached[1].shape[1] == dims:
+            return cached
+        ids: List[str] = []
+        rows: List[List[float]] = []
+        for node in self.storage.get_nodes_by_label(self._label(name)):
+            vec = node.properties.get("_vector")
+            if vec and len(vec) == dims:
+                ids.append(node.id)
+                rows.append(vec)
+        m = np.asarray(rows, dtype=np.float32) if rows else np.zeros(
+            (0, dims), np.float32)
+        with self._lock:
+            self._raw[name] = (ids, m)
+        return ids, m
+
+    def _invalidate_raw(self, name: str) -> None:
+        with self._lock:
+            self._raw.pop(name, None)
+
     def _ranked_raw(self, name: str, vector: Sequence[float], distance: str):
         """Dot / Euclid over the raw (unnormalized) client vectors.
         Euclid yields NEGATED distances so callers sort uniformly
         best-first."""
         q = np.asarray(vector, dtype=np.float32)
-        ids: List[str] = []
-        rows: List[List[float]] = []
-        for node in self.storage.get_nodes_by_label(self._label(name)):
-            vec = node.properties.get("_vector")
-            if vec and len(vec) == len(q):
-                ids.append(node.id)
-                rows.append(vec)
+        ids, m = self._raw_matrix(name, len(q))
         if not ids:
             return
-        m = np.asarray(rows, dtype=np.float32)
         if distance == "Dot":
             scores = m @ q
         else:  # Euclid

@@ -1,0 +1,239 @@
+"""GraphQL engine + resolver tests.
+
+Reference: pkg/graphql (schema.graphql Query/Mutation surface; gqlgen
+handler + resolvers). The engine here is hand-rolled; these tests cover
+both the language subset (variables, aliases, fragments, directives)
+and the NornicDB schema semantics.
+"""
+
+import json
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.api.graphql import GraphQLAPI, GraphQLError, _Parser
+
+
+@pytest.fixture()
+def api():
+    db = nornicdb_tpu.open()
+    db.cypher(
+        """
+        CREATE (a:Person {name: 'Alice', age: 30}),
+               (b:Person {name: 'Bob', age: 25}),
+               (c:Company {name: 'Initech'}),
+               (a)-[:WORKS_AT {since: 2020}]->(c),
+               (b)-[:WORKS_AT {since: 2021}]->(c),
+               (a)-[:KNOWS]->(b)
+        """
+    )
+    yield GraphQLAPI(db)
+    db.close()
+
+
+class TestParser:
+    def test_parses_operations_and_fragments(self):
+        doc = _Parser("""
+            query GetStuff($n: Int = 5) {
+              allNodes(limit: $n) { id ...Props }
+            }
+            fragment Props on Node { labels properties }
+        """).parse_document()
+        assert doc["operations"][0]["name"] == "GetStuff"
+        assert doc["operations"][0]["variables"][0]["default"]["value"] == 5
+        assert "Props" in doc["fragments"]
+
+    def test_rejects_garbage(self):
+        with pytest.raises(GraphQLError):
+            _Parser("query { node( }").parse_document()
+
+
+class TestQueries:
+    def test_node_counts(self, api):
+        r = api.execute("{ nodeCount relationshipCount }")
+        assert r["data"] == {"nodeCount": 3, "relationshipCount": 3}
+
+    def test_nodes_by_label_with_nested_relationships(self, api):
+        r = api.execute("""
+        { nodesByLabel(label: "Person") {
+            id properties
+            relationships(direction: OUTGOING, type: "WORKS_AT") {
+              type properties endNode { properties }
+            }
+        } }
+        """)
+        people = r["data"]["nodesByLabel"]
+        assert len(people) == 2
+        alice = next(p for p in people
+                     if p["properties"]["name"] == "Alice")
+        rels = alice["relationships"]
+        assert len(rels) == 1
+        assert rels[0]["type"] == "WORKS_AT"
+        assert rels[0]["endNode"]["properties"]["name"] == "Initech"
+
+    def test_variables_aliases_typename(self, api):
+        r = api.execute(
+            """
+            query People($lbl: String!) {
+              folks: nodesByLabel(label: $lbl) { id __typename }
+            }
+            """,
+            variables={"lbl": "Person"},
+        )
+        assert len(r["data"]["folks"]) == 2
+        assert r["data"]["folks"][0]["__typename"] == "Node"
+
+    def test_skip_include_directives(self, api):
+        r = api.execute("""
+        query Q($yes: Boolean = true) {
+          nodeCount @include(if: $yes)
+          relationshipCount @skip(if: $yes)
+        }
+        """)
+        assert "nodeCount" in r["data"]
+        assert "relationshipCount" not in r["data"]
+
+    def test_cypher_passthrough(self, api):
+        r = api.execute("""
+        { cypher(query: "MATCH (p:Person) RETURN p.name ORDER BY p.name") {
+            columns rows
+        } }
+        """)
+        assert r["data"]["cypher"]["rows"] == [["Alice"], ["Bob"]]
+
+    def test_unknown_field_is_error_not_crash(self, api):
+        r = api.execute("{ bogusField }")
+        assert r["data"] is None
+        assert "bogusField" in r["errors"][0]["message"]
+
+
+class TestMutations:
+    def test_create_update_delete_node(self, api):
+        r = api.execute("""
+        mutation {
+          createNode(input: {labels: ["City"],
+                             properties: {name: "Oslo"}}) { id labels }
+        }
+        """)
+        nid = r["data"]["createNode"]["id"]
+        assert r["data"]["createNode"]["labels"] == ["City"]
+        r = api.execute(
+            """
+            mutation Up($id: ID!) {
+              updateNode(id: $id, input: {properties: {pop: 700000}}) {
+                properties
+              }
+            }
+            """,
+            variables={"id": nid},
+        )
+        assert r["data"]["updateNode"]["properties"]["pop"] == 700000
+        r = api.execute(
+            "mutation D($id: ID!) { deleteNode(id: $id) }",
+            variables={"id": nid},
+        )
+        assert r["data"]["deleteNode"] is True
+
+    def test_create_relationship(self, api):
+        api.execute("""
+        mutation {
+          a: createNode(input: {id: "x1", labels: ["T"]}) { id }
+          b: createNode(input: {id: "x2", labels: ["T"]}) { id }
+        }
+        """)
+        r = api.execute("""
+        mutation {
+          createRelationship(input: {startNodeId: "x1", endNodeId: "x2",
+                                     type: "LINKS"}) {
+            type startNodeId endNodeId
+          }
+        }
+        """)
+        rel = r["data"]["createRelationship"]
+        assert rel == {"type": "LINKS", "startNodeId": "x1",
+                       "endNodeId": "x2"}
+
+    def test_bulk_and_merge(self, api):
+        r = api.execute("""
+        mutation {
+          bulkCreateNodes(input: [
+            {id: "b1", labels: ["B"]}, {id: "b2", labels: ["B"]}
+          ]) { id }
+          mergeNode(input: {id: "b1", properties: {seen: true}}) {
+            properties
+          }
+          bulkDeleteNodes(ids: ["b2"])
+        }
+        """)
+        assert [n["id"] for n in r["data"]["bulkCreateNodes"]] == ["b1", "b2"]
+        assert r["data"]["mergeNode"]["properties"]["seen"] is True
+        assert r["data"]["bulkDeleteNodes"] == 1
+
+
+class TestHTTPEndpoint:
+    def test_graphql_over_http(self):
+        import urllib.request
+
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        db = nornicdb_tpu.open()
+        db.cypher("CREATE (:Thing {name: 'x'})")
+        srv = HttpServer(db, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/graphql",
+                data=json.dumps({"query": "{ nodeCount }"}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                body = json.loads(resp.read())
+            assert body == {"data": {"nodeCount": 1}}
+            # playground
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/graphql"
+            ) as resp:
+                assert resp.headers["Content-Type"].startswith("text/html")
+                assert b"GraphQL" in resp.read()
+        finally:
+            srv.stop()
+            db.close()
+
+
+class TestAuthRegressions:
+    """Authorization must be decided on the parsed document, and write
+    Cypher must not ride the Query root."""
+
+    def test_operation_kind_sees_through_comments_and_multiop(self):
+        from nornicdb_tpu.api.graphql import GraphQLAPI
+
+        assert GraphQLAPI.operation_kind(
+            "# leading comment\nmutation { deleteNode(id: \"x\") }", None
+        ) == "mutation"
+        assert GraphQLAPI.operation_kind(
+            "query Q { nodeCount } mutation M { deleteNode(id: \"x\") }",
+            "M",
+        ) == "mutation"
+
+    def test_write_cypher_rejected_on_query_root(self, api):
+        r = api.execute('{ cypher(query: "CREATE (n:Pwned)") { rows } }')
+        assert r["data"] is None
+        assert "executeCypher" in r["errors"][0]["message"]
+        check = api.execute(
+            '{ cypher(query: "MATCH (n:Pwned) RETURN count(n)") { rows } }')
+        assert check["data"]["cypher"]["rows"] == [[0]]
+
+    def test_write_cypher_allowed_via_mutation(self, api):
+        r = api.execute(
+            'mutation { executeCypher(query: "CREATE (n:Ok)") '
+            '{ nodesCreated } }')
+        assert r["data"]["executeCypher"]["nodesCreated"] == 1
+
+    def test_non_ascii_string_literals(self, api):
+        r = api.execute(
+            'mutation { createNode(input: {id: "café", labels: ["T"],'
+            ' properties: {name: "Žižek \\u00e9"}}) { id properties } }')
+        assert r["data"]["createNode"]["id"] == "café"
+        assert r["data"]["createNode"]["properties"]["name"] == "Žižek é"
+        r = api.execute('{ node(id: "café") { id } }')
+        assert r["data"]["node"]["id"] == "café"

@@ -365,3 +365,38 @@ class TestReviewRegressions:
         finally:
             srv.stop()
             db.close()
+
+
+class TestGrpcErrorMapping:
+    def test_missing_collection_maps_to_not_found(self):
+        import grpc
+
+        from nornicdb_tpu.api.grpc_server import GrpcServer
+        from nornicdb_tpu.api.proto import nornic_pb2 as pb
+
+        db = nornicdb_tpu.open()
+        srv = GrpcServer(db, port=0).start()
+        try:
+            ch = grpc.insecure_channel(srv.address)
+            rpc = ch.unary_unary(
+                "/nornic.v1.QdrantService/CountPoints",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.CountResponse.FromString)
+            with pytest.raises(grpc.RpcError) as ei:
+                rpc(pb.CollectionRequest(collection="ghost"), timeout=5)
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+            ch.close()
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_raw_cache_invalidated_on_upsert(self, compat):
+        compat.create_collection("dotc", {"size": 2, "distance": "Dot"})
+        compat.upsert_points("dotc", [{"id": "a", "vector": [1.0, 0.0]}])
+        assert compat.search_points("dotc", [1.0, 0.0], limit=2)[0]["id"] == "a"
+        compat.upsert_points("dotc", [{"id": "b", "vector": [5.0, 0.0]}])
+        hits = compat.search_points("dotc", [1.0, 0.0], limit=2)
+        assert hits[0]["id"] == "b"  # cache saw the new point
+        compat.delete_points("dotc", ["b"])
+        hits = compat.search_points("dotc", [1.0, 0.0], limit=2)
+        assert [h["id"] for h in hits] == ["a"]
