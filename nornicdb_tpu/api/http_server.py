@@ -190,6 +190,12 @@ class HttpServer:
                 if not outer.rate_limiter.allow(client):
                     self._reply(429, {"error": "rate limit exceeded"})
                     return
+                if (method == "GET"
+                        and self.path.split("?")[0] == "/bifrost/events"):
+                    # SSE push channel (reference: heimdall Bifrost,
+                    # bifrost.go:15,42) — streamed, bypasses JSON reply
+                    outer._stream_bifrost(self)
+                    return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 try:
@@ -317,6 +323,16 @@ class HttpServer:
         # REST convenience API (reference: server_nornicdb.go)
         if segments[:1] == ["nornicdb"]:
             return self._nornicdb_routes(method, segments, payload, query, username)
+
+        # Heimdall: OpenAI-compatible chat + management
+        # (reference: pkg/heimdall OpenAI-compatible chat, scheduler.go:311)
+        if parsed.path == "/v1/chat/completions" and method == "POST":
+            self.authorize(username, self.default_database, READ)
+            return self._chat_completions(payload, username)
+        if segments[:1] == ["heimdall"]:
+            self.authorize(username, self.default_database,
+                           WRITE if method == "POST" else READ)
+            return self._heimdall_routes(method, segments, payload, username)
 
         # Qdrant-compatible REST surface (reference: pkg/qdrantgrpc
         # translated onto storage+search; REST here speaks the Qdrant
@@ -532,6 +548,26 @@ class HttpServer:
             self._graphql = GraphQLAPI(self.db)
         return self._graphql
 
+    @property
+    def heimdall(self):
+        """Heimdall manager + Bifrost, lazily stood up with the default
+        in-process JAX SLM registered (reference: heimdall wiring in
+        server.New, server.go:921)."""
+        with self._lock:
+            if getattr(self, "_heimdall", None) is None:
+                from nornicdb_tpu.heimdall import (
+                    Bifrost, Manager, ModelSpec,
+                )
+                from nornicdb_tpu.heimdall.model import DecoderConfig
+
+                mgr = Manager()
+                mgr.register(ModelSpec(
+                    name="heimdall-slm", backend="jax",
+                    options={"cfg": DecoderConfig.tiny()}))
+                mgr.bifrost = Bifrost()
+                self._heimdall = mgr
+            return self._heimdall
+
     def _qdrant_routes(self, method: str, segments: List[str],
                        payload: Dict[str, Any],
                        query: Dict[str, str]) -> Tuple[int, Any]:
@@ -610,6 +646,108 @@ class HttpServer:
         raise HTTPError(404, "Neo.ClientError.Request.Invalid",
                         f"no qdrant route {method} /{'/'.join(segments)}")
 
+    # -- heimdall --------------------------------------------------------
+
+    def _stream_bifrost(self, handler, idle_timeout: float = 10.0) -> None:
+        """Stream Bifrost events as SSE until the client disconnects or
+        the stream is idle past idle_timeout. Auth runs first — the feed
+        carries tool-call args and must not be weaker than other routes."""
+        from urllib.parse import parse_qs as _pq, urlparse as _up
+
+        try:
+            username = self.authenticate(handler.headers)
+            self.authorize(username, self.default_database, READ)
+        except (AuthError, PermissionDenied, HTTPError) as e:
+            status = getattr(e, "status", 401)
+            handler._reply(status if isinstance(status, int) else 401,
+                           {"errors": [{"message": str(e)}]})
+            return
+        q = {k: v[0] for k, v in _pq(_up(handler.path).query).items()}
+        try:
+            idle = min(max(float(q.get("idle_timeout", idle_timeout)),
+                           0.1), 120.0)
+        except (TypeError, ValueError):
+            handler._reply(400, {"errors": [
+                {"message": "idle_timeout must be a number"}]})
+            return
+        bifrost = self.heimdall.bifrost
+        sid = bifrost.subscribe()
+        try:
+            handler.close_connection = True  # streamed body has no length
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/event-stream")
+            handler.send_header("Cache-Control", "no-cache")
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            handler.wfile.write(b": connected\n\n")
+            handler.wfile.flush()
+            for msg in bifrost.events(sid, timeout=idle):
+                handler.wfile.write(bifrost.sse(msg).encode())
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            bifrost.unsubscribe(sid)
+
+    def _chat_completions(self, payload: Dict[str, Any],
+                          username: Optional[str]) -> Tuple[int, Any]:
+        """OpenAI-compatible /v1/chat/completions."""
+        messages = payload.get("messages") or []
+        result = self.heimdall.chat(
+            messages,
+            model=payload.get("model"),
+            max_tokens=int(payload.get("max_tokens", 256)),
+            temperature=float(payload.get("temperature", 0.0)),
+            user=username,
+        )
+        now = int(time.time())
+        return 200, {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": now,
+            "model": result.model,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": result.text},
+                "finish_reason": "stop",
+            }],
+            "usage": _usage(messages, result.text),
+        }
+
+    def _heimdall_routes(self, method: str, segments: List[str],
+                         payload: Dict[str, Any],
+                         username: Optional[str]) -> Tuple[int, Any]:
+        action = segments[1] if len(segments) > 1 else ""
+        mgr = self.heimdall
+        if action == "models" and method == "GET":
+            return 200, {"models": [
+                {"name": s.name, "backend": s.backend, "loaded": s.loaded,
+                 "memory_bytes": s.memory_bytes}
+                for s in mgr.models()
+            ]}
+        if action == "generate" and method == "POST":
+            r = mgr.generate(
+                payload.get("prompt", ""),
+                model=payload.get("model"),
+                max_tokens=int(payload.get("max_tokens", 256)),
+                temperature=float(payload.get("temperature", 0.0)),
+                user=username,
+            )
+            return 200, {"text": r.text, "model": r.model,
+                         "took_ms": r.took_ms}
+        if action == "tools" and method == "POST":
+            r = mgr.generate_with_tools(
+                payload.get("prompt", ""), self.mcp,
+                model=payload.get("model"),
+                max_rounds=int(payload.get("max_rounds", 4)),
+                max_tokens=int(payload.get("max_tokens", 256)),
+                user=username,
+            )
+            return 200, {"text": r.text, "model": r.model,
+                         "tool_calls": r.tool_calls, "took_ms": r.took_ms}
+        raise HTTPError(404, "Neo.ClientError.Request.Invalid",
+                        f"no heimdall route {method} /{'/'.join(segments)}")
+
     # -- admin -----------------------------------------------------------
 
     def _admin_routes(self, method: str, segments: List[str],
@@ -664,6 +802,19 @@ class HttpServer:
 
 _WRITE_RE = re.compile(
     r"\b(CREATE|MERGE|DELETE|DETACH|SET|REMOVE|DROP|LOAD\s+CSV)\b", re.I)
+
+
+def _usage(messages, completion: str) -> Dict[str, int]:
+    """OpenAI-wire usage block (~4 chars/token heuristic). content may
+    be explicitly null for assistant tool-call turns."""
+    prompt_tokens = sum(
+        len(m.get("content") or "") for m in messages) // 4
+    completion_tokens = len(completion) // 4
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
 
 
 def _is_write(query: str) -> bool:

@@ -46,6 +46,7 @@ class McpServer:
                 "content": {"type": "string"},
                 "labels": {"type": "array", "items": {"type": "string"}},
                 "properties": {"type": "object"},
+                "node_id": {"type": "string"},
             }, "required": ["content"]},
             self._tool_store,
         )
@@ -119,6 +120,7 @@ class McpServer:
             args.get("content", ""),
             labels=args.get("labels"),
             properties=args.get("properties"),
+            node_id=args.get("node_id"),
         )
         return {"id": node.id, "labels": node.labels}
 

@@ -1,0 +1,37 @@
+"""Heimdall: the "cognitive guardian" — reasoning SLMs next to the DB.
+
+Reference: pkg/heimdall (types.go:1-60, scheduler.go:22-311 Manager with
+Generate/GenerateStream/GenerateWithTools/Chat, bifrost.go push channel,
+plugin.go). The TPU build replaces the llama.cpp GGUF backends with an
+in-process JAX decoder (heimdall/model.py) plus HTTP generator backends,
+a model registry/scheduler with HBM estimates, a streaming agentic tool
+loop over the MCP tools, and the Bifrost SSE push channel.
+"""
+
+from nornicdb_tpu.heimdall.scheduler import (
+    GenerationResult,
+    Manager,
+    ModelSpec,
+)
+from nornicdb_tpu.heimdall.generators import (
+    EchoGenerator,
+    Generator,
+    JAXGenerator,
+    OllamaGenerator,
+    OpenAIGenerator,
+)
+from nornicdb_tpu.heimdall.bifrost import Bifrost
+from nornicdb_tpu.heimdall.tools import ToolLoop
+
+__all__ = [
+    "Bifrost",
+    "EchoGenerator",
+    "GenerationResult",
+    "Generator",
+    "JAXGenerator",
+    "Manager",
+    "ModelSpec",
+    "OllamaGenerator",
+    "OpenAIGenerator",
+    "ToolLoop",
+]

@@ -1,0 +1,150 @@
+"""Generator backends for Heimdall.
+
+Reference: pkg/heimdall generator backends — local GGUF (cgo llama.cpp),
+OpenAI, Ollama, yzma (types.go, scheduler.go). Here: JAXGenerator (the
+TPU-native in-process SLM), OpenAI/Ollama HTTP backends, and a
+deterministic EchoGenerator test double (the universal fixture, as the
+reference's tests use stub generators).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Dict, Iterator, List, Optional, Protocol
+
+
+Message = Dict[str, str]  # {"role": ..., "content": ...}
+
+
+def render_chat(messages: List[Message]) -> str:
+    """Flatten a chat transcript into a single prompt."""
+    parts = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+             for m in messages]
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+class Generator(Protocol):
+    name: str
+
+    def generate(self, prompt: str, max_tokens: int = 256,
+                 temperature: float = 0.0) -> str: ...
+
+    def generate_stream(self, prompt: str, max_tokens: int = 256,
+                        temperature: float = 0.0) -> Iterator[str]: ...
+
+
+class EchoGenerator:
+    """Deterministic test double; optionally scripted replies."""
+
+    def __init__(self, name: str = "echo",
+                 replies: Optional[List[str]] = None):
+        self.name = name
+        self._replies = list(replies or [])
+        self.calls: List[str] = []
+
+    def generate(self, prompt: str, max_tokens: int = 256,
+                 temperature: float = 0.0) -> str:
+        self.calls.append(prompt)
+        if self._replies:
+            return self._replies.pop(0)
+        return f"echo: {prompt[-200:]}"
+
+    def generate_stream(self, prompt: str, max_tokens: int = 256,
+                        temperature: float = 0.0) -> Iterator[str]:
+        text = self.generate(prompt, max_tokens, temperature)
+        for i in range(0, len(text), 8):
+            yield text[i:i + 8]
+
+
+class JAXGenerator:
+    """In-process TPU SLM (reference analog: local GGUF llama.cpp
+    backend). Weights come from a checkpoint when provided; otherwise
+    random init (serving machinery identical)."""
+
+    def __init__(self, name: str = "heimdall-slm", cfg=None, params=None):
+        from nornicdb_tpu.heimdall.model import DecoderModel
+
+        self.name = name
+        self.model = DecoderModel(cfg=cfg, params=params)
+
+    def param_bytes(self) -> int:
+        return self.model.param_bytes()
+
+    def generate(self, prompt: str, max_tokens: int = 256,
+                 temperature: float = 0.0) -> str:
+        return self.model.generate(prompt, max_tokens=max_tokens,
+                                   temperature=temperature)
+
+    def generate_stream(self, prompt: str, max_tokens: int = 256,
+                        temperature: float = 0.0) -> Iterator[str]:
+        # decode is a single fused device loop; stream in host chunks
+        text = self.generate(prompt, max_tokens, temperature)
+        for i in range(0, len(text), 16):
+            yield text[i:i + 16]
+
+
+class _HttpGenerator:
+    timeout = 60.0
+
+    def _post(self, url: str, payload: dict, headers: dict) -> dict:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **headers},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+
+class OpenAIGenerator(_HttpGenerator):
+    """OpenAI-compatible HTTP backend (reference: OpenAI generator)."""
+
+    def __init__(self, base_url: str, model: str, api_key: str = "",
+                 name: Optional[str] = None):
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.api_key = api_key
+        self.name = name or f"openai:{model}"
+
+    def generate(self, prompt: str, max_tokens: int = 256,
+                 temperature: float = 0.0) -> str:
+        headers = (
+            {"Authorization": f"Bearer {self.api_key}"}
+            if self.api_key else {}
+        )
+        out = self._post(
+            f"{self.base_url}/v1/chat/completions",
+            {"model": self.model, "max_tokens": max_tokens,
+             "temperature": temperature,
+             "messages": [{"role": "user", "content": prompt}]},
+            headers)
+        return out["choices"][0]["message"]["content"]
+
+    def generate_stream(self, prompt: str, max_tokens: int = 256,
+                        temperature: float = 0.0) -> Iterator[str]:
+        yield self.generate(prompt, max_tokens, temperature)
+
+
+class OllamaGenerator(_HttpGenerator):
+    """Ollama HTTP backend (reference: Ollama generator)."""
+
+    def __init__(self, base_url: str = "http://127.0.0.1:11434",
+                 model: str = "llama3", name: Optional[str] = None):
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.name = name or f"ollama:{model}"
+
+    def generate(self, prompt: str, max_tokens: int = 256,
+                 temperature: float = 0.0) -> str:
+        out = self._post(
+            f"{self.base_url}/api/generate",
+            {"model": self.model, "prompt": prompt, "stream": False,
+             "options": {"num_predict": max_tokens,
+                         "temperature": temperature}},
+            {})
+        return out.get("response", "")
+
+    def generate_stream(self, prompt: str, max_tokens: int = 256,
+                        temperature: float = 0.0) -> Iterator[str]:
+        yield self.generate(prompt, max_tokens, temperature)

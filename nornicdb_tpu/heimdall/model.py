@@ -1,0 +1,221 @@
+"""TPU-native SLM decoder: the compute core of the Heimdall subsystem.
+
+Reference: pkg/heimdall runs reasoning SLMs next to the DB through
+llama.cpp (types.go:1-60; local GGUF backend). The TPU replacement is a
+JAX decoder-only transformer served in-process: static-shape prefill +
+a KV-cache decode loop under ``lax.scan`` (no data-dependent Python
+control flow inside jit), bfloat16 matmuls on the MXU, and a byte-level
+tokenizer so the pipeline is fully self-contained (no vendored GGUF
+weights in this image; weights load from an orbax/npz checkpoint when
+provided, else random init — generation machinery, sampling, and
+serving are identical either way).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# byte-level vocab: 256 bytes + PAD/BOS/EOS
+PAD, BOS, EOS = 256, 257, 258
+VOCAB = 259
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    vocab: int = VOCAB
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 1024
+    max_seq: int = 512
+
+    @staticmethod
+    def tiny() -> "DecoderConfig":
+        return DecoderConfig(d_model=64, n_heads=2, n_layers=2, d_ff=128,
+                             max_seq=128)
+
+
+def encode_bytes(text: str, max_len: int) -> np.ndarray:
+    ids = [BOS] + list(text.encode("utf-8"))[: max_len - 1]
+    return np.asarray(ids, dtype=np.int32)
+
+
+def decode_bytes(ids) -> str:
+    bs = bytes(int(i) for i in ids if 0 <= int(i) < 256)
+    return bs.decode("utf-8", errors="replace")
+
+
+def init_params(cfg: DecoderConfig, seed: int = 0) -> Dict[str, Any]:
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[0]))
+        return jnp.asarray(
+            rng.standard_normal(shape, dtype=np.float32) * scale)
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "ln1": jnp.ones(cfg.d_model),
+            "ln2": jnp.ones(cfg.d_model),
+            "wq": w(cfg.d_model, cfg.d_model),
+            "wk": w(cfg.d_model, cfg.d_model),
+            "wv": w(cfg.d_model, cfg.d_model),
+            "wo": w(cfg.d_model, cfg.d_model),
+            "w1": w(cfg.d_model, cfg.d_ff),
+            "w2": w(cfg.d_ff, cfg.d_model),
+        })
+    return {
+        "embed": w(cfg.vocab, cfg.d_model, scale=0.02),
+        "pos": w(cfg.max_seq, cfg.d_model, scale=0.02),
+        "ln_f": jnp.ones(cfg.d_model),
+        "layers": layers,
+    }
+
+
+def _rms_norm(x, g):
+    return x * g * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True)
+                                 + 1e-6)
+
+
+def _attn(cfg: DecoderConfig, lp, x, k_cache, v_cache, pos_mask):
+    """x: [T, D]; caches: [S, D] (S = max_seq). pos_mask: [T, S] allowed."""
+    t = x.shape[0]
+    h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+    q = (x @ lp["wq"]).reshape(t, h, dh)
+    k = k_cache.reshape(-1, h, dh)
+    v = v_cache.reshape(-1, h, dh)
+    scores = jnp.einsum("thd,shd->hts", q, k) / jnp.sqrt(dh).astype(x.dtype)
+    scores = jnp.where(pos_mask[None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hts,shd->thd", probs, v).reshape(t, cfg.d_model)
+    return out @ lp["wo"]
+
+
+def _block(cfg, lp, x, k_cache, v_cache, pos_mask):
+    normed = _rms_norm(x, lp["ln1"])
+    x = x + _attn(cfg, lp, normed, k_cache, v_cache, pos_mask)
+    normed = _rms_norm(x, lp["ln2"])
+    x = x + jax.nn.gelu(normed @ lp["w1"]) @ lp["w2"]
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill(cfg: DecoderConfig, params, tokens: jnp.ndarray,
+            length: jnp.ndarray):
+    """tokens: [max_seq] int32 (PAD-padded); length: scalar actual length.
+    Returns (logits_at_last, caches) where caches[l] = (k [S,D], v [S,D])."""
+    s = cfg.max_seq
+    x = params["embed"][tokens] + params["pos"]
+    x = x.astype(jnp.bfloat16)
+    positions = jnp.arange(s)
+    causal = positions[None, :] <= positions[:, None]  # [T, S]
+    valid = positions[None, :] < length  # keys must be real tokens
+    mask = causal & (valid | (positions[None, :] == positions[:, None]))
+    caches = []
+    for lp in params["layers"]:
+        lp16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), lp)
+        k = _rms_norm(x, lp16["ln1"]) @ lp16["wk"]
+        v = _rms_norm(x, lp16["ln1"]) @ lp16["wv"]
+        x = _block(cfg, lp16, x, k, v, mask)
+        caches.append((k, v))
+    x = _rms_norm(x, params["ln_f"].astype(jnp.bfloat16))
+    logits = (x[length - 1] @ params["embed"].astype(jnp.bfloat16).T)
+    return logits.astype(jnp.float32), caches
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new"))
+def generate_tokens(
+    cfg: DecoderConfig,
+    params,
+    tokens: jnp.ndarray,  # [max_seq] PAD-padded prompt
+    length: jnp.ndarray,  # scalar
+    max_new: int,
+    temperature: float,
+    rng_key: jnp.ndarray,
+) -> jnp.ndarray:
+    """Sample up to max_new tokens after the prompt; returns [max_new]
+    int32 (EOS-padded once EOS is hit). Static shapes throughout: the
+    decode loop is a lax.scan over positions with the KV cache updated
+    via dynamic_update_slice."""
+    logits0, caches = prefill(cfg, params, tokens, length)
+    params16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), params)
+    s = cfg.max_seq
+    positions = jnp.arange(s)
+
+    def sample(logits, key):
+        logits = logits.at[PAD].set(-1e30)
+        return jax.lax.cond(
+            temperature <= 1e-6,
+            lambda: jnp.argmax(logits).astype(jnp.int32),
+            lambda: jax.random.categorical(
+                key, logits / jnp.maximum(temperature, 1e-6)
+            ).astype(jnp.int32),
+        )
+
+    def step(carry, key):
+        logits, caches, pos, done = carry
+        tok = sample(logits, key)
+        tok = jnp.where(done, EOS, tok)
+        done = done | (tok == EOS) | (pos >= s - 1)
+        # single-token forward at position `pos`
+        x = (params16["embed"][tok] + params16["pos"][pos])[None, :]
+        new_caches = []
+        mask = (positions[None, :] <= pos)  # [1, S]
+        for lp, (k_c, v_c) in zip(params16["layers"], caches):
+            normed = _rms_norm(x, lp["ln1"])
+            k_new = normed @ lp["wk"]
+            v_new = normed @ lp["wv"]
+            k_c = jax.lax.dynamic_update_slice(k_c, k_new, (pos, 0))
+            v_c = jax.lax.dynamic_update_slice(v_c, v_new, (pos, 0))
+            x = _block(cfg, lp, x, k_c, v_c, mask)
+            new_caches.append((k_c, v_c))
+        x = _rms_norm(x, params16["ln_f"])
+        next_logits = (x[0] @ params16["embed"].T).astype(jnp.float32)
+        return (next_logits, new_caches, pos + 1, done), tok
+
+    keys = jax.random.split(rng_key, max_new)
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (logits0, caches, length, jnp.asarray(False)), keys)
+    return toks
+
+
+class DecoderModel:
+    """Host-side wrapper: tokenize → device generate → detokenize."""
+
+    def __init__(self, cfg: Optional[DecoderConfig] = None,
+                 params: Optional[Dict[str, Any]] = None, seed: int = 0):
+        self.cfg = cfg or DecoderConfig.tiny()
+        self.params = params if params is not None else init_params(
+            self.cfg, seed)
+
+    def param_bytes(self) -> int:
+        leaves = jax.tree_util.tree_leaves(self.params)
+        return int(sum(np.prod(x.shape) * 4 for x in leaves))
+
+    def generate(self, prompt: str, max_tokens: int = 64,
+                 temperature: float = 0.0, seed: int = 0) -> str:
+        ids = encode_bytes(prompt, self.cfg.max_seq)
+        length = len(ids)
+        padded = np.full(self.cfg.max_seq, PAD, np.int32)
+        padded[:length] = ids
+        max_new = min(max_tokens, self.cfg.max_seq - length)
+        if max_new <= 0:
+            return ""
+        toks = generate_tokens(
+            self.cfg, self.params, jnp.asarray(padded),
+            jnp.asarray(length, jnp.int32), int(max_new),
+            float(temperature), jax.random.PRNGKey(seed),
+        )
+        out = np.asarray(toks)
+        eos = np.nonzero(out == EOS)[0]
+        if eos.size:
+            out = out[: eos[0]]
+        return decode_bytes(out)

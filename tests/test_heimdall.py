@@ -1,0 +1,343 @@
+"""Heimdall subsystem tests.
+
+Reference: pkg/heimdall — scheduler (Manager load/unload + budget),
+Generate/Chat/GenerateWithTools, Bifrost push channel, plugin API.
+EchoGenerator is the stub backend (reference tests use stub generators).
+"""
+
+import json
+import threading
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.heimdall import (
+    Bifrost,
+    EchoGenerator,
+    Manager,
+    ModelSpec,
+    ToolLoop,
+)
+
+
+class TestDecoderModel:
+    def test_greedy_generation_is_deterministic(self):
+        from nornicdb_tpu.heimdall.model import DecoderConfig, DecoderModel
+
+        m = DecoderModel(DecoderConfig.tiny())
+        a = m.generate("hi", max_tokens=6)
+        b = m.generate("hi", max_tokens=6)
+        assert a == b
+
+    def test_generation_respects_max_tokens(self):
+        from nornicdb_tpu.heimdall.model import DecoderConfig, DecoderModel
+
+        m = DecoderModel(DecoderConfig.tiny())
+        out = m.generate("x", max_tokens=4, temperature=1.0, seed=3)
+        assert len(out.encode("utf-8", errors="replace")) <= 16
+
+    def test_param_bytes_positive(self):
+        from nornicdb_tpu.heimdall.generators import JAXGenerator
+        from nornicdb_tpu.heimdall.model import DecoderConfig
+
+        g = JAXGenerator(cfg=DecoderConfig.tiny())
+        assert g.param_bytes() > 0
+
+
+class TestManager:
+    def test_register_load_generate(self):
+        mgr = Manager()
+        mgr.register(ModelSpec(name="m1", backend="echo",
+                               memory_bytes=100))
+        r = mgr.generate("hello", model="m1")
+        assert r.text.startswith("echo:")
+        assert r.model == "m1"
+        assert mgr.models()[0].loaded
+
+    def test_memory_budget_evicts(self):
+        mgr = Manager(memory_budget_bytes=150)
+        mgr.register(ModelSpec(name="a", backend="echo", memory_bytes=100))
+        mgr.register(ModelSpec(name="b", backend="echo", memory_bytes=100))
+        mgr.load("a")
+        mgr.load("b")  # must evict a
+        specs = {s.name: s for s in mgr.models()}
+        assert specs["b"].loaded and not specs["a"].loaded
+        assert mgr.memory_used == 100
+
+    def test_over_budget_model_rejected(self):
+        mgr = Manager(memory_budget_bytes=50)
+        mgr.register(ModelSpec(name="big", backend="echo",
+                               memory_bytes=100))
+        with pytest.raises(MemoryError):
+            mgr.load("big")
+
+    def test_chat_renders_transcript(self):
+        mgr = Manager()
+        echo = EchoGenerator()
+        mgr.register(ModelSpec(name="e", backend="echo"))
+        mgr._loaded["e"] = echo  # inject to inspect calls
+        mgr._specs["e"].loaded = True
+        mgr.chat([{"role": "system", "content": "be brief"},
+                  {"role": "user", "content": "hi"}], model="e")
+        assert "system: be brief" in echo.calls[0]
+        assert echo.calls[0].rstrip().endswith("assistant:")
+
+    def test_rbac_check_runs(self):
+        denied = []
+
+        def rbac(user):
+            denied.append(user)
+            raise PermissionError("nope")
+
+        mgr = Manager(rbac_check=rbac)
+        mgr.register(ModelSpec(name="e", backend="echo"))
+        with pytest.raises(PermissionError):
+            mgr.generate("x", model="e", user="alice")
+        assert denied == ["alice"]
+
+    def test_plugin_transforms_output(self):
+        class Upper:
+            def on_generate(self, prompt, text):
+                return text.upper()
+
+        mgr = Manager()
+        mgr.register(ModelSpec(name="e", backend="echo"))
+        mgr.register_plugin(Upper())
+        r = mgr.generate("hi", model="e")
+        assert r.text.startswith("ECHO:")
+
+
+class TestToolLoop:
+    def test_tool_loop_executes_mcp_and_answers(self):
+        from nornicdb_tpu.api.mcp import McpServer
+
+        db = nornicdb_tpu.open()
+        try:
+            mcp = McpServer(db)
+            gen = EchoGenerator(replies=[
+                'TOOL {"tool": "store", "args": {"content": "note one",'
+                ' "node_id": "n1"}}',
+                "stored it!",
+            ])
+            loop = ToolLoop(gen, mcp)
+            text, calls = loop.run("please store a note")
+            assert text == "stored it!"
+            assert len(calls) == 1
+            assert calls[0]["tool"] == "store"
+            assert db.storage.has_node("n1")
+        finally:
+            db.close()
+
+    def test_unknown_tool_reported_not_crash(self):
+        from nornicdb_tpu.api.mcp import McpServer
+
+        db = nornicdb_tpu.open()
+        try:
+            mcp = McpServer(db)
+            gen = EchoGenerator(replies=[
+                'TOOL {"tool": "nope", "args": {}}',
+                "done",
+            ])
+            text, calls = ToolLoop(gen, mcp).run("x")
+            assert calls[0]["result"]["error"].startswith("unknown tool")
+            assert text == "done"
+        finally:
+            db.close()
+
+    def test_round_cap(self):
+        from nornicdb_tpu.api.mcp import McpServer
+
+        db = nornicdb_tpu.open()
+        try:
+            mcp = McpServer(db)
+            gen = EchoGenerator(replies=[
+                'TOOL {"tool": "tasks", "args": {}}'] * 10)
+            text, calls = ToolLoop(gen, mcp).run("x", max_rounds=3)
+            assert len(calls) == 3
+        finally:
+            db.close()
+
+
+class TestBifrost:
+    def test_pubsub_fanout(self):
+        b = Bifrost()
+        s1, s2 = b.subscribe(), b.subscribe()
+        assert b.publish("tick", {"n": 1}) == 2
+        e1 = list(b.events(s1, timeout=0.1, max_events=1))
+        e2 = list(b.events(s2, timeout=0.1, max_events=1))
+        assert e1[0]["data"] == {"n": 1}
+        assert e2[0]["event"] == "tick"
+
+    def test_slow_subscriber_drops_oldest(self):
+        b = Bifrost(max_queue=2)
+        s = b.subscribe()
+        for i in range(5):
+            b.publish("e", {"i": i})
+        got = [m["data"]["i"] for m in b.events(s, timeout=0.05)]
+        assert got == [3, 4]
+
+    def test_sse_rendering(self):
+        b = Bifrost()
+        s = b.subscribe()
+        b.publish("gen", {"x": "y"})
+        msg = next(b.events(s, timeout=0.1))
+        sse = Bifrost.sse(msg)
+        assert sse.startswith("event: gen\n")
+        assert 'data: {"x": "y"}' in sse
+
+
+class TestHTTPSurface:
+    @pytest.fixture()
+    def server(self):
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        db = nornicdb_tpu.open()
+        srv = HttpServer(db, port=0).start()
+        # swap the default JAX model for the echo stub: HTTP tests
+        # shouldn't pay a jit compile
+        from nornicdb_tpu.heimdall import Bifrost as _B, Manager, ModelSpec
+
+        mgr = Manager()
+        mgr.register(ModelSpec(name="echo", backend="echo"))
+        mgr.bifrost = _B()
+        srv._heimdall = mgr
+        yield srv
+        srv.stop()
+        db.close()
+
+    def _post(self, server, path, body):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())
+
+    def test_openai_compatible_chat(self, server):
+        r = self._post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "hello"}]})
+        assert r["object"] == "chat.completion"
+        assert r["choices"][0]["message"]["role"] == "assistant"
+        assert "hello" in r["choices"][0]["message"]["content"]
+
+    def test_heimdall_models_and_generate(self, server):
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/heimdall/models"
+        ) as resp:
+            models = json.loads(resp.read())["models"]
+        assert models[0]["name"] == "echo"
+        r = self._post(server, "/heimdall/generate", {"prompt": "yo"})
+        assert r["text"].startswith("echo:")
+
+    def test_bifrost_sse_stream(self, server):
+        import urllib.request
+
+        events = []
+
+        def reader():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/bifrost/events"
+                "?idle_timeout=1.5")
+            with urllib.request.urlopen(req) as resp:
+                buf = b""
+                while True:
+                    chunk = resp.read(1)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    if buf.endswith(b"\n\n") and b"event:" in buf:
+                        events.append(buf.decode())
+                        break
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        import time as _t
+
+        _t.sleep(0.3)  # let the subscriber attach
+        self._post(server, "/heimdall/generate", {"prompt": "ping"})
+        t.join(timeout=5)
+        assert events and "event: generation" in events[0]
+
+
+class TestHTTPRegressions:
+    def test_chat_null_content_and_total_tokens(self, server=None):
+        from nornicdb_tpu.api.http_server import HttpServer
+        import urllib.request
+
+        db = nornicdb_tpu.open()
+        srv = HttpServer(db, port=0).start()
+        from nornicdb_tpu.heimdall import Bifrost as _B, Manager, ModelSpec
+
+        mgr = Manager()
+        mgr.register(ModelSpec(name="echo", backend="echo"))
+        mgr.bifrost = _B()
+        srv._heimdall = mgr
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+                data=json.dumps({"messages": [
+                    {"role": "assistant", "content": None},
+                    {"role": "user", "content": "hello"},
+                ]}).encode(), method="POST")
+            with urllib.request.urlopen(req) as resp:
+                r = json.loads(resp.read())
+            usage = r["usage"]
+            assert usage["total_tokens"] == (
+                usage["prompt_tokens"] + usage["completion_tokens"])
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_sse_requires_auth_when_enabled(self):
+        import urllib.error
+        import urllib.request
+
+        from nornicdb_tpu.api.http_server import HttpServer
+        from nornicdb_tpu.auth import Authenticator
+
+        db = nornicdb_tpu.open()
+        auth = Authenticator()
+        auth.create_user("admin", "pw", roles=["admin"])
+        srv = HttpServer(db, port=0, authenticator=auth).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/bifrost/events"
+                    "?idle_timeout=0.2")
+            assert ei.value.code in (401, 403)
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_sse_bad_idle_timeout_is_400(self):
+        import urllib.error
+        import urllib.request
+
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        db = nornicdb_tpu.open()
+        srv = HttpServer(db, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/bifrost/events"
+                    "?idle_timeout=abc")
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_store_tool_schema_declares_node_id(self):
+        from nornicdb_tpu.api.mcp import McpServer
+
+        db = nornicdb_tpu.open()
+        try:
+            mcp = McpServer(db)
+            schema = mcp._tools["store"]["inputSchema"]
+            assert "node_id" in schema["properties"]
+        finally:
+            db.close()
