@@ -9,5 +9,13 @@ CPU; all bulk distance math runs on device via nornicdb_tpu.ops.
 from nornicdb_tpu.search.bm25 import BM25Index, tokenize  # noqa: F401
 from nornicdb_tpu.search.vector_index import BruteForceIndex  # noqa: F401
 from nornicdb_tpu.search.hnsw import HNSWIndex  # noqa: F401
+from nornicdb_tpu.search.ivf_hnsw import IVFHNSWIndex  # noqa: F401
+from nornicdb_tpu.search.ivfpq import IVFPQIndex  # noqa: F401
+from nornicdb_tpu.search.ann_quality import (  # noqa: F401
+    ANNProfile,
+    PROFILES,
+    current_profile,
+)
+from nornicdb_tpu.search.rerank import LLMReranker, LocalReranker  # noqa: F401
 from nornicdb_tpu.search.rrf import rrf_fuse  # noqa: F401
 from nornicdb_tpu.search.service import SearchService, SearchResult  # noqa: F401

@@ -1,0 +1,47 @@
+"""ANN quality profiles: the global strategy selector.
+
+Reference: pkg/search ann_quality.go:10-35 (ANNQuality fast/balanced/
+accurate/compressed), ann_profile.go, build_settings.go — one env knob
+(NORNICDB_VECTOR_ANN_QUALITY) that maps to index choice + parameters.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ANNProfile:
+    name: str
+    index_kind: str  # brute | hnsw | ivf_hnsw | ivfpq
+    hnsw_m: int = 16
+    hnsw_ef_construction: int = 100
+    hnsw_ef_search: int = 64
+    nprobe: int = 8
+    pq_subspaces: int = 16
+
+
+PROFILES = {
+    "fast": ANNProfile(
+        name="fast", index_kind="hnsw",
+        hnsw_m=8, hnsw_ef_construction=60, hnsw_ef_search=32, nprobe=2),
+    "balanced": ANNProfile(
+        name="balanced", index_kind="hnsw",
+        hnsw_m=16, hnsw_ef_construction=100, hnsw_ef_search=64, nprobe=4),
+    "accurate": ANNProfile(
+        name="accurate", index_kind="hnsw",
+        hnsw_m=32, hnsw_ef_construction=200, hnsw_ef_search=128, nprobe=8),
+    "compressed": ANNProfile(
+        name="compressed", index_kind="ivfpq",
+        nprobe=8, pq_subspaces=16),
+}
+
+ENV_VAR = "NORNICDB_VECTOR_ANN_QUALITY"
+
+
+def current_profile(name: str | None = None) -> ANNProfile:
+    """Resolve a profile by explicit name or the env knob; unknown names
+    fall back to balanced (reference behavior)."""
+    key = (name or os.environ.get(ENV_VAR, "balanced")).strip().lower()
+    return PROFILES.get(key, PROFILES["balanced"])

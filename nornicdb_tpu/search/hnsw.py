@@ -68,6 +68,12 @@ class HNSWIndex:
         with self._lock:
             return ext_id in self._slot_of
 
+    def ids(self):
+        """Alive external ids (IVF-HNSW reload rebuilds its routing map
+        from these)."""
+        with self._lock:
+            return list(self._slot_of.keys())
+
     @property
     def tombstone_ratio(self) -> float:
         total = self._count

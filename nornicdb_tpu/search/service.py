@@ -77,9 +77,11 @@ class SearchService:
         hnsw_threshold: int = 10_000,
         hnsw_m: int = 16,
         hnsw_ef_search: int = 64,
+        reranker: Optional[Any] = None,
     ):
         self.storage = storage
         self.embedder = embedder
+        self.reranker = reranker  # stage-2 rerank (rerank.py), optional
         self.hnsw_threshold = hnsw_threshold
         self._lock = threading.RLock()
         self.bm25 = BM25Index()
@@ -231,6 +233,7 @@ class SearchService:
         vec_hits: List[Tuple[str, float]] = []
         if mode in ("hybrid", "text") and query:
             bm25_hits = self.bm25.search(query, overfetch)
+        qv = None
         if mode in ("hybrid", "vector"):
             qv = (
                 np.asarray(query_embedding, dtype=np.float32)
@@ -276,6 +279,15 @@ class SearchService:
                 if enrich:
                     res.node = node
             out.append(res.to_dict())
-            if len(out) >= limit:
+            if len(out) >= limit and self.reranker is None:
                 break
-        return out
+        if self.reranker is not None and out:
+            # stage-2 rerank over the full fused overfetch, then cut
+            # (reference: rerank.go after RRF). Pass the query embedding
+            # already computed — the reranker must not re-embed.
+            try:
+                out = self.reranker.rerank(query, out, limit=limit,
+                                           query_embedding=qv)
+            except Exception:
+                out = out[:limit]  # fail-open (reference: llm_rerank.go)
+        return out[:limit]

@@ -1,0 +1,129 @@
+"""Vector-space registry: named vector spaces keyed by scope.
+
+Reference: pkg/vectorspace/registry.go:1-60 — spaces keyed
+(db, entity type, vector name, dims, metric) with backend kinds
+auto/brute-force/hnsw; chunk vectors get their own space
+(ChunkVectorName). The TPU build adds ivf_hnsw / ivfpq backends
+(ann_quality.py profiles).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+CHUNK_VECTOR_NAME = "chunks"
+DEFAULT_VECTOR_NAME = "embedding"
+
+_BACKENDS = ("auto", "brute", "hnsw", "ivf_hnsw", "ivfpq")
+
+
+@dataclass(frozen=True)
+class SpaceKey:
+    database: str = "neo4j"
+    entity_type: str = "node"
+    vector_name: str = DEFAULT_VECTOR_NAME
+    dims: int = 0
+    metric: str = "cosine"
+
+
+@dataclass
+class VectorSpace:
+    key: SpaceKey
+    backend: str = "auto"
+    index: Any = None  # lazily-built index instance
+    _build_lock: Any = field(default_factory=threading.Lock, repr=False)
+
+    def ensure_index(self):
+        """Build the backend index on first use (auto resolves through
+        the ANN profile). Locked: a concurrent double-build would hand
+        two callers different instances and silently lose vectors."""
+        with self._build_lock:
+            return self._ensure_index_locked()
+
+    def _ensure_index_locked(self):
+        if self.index is not None:
+            return self.index
+        from nornicdb_tpu.search.ann_quality import current_profile
+        from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+        kind = self.backend
+        if kind == "auto":
+            kind = current_profile().index_kind
+        if kind == "brute":
+            self.index = BruteForceIndex(dims=self.key.dims or None)
+        elif kind == "hnsw":
+            from nornicdb_tpu.search.hnsw import HNSWIndex
+
+            p = current_profile()
+            self.index = HNSWIndex(m=p.hnsw_m,
+                                   ef_construction=p.hnsw_ef_construction,
+                                   ef_search=p.hnsw_ef_search)
+        elif kind == "ivf_hnsw":
+            from nornicdb_tpu.search.ivf_hnsw import IVFHNSWIndex
+
+            p = current_profile()
+            self.index = IVFHNSWIndex(nprobe=p.nprobe, m=p.hnsw_m,
+                                      ef_construction=p.hnsw_ef_construction)
+        elif kind == "ivfpq":
+            from nornicdb_tpu.search.ivfpq import IVFPQIndex
+
+            p = current_profile()
+            self.index = IVFPQIndex(n_subspaces=p.pq_subspaces,
+                                    nprobe=p.nprobe)
+        else:
+            raise ValueError(f"unknown backend {kind!r}")
+        return self.index
+
+
+class VectorSpaceRegistry:
+    """Thread-safe registry (reference: registry.go)."""
+
+    def __init__(self):
+        self._spaces: Dict[SpaceKey, VectorSpace] = {}
+        self._lock = threading.Lock()
+
+    def register(self, key: SpaceKey, backend: str = "auto") -> VectorSpace:
+        if backend not in _BACKENDS:
+            raise ValueError(f"unknown backend {backend!r}")
+        with self._lock:
+            sp = self._spaces.get(key)
+            if sp is None:
+                sp = VectorSpace(key=key, backend=backend)
+                self._spaces[key] = sp
+            return sp
+
+    def get(self, key: SpaceKey) -> Optional[VectorSpace]:
+        with self._lock:
+            return self._spaces.get(key)
+
+    def get_or_create(
+        self,
+        database: str = "neo4j",
+        entity_type: str = "node",
+        vector_name: str = DEFAULT_VECTOR_NAME,
+        dims: int = 0,
+        metric: str = "cosine",
+        backend: str = "auto",
+    ) -> VectorSpace:
+        return self.register(
+            SpaceKey(database, entity_type, vector_name, dims, metric),
+            backend)
+
+    def list(self, database: Optional[str] = None) -> List[SpaceKey]:
+        with self._lock:
+            return [k for k in self._spaces
+                    if database is None or k.database == database]
+
+    def drop(self, key: SpaceKey) -> bool:
+        with self._lock:
+            return self._spaces.pop(key, None) is not None
+
+    def drop_database(self, database: str) -> int:
+        """Drop every space of a database (multi-DB drop path)."""
+        with self._lock:
+            doomed = [k for k in self._spaces if k.database == database]
+            for k in doomed:
+                del self._spaces[k]
+            return len(doomed)

@@ -1,0 +1,325 @@
+"""IVF-PQ / IVF-HNSW / ANN profiles / vectorspace / rerank tests.
+
+Reference: pkg/search (ivfpq_index.go, ivf_hnsw_candidate_gen.go,
+ann_quality.go, rerank.go) and pkg/vectorspace (registry.go).
+"""
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.search import (
+    IVFHNSWIndex,
+    IVFPQIndex,
+    PROFILES,
+    LLMReranker,
+    LocalReranker,
+    current_profile,
+)
+from nornicdb_tpu.vectorspace import (
+    CHUNK_VECTOR_NAME,
+    SpaceKey,
+    VectorSpaceRegistry,
+)
+
+
+def _clustered_vectors(n_per=50, n_clusters=4, dims=32, seed=0):
+    """Well-separated clusters so ANN recall is testable."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_clusters, dims)) * 10
+    items = []
+    for c in range(n_clusters):
+        pts = centers[c] + rng.standard_normal((n_per, dims)) * 0.1
+        for i, p in enumerate(pts):
+            items.append((f"c{c}-{i}", p.astype(np.float32)))
+    return items
+
+
+class TestIVFPQ:
+    def test_train_encode_search_recall(self):
+        items = _clustered_vectors()
+        vecs = np.asarray([v for _, v in items])
+        idx = IVFPQIndex(n_subspaces=8, n_clusters=4, nprobe=2)
+        idx.train(vecs)
+        idx.add_batch(items)
+        assert len(idx) == len(items)
+        # querying with a member vector finds same-cluster neighbors
+        hits = idx.search(items[0][1], k=5)
+        assert len(hits) == 5
+        assert all(h.startswith("c0-") for h, _ in hits)
+
+    def test_untrained_raises(self):
+        idx = IVFPQIndex()
+        with pytest.raises(RuntimeError):
+            idx.add_batch([("a", [1.0, 2.0])])
+
+    def test_dims_divisibility_enforced(self):
+        idx = IVFPQIndex(n_subspaces=7)
+        with pytest.raises(ValueError):
+            idx.train(np.random.default_rng(0).standard_normal((10, 32)))
+
+    def test_remove_and_upsert(self):
+        items = _clustered_vectors(n_per=10)
+        idx = IVFPQIndex(n_subspaces=8, n_clusters=4)
+        idx.train(np.asarray([v for _, v in items]))
+        idx.add_batch(items)
+        assert idx.remove("c0-0")
+        assert not idx.remove("c0-0")  # already gone
+        assert len(idx) == len(items) - 1
+        assert all(h != "c0-0" for h, _ in idx.search(items[0][1], k=10))
+        # re-adding resurrects
+        idx.add_batch([items[0]])
+        assert len(idx) == len(items)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        items = _clustered_vectors(n_per=10)
+        idx = IVFPQIndex(n_subspaces=8, n_clusters=4)
+        idx.train(np.asarray([v for _, v in items]))
+        idx.add_batch(items)
+        path = str(tmp_path / "pq")
+        idx.save(path)
+        loaded = IVFPQIndex.load(path)
+        assert len(loaded) == len(idx)
+        a = [h for h, _ in idx.search(items[5][1], k=5)]
+        b = [h for h, _ in loaded.search(items[5][1], k=5)]
+        assert a == b
+
+    def test_compression_ratio(self):
+        items = _clustered_vectors(n_per=25, dims=32)
+        idx = IVFPQIndex(n_subspaces=8, n_clusters=4)
+        idx.train(np.asarray([v for _, v in items]))
+        idx.add_batch(items)
+        raw = len(items) * 32 * 4
+        compressed = idx._codes.nbytes
+        assert compressed * 10 < raw  # 8 bytes vs 128 bytes per vector
+
+
+class TestIVFHNSW:
+    def test_build_and_search(self):
+        items = _clustered_vectors()
+        idx = IVFHNSWIndex(n_clusters=4, nprobe=2)
+        idx.build(items)
+        assert len(idx) == len(items)
+        hits = idx.search(items[0][1], k=5)
+        assert hits[0][0] == "c0-0"
+        assert all(h.startswith("c0-") for h, _ in hits)
+
+    def test_incremental_add_and_remove(self):
+        items = _clustered_vectors(n_per=10)
+        idx = IVFHNSWIndex(n_clusters=4, nprobe=2)
+        idx.build(items)
+        new_vec = items[0][1] + 0.01
+        idx.add("newbie", new_vec)
+        hits = idx.search(new_vec, k=3)
+        assert "newbie" in [h for h, _ in hits]
+        assert idx.remove("newbie")
+        assert "newbie" not in [h for h, _ in idx.search(new_vec, k=3)]
+
+    def test_save_load_roundtrip(self, tmp_path):
+        items = _clustered_vectors(n_per=10)
+        idx = IVFHNSWIndex(n_clusters=4, nprobe=2)
+        idx.build(items)
+        idx.save(str(tmp_path / "ivf"))
+        loaded = IVFHNSWIndex.load(str(tmp_path / "ivf"))
+        assert len(loaded) == len(idx)
+        a = [h for h, _ in idx.search(items[3][1], k=5)]
+        b = [h for h, _ in loaded.search(items[3][1], k=5)]
+        assert set(a) == set(b)
+
+
+class TestANNQuality:
+    def test_profiles_exist(self):
+        assert set(PROFILES) == {"fast", "balanced", "accurate",
+                                 "compressed"}
+        assert PROFILES["compressed"].index_kind == "ivfpq"
+        assert (PROFILES["accurate"].hnsw_ef_search
+                > PROFILES["fast"].hnsw_ef_search)
+
+    def test_env_selection(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_VECTOR_ANN_QUALITY", "accurate")
+        assert current_profile().name == "accurate"
+        monkeypatch.setenv("NORNICDB_VECTOR_ANN_QUALITY", "garbage")
+        assert current_profile().name == "balanced"  # fallback
+
+    def test_explicit_name_wins(self):
+        assert current_profile("fast").name == "fast"
+
+
+class TestVectorSpaceRegistry:
+    def test_register_get_drop(self):
+        reg = VectorSpaceRegistry()
+        sp = reg.get_or_create(database="db1", dims=128)
+        assert reg.get(sp.key) is sp
+        # same key -> same space
+        assert reg.get_or_create(database="db1", dims=128) is sp
+        chunk = reg.get_or_create(database="db1",
+                                  vector_name=CHUNK_VECTOR_NAME, dims=128)
+        assert chunk is not sp
+        assert len(reg.list("db1")) == 2
+        assert reg.drop_database("db1") == 2
+        assert reg.list() == []
+
+    def test_backend_resolution(self):
+        reg = VectorSpaceRegistry()
+        brute = reg.get_or_create(database="x", backend="brute")
+        from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+        assert isinstance(brute.ensure_index(), BruteForceIndex)
+        pq = reg.get_or_create(database="x", vector_name="pq",
+                               backend="ivfpq")
+        from nornicdb_tpu.search.ivfpq import IVFPQIndex as PQ
+
+        assert isinstance(pq.ensure_index(), PQ)
+
+    def test_unknown_backend_rejected(self):
+        reg = VectorSpaceRegistry()
+        with pytest.raises(ValueError):
+            reg.register(SpaceKey(), backend="warp-drive")
+
+
+class TestRerank:
+    def _candidates(self):
+        return [
+            {"id": "a", "score": 0.9,
+             "properties": {"content": "cooking pasta recipes"}},
+            {"id": "b", "score": 0.8,
+             "properties": {"content": "tpu compiler internals"}},
+            {"id": "c", "score": 0.7,
+             "properties": {"content": "tpu kernel tuning guide"}},
+        ]
+
+    def test_local_reranker_lexical(self):
+        rr = LocalReranker(alpha=0.0)  # lexical only
+        out = rr.rerank("tpu kernel tuning", self._candidates())
+        assert out[0]["id"] == "c"
+        assert out[0]["rerank_score"] >= out[-1]["rerank_score"]
+
+    def test_local_reranker_with_embeddings(self):
+        rr = LocalReranker(alpha=1.0)  # cosine only
+        cands = self._candidates()
+        cands[0]["_embedding"] = [1.0, 0.0]
+        cands[1]["_embedding"] = [0.0, 1.0]
+        cands[2]["_embedding"] = [0.9, 0.1]
+        out = rr.rerank("q", cands, query_embedding=[0.0, 1.0])
+        assert out[0]["id"] == "b"
+
+    def test_llm_reranker_orders_by_model(self):
+        from nornicdb_tpu.heimdall import Manager, ModelSpec
+
+        mgr = Manager()
+        mgr.register(ModelSpec(name="e", backend="echo",
+                               options={"replies": ['["c", "a", "b"]']}))
+        rr = LLMReranker(mgr, model="e")
+        out = rr.rerank("q", self._candidates())
+        assert [c["id"] for c in out] == ["c", "a", "b"]
+
+    def test_llm_reranker_fails_open(self):
+        from nornicdb_tpu.heimdall import Manager, ModelSpec
+
+        mgr = Manager()
+        mgr.register(ModelSpec(name="e", backend="echo",
+                               options={"replies": ["not json at all"]}))
+        rr = LLMReranker(mgr, model="e")
+        out = rr.rerank("q", self._candidates())
+        assert [c["id"] for c in out] == ["a", "b", "c"]  # untouched
+
+    def test_service_integration(self):
+        import nornicdb_tpu
+        from nornicdb_tpu.search.service import SearchService
+
+        db = nornicdb_tpu.open()
+        try:
+            svc = SearchService(db.storage,
+                                reranker=LocalReranker(alpha=0.0))
+            from nornicdb_tpu.storage.types import Node
+
+            for i, text in enumerate(
+                ["tpu kernels", "pasta cooking", "tpu tuning deep dive"]
+            ):
+                n = Node(id=f"n{i}", labels=["Doc"],
+                         properties={"content": text})
+                db.storage.create_node(n)
+                svc.index_node(n)
+            out = svc.search("tpu tuning", limit=2)
+            assert out[0]["id"] == "n2"
+            assert "rerank_score" in out[0]
+        finally:
+            db.close()
+
+
+class TestReviewRegressions:
+    def test_ivfpq_trains_on_duplicate_vectors(self):
+        """kmeans++ must not crash when residual subvectors coincide."""
+        v = np.ones((50, 16), dtype=np.float32)
+        items = [(f"d{i}", v[i]) for i in range(50)]
+        idx = IVFPQIndex(n_subspaces=4, n_clusters=2)
+        idx.train(v)  # all-duplicate: zero D^2 weights everywhere
+        idx.add_batch(items)
+        assert len(idx.search(v[0], k=3)) == 3
+
+    def test_ivfpq_empty_batch_noop(self):
+        idx = IVFPQIndex(n_subspaces=4, n_clusters=2)
+        idx.train(np.random.default_rng(0)
+                  .standard_normal((20, 16)).astype(np.float32))
+        idx.add_batch([])  # must not crash
+        assert len(idx) == 0
+
+    def test_ivf_hnsw_save_clears_stale_clusters(self, tmp_path):
+        items = _clustered_vectors(n_per=10)
+        idx = IVFHNSWIndex(n_clusters=4, nprobe=4)
+        idx.build(items)
+        d = str(tmp_path / "ivf")
+        idx.save(d)
+        # rebuild with a disjoint, smaller dataset and save again
+        small = _clustered_vectors(n_per=5, n_clusters=2, seed=9)
+        small = [(f"new-{i}", v) for i, (_, v) in enumerate(small)]
+        idx2 = IVFHNSWIndex(n_clusters=2, nprobe=2)
+        idx2.build(small)
+        idx2.save(d)
+        loaded = IVFHNSWIndex.load(d)
+        assert len(loaded) == len(small)
+        assert all(e.startswith("new-") for e in loaded._where)
+
+    def test_vectorspace_concurrent_ensure_index(self):
+        import threading as th
+
+        from nornicdb_tpu.vectorspace import VectorSpaceRegistry
+
+        reg = VectorSpaceRegistry()
+        sp = reg.get_or_create(database="r", backend="brute")
+        got = []
+        barrier = th.Barrier(8)
+
+        def grab():
+            barrier.wait()
+            got.append(sp.ensure_index())
+
+        threads = [th.Thread(target=grab) for _ in range(8)]
+        for t in threads: t.start()
+        for t in threads: t.join()
+        assert all(g is got[0] for g in got)
+
+    def test_reranker_receives_precomputed_embedding(self):
+        import nornicdb_tpu
+        from nornicdb_tpu.search.service import SearchService
+        from nornicdb_tpu.storage.types import Node
+
+        received = {}
+
+        class Spy:
+            def rerank(self, query, candidates, query_embedding=None,
+                       limit=None):
+                received["qv"] = query_embedding
+                return candidates[:limit]
+
+        db = nornicdb_tpu.open()
+        try:
+            svc = SearchService(db.storage, reranker=Spy())
+            n = Node(id="n0", labels=["Doc"],
+                     properties={"content": "hello"},
+                     embedding=[1.0, 0.0])
+            db.storage.create_node(n)
+            svc.index_node(n)
+            svc.search("hello", limit=1, query_embedding=[1.0, 0.0])
+            assert received["qv"] is not None
+        finally:
+            db.close()
