@@ -1,0 +1,227 @@
+"""CLI entrypoint: serve / version / import / export / eval.
+
+Reference: cmd/nornicdb (cobra CLI, main.go:75-1296 — serve with port,
+data-dir, embedding and accelerator flags) and cmd/eval (search-quality
+eval harness CLI). Run as ``python -m nornicdb_tpu.cli <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+VERSION = "0.1.0"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nornicdb-tpu",
+        description="TPU-native NornicDB-capability graph database",
+    )
+    sub = p.add_subparsers(dest="command")
+
+    serve = sub.add_parser("serve", help="start the server")
+    serve.add_argument("--data-dir", default=None,
+                       help="persistent data directory (in-memory if unset)")
+    serve.add_argument("--http-port", type=int, default=7474)
+    serve.add_argument("--bolt-port", type=int, default=7687)
+    serve.add_argument("--grpc-port", type=int, default=0,
+                       help="gRPC port (0 = disabled)")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--database", default="neo4j")
+    serve.add_argument("--plugins-dir", default=None)
+    serve.add_argument("--ann-quality", default=None,
+                       choices=["fast", "balanced", "accurate",
+                                "compressed"])
+
+    sub.add_parser("version", help="print version")
+
+    imp = sub.add_parser("import", help="import nodes/edges from JSONL")
+    imp.add_argument("file")
+    imp.add_argument("--data-dir", default=None)
+    imp.add_argument("--database", default="neo4j")
+
+    exp = sub.add_parser("export", help="export the graph as JSONL")
+    exp.add_argument("file")
+    exp.add_argument("--data-dir", default=None)
+    exp.add_argument("--database", default="neo4j")
+
+    ev = sub.add_parser("eval", help="run a search-quality eval suite")
+    ev.add_argument("suite", help="JSONL suite file")
+    ev.add_argument("--data-dir", default=None)
+    ev.add_argument("--corpus", default=None,
+                    help="JSONL corpus to ingest before evaluating")
+    ev.add_argument("--precision", type=float, default=0.5)
+    ev.add_argument("--recall", type=float, default=0.5)
+    ev.add_argument("--mrr", type=float, default=0.5)
+    return p
+
+
+def _open_db(data_dir: Optional[str], database: str = "neo4j"):
+    import nornicdb_tpu
+
+    return nornicdb_tpu.open(data_dir, database=database)
+
+
+def cmd_serve(args) -> int:
+    import os
+
+    if args.ann_quality:
+        os.environ["NORNICDB_VECTOR_ANN_QUALITY"] = args.ann_quality
+    db = _open_db(args.data_dir, args.database)
+    from nornicdb_tpu.api.bolt import BoltServer
+    from nornicdb_tpu.api.http_server import HttpServer
+
+    http = HttpServer(db, host=args.host, port=args.http_port).start()
+    bolt = BoltServer(db, host=args.host, port=args.bolt_port).start()
+    grpc_srv = None
+    if args.grpc_port:
+        from nornicdb_tpu.api.grpc_server import GrpcServer
+
+        grpc_srv = GrpcServer(db, host=args.host,
+                              port=args.grpc_port).start()
+    if args.plugins_dir:
+        from nornicdb_tpu.plugins import install_plugins
+
+        loaded = install_plugins(db, args.plugins_dir)
+        for p in loaded:
+            status = p.error or f"{p.kind}, {len(p.functions)} functions"
+            print(f"plugin {p.name}: {status}")
+    print(f"nornicdb-tpu {VERSION}")
+    print(f"  http  : http://{args.host}:{http.port}")
+    print(f"  bolt  : bolt://{args.host}:{bolt.port}")
+    if grpc_srv is not None:
+        print(f"  grpc  : {grpc_srv.address}")
+    print(f"  data  : {args.data_dir or '(in-memory)'}")
+    stop = threading.Event()
+
+    def _sig(*_):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    stop.wait()
+    print("shutting down")
+    if grpc_srv is not None:
+        grpc_srv.stop()
+    bolt.stop()
+    http.stop()
+    db.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    """JSONL rows: {"type": "node", "id", "labels", "properties",
+    "embedding"} or {"type": "edge", "id", "start", "end", "edge_type",
+    "properties"}."""
+    from nornicdb_tpu.storage.types import Edge, Node
+
+    db = _open_db(args.data_dir, args.database)
+    nodes = edges = 0
+    try:
+        with open(args.file, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                if row.get("type", "node") == "node":
+                    db.storage.create_node(Node(
+                        id=row["id"], labels=row.get("labels", []),
+                        properties=row.get("properties", {}),
+                        embedding=row.get("embedding")))
+                    nodes += 1
+                else:
+                    db.storage.create_edge(Edge(
+                        id=row["id"], start_node=row["start"],
+                        end_node=row["end"],
+                        type=row.get("edge_type", "RELATED"),
+                        properties=row.get("properties", {})))
+                    edges += 1
+        print(f"imported {nodes} nodes, {edges} edges")
+        return 0
+    finally:
+        db.close()
+
+
+def cmd_export(args) -> int:
+    db = _open_db(args.data_dir, args.database)
+    try:
+        with open(args.file, "w", encoding="utf-8") as f:
+            n = e = 0
+            for node in db.storage.all_nodes():
+                row: Dict[str, Any] = {
+                    "type": "node", "id": node.id, "labels": node.labels,
+                    "properties": node.properties,
+                }
+                if node.embedding is not None:
+                    row["embedding"] = node.embedding
+                f.write(json.dumps(row, default=str) + "\n")
+                n += 1
+            for edge in db.storage.all_edges():
+                f.write(json.dumps({
+                    "type": "edge", "id": edge.id,
+                    "start": edge.start_node, "end": edge.end_node,
+                    "edge_type": edge.type,
+                    "properties": edge.properties,
+                }, default=str) + "\n")
+                e += 1
+        print(f"exported {n} nodes, {e} edges")
+        return 0
+    finally:
+        db.close()
+
+
+def cmd_eval(args) -> int:
+    from nornicdb_tpu.eval import Thresholds, harness_for_db
+
+    db = _open_db(args.data_dir)
+    try:
+        if args.corpus:
+            from nornicdb_tpu.storage.types import Node
+
+            with open(args.corpus, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    node = Node(id=row["id"],
+                                labels=row.get("labels", []),
+                                properties=row.get("properties", {}),
+                                embedding=row.get("embedding"))
+                    db.storage.create_node(node)
+            db.search.build_indexes()
+        harness = harness_for_db(db, Thresholds(
+            precision=args.precision, recall=args.recall, mrr=args.mrr))
+        result = harness.run_file(args.suite)
+        print(json.dumps(result.to_dict(), indent=2))
+        return 0 if result.passed else 1
+    finally:
+        db.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    if args.command == "serve":
+        return cmd_serve(args)
+    if args.command == "version":
+        print(f"nornicdb-tpu {VERSION}")
+        return 0
+    if args.command == "import":
+        return cmd_import(args)
+    if args.command == "export":
+        return cmd_export(args)
+    if args.command == "eval":
+        return cmd_eval(args)
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

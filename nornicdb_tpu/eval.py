@@ -1,0 +1,132 @@
+"""Search-quality eval harness: JSONL suites with P/R/MRR thresholds.
+
+Reference: pkg/eval/harness.go:175-272 (Run/runTestCase), Thresholds
+(harness.go:155), CLI cmd/eval. Suite format (one JSON object per
+line):
+
+    {"name": "case-1", "query": "tpu kernels",
+     "expected": ["n1", "n7"], "limit": 10}
+
+Metrics per case: precision@k, recall@k, reciprocal rank of the first
+relevant hit; suite passes when the averages clear the thresholds.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+
+@dataclass
+class Thresholds:
+    precision: float = 0.5
+    recall: float = 0.5
+    mrr: float = 0.5
+
+
+@dataclass
+class CaseResult:
+    name: str
+    precision: float
+    recall: float
+    reciprocal_rank: float
+    returned: List[str] = field(default_factory=list)
+    expected: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SuiteResult:
+    cases: List[CaseResult] = field(default_factory=list)
+    thresholds: Thresholds = field(default_factory=Thresholds)
+
+    @property
+    def precision(self) -> float:
+        return (sum(c.precision for c in self.cases) / len(self.cases)
+                if self.cases else 0.0)
+
+    @property
+    def recall(self) -> float:
+        return (sum(c.recall for c in self.cases) / len(self.cases)
+                if self.cases else 0.0)
+
+    @property
+    def mrr(self) -> float:
+        return (sum(c.reciprocal_rank for c in self.cases) / len(self.cases)
+                if self.cases else 0.0)
+
+    @property
+    def passed(self) -> bool:
+        t = self.thresholds
+        return (bool(self.cases) and self.precision >= t.precision
+                and self.recall >= t.recall and self.mrr >= t.mrr)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "cases": len(self.cases),
+            "precision": round(self.precision, 4),
+            "recall": round(self.recall, 4),
+            "mrr": round(self.mrr, 4),
+            "passed": self.passed,
+            "failed_cases": [
+                c.name for c in self.cases
+                if c.reciprocal_rank == 0.0
+            ],
+        }
+
+
+def score_case(
+    name: str, returned: Sequence[str], expected: Sequence[str]
+) -> CaseResult:
+    rset = list(returned)
+    eset = set(expected)
+    hits = [r for r in rset if r in eset]
+    precision = len(hits) / len(rset) if rset else 0.0
+    recall = len(set(hits)) / len(eset) if eset else 1.0
+    rr = 0.0
+    for rank, r in enumerate(rset, start=1):
+        if r in eset:
+            rr = 1.0 / rank
+            break
+    return CaseResult(name=name, precision=precision, recall=recall,
+                      reciprocal_rank=rr, returned=rset,
+                      expected=list(expected))
+
+
+class EvalHarness:
+    """Runs a JSONL suite against a search callable
+    (reference: Run/runTestCase harness.go:175-272)."""
+
+    def __init__(self, search_fn, thresholds: Optional[Thresholds] = None):
+        """search_fn(query: str, limit: int) -> List[str] of ids."""
+        self.search_fn = search_fn
+        self.thresholds = thresholds or Thresholds()
+
+    def run_cases(self, cases: Sequence[Dict[str, Any]]) -> SuiteResult:
+        suite = SuiteResult(thresholds=self.thresholds)
+        for case in cases:
+            limit = int(case.get("limit", 10))
+            returned = self.search_fn(case.get("query", ""), limit)
+            suite.cases.append(score_case(
+                case.get("name", case.get("query", "?")),
+                returned, case.get("expected", [])))
+        return suite
+
+    def run_file(self, path: str) -> SuiteResult:
+        cases = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    cases.append(json.loads(line))
+        return self.run_cases(cases)
+
+
+def harness_for_db(db, thresholds: Optional[Thresholds] = None,
+                   mode: str = "hybrid") -> EvalHarness:
+    def search_fn(query: str, limit: int) -> List[str]:
+        return [str(h.get("id")) for h in
+                db.search.search(query=query, limit=limit, mode=mode,
+                                 enrich=False)]
+
+    return EvalHarness(search_fn, thresholds)

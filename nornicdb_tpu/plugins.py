@@ -1,0 +1,112 @@
+"""Plugin system: load Python plugins exposing functions + Heimdall hooks.
+
+Reference: pkg/nornicdb/plugins.go — Go .so plugin loading with
+reflection type-detection (LoadPluginsFromDir :59, detectPluginType
+:207); function plugins become callable from Cypher
+(PluginFunctionLookup db.go:992-999), Heimdall plugins hook generation.
+The Python analog loads modules from a plugin directory and detects
+their type by exported surface:
+
+- **function plugin**: module defines ``FUNCTIONS = {"ns.name": fn}``
+  (or ``register(db)``); functions become Cypher-callable.
+- **heimdall plugin**: module defines a class/instance with an
+  ``on_generate(prompt, text)`` hook.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class LoadedPlugin:
+    name: str
+    path: str
+    kind: str  # function | heimdall | mixed | unknown
+    functions: Dict[str, Callable] = field(default_factory=dict)
+    heimdall_plugins: List[Any] = field(default_factory=list)
+    error: Optional[str] = None
+
+
+def detect_plugin_type(module) -> str:
+    """Reference: detectPluginType (plugins.go:207) — inspect the
+    exported surface instead of requiring a manifest."""
+    has_fn = bool(getattr(module, "FUNCTIONS", None)) or callable(
+        getattr(module, "register", None))
+    has_heimdall = bool(getattr(module, "HEIMDALL_PLUGINS", None)) or (
+        callable(getattr(module, "on_generate", None)))
+    if has_fn and has_heimdall:
+        return "mixed"
+    if has_fn:
+        return "function"
+    if has_heimdall:
+        return "heimdall"
+    return "unknown"
+
+
+def _load_module(path: str):
+    name = "nornicdb_plugin_" + os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def load_plugins_from_dir(
+    directory: str, db=None
+) -> List[LoadedPlugin]:
+    """Load every .py plugin in a directory (reference:
+    LoadPluginsFromDir plugins.go:59). A broken plugin is reported, not
+    fatal."""
+    out: List[LoadedPlugin] = []
+    if not os.path.isdir(directory):
+        return out
+    for fname in sorted(os.listdir(directory)):
+        if not fname.endswith(".py") or fname.startswith("_"):
+            continue
+        path = os.path.join(directory, fname)
+        name = os.path.splitext(fname)[0]
+        try:
+            module = _load_module(path)
+        except Exception as e:
+            out.append(LoadedPlugin(name=name, path=path, kind="unknown",
+                                    error=f"{type(e).__name__}: {e}"))
+            continue
+        kind = detect_plugin_type(module)
+        plugin = LoadedPlugin(name=name, path=path, kind=kind)
+        fns = dict(getattr(module, "FUNCTIONS", {}) or {})
+        register = getattr(module, "register", None)
+        if callable(register):
+            try:
+                extra = register(db)
+                if isinstance(extra, dict):
+                    fns.update(extra)
+            except Exception as e:
+                plugin.error = f"register() failed: {e}"
+        plugin.functions = fns
+        hps = list(getattr(module, "HEIMDALL_PLUGINS", []) or [])
+        if callable(getattr(module, "on_generate", None)):
+            hps.append(module)
+        plugin.heimdall_plugins = hps
+        out.append(plugin)
+    return out
+
+
+def install_plugins(db, directory: str, heimdall_manager=None
+                    ) -> List[LoadedPlugin]:
+    """Load + wire: Cypher-callable functions onto the executor
+    (reference: PluginFunctionLookup db.go:992-999), Heimdall hooks
+    onto the manager."""
+    plugins = load_plugins_from_dir(directory, db=db)
+    for p in plugins:
+        for name, fn in p.functions.items():
+            db.executor.register_function(name, fn)
+        if heimdall_manager is not None:
+            for hp in p.heimdall_plugins:
+                heimdall_manager.register_plugin(hp)
+    return plugins

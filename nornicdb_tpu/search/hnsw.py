@@ -298,7 +298,8 @@ class HNSWIndex:
                     dtype=object,
                 ),
                 meta=np.asarray(
-                    [self._entry, self._max_level, self.m, self.dims or 0],
+                    [self._entry, self._max_level, self.m, self.dims or 0,
+                     self.ef_construction, self.ef_search],
                     dtype=np.int64,
                 ),
             )
@@ -307,8 +308,13 @@ class HNSWIndex:
     def load(cls, path: str) -> "HNSWIndex":
         data = np.load(path if path.endswith(".npz") else path + ".npz",
                        allow_pickle=True)
-        entry, max_level, m, dims = (int(x) for x in data["meta"])
-        idx = cls(dims=dims or None, m=m)
+        meta = [int(x) for x in data["meta"]]
+        entry, max_level, m, dims = meta[:4]
+        # older snapshots (4-field meta) predate ef persistence
+        ef_c = meta[4] if len(meta) > 4 else 200
+        ef_s = meta[5] if len(meta) > 5 else 64
+        idx = cls(dims=dims or None, m=m, ef_construction=ef_c,
+                  ef_search=ef_s)
         vecs = data["vectors"]
         idx._count = vecs.shape[0]
         idx._capacity = vecs.shape[0]

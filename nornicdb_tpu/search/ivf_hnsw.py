@@ -22,11 +22,13 @@ from nornicdb_tpu.search.util import normalize_rows as _normalize
 
 class IVFHNSWIndex:
     def __init__(self, n_clusters: int = 16, nprobe: int = 3,
-                 m: int = 16, ef_construction: int = 100):
+                 m: int = 16, ef_construction: int = 100,
+                 ef_search: int = 64):
         self.n_clusters = n_clusters
         self.nprobe = nprobe
         self.m = m
         self.ef_construction = ef_construction
+        self.ef_search = ef_search
         self.centroids: Optional[np.ndarray] = None  # [K, D] normalized
         self.clusters: Dict[int, HNSWIndex] = {}
         self._where: Dict[str, int] = {}
@@ -69,7 +71,8 @@ class IVFHNSWIndex:
                 if not members:
                     continue
                 idx = HNSWIndex(m=self.m,
-                                ef_construction=self.ef_construction)
+                                ef_construction=self.ef_construction,
+                                ef_search=self.ef_search)
                 idx.build(members,
                           seed_ids=[e for e, _ in members if e in seeds])
                 self.clusters[int(c)] = idx
@@ -90,7 +93,8 @@ class IVFHNSWIndex:
             idx = self.clusters.get(c)
             if idx is None:
                 idx = HNSWIndex(m=self.m,
-                                ef_construction=self.ef_construction)
+                                ef_construction=self.ef_construction,
+                                ef_search=self.ef_search)
                 self.clusters[c] = idx
             self._where[ext_id] = c
             # insert under the lock: a concurrent remove() between the
@@ -103,7 +107,9 @@ class IVFHNSWIndex:
             if c is None:
                 return False
             idx = self.clusters.get(c)
-        return idx.remove(ext_id) if idx is not None else False
+            # tombstone under the same lock as add(): an interleaved
+            # add() would otherwise get its fresh insert tombstoned
+            return idx.remove(ext_id) if idx is not None else False
 
     # -- search ----------------------------------------------------------
 
@@ -121,7 +127,7 @@ class IVFHNSWIndex:
         for c in probe:
             idx = self.clusters.get(int(c))
             if idx is not None:
-                hits.extend(idx.search(q, k=k, ef=ef))
+                hits.extend(idx.search(q, k=k, ef=ef or self.ef_search))
         hits.sort(key=lambda t: -t[1])
         return hits[:k]
 
@@ -140,6 +146,7 @@ class IVFHNSWIndex:
                 centroids=self.centroids,
                 nprobe=self.nprobe, m=self.m,
                 ef_construction=self.ef_construction,
+                ef_search=self.ef_search,
             )
             for c, idx in self.clusters.items():
                 idx.save(os.path.join(directory, f"cluster-{c}.npz"))
@@ -148,7 +155,8 @@ class IVFHNSWIndex:
     def load(cls, directory: str) -> "IVFHNSWIndex":
         z = np.load(os.path.join(directory, "routing.npz"))
         idx = cls(nprobe=int(z["nprobe"]), m=int(z["m"]),
-                  ef_construction=int(z["ef_construction"]))
+                  ef_construction=int(z["ef_construction"]),
+                  ef_search=int(z["ef_search"]) if "ef_search" in z else 64)
         idx.centroids = z["centroids"]
         idx.n_clusters = idx.centroids.shape[0]
         for name in os.listdir(directory):

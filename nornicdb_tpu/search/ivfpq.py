@@ -164,24 +164,29 @@ class IVFPQIndex:
                                      dtype=np.float32))
         assign, codes = self._encode(vecs)
         with self._lock:
-            for (ext_id, _), a, c in zip(items, assign, codes):
-                if ext_id in self._id_pos:
-                    pos = self._id_pos[ext_id]
-                    self._assign[pos] = a
-                    self._codes[pos] = c
+            new_rows: List[int] = []
+            for row, (ext_id, _) in enumerate(items):
+                pos = self._id_pos.get(ext_id)
+                if pos is not None:
+                    self._assign[pos] = assign[row]
+                    self._codes[pos] = codes[row]
                     self._alive[pos] = True
-                    continue
-                pos = len(self._ids)
-                self._ids.append(ext_id)
-                self._id_pos[ext_id] = pos
-                if self._codes is None:
-                    self._codes = c[None, :].copy()
-                    self._assign = np.asarray([a])
-                    self._alive = np.asarray([True])
                 else:
-                    self._codes = np.vstack([self._codes, c])
-                    self._assign = np.append(self._assign, a)
-                    self._alive = np.append(self._alive, True)
+                    self._id_pos[ext_id] = len(self._ids)
+                    self._ids.append(ext_id)
+                    new_rows.append(row)
+            if new_rows:
+                # one concatenate per batch, not per item (O(N*B) -> O(B))
+                nc = codes[new_rows]
+                na = assign[new_rows]
+                nv = np.ones(len(new_rows), dtype=bool)
+                if self._codes is None:
+                    self._codes, self._assign, self._alive = (
+                        nc.copy(), na.copy(), nv)
+                else:
+                    self._codes = np.vstack([self._codes, nc])
+                    self._assign = np.concatenate([self._assign, na])
+                    self._alive = np.concatenate([self._alive, nv])
 
     def remove(self, ext_id: str) -> bool:
         with self._lock:
@@ -213,9 +218,11 @@ class IVFPQIndex:
         out_scores: List[np.ndarray] = []
         out_pos: List[np.ndarray] = []
         with self._lock:
-            codes = self._codes
-            assign = self._assign
-            alive = self._alive
+            # snapshot by value: add_batch/remove mutate rows in place,
+            # so reference-only snapshots could read torn code rows
+            codes = self._codes.copy()
+            assign = self._assign.copy()
+            alive = self._alive.copy()
         for c in probe:
             mask = (assign == c) & alive
             pos = np.nonzero(mask)[0]
@@ -244,14 +251,24 @@ class IVFPQIndex:
     # -- persistence (reference: ivfpq_persist.go:169) -------------------
 
     def save(self, path: str) -> None:
+        if not self.trained:
+            raise RuntimeError("cannot save an untrained IVFPQIndex")
         with self._lock:
+            # trained-but-empty saves use (0, M) arrays — np.savez would
+            # pickle None as a 0-d object array that poisons load()
+            codes = (self._codes if self._codes is not None
+                     else np.zeros((0, self.m), np.uint8))
+            assign = (self._assign if self._assign is not None
+                      else np.zeros(0, np.int64))
+            alive = (self._alive if self._alive is not None
+                     else np.zeros(0, bool))
             np.savez_compressed(
                 path,
                 m=self.m, n_codes=self.n_codes, nprobe=self.nprobe,
                 dims=self.dims, coarse=self.coarse,
                 codebooks=self.codebooks,
                 ids=np.asarray(self._ids, dtype=object),
-                codes=self._codes, assign=self._assign, alive=self._alive,
+                codes=codes, assign=assign, alive=alive,
             )
 
     @classmethod

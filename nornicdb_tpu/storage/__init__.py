@@ -16,6 +16,7 @@ from nornicdb_tpu.storage.types import (  # noqa: F401
     NodeID,
     now_ms,
 )
+from nornicdb_tpu.storage.composite import CompositeEngine  # noqa: F401
 from nornicdb_tpu.storage.memory import MemoryEngine  # noqa: F401
 from nornicdb_tpu.storage.wal import WAL, ReplayResult  # noqa: F401
 from nornicdb_tpu.storage.wal_engine import DurableEngine, WALEngine  # noqa: F401

@@ -65,7 +65,8 @@ class VectorSpace:
 
             p = current_profile()
             self.index = IVFHNSWIndex(nprobe=p.nprobe, m=p.hnsw_m,
-                                      ef_construction=p.hnsw_ef_construction)
+                                      ef_construction=p.hnsw_ef_construction,
+                                      ef_search=p.hnsw_ef_search)
         elif kind == "ivfpq":
             from nornicdb_tpu.search.ivfpq import IVFPQIndex
 

@@ -323,3 +323,43 @@ class TestReviewRegressions:
             assert received["qv"] is not None
         finally:
             db.close()
+
+    def test_ivfpq_save_empty_roundtrip(self, tmp_path):
+        idx = IVFPQIndex(n_subspaces=4, n_clusters=2)
+        idx.train(np.random.default_rng(0)
+                  .standard_normal((20, 16)).astype(np.float32))
+        path = str(tmp_path / "empty")
+        idx.save(path)  # trained but no points
+        loaded = IVFPQIndex.load(path)
+        assert len(loaded) == 0
+        assert loaded.search([0.0] * 16, k=3) == []
+
+    def test_ivfpq_untrained_save_rejected(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            IVFPQIndex().save(str(tmp_path / "x"))
+
+    def test_ivf_hnsw_ef_search_persisted(self, tmp_path):
+        items = _clustered_vectors(n_per=5)
+        idx = IVFHNSWIndex(n_clusters=2, ef_search=99, ef_construction=77)
+        idx.build(items)
+        d = str(tmp_path / "ef")
+        idx.save(d)
+        loaded = IVFHNSWIndex.load(d)
+        assert loaded.ef_search == 99
+        assert loaded.ef_construction == 77
+        # cluster graphs restore their ef params too
+        sub = next(iter(loaded.clusters.values()))
+        assert sub.ef_search == 99 and sub.ef_construction == 77
+
+    def test_ivfpq_bulk_add_matches_incremental(self):
+        items = _clustered_vectors(n_per=10)
+        a = IVFPQIndex(n_subspaces=8, n_clusters=4)
+        a.train(np.asarray([v for _, v in items]))
+        a.add_batch(items)
+        b = IVFPQIndex(n_subspaces=8, n_clusters=4)
+        b.train(np.asarray([v for _, v in items]))
+        for it in items:
+            b.add_batch([it])
+        qa = [h for h, _ in a.search(items[7][1], k=5)]
+        qb = [h for h, _ in b.search(items[7][1], k=5)]
+        assert qa == qb

@@ -1,0 +1,231 @@
+"""CompositeEngine, plugin system, CLI, and eval harness tests.
+
+Reference: pkg/storage composite_engine.go, pkg/nornicdb/plugins.go,
+cmd/nornicdb + cmd/eval, pkg/eval/harness.go.
+"""
+
+import json
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.storage import CompositeEngine, MemoryEngine
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+def _node(i, label="N", **props):
+    return Node(id=f"n{i}", labels=[label], properties=props)
+
+
+class TestCompositeEngine:
+    def _setup(self):
+        a, b = MemoryEngine(), MemoryEngine()
+        a.create_node(_node(1, "A", v=1))
+        b.create_node(_node(2, "B", v=2))
+        b.create_node(_node(1, "A", v=99))  # duplicate id: primary wins
+        comp = CompositeEngine(a, [b])
+        return a, b, comp
+
+    def test_reads_fan_out_primary_wins(self):
+        a, b, comp = self._setup()
+        assert comp.get_node("n2").properties["v"] == 2
+        assert comp.get_node("n1").properties["v"] == 1  # primary's copy
+        assert comp.has_node("n2")
+        nodes = {n.id: n for n in comp.all_nodes()}
+        assert set(nodes) == {"n1", "n2"}
+        assert nodes["n1"].properties["v"] == 1
+        assert comp.count_nodes() == 2
+
+    def test_writes_go_to_primary(self):
+        a, b, comp = self._setup()
+        comp.create_node(_node(3, "C"))
+        assert a.has_node("n3") and not b.has_node("n3")
+
+    def test_batch_get_across_engines(self):
+        a, b, comp = self._setup()
+        got = comp.batch_get_nodes(["n2", "nope", "n1"])
+        assert got[0].id == "n2"
+        assert got[1] is None
+        assert got[2].properties["v"] == 1
+
+    def test_edges_and_neighbors(self):
+        a, b, comp = self._setup()
+        b.create_edge(Edge(id="e1", start_node="n2", end_node="n1",
+                           type="REL", properties={}))
+        assert comp.get_edge("e1").type == "REL"
+        assert comp.degree("n2") == 1
+        assert [n.id for n in comp.neighbors("n2")] == ["n1"]
+        assert comp.count_edges() == 1
+
+    def test_missing_node_raises(self):
+        _, _, comp = self._setup()
+        with pytest.raises(KeyError):
+            comp.get_node("ghost")
+
+
+class TestPlugins:
+    def _write_plugin(self, tmp_path, name, body):
+        p = tmp_path / f"{name}.py"
+        p.write_text(body)
+        return str(tmp_path)
+
+    def test_function_plugin_callable_from_cypher(self, tmp_path):
+        from nornicdb_tpu.plugins import install_plugins
+
+        self._write_plugin(tmp_path, "mathx", """
+def double(x):
+    return x * 2
+
+FUNCTIONS = {"mathx.double": double}
+""")
+        db = nornicdb_tpu.open()
+        try:
+            loaded = install_plugins(db, str(tmp_path))
+            assert loaded[0].kind == "function"
+            r = db.cypher("RETURN mathx.double(21) AS x")
+            assert r.rows == [[42]]
+        finally:
+            db.close()
+
+    def test_heimdall_plugin_detected_and_wired(self, tmp_path):
+        from nornicdb_tpu.heimdall import Manager, ModelSpec
+        from nornicdb_tpu.plugins import install_plugins
+
+        self._write_plugin(tmp_path, "shout", """
+def on_generate(prompt, text):
+    return text.upper()
+""")
+        db = nornicdb_tpu.open()
+        try:
+            mgr = Manager()
+            mgr.register(ModelSpec(name="e", backend="echo"))
+            loaded = install_plugins(db, str(tmp_path),
+                                     heimdall_manager=mgr)
+            assert loaded[0].kind == "heimdall"
+            assert mgr.generate("hi", model="e").text.startswith("ECHO:")
+        finally:
+            db.close()
+
+    def test_broken_plugin_reported_not_fatal(self, tmp_path):
+        from nornicdb_tpu.plugins import load_plugins_from_dir
+
+        self._write_plugin(tmp_path, "broken", "raise RuntimeError('boom')")
+        self._write_plugin(tmp_path, "good", "FUNCTIONS = {}")
+        loaded = load_plugins_from_dir(str(tmp_path))
+        by_name = {p.name: p for p in loaded}
+        assert by_name["broken"].error is not None
+        assert by_name["good"].error is None
+
+    def test_register_hook_receives_db(self, tmp_path):
+        from nornicdb_tpu.plugins import install_plugins
+
+        self._write_plugin(tmp_path, "counting", """
+def register(db):
+    def node_count():
+        return db.storage.count_nodes()
+    return {"plugin.nodecount": node_count}
+""")
+        db = nornicdb_tpu.open()
+        try:
+            db.cypher("CREATE (:X), (:X)")
+            install_plugins(db, str(tmp_path))
+            r = db.cypher("RETURN plugin.nodecount() AS c")
+            assert r.rows == [[2]]
+        finally:
+            db.close()
+
+
+class TestEvalHarness:
+    def test_score_case_metrics(self):
+        from nornicdb_tpu.eval import score_case
+
+        c = score_case("t", ["a", "x", "b"], ["a", "b", "c"])
+        assert c.precision == pytest.approx(2 / 3)
+        assert c.recall == pytest.approx(2 / 3)
+        assert c.reciprocal_rank == 1.0
+        c2 = score_case("t2", ["x", "a"], ["a"])
+        assert c2.reciprocal_rank == 0.5
+
+    def test_harness_against_db(self, tmp_path):
+        from nornicdb_tpu.eval import Thresholds, harness_for_db
+
+        db = nornicdb_tpu.open()
+        try:
+            for i, text in enumerate([
+                "tpu compiler pipelines", "pasta with garlic",
+                "tpu kernel tuning",
+            ]):
+                db.store(text, node_id=f"d{i}")
+            db.search.build_indexes()
+            harness = harness_for_db(db, Thresholds(precision=0.1,
+                                                    recall=0.3, mrr=0.3))
+            suite = harness.run_cases([
+                {"name": "tpu", "query": "tpu kernel",
+                 "expected": ["d2"], "limit": 3},
+                {"name": "food", "query": "pasta garlic",
+                 "expected": ["d1"], "limit": 3},
+            ])
+            assert suite.mrr > 0.5
+            assert suite.passed
+        finally:
+            db.close()
+
+    def test_suite_file_roundtrip(self, tmp_path):
+        from nornicdb_tpu.eval import EvalHarness
+
+        suite_file = tmp_path / "suite.jsonl"
+        suite_file.write_text(
+            '{"name": "one", "query": "q", "expected": ["a"]}\n'
+            "# comment line\n"
+        )
+        harness = EvalHarness(lambda q, k: ["a"])
+        result = harness.run_file(str(suite_file))
+        assert result.passed and len(result.cases) == 1
+
+
+class TestCLI:
+    def test_version(self, capsys):
+        from nornicdb_tpu.cli import main
+
+        assert main(["version"]) == 0
+        assert "nornicdb-tpu" in capsys.readouterr().out
+
+    def test_import_export_roundtrip(self, tmp_path, capsys):
+        from nornicdb_tpu.cli import main
+
+        data = tmp_path / "in.jsonl"
+        data.write_text(
+            json.dumps({"type": "node", "id": "a", "labels": ["T"],
+                        "properties": {"x": 1}}) + "\n"
+            + json.dumps({"type": "node", "id": "b", "labels": ["T"],
+                          "properties": {}}) + "\n"
+            + json.dumps({"type": "edge", "id": "e", "start": "a",
+                          "end": "b", "edge_type": "R",
+                          "properties": {}}) + "\n"
+        )
+        store = str(tmp_path / "store")
+        assert main(["import", str(data), "--data-dir", store]) == 0
+        out_file = tmp_path / "out.jsonl"
+        assert main(["export", str(out_file), "--data-dir", store]) == 0
+        rows = [json.loads(line) for line in
+                out_file.read_text().splitlines()]
+        kinds = sorted(r["type"] for r in rows)
+        assert kinds == ["edge", "node", "node"]
+
+    def test_eval_command(self, tmp_path, capsys):
+        from nornicdb_tpu.cli import main
+
+        corpus = tmp_path / "corpus.jsonl"
+        corpus.write_text(
+            json.dumps({"id": "d1", "labels": ["Doc"],
+                        "properties": {"content": "tpu kernels"}}) + "\n")
+        suite = tmp_path / "suite.jsonl"
+        suite.write_text(
+            json.dumps({"name": "t", "query": "tpu kernels",
+                        "expected": ["d1"]}) + "\n")
+        rc = main(["eval", str(suite), "--corpus", str(corpus),
+                   "--precision", "0.1", "--recall", "0.5",
+                   "--mrr", "0.5"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert json.loads(out)["passed"] is True
