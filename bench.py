@@ -9,10 +9,17 @@ single-stream throughput of batch=1 queries with async pipelined
 dispatch — back-to-back requests as a loaded server sees them. Each
 query is a distinct device-resident [1, D] tensor; no batching.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Backend init is hardened: the TPU (axon) backend is probed in a
+subprocess with a bounded timeout and retries; on hard failure the bench
+falls back to the CPU PJRT backend (the result line then carries
+"backend": "cpu-fallback") instead of hanging or dying with a traceback.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -21,12 +28,56 @@ import numpy as np
 
 BASELINE_REST_SEARCH_OPS = 10_296.0
 
+PROBE_SRC = (
+    "import jax; d = jax.devices(); "
+    "print(d[0].platform if d else 'none')"
+)
+
+
+def _probe_backend(timeout_s: float = 120.0, attempts: int = 3) -> str:
+    """Initialize the default (axon TPU) backend in a throwaway subprocess
+    so a hang or init crash can't take the bench down. Returns the platform
+    name that came up, or 'cpu' after all attempts fail."""
+    env = dict(os.environ)
+    for attempt in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                env=env,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+            sys.stderr.write(
+                f"bench: backend probe attempt {attempt + 1} rc={out.returncode}: "
+                f"{out.stderr.strip()[-400:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"bench: backend probe attempt {attempt + 1} timed out after {timeout_s}s\n"
+            )
+        time.sleep(2.0 * (attempt + 1))
+    return "cpu"
+
 
 def main():
+    platform = _probe_backend()
+    fallback = platform == "cpu"
+    if fallback:
+        # TPU never came up: force the CPU PJRT backend. sitecustomize pins
+        # jax_platforms="axon,cpu" at import time, so fix it post-import too.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
+
+    if fallback:
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from nornicdb_tpu.ops import cosine_topk, l2_normalize, pad_dim
 
     n, d, k = 10_000, 1024, 10
@@ -62,17 +113,29 @@ def main():
     dt = time.perf_counter() - t0
     qps = iters / dt
 
-    print(
-        json.dumps(
-            {
-                "metric": "knn_throughput_b1_10k_x_1024",
-                "value": round(qps, 1),
-                "unit": "queries/s",
-                "vs_baseline": round(qps / BASELINE_REST_SEARCH_OPS, 3),
-            }
-        )
-    )
+    result = {
+        "metric": "knn_throughput_b1_10k_x_1024",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / BASELINE_REST_SEARCH_OPS, 3),
+        "backend": "cpu-fallback" if fallback else jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # last-resort: a parseable line beats a traceback
+        print(
+            json.dumps(
+                {
+                    "metric": "knn_throughput_b1_10k_x_1024",
+                    "value": 0.0,
+                    "unit": "queries/s",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(exc).__name__}: {exc}"[:400],
+                }
+            )
+        )
+        sys.exit(0)
