@@ -34,10 +34,12 @@ PROBE_SRC = (
 )
 
 
-def _probe_backend(timeout_s: float = 120.0, attempts: int = 3) -> str:
+def _probe_backend(timeout_s: float = 120.0, attempts: int = 3):
     """Initialize the default (axon TPU) backend in a throwaway subprocess
     so a hang or init crash can't take the bench down. Returns the platform
-    name that came up, or 'cpu' after all attempts fail."""
+    name that came up (possibly a healthy 'cpu' on a box without the TPU
+    plugin), or None after all attempts fail — callers must distinguish
+    probe-failed from probe-returned-cpu."""
     env = dict(os.environ)
     for attempt in range(attempts):
         try:
@@ -59,12 +61,12 @@ def _probe_backend(timeout_s: float = 120.0, attempts: int = 3) -> str:
                 f"bench: backend probe attempt {attempt + 1} timed out after {timeout_s}s\n"
             )
         time.sleep(2.0 * (attempt + 1))
-    return "cpu"
+    return None
 
 
 def main():
     platform = _probe_backend()
-    fallback = platform == "cpu"
+    fallback = platform is None
     if fallback:
         # TPU never came up: force the CPU PJRT backend. sitecustomize pins
         # jax_platforms="axon,cpu" at import time, so fix it post-import too.

@@ -149,7 +149,10 @@ class QdrantCompat:
         idx = self._index(name)
         if not want:
             want = idx.dims or 0
-        # pass 1: validate everything
+        # pass 1: validate everything — including float coercion, so a
+        # non-numeric vector element fails here, before any write, and never
+        # leaves a partially-applied batch
+        coerced: List[List[float]] = []
         for p in points:
             if "id" not in p:
                 raise QdrantError("point missing id")
@@ -160,17 +163,24 @@ class QdrantCompat:
                         f"vector size {len(vec)} != collection size {want}"
                     )
                 want = want or len(vec)
+                try:
+                    coerced.append([float(x) for x in vec])
+                except (TypeError, ValueError) as exc:
+                    raise QdrantError(
+                        f"point {p['id']}: non-numeric vector element ({exc})"
+                    )
+            else:
+                coerced.append([])
         # pass 2: apply
         n = 0
-        for p in points:
-            vec = p.get("vector") or []
+        for p, vec in zip(points, coerced):
             nid = _point_node_id(name, p["id"])
             node = Node(
                 id=nid,
                 labels=[self._label(name)],
                 properties={
                     "_point_id": p["id"],
-                    "_vector": list(map(float, vec)),
+                    "_vector": vec,
                     "payload": p.get("payload") or {},
                 },
             )

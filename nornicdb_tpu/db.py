@@ -35,7 +35,7 @@ class DB:
         async_writes: bool = False,
         sync_every_write: bool = False,
         embedder: Optional[Any] = None,
-        auto_embed: bool = False,
+        auto_embed: bool = True,
         engine: str = "auto",  # auto | native | python | memory
         replication: Optional[Any] = None,  # ReplicationConfig
     ):
@@ -82,12 +82,21 @@ class DB:
         # lazily-built services (per logical DB)
         self._executor = None
         self._search = None
+        if embedder is None:
+            # default local embedder: deterministic hash bag-of-features
+            # behind an LRU — store→recall works out of the box with zero
+            # model downloads (reference default: local embedding always
+            # on, embed.go; swap in JaxEncoderEmbedder for semantic
+            # quality via the embedder= kwarg or config)
+            from nornicdb_tpu.embed.embedder import CachedEmbedder, HashEmbedder
+
+            embedder = CachedEmbedder(HashEmbedder())
         self._embedder = embedder
         self._embed_queue = None
         self._decay = None
         self._temporal = None
         self._inference = None
-        if auto_embed and embedder is not None:
+        if auto_embed:
             self._start_embed_queue()
 
     def _enable_replication(self, chain: Engine, cfg: Any) -> Engine:
@@ -161,7 +170,18 @@ class DB:
         if self._search is None:
             from nornicdb_tpu.search.service import SearchService
 
-            self._search = SearchService(self.storage, embedder=self._embedder)
+            svc = SearchService(self.storage, embedder=self._embedder)
+            # publish BEFORE backfill so a concurrently-finishing embed
+            # lands via _on_embedded instead of being dropped (index_node
+            # is idempotent, double-index is harmless)
+            self._search = svc
+            try:
+                svc.build_indexes()  # nodes stored before first search
+            except BaseException:
+                # un-publish: a half-built index must not be served for
+                # the life of the process; next access retries backfill
+                self._search = None
+                raise
             if self._executor is not None:
                 self._executor.set_search_service(self._search)
         return self._search

@@ -49,6 +49,7 @@ class Manager:
                  rbac_check: Optional[Callable[[Optional[str]], None]] = None):
         self._specs: Dict[str, ModelSpec] = {}
         self._loaded: Dict[str, Generator] = {}
+        self._loading: Dict[str, threading.Event] = {}
         self._lock = threading.Lock()
         self.memory_budget = memory_budget_bytes
         self.memory_used = 0
@@ -92,15 +93,34 @@ class Manager:
         raise ValueError(f"unknown backend {spec.backend!r}")
 
     def load(self, name: str) -> Generator:
-        with self._lock:
-            if name in self._loaded:
-                return self._loaded[name]
-            spec = self._specs.get(name)
-            if spec is None:
-                raise KeyError(f"model {name!r} not registered")
-        gen = self._build(spec)
+        # per-name loading latch: two concurrent loads of the same model
+        # must not both run _build (the second would allocate the model's
+        # device memory again and double-count memory_used — a permanent
+        # accounting leak causing spurious evictions)
+        while True:
+            with self._lock:
+                if name in self._loaded:
+                    return self._loaded[name]
+                latch = self._loading.get(name)
+                if latch is None:
+                    spec = self._specs.get(name)
+                    if spec is None:
+                        raise KeyError(f"model {name!r} not registered")
+                    latch = threading.Event()
+                    self._loading[name] = latch
+                    break
+            latch.wait()  # another thread is building; retry once it's done
+        try:
+            gen = self._build(spec)
+        except BaseException:
+            with self._lock:
+                del self._loading[name]
+            latch.set()
+            raise
         need = spec.memory_bytes
         with self._lock:
+            del self._loading[name]
+            latch.set()
             # evict least-recently-loaded models until it fits
             # (reference: scheduler evicts on VRAM pressure)
             while (self.memory_used + need > self.memory_budget

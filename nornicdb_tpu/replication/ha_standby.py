@@ -74,16 +74,26 @@ class HAPrimary(Replicator):
             if self._role is not Role.PRIMARY:
                 raise NotPrimaryError()
             epoch = self.epoch
-        # local first: WALEngine sequences + persists it
-        getattr(self.engine, op)(*_op_args(op, data))
-        seq = self.engine.wal.last_seq
-        rec = {"seq": seq, "op": op, "data": data}
+        # local first: WALEngine sequences + persists it. The record's seq is
+        # captured atomically under the WALEngine mutation lock (apply_op
+        # returns it), and for async mode the pending enqueue happens inside
+        # that same lock via on_logged — so stream order always matches seq
+        # order even with concurrent appliers (a post-hoc read of
+        # wal.last_seq could tag two interleaved writes with the same seq
+        # and the standby would silently drop one).
+        rec: Dict[str, Any] = {"op": op, "data": data}
+
         if self.config.sync == "quorum":
+            rec["seq"] = self.engine.apply_op(op, data)
             self._replicate_quorum([rec], epoch)
         else:
-            with self._pending_cv:
-                self._pending.append(rec)
-                self._pending_cv.notify()
+            def enqueue(seq: int) -> None:
+                rec["seq"] = seq
+                with self._pending_cv:
+                    self._pending.append(rec)
+                    self._pending_cv.notify()
+
+            self.engine.apply_op(op, data, on_logged=enqueue)
 
     @property
     def role(self) -> Role:
@@ -105,9 +115,17 @@ class HAPrimary(Replicator):
         the write acks only once a majority of the cluster (primary
         included) has it."""
         msg = self._batch_msg(records, epoch)
+        max_seq = max((r.get("seq", 0) for r in records), default=0)
         replies = self.transport.broadcast(self.config.peers, msg)
+        # an ack only counts if the standby has APPLIED through this
+        # batch's last seq (a buffered-but-unapplied batch must not reach
+        # quorum — those records are lost if the primary dies now)
         acks = 1 + sum(
-            1 for r in replies.values() if r is not None and r.get("ok")
+            1
+            for r in replies.values()
+            if r is not None
+            and r.get("ok")
+            and r.get("applied_seq", 0) >= max_seq
         )
         need = (len(self.config.peers) + 1) // 2 + 1
         if acks < need:
@@ -158,23 +176,25 @@ class HAPrimary(Replicator):
         return {"ok": False, "error": "stale fence epoch"}
 
     def handle_wal_sync(self, msg: ClusterMessage) -> ClusterMessage:
-        """Catch-up: a (re)joining standby asks for records after seq N."""
+        """Catch-up: a (re)joining standby asks for records after seq N.
+        Records ship seq-tagged and in log order so the standby can apply
+        them strictly in order and advance its watermark precisely."""
         from_seq = int(msg.get("from_seq", 0))
-        records: List[Dict[str, Any]] = []
-
-        def collect(op: str, data: Dict[str, Any], seq: int = 0) -> None:
-            records.append({"op": op, "data": data})
-
-        # drain buffered appends to the segment file, then replay from it
+        # drain buffered appends to the segment files, then read from them
         self.engine.wal.flush()
-        self.engine.wal.replay(collect, from_seq=from_seq)
+        records = [
+            {"seq": rec.get("seq", 0), "op": rec["op"],
+             "data": rec.get("data", {})}
+            for rec in self.engine.wal.iter_records(from_seq=from_seq)
+        ]
+        last_seq = records[-1]["seq"] if records else from_seq
         with self._lock:
             epoch = self.epoch
         return {
             "ok": True,
             "epoch": epoch,
             "records": records,
-            "last_seq": self.engine.wal.last_seq,
+            "last_seq": last_seq,
         }
 
     def close(self) -> None:
@@ -215,6 +235,11 @@ class HAStandby(Replicator):
         self.on_promote = on_promote
         self.epoch = 1
         self.applied_seq = 0
+        # records received ahead of the watermark, held until the gap fills
+        # (strict in-order apply: an older write applied after a newer one
+        # to the same key would silently diverge the replica)
+        self._reorder_buf: Dict[int, Dict[str, Any]] = {}
+        self._sync_lock = threading.Lock()  # one catch-up at a time
         self._role = Role.STANDBY
         self._lock = threading.Lock()
         self._last_heartbeat = time.monotonic()
@@ -256,16 +281,50 @@ class HAStandby(Replicator):
                 return {"ok": False, "error": "fenced: stale epoch"}
             self.epoch = max(self.epoch, msg.get("epoch", 0))
             self._last_heartbeat = time.monotonic()
-        for rec in msg.get("records", []):
+        # Strict in-order apply. quorum mode broadcasts each record
+        # independently, so batches from concurrent writers can arrive
+        # reordered; applying on arrival would let an older write land
+        # after a newer one to the same key (silent divergence), and a
+        # create/update inversion loses the update entirely (apply_record
+        # swallows the not-found). Out-of-order records are buffered and a
+        # catch-up from the primary fills the gap.
+        need_repair = False
+        max_seq = 0
+        for rec in sorted(msg.get("records", []), key=lambda r: r.get("seq", 0)):
             seq = rec.get("seq", 0)
+            max_seq = max(max_seq, seq)
             with self._lock:
-                if 0 < seq <= self.applied_seq:
-                    continue  # duplicate/out-of-order batch overlap
-            self.engine.apply_record(rec["op"], rec["data"])
-            with self._lock:
-                if seq > self.applied_seq:
+                if seq <= 0:
+                    self.engine.apply_record(rec["op"], rec["data"])
+                    continue
+                if seq <= self.applied_seq or seq in self._reorder_buf:
+                    continue  # duplicate batch overlap
+                if seq == self.applied_seq + 1:
+                    self.engine.apply_record(rec["op"], rec["data"])
                     self.applied_seq = seq
-        return {"ok": True, "applied_seq": self.applied_seq}
+                    self._drain_reorder_buf_locked()
+                else:
+                    self._reorder_buf[seq] = rec
+                    need_repair = True
+        if need_repair:
+            # a gap precedes the buffered records: pull the missing range
+            # from the primary (fresh standby joining an established
+            # primary hits this on its first batch and pulls full history)
+            self.catch_up()
+        with self._lock:
+            # ok means APPLIED, not received: a quorum primary counts this
+            # ack toward durability, so a batch that is only buffered
+            # (gap repair failed) must not be acknowledged
+            return {
+                "ok": self.applied_seq >= max_seq,
+                "applied_seq": self.applied_seq,
+            }
+
+    def _drain_reorder_buf_locked(self) -> None:
+        while self.applied_seq + 1 in self._reorder_buf:
+            nxt = self._reorder_buf.pop(self.applied_seq + 1)
+            self.engine.apply_record(nxt["op"], nxt["data"])
+            self.applied_seq += 1
 
     def handle_heartbeat(self, msg: ClusterMessage) -> ClusterMessage:
         with self._lock:
@@ -356,23 +415,42 @@ class HAStandby(Replicator):
             self.on_promote(self)
 
     def catch_up(self, addr: Optional[Tuple[str, int]] = None) -> int:
-        """Pull missed records from the primary (rejoin path). Returns
-        number of records applied."""
+        """Pull missed records from the primary (rejoin path, and gap
+        repair when a streamed batch arrives ahead of the watermark).
+        Returns number of records applied."""
         target = addr or self.primary_addr
         if target is None:
             return 0
-        resp = self.transport.request(
-            target, {"type": "wal_sync", "from_seq": self.applied_seq}
-        )
-        if not resp.get("ok"):
-            return 0
-        n = 0
-        for rec in resp.get("records", []):
-            self.engine.apply_record(rec["op"], rec["data"])
-            n += 1
-        with self._lock:
-            self.applied_seq = max(self.applied_seq, resp.get("last_seq", 0))
-        return n
+        with self._sync_lock:
+            with self._lock:
+                from_seq = self.applied_seq
+            try:
+                resp = self.transport.request(
+                    target, {"type": "wal_sync", "from_seq": from_seq}
+                )
+            except ConnectionError:
+                return 0
+            if not resp.get("ok"):
+                return 0
+            n = 0
+            with self._lock:
+                for rec in resp.get("records", []):
+                    seq = rec.get("seq", 0)
+                    if 0 < seq <= self.applied_seq:
+                        continue
+                    self.engine.apply_record(rec["op"], rec["data"])
+                    n += 1
+                    if seq > 0:
+                        self.applied_seq = max(self.applied_seq, seq)
+                self.applied_seq = max(
+                    self.applied_seq, resp.get("last_seq", 0)
+                )
+                self._reorder_buf = {
+                    s: r for s, r in self._reorder_buf.items()
+                    if s > self.applied_seq
+                }
+                self._drain_reorder_buf_locked()
+            return n
 
     def close(self) -> None:
         self._closed.set()

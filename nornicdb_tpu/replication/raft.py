@@ -162,10 +162,15 @@ class RaftNode(Replicator):
             self._heartbeat()
 
     def _step_down(self, term: int) -> None:
-        """Caller holds the lock."""
-        self.term = term
+        """Caller holds the lock. ``voted_for`` is cleared ONLY when the
+        term actually increases: a candidate demoted at an equal term must
+        keep its vote record or it could grant a second vote in the same
+        term (one-vote-per-term safety; reference raft.go:1084 clears
+        votedFor only on a strictly higher request term)."""
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
         self._state = Role.STANDBY
-        self.voted_for = None
 
     # -- replication -----------------------------------------------------
 

@@ -61,18 +61,6 @@ class Replicator:
         pass
 
 
-def decode_op_args(op: str, data: Dict[str, Any]) -> tuple:
-    """Decode a replicated op payload into engine-call args (same
-    op/data vocabulary as WAL records, storage/wal_engine.py
-    apply_record)."""
-    from nornicdb_tpu.storage.types import Edge, Node
-
-    if op in ("create_node", "update_node"):
-        return (Node.from_dict(data),)
-    if op in ("create_edge", "update_edge"):
-        return (Edge.from_dict(data),)
-    if op in ("delete_node", "delete_edge"):
-        return (data["id"],)
-    if op == "delete_by_prefix":
-        return (data["prefix"],)
-    raise ValueError(f"unknown replicated op {op}")
+# canonical decode lives next to the op vocabulary in storage/wal_engine.py;
+# re-exported here because replication callers address it from this module
+from nornicdb_tpu.storage.wal_engine import decode_op_args  # noqa: E402,F401

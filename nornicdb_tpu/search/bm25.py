@@ -166,19 +166,26 @@ class BM25Index:
             scores = np.zeros(n_docs, dtype=np.float32)
             doc_len = np.asarray(self._doc_len, dtype=np.float32)
             touched = np.zeros(n_docs, dtype=bool)
+            alive = np.asarray(self._alive, dtype=bool)
             for t in toks:
                 p = self._postings.get(t)
                 if p is None:
                     continue
                 ids = np.asarray(p.doc_ids, dtype=np.int64)
                 tfs = np.asarray(p.tfs, dtype=np.float32)
-                df = len(ids)
+                # df over LIVE postings only: a tombstoned slot (re-index
+                # leaves one) must not inflate df — with few docs that
+                # flips idf negative and hits get min_score-filtered
+                live = alive[ids]
+                ids, tfs = ids[live], tfs[live]
+                df = int(ids.size)
+                if df == 0:
+                    continue
                 idf = self._idf(df)
                 dl = doc_len[ids]
                 tf_norm = tfs * (K1 + 1.0) / (tfs + K1 * (1.0 - B + B * dl / avgdl))
                 scores[ids] += idf * tf_norm
                 touched[ids] = True
-            alive = np.asarray(self._alive, dtype=bool)
             mask = touched & alive
             cand = np.nonzero(mask)[0]
             if cand.size == 0:
@@ -200,7 +207,7 @@ class BM25Index:
                 return []
             ranked_terms = []
             for t, p in self._postings.items():
-                df = len(p.doc_ids)
+                df = sum(1 for i in p.doc_ids if self._alive[i])
                 if df < 2:  # hapax terms don't discriminate clusters
                     continue
                 ranked_terms.append((self._idf(df), t))

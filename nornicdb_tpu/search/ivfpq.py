@@ -164,16 +164,24 @@ class IVFPQIndex:
                                      dtype=np.float32))
         assign, codes = self._encode(vecs)
         with self._lock:
+            existing = 0 if self._codes is None else len(self._codes)
             new_rows: List[int] = []
+            staged: Dict[str, int] = {}  # ext_id -> index into new_rows
             for row, (ext_id, _) in enumerate(items):
                 pos = self._id_pos.get(ext_id)
-                if pos is not None:
+                if pos is not None and pos < existing:
                     self._assign[pos] = assign[row]
                     self._codes[pos] = codes[row]
                     self._alive[pos] = True
+                elif ext_id in staged:
+                    # duplicate id within this batch whose first occurrence
+                    # is only staged — overwrite the staged row instead of
+                    # indexing arrays it hasn't been appended to yet
+                    new_rows[staged[ext_id]] = row
                 else:
                     self._id_pos[ext_id] = len(self._ids)
                     self._ids.append(ext_id)
+                    staged[ext_id] = len(new_rows)
                     new_rows.append(row)
             if new_rows:
                 # one concatenate per batch, not per item (O(N*B) -> O(B))

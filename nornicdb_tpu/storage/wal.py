@@ -242,6 +242,21 @@ class WAL:
                 continue
         return None, 0
 
+    def iter_records(self, from_seq: int = 0) -> List[Dict[str, Any]]:
+        """Return raw WAL records (seq included) with seq > from_seq, in
+        log order. Read-only: no tail repair, no degraded-mode side
+        effects — replication catch-up uses this to ship seq-tagged
+        history. Materialized under the lock: a lazy generator would race
+        auto-compaction's segment pruning, silently shipping a gapped
+        history to the standby."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for path in self._segment_paths():
+                for rec, _ in _iter_records(path):
+                    if rec.get("seq", 0) > from_seq:
+                        out.append(rec)
+        return out
+
     def replay(
         self, apply: Callable[[str, Dict[str, Any]], None], from_seq: int = 0
     ) -> ReplayResult:

@@ -21,6 +21,21 @@ from nornicdb_tpu.storage.types import Edge, EdgeID, Engine, EngineDecorator, No
 from nornicdb_tpu.storage.wal import WAL, ReplayResult
 
 
+def decode_op_args(op: str, data: Dict[str, Any]) -> tuple:
+    """Decode a WAL/replication op payload into engine-call args — the one
+    canonical copy of the op/data vocabulary (``apply_record`` and the
+    replication layer both dispatch through it)."""
+    if op in ("create_node", "update_node"):
+        return (Node.from_dict(data),)
+    if op in ("create_edge", "update_edge"):
+        return (Edge.from_dict(data),)
+    if op in ("delete_node", "delete_edge"):
+        return (data["id"],)
+    if op == "delete_by_prefix":
+        return (data["prefix"],)
+    raise ValueError(f"unknown replicated op {op}")
+
+
 class WALEngine(EngineDecorator):
     """Applies each mutation to ``inner`` (which validates it), then appends
     it to the WAL, atomically under a mutation lock so the log order matches
@@ -48,23 +63,15 @@ class WALEngine(EngineDecorator):
         """Apply one WAL record to the inner engine (used during replay and
         by replication followers)."""
         try:
-            if op == "create_node":
-                self.inner.create_node(Node.from_dict(data))
-            elif op == "update_node":
-                self.inner.update_node(Node.from_dict(data))
-            elif op == "delete_node":
-                self.inner.delete_node(data["id"])
-            elif op == "create_edge":
-                self.inner.create_edge(Edge.from_dict(data))
-            elif op == "update_edge":
-                self.inner.update_edge(Edge.from_dict(data))
-            elif op == "delete_edge":
-                self.inner.delete_edge(data["id"])
-            elif op == "delete_by_prefix":
-                self.inner.delete_by_prefix(data["prefix"])
-        except (KeyError, NornicError):
+            # decode FIRST: it whitelists the op vocabulary (ValueError on
+            # an unknown op), making the getattr dispatch safe
+            args = decode_op_args(op, data)
+            getattr(self.inner, op)(*args)
+        except (KeyError, ValueError, NornicError):
             # replaying over a snapshot that already contains the mutation,
-            # or a delete of an already-deleted entity — idempotent replay
+            # a delete of an already-deleted entity, or a record written by
+            # a newer version with an op this build doesn't know —
+            # idempotent, forward-compatible replay
             pass
 
     def recover(self) -> ReplayResult:
@@ -119,6 +126,28 @@ class WALEngine(EngineDecorator):
         self.snapshot()
 
     # -- mutations (apply-validates, then log; atomic so WAL order == applied order)
+
+    def apply_op(
+        self,
+        op: str,
+        data: Dict[str, Any],
+        on_logged: Optional[Any] = None,
+    ) -> int:
+        """Apply one mutation by op name and return the WAL seq it was
+        logged at, atomically under the mutation lock. ``on_logged(seq)``,
+        if given, also runs under the lock — replication uses it to enqueue
+        the record for streaming so enqueue order always matches seq order
+        (two concurrent appliers can otherwise interleave between the
+        engine call and the seq read, tagging both writes with the later
+        seq and inverting stream order)."""
+        args = decode_op_args(op, data)
+        with self._mut:
+            getattr(self.inner, op)(*args)
+            seq = self.wal.append(op, data)
+            if on_logged is not None:
+                on_logged(seq)
+        self._maybe_compact()
+        return seq
 
     def create_node(self, node: Node) -> None:
         with self._mut:

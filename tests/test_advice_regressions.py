@@ -1,0 +1,307 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+Each test encodes the exact failure scenario the advisor described and
+must keep passing: HA seq-race, Raft one-vote-per-term, Qdrant atomic
+batch validation, IVFPQ in-batch duplicate ids, Heimdall double-load.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.api.qdrant import QdrantCompat, QdrantError
+from nornicdb_tpu.heimdall.scheduler import Manager, ModelSpec
+from nornicdb_tpu.replication import (
+    ClusterTransport,
+    HAPrimary,
+    HAStandby,
+    RaftNode,
+    ReplicationConfig,
+    Role,
+)
+from nornicdb_tpu.search.ivfpq import IVFPQIndex
+from nornicdb_tpu.storage import WAL, MemoryEngine, WALEngine
+from nornicdb_tpu.storage.memory import MemoryEngine as _Mem
+from nornicdb_tpu.storage.namespaced import NamespacedEngine
+from nornicdb_tpu.storage.types import Node
+
+
+def make_wal_engine(tmp_path, name):
+    return WALEngine(MemoryEngine(), WAL(str(tmp_path / name)))
+
+
+class TestHASeqRace:
+    """ADVICE high: HAPrimary.apply read wal.last_seq outside the mutation
+    lock, so concurrent appliers could tag two records with the same seq
+    and/or invert pending order — the standby then silently dropped one."""
+
+    def test_concurrent_applies_unique_ordered_seqs(self, tmp_path):
+        tp = ClusterTransport("p")
+        tp.start()
+        ep = make_wal_engine(tmp_path, "p")
+        cfg = ReplicationConfig(
+            mode="ha_standby", sync="async", node_id="p", peers=[],
+            heartbeat_interval=5.0, failover_timeout=60.0,
+        )
+        primary = HAPrimary(ep, tp, cfg)  # no start(): pending never drains
+        try:
+            n_threads, per = 8, 25
+            barrier = threading.Barrier(n_threads)
+
+            def writer(t):
+                barrier.wait()
+                for i in range(per):
+                    primary.apply(
+                        "create_node",
+                        Node(id=f"n{t}-{i}", labels=[], properties={}).to_dict(),
+                    )
+
+            threads = [
+                threading.Thread(target=writer, args=(t,))
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+            seqs = [r["seq"] for r in primary._pending]
+            assert len(seqs) == n_threads * per
+            assert len(set(seqs)) == len(seqs), "duplicate WAL seqs"
+            assert seqs == sorted(seqs), "pending order inverted vs seq order"
+        finally:
+            primary.close()
+            tp.close()
+
+    def _pair(self, tmp_path):
+        tp = ClusterTransport("p")
+        ts = ClusterTransport("s")
+        tp.start()
+        ts.start()
+        ep = make_wal_engine(tmp_path, "p")
+        es = make_wal_engine(tmp_path, "s")
+        cfg_p = ReplicationConfig(
+            mode="ha_standby", sync="quorum", node_id="p", peers=[ts.addr],
+            heartbeat_interval=5.0, failover_timeout=60.0,
+        )
+        cfg_s = ReplicationConfig(
+            mode="ha_standby", node_id="s",
+            heartbeat_interval=5.0, failover_timeout=60.0,
+        )
+        primary = HAPrimary(ep, tp, cfg_p)
+        standby = HAStandby(es, ts, cfg_s, primary_addr=tp.addr)
+        return primary, standby, tp, ts
+
+    def test_reordered_quorum_batches_apply_in_seq_order(self, tmp_path):
+        """Out-of-order delivery must never apply an older write after a
+        newer one (same-key divergence) nor drop the older record."""
+        primary, standby, tp, ts = self._pair(tmp_path)
+        try:
+            # write 4 records to the primary's WAL only (no broadcast),
+            # capturing the real seqs
+            recs = []
+            for i in range(4):
+                data = Node(id=f"n{i}", labels=[], properties={}).to_dict()
+                seq = primary.engine.apply_op("create_node", data)
+                recs.append({"seq": seq, "op": "create_node", "data": data})
+            # deliver to the standby out of order: the gap triggers a
+            # catch-up pull from the primary's WAL
+            standby.handle_wal_batch({"epoch": 1, "records": [recs[1]]})
+            standby.handle_wal_batch({"epoch": 1, "records": [recs[0]]})
+            standby.handle_wal_batch({"epoch": 1, "records": [recs[3]]})
+            standby.handle_wal_batch({"epoch": 1, "records": [recs[2]]})
+            for i in range(4):
+                assert standby.engine.has_node(f"n{i}"), f"dropped n{i}"
+            assert standby.applied_seq == recs[-1]["seq"]
+            # duplicates are still deduped
+            n_before = standby.engine.count_nodes()
+            standby.handle_wal_batch({"epoch": 1, "records": [recs[2]]})
+            assert standby.engine.count_nodes() == n_before
+        finally:
+            primary.close(); standby.close(); tp.close(); ts.close()
+
+    def test_quorum_never_acks_buffered_only_records(self, tmp_path):
+        """A standby that only BUFFERED a batch (gap + failed repair) must
+        not ack it — a false ack would let the primary count quorum on a
+        write the standby loses if the primary dies."""
+        ts = ClusterTransport("s")
+        ts.start()
+        es = make_wal_engine(tmp_path, "s")
+        cfg_s = ReplicationConfig(mode="ha_standby", node_id="s")
+        standby = HAStandby(es, ts, cfg_s, primary_addr=None)  # repair fails
+        try:
+            reply = standby.handle_wal_batch(
+                {"epoch": 1,
+                 "records": [{"seq": 10, "op": "create_node",
+                              "data": Node(id="g", labels=[],
+                                           properties={}).to_dict()}]}
+            )
+            assert reply["ok"] is False
+            assert reply["applied_seq"] == 0
+            assert not standby.engine.has_node("g")
+        finally:
+            standby.close(); ts.close()
+
+    def test_quorum_write_fails_when_standby_cannot_apply(self, tmp_path):
+        """End-to-end: quorum apply must raise when the only standby can't
+        actually apply the record (instead of silently succeeding)."""
+        primary, standby, tp, ts = self._pair(tmp_path)
+        try:
+            # poison the standby with a fake watermark gap so streamed
+            # records buffer; its catch-up *would* repair from the
+            # primary, so point it at a dead address instead
+            standby.primary_addr = ("127.0.0.1", 1)
+            standby.applied_seq = 0
+            # pre-load the primary's WAL to seq>1 so the standby sees a gap
+            primary.engine.apply_op(
+                "create_node",
+                Node(id="w0", labels=[], properties={}).to_dict())
+            with pytest.raises(ConnectionError, match="quorum"):
+                primary.apply(
+                    "create_node",
+                    Node(id="w1", labels=[], properties={}).to_dict())
+        finally:
+            primary.close(); standby.close(); tp.close(); ts.close()
+
+    def test_same_key_reorder_converges_to_primary_value(self, tmp_path):
+        """create(x) then update(x) delivered reversed: the update must not
+        be lost and the standby must end at the primary's final value."""
+        primary, standby, tp, ts = self._pair(tmp_path)
+        try:
+            create = Node(id="x", labels=[], properties={"v": 1}).to_dict()
+            update = Node(id="x", labels=[], properties={"v": 2}).to_dict()
+            s1 = primary.engine.apply_op("create_node", create)
+            s2 = primary.engine.apply_op("update_node", update)
+            # newer update arrives first
+            standby.handle_wal_batch(
+                {"epoch": 1,
+                 "records": [{"seq": s2, "op": "update_node", "data": update}]}
+            )
+            standby.handle_wal_batch(
+                {"epoch": 1,
+                 "records": [{"seq": s1, "op": "create_node", "data": create}]}
+            )
+            assert standby.engine.get_node("x").properties["v"] == 2
+            assert primary.engine.get_node("x").properties["v"] == 2
+        finally:
+            primary.close(); standby.close(); tp.close(); ts.close()
+
+
+class TestRaftVoteSafety:
+    """ADVICE medium: _step_down cleared voted_for even at an equal term,
+    letting a self-voted candidate grant a second vote in the same term."""
+
+    def _node(self, name):
+        t = ClusterTransport(name)
+        cfg = ReplicationConfig(
+            mode="raft", node_id=name, peers=[],
+            heartbeat_interval=60.0, failover_timeout=600.0,
+        )
+        return RaftNode(t, cfg, lambda op, data: None)
+
+    def test_equal_term_demotion_keeps_vote(self):
+        n = self._node("a")
+        # candidate that voted for itself in term 5
+        n.term = 5
+        n.voted_for = "a"
+        n._state = Role.CANDIDATE
+        # the term-5 leader's heartbeat demotes it...
+        r = n.handle_append_entries(
+            {"term": 5, "leader": "b", "prev_log_index": 0,
+             "prev_log_term": 0, "entries": [], "leader_commit": 0}
+        )
+        assert r["ok"]
+        assert n._state is Role.STANDBY
+        # ...but must NOT clear its term-5 vote record
+        assert n.voted_for == "a"
+        # a delayed term-5 candidate asks for a vote: denied
+        v = n.handle_request_vote(
+            {"term": 5, "candidate": "c", "last_log_index": 99,
+             "last_log_term": 99}
+        )
+        assert v["vote_granted"] is False
+
+    def test_higher_term_still_clears_vote(self):
+        n = self._node("a")
+        n.term = 5
+        n.voted_for = "a"
+        n._state = Role.CANDIDATE
+        n.handle_append_entries(
+            {"term": 6, "leader": "b", "prev_log_index": 0,
+             "prev_log_term": 0, "entries": [], "leader_commit": 0}
+        )
+        assert n.term == 6
+        v = n.handle_request_vote(
+            {"term": 6, "candidate": "c", "last_log_index": 99,
+             "last_log_term": 99}
+        )
+        assert v["vote_granted"] is True
+
+
+class TestQdrantAtomicBatch:
+    """ADVICE medium: non-numeric vector elements must fail validation in
+    pass 1, before any write — never a partially-applied batch."""
+
+    def test_bad_element_leaves_no_partial_batch(self):
+        compat = QdrantCompat(NamespacedEngine(_Mem(), "t"))
+        compat.create_collection("docs", {"size": 2})
+        pts = [
+            {"id": "1", "vector": [0.1, 0.2]},
+            {"id": "2", "vector": [0.3, "oops"]},
+        ]
+        with pytest.raises(QdrantError, match="non-numeric"):
+            compat.upsert_points("docs", pts)
+        assert compat.count_points("docs") == 0
+        assert compat.retrieve_points("docs", ["1"]) == []
+
+
+class TestIVFPQDuplicateInBatch:
+    """ADVICE low: a batch containing the same new ext_id twice crashed
+    (TypeError on empty index / IndexError otherwise)."""
+
+    def _trained(self, dims=8):
+        idx = IVFPQIndex(n_subspaces=2, n_clusters=2)
+        rng = np.random.default_rng(0)
+        idx.train(rng.standard_normal((64, dims)).astype(np.float32))
+        return idx, rng
+
+    def test_duplicate_id_empty_index(self):
+        idx, rng = self._trained()
+        v1 = rng.standard_normal(8).astype(np.float32)
+        v2 = rng.standard_normal(8).astype(np.float32)
+        idx.add_batch([("dup", v1), ("dup", v2)])  # crashed before the fix
+        assert len(idx) == 1
+        # last occurrence wins: searching with v2 finds "dup"
+        hits = idx.search(v2, k=1)
+        assert hits[0][0] == "dup"
+
+    def test_duplicate_id_nonempty_index(self):
+        idx, rng = self._trained()
+        idx.add_batch([("a", rng.standard_normal(8).astype(np.float32))])
+        v = rng.standard_normal(8).astype(np.float32)
+        idx.add_batch([("b", v), ("b", v)])
+        assert len(idx) == 2
+
+
+class TestHeimdallDoubleLoad:
+    """ADVICE low: two concurrent loads of one model both built it and
+    double-counted memory_used — a permanent accounting leak."""
+
+    def test_concurrent_load_counts_memory_once(self):
+        mgr = Manager(memory_budget_bytes=1000)
+        mgr.register(ModelSpec(name="m", backend="echo", memory_bytes=100))
+        results = []
+        barrier = threading.Barrier(8)
+
+        def load():
+            barrier.wait()
+            results.append(mgr.load("m"))
+
+        threads = [threading.Thread(target=load) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert mgr.memory_used == 100, "memory double-counted"
+        assert len({id(g) for g in results}) == 1, "model built twice"

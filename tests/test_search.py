@@ -59,6 +59,20 @@ class TestBM25:
         hits = idx.search("tpu", k=4)
         assert hits[0][0] == "d3"
 
+    def test_reindex_keeps_score_positive(self):
+        """Tombstoned posting entries must not inflate df: re-indexing the
+        only doc once flipped its idf negative, and the search service's
+        min_score=0 gate then silently dropped every hit (found via the
+        store→embed→reindex→recall path)."""
+        idx = BM25Index()
+        idx.index("d1", "tpu kernels")
+        before = idx.search("tpu kernels", k=1)[0][1]
+        idx.index("d1", "tpu kernels")  # same text: embed-queue reindex
+        after = idx.search("tpu kernels", k=1)
+        assert after and after[0][0] == "d1"
+        assert after[0][1] > 0
+        assert abs(after[0][1] - before) < 1e-6
+
     def test_idf_rare_terms_win(self):
         idx = BM25Index()
         for i in range(20):
