@@ -122,7 +122,132 @@ def main():
         "vs_baseline": round(qps / BASELINE_REST_SEARCH_OPS, 3),
         "backend": "cpu-fallback" if fallback else jax.devices()[0].platform,
     }
+    result["cypher"] = _bench_cypher()
     print(json.dumps(result))
+
+
+# LDBC-SNB published reference numbers (BASELINE.md rows 1-4, M3 Max).
+_LDBC_BASELINES = {
+    "msg_content_lookup": 6389.0,
+    "recent_messages_friends": 2769.0,
+    "avg_friends_per_city": 4713.0,
+    "tag_cooccurrence": 2076.0,
+}
+
+
+def _bench_cypher():
+    """Sustained single-stream ops/s for the four LDBC-shaped queries in
+    BASELINE.md, on a 1k-person social graph. The query-result cache is
+    disabled so this measures real execution (the columnar fast paths),
+    not cache hits; lookup params rotate across iterations."""
+    import random
+    import uuid
+
+    from nornicdb_tpu.query.executor import CypherExecutor
+    from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+    from nornicdb_tpu.storage.types import Edge, Node
+
+    eng = NamespacedEngine(MemoryEngine(), "bench")
+    rng = random.Random(11)
+    cities = ["Oslo", "Bergen", "Pune", "Kyoto", "Quito", "Lagos", "Lima"]
+    tags = [f"tag{t}" for t in range(40)]
+
+    def add_node(labels, props):
+        n = Node(id=str(uuid.uuid4()), labels=labels, properties=props)
+        eng.create_node(n)
+        return n.id
+
+    def add_edge(etype, a, b, props=None):
+        eng.create_edge(Edge(id=str(uuid.uuid4()), type=etype, start_node=a,
+                             end_node=b, properties=props or {}))
+
+    city_ids = [add_node(["City"], {"name": c}) for c in cities]
+    tag_ids = [add_node(["Tag"], {"name": t}) for t in tags]
+    n_people = 1000
+    people = [
+        add_node(["Person"], {"id": i, "name": f"p{i}", "age": 18 + (i * 7) % 50})
+        for i in range(n_people)
+    ]
+    for i, pid in enumerate(people):
+        add_edge("IS_LOCATED_IN", pid, city_ids[i % len(cities)])
+        for j in rng.sample(range(n_people), 8):
+            if j != i:
+                add_edge("KNOWS", pid, people[j])
+    n_msgs = 2000
+    for m in range(n_msgs):
+        mid = add_node(
+            ["Message"],
+            {"id": 100000 + m, "content": f"msg {m}",
+             "creationDate": 1700000000 + m * 37},
+        )
+        add_edge("HAS_CREATOR", mid, people[rng.randrange(n_people)])
+        for t in rng.sample(range(len(tags)), rng.randrange(1, 4)):
+            add_edge("HAS_TAG", mid, tag_ids[t])
+
+    ex = CypherExecutor(eng)
+    ex.enable_query_cache = False
+
+    queries = {
+        "msg_content_lookup": (
+            "MATCH (m:Message {id: $mid}) RETURN m.content",
+            lambda it: {"mid": 100000 + (it * 7) % n_msgs},
+        ),
+        "recent_messages_friends": (
+            "MATCH (p:Person {id: $pid})-[:KNOWS]->(f:Person)"
+            "<-[:HAS_CREATOR]-(m:Message) "
+            "RETURN f.name, m.content, m.creationDate "
+            "ORDER BY m.creationDate DESC LIMIT 10",
+            lambda it: {"pid": (it * 13) % n_people},
+        ),
+        "avg_friends_per_city": (
+            "MATCH (c:City)<-[:IS_LOCATED_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+            "RETURN c.name, count(f) / count(DISTINCT p) AS avgFriends",
+            lambda it: {},
+        ),
+        "tag_cooccurrence": (
+            "MATCH (t1:Tag)<-[:HAS_TAG]-(m:Message)-[:HAS_TAG]->(t2:Tag) "
+            "WHERE t1 <> t2 RETURN t1.name, t2.name, count(m) AS freq",
+            lambda it: {},
+        ),
+    }
+
+    def measure(q, mk_params):
+        ex.execute(q, mk_params(0))  # warm (builds columnar tables)
+        iters = 50
+        t0 = time.perf_counter()
+        n_done = 0
+        while True:
+            for it in range(iters):
+                ex.execute(q, mk_params(n_done + it))
+            n_done += iters
+            dt = time.perf_counter() - t0
+            if dt > 2.0 or n_done >= 20000:
+                break
+        return n_done / dt
+
+    out = {}
+    ratios = []
+    for name, (q, mk_params) in queries.items():
+        qps = measure(q, mk_params)
+        base = _LDBC_BASELINES[name]
+        out[name] = {
+            "value": round(qps, 1), "unit": "queries/s",
+            "vs_baseline": round(qps / base, 3),
+        }
+        ratios.append(qps / base)
+        # Repeated identical reads are the reference's bench pattern and
+        # hit its LRU result cache (read-cache probe, executor.go:634);
+        # report our cached number too for the static-param queries.
+        if not mk_params(0):
+            ex.enable_query_cache = True
+            cached_qps = measure(q, mk_params)
+            ex.enable_query_cache = False
+            ex.query_cache.clear()
+            out[name]["cached_value"] = round(cached_qps, 1)
+            out[name]["cached_vs_baseline"] = round(cached_qps / base, 3)
+    geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
+    out["ldbc_geomean_vs_baseline"] = round(geomean, 3)
+    return out
 
 
 if __name__ == "__main__":

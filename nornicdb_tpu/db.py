@@ -20,6 +20,7 @@ from nornicdb_tpu.storage import (
     Engine,
     ListenableEngine,
     MemoryEngine,
+    MutationListener,
     NamespacedEngine,
     Node,
 )
@@ -163,6 +164,25 @@ class DB:
             self._executor = CypherExecutor(self.storage)
             if self._search is not None:
                 self._executor.set_search_service(self._search)
+            # Writes arriving outside Cypher (Store/Link, embed queue,
+            # replication apply) must invalidate the executor's read
+            # cache + columnar snapshot (reference: cache_policy.go).
+            ex = self._executor
+
+            class _CacheInvalidator(MutationListener):
+                def on_node_upsert(self, node):
+                    ex.invalidate_caches()
+
+                def on_node_delete(self, node_id):
+                    ex.invalidate_caches()
+
+                def on_edge_upsert(self, edge):
+                    ex.invalidate_caches()
+
+                def on_edge_delete(self, edge_id):
+                    ex.invalidate_caches()
+
+            self._listenable.add_listener(_CacheInvalidator())
         return self._executor
 
     @property

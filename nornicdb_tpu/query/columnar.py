@@ -1,0 +1,334 @@
+"""Columnar graph snapshot for vectorized query execution.
+
+The reference parallelizes hot query shapes by chunking node/edge slices
+across cores (pkg/cypher/parallel.go:99-403) and serves LDBC/Northwind
+shapes through specialized executors over indexed storage
+(optimized_executors.go:25-282, storage_fastpaths.go:14-54). The
+TPU-native redesign replaces both with *columnar* execution: the graph is
+snapshotted into flat arrays (a global node table, per-edge-type CSR
+adjacency, lazily materialized property columns and hash property
+indexes) and query shapes compile to batched array ops — numpy for the
+small/latency-bound shapes, with the same layout streaming to the device
+data plane (ops/) for large scans. SURVEY §2.8 row 1 maps the
+reference's multicore chunk parallelism to exactly this design.
+
+The catalog is invalidated wholesale on any mutation (cheap: builds are
+lazy and per-label/type) via `invalidate()`, wired to executor write
+stats and to storage mutation listeners in db.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from nornicdb_tpu.storage.types import Direction, Edge, Engine, Node
+
+
+class EdgeTable:
+    """All edges of one type, as parallel arrays over global node rows."""
+
+    __slots__ = (
+        "etype", "src", "dst", "edges",
+        "_csr_out", "_csr_in", "_prop_cols",
+    )
+
+    def __init__(self, etype: str, src: np.ndarray, dst: np.ndarray,
+                 edges: List[Edge]):
+        self.etype = etype
+        self.src = src  # int32[ne] global node row of start
+        self.dst = dst  # int32[ne] global node row of end
+        self.edges = edges  # Edge objects aligned with src/dst
+        self._csr_out: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._csr_in: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._prop_cols: Dict[str, np.ndarray] = {}
+
+    def __len__(self) -> int:
+        return len(self.edges)
+
+    def csr(self, direction: str, n_nodes: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(indptr, order): edge rows grouped by src (out) or dst (in).
+
+        ``order`` is a permutation of edge rows; edges with source node g
+        occupy order[indptr[g]:indptr[g+1]] (for direction 'out').
+        """
+        if direction == "out":
+            if self._csr_out is None:
+                self._csr_out = _build_csr(self.src, n_nodes)
+            return self._csr_out
+        if direction == "in":
+            if self._csr_in is None:
+                self._csr_in = _build_csr(self.dst, n_nodes)
+            return self._csr_in
+        raise ValueError(f"bad direction {direction}")
+
+    def prop_col(self, name: str) -> np.ndarray:
+        """Object array of edge property ``name`` aligned with edge rows."""
+        col = self._prop_cols.get(name)
+        if col is None:
+            col = np.empty(len(self.edges), dtype=object)
+            for i, e in enumerate(self.edges):
+                col[i] = e.properties.get(name)
+            self._prop_cols[name] = col
+        return col
+
+
+def _build_csr(keys: np.ndarray, n_nodes: int) -> Tuple[np.ndarray, np.ndarray]:
+    order = np.argsort(keys, kind="stable").astype(np.int32)
+    counts = np.bincount(keys, minlength=n_nodes)
+    indptr = np.zeros(n_nodes + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    return indptr, order
+
+
+class ColumnarCatalog:
+    """Versioned columnar snapshot of a storage.Engine.
+
+    Everything is built lazily on first use and discarded wholesale on
+    ``invalidate()``. Thread-safe for concurrent readers; builds are
+    serialized under a lock.
+    """
+
+    def __init__(self, storage: Engine):
+        self._storage = storage
+        self._lock = threading.Lock()
+        self._version = 0
+        self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self._nodes: Optional[List[Node]] = None
+        self._node_pos: Optional[Dict[str, int]] = None
+        self._label_rows: Dict[str, np.ndarray] = {}
+        self._label_mask: Dict[str, np.ndarray] = {}
+        self._node_prop_cols: Dict[str, np.ndarray] = {}
+        self._prop_index: Dict[Tuple[str, str], Dict[Any, np.ndarray]] = {}
+        self._edge_tables: Dict[str, EdgeTable] = {}
+        self._all_edge_types: Optional[List[str]] = None
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._version += 1
+            self._reset_locked()
+
+    # -- node table -------------------------------------------------------
+
+    def _ensure_nodes(self) -> List[Node]:
+        if self._nodes is None:
+            nodes = list(self._storage.all_nodes())
+            pos = {n.id: i for i, n in enumerate(nodes)}
+            self._nodes = nodes
+            self._node_pos = pos
+        return self._nodes
+
+    def nodes(self) -> List[Node]:
+        with self._lock:
+            return self._ensure_nodes()
+
+    def n_nodes(self) -> int:
+        with self._lock:
+            return len(self._ensure_nodes())
+
+    def node_row(self, node_id: str) -> Optional[int]:
+        with self._lock:
+            self._ensure_nodes()
+            return self._node_pos.get(node_id)
+
+    def label_rows(self, label: str) -> np.ndarray:
+        """Global row indices of nodes carrying ``label`` (int32, sorted)."""
+        with self._lock:
+            rows = self._label_rows.get(label)
+            if rows is None:
+                nodes = self._ensure_nodes()
+                rows = np.asarray(
+                    [i for i, n in enumerate(nodes) if label in n.labels],
+                    dtype=np.int32,
+                )
+                self._label_rows[label] = rows
+            return rows
+
+    def label_mask(self, label: str) -> np.ndarray:
+        """bool[n_nodes] membership mask for ``label``."""
+        with self._lock:
+            mask = self._label_mask.get(label)
+            if mask is None:
+                nodes = self._ensure_nodes()
+                mask = np.zeros(len(nodes), dtype=bool)
+                rows = self._label_rows.get(label)
+                if rows is not None:
+                    mask[rows] = True
+                else:
+                    for i, n in enumerate(nodes):
+                        if label in n.labels:
+                            mask[i] = True
+                self._label_mask[label] = mask
+            return mask
+
+    def node_prop_col(self, name: str) -> np.ndarray:
+        """Object array of node property ``name`` over ALL global rows."""
+        with self._lock:
+            col = self._node_prop_cols.get(name)
+            if col is None:
+                nodes = self._ensure_nodes()
+                col = np.empty(len(nodes), dtype=object)
+                for i, n in enumerate(nodes):
+                    col[i] = n.properties.get(name)
+                self._node_prop_cols[name] = col
+            return col
+
+    def prop_index(self, label: str, prop: str) -> Dict[Any, np.ndarray]:
+        """Hash index value -> global rows, over nodes with ``label``.
+
+        The reference reaches point lookups like LDBC "message content
+        lookup" through indexed property access (storage_fastpaths.go);
+        this is the columnar equivalent.
+        """
+        with self._lock:
+            key = (label, prop)
+            idx = self._prop_index.get(key)
+            if idx is None:
+                nodes = self._ensure_nodes()
+                rows = self._label_rows.get(label)
+                if rows is None:
+                    rows = np.asarray(
+                        [i for i, n in enumerate(nodes) if label in n.labels],
+                        dtype=np.int32,
+                    )
+                    self._label_rows[label] = rows
+                buckets: Dict[Any, List[int]] = {}
+                for i in rows.tolist():
+                    v = nodes[i].properties.get(prop)
+                    if v is not None and not isinstance(v, (list, dict)):
+                        buckets.setdefault(v, []).append(i)
+                idx = {
+                    v: np.asarray(lst, dtype=np.int32)
+                    for v, lst in buckets.items()
+                }
+                self._prop_index[key] = idx
+            return idx
+
+    # -- edge tables ------------------------------------------------------
+
+    def edge_table(self, etype: str) -> EdgeTable:
+        with self._lock:
+            tbl = self._edge_tables.get(etype)
+            if tbl is None:
+                self._ensure_nodes()
+                pos = self._node_pos
+                src: List[int] = []
+                dst: List[int] = []
+                edges: List[Edge] = []
+                for e in self._storage.get_edges_by_type(etype):
+                    s = pos.get(e.start_node)
+                    d = pos.get(e.end_node)
+                    if s is None or d is None:
+                        continue  # dangling edge: invisible to matching
+                    src.append(s)
+                    dst.append(d)
+                    edges.append(e)
+                tbl = EdgeTable(
+                    etype,
+                    np.asarray(src, dtype=np.int32),
+                    np.asarray(dst, dtype=np.int32),
+                    edges,
+                )
+                self._edge_tables[etype] = tbl
+            return tbl
+
+    def edge_types(self) -> List[str]:
+        with self._lock:
+            if self._all_edge_types is None:
+                types = set()
+                for e in self._storage.all_edges():
+                    types.add(e.type)
+                self._all_edge_types = sorted(types)
+            return self._all_edge_types
+
+
+def expand_hop(
+    table: EdgeTable,
+    frontier: np.ndarray,
+    direction: str,
+    n_nodes: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Expand one relationship hop for every row of ``frontier``.
+
+    frontier: int array of global node rows (the current binding column).
+    direction: 'out' (frontier is edge source) or 'in' (frontier is edge
+    target). Returns (row_repeat, edge_rows, targets):
+
+    - row_repeat: for each produced match, the index into ``frontier`` it
+      came from (so sibling binding columns can be np.take'd).
+    - edge_rows: the edge-table row of the traversed edge.
+    - targets: the global node row reached.
+
+    Fully vectorized (no per-row Python loop): the classic
+    repeat/cumsum-offset trick over CSR ranges.
+    """
+    indptr, order = table.csr(direction, n_nodes)
+    starts = indptr[frontier]
+    counts = indptr[frontier + 1] - starts
+    total = int(counts.sum())
+    if total == 0:
+        empty = np.empty(0, dtype=np.int32)
+        return empty, empty, empty
+    row_repeat = np.repeat(
+        np.arange(len(frontier), dtype=np.int32), counts
+    )
+    grp_start = np.repeat(starts, counts)
+    grp_off = np.repeat(np.cumsum(counts) - counts, counts)
+    within = np.arange(total, dtype=np.int64) - grp_off
+    edge_rows = order[grp_start + within]
+    if direction == "out":
+        targets = table.dst[edge_rows]
+    else:
+        targets = table.src[edge_rows]
+    return row_repeat, edge_rows.astype(np.int32), targets
+
+
+def group_codes(cols: List[np.ndarray]) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Encode rows of ``cols`` (parallel arrays) into dense group codes.
+
+    Returns (codes[int64 per row], uniques-per-col) where equal rows get
+    equal codes in [0, n_groups). Mixed-type object columns are handled
+    by per-column np.unique on a sort-stable key.
+    """
+    if not cols:
+        return np.zeros(0, dtype=np.int64), []
+    inv_total = np.zeros(len(cols[0]), dtype=np.int64)
+    uniques: List[np.ndarray] = []
+    for col in cols:
+        uniq, inv = _unique_inverse(col)
+        uniques.append(uniq)
+        inv_total = inv_total * max(len(uniq), 1) + inv
+    # re-densify combined codes
+    _, codes = np.unique(inv_total, return_inverse=True)
+    return codes, uniques
+
+
+def _unique_inverse(col: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    if col.dtype != object:
+        return np.unique(col, return_inverse=True)
+    # object column: hash via Python dict (stable, handles mixed types)
+    table: Dict[Any, int] = {}
+    inv = np.empty(len(col), dtype=np.int64)
+    uniq: List[Any] = []
+    for i, v in enumerate(col.tolist()):
+        key = (type(v).__name__, v) if not isinstance(v, (list, dict)) else (
+            "repr", repr(v)
+        )
+        j = table.get(key)
+        if j is None:
+            j = len(uniq)
+            table[key] = j
+            uniq.append(v)
+        inv[i] = j
+    u = np.empty(len(uniq), dtype=object)
+    for i, v in enumerate(uniq):
+        u[i] = v
+    return u, inv

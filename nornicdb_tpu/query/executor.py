@@ -93,11 +93,35 @@ class CypherExecutor:
     """Executes Cypher against a storage.Engine
     (reference: cypher.NewStorageExecutor, wired at db.go:974)."""
 
-    def __init__(self, storage: Engine):
+    def __init__(self, storage: Engine, cache_size: int = 1024,
+                 cache_ttl: float = 60.0):
         self.storage = storage
         self._search = None
         self._lock = threading.Lock()
         self._plugin_functions: Dict[str, Any] = {}
+        # Columnar snapshot powering the vectorized fast paths
+        # (reference analog: the per-shape optimized executors +
+        # parallel.go chunked scans; see query/columnar.py).
+        from nornicdb_tpu.query.columnar import ColumnarCatalog
+
+        self.columnar = ColumnarCatalog(storage)
+        self.enable_fastpaths = True
+        # Read-query result cache with write invalidation (reference:
+        # read-cache probe executor.go:634, pkg/cache/query_cache.go).
+        from nornicdb_tpu.cache import LRUCache
+
+        self.query_cache: LRUCache = LRUCache(
+            max_size=cache_size, ttl_seconds=cache_ttl
+        )
+        self.enable_query_cache = True
+
+    def invalidate_caches(self) -> None:
+        """Drop the query-result cache and columnar snapshot. Called after
+        any write this executor performs, and wired to storage mutation
+        listeners for writes arriving from other paths (db.Store, embed
+        queue) — reference: cache_policy.go write invalidation."""
+        self.query_cache.clear()
+        self.columnar.invalidate()
 
     def set_search_service(self, svc) -> None:
         """Wire the vector/fulltext procedures
@@ -122,7 +146,22 @@ class CypherExecutor:
             return self._execute_explain(rest, params)
         if head == "PROFILE" and boundary:
             return self._execute_profile(rest, params)
-        return self._execute_parsed(parse(query), params)
+        uq = parse(query)
+        cache_key = None
+        if self.enable_query_cache and _is_read_only(uq):
+            cache_key = _cache_key(query, params)
+            if cache_key is not None:
+                hit = self.query_cache.get(cache_key)
+                if hit is not None:
+                    return CypherResult(
+                        columns=list(hit.columns),
+                        rows=[list(r) for r in hit.rows],
+                        plan=hit.plan,
+                    )
+        result = self._execute_parsed(uq, params)
+        if cache_key is not None and not result.stats.contains_updates:
+            self.query_cache.put(cache_key, result)
+        return result
 
     def _execute_parsed(
         self,
@@ -151,6 +190,10 @@ class CypherExecutor:
                     result.rows = deduped
         result = result or CypherResult()
         result.stats = ctx.stats
+        if ctx.stats.contains_updates:
+            # write invalidation for every execution route (including
+            # PROFILE and txn overlays) — reference: cache_policy.go
+            self.invalidate_caches()
         return result
 
     def _execute_explain(
@@ -1056,14 +1099,29 @@ class CypherExecutor:
     def _order_rows(self, clause, cols, out_rows, envs, ctx):
         import functools as _ft
 
+        # ORDER BY may reference a projected item by its expression (legal
+        # for grouping keys after aggregation: RETURN o.city, count(*) AS n
+        # ORDER BY n DESC, o.city) — resolve those to column positions
+        # first, because the source variable is out of scope post-projection.
+        col_of_expr: List[Optional[int]] = []
+        for expr, _desc in clause.order_by:
+            pos = None
+            for i, item in enumerate(clause.items):
+                if item.expr == expr:
+                    pos = len(cols) - len(clause.items) + i
+                    break
+            col_of_expr.append(pos)
         keyed = []
         for vals, env in zip(out_rows, envs):
             keys = []
-            for expr, desc in clause.order_by:
-                try:
-                    v = self._eval(expr, env, ctx)
-                except CypherRuntimeError:
-                    v = None
+            for (expr, desc), pos in zip(clause.order_by, col_of_expr):
+                if pos is not None:
+                    v = vals[pos]
+                else:
+                    try:
+                        v = self._eval(expr, env, ctx)
+                    except CypherRuntimeError:
+                        v = None
                 keys.append((v, desc))
             keyed.append((keys, vals, env))
 
@@ -1231,6 +1289,39 @@ class CypherExecutor:
 
 
 # -- helpers -------------------------------------------------------------
+
+_WRITE_CLAUSES = (
+    A.CreateClause, A.MergeClause, A.SetClause, A.RemoveClause, A.DeleteClause,
+)
+
+# Functions whose results must never be served from cache.
+_NONDETERMINISTIC = (
+    "rand(", "randomuuid(", "timestamp(", "datetime(", "date(", "time(",
+    "localtime(", "localdatetime(", "apoc.create.uuid(",
+)
+
+
+def _is_read_only(uq: "A.UnionQuery") -> bool:
+    """Cacheable = no write clauses and no CALL (procedures may write)."""
+    for part in uq.parts:
+        for clause in part.clauses:
+            if isinstance(clause, _WRITE_CLAUSES + (A.CallClause,)):
+                return False
+    return True
+
+
+def _cache_key(query: str, params: Optional[Dict[str, Any]]):
+    low = query.lower()
+    if any(tok in low for tok in _NONDETERMINISTIC):
+        return None
+    if not params:
+        return query
+    try:
+        import json
+
+        return (query, json.dumps(params, sort_keys=True, default=str))
+    except (TypeError, ValueError):
+        return None
 
 
 def _truthy(v: Any) -> bool:

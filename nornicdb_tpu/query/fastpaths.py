@@ -4,20 +4,63 @@ general pipeline.
 Reference: the reference's perf story is mostly *avoiding* general
 execution — tryFastPathCompoundQuery (executor.go:1421), ExecuteOptimized
 (optimized_executors.go:25-282), fast aggregations
-(traversal_fast_agg.go:15,57), namespace-bypass (storage_fastpaths.go).
-Here the detection works on the parsed AST (cheaper to keep correct than
-regex shape-matching) and the counting shapes hit the storage engine's
-O(1)/indexed paths directly.
+(traversal_fast_agg.go:15,57), revenue-by-product
+(match_with_rel_fast.go:10), namespace-bypass (storage_fastpaths.go).
+
+Two tiers here:
+
+1. O(1)/indexed count shapes answered straight from engine counters
+   (`_try_count_shapes`).
+2. A *vectorized chain family* (`_try_vectorized`): single-path MATCH of
+   fixed-length relationship chains + simple WHERE + projection or
+   grouped aggregation + ORDER BY/SKIP/LIMIT, compiled onto the columnar
+   catalog (query/columnar.py) as batched numpy array ops instead of the
+   row-at-a-time interpreter. This is the TPU-first redesign of the
+   reference's per-shape Go executors: one compiler for the whole LDBC/
+   Northwind family (message content lookup, recent messages of friends,
+   avg friends per city, tag co-occurrence, supplier/category counts,
+   revenue per product) rather than a dozen hand-written shapes.
+
+Any unsupported feature falls through (return None) to the general
+executor — parity between paths is enforced by tests/test_fastpath_parity.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from nornicdb_tpu.query import ast as A
 
 
+_AGG_NAMES = {"count", "sum", "avg", "min", "max", "collect"}
+
+
 def try_fast_path(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
+    if not getattr(executor, "enable_fastpaths", True):
+        return None
+    r = _try_count_shapes(executor, q, ctx)
+    if r is not None:
+        return r
+    # Vectorized paths read through the executor's columnar catalog, which
+    # snapshots executor.storage — bail out when this query runs against a
+    # different engine view (PROFILE counting proxy, explicit txn overlay).
+    if ctx.storage is not executor.storage:
+        return None
+    catalog = getattr(executor, "columnar", None)
+    if catalog is None:
+        return None
+    try:
+        return _try_vectorized(executor, catalog, q, ctx)
+    except _Unsupported:
+        return None
+
+
+# -- tier 1: engine-counter shapes ---------------------------------------
+
+
+def _try_count_shapes(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
     from nornicdb_tpu.query.executor import CypherResult
 
     clauses = q.clauses
@@ -87,3 +130,879 @@ def try_fast_path(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
         return CypherResult(columns=[col], rows=[[total]])
 
     return None
+
+
+# -- tier 2: vectorized chain family -------------------------------------
+
+
+class _Unsupported(Exception):
+    """Shape outside the vectorized family — fall back to general path."""
+
+
+def _bail() -> None:
+    raise _Unsupported
+
+
+class _Bindings:
+    """Parallel binding columns over match rows.
+
+    node_cols: var -> int32 global node rows
+    edge_cols: var/slot -> (EdgeTable, int32 edge rows)
+    """
+
+    def __init__(self):
+        self.node_cols: Dict[str, np.ndarray] = {}
+        self.edge_cols: Dict[str, Tuple[Any, np.ndarray]] = {}
+        self.hop_edges: List[Tuple[str, np.ndarray]] = []  # (etype, edge rows)
+        self.n_rows = 0
+
+    def take(self, sel: np.ndarray) -> None:
+        """Keep only selected row positions (index array or bool mask)."""
+        self.node_cols = {k: v[sel] for k, v in self.node_cols.items()}
+        self.edge_cols = {k: (t, v[sel]) for k, (t, v) in self.edge_cols.items()}
+        self.hop_edges = [(t, v[sel]) for t, v in self.hop_edges]
+        some = next(iter(self.node_cols.values()), None)
+        if some is None and self.hop_edges:
+            some = self.hop_edges[0][1]
+        if some is not None:
+            self.n_rows = len(some)
+        elif sel.dtype == bool:
+            self.n_rows = int(sel.sum())
+        else:
+            self.n_rows = len(sel)
+
+
+def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResult"]:
+    from nornicdb_tpu.query.executor import CypherResult
+
+    clauses = q.clauses
+    if len(clauses) != 2:
+        return None
+    m, ret = clauses[0], clauses[1]
+    if not isinstance(m, A.MatchClause) or not isinstance(ret, A.ReturnClause):
+        return None
+    if m.optional or len(m.paths) != 1:
+        return None
+    path = m.paths[0]
+    if path.path_var or not path.nodes or len(path.nodes) > 4:
+        return None
+    if ret.star:
+        return None
+    for pr in path.rels:
+        if pr.min_hops != 1 or pr.max_hops != 1 or pr.props is not None:
+            return None
+        if pr.direction not in ("out", "in"):
+            return None
+        if len(pr.types) != 1:
+            return None
+    # variable sanity: all node vars distinct (cycles fall back)
+    seen_vars = set()
+    for pn in path.nodes:
+        if pn.var:
+            if pn.var in seen_vars:
+                return None
+            seen_vars.add(pn.var)
+
+    b = _match_chain(catalog, path, ctx)
+    if b is None:
+        return None  # empty graph handled below via n_rows == 0
+
+    # WHERE
+    if m.where is not None:
+        for conj in _split_and(m.where):
+            mask = _vec_predicate(conj, b, catalog, ctx)
+            b.take(mask)
+
+    return _project(executor, catalog, ret, b, ctx, CypherResult)
+
+
+def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
+    from nornicdb_tpu.query.columnar import expand_hop
+
+    nodes, rels = path.nodes, path.rels
+    n_nodes_total = catalog.n_nodes()
+
+    # candidate rows for each pattern node (None == unconstrained)
+    def candidates(pn: A.PatternNode) -> Optional[np.ndarray]:
+        rows: Optional[np.ndarray] = None
+        if pn.labels:
+            rows = catalog.label_rows(pn.labels[0])
+            for lbl in pn.labels[1:]:
+                rows = rows[catalog.label_mask(lbl)[rows]]
+        if pn.props is not None:
+            items = list(pn.props.items)
+            if pn.labels and items:
+                # point lookup via the hash property index (reference:
+                # LDBC message-content-lookup path, storage_fastpaths.go)
+                k0, vexpr0 = items[0]
+                hit = catalog.prop_index(pn.labels[0], k0).get(
+                    _const_value(vexpr0, ctx)
+                )
+                hit = hit if hit is not None else np.empty(0, np.int32)
+                mask = catalog.label_mask(pn.labels[0])  # noqa: F841 (built)
+                rows = (
+                    np.intersect1d(rows, hit).astype(np.int32)
+                    if len(pn.labels) > 1
+                    else hit
+                )
+                items = items[1:]
+            for k, vexpr in items:
+                v = _const_value(vexpr, ctx)
+                base = rows if rows is not None else np.arange(
+                    n_nodes_total, dtype=np.int32
+                )
+                rows = base[_vec_eq(catalog.node_prop_col(k)[base], v)]
+        return rows
+
+    cand = [candidates(pn) for pn in nodes]
+
+    def size(i: int) -> int:
+        return len(cand[i]) if cand[i] is not None else n_nodes_total
+
+    anchor = min(range(len(nodes)), key=size)
+    rows0 = cand[anchor]
+    if rows0 is None:
+        rows0 = np.arange(n_nodes_total, dtype=np.int32)
+
+    b = _Bindings()
+    slot_cols: List[Optional[np.ndarray]] = [None] * len(nodes)
+    slot_cols[anchor] = rows0.astype(np.int32, copy=False)
+
+    def take_all(sel) -> None:
+        for i in range(len(nodes)):
+            if slot_cols[i] is not None:
+                slot_cols[i] = slot_cols[i][sel]
+        b.edge_cols = {k: (t, x[sel]) for k, (t, x) in b.edge_cols.items()}
+        b.hop_edges = [(t, x[sel]) for t, x in b.hop_edges]
+
+    def expand(frm: int, to: int, rel_idx: int) -> None:
+        pr = rels[rel_idx]
+        table = catalog.edge_table(pr.types[0])
+        forward = to > frm
+        # pr.direction 'out': edge start=nodes[rel_idx], end=nodes[rel_idx+1]
+        if pr.direction == "out":
+            direction = "out" if forward else "in"
+        else:
+            direction = "in" if forward else "out"
+        rep, edge_rows, targets = expand_hop(
+            table, slot_cols[frm], direction, n_nodes_total
+        )
+        # replicate existing columns to the expanded row set
+        for i in range(len(nodes)):
+            if slot_cols[i] is not None:
+                slot_cols[i] = slot_cols[i][rep]
+        b.edge_cols = {k: (t, x[rep]) for k, (t, x) in b.edge_cols.items()}
+        b.hop_edges = [(t, x[rep]) for t, x in b.hop_edges]
+        slot_cols[to] = targets
+        if pr.var:
+            b.edge_cols[pr.var] = (table, edge_rows)
+        b.hop_edges.append((pr.types[0], edge_rows))
+        # constrain targets by the `to` node's label/prop candidate set
+        if cand[to] is not None:
+            keep = np.zeros(n_nodes_total, dtype=bool)
+            keep[cand[to]] = True
+            take_all(keep[targets])
+        # Cypher relationship uniqueness: a match may not reuse an edge.
+        # Only same-type hops can collide (edge rows are per-type).
+        latest = len(b.hop_edges) - 1
+        for j in range(latest):
+            if b.hop_edges[j][0] == pr.types[0]:
+                take_all(b.hop_edges[latest][1] != b.hop_edges[j][1])
+
+    for to in range(anchor + 1, len(nodes)):
+        expand(to - 1, to, to - 1)
+    for to in range(anchor - 1, -1, -1):
+        expand(to + 1, to, to)
+
+    for i, pn in enumerate(nodes):
+        if pn.var:
+            b.node_cols[pn.var] = slot_cols[i]
+    b.n_rows = len(slot_cols[anchor]) if slot_cols[anchor] is not None else 0
+    return b
+
+
+def _const_value(e: A.Expr, ctx) -> Any:
+    if isinstance(e, A.Literal):
+        return e.value
+    if isinstance(e, A.Param):
+        if e.name not in ctx.params:
+            _bail()
+        return ctx.params[e.name]
+    _bail()
+
+
+def _index_key(v: Any) -> Any:
+    # the prop_index stores raw property values; ints/floats hash-equal
+    return v
+
+
+def _split_and(e: A.Expr) -> List[A.Expr]:
+    if isinstance(e, A.Binary) and e.op == "AND":
+        return _split_and(e.left) + _split_and(e.right)
+    return [e]
+
+
+def _vec_eq(col: np.ndarray, v: Any) -> np.ndarray:
+    """Null-safe elementwise equality (null -> no match)."""
+    if v is None:
+        return np.zeros(len(col), dtype=bool)
+    out = np.zeros(len(col), dtype=bool)
+    for i, x in enumerate(col.tolist()):
+        if x is None:
+            continue
+        if isinstance(x, bool) != isinstance(v, bool):
+            continue
+        try:
+            out[i] = x == v
+        except TypeError:
+            pass
+    return out
+
+
+def _as_float(col: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(float64 values, valid mask) if all non-null entries numeric."""
+    if col.dtype != object:
+        f = col.astype(np.float64, copy=False)
+        return f, np.ones(len(col), dtype=bool)
+    vals = np.empty(len(col), dtype=np.float64)
+    mask = np.zeros(len(col), dtype=bool)
+    for i, x in enumerate(col.tolist()):
+        if x is None:
+            vals[i] = np.nan
+            continue
+        if isinstance(x, bool) or not isinstance(x, (int, float)):
+            return None
+        vals[i] = float(x)
+        mask[i] = True
+    return vals, mask
+
+
+def _vec_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
+    """Evaluate an expression to a value column over binding rows."""
+    if isinstance(e, A.Prop) and isinstance(e.target, A.Var):
+        name = e.target.name
+        if name in b.node_cols:
+            return catalog.node_prop_col(e.name)[b.node_cols[name]]
+        if name in b.edge_cols:
+            table, rows = b.edge_cols[name]
+            return table.prop_col(e.name)[rows]
+        _bail()
+    if isinstance(e, (A.Literal, A.Param)):
+        v = _const_value(e, ctx)
+        out = np.empty(b.n_rows, dtype=object)
+        out[:] = v
+        return out
+    if isinstance(e, A.Binary) and e.op in ("+", "-", "*", "/"):
+        lcol = _vec_col(e.left, b, catalog, ctx)
+        rcol = _vec_col(e.right, b, catalog, ctx)
+        lf = _as_float(lcol)
+        rf = _as_float(rcol)
+        if lf is None or rf is None:
+            _bail()
+        lv, lm = lf
+        rv, rm = rf
+        with np.errstate(invalid="ignore", divide="ignore"):
+            if e.op == "+":
+                out = lv + rv
+            elif e.op == "-":
+                out = lv - rv
+            elif e.op == "*":
+                out = lv * rv
+            else:
+                out = lv / rv
+        res = np.empty(b.n_rows, dtype=object)
+        valid = lm & rm
+        for i in range(b.n_rows):
+            res[i] = float(out[i]) if valid[i] else None
+        return res
+    _bail()
+
+
+def _vec_predicate(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
+    """Boolean mask over binding rows for one WHERE conjunct."""
+    if isinstance(e, A.Binary):
+        op = e.op
+        # node-var inequality: t1 <> t2 (tag co-occurrence shape)
+        if (
+            op in ("<>", "=")
+            and isinstance(e.left, A.Var)
+            and isinstance(e.right, A.Var)
+            and e.left.name in b.node_cols
+            and e.right.name in b.node_cols
+        ):
+            same = b.node_cols[e.left.name] == b.node_cols[e.right.name]
+            return same if op == "=" else ~same
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            lcol = (
+                _vec_col(e.left, b, catalog, ctx)
+                if not _is_const(e.left) else None
+            )
+            rcol = (
+                _vec_col(e.right, b, catalog, ctx)
+                if not _is_const(e.right) else None
+            )
+            if lcol is None and rcol is None:
+                _bail()
+            if lcol is None:
+                # const OP col  ->  col (flip) OP const
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                op = flip.get(op, op)
+                lcol = rcol
+                rcol = None
+                const = _const_value(e.left, ctx)
+            elif rcol is None:
+                const = _const_value(e.right, ctx)
+            else:
+                return _vec_cmp_cols(lcol, rcol, op)
+            return _vec_cmp_const(lcol, op, const)
+        if op == "IN":
+            lcol = _vec_col(e.left, b, catalog, ctx)
+            vals = _const_value(e.right, ctx)
+            if not isinstance(vals, list):
+                _bail()
+            out = np.zeros(b.n_rows, dtype=bool)
+            vset = set()
+            unhashable = []
+            for v in vals:
+                try:
+                    vset.add(v)
+                except TypeError:
+                    unhashable.append(v)
+            for i, x in enumerate(lcol.tolist()):
+                if x is None:
+                    continue
+                try:
+                    out[i] = x in vset or x in unhashable
+                except TypeError:
+                    pass
+            return out
+        if op in ("STARTS WITH", "ENDS WITH", "CONTAINS"):
+            lcol = _vec_col(e.left, b, catalog, ctx)
+            v = _const_value(e.right, ctx)
+            if not isinstance(v, str):
+                _bail()
+            out = np.zeros(b.n_rows, dtype=bool)
+            for i, x in enumerate(lcol.tolist()):
+                if not isinstance(x, str):
+                    continue
+                if op == "STARTS WITH":
+                    out[i] = x.startswith(v)
+                elif op == "ENDS WITH":
+                    out[i] = x.endswith(v)
+                else:
+                    out[i] = v in x
+            return out
+    if isinstance(e, A.LabelCheck):
+        if e.var not in b.node_cols:
+            _bail()
+        mask = np.ones(b.n_rows, dtype=bool)
+        for lbl in e.labels:
+            mask &= catalog.label_mask(lbl)[b.node_cols[e.var]]
+        return mask
+    if isinstance(e, A.IsNull):
+        col = _vec_col(e.operand, b, catalog, ctx)
+        isnull = np.array([x is None for x in col.tolist()], dtype=bool)
+        return ~isnull if e.negated else isnull
+    _bail()
+
+
+def _is_const(e: A.Expr) -> bool:
+    return isinstance(e, (A.Literal, A.Param))
+
+
+def _vec_cmp_const(col: np.ndarray, op: str, v: Any) -> np.ndarray:
+    if op == "=":
+        return _vec_eq(col, v)
+    if op == "<>":
+        eq = _vec_eq(col, v)
+        nonnull = np.array([x is not None for x in col.tolist()], dtype=bool)
+        return nonnull & ~eq
+    # ordering comparisons: numeric lane when possible
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        f = _as_float(col)
+        if f is not None:
+            vals, mask = f
+            with np.errstate(invalid="ignore"):
+                if op == "<":
+                    res = vals < v
+                elif op == "<=":
+                    res = vals <= v
+                elif op == ">":
+                    res = vals > v
+                else:
+                    res = vals >= v
+            return res & mask
+    out = np.zeros(len(col), dtype=bool)
+    for i, x in enumerate(col.tolist()):
+        if x is None:
+            continue
+        try:
+            if op == "<":
+                out[i] = x < v
+            elif op == "<=":
+                out[i] = x <= v
+            elif op == ">":
+                out[i] = x > v
+            else:
+                out[i] = x >= v
+        except TypeError:
+            pass
+    return out
+
+
+def _vec_cmp_cols(lcol: np.ndarray, rcol: np.ndarray, op: str) -> np.ndarray:
+    out = np.zeros(len(lcol), dtype=bool)
+    for i, (x, y) in enumerate(zip(lcol.tolist(), rcol.tolist())):
+        if x is None or y is None:
+            continue
+        try:
+            if op == "=":
+                out[i] = x == y and isinstance(x, bool) == isinstance(y, bool)
+            elif op == "<>":
+                out[i] = not (x == y and isinstance(x, bool) == isinstance(y, bool))
+            elif op == "<":
+                out[i] = x < y
+            elif op == "<=":
+                out[i] = x <= y
+            elif op == ">":
+                out[i] = x > y
+            else:
+                out[i] = x >= y
+        except TypeError:
+            pass
+    return out
+
+
+# -- projection / aggregation --------------------------------------------
+
+
+def _project(executor, catalog, ret: A.ReturnClause, b: _Bindings, ctx, CypherResult):
+    from nornicdb_tpu.query.executor import _contains_agg
+
+    has_agg = any(_contains_agg(i.expr) for i in ret.items)
+    cols = []
+    for item in ret.items:
+        if item.alias:
+            cols.append(item.alias)
+        elif isinstance(item.expr, A.Var):
+            cols.append(item.expr.name)
+        elif isinstance(item.expr, A.Prop) and isinstance(item.expr.target, A.Var):
+            cols.append(f"{item.expr.target.name}.{item.expr.name}")
+        else:
+            cols.append(item.text)
+
+    if has_agg:
+        out_cols = _aggregate(catalog, ret, b, ctx)
+    else:
+        out_cols = []
+        for item in ret.items:
+            out_cols.append(_out_col(item.expr, b, catalog, ctx))
+        if ret.distinct:
+            from nornicdb_tpu.query.columnar import group_codes
+
+            codes, _ = group_codes(
+                [_codeable(c, b, catalog) for c in out_cols]
+            )
+            first = _first_occurrence(codes)
+            out_cols = [c[first] for c in out_cols]
+
+    n = len(out_cols[0]) if out_cols else 0
+    order = np.arange(n)
+    if ret.order_by:
+        order = _order(ret, cols, out_cols, b, catalog, ctx)
+        out_cols = [c[order] for c in out_cols]
+    if ret.skip is not None:
+        k = int(_const_value(ret.skip, ctx))
+        out_cols = [c[k:] for c in out_cols]
+    if ret.limit is not None:
+        k = int(_const_value(ret.limit, ctx))
+        out_cols = [c[:k] for c in out_cols]
+
+    py_cols: List[List[Any]] = []
+    for col in out_cols:
+        lst = col.tolist()  # np scalars -> python natives in one pass
+        if lst and isinstance(lst[0], _NodeRef):
+            nodes = catalog.nodes()
+            lst = [nodes[v.row] for v in lst]
+        py_cols.append(lst)
+    rows = [list(t) for t in zip(*py_cols)] if py_cols else []
+    return CypherResult(columns=cols, rows=rows)
+
+
+class _NodeRef:
+    """Marker wrapping a global node row so projection can materialize the
+    Node object only for rows that survive ORDER BY/LIMIT."""
+
+    __slots__ = ("row",)
+
+    def __init__(self, row: int):
+        self.row = row
+
+
+def _out_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
+    if isinstance(e, A.Var):
+        if e.name in b.node_cols:
+            rows = b.node_cols[e.name]
+            out = np.empty(len(rows), dtype=object)
+            for i, r in enumerate(rows.tolist()):
+                out[i] = _NodeRef(r)
+            return out
+        _bail()
+    return _vec_col(e, b, catalog, ctx)
+
+
+def _codeable(col: np.ndarray, b: _Bindings, catalog) -> np.ndarray:
+    """Column usable as a grouping key (NodeRefs become row ints)."""
+    if len(col) and isinstance(col[0], _NodeRef):
+        return np.asarray([v.row for v in col.tolist()], dtype=np.int64)
+    return col
+
+
+def _first_occurrence(codes: np.ndarray) -> np.ndarray:
+    """Row index of the first occurrence of each group code, in
+    first-encounter order (matches the general path's insertion order)."""
+    n_groups = int(codes.max()) + 1 if len(codes) else 0
+    first = np.full(n_groups, np.iinfo(np.int64).max, dtype=np.int64)
+    np.minimum.at(first, codes, np.arange(len(codes), dtype=np.int64))
+    return np.sort(first)
+
+
+def _group_code_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
+    """Dense int64 group codes for one grouping-key expression.
+
+    Property keys are routed through the entity *row* column first
+    (vectorized int unique), then the small unique-row value table is
+    deduplicated by value — Cypher groups by value, and two entities can
+    share one — so the Python-level work is O(distinct entities), not
+    O(match rows)."""
+    from nornicdb_tpu.query.columnar import _unique_inverse
+
+    if isinstance(e, A.Prop) and isinstance(e.target, A.Var):
+        name = e.target.name
+        if name in b.node_cols:
+            rows = b.node_cols[name]
+            uniq_rows, inv = np.unique(rows, return_inverse=True)
+            vals = catalog.node_prop_col(e.name)[uniq_rows]
+        elif name in b.edge_cols:
+            table, erows = b.edge_cols[name]
+            uniq_rows, inv = np.unique(erows, return_inverse=True)
+            vals = table.prop_col(e.name)[uniq_rows]
+        else:
+            _bail()
+        _, vcodes = _unique_inverse(vals)
+        return vcodes[inv]
+    if isinstance(e, A.Var):
+        if e.name in b.node_cols:
+            _, inv = np.unique(b.node_cols[e.name], return_inverse=True)
+            return inv
+        if e.name in b.edge_cols:
+            _, inv = np.unique(b.edge_cols[e.name][1], return_inverse=True)
+            return inv
+        _bail()
+    # anything else: evaluate the value column and hash it
+    col = _vec_col(e, b, catalog, ctx)
+    _, codes = _unique_inverse(col)
+    return codes
+
+
+def _combine_codes(code_cols: List[np.ndarray]) -> np.ndarray:
+    combined = np.zeros(len(code_cols[0]), dtype=np.int64)
+    for c in code_cols:
+        combined = combined * (int(c.max()) + 1 if len(c) else 1) + c
+    _, codes = np.unique(combined, return_inverse=True)
+    return codes
+
+
+def _aggregate(catalog, ret: A.ReturnClause, b: _Bindings, ctx) -> List[np.ndarray]:
+    from nornicdb_tpu.query.executor import _contains_agg
+
+    group_items = [i for i in ret.items if not _contains_agg(i.expr)]
+    key_cols = [
+        _group_code_col(i.expr, b, catalog, ctx) for i in group_items
+    ]
+    if key_cols:
+        codes = _combine_codes(key_cols)
+        first = _first_occurrence(codes)
+        # remap codes so group ids follow first-encounter order (matches
+        # the general path's insertion-ordered groups); `first` is sorted,
+        # so codes[first] lists groups in encounter order.
+        rank = np.empty(len(first), dtype=np.int64)
+        rank[codes[first]] = np.arange(len(first))
+        codes = rank[codes]
+        n_groups = len(first)
+    else:
+        codes = np.zeros(b.n_rows, dtype=np.int64)
+        first = np.zeros(1, dtype=np.int64) if b.n_rows else np.empty(0, np.int64)
+        n_groups = 1  # global aggregation has exactly one output row
+
+    out: List[np.ndarray] = []
+    gi = 0
+    for item in ret.items:
+        if not _contains_agg(item.expr):
+            full = _out_col(item.expr, b, catalog, ctx)
+            out.append(full[first])
+            gi += 1
+        else:
+            out.append(_agg_expr(item.expr, b, catalog, ctx, codes, n_groups))
+    return out
+
+
+def _agg_expr(
+    e: A.Expr, b: _Bindings, catalog, ctx, codes: np.ndarray, n_groups: int
+) -> np.ndarray:
+    """Per-group value of an aggregate-bearing expression."""
+    if isinstance(e, A.FuncCall) and e.name in _AGG_NAMES:
+        return _agg_leaf(e, b, catalog, ctx, codes, n_groups)
+    if isinstance(e, A.Binary) and e.op in ("+", "-", "*", "/", "%"):
+        l = _agg_expr(e.left, b, catalog, ctx, codes, n_groups)
+        r = _agg_expr(e.right, b, catalog, ctx, codes, n_groups)
+        out = np.empty(n_groups, dtype=object)
+        for i in range(n_groups):
+            lv, rv = l[i], r[i]
+            if lv is None or rv is None:
+                out[i] = None
+                continue
+            if e.op == "+":
+                out[i] = lv + rv
+            elif e.op == "-":
+                out[i] = lv - rv
+            elif e.op == "*":
+                out[i] = lv * rv
+            elif e.op == "/":
+                if rv == 0:
+                    _bail()
+                if isinstance(lv, int) and isinstance(rv, int):
+                    q = lv // rv
+                    if q < 0 and lv % rv != 0:
+                        q += 1
+                    out[i] = q
+                else:
+                    out[i] = lv / rv
+            else:
+                if rv == 0:
+                    _bail()
+                mres = abs(lv) % abs(rv)
+                out[i] = mres if lv >= 0 else -mres
+        return out
+    if isinstance(e, (A.Literal, A.Param)):
+        v = _const_value(e, ctx)
+        out = np.empty(n_groups, dtype=object)
+        out[:] = v
+        return out
+    if isinstance(e, A.FuncCall) and e.name in ("tofloat", "tointeger"):
+        inner = _agg_expr(e.args[0], b, catalog, ctx, codes, n_groups)
+        out = np.empty(n_groups, dtype=object)
+        for i in range(n_groups):
+            v = inner[i]
+            if v is None:
+                out[i] = None
+            elif e.name == "tofloat":
+                out[i] = float(v)
+            else:
+                out[i] = int(v)
+        return out
+    if isinstance(e, A.FuncCall) and e.name == "round":
+        inner = _agg_expr(e.args[0], b, catalog, ctx, codes, n_groups)
+        out = np.empty(n_groups, dtype=object)
+        for i in range(n_groups):
+            v = inner[i]
+            out[i] = None if v is None else float(round(v))
+        return out
+    _bail()
+
+
+def _agg_leaf(
+    e: A.FuncCall, b: _Bindings, catalog, ctx, codes: np.ndarray, n_groups: int
+) -> np.ndarray:
+    name = e.name
+    if name == "count" and e.star:
+        cnt = np.bincount(codes, minlength=n_groups)[:n_groups]
+        out = np.empty(n_groups, dtype=object)
+        for i in range(n_groups):
+            out[i] = int(cnt[i])
+        return out
+    if not e.args:
+        _bail()
+    arg = e.args[0]
+    if isinstance(arg, A.Var) and arg.name in b.node_cols:
+        vals = b.node_cols[arg.name].astype(np.int64)
+        nonnull = np.ones(b.n_rows, dtype=bool)
+        values_obj = None
+    else:
+        values_obj = _vec_col(arg, b, catalog, ctx)
+        nonnull = np.array([x is not None for x in values_obj.tolist()], dtype=bool)
+        vals = None
+
+    if name == "count":
+        if e.distinct:
+            if vals is None:
+                from nornicdb_tpu.query.columnar import group_codes as _gc
+
+                vcodes, _ = _gc([values_obj])
+            else:
+                _, vcodes = np.unique(vals, return_inverse=True)
+            sel = nonnull
+            pair = codes[sel] * (int(vcodes.max()) + 1 if len(vcodes) else 1) + vcodes[sel]
+            uniq_pairs = np.unique(pair)
+            denom = int(vcodes.max()) + 1 if len(vcodes) else 1
+            grp = uniq_pairs // denom
+            cnt = np.bincount(grp, minlength=n_groups)[:n_groups]
+        else:
+            cnt = np.bincount(codes[nonnull], minlength=n_groups)[:n_groups]
+        out = np.empty(n_groups, dtype=object)
+        for i in range(n_groups):
+            out[i] = int(cnt[i])
+        return out
+
+    if values_obj is None:
+        _bail()
+
+    if name == "collect":
+        src = values_obj
+        sel = nonnull
+        if e.distinct:
+            from nornicdb_tpu.query.columnar import group_codes as _gc
+
+            vcodes, _ = _gc([values_obj])
+            seen = set()
+            keep = np.zeros(b.n_rows, dtype=bool)
+            for i in range(b.n_rows):
+                if not nonnull[i]:
+                    continue
+                key = (int(codes[i]), int(vcodes[i]))
+                if key in seen:
+                    continue
+                seen.add(key)
+                keep[i] = True
+            sel = keep
+        out = np.empty(n_groups, dtype=object)
+        for i in range(n_groups):
+            out[i] = []
+        idxs = np.nonzero(sel)[0]
+        for i in idxs.tolist():
+            out[codes[i]].append(values_obj[i])
+        return out
+
+    f = _as_float(values_obj)
+    if f is None:
+        if name in ("min", "max"):
+            # non-numeric min/max (e.g. strings): python per-group
+            out = np.empty(n_groups, dtype=object)
+            out[:] = None
+            for i in range(b.n_rows):
+                if not nonnull[i]:
+                    continue
+                g = codes[i]
+                v = values_obj[i]
+                try:
+                    if out[g] is None or (
+                        v < out[g] if name == "min" else v > out[g]
+                    ):
+                        out[g] = v
+                except TypeError:
+                    _bail()
+            return out
+        _bail()
+    fvals, fmask = f
+    if e.distinct:
+        _bail()
+    safe = np.where(fmask, fvals, 0.0)
+    cnt = np.bincount(codes[fmask], minlength=n_groups)[:n_groups]
+    if name == "sum":
+        s = np.bincount(codes, weights=safe, minlength=n_groups)[:n_groups]
+        out = np.empty(n_groups, dtype=object)
+        all_int = all(
+            isinstance(x, int) and not isinstance(x, bool)
+            for x in values_obj.tolist()
+            if x is not None
+        )
+        for i in range(n_groups):
+            out[i] = int(s[i]) if all_int else float(s[i])
+        return out
+    if name == "avg":
+        s = np.bincount(codes, weights=safe, minlength=n_groups)[:n_groups]
+        out = np.empty(n_groups, dtype=object)
+        for i in range(n_groups):
+            out[i] = float(s[i] / cnt[i]) if cnt[i] else None
+        return out
+    if name in ("min", "max"):
+        init = np.inf if name == "min" else -np.inf
+        acc = np.full(n_groups, init, dtype=np.float64)
+        op = np.minimum if name == "min" else np.maximum
+        op.at(acc, codes[fmask], fvals[fmask])
+        out = np.empty(n_groups, dtype=object)
+        all_int = all(
+            isinstance(x, int) and not isinstance(x, bool)
+            for x in values_obj.tolist()
+            if x is not None
+        )
+        for i in range(n_groups):
+            if cnt[i] == 0:
+                out[i] = None
+            else:
+                out[i] = int(acc[i]) if all_int else float(acc[i])
+        return out
+    _bail()
+
+
+def _order(ret, cols, out_cols, b, catalog, ctx) -> np.ndarray:
+    """Row order for ORDER BY over the projected columns."""
+    n = len(out_cols[0]) if out_cols else 0
+    keys: List[Tuple[np.ndarray, bool]] = []
+    for expr, desc in ret.order_by:
+        col = _order_key(expr, ret, cols, out_cols, b, catalog, ctx)
+        keys.append((col, desc))
+    # numeric lane: all keys float-able -> lexsort
+    float_keys = []
+    ok = True
+    for col, desc in keys:
+        f = _as_float(col) if col.dtype == object else (
+            col.astype(np.float64), np.ones(len(col), bool)
+        )
+        if f is None:
+            ok = False
+            break
+        vals, mask = f
+        # Neo4j treats null as the largest value: last in ASC, first in
+        # DESC (general path _cypher_cmp returns 1 for None) — so map
+        # null to +inf BEFORE the DESC negation.
+        vals = np.where(mask, vals, np.inf)
+        float_keys.append(-vals if desc else vals)
+    if ok and float_keys:
+        order = np.lexsort(list(reversed(float_keys)))
+        return order
+    # general: stable python sort
+    from nornicdb_tpu.query.executor import _cypher_cmp
+    import functools
+
+    idx = list(range(n))
+
+    def cmp(a: int, bidx: int) -> int:
+        for col, desc in keys:
+            va = col[a]
+            vb = col[bidx]
+            if isinstance(va, _NodeRef) or isinstance(vb, _NodeRef):
+                _bail()
+            c = _cypher_cmp(va, vb)
+            if c != 0:
+                return -c if desc else c
+        return 0
+
+    idx.sort(key=functools.cmp_to_key(cmp))
+    return np.asarray(idx, dtype=np.int64)
+
+
+def _order_key(expr, ret, cols, out_cols, b, catalog, ctx) -> np.ndarray:
+    # 1. ORDER BY <alias or column name>
+    if isinstance(expr, A.Var) and expr.name in cols:
+        return out_cols[cols.index(expr.name)]
+    # 2. ORDER BY <projected expression> (AST equality via dataclass eq)
+    for i, item in enumerate(ret.items):
+        if item.expr == expr:
+            return out_cols[i]
+    # 3. non-agg queries: any vectorizable expression over bindings
+    from nornicdb_tpu.query.executor import _contains_agg
+
+    if not any(_contains_agg(i.expr) for i in ret.items):
+        return _vec_col(expr, b, catalog, ctx)
+    _bail()

@@ -1,0 +1,225 @@
+"""Fastpath-vs-general parity corpus.
+
+The reference's fast-path executors are held to the general executor's
+semantics by a large regression corpus (pkg/cypher/*_test.go, SURVEY §4
+"parity tests between fast-path and general executors"). Same contract
+here: every query in the corpus runs once with fast paths enabled and
+once with them disabled; results must match exactly (up to row order
+when the query imposes none).
+"""
+
+import random
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+def _sorted_rows(result):
+    return sorted([repr(r) for r in result.rows])
+
+
+@pytest.fixture(scope="module")
+def graph():
+    """LDBC-SNB-shaped social graph + Northwind-shaped product graph."""
+    eng = NamespacedEngine(MemoryEngine(), "test")
+    ex = CypherExecutor(eng)
+    ex.enable_query_cache = False
+    rng = random.Random(7)
+
+    cities = ["Oslo", "Bergen", "Pune", "Kyoto", "Quito"]
+    for c in cities:
+        ex.execute(f"CREATE (:City {{name: '{c}'}})")
+    n_people = 60
+    for i in range(n_people):
+        ex.execute(
+            "CREATE (:Person {id: $id, name: $name, age: $age})",
+            {"id": i, "name": f"p{i}", "age": 18 + (i * 7) % 50},
+        )
+    for i in range(n_people):
+        city = cities[i % len(cities)]
+        ex.execute(
+            "MATCH (p:Person {id: $id}), (c:City {name: $c}) "
+            "CREATE (p)-[:IS_LOCATED_IN]->(c)",
+            {"id": i, "c": city},
+        )
+        for j in rng.sample(range(n_people), 5):
+            if j != i:
+                ex.execute(
+                    "MATCH (a:Person {id: $a}), (b:Person {id: $b}) "
+                    "CREATE (a)-[:KNOWS]->(b)",
+                    {"a": i, "b": j},
+                )
+    tags = ["ai", "tpu", "graphs", "jax", "music"]
+    for t in tags:
+        ex.execute("CREATE (:Tag {name: $t})", {"t": t})
+    for m in range(120):
+        creator = rng.randrange(n_people)
+        ex.execute(
+            "MATCH (p:Person {id: $pid}) "
+            "CREATE (msg:Message {id: $mid, content: $content, "
+            "creationDate: $ts, length: $ln})-[:HAS_CREATOR]->(p)",
+            {
+                "pid": creator, "mid": 1000 + m,
+                "content": f"message {m}", "ts": 1700000000 + m * 37,
+                "ln": 10 + m % 90,
+            },
+        )
+        for t in rng.sample(tags, rng.randrange(1, 4)):
+            ex.execute(
+                "MATCH (m:Message {id: $mid}), (t:Tag {name: $t}) "
+                "CREATE (m)-[:HAS_TAG]->(t)",
+                {"mid": 1000 + m, "t": t},
+            )
+    # Northwind-ish
+    for s in range(6):
+        ex.execute("CREATE (:Supplier {id: $i, companyName: $n})",
+                   {"i": s, "n": f"supplier{s}"})
+    for c in range(4):
+        ex.execute("CREATE (:Category {id: $i, categoryName: $n})",
+                   {"i": c, "n": f"cat{c}"})
+    for p in range(40):
+        ex.execute("CREATE (:Product {id: $i, productName: $n, unitPrice: $u})",
+                   {"i": p, "n": f"product{p}", "u": round(1.5 + p * 0.75, 2)})
+        ex.execute(
+            "MATCH (s:Supplier {id: $s}), (p:Product {id: $p}) "
+            "CREATE (s)-[:SUPPLIES]->(p)",
+            {"s": p % 6, "p": p},
+        )
+        ex.execute(
+            "MATCH (p:Product {id: $p}), (c:Category {id: $c}) "
+            "CREATE (p)-[:PART_OF]->(c)",
+            {"p": p, "c": p % 4},
+        )
+    for o in range(80):
+        ex.execute("CREATE (:Order {id: $i, shipCity: $c})",
+                   {"i": o, "c": cities[o % 5]})
+        for p in rng.sample(range(40), 3):
+            ex.execute(
+                "MATCH (o:Order {id: $o}), (p:Product {id: $p}) "
+                "CREATE (o)-[:ORDERS {quantity: $q, unitPrice: $u}]->(p)",
+                {"o": o, "p": p, "q": rng.randrange(1, 20),
+                 "u": round(1.5 + p * 0.75, 2)},
+            )
+    ex.invalidate_caches()
+    return eng
+
+
+CORPUS = [
+    # LDBC message content lookup (BASELINE row 1)
+    ("MATCH (m:Message {id: $mid}) RETURN m.content", {"mid": 1042}, False),
+    ("MATCH (m:Message {id: $mid}) RETURN m.content, m.creationDate",
+     {"mid": 1007}, False),
+    # LDBC recent messages of friends (BASELINE row 2)
+    ("MATCH (p:Person {id: $pid})-[:KNOWS]->(f:Person)"
+     "<-[:HAS_CREATOR]-(m:Message) "
+     "RETURN f.name, m.content, m.creationDate "
+     "ORDER BY m.creationDate DESC LIMIT 10", {"pid": 3}, True),
+    # LDBC avg friends per city (BASELINE row 3)
+    ("MATCH (c:City)<-[:IS_LOCATED_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+     "RETURN c.name, count(f), count(DISTINCT p)", {}, False),
+    ("MATCH (c:City)<-[:IS_LOCATED_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+     "RETURN c.name, count(f) / count(DISTINCT p) AS avgFriends", {}, False),
+    # LDBC tag co-occurrence (BASELINE row 4)
+    ("MATCH (t1:Tag)<-[:HAS_TAG]-(m:Message)-[:HAS_TAG]->(t2:Tag) "
+     "WHERE t1 <> t2 RETURN t1.name, t2.name, count(m) AS freq", {}, False),
+    # Northwind supplier/category counts (optimized_executors.go:138)
+    ("MATCH (s:Supplier)-[:SUPPLIES]->(p:Product)-[:PART_OF]->(c:Category) "
+     "RETURN s.companyName, c.categoryName, count(p)", {}, False),
+    # Northwind revenue by product (match_with_rel_fast.go:10)
+    ("MATCH (o:Order)-[r:ORDERS]->(p:Product) "
+     "RETURN p.productName, sum(r.quantity * r.unitPrice) AS revenue", {},
+     False),
+    ("MATCH (o:Order)-[r:ORDERS]->(p:Product) "
+     "RETURN p.productName, sum(r.quantity * r.unitPrice) AS revenue "
+     "ORDER BY revenue DESC LIMIT 5", {}, True),
+    # filters
+    ("MATCH (p:Person) WHERE p.age > 40 RETURN p.name, p.age", {}, False),
+    ("MATCH (p:Person) WHERE p.age >= 20 AND p.age <= 30 "
+     "RETURN p.name ORDER BY p.name", {}, True),
+    ("MATCH (m:Message) WHERE m.length < 30 RETURN count(m)", {}, False),
+    ("MATCH (m:Message) WHERE m.content CONTAINS '7' RETURN m.content",
+     {}, False),
+    ("MATCH (p:Person) WHERE p.name STARTS WITH 'p1' RETURN p.name", {},
+     False),
+    ("MATCH (p:Person) WHERE p.id IN [1, 2, 3, 999] RETURN p.name", {},
+     False),
+    # aggregation variants
+    ("MATCH (p:Person) RETURN min(p.age), max(p.age), avg(p.age), "
+     "sum(p.age), count(*)", {}, False),
+    ("MATCH (p:Person)-[:IS_LOCATED_IN]->(c:City) "
+     "RETURN c.name, collect(p.name)[0..3]", {}, False),
+    ("MATCH (o:Order) RETURN o.shipCity, count(*) AS n ORDER BY n DESC, "
+     "o.shipCity", {}, True),
+    # distinct
+    ("MATCH (p:Person)-[:IS_LOCATED_IN]->(c:City) "
+     "RETURN DISTINCT c.name", {}, False),
+    # projection of nodes
+    ("MATCH (t:Tag) RETURN t ORDER BY t.name", {}, True),
+    # skip/limit without order (row count only)
+    ("MATCH (p:Person) RETURN p.name ORDER BY p.name SKIP 5 LIMIT 10",
+     {}, True),
+    # var inequality + grouped agg over 3-hop
+    ("MATCH (s:Supplier)-[:SUPPLIES]->(p:Product)-[:PART_OF]->(c:Category) "
+     "WHERE p.unitPrice > 10 RETURN c.categoryName, count(DISTINCT s)",
+     {}, False),
+    # reverse direction chain
+    ("MATCH (c:Category)<-[:PART_OF]-(p:Product)<-[:SUPPLIES]-(s:Supplier) "
+     "RETURN c.categoryName, count(p)", {}, False),
+    # same-type twice (edge uniqueness)
+    ("MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Person) "
+     "RETURN count(*)", {}, False),
+]
+
+
+@pytest.mark.parametrize("query,params,ordered", CORPUS)
+def test_parity(graph, query, params, ordered):
+    fast_ex = CypherExecutor(graph)
+    fast_ex.enable_query_cache = False
+    slow_ex = CypherExecutor(graph)
+    slow_ex.enable_query_cache = False
+    slow_ex.enable_fastpaths = False
+
+    fast = fast_ex.execute(query, params)
+    slow = slow_ex.execute(query, params)
+    assert fast.columns == slow.columns
+    if ordered:
+        assert [repr(r) for r in fast.rows] == [repr(r) for r in slow.rows]
+    else:
+        assert _sorted_rows(fast) == _sorted_rows(slow)
+
+
+def test_fastpath_actually_triggers(graph):
+    """Guard against silently falling back to the general path for the
+    flagship shapes (the corpus above would still pass)."""
+    from nornicdb_tpu.query import fastpaths
+    from nornicdb_tpu.query.parser import parse
+
+    ex = CypherExecutor(graph)
+    ex.enable_query_cache = False
+
+    class _Ctx:
+        storage = graph
+        params = {"mid": 1042, "pid": 3}
+
+    for query in [CORPUS[0][0], CORPUS[2][0], CORPUS[5][0], CORPUS[7][0]]:
+        uq = parse(query)
+        r = fastpaths.try_fast_path(ex, uq.parts[0], _Ctx())
+        assert r is not None, f"fast path did not engage for: {query}"
+
+
+def test_cache_hit_and_write_invalidation(graph):
+    """Read-cache probe + write invalidation (reference executor.go:634)."""
+    eng = NamespacedEngine(MemoryEngine(), "test")
+    ex = CypherExecutor(eng)
+    ex.execute("CREATE (:X {v: 1})")
+    r1 = ex.execute("MATCH (x:X) RETURN x.v")
+    h0 = ex.query_cache.hits
+    r2 = ex.execute("MATCH (x:X) RETURN x.v")
+    assert ex.query_cache.hits == h0 + 1
+    assert r1.rows == r2.rows
+    # a write must invalidate
+    ex.execute("MATCH (x:X) SET x.v = 2")
+    r3 = ex.execute("MATCH (x:X) RETURN x.v")
+    assert r3.rows == [[2]]
