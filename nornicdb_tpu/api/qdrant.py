@@ -43,13 +43,31 @@ def _point_node_id(collection: str, point_id: Any) -> str:
 class QdrantCompat:
     """Collection + point operations with Qdrant semantics."""
 
-    def __init__(self, storage):
+    def __init__(self, storage, vector_registry=None):
+        from nornicdb_tpu.vectorspace import VectorSpaceRegistry
+
         self.storage = storage
-        self._indexes: Dict[str, BruteForceIndex] = {}
+        # per-collection indexes live in registered vector spaces keyed
+        # (db="qdrant", entity_type=collection) — reference:
+        # pkg/vectorspace/registry.go + vector_index_cache.go
+        self.vector_registry = vector_registry or VectorSpaceRegistry()
         # raw (unnormalized) vectors for Dot/Euclid collections:
         # name -> (ids, [N,D] matrix); invalidated on any point mutation
         self._raw: Dict[str, Any] = {}
         self._lock = threading.Lock()
+
+    def _space_key(self, name: str):
+        from nornicdb_tpu.vectorspace import DEFAULT_VECTOR_NAME, SpaceKey
+
+        # dims intentionally 0 in the key: the collection's vector size
+        # lives in its meta config, and a fixed key keeps lookups O(1)
+        return SpaceKey(database="qdrant", entity_type=name,
+                        vector_name=DEFAULT_VECTOR_NAME, dims=0,
+                        metric="cosine")
+
+    def _space(self, name: str):
+        return self.vector_registry.register(self._space_key(name),
+                                             backend="brute")
 
     # -- collections -----------------------------------------------------
 
@@ -74,7 +92,7 @@ class QdrantCompat:
                         "created_at": now_ms()},
         ))
         with self._lock:
-            self._indexes[name] = BruteForceIndex()
+            self._space(name).ensure_index()
         return True
 
     def delete_collection(self, name: str) -> bool:
@@ -85,7 +103,7 @@ class QdrantCompat:
             self.storage.delete_node(node.id)
         self.storage.delete_node(meta_id)
         with self._lock:
-            self._indexes.pop(name, None)
+            self.vector_registry.drop(self._space_key(name))
             self._raw.pop(name, None)
         return True
 
@@ -122,9 +140,9 @@ class QdrantCompat:
 
     def _index(self, name: str) -> BruteForceIndex:
         with self._lock:
-            idx = self._indexes.get(name)
-            if idx is not None:
-                return idx
+            space = self.vector_registry.get(self._space_key(name))
+            if space is not None and space.index is not None:
+                return space.index
         # lazy rebuild from storage (post-restart)
         self._meta(name)  # raises if collection doesn't exist
         idx = BruteForceIndex()
@@ -133,7 +151,10 @@ class QdrantCompat:
             if vec:
                 idx.add(node.id, vec)
         with self._lock:
-            return self._indexes.setdefault(name, idx)
+            space = self._space(name)
+            if space.index is None:
+                space.index = idx
+            return space.index
 
     # -- points ----------------------------------------------------------
 

@@ -39,6 +39,7 @@ class DB:
         auto_embed: bool = True,
         engine: str = "auto",  # auto | native | python | memory
         replication: Optional[Any] = None,  # ReplicationConfig
+        passphrase: Optional[str] = None,  # at-rest AES-256-GCM encryption
     ):
         # engine chain: Disk/Durable/Memory -> [Async] -> Namespaced ->
         # Listenable (reference chain order: db.go:742-947; the listener
@@ -48,16 +49,28 @@ class DB:
         if engine in ("native", "python") and not data_dir:
             raise ValueError(f"engine={engine!r} requires data_dir")
         if data_dir and engine != "memory":
+            # at-rest encryption: PBKDF2-derived key + salt file in the
+            # data dir (reference: db.go:776-805 DeriveKey + salt)
+            from nornicdb_tpu.encryption import make_encryptor
+
+            encryptor = make_encryptor(passphrase, data_dir)
             if engine == "python":
-                base: Engine = DurableEngine(data_dir, sync_every_write=sync_every_write)
+                base: Engine = DurableEngine(
+                    data_dir, sync_every_write=sync_every_write,
+                    encryptor=encryptor,
+                )
             elif engine == "native":
                 from nornicdb_tpu.storage.disk import DiskEngine
 
-                base = DiskEngine(data_dir, sync_every_write=sync_every_write)
+                base = DiskEngine(data_dir, sync_every_write=sync_every_write,
+                                  encryptor=encryptor)
             else:
                 from nornicdb_tpu.storage import make_persistent_engine
 
-                base = make_persistent_engine(data_dir, sync_every_write=sync_every_write)
+                base = make_persistent_engine(
+                    data_dir, sync_every_write=sync_every_write,
+                    encryptor=encryptor,
+                )
         else:
             base = MemoryEngine()
         self._base = base

@@ -106,9 +106,11 @@ def hybrid_predict(
     node_id: str,
     topology_weight: float = 0.5,
     limit: int = 10,
-) -> List[Tuple[str, float]]:
+) -> List[Tuple[str, float, float, float]]:
     """Blend topology score with embedding similarity
-    (reference: hybrid.go)."""
+    (reference: hybrid.go). Returns (node_id, blended_score,
+    topology_score, semantic_score) so callers can decompose the blend:
+    blended == w*topology + (1-w)*semantic exactly."""
     topo = dict(predict_links(storage, node_id, limit=limit * 3))
     emb: Dict[str, float] = {}
     try:
@@ -123,10 +125,10 @@ def hybrid_predict(
                 emb[nid] = max(score, 0.0)
     # normalize topology scores to [0, 1]
     tmax = max(topo.values(), default=1.0) or 1.0
-    out: Dict[str, float] = {}
+    out: Dict[str, Tuple[float, float, float]] = {}
     for nid in set(topo) | set(emb):
         t = topo.get(nid, 0.0) / tmax
         s = emb.get(nid, 0.0)
-        out[nid] = topology_weight * t + (1.0 - topology_weight) * s
-    ranked = sorted(out.items(), key=lambda kv: (-kv[1], kv[0]))
-    return ranked[:limit]
+        out[nid] = (topology_weight * t + (1.0 - topology_weight) * s, t, s)
+    ranked = sorted(out.items(), key=lambda kv: (-kv[1][0], kv[0]))
+    return [(nid, sc, t, s) for nid, (sc, t, s) in ranked[:limit]]

@@ -101,6 +101,54 @@ def run_procedure(
             yield {"node": node, "score": float(score)}
         return
 
+    if name.startswith("gds.linkprediction."):
+        # Neo4j GDS link-prediction procedures (reference:
+        # pkg/cypher/linkprediction.go:1-559 — always available, result
+        # format {node1, node2, score}; hybrid predict adds
+        # topology_score/semantic_score).
+        from nornicdb_tpu.linkpredict import hybrid_predict, predict_links
+
+        method_map = {
+            "gds.linkprediction.adamicadar.stream": "adamic_adar",
+            "gds.linkprediction.commonneighbors.stream": "common_neighbors",
+            "gds.linkprediction.jaccard.stream": "jaccard",
+            "gds.linkprediction.preferentialattachment.stream":
+                "preferential_attachment",
+            "gds.linkprediction.resourceallocation.stream":
+                "resource_allocation",
+        }
+        cfg = args[0] if args else {}
+        if not isinstance(cfg, dict):
+            raise CypherRuntimeError(
+                "gds.linkPrediction.*.stream expects a configuration map "
+                "{sourceNode, topK}"
+            )
+        source = cfg.get("sourceNode") or cfg.get("sourcenode")
+        if source is None:
+            raise CypherRuntimeError("configuration requires sourceNode")
+        source_id = source.id if hasattr(source, "id") else str(source)
+        top_k = int(cfg.get("topK", cfg.get("topk", 10)))
+        if name in method_map:
+            for nid, score in predict_links(
+                storage, source_id, method=method_map[name], limit=top_k
+            ):
+                yield {"node1": source_id, "node2": nid, "score": float(score)}
+            return
+        if name == "gds.linkprediction.predict.stream":
+            weight = float(cfg.get("topologyWeight",
+                                   cfg.get("topologyweight", 0.5)))
+            for nid, score, topo, sem in hybrid_predict(
+                storage, executor._search, source_id,
+                topology_weight=weight, limit=top_k,
+            ):
+                yield {
+                    "node1": source_id, "node2": nid, "score": float(score),
+                    "topology_score": float(topo),
+                    "semantic_score": float(sem),
+                }
+            return
+        raise CypherRuntimeError(f"unknown procedure {name}")
+
     if name.startswith("apoc."):
         from nornicdb_tpu.query.apoc import run_apoc_procedure
 

@@ -78,6 +78,8 @@ class SearchService:
         hnsw_m: int = 16,
         hnsw_ef_search: int = 64,
         reranker: Optional[Any] = None,
+        database: str = "neo4j",
+        vector_registry: Optional[Any] = None,
     ):
         self.storage = storage
         self.embedder = embedder
@@ -85,7 +87,19 @@ class SearchService:
         self.hnsw_threshold = hnsw_threshold
         self._lock = threading.RLock()
         self.bm25 = BM25Index()
-        self.vectors = BruteForceIndex()
+        # the document vector index lives in a registered vector space
+        # (reference: pkg/vectorspace/registry.go keyed spaces; the
+        # service's default doc space is (db, "node", "embedding"))
+        from nornicdb_tpu.vectorspace import VectorSpaceRegistry
+
+        self.database = database
+        # per-service registry unless the caller shares one (multidb
+        # passes a shared registry so spaces are keyed per database)
+        self.vector_registry = vector_registry or VectorSpaceRegistry()
+        self._doc_space = self.vector_registry.get_or_create(
+            database=database, entity_type="node", backend="brute"
+        )
+        self.vectors = self._doc_space.ensure_index()
         self.hnsw: Optional[HNSWIndex] = None
         self._hnsw_m = hnsw_m
         self._hnsw_ef = hnsw_ef_search
@@ -164,6 +178,13 @@ class SearchService:
         idx = HNSWIndex(m=self._hnsw_m, ef_search=self._hnsw_ef)
         idx.build(items, seed_ids=seeds)
         self.hnsw = idx
+        # surface the graph index as its own vector space (reference:
+        # backend kinds auto/brute-force/hnsw, registry.go:1-60)
+        hnsw_space = self.vector_registry.get_or_create(
+            database=self.database, entity_type="node",
+            vector_name="embedding_hnsw", backend="hnsw",
+        )
+        hnsw_space.index = idx
         self.stats.hnsw_builds += 1
         self.stats.strategy = "hnsw"
 

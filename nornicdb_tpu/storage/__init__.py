@@ -32,7 +32,8 @@ from nornicdb_tpu.storage.schema import (  # noqa: F401
 )
 
 
-def make_persistent_engine(data_dir: str, sync_every_write: bool = False):
+def make_persistent_engine(data_dir: str, sync_every_write: bool = False,
+                           encryptor=None):
     """Best persistent base engine available, honoring whatever format is
     already on disk: a dir with WAL/snapshot files reopens as the
     pure-Python DurableEngine, a dir with a native kv/ store reopens as
@@ -54,16 +55,21 @@ def make_persistent_engine(data_dir: str, sync_every_write: bool = False):
             "engine='python' or engine='native'"
         )
     if has_python_format:
-        return DurableEngine(data_dir, sync_every_write=sync_every_write)
+        return DurableEngine(data_dir, sync_every_write=sync_every_write,
+                             encryptor=encryptor)
     if has_native_format:
         from nornicdb_tpu.storage.disk import DiskEngine
 
-        return DiskEngine(data_dir, sync_every_write=sync_every_write)
+        return DiskEngine(data_dir, sync_every_write=sync_every_write,
+                          encryptor=encryptor)
     # fresh directory: pick native if buildable, else pure Python
     try:
         from nornicdb_tpu.storage.disk import DiskEngine, native_available
     except ImportError:
-        return DurableEngine(data_dir, sync_every_write=sync_every_write)
+        return DurableEngine(data_dir, sync_every_write=sync_every_write,
+                             encryptor=encryptor)
     if native_available():
-        return DiskEngine(data_dir, sync_every_write=sync_every_write)
-    return DurableEngine(data_dir, sync_every_write=sync_every_write)
+        return DiskEngine(data_dir, sync_every_write=sync_every_write,
+                          encryptor=encryptor)
+    return DurableEngine(data_dir, sync_every_write=sync_every_write,
+                         encryptor=encryptor)

@@ -28,6 +28,7 @@ import msgpack
 from nornicdb_tpu.storage.types import Direction, Edge, EdgeID, Engine, Node, NodeID, now_ms
 
 _SEP = b"\x00"
+_ENC_MAGIC = b"NKE1"
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -199,7 +200,7 @@ class DiskEngine(Engine):
     """
 
     def __init__(self, data_dir: str, sync_every_write: bool = False,
-                 auto_compact: bool = True):
+                 auto_compact: bool = True, encryptor=None):
         import glob
 
         # refuse to create a native store beside pure-Python DurableEngine
@@ -215,6 +216,8 @@ class DiskEngine(Engine):
             )
         self.kv = DiskKV(os.path.join(data_dir, "kv"), sync_every_write=sync_every_write)
         self.auto_compact = auto_compact
+        self._enc = encryptor
+        self._verify_encryption_state()
         self._lock = threading.Lock()  # serializes multi-key mutations
 
     # -- helpers --------------------------------------------------------
@@ -239,6 +242,62 @@ class DiskEngine(Engine):
     def _ak(node_id: str, direction: bytes, edge_id: str) -> bytes:
         return b"a:" + node_id.encode() + _SEP + direction + _SEP + edge_id.encode()
 
+    _ENC_SENTINEL_KEY = b"\x00meta:enc"
+
+    def _verify_encryption_state(self) -> None:
+        """Fail at open on passphrase mismatch, BEFORE any write could mix
+        records under different keys. A sentinel record is written on the
+        first encrypted open; later opens must decrypt it."""
+        from nornicdb_tpu.encryption import EncryptionError
+
+        raw = self.kv.get(self._ENC_SENTINEL_KEY)
+        if raw is not None:
+            if raw[: len(_ENC_MAGIC)] == _ENC_MAGIC and self._enc is None:
+                self.kv.close()
+                raise EncryptionError(
+                    "store is encrypted; open with the passphrase"
+                )
+            try:
+                self._unpack(raw)  # raises EncryptionError on wrong key
+            except EncryptionError:
+                self.kv.close()
+                raise
+        elif self._enc is not None:
+            if self.kv.count() > 0:
+                self.kv.close()
+                raise EncryptionError(
+                    "store exists unencrypted; open without a passphrase "
+                    "(or export/re-import to encrypt)"
+                )
+            self.kv.put(self._ENC_SENTINEL_KEY, self._pack({"enc": True}))
+
+    def _pack(self, d) -> bytes:
+        """Serialize a record, AES-256-GCM-wrapped when the store was
+        opened with a passphrase (reference: at-rest encryption wired into
+        the storage engine, db.go:776-805).
+
+        Scope: record VALUES (node/edge documents) are encrypted; the KV
+        index keys (ids, labels, edge types) stay plaintext because the
+        engine's prefix scans depend on them. For full-record-at-rest
+        (including identifiers) use engine="python", whose WAL+snapshot
+        payloads are encrypted whole; for sensitive property values use
+        field-level encryption (encryption.Encryptor.encrypt_field)."""
+        raw = msgpack.packb(d, use_bin_type=True)
+        if self._enc is not None:
+            raw = _ENC_MAGIC + self._enc.encrypt(raw)
+        return raw
+
+    def _unpack(self, raw: bytes):
+        if raw[: len(_ENC_MAGIC)] == _ENC_MAGIC:
+            if self._enc is None:
+                from nornicdb_tpu.encryption import EncryptionError
+
+                raise EncryptionError(
+                    "store is encrypted; open with the passphrase"
+                )
+            raw = self._enc.decrypt(raw[len(_ENC_MAGIC):])
+        return msgpack.unpackb(raw, raw=False)
+
     def _maybe_compact(self) -> None:
         if not self.auto_compact:
             return
@@ -257,7 +316,7 @@ class DiskEngine(Engine):
             ts = now_ms()
             n.created_at = n.created_at or ts
             n.updated_at = ts
-            self.kv.put(key, msgpack.packb(n.to_dict(), use_bin_type=True))
+            self.kv.put(key, self._pack(n.to_dict()))
             for label in n.labels:
                 self.kv.put(self._lk(label, n.id), b"")
 
@@ -265,14 +324,14 @@ class DiskEngine(Engine):
         raw = self.kv.get(self._nk(node_id))
         if raw is None:
             raise KeyError(node_id)
-        return Node.from_dict(msgpack.unpackb(raw, raw=False))
+        return Node.from_dict(self._unpack(raw))
 
     def update_node(self, node: Node) -> None:
         with self._lock:
             raw = self.kv.get(self._nk(node.id))
             if raw is None:
                 raise KeyError(node.id)
-            old = Node.from_dict(msgpack.unpackb(raw, raw=False))
+            old = Node.from_dict(self._unpack(raw))
             n = node.copy()
             n.created_at = old.created_at
             n.updated_at = now_ms()
@@ -280,7 +339,7 @@ class DiskEngine(Engine):
                 self.kv.delete(self._lk(label, n.id))
             for label in set(n.labels) - set(old.labels):
                 self.kv.put(self._lk(label, n.id), b"")
-            self.kv.put(self._nk(n.id), msgpack.packb(n.to_dict(), use_bin_type=True))
+            self.kv.put(self._nk(n.id), self._pack(n.to_dict()))
         self._maybe_compact()
 
     def delete_node(self, node_id: NodeID) -> None:
@@ -288,7 +347,7 @@ class DiskEngine(Engine):
             raw = self.kv.get(self._nk(node_id))
             if raw is None:
                 raise KeyError(node_id)
-            node = Node.from_dict(msgpack.unpackb(raw, raw=False))
+            node = Node.from_dict(self._unpack(raw))
             for eid in [e.id for e in self._node_edges_locked(node_id, Direction.BOTH)]:
                 self._delete_edge_locked(eid)
             for label in node.labels:
@@ -303,13 +362,13 @@ class DiskEngine(Engine):
 
     def all_nodes(self) -> Iterable[Node]:
         for _, raw in self.kv.scan(b"n:"):
-            yield Node.from_dict(msgpack.unpackb(raw, raw=False))
+            yield Node.from_dict(self._unpack(raw))
 
     def batch_get_nodes(self, node_ids: Sequence[NodeID]) -> List[Optional[Node]]:
         out: List[Optional[Node]] = []
         for nid in node_ids:
             raw = self.kv.get(self._nk(nid))
-            out.append(None if raw is None else Node.from_dict(msgpack.unpackb(raw, raw=False)))
+            out.append(None if raw is None else Node.from_dict(self._unpack(raw)))
         return out
 
     def has_node(self, node_id: NodeID) -> bool:
@@ -330,7 +389,7 @@ class DiskEngine(Engine):
             ts = now_ms()
             e.created_at = e.created_at or ts
             e.updated_at = ts
-            self.kv.put(key, msgpack.packb(e.to_dict(), use_bin_type=True))
+            self.kv.put(key, self._pack(e.to_dict()))
             self.kv.put(self._tk(e.type, e.id), b"")
             self.kv.put(self._ak(e.start_node, b"o", e.id), b"")
             self.kv.put(self._ak(e.end_node, b"i", e.id), b"")
@@ -339,28 +398,28 @@ class DiskEngine(Engine):
         raw = self.kv.get(self._ek(edge_id))
         if raw is None:
             raise KeyError(edge_id)
-        return Edge.from_dict(msgpack.unpackb(raw, raw=False))
+        return Edge.from_dict(self._unpack(raw))
 
     def update_edge(self, edge: Edge) -> None:
         with self._lock:
             raw = self.kv.get(self._ek(edge.id))
             if raw is None:
                 raise KeyError(edge.id)
-            old = Edge.from_dict(msgpack.unpackb(raw, raw=False))
+            old = Edge.from_dict(self._unpack(raw))
             e = edge.copy()
             e.created_at = old.created_at
             e.updated_at = now_ms()
             # endpoints/type are immutable in the reference; enforce the
             # same semantics as MemoryEngine so engine choice is invisible
             e.start_node, e.end_node, e.type = old.start_node, old.end_node, old.type
-            self.kv.put(self._ek(e.id), msgpack.packb(e.to_dict(), use_bin_type=True))
+            self.kv.put(self._ek(e.id), self._pack(e.to_dict()))
         self._maybe_compact()
 
     def _delete_edge_locked(self, edge_id: EdgeID) -> None:
         raw = self.kv.get(self._ek(edge_id))
         if raw is None:
             raise KeyError(edge_id)
-        edge = Edge.from_dict(msgpack.unpackb(raw, raw=False))
+        edge = Edge.from_dict(self._unpack(raw))
         self.kv.delete(self._tk(edge.type, edge_id))
         self.kv.delete(self._ak(edge.start_node, b"o", edge_id))
         self.kv.delete(self._ak(edge.end_node, b"i", edge_id))
@@ -377,12 +436,12 @@ class DiskEngine(Engine):
         for k, _ in self.kv.scan(prefix):
             raw = self.kv.get(self._ek(k[len(prefix):].decode()))
             if raw is not None:
-                out.append(Edge.from_dict(msgpack.unpackb(raw, raw=False)))
+                out.append(Edge.from_dict(self._unpack(raw)))
         return out
 
     def all_edges(self) -> Iterable[Edge]:
         for _, raw in self.kv.scan(b"e:"):
-            yield Edge.from_dict(msgpack.unpackb(raw, raw=False))
+            yield Edge.from_dict(self._unpack(raw))
 
     def _node_edges_locked(self, node_id: NodeID, direction: str) -> List[Edge]:
         dirs = []
@@ -401,7 +460,7 @@ class DiskEngine(Engine):
                 seen.add(eid)
                 raw = self.kv.get(self._ek(eid))
                 if raw is not None:
-                    out.append(Edge.from_dict(msgpack.unpackb(raw, raw=False)))
+                    out.append(Edge.from_dict(self._unpack(raw)))
         return out
 
     def get_node_edges(self, node_id: NodeID, direction: str = Direction.BOTH) -> List[Edge]:

@@ -43,6 +43,7 @@ _HEADER = struct.Struct("<II")  # payload_len, crc32
 SEGMENT_PREFIX = "wal-"
 SEGMENT_SUFFIX = ".log"
 SNAPSHOT_PREFIX = "snapshot-"
+_ENC_MAGIC = b"NKE1"
 SNAPSHOT_SUFFIX = ".bin"
 
 
@@ -66,8 +67,10 @@ class WAL:
         max_segment_bytes: int = 16 * 1024 * 1024,
         sync_every_write: bool = False,
         retained_segments: int = 4,
+        encryptor=None,
     ):
         self.dir = directory
+        self._enc = encryptor
         self.max_segment_bytes = max_segment_bytes
         self.sync_every_write = sync_every_write
         self.retained_segments = retained_segments
@@ -78,6 +81,26 @@ class WAL:
         self._fh_size = 0
         os.makedirs(self.dir, exist_ok=True)
         self._seq = self._scan_last_seq()
+
+    # -- payload codec (optional AES-256-GCM at rest; reference wires
+    # at-rest encryption into the storage layer at db.go:776-805) -------
+
+    def _encode(self, obj) -> bytes:
+        payload = _pack(obj)
+        if self._enc is not None:
+            payload = _ENC_MAGIC + self._enc.encrypt(payload)
+        return payload
+
+    def _decode(self, payload: bytes):
+        if payload[: len(_ENC_MAGIC)] == _ENC_MAGIC:
+            if self._enc is None:
+                from nornicdb_tpu.encryption import EncryptionError
+
+                raise EncryptionError(
+                    "WAL is encrypted; open with the passphrase"
+                )
+            payload = self._enc.decrypt(payload[len(_ENC_MAGIC):])
+        return _unpack(payload)
 
     # -- segment bookkeeping --------------------------------------------
 
@@ -139,7 +162,7 @@ class WAL:
         with self._lock:
             self._seq += 1
             rec = {"seq": self._seq, "op": op, "data": data}
-            payload = _pack(rec)
+            payload = self._encode(rec)
             frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
             self._ensure_segment(len(frame))
             self._fh.write(frame)
@@ -182,7 +205,7 @@ class WAL:
         segment retention)."""
         with self._lock:
             seq = self._seq
-            payload = _pack({"seq": seq, "state": state})
+            payload = self._encode({"seq": seq, "state": state})
             path = os.path.join(self.dir, f"{SNAPSHOT_PREFIX}{seq}{SNAPSHOT_SUFFIX}")
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
@@ -236,7 +259,7 @@ class WAL:
                     payload = f.read(ln)
                     if len(payload) != ln or zlib.crc32(payload) != crc:
                         continue
-                    doc = _unpack(payload)
+                    doc = self._decode(payload)
                     return doc["state"], doc["seq"]
             except (OSError, ValueError, KeyError):
                 continue
@@ -252,7 +275,7 @@ class WAL:
         out: List[Dict[str, Any]] = []
         with self._lock:
             for path in self._segment_paths():
-                for rec, _ in _iter_records(path):
+                for rec, _ in _iter_records(path, self._decode):
                     if rec.get("seq", 0) > from_seq:
                         out.append(rec)
         return out
@@ -270,7 +293,7 @@ class WAL:
                 res.segments_read += 1
                 good_bytes = 0
                 corrupt = False
-                for rec, end_off in _iter_records(path):
+                for rec, end_off in _iter_records(path, self._decode):
                     good_bytes = end_off
                     seq = rec.get("seq", 0)
                     if seq > from_seq:
@@ -294,9 +317,12 @@ class WAL:
         return res
 
 
-def _iter_records(path: str):
+def _iter_records(path: str, decode=None):
     """Yield (record, end_offset) for each valid record; stop at the first
-    corrupt/torn frame."""
+    corrupt/torn frame. A wrong or missing encryption passphrase raises
+    instead of masquerading as a torn log."""
+    if decode is None:
+        decode = _unpack
     try:
         with open(path, "rb") as f:
             off = 0
@@ -312,8 +338,12 @@ def _iter_records(path: str):
                     return
                 off += _HEADER.size + ln
                 try:
-                    rec = _unpack(payload)
-                except Exception:
+                    rec = decode(payload)
+                except Exception as exc:
+                    from nornicdb_tpu.encryption import EncryptionError
+
+                    if isinstance(exc, EncryptionError):
+                        raise
                     return
                 if not isinstance(rec, dict) or "op" not in rec:
                     return
