@@ -75,7 +75,7 @@ class SearchServicer:
             hits.append(pb.Hit(
                 node_id=str(r.get("id", "")),
                 score=float(r.get("score", 0.0)),
-                payload_json=json.dumps(r.get("properties", {})),
+                payload_json=json.dumps(r.get("properties", {}), default=str),
             ))
         return pb.SearchResponse(hits=hits, took_ms=(time.time() - t0) * 1e3)
 
@@ -184,7 +184,7 @@ class QdrantServicer:
                 pb.ScoredPoint(
                     id=str(h["id"]),
                     score=h.get("score", 0.0),
-                    payload_json=json.dumps(h.get("payload", {})),
+                    payload_json=json.dumps(h.get("payload", {}), default=str),
                     vector=h.get("vector", []),
                 )
                 for h in hits

@@ -224,7 +224,7 @@ class HttpServer:
                     data = payload.encode()
                 else:
                     ctype = "application/json"
-                    data = json.dumps(payload).encode()
+                    data = json.dumps(payload, default=str).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
@@ -867,10 +867,10 @@ def _backup(storage, target_path: str) -> int:
     tmp = target_path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         for node in storage.all_nodes():
-            f.write(json.dumps({"kind": "node", **node.to_dict()}) + "\n")
+            f.write(json.dumps({"kind": "node", **node.to_dict()}, default=str) + "\n")
             n += 1
         for edge in storage.all_edges():
-            f.write(json.dumps({"kind": "edge", **edge.to_dict()}) + "\n")
+            f.write(json.dumps({"kind": "edge", **edge.to_dict()}, default=str) + "\n")
             n += 1
     os.replace(tmp, target_path)
     return n

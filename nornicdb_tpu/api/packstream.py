@@ -128,6 +128,8 @@ class Packer:
             self.pack(node_structure(value))
         elif isinstance(value, Edge):
             self.pack(relationship_structure(value))
+        elif (ts := _temporal_structure(value)) is not None:
+            self.pack(ts)
         else:
             # numpy scalars etc.
             try:
@@ -218,6 +220,57 @@ class Packer:
         else:
             b.append(0xDA)
             b += struct.pack(">I", n)
+
+
+import datetime as _dt
+
+from nornicdb_tpu.query import temporal_types as T
+
+
+def _temporal_structure(value: Any) -> Any:
+    """Bolt structures for temporal/spatial values (Bolt 4.x tags:
+    Date 'D', Time 'T', LocalTime 't', DateTime 'F', LocalDateTime 'd',
+    Duration 'E', Point2D 'X', Point3D 'Y') so official drivers decode
+    them natively."""
+    if isinstance(value, T.CypherDate):
+        days = (value._dt - _dt.date(1970, 1, 1)).days
+        return Structure(0x44, [days])
+    if isinstance(value, T.CypherLocalTime):
+        t = value._dt
+        nanos = ((t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000
+                 + t.microsecond) * 1000
+        return Structure(0x74, [nanos])
+    if isinstance(value, T.CypherTime):
+        t = value._dt
+        nanos = ((t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000
+                 + t.microsecond) * 1000
+        off = int((t.utcoffset() or _dt.timedelta(0)).total_seconds())
+        return Structure(0x54, [nanos, off])
+    if isinstance(value, T.CypherLocalDateTime):
+        d = value._dt
+        epoch = _dt.datetime(1970, 1, 1)
+        delta = d - epoch
+        secs = delta.days * 86400 + delta.seconds
+        return Structure(0x64, [secs, delta.microseconds * 1000])
+    if isinstance(value, T.CypherDateTime):
+        d = value._dt
+        off = int((d.utcoffset() or _dt.timedelta(0)).total_seconds())
+        naive = d.replace(tzinfo=None)
+        delta = naive - _dt.datetime(1970, 1, 1)
+        # legacy 'F' (pre-utc-patch Bolt 4.x): seconds field is the LOCAL
+        # wall-clock time interpreted against the unix epoch, offset
+        # carried separately
+        wall_secs = delta.days * 86400 + delta.seconds
+        return Structure(0x46, [wall_secs, delta.microseconds * 1000, off])
+    if isinstance(value, T.CypherDuration):
+        return Structure(0x45, [value.months, value.days, value.seconds,
+                                value.nanos])
+    if isinstance(value, T.CypherPoint):
+        srid = value.component("srid") or 7203
+        if value.z is not None:
+            return Structure(0x59, [srid, value.x, value.y, value.z])
+        return Structure(0x58, [srid, value.x, value.y])
+    return None
 
 
 def pack(*values: Any) -> bytes:

@@ -102,6 +102,27 @@ class ListComp(Expr):
 
 
 @dataclass
+class ListPredicate(Expr):
+    """all/any/none/single(x IN list WHERE pred)."""
+
+    kind: str  # 'all' | 'any' | 'none' | 'single'
+    var: str
+    source: Expr
+    where: Expr
+
+
+@dataclass
+class Reduce(Expr):
+    """reduce(acc = init, x IN list | expr)."""
+
+    acc: str
+    init: Expr
+    var: str
+    source: Expr
+    expr: Expr
+
+
+@dataclass
 class PatternPredicate(Expr):
     """A bare pattern used as a boolean predicate: WHERE (a)-[:KNOWS]->(b)."""
 

@@ -149,7 +149,7 @@ class CypherExecutor:
         uq = parse(query)
         cache_key = None
         if self.enable_query_cache and _is_read_only(uq):
-            cache_key = _cache_key(query, params)
+            cache_key = _cache_key(query, params, uq)
             if cache_key is not None:
                 hit = self.query_cache.get(cache_key)
                 if hit is not None:
@@ -300,6 +300,11 @@ class CypherExecutor:
                 return target.properties.get(e.name)
             if isinstance(target, dict):
                 return target.get(e.name)
+            # temporal/duration/point component access (d.year, dur.days,
+            # p.x — reference: temporal component properties)
+            comp = getattr(target, "component", None)
+            if comp is not None:
+                return comp(e.name)
             raise CypherRuntimeError(f"cannot access property on {type(target).__name__}")
         if isinstance(e, A.ListExpr):
             return [self._eval(x, row, ctx) for x in e.items]
@@ -362,6 +367,57 @@ class CypherExecutor:
                     self._eval(e.projection, inner, ctx) if e.projection else item
                 )
             return out
+        if isinstance(e, A.ListPredicate):
+            src = self._eval(e.source, row, ctx)
+            if src is None:
+                return None
+            if not isinstance(src, list):
+                raise CypherRuntimeError(
+                    f"{e.kind}() expects a list, got {type(src).__name__}"
+                )
+            n_true = 0
+            n_null = 0
+            for item in src:
+                inner = dict(row)
+                inner[e.var] = item
+                v = self._eval(e.where, inner, ctx)
+                if v is None:
+                    n_null += 1
+                elif _truthy(v):
+                    n_true += 1
+            n = len(src)
+            # Cypher ternary semantics per predicate kind
+            if e.kind == "all":
+                if n_true == n:
+                    return True
+                return None if n_true + n_null == n else False
+            if e.kind == "any":
+                if n_true > 0:
+                    return True
+                return None if n_null > 0 else False
+            if e.kind == "none":
+                if n_true > 0:
+                    return False
+                return None if n_null > 0 else True
+            # single
+            if n_null > 0 and n_true <= 1:
+                return None
+            return n_true == 1
+        if isinstance(e, A.Reduce):
+            src = self._eval(e.source, row, ctx)
+            if src is None:
+                return None
+            if not isinstance(src, list):
+                raise CypherRuntimeError(
+                    f"reduce() expects a list, got {type(src).__name__}"
+                )
+            acc = self._eval(e.init, row, ctx)
+            for item in src:
+                inner = dict(row)
+                inner[e.acc] = acc
+                inner[e.var] = item
+                acc = self._eval(e.expr, inner, ctx)
+            return acc
         if isinstance(e, A.LabelCheck):
             v = row.get(e.var)
             if not isinstance(v, Node):
@@ -442,30 +498,13 @@ class CypherExecutor:
         if op in ("-", "*", "/", "%", "^"):
             if l is None or r is None:
                 return None
-            if op == "-":
-                return l - r
-            if op == "*":
-                return l * r
-            if op == "/":
-                if r == 0:
-                    if isinstance(l, float) or isinstance(r, float):
-                        # IEEE float semantics (Neo4j returns Infinity/NaN)
-                        if l == 0:
-                            return float("nan")
-                        return float("inf") if l > 0 else float("-inf")
-                    raise CypherRuntimeError("division by zero")
-                if isinstance(l, int) and isinstance(r, int):
-                    q = l // r
-                    if q < 0 and l % r != 0:
-                        q += 1  # Cypher truncates toward zero
-                    return q
-                return l / r
-            if op == "%":
-                if r == 0:
-                    raise CypherRuntimeError("modulo by zero")
-                m = abs(l) % abs(r)
-                return m if l >= 0 else -m
-            return float(l) ** float(r)
+            try:
+                return self._arith(op, l, r)
+            except TypeError:
+                raise CypherRuntimeError(
+                    f"cannot apply {op} to {type(l).__name__} and "
+                    f"{type(r).__name__}"
+                )
         if op == "IN":
             if r is None:
                 return None
@@ -491,6 +530,33 @@ class CypherExecutor:
 
             return bool(_re.fullmatch(r, l))
         raise CypherRuntimeError(f"unhandled operator {op}")
+
+    def _arith(self, op: str, l: Any, r: Any) -> Any:
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            is_num = isinstance(l, (int, float)) and isinstance(r, (int, float))
+            if is_num and r == 0:
+                if isinstance(l, float) or isinstance(r, float):
+                    # IEEE float semantics (Neo4j returns Infinity/NaN)
+                    if l == 0:
+                        return float("nan")
+                    return float("inf") if l > 0 else float("-inf")
+                raise CypherRuntimeError("division by zero")
+            if isinstance(l, int) and isinstance(r, int):
+                q = l // r
+                if q < 0 and l % r != 0:
+                    q += 1  # Cypher truncates toward zero
+                return q
+            return l / r
+        if op == "%":
+            if r == 0:
+                raise CypherRuntimeError("modulo by zero")
+            m = abs(l) % abs(r)
+            return m if l >= 0 else -m
+        return float(l) ** float(r)
 
     def _eval_func(self, e: A.FuncCall, row, ctx) -> Any:
         name = e.name
@@ -1294,11 +1360,17 @@ _WRITE_CLAUSES = (
     A.CreateClause, A.MergeClause, A.SetClause, A.RemoveClause, A.DeleteClause,
 )
 
-# Functions whose results must never be served from cache.
-_NONDETERMINISTIC = (
-    "rand(", "randomuuid(", "timestamp(", "datetime(", "date(", "time(",
-    "localtime(", "localdatetime(", "apoc.create.uuid(",
-)
+# Functions whose results must never be served from cache. Clock
+# constructors (date/datetime/...) are volatile only when called with no
+# argument; their .transaction/.statement/.realtime variants always are.
+_VOLATILE_ALWAYS = frozenset({
+    "rand", "randomuuid", "timestamp", "apoc.create.uuid",
+    "apoc.create.uuidbase64",
+})
+_CLOCK_FUNCS = frozenset({
+    "date", "datetime", "localdatetime", "time", "localtime",
+})
+_CLOCK_SUFFIXES = (".transaction", ".statement", ".realtime")
 
 
 def _is_read_only(uq: "A.UnionQuery") -> bool:
@@ -1310,9 +1382,31 @@ def _is_read_only(uq: "A.UnionQuery") -> bool:
     return True
 
 
-def _cache_key(query: str, params: Optional[Dict[str, Any]]):
-    low = query.lower()
-    if any(tok in low for tok in _NONDETERMINISTIC):
+def _has_volatile_call(obj: Any) -> bool:
+    """Walk the parsed query's dataclass tree for volatile FuncCalls."""
+    if isinstance(obj, A.FuncCall):
+        name = obj.name
+        if name in _VOLATILE_ALWAYS:
+            return True
+        if name in _CLOCK_FUNCS and not obj.args and not obj.star:
+            return True
+        if name.endswith(_CLOCK_SUFFIXES):
+            return True
+    import dataclasses
+
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return any(
+            _has_volatile_call(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        )
+    if isinstance(obj, (list, tuple)):
+        return any(_has_volatile_call(x) for x in obj)
+    return False
+
+
+def _cache_key(query: str, params: Optional[Dict[str, Any]],
+               uq: Optional["A.UnionQuery"] = None):
+    if uq is not None and _has_volatile_call(uq):
         return None
     if not params:
         return query
@@ -1457,6 +1551,10 @@ def _contains_agg(e: A.Expr) -> bool:
     if isinstance(e, A.ListComp):
         parts = [e.source] + [x for x in (e.where, e.projection) if x is not None]
         return any(_contains_agg(p) for p in parts)
+    if isinstance(e, A.ListPredicate):
+        return _contains_agg(e.source) or _contains_agg(e.where)
+    if isinstance(e, A.Reduce):
+        return any(_contains_agg(p) for p in (e.init, e.source, e.expr))
     if isinstance(e, A.CaseExpr):
         parts = [e.subject] if e.subject else []
         for c, v in e.whens:

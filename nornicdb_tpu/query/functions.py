@@ -260,6 +260,14 @@ def _to_float(x):
         return None
 
 
+def _to_boolean(x):
+    if x is None or isinstance(x, bool):
+        return x
+    if isinstance(x, str) and x.lower() in ("true", "false"):
+        return x.lower() == "true"
+    return None
+
+
 def _round(x, precision=0):
     if x is None:
         return None
@@ -324,17 +332,172 @@ def _install_core():
     register("rand", lambda: random.random())
     register("toInteger", _to_integer)
     register("toFloat", _to_float)
-    register("toBoolean", lambda x: None if x is None else (
-        x if isinstance(x, bool) else
-        (x.lower() == "true" if isinstance(x, str) and x.lower() in ("true", "false") else None)))
+    register("toBoolean", _to_boolean)
 
     register("timestamp", lambda: int(time.time() * 1000))
     register("randomUUID", lambda: str(_uuid.uuid4()))
-    register("date", lambda s=None: (
-        datetime.now(timezone.utc).strftime("%Y-%m-%d") if s is None else str(s)))
-    register("datetime", lambda s=None: (
-        datetime.now(timezone.utc).isoformat() if s is None
-        else str(s)))
+
+
+def _install_temporal_spatial():
+    """Temporal, duration, and spatial builtins (reference:
+    pkg/cypher/duration.go + temporal functions in
+    functions_eval_functions.go; spatial point/distance)."""
+    from nornicdb_tpu.query import temporal_types as T
+
+    def _nullable_ctor(maker):
+        # fn() -> now; fn(null) -> null (Cypher distinguishes the two)
+        def fn(*args):
+            if args and args[0] is None:
+                return None
+            return maker(args[0]) if args else maker()
+        return fn
+
+    register("date", _nullable_ctor(T.make_date))
+    register("datetime", _nullable_ctor(T.make_datetime))
+    register("localdatetime", _nullable_ctor(T.make_localdatetime))
+    register("time", _nullable_ctor(T.make_time))
+    register("localtime", _nullable_ctor(T.make_localtime))
+    register("duration", lambda v: None if v is None else T.parse_duration(v))
+
+    register("date.truncate",
+             lambda unit, v=None: T.truncate(unit, v if v is not None
+                                             else T.make_date(), "date"))
+    register("datetime.truncate",
+             lambda unit, v=None: T.truncate(unit, v if v is not None
+                                             else T.make_datetime(),
+                                             "datetime"))
+    register("localdatetime.truncate",
+             lambda unit, v=None: T.truncate(unit, v if v is not None
+                                             else T.make_localdatetime(),
+                                             "localdatetime"))
+    # transaction/statement/realtime clocks (same instant in this engine)
+    for fn_name, maker in [("date", T.make_date),
+                           ("datetime", T.make_datetime),
+                           ("localdatetime", T.make_localdatetime),
+                           ("time", T.make_time),
+                           ("localtime", T.make_localtime)]:
+        for clock in ("transaction", "statement", "realtime"):
+            register(f"{fn_name}.{clock}", (lambda mk: lambda: mk())(maker))
+    register("datetime.fromepoch",
+             lambda secs, nanos=0: T.make_datetime(
+                 float(secs) * 1000.0 + float(nanos) / 1e6))
+    register("datetime.fromepochmillis",
+             lambda ms: T.make_datetime(float(ms)))
+
+    register("duration.between", T.duration_between)
+    register("duration.inmonths", T.duration_in_months)
+    register("duration.indays", T.duration_in_days)
+    register("duration.inseconds", T.duration_in_seconds)
+
+    register("point", T.make_point)
+    register("distance", T.point_distance)
+    register("point.distance", T.point_distance)
+    register("point.withinbbox", _point_within_bbox)
+
+
+def _point_within_bbox(p, lower, upper):
+    from nornicdb_tpu.query.temporal_types import CypherPoint
+
+    if p is None or lower is None or upper is None:
+        return None
+    for v in (p, lower, upper):
+        if not isinstance(v, CypherPoint):
+            raise CypherRuntimeError("point.withinBBox() expects points")
+    return (lower.x <= p.x <= upper.x) and (lower.y <= p.y <= upper.y)
+
+
+def _install_extended():
+    """Breadth beyond the core (reference builtins_core.go ~200 entries):
+    *OrNull conversions, list conversions/operations, extra string and
+    math functions, isEmpty/valueType, char_length."""
+    # conversions with explicit null-on-failure contract
+    register("tointegerornull", _to_integer)
+    register("tofloatornull", _to_float)
+    register("tobooleanornull", _to_boolean)
+    register("tostringornull", lambda x: (
+        _to_string(x) if isinstance(x, (bool, int, float, str)) else None))
+
+    def _list_conv(conv):
+        def fn(lst):
+            if lst is None:
+                return None
+            if not isinstance(lst, list):
+                raise CypherRuntimeError("expected a list")
+            return [conv(x) for x in lst]
+        return fn
+
+    register("tointegerlist", _list_conv(_to_integer))
+    register("tofloatlist", _list_conv(_to_float))
+    register("tostringlist", _list_conv(
+        lambda x: _to_string(x) if isinstance(x, (bool, int, float, str))
+        else None))
+    register("tobooleanlist", _list_conv(_to_boolean))
+
+    register("isempty", lambda x: None if x is None else (
+        len(x) == 0 if isinstance(x, (list, str, dict)) else
+        _raise(CypherRuntimeError("isEmpty() expects list/string/map"))))
+    register("char_length", lambda s: None if s is None else len(s))
+    register("character_length", lambda s: None if s is None else len(s))
+    register("normalize", lambda s, form="NFC": (
+        None if s is None else __import__("unicodedata").normalize(form, s)))
+    register("btrim", lambda s, chars=None: (
+        None if s is None else s.strip(chars)))
+
+    register("degrees", lambda x: None if x is None else math.degrees(_num(x)))
+    register("radians", lambda x: None if x is None else math.radians(_num(x)))
+    register("cot", lambda x: None if x is None else (
+        float("inf") if math.tan(_num(x)) == 0 else 1.0 / math.tan(_num(x))))
+    register("haversin", lambda x: None if x is None else
+             math.sin(_num(x) / 2) ** 2)
+    register("isnan", lambda x: None if x is None else (
+        isinstance(x, float) and math.isnan(x)))
+
+    def _value_type(x):
+        if x is None:
+            return "NULL"
+        if isinstance(x, bool):
+            return "BOOLEAN"
+        if isinstance(x, int):
+            return "INTEGER"
+        if isinstance(x, float):
+            return "FLOAT"
+        if isinstance(x, str):
+            return "STRING"
+        if isinstance(x, list):
+            return "LIST<ANY>"
+        if isinstance(x, dict):
+            return "MAP"
+        if isinstance(x, Node):
+            return "NODE"
+        if isinstance(x, Edge):
+            return "RELATIONSHIP"
+        if isinstance(x, PathValue):
+            return "PATH"
+        from nornicdb_tpu.query import temporal_types as T
+
+        if isinstance(x, T.CypherDate):
+            return "DATE"
+        if isinstance(x, T.CypherDateTime):
+            return "ZONED DATETIME"
+        if isinstance(x, T.CypherLocalDateTime):
+            return "LOCAL DATETIME"
+        if isinstance(x, T.CypherTime):
+            return "ZONED TIME"
+        if isinstance(x, T.CypherLocalTime):
+            return "LOCAL TIME"
+        if isinstance(x, T.CypherDuration):
+            return "DURATION"
+        if isinstance(x, T.CypherPoint):
+            return "POINT"
+        return type(x).__name__.upper()
+
+    register("valuetype", _value_type)
+
+
+def _raise(exc):
+    raise exc
 
 
 _install_core()
+_install_temporal_spatial()
+_install_extended()

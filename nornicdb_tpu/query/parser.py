@@ -25,6 +25,8 @@ from nornicdb_tpu.query.ast import (
     IsNull,
     LabelCheck,
     ListComp,
+    ListPredicate,
+    Reduce,
     ListExpr,
     Literal,
     MapExpr,
@@ -622,6 +624,44 @@ def _parse_atom(ts: TokenStream, stop_at_eq: bool = False) -> Expr:
                 ts.expect(")")
                 return Exists(pattern=None, prop=inner)
             ts.i = save
+        if (
+            kw in ("ALL", "ANY", "NONE", "SINGLE")
+            and ts.peek(1).kind == PUNCT and ts.peek(1).value == "("
+            and ts.peek(2).kind == IDENT
+            and ts.peek(3).kind == IDENT and ts.peek(3).upper() == "IN"
+        ):
+            # all/any/none/single(x IN list WHERE pred)
+            ts.next()  # keyword
+            ts.expect("(")
+            var = ts.next().value
+            ts.next()  # IN
+            source = parse_expression(ts)
+            if not ts.accept_kw("WHERE"):
+                raise CypherSyntaxError(f"{kw.lower()}() requires WHERE")
+            where = parse_expression(ts)
+            ts.expect(")")
+            return ListPredicate(kind=kw.lower(), var=var, source=source,
+                                 where=where)
+        if kw == "REDUCE" and ts.peek(1).kind == PUNCT and ts.peek(1).value == "(":
+            # reduce(acc = init, x IN list | expr)
+            ts.next()
+            ts.expect("(")
+            acc = ts.next().value
+            if not (ts.peek().kind == OP and ts.peek().value == "="):
+                raise CypherSyntaxError("reduce() expects acc = init")
+            ts.next()
+            init = parse_expression(ts)
+            ts.expect(",")
+            var = ts.next().value
+            if not (ts.peek().kind == IDENT and ts.peek().upper() == "IN"):
+                raise CypherSyntaxError("reduce() expects `x IN list`")
+            ts.next()
+            source = parse_expression(ts)
+            if not ts.accept("|", PUNCT):
+                raise CypherSyntaxError("reduce() expects `| expr`")
+            expr = parse_expression(ts)
+            ts.expect(")")
+            return Reduce(acc=acc, init=init, var=var, source=source, expr=expr)
         if kw == "COUNT" and ts.peek(1).kind == PUNCT and ts.peek(1).value == "{":
             # COUNT { (n)--() } subquery-count — parse pattern inside
             ts.next()

@@ -45,11 +45,18 @@ def read_frame(sock: socket.socket) -> ClusterMessage:
     (length,) = _LEN.unpack(_read_exact(sock, 4))
     if length > MAX_FRAME:
         raise TransportError(f"frame too large: {length}")
-    return json.loads(_read_exact(sock, length).decode("utf-8"))
+    from nornicdb_tpu.query.temporal_types import decode_tree
+
+    # revive tagged temporal/point values so replica applies store the
+    # same typed property values as the primary (no divergence)
+    return decode_tree(json.loads(_read_exact(sock, length).decode("utf-8")))
 
 
 def write_frame(sock: socket.socket, msg: ClusterMessage) -> None:
-    payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    from nornicdb_tpu.query.temporal_types import encode_value
+
+    payload = json.dumps(msg, separators=(",", ":"),
+                         default=encode_value).encode("utf-8")
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 

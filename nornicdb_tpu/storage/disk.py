@@ -282,7 +282,9 @@ class DiskEngine(Engine):
         (including identifiers) use engine="python", whose WAL+snapshot
         payloads are encrypted whole; for sensitive property values use
         field-level encryption (encryption.Encryptor.encrypt_field)."""
-        raw = msgpack.packb(d, use_bin_type=True)
+        from nornicdb_tpu.storage.wal import _typed_default
+
+        raw = msgpack.packb(d, use_bin_type=True, default=_typed_default)
         if self._enc is not None:
             raw = _ENC_MAGIC + self._enc.encrypt(raw)
         return raw
@@ -296,7 +298,9 @@ class DiskEngine(Engine):
                     "store is encrypted; open with the passphrase"
                 )
             raw = self._enc.decrypt(raw[len(_ENC_MAGIC):])
-        return msgpack.unpackb(raw, raw=False)
+        from nornicdb_tpu.storage.wal import _typed_hook
+
+        return msgpack.unpackb(raw, raw=False, object_hook=_typed_hook)
 
     def _maybe_compact(self) -> None:
         if not self.auto_compact:

@@ -20,23 +20,40 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+def _typed_default(v):
+    # temporal/duration/point property values serialize as tagged maps
+    # (query/temporal_types.py codec)
+    from nornicdb_tpu.query.temporal_types import encode_value
+
+    return encode_value(v)
+
+
+def _typed_hook(m):
+    from nornicdb_tpu.query.temporal_types import decode_map
+
+    return decode_map(m)
+
+
 try:
     import msgpack  # ships with flax
 
     def _pack(obj) -> bytes:
-        return msgpack.packb(obj, use_bin_type=True)
+        return msgpack.packb(obj, use_bin_type=True, default=_typed_default)
 
     def _unpack(b: bytes):
-        return msgpack.unpackb(b, raw=False, strict_map_key=False)
+        return msgpack.unpackb(b, raw=False, strict_map_key=False,
+                               object_hook=_typed_hook)
 
 except ImportError:  # pragma: no cover
     import json
 
     def _pack(obj) -> bytes:
-        return json.dumps(obj).encode("utf-8")
+        return json.dumps(obj, default=_typed_default).encode("utf-8")
 
     def _unpack(b: bytes):
-        return json.loads(b.decode("utf-8"))
+        from nornicdb_tpu.query.temporal_types import decode_tree
+
+        return decode_tree(json.loads(b.decode("utf-8")))
 
 
 _HEADER = struct.Struct("<II")  # payload_len, crc32

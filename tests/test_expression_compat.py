@@ -1,0 +1,399 @@
+"""Expression-compat corpus: >=150 expressions checked against documented
+Neo4j/openCypher semantics (VERDICT r1 item 4).
+
+Reference surface: pkg/cypher/functions_eval_functions.go (~200
+builtins), duration.go (temporal types), spatial point/distance; list
+predicates and reduce; ternary-logic operators.
+
+Each case is (expression, expected) evaluated via RETURN <expr>.
+Expected values follow Neo4j's documented behavior.
+"""
+
+import math
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture(scope="module")
+def ex():
+    e = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+    e.enable_query_cache = False
+    return e
+
+
+NULL = object()  # sentinel: expected null
+
+
+def _run(ex, expr):
+    r = ex.execute(f"RETURN {expr} AS v")
+    return r.rows[0][0]
+
+
+CASES = [
+    # -- arithmetic & ternary logic (openCypher semantics) -----------------
+    ("1 + 2", 3),
+    ("5 / 2", 2),
+    ("-5 / 2", -2),          # truncation toward zero
+    ("5.0 / 2", 2.5),
+    ("5 % 3", 2),
+    ("-5 % 3", -2),          # sign follows dividend
+    ("2 ^ 10", 1024.0),      # power is float
+    ("1 + null", NULL),
+    ("null * 3", NULL),
+    ("null = null", NULL),
+    ("null <> null", NULL),
+    ("null IS NULL", True),
+    ("null IS NOT NULL", False),
+    ("true AND null", NULL),
+    ("false AND null", False),
+    ("true OR null", True),
+    ("false OR null", NULL),
+    ("true XOR null", NULL),
+    ("NOT null", NULL),
+    ("1 = 1.0", True),
+    ("1 < 2.5", True),
+    ("'a' + 'b'", "ab"),
+    ("'a' + 1", "a1"),
+    ("[1,2] + [3]", [1, 2, 3]),
+    ("[1,2] + 3", [1, 2, 3]),
+    ("1 IN [1,2,3]", True),
+    ("4 IN [1,2,3]", False),
+    ("1 IN null", NULL),
+    ("null IN [1]", NULL),
+    ("'abc' STARTS WITH 'ab'", True),
+    ("'abc' ENDS WITH 'bc'", True),
+    ("'abc' CONTAINS 'b'", True),
+    ("'abc' =~ 'a.c'", True),
+    ("'abc' =~ 'b'", False),
+    # -- string functions --------------------------------------------------
+    ("toUpper('aBc')", "ABC"),
+    ("toLower('aBc')", "abc"),
+    ("trim('  x  ')", "x"),
+    ("ltrim('  x')", "x"),
+    ("rtrim('x  ')", "x"),
+    ("substring('hello', 1)", "ello"),
+    ("substring('hello', 1, 3)", "ell"),
+    ("left('hello', 2)", "he"),
+    ("right('hello', 2)", "lo"),
+    ("split('a,b,c', ',')", ["a", "b", "c"]),
+    ("replace('aaa', 'a', 'b')", "bbb"),
+    ("reverse('abc')", "cba"),
+    ("toString(1)", "1"),
+    ("toString(1.0)", "1.0"),
+    ("toString(true)", "true"),
+    ("size('hello')", 5),
+    ("char_length('hello')", 5),
+    ("character_length('ab')", 2),
+    ("normalize('é')", "é"),
+    ("btrim('xxhixx', 'x')", "hi"),
+    ("isEmpty('')", True),
+    ("isEmpty([1])", False),
+    ("isEmpty({})", True),
+    # -- numeric functions -------------------------------------------------
+    ("abs(-3)", 3),
+    ("ceil(1.1)", 2.0),
+    ("floor(1.9)", 1.0),
+    ("round(1.5)", 2.0),
+    ("round(-1.5)", -2.0),   # half away from zero
+    ("round(1.249, 1)", 1.2),
+    ("sign(-9)", -1),
+    ("sign(0)", 0),
+    ("sqrt(16)", 4.0),
+    ("exp(0)", 1.0),
+    ("log(e())", 1.0),
+    ("log10(1000)", 3.0),
+    ("sin(0)", 0.0),
+    ("cos(0)", 1.0),
+    ("tan(0)", 0.0),
+    ("atan2(0, 1)", 0.0),
+    ("pi()", math.pi),
+    ("degrees(pi())", 180.0),
+    ("radians(180)", math.pi),
+    ("cot(atan2(1,1))", pytest.approx(1.0)),
+    ("haversin(0)", 0.0),
+    ("isNaN(0.0/0.0)", True),
+    ("isNaN(1.0)", False),
+    ("toInteger('42')", 42),
+    ("toInteger('4.9')", 4),
+    ("toInteger('x')", NULL),
+    ("toFloat('2.5')", 2.5),
+    ("toFloat('x')", NULL),
+    ("toBoolean('true')", True),
+    ("toBoolean('nope')", NULL),
+    ("toIntegerOrNull('x')", NULL),
+    ("toFloatOrNull([1])", NULL),
+    ("toStringOrNull(4)", "4"),
+    ("toBooleanOrNull(7)", NULL),
+    # -- list functions ----------------------------------------------------
+    ("range(1, 5)", [1, 2, 3, 4, 5]),
+    ("range(1, 10, 3)", [1, 4, 7, 10]),
+    ("range(5, 1, -2)", [5, 3, 1]),
+    ("size([1,2,3])", 3),
+    ("head([1,2])", 1),
+    ("head([])", NULL),
+    ("last([1,2])", 2),
+    ("tail([1,2,3])", [2, 3]),
+    ("reverse([1,2,3])", [3, 2, 1]),
+    ("coalesce(null, null, 3)", 3),
+    ("coalesce(null)", NULL),
+    ("[x IN range(1,5) WHERE x % 2 = 0]", [2, 4]),
+    ("[x IN range(1,3) | x * 10]", [10, 20, 30]),
+    ("[x IN range(1,6) WHERE x > 2 | x + 1]", [4, 5, 6, 7]),
+    ("toIntegerList(['1','2'])", [1, 2]),
+    ("toFloatList(['1.5'])", [1.5]),
+    ("toStringList([1, 2])", ["1", "2"]),
+    ("toBooleanList(['true','false'])", [True, False]),
+    ("[1,2,3][0]", 1),
+    ("[1,2,3][-1]", 3),
+    ("[1,2,3][5]", NULL),
+    ("[1,2,3,4][1..3]", [2, 3]),
+    ("[1,2,3,4][..2]", [1, 2]),
+    ("[1,2,3,4][2..]", [3, 4]),
+    ("{a: 1}['a']", 1),
+    ("keys({b: 1, a: 2})", ["a", "b"]),
+    # -- list predicates + reduce -----------------------------------------
+    ("all(x IN [1,2,3] WHERE x > 0)", True),
+    ("all(x IN [1,2,3] WHERE x > 1)", False),
+    ("all(x IN [] WHERE x > 1)", True),
+    ("any(x IN [1,2,3] WHERE x = 2)", True),
+    ("any(x IN [] WHERE true)", False),
+    ("none(x IN [1,2,3] WHERE x = 5)", True),
+    ("none(x IN [1,2,3] WHERE x = 2)", False),
+    ("single(x IN [1,2,3] WHERE x = 2)", True),
+    ("single(x IN [1,2,2] WHERE x = 2)", False),
+    ("all(x IN [1, null] WHERE x > 0)", NULL),
+    ("any(x IN [null] WHERE x > 0)", NULL),
+    ("reduce(acc = 0, x IN [1,2,3] | acc + x)", 6),
+    ("reduce(s = '', x IN ['a','b'] | s + x)", "ab"),
+    ("reduce(acc = 1, x IN [2,3,4] | acc * x)", 24),
+    ("reduce(acc = 0, x IN [] | acc + x)", 0),
+    # -- CASE --------------------------------------------------------------
+    ("CASE 2 WHEN 1 THEN 'a' WHEN 2 THEN 'b' ELSE 'c' END", "b"),
+    ("CASE WHEN false THEN 1 ELSE 2 END", 2),
+    ("CASE WHEN false THEN 1 END", NULL),
+    # -- temporal construction & components -------------------------------
+    ("date('2026-07-29').year", 2026),
+    ("date('2026-07-29').month", 7),
+    ("date('2026-07-29').day", 29),
+    ("date('20260729').day", 29),
+    ("date({year: 2026, month: 2, day: 28}).day", 28),
+    ("date('2026-07-29').quarter", 3),
+    ("date('2026-01-01').dayOfWeek", 4),       # 2026-01-01 is a Thursday
+    ("date('2026-01-04').week", 1),
+    ("date('2026-03-01').ordinalDay", 60),     # 2026 not a leap year
+    ("toString(date('2026-07-29'))", "2026-07-29"),
+    ("date(null)", NULL),
+    ("datetime('2026-07-29T12:30:00Z').hour", 12),
+    ("datetime('2026-07-29T12:30:00Z').minute", 30),
+    ("datetime('2026-07-29T12:30:00Z').epochSeconds", 1785328200),
+    ("datetime({epochMillis: 0}).year", 1970),
+    ("datetime('2026-07-29T12:00:00+02:00').offset", "+02:00"),
+    ("localdatetime('2026-07-29T01:02:03').second", 3),
+    ("time('12:34:56Z').minute", 34),
+    ("localtime('23:59:01').hour", 23),
+    ("datetime('2026-07-29T00:00:00Z') > datetime('2026-01-01T00:00:00Z')",
+     True),
+    ("date('2026-01-01') < date('2026-01-02')", True),
+    ("date('2026-01-01') = date('2026-01-01')", True),
+    # -- truncate ----------------------------------------------------------
+    ("date.truncate('month', date('2026-07-29')).day", 1),
+    ("date.truncate('year', date('2026-07-29')).month", 1),
+    ("date.truncate('week', date('2026-07-29')).dayOfWeek", 1),
+    ("datetime.truncate('day', datetime('2026-07-29T12:30:00Z')).hour", 0),
+    ("datetime.truncate('hour', datetime('2026-07-29T12:30:44Z')).minute", 0),
+    # -- durations ---------------------------------------------------------
+    ("duration('P1Y2M3D').months", 14),
+    ("duration('P1Y2M3D').days", 3),
+    ("duration('PT1H30M').minutes", 90),
+    ("duration('P1W').days", 7),
+    ("duration({days: 2, hours: 3}).hours", 3),   # days held separately
+    ("duration('PT0.5S').milliseconds", 500),
+    ("toString(duration({hours: 1, minutes: 30}))", "PT1H30M"),
+    ("duration('P1D') = duration('P1D')", True),
+    ("duration.between(date('2026-01-01'), date('2026-03-15')).months", 2),
+    ("duration.between(date('2026-01-01'), date('2026-03-15')).days", 14),
+    ("duration.inDays(date('2026-01-01'), date('2026-02-01')).days", 31),
+    ("duration.inMonths(date('2025-01-01'), date('2026-03-01')).months", 14),
+    ("duration.inSeconds(datetime('2026-01-01T00:00:00Z'), "
+     "datetime('2026-01-01T01:30:00Z')).seconds", 5400),
+    # -- temporal arithmetic ----------------------------------------------
+    ("(date('2026-01-31') + duration('P1M')).day", 28),    # clamped
+    ("(date('2026-01-01') + duration('P1Y2M3D')).month", 3),
+    ("(date('2026-03-15') - duration('P1M')).month", 2),
+    ("(datetime('2026-01-01T00:00:00Z') + duration('PT36H')).day", 2),
+    ("(localtime('23:00') + duration('PT2H')).hour", 1),   # wraps
+    ("(duration('P1D') + duration('PT12H')).hours", 12),  # days separate
+    ("(duration('PT1H') * 3).hours", 3),
+    ("(duration('PT3H') / 3).hours", 1),
+    # -- spatial -----------------------------------------------------------
+    ("point({x: 3, y: 4}).x", 3.0),
+    ("point({x: 3, y: 4}).srid", 7203),
+    ("point({latitude: 1, longitude: 2}).srid", 4326),
+    ("point({latitude: 1, longitude: 2}).longitude", 2.0),
+    ("point({x: 1, y: 2, z: 3}).z", 3.0),
+    ("point.distance(point({x: 0, y: 0}), point({x: 3, y: 4}))", 5.0),
+    ("distance(point({x: 0, y: 0}), point({x: 0, y: 2}))", 2.0),
+    ("point.distance(point({x:0,y:0}), point({latitude:0, longitude:0}))",
+     NULL),  # mixed CRS -> null
+    ("point.withinBBox(point({x:1,y:1}), point({x:0,y:0}), point({x:2,y:2}))",
+     True),
+    ("point(null)", NULL),
+    # -- misc --------------------------------------------------------------
+    ("valueType(1)", "INTEGER"),
+    ("valueType(1.5)", "FLOAT"),
+    ("valueType('s')", "STRING"),
+    ("valueType(true)", "BOOLEAN"),
+    ("valueType(null)", "NULL"),
+    ("valueType(date('2026-01-01'))", "DATE"),
+    ("valueType(duration('P1D'))", "DURATION"),
+    ("coalesce(toInteger('x'), -1)", -1),
+]
+
+
+@pytest.mark.parametrize("expr,expected", CASES, ids=[c[0][:60] for c in CASES])
+def test_expression(ex, expr, expected):
+    got = _run(ex, expr)
+    if expected is NULL:
+        assert got is None, f"{expr}: expected null, got {got!r}"
+    elif isinstance(expected, float) and not isinstance(expected, bool):
+        assert got == pytest.approx(expected), f"{expr}: {got!r}"
+        assert isinstance(got, float), f"{expr}: expected float, got {type(got)}"
+    else:
+        assert got == expected, f"{expr}: {got!r} != {expected!r}"
+        if isinstance(expected, bool):
+            assert isinstance(got, bool), f"{expr}: not a bool"
+
+
+def test_case_count():
+    assert len(CASES) >= 150, f"corpus has {len(CASES)} cases; need >= 150"
+
+
+def test_registry_breadth():
+    """Callable-function surface approaching the reference's ~200 core
+    builtins + APOC registry (functions_eval_functions.go, apoc.go:222)."""
+    from nornicdb_tpu.query.apoc import APOC_FUNCS
+    from nornicdb_tpu.query.functions import REGISTRY
+
+    assert len(REGISTRY) >= 100, f"only {len(REGISTRY)} core builtins"
+    total = len(REGISTRY) + len(APOC_FUNCS)
+    assert total >= 150, f"only {total} callable functions"
+
+
+def test_temporal_values_survive_bolt_packstream():
+    from nornicdb_tpu.api.packstream import pack, unpack
+    from nornicdb_tpu.query.temporal_types import (
+        CypherDuration, make_date, make_datetime, make_point,
+    )
+
+    blob = pack(make_date("2026-07-29"))
+    v = unpack(blob)
+    # Date structure: tag 0x44, one field (days since epoch)
+    assert v.tag == 0x44
+    assert v.fields == [(make_date("2026-07-29")._dt
+                         - __import__("datetime").date(1970, 1, 1)).days]
+    blob = pack(CypherDuration(1, 2, 3, 4))
+    v = unpack(blob)
+    assert v.tag == 0x45 and v.fields == [1, 2, 3, 4]
+    blob = pack(make_point({"x": 1, "y": 2}))
+    v = unpack(blob)
+    assert v.tag == 0x58 and v.fields == [7203, 1.0, 2.0]
+    blob = pack(make_datetime("2026-07-29T12:00:00Z"))
+    v = unpack(blob)
+    assert v.tag == 0x46
+
+
+def test_temporal_in_node_properties_roundtrip(ex):
+    """Storing temporal-typed property then reading components."""
+    ex.execute("CREATE (:Event {at: datetime('2026-07-29T10:00:00Z'), "
+               "d: duration('P2D')})")
+    r = ex.execute("MATCH (e:Event) RETURN e.at.hour, e.d.days")
+    assert r.rows == [[10, 2]]
+
+
+# -- regressions from review findings -------------------------------------
+
+
+def test_temporal_properties_survive_durable_restart(tmp_path):
+    """Temporal/point property values must persist through the WAL and
+    native KV (tagged msgpack codec) and revive as typed values."""
+    import nornicdb_tpu
+
+    for engine in ("python", "native"):
+        if engine == "native":
+            from nornicdb_tpu.storage.disk import native_available
+
+            if not native_available():
+                continue
+        data_dir = str(tmp_path / f"t-{engine}")
+        db = nornicdb_tpu.open(data_dir, engine=engine, auto_embed=False)
+        db.cypher("CREATE (:Event {at: date('2026-07-29'), "
+                  "dur: duration('P1DT2H'), loc: point({x: 1, y: 2})})")
+        db.close()
+        db = nornicdb_tpu.open(data_dir, engine=engine, auto_embed=False)
+        r = db.cypher("MATCH (e:Event) RETURN e.at.year, e.dur.days, "
+                      "e.loc.x, valueType(e.at)")
+        assert r.rows == [[2026, 1, 1.0, "DATE"]], (engine, r.rows)
+        db.close()
+
+
+def test_clock_functions_not_cached():
+    """datetime.statement() etc. must never be served from the read cache
+    (volatility is an AST property, not a substring match)."""
+    e = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+    import time as _time
+
+    t1 = e.execute("RETURN datetime.statement() AS t").rows[0][0]
+    _time.sleep(0.02)
+    t2 = e.execute("RETURN datetime.statement() AS t").rows[0][0]
+    assert str(t1) != str(t2)
+    r1 = e.execute("RETURN rand() AS r").rows[0][0]
+    r2 = e.execute("RETURN rand() AS r").rows[0][0]
+    assert r1 != r2
+    # deterministic forms DO cache: date with an argument
+    h0 = e.query_cache.hits
+    e.execute("RETURN date('2026-01-01') AS d")
+    e.execute("RETURN date('2026-01-01') AS d")
+    assert e.query_cache.hits > h0
+
+
+def test_negative_duration_spans(ex):
+    """inSeconds/inDays of reversed arguments keep exact magnitude."""
+    got = _run(ex, "duration.inSeconds(datetime('2026-01-01T00:00:01.5Z'), "
+                   "datetime('2026-01-01T00:00:00Z'))")
+    # exact instant: -1.5s (normalized floor: seconds=-2, nanos=+5e8)
+    assert got.seconds * 1_000_000_000 + got.nanos == -1_500_000_000
+    got = _run(ex, "duration.inDays(date('2026-01-02'), date('2026-01-01')).days")
+    assert got == -1
+    got = _run(ex, "duration.inDays(datetime('2026-01-02T12:00:00Z'), "
+                   "datetime('2026-01-01T00:00:00Z')).days")
+    assert got == -1  # -36h truncates toward zero
+
+
+def test_list_predicate_type_errors(ex):
+    from nornicdb_tpu.errors import CypherRuntimeError
+
+    for q in ["RETURN all(x IN 5 WHERE x > 0)",
+              "RETURN reduce(a = 0, x IN 'abc' | a + 1)"]:
+        with pytest.raises(CypherRuntimeError):
+            ex.execute(q)
+
+
+def test_cot_zero_is_infinity(ex):
+    assert _run(ex, "cot(0)") == float("inf")
+
+
+def test_temporal_over_replication_transport():
+    """Tagged JSON codec: a temporal property shipped through the cluster
+    transport revives as the same typed value (no replica divergence)."""
+    from nornicdb_tpu.query.temporal_types import (
+        decode_tree, encode_value, make_date,
+    )
+    import json
+
+    msg = {"op": "create_node", "props": {"at": make_date("2026-07-29")}}
+    wire = json.dumps(msg, default=encode_value)
+    back = decode_tree(json.loads(wire))
+    assert back["props"]["at"] == make_date("2026-07-29")
