@@ -863,14 +863,27 @@ def _backup(storage, target_path: str) -> int:
     import os
 
     os.makedirs(os.path.dirname(os.path.abspath(target_path)), exist_ok=True)
+
+    def _default(v):
+        # typed property values (temporal/duration/point) keep their tag
+        # so a restore revives them; anything else degrades to str
+        from nornicdb_tpu.query.temporal_types import encode_value
+
+        try:
+            return encode_value(v)
+        except TypeError:
+            return str(v)
+
     n = 0
     tmp = target_path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         for node in storage.all_nodes():
-            f.write(json.dumps({"kind": "node", **node.to_dict()}, default=str) + "\n")
+            f.write(json.dumps({"kind": "node", **node.to_dict()},
+                               default=_default) + "\n")
             n += 1
         for edge in storage.all_edges():
-            f.write(json.dumps({"kind": "edge", **edge.to_dict()}, default=str) + "\n")
+            f.write(json.dumps({"kind": "edge", **edge.to_dict()},
+                               default=_default) + "\n")
             n += 1
     os.replace(tmp, target_path)
     return n

@@ -128,7 +128,9 @@ def cmd_import(args) -> int:
                 line = line.strip()
                 if not line:
                     continue
-                row = json.loads(line)
+                from nornicdb_tpu.query.temporal_types import decode_map
+
+                row = json.loads(line, object_hook=decode_map)
                 if row.get("type", "node") == "node":
                     db.storage.create_node(Node(
                         id=row["id"], labels=row.get("labels", []),
@@ -150,6 +152,15 @@ def cmd_import(args) -> int:
 
 def cmd_export(args) -> int:
     db = _open_db(args.data_dir, args.database)
+    from nornicdb_tpu.query.temporal_types import encode_value
+
+    def _default(v):
+        # typed property values keep their tag; anything else becomes str
+        try:
+            return encode_value(v)
+        except TypeError:
+            return str(v)
+
     try:
         with open(args.file, "w", encoding="utf-8") as f:
             n = e = 0
@@ -160,7 +171,7 @@ def cmd_export(args) -> int:
                 }
                 if node.embedding is not None:
                     row["embedding"] = node.embedding
-                f.write(json.dumps(row, default=str) + "\n")
+                f.write(json.dumps(row, default=_default) + "\n")
                 n += 1
             for edge in db.storage.all_edges():
                 f.write(json.dumps({
@@ -168,7 +179,7 @@ def cmd_export(args) -> int:
                     "start": edge.start_node, "end": edge.end_node,
                     "edge_type": edge.type,
                     "properties": edge.properties,
-                }, default=str) + "\n")
+                }, default=_default) + "\n")
                 e += 1
         print(f"exported {n} nodes, {e} edges")
         return 0
@@ -189,7 +200,9 @@ def cmd_eval(args) -> int:
                     line = line.strip()
                     if not line:
                         continue
-                    row = json.loads(line)
+                    from nornicdb_tpu.query.temporal_types import decode_map
+
+                    row = json.loads(line, object_hook=decode_map)
                     node = Node(id=row["id"],
                                 labels=row.get("labels", []),
                                 properties=row.get("properties", {}),

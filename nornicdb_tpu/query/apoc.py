@@ -213,12 +213,21 @@ def _meta_type(v):
 
 _install()
 
+# extended categories (periodic/trigger/path/export/create/merge/util —
+# apoc_ext.py) register into the same table on import
+from nornicdb_tpu.query import apoc_ext as _apoc_ext  # noqa: E402,F401
 
 # -- APOC procedures (CALL apoc.*) ---------------------------------------
 
 
 def run_apoc_procedure(executor, name: str, args: List[Any], ctx) -> Iterator[Dict[str, Any]]:
     name = name.lower()
+    from nornicdb_tpu.query.apoc_ext import run_ext_procedure
+
+    ext = run_ext_procedure(executor, name, args, ctx)
+    if ext is not None:
+        yield from ext
+        return
     if name == "apoc.algo.pagerank":
         # args: [nodes] or nothing — run over whole graph
         from nornicdb_tpu.ops.graph import pagerank_engine

@@ -114,6 +114,11 @@ class CypherExecutor:
             max_size=cache_size, ttl_seconds=cache_ttl
         )
         self.enable_query_cache = True
+        # apoc.trigger.* registry; statements fire after updating queries
+        from nornicdb_tpu.query.apoc_ext import TriggerRegistry
+
+        self.triggers = TriggerRegistry()
+        self._in_trigger = False
 
     def invalidate_caches(self) -> None:
         """Drop the query-result cache and columnar snapshot. Called after
@@ -194,7 +199,22 @@ class CypherExecutor:
             # write invalidation for every execution route (including
             # PROFILE and txn overlays) — reference: cache_policy.go
             self.invalidate_caches()
+            # apoc triggers ('after' phase); guarded against recursion
+            if self.triggers.triggers and not self._in_trigger:
+                self.triggers.fire(self)
         return result
+
+    def _execute_for_trigger(self, statement: str,
+                             params: Optional[Dict[str, Any]] = None
+                             ) -> "CypherResult":
+        """Nested execution for triggers / apoc.periodic / apoc.cypher.run:
+        bypasses the read cache and suppresses re-entrant trigger firing."""
+        prev = self._in_trigger
+        self._in_trigger = True
+        try:
+            return self._execute_parsed(parse(statement), params or {})
+        finally:
+            self._in_trigger = prev
 
     def _execute_explain(
         self, query: str, params: Optional[Dict[str, Any]]
@@ -494,7 +514,13 @@ class CypherExecutor:
                 if isinstance(l, str) and isinstance(r, str):
                     return l + r
                 return _to_str(l) + _to_str(r)
-            return l + r
+            try:
+                return l + r
+            except TypeError:
+                raise CypherRuntimeError(
+                    f"cannot apply + to {type(l).__name__} and "
+                    f"{type(r).__name__}"
+                )
         if op in ("-", "*", "/", "%", "^"):
             if l is None or r is None:
                 return None

@@ -357,7 +357,14 @@ def _install_temporal_spatial():
     register("localdatetime", _nullable_ctor(T.make_localdatetime))
     register("time", _nullable_ctor(T.make_time))
     register("localtime", _nullable_ctor(T.make_localtime))
-    register("duration", lambda v: None if v is None else T.parse_duration(v))
+    def _duration(*args):
+        if not args:
+            raise CypherRuntimeError(
+                "duration() requires a string or map argument"
+            )
+        return None if args[0] is None else T.parse_duration(args[0])
+
+    register("duration", _duration)
 
     register("date.truncate",
              lambda unit, v=None: T.truncate(unit, v if v is not None

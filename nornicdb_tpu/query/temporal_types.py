@@ -191,6 +191,11 @@ def parse_duration(value: Any) -> CypherDuration:
                  + int(value.get("nanoseconds", 0)))
         return CypherDuration(months, days, seconds, nanos)
     if isinstance(value, str):
+        if value.startswith("-"):
+            # leading sign negates the whole duration (Neo4j accepts -P1D)
+            return -parse_duration(value[1:])
+        if value.startswith("+"):
+            return parse_duration(value[1:])
         m = _DUR_RE.match(value)
         if not m or value == "P":
             raise CypherRuntimeError(f"invalid duration {value!r}")
@@ -577,6 +582,10 @@ def make_time(value: Any = None) -> Optional[CypherTime]:
         return value
     if isinstance(value, CypherLocalTime):
         return CypherTime(value._dt.replace(tzinfo=_dt.timezone.utc))
+    if isinstance(value, CypherDateTime):
+        return CypherTime(value._dt.timetz())
+    if isinstance(value, CypherLocalDateTime):
+        return CypherTime(value._dt.time().replace(tzinfo=_dt.timezone.utc))
     if isinstance(value, str):
         try:
             return CypherTime(_dt.time.fromisoformat(value.replace("Z", "+00:00")))
@@ -917,17 +926,29 @@ def encode_value(v: Any):
 
 
 def decode_map(m: Dict[str, Any]):
-    """msgpack `object_hook`: revive a tagged map, else return it as-is."""
+    """msgpack `object_hook`: revive a tagged map, else return it as-is.
+
+    `__nornic_value__` is a reserved property-map key. Decoding is
+    strict-schema: a map carrying the tag but not matching the codec's
+    exact shape is returned unchanged (never crashes replay), so an
+    unlucky user map can only collide by reproducing the full schema.
+    """
     kind = m.get(_TAG) if isinstance(m, dict) else None
     if kind is None:
         return m
-    if kind == "duration":
-        return CypherDuration(m["m"], m["d"], m["s"], m["n"])
-    if kind == "point":
-        return CypherPoint(m["x"], m["y"], m.get("z"), m.get("crs", "cartesian"))
-    maker = _KIND_MAKERS.get(kind)
-    if maker is not None:
-        return maker(m["v"])
+    try:
+        if kind == "duration" and set(m) == {_TAG, "m", "d", "s", "n"}:
+            return CypherDuration(m["m"], m["d"], m["s"], m["n"])
+        if kind == "point" and set(m) == {_TAG, "x", "y", "z", "crs"}:
+            return CypherPoint(m["x"], m["y"], m.get("z"),
+                               m.get("crs", "cartesian"))
+        maker = _KIND_MAKERS.get(kind)
+        if maker is not None and set(m) == {_TAG, "v"} and isinstance(
+            m["v"], str
+        ):
+            return maker(m["v"])
+    except (KeyError, TypeError, ValueError, CypherRuntimeError):
+        return m
     return m
 
 

@@ -45,11 +45,12 @@ def read_frame(sock: socket.socket) -> ClusterMessage:
     (length,) = _LEN.unpack(_read_exact(sock, 4))
     if length > MAX_FRAME:
         raise TransportError(f"frame too large: {length}")
-    from nornicdb_tpu.query.temporal_types import decode_tree
+    from nornicdb_tpu.query.temporal_types import decode_map
 
-    # revive tagged temporal/point values so replica applies store the
-    # same typed property values as the primary (no divergence)
-    return decode_tree(json.loads(_read_exact(sock, length).decode("utf-8")))
+    # revive tagged temporal/point values in the single parse pass so
+    # replica applies store the same typed property values as the primary
+    return json.loads(_read_exact(sock, length).decode("utf-8"),
+                      object_hook=decode_map)
 
 
 def write_frame(sock: socket.socket, msg: ClusterMessage) -> None:

@@ -1,0 +1,254 @@
+"""APOC extended-category tests (reference: apoc/apoc.go:222 categories —
+periodic, trigger, path, export/import/load, create/merge, util/hashing,
+coll/map/text long tail)."""
+
+import json
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture()
+def ex():
+    e = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+    e.enable_query_cache = False
+    return e
+
+
+def _val(ex, expr):
+    return ex.execute(f"RETURN {expr} AS v").rows[0][0]
+
+
+class TestFunctions:
+    def test_coll_long_tail(self, ex):
+        assert _val(ex, "apoc.coll.partition([1,2,3,4,5], 2)") == [[1, 2], [3, 4], [5]]
+        assert _val(ex, "apoc.coll.split([1,2,0,3,0,4], 0)") == [[1, 2], [3], [4]]
+        assert _val(ex, "apoc.coll.occurrences([1,1,2], 1)") == 2
+        assert _val(ex, "apoc.coll.removeAll([1,2,3,2], [2])") == [1, 3]
+        assert _val(ex, "apoc.coll.insert([1,3], 1, 2)") == [1, 2, 3]
+        assert _val(ex, "apoc.coll.set([1,9,3], 1, 2)") == [1, 2, 3]
+        assert _val(ex, "apoc.coll.remove([1,2,3], 1)") == [1, 3]
+        assert _val(ex, "apoc.coll.duplicates([1,2,2,3,3])") == [2, 3]
+        assert _val(ex, "apoc.coll.different([1,2,3])" ) is True
+        assert _val(ex, "apoc.coll.dropDuplicateNeighbors([1,1,2,1])") == [1, 2, 1]
+        assert _val(ex, "apoc.coll.fill('x', 3)") == ["x", "x", "x"]
+        assert _val(ex, "apoc.coll.sumLongs([1,2,3])") == 6
+        assert _val(ex, "apoc.coll.containsAll([1,2,3], [1,3])") is True
+        assert _val(ex, "apoc.coll.containsAny([1,2], [9,2])") is True
+
+    def test_map_long_tail(self, ex):
+        assert _val(ex, "apoc.map.flatten({a: {b: 1}})") == {"a.b": 1}
+        assert _val(ex, "apoc.map.submap({a:1, b:2, c:3}, ['a','c'])") == {"a": 1, "c": 3}
+        assert _val(ex, "apoc.map.mget({a:1, b:2}, ['b','a'])") == [2, 1]
+        assert _val(ex, "apoc.map.fromValues(['a', 1, 'b', 2])") == {"a": 1, "b": 2}
+        assert _val(ex, "apoc.map.clean({a:1, b:null, c:2}, ['c'], [null])") == {"a": 1}
+        assert _val(ex, "apoc.map.groupBy([{k:'x', v:1}, {k:'y', v:2}], 'k')") == {
+            "x": {"k": "x", "v": 1}, "y": {"k": "y", "v": 2}}
+
+    def test_text_long_tail(self, ex):
+        assert _val(ex, "apoc.text.slug('Hello World!')") == "Hello-World"
+        assert _val(ex, "apoc.text.hammingDistance('karolin', 'kathrin')") == 3
+        assert _val(ex, "apoc.text.repeat('ab', 3)") == "ababab"
+        assert _val(ex, "apoc.text.snakeCase('fooBar baz')") == "foo_bar_baz"
+        assert _val(ex, "apoc.text.byteCount('é')") == 2
+        assert _val(ex, "apoc.text.regexGroups('a1b2', '([a-z])(\\\\d)')") == [
+            ["a1", "a", "1"], ["b2", "b", "2"]]
+        assert _val(ex, "apoc.text.jaroWinklerDistance('abc', 'abc')") == 1.0
+        assert 0.0 < _val(ex, "apoc.text.jaroWinklerDistance('martha', 'marhta')") < 1.0
+        assert _val(ex, "apoc.text.sorensenDiceSimilarity('night', 'nacht')") == pytest.approx(0.25)
+        assert _val(ex, "apoc.text.fuzzyMatch('hello', 'helo')") is True
+
+    def test_hashing(self, ex):
+        import hashlib
+
+        assert _val(ex, "apoc.util.md5(['a'])") == hashlib.md5(b"a").hexdigest()
+        assert _val(ex, "apoc.util.sha256(['a','b'])") == hashlib.sha256(b"ab").hexdigest()
+        f1 = _val(ex, "apoc.hashing.fingerprint({a: 1, b: 2})")
+        f2 = _val(ex, "apoc.hashing.fingerprint({b: 2, a: 1})")
+        assert f1 == f2  # key order independent
+
+    def test_date_helpers(self, ex):
+        assert _val(ex, "apoc.date.convert(90, 's', 'm')") == 1
+        assert _val(ex, "apoc.date.toISO8601(0)") == "1970-01-01T00:00:00+00:00"
+        assert _val(ex, "apoc.date.fromISO8601('1970-01-01T00:00:10Z')") == 10000
+        assert _val(ex, "apoc.date.field(86400000, 'day')") == 2
+        assert _val(ex, "apoc.temporal.format(date('2026-07-29'), 'yyyy/MM/dd')") == "2026/07/29"
+
+
+class TestProcedures:
+    @pytest.fixture()
+    def graph(self, ex):
+        ex.execute("CREATE (:P {name: 'a'})-[:KNOWS]->(:P {name: 'b'})"
+                   "-[:KNOWS]->(:P {name: 'c'})")
+        ex.execute("MATCH (b:P {name: 'b'}) CREATE (b)-[:WORKS_AT]->(:Co {name: 'x'})")
+        return ex
+
+    def test_periodic_iterate(self, ex):
+        for i in range(25):
+            ex.execute("CREATE (:Item {i: $i})", {"i": i})
+        r = ex.execute(
+            "CALL apoc.periodic.iterate("
+            "'MATCH (n:Item) RETURN n', "
+            "'SET n.flag = true', {batchSize: 10}) "
+            "YIELD batches, total, committedOperations RETURN *")
+        rec = r.records()[0]
+        assert rec["total"] == 25
+        assert rec["batches"] == 3
+        assert rec["committedOperations"] == 25
+        assert ex.execute(
+            "MATCH (n:Item) WHERE n.flag RETURN count(n)").rows == [[25]]
+
+    def test_periodic_iterate_counts_failures(self, ex):
+        ex.execute("CREATE (:Item {i: 1})")
+        r = ex.execute(
+            "CALL apoc.periodic.iterate("
+            "'MATCH (n:Item) RETURN n', "
+            "'CALL nonexistent.proc() YIELD x RETURN x', {}) "
+            "YIELD failedOperations RETURN failedOperations")
+        assert r.rows == [[1]]
+
+    def test_periodic_commit(self, ex):
+        for i in range(7):
+            ex.execute("CREATE (:Tmp {i: $i})", {"i": i})
+        r = ex.execute(
+            "CALL apoc.periodic.commit("
+            "'MATCH (n:Tmp) WITH n LIMIT 3 DETACH DELETE n', {}) "
+            "YIELD updates, executions RETURN updates, executions")
+        rec = r.records()[0]
+        assert rec["updates"] == 7
+        assert rec["executions"] == 4  # 3+3+1+0
+        assert ex.execute("MATCH (n:Tmp) RETURN count(n)").rows == [[0]]
+
+    def test_triggers_fire_on_writes(self, ex):
+        ex.execute("CALL apoc.trigger.add('audit', "
+                   "'MERGE (c:_Counter {id: 1}) "
+                   "SET c.n = coalesce(c.n, 0) + 1', {})")
+        ex.execute("CREATE (:T1)")
+        ex.execute("CREATE (:T2)")
+        r = ex.execute("MATCH (c:_Counter) RETURN c.n")
+        assert r.rows[0][0] >= 2
+        # list / pause / resume / remove
+        assert ex.execute("CALL apoc.trigger.list() YIELD name RETURN name"
+                          ).rows == [["audit"]]
+        ex.execute("CALL apoc.trigger.pause('audit')")
+        before = ex.execute("MATCH (c:_Counter) RETURN c.n").rows[0][0]
+        ex.execute("CREATE (:T3)")
+        after = ex.execute("MATCH (c:_Counter) RETURN c.n").rows[0][0]
+        assert after == before
+        ex.execute("CALL apoc.trigger.removeAll()")
+        assert ex.execute("CALL apoc.trigger.list() YIELD name RETURN name").rows == []
+
+    def test_path_expand(self, graph):
+        r = graph.execute(
+            "MATCH (a:P {name: 'a'}) "
+            "CALL apoc.path.expand(a, 'KNOWS>', null, 1, 2) YIELD path "
+            "RETURN length(path) AS l ORDER BY l")
+        assert [row[0] for row in r.rows] == [1, 2]
+
+    def test_path_subgraph_nodes(self, graph):
+        r = graph.execute(
+            "MATCH (a:P {name: 'a'}) "
+            "CALL apoc.path.subgraphNodes(a, {relationshipFilter: 'KNOWS>'}) "
+            "YIELD node RETURN node.name ORDER BY node.name")
+        assert [row[0] for row in r.rows] == ["a", "b", "c"]
+
+    def test_path_subgraph_all(self, graph):
+        r = graph.execute(
+            "MATCH (a:P {name: 'a'}) "
+            "CALL apoc.path.subgraphAll(a, {}) "
+            "YIELD nodes, relationships RETURN size(nodes), size(relationships)")
+        assert r.rows == [[4, 3]]
+
+    def test_spanning_tree(self, graph):
+        r = graph.execute(
+            "MATCH (a:P {name: 'a'}) "
+            "CALL apoc.path.spanningTree(a, {}) YIELD path RETURN count(path)")
+        assert r.rows == [[4]]  # one tree path per reachable node (incl. start)
+
+    def test_create_and_merge(self, ex):
+        r = ex.execute("CALL apoc.create.node(['X'], {v: 1}) YIELD node RETURN node.v")
+        assert r.rows == [[1]]
+        r = ex.execute(
+            "MATCH (x:X) CALL apoc.create.relationship(x, 'SELF', {w: 2}, x) "
+            "YIELD rel RETURN rel.w")
+        assert r.rows == [[2]]
+        # merge: first call creates, second matches
+        ex.execute("CALL apoc.merge.node(['Y'], {k: 'a'}, {created: true})")
+        ex.execute("CALL apoc.merge.node(['Y'], {k: 'a'}, {created: true})")
+        assert ex.execute("MATCH (y:Y) RETURN count(y)").rows == [[1]]
+
+    def test_export_import_roundtrip(self, ex, tmp_path):
+        ex.execute("CREATE (:A {v: 1})-[:R {w: 2}]->(:B {v: 3})")
+        path = str(tmp_path / "dump.jsonl")
+        r = ex.execute(
+            "CALL apoc.export.json.all($f, {}) YIELD nodes, relationships "
+            "RETURN nodes, relationships", {"f": path})
+        assert r.rows == [[2, 1]]
+        # import into a fresh engine
+        ex2 = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+        r = ex2.execute(
+            "CALL apoc.import.json($f) YIELD nodes, relationships RETURN *",
+            {"f": path})
+        assert r.records()[0]["nodes"] == 2
+        assert ex2.execute(
+            "MATCH (:A)-[r:R]->(:B) RETURN r.w").rows == [[2]]
+
+    def test_export_csv(self, ex, tmp_path):
+        ex.execute("CREATE (:A {v: 1})")
+        path = str(tmp_path / "dump.csv")
+        r = ex.execute("CALL apoc.export.csv.all($f, {}) YIELD nodes RETURN nodes",
+                       {"f": path})
+        assert r.rows == [[1]]
+        assert "_labels" in open(path).read()
+
+    def test_load_json_and_csv(self, ex, tmp_path):
+        jf = tmp_path / "data.json"
+        jf.write_text(json.dumps([{"name": "x"}, {"name": "y"}]))
+        r = ex.execute("CALL apoc.load.json($f) YIELD value RETURN value.name",
+                       {"f": str(jf)})
+        assert [row[0] for row in r.rows] == ["x", "y"]
+        cf = tmp_path / "data.csv"
+        cf.write_text("name,age\nx,1\ny,2\n")
+        r = ex.execute("CALL apoc.load.csv($f) YIELD map RETURN map.age",
+                       {"f": str(cf)})
+        assert [row[0] for row in r.rows] == ["1", "2"]
+
+    def test_load_json_rejects_urls(self, ex):
+        from nornicdb_tpu.errors import CypherRuntimeError
+
+        with pytest.raises(CypherRuntimeError):
+            ex.execute("CALL apoc.load.json('https://x.test/a.json')")
+
+    def test_cypher_run_and_do_when(self, ex):
+        ex.execute("CREATE (:Z {v: 42})")
+        r = ex.execute("CALL apoc.cypher.run('MATCH (z:Z) RETURN z.v AS v', {}) "
+                       "YIELD v RETURN v")
+        assert r.rows == [[42]]
+        r = ex.execute(
+            "CALL apoc.do.when(true, 'RETURN 1 AS x', 'RETURN 2 AS x', {}) "
+            "YIELD value RETURN value.x")
+        assert r.rows == [[1]]
+
+    def test_util_validate(self, ex):
+        from nornicdb_tpu.errors import CypherRuntimeError
+
+        with pytest.raises(CypherRuntimeError, match="boom"):
+            ex.execute("CALL apoc.util.validate(true, 'boom', [])")
+
+    def test_node_degree_procedure(self, graph):
+        r = graph.execute(
+            "MATCH (b:P {name: 'b'}) CALL apoc.node.degree(b, 'KNOWS>') "
+            "YIELD value RETURN value")
+        assert r.rows == [[1]]
+        r = graph.execute(
+            "MATCH (b:P {name: 'b'}) CALL apoc.node.degree(b) "
+            "YIELD value RETURN value")
+        assert r.rows == [[3]]
+
+
+def test_apoc_registry_size():
+    from nornicdb_tpu.query.apoc import APOC_FUNCS
+
+    assert len(APOC_FUNCS) >= 110, f"only {len(APOC_FUNCS)} APOC functions"
