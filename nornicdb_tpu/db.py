@@ -48,6 +48,7 @@ class DB:
             raise ValueError(f"unknown engine {engine!r}")
         if engine in ("native", "python") and not data_dir:
             raise ValueError(f"engine={engine!r} requires data_dir")
+        self._data_dir = data_dir if engine != "memory" else None
         if data_dir and engine != "memory":
             # at-rest encryption: PBKDF2-derived key + salt file in the
             # data dir (reference: db.go:776-805 DeriveKey + salt)
@@ -203,7 +204,13 @@ class DB:
         if self._search is None:
             from nornicdb_tpu.search.service import SearchService
 
-            svc = SearchService(self.storage, embedder=self._embedder)
+            import os as _os
+
+            svc = SearchService(
+                self.storage, embedder=self._embedder,
+                persist_dir=(_os.path.join(self._data_dir, "search")
+                             if self._data_dir else None),
+            )
             # publish BEFORE backfill so a concurrently-finishing embed
             # lands via _on_embedded instead of being dropped (index_node
             # is idempotent, double-index is harmless)
@@ -351,6 +358,8 @@ class DB:
             self._closed = True
         if self._embed_queue is not None:
             self._embed_queue.stop()
+        if self._search is not None:
+            self._search.close()  # final index snapshot (search.go:496)
         if self._decay is not None:
             self._decay.stop()
         if self.replicator is not None:

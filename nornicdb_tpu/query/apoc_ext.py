@@ -52,7 +52,9 @@ def _install_functions():
         x for i, x in enumerate(l or []) if i == 0 or x != l[i - 1]])
     register("apoc.coll.fill", lambda v, n: [v] * int(n))
     register("apoc.coll.sumLongs", lambda l: int(sum(l or [])))
-    register("apoc.coll.stdev", lambda l, biased=True: _stdev(l or [], biased))
+    # isBiasCorrected defaults true in APOC => sample stdev (divide n-1)
+    register("apoc.coll.stdev", lambda l, bias_corrected=True: _stdev(
+        l or [], biased=not bias_corrected))
     register("apoc.coll.sortMaps", lambda l, key: sorted(
         l or [], key=lambda m: (m.get(key) is None, m.get(key)), reverse=True))
     register("apoc.coll.randomItem", lambda l: (
@@ -462,6 +464,54 @@ def _expand_paths(storage, start: Node, rel_filter, label_filter,
 # -- procedures -----------------------------------------------------------
 
 
+def _bfs_subgraph(storage, start: Node, rel_filter, label_filter,
+                  max_level: int):
+    """NODE_GLOBAL-uniqueness BFS (reference: apoc/path subgraph
+    procedures) — each node visited once via its first (tree) path, so
+    dense graphs stay linear instead of enumerating factorially many
+    relationship-unique walks."""
+    from nornicdb_tpu.query.functions import PathValue
+
+    allow, deny, term, end = label_filter
+    visited = {start.id}
+    tree_paths = [PathValue([start], [])]
+    all_rels: Dict[str, Edge] = {}
+    queue = [(start, [], [start])]
+    while queue:
+        node, rels, nodes = queue.pop(0)
+        depth = len(rels)
+        if depth >= max_level >= 0:
+            continue
+        if term and (set(node.labels) & term) and node.id != start.id:
+            continue
+        for e in storage.get_node_edges(node.id, Direction.BOTH):
+            if e.start_node == node.id:
+                other_id, direction = e.end_node, "out"
+            else:
+                other_id, direction = e.start_node, "in"
+            if rel_filter is not None and not any(
+                (not t or t == e.type) and d in (direction, "both")
+                for t, d in rel_filter
+            ):
+                continue
+            try:
+                other = storage.get_node(other_id)
+            except KeyError:
+                continue
+            if deny and (set(other.labels) & deny):
+                continue
+            if allow and not (set(other.labels) & allow):
+                continue
+            all_rels[e.id] = e
+            if other.id in visited:
+                continue
+            visited.add(other.id)
+            p = PathValue(nodes + [other], rels + [e])
+            tree_paths.append(p)
+            queue.append((other, rels + [e], nodes + [other]))
+    return tree_paths, all_rels
+
+
 def run_ext_procedure(executor, name: str, args: List[Any],
                       ctx) -> Optional[Iterator[Dict[str, Any]]]:
     """Dispatch for the extended APOC procedures; returns None when the
@@ -485,59 +535,26 @@ def run_ext_procedure(executor, name: str, args: List[Any],
         paths = _expand_paths(
             storage, _as_node(storage, start),
             _parse_rel_filter(rel_spec), _parse_label_filter(label_spec),
-            int(min_l or 1), int(max_l if max_l is not None else 5),
+            int(min_l) if min_l is not None else 1,
+            int(max_l) if max_l is not None else 5,
         )
         return iter([{"path": p} for p in paths])
-    if name == "apoc.path.subgraphnodes":
+    if name in ("apoc.path.subgraphnodes", "apoc.path.subgraphall",
+                "apoc.path.spanningtree"):
         start, cfg = (list(args) + [{}])[:2]
         cfg = cfg or {}
-        paths = _expand_paths(
+        tree_paths, all_rels = _bfs_subgraph(
             storage, _as_node(storage, start),
             _parse_rel_filter(cfg.get("relationshipFilter")),
             _parse_label_filter(cfg.get("labelFilter")),
-            0, int(cfg.get("maxLevel", -1)),
+            int(cfg.get("maxLevel", -1)),
         )
-        seen, rows = set(), []
-        for p in paths:
-            n = p.nodes[-1]
-            if n.id not in seen:
-                seen.add(n.id)
-                rows.append({"node": n})
-        return iter(rows)
-    if name == "apoc.path.subgraphall":
-        start, cfg = (list(args) + [{}])[:2]
-        cfg = cfg or {}
-        paths = _expand_paths(
-            storage, _as_node(storage, start),
-            _parse_rel_filter(cfg.get("relationshipFilter")),
-            _parse_label_filter(cfg.get("labelFilter")),
-            0, int(cfg.get("maxLevel", -1)),
-        )
-        nodes, rels = {}, {}
-        for p in paths:
-            for n in p.nodes:
-                nodes[n.id] = n
-            for r in p.rels:
-                rels[r.id] = r
-        return iter([{"nodes": list(nodes.values()),
-                      "relationships": list(rels.values())}])
-    if name == "apoc.path.spanningtree":
-        start, cfg = (list(args) + [{}])[:2]
-        cfg = cfg or {}
-        paths = _expand_paths(
-            storage, _as_node(storage, start),
-            _parse_rel_filter(cfg.get("relationshipFilter")),
-            _parse_label_filter(cfg.get("labelFilter")),
-            0, int(cfg.get("maxLevel", -1)),
-        )
-        seen = set()
-        rows = []
-        for p in paths:  # BFS order => first path to a node is the tree path
-            n = p.nodes[-1]
-            if n.id not in seen:
-                seen.add(n.id)
-                rows.append({"path": p})
-        return iter(rows)
+        if name == "apoc.path.subgraphnodes":
+            return iter([{"node": p.nodes[-1]} for p in tree_paths])
+        if name == "apoc.path.spanningtree":
+            return iter([{"path": p} for p in tree_paths])
+        return iter([{"nodes": [p.nodes[-1] for p in tree_paths],
+                      "relationships": list(all_rels.values())}])
 
     if name == "apoc.create.node":
         labels, props = (list(args) + [{}])[:2]
@@ -834,7 +851,8 @@ def _cypher_run(executor, args, ctx) -> Iterator[Dict]:
     params = args[1] if len(args) > 1 else {}
     r = executor._execute_for_trigger(statement, params or {})
     for rec in r.records():
-        yield rec
+        # APOC contract: each row is wrapped as the `value` map
+        yield {"value": rec}
 
 
 def _do_when(executor, args, ctx) -> Iterator[Dict]:

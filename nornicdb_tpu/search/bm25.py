@@ -148,6 +148,11 @@ class BM25Index:
     def __len__(self) -> int:
         return self._n_alive
 
+    def ids(self) -> list:
+        """Live (non-tombstoned) document ids."""
+        with self._lock:
+            return [e for e, i in self._int_of.items() if self._alive[i]]
+
     # -- scoring ---------------------------------------------------------
 
     def _idf(self, df: int) -> float:

@@ -11,6 +11,7 @@ BM25-seeded build.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -80,6 +81,8 @@ class SearchService:
         reranker: Optional[Any] = None,
         database: str = "neo4j",
         vector_registry: Optional[Any] = None,
+        persist_dir: Optional[str] = None,
+        save_debounce_s: float = 5.0,
     ):
         self.storage = storage
         self.embedder = embedder
@@ -104,6 +107,15 @@ class SearchService:
         self._hnsw_m = hnsw_m
         self._hnsw_ef = hnsw_ef_search
         self.stats = SearchStats()
+        # index persistence: debounced saves + load-on-open so a restart
+        # skips the rebuild (reference: search.go:496-507, versioned
+        # persisted indexes + resumeVectorBuild search.go:432)
+        self.persist_dir = persist_dir
+        self._save_debounce_s = save_debounce_s
+        self._save_timer: Optional[threading.Timer] = None
+        self._save_lock = threading.Lock()  # serializes snapshot writers
+        self._saved_at_ms = 0
+        self._closed = False
 
     # -- indexing ---------------------------------------------------------
 
@@ -138,6 +150,7 @@ class SearchService:
             self.stats.indexed_docs = len(self.bm25)
             self.stats.indexed_vectors = len(self.vectors)
             self._maybe_switch_strategy()
+        self._schedule_save()
 
     def remove_node(self, node_id: str) -> None:
         with self._lock:
@@ -149,17 +162,160 @@ class SearchService:
                     self._rebuild_hnsw()
             self.stats.indexed_docs = len(self.bm25)
             self.stats.indexed_vectors = len(self.vectors)
+        self._schedule_save()
 
     def build_indexes(self) -> int:
         """Index every node in storage (reference: BuildIndexes :2246).
-        Returns count indexed."""
+        Returns count indexed. With a persist_dir, a valid on-disk
+        snapshot is loaded first and only nodes created/updated since the
+        snapshot are (re)indexed — the resume-aware build of
+        search.go:432 resumeVectorBuild."""
         if self.storage is None:
             return 0
+        resumed = self.load_indexes()
         n = 0
         for node in self.storage.all_nodes():
+            if resumed and not self._needs_reindex(node):
+                continue
             self.index_node(node)
             n += 1
+        if resumed:
+            # drop index entries whose node vanished while we were down —
+            # both vector AND bm25 entries (a text-only node never enters
+            # the vector index)
+            live = {nd.id for nd in self.storage.all_nodes()}
+            stale = set(self.vectors.ids()) | set(self.bm25.ids())
+            for ext_id in stale - live:
+                self.remove_node(ext_id)
         return n
+
+    def _needs_reindex(self, node: Node) -> bool:
+        if (node.updated_at or 0) > self._saved_at_ms:
+            return True
+        has_vec = node.embedding is not None or node.chunk_embeddings
+        if has_vec and node.id not in self.vectors:
+            return True
+        return node.id not in self.bm25 and bool(extract_text(node))
+
+    # -- persistence ------------------------------------------------------
+
+    _FORMAT_VERSION = 1
+
+    def save_indexes(self) -> bool:
+        """Write BM25 + vector (+ HNSW) snapshots atomically. Serialized:
+        a timer-thread save racing a close() save over the same .tmp
+        paths would publish a torn or mixed-generation snapshot."""
+        if not self.persist_dir:
+            return False
+        with self._save_lock:
+            return self._save_indexes_locked()
+
+    def _save_indexes_locked(self) -> bool:
+        import json
+        import os
+
+        os.makedirs(self.persist_dir, exist_ok=True)
+        with self._lock:
+            saved_at = int(time.time() * 1000)
+            bm25_doc = self.bm25.to_dict()
+            self.vectors.save(os.path.join(self.persist_dir, "vectors.npz.tmp"))
+            if self.hnsw is not None:
+                # HNSWIndex.save appends .npz itself
+                self.hnsw.save(os.path.join(self.persist_dir, "hnsw.tmp"))
+        with open(os.path.join(self.persist_dir, "bm25.json.tmp"), "w") as f:
+            json.dump(bm25_doc, f)
+        meta = {
+            "format": self._FORMAT_VERSION,
+            "saved_at_ms": saved_at,
+            "has_hnsw": self.hnsw is not None,
+            "strategy": self.stats.strategy,
+        }
+        with open(os.path.join(self.persist_dir, "meta.json.tmp"), "w") as f:
+            json.dump(meta, f)
+        # publish: meta last, so a torn save is simply ignored on load
+        renames = [("vectors.npz.tmp", "vectors.npz"),
+                   ("hnsw.tmp.npz", "hnsw.npz"),
+                   ("bm25.json.tmp", "bm25.json"),
+                   ("meta.json.tmp", "meta.json")]
+        for tmp_name, name in renames:
+            tmp = os.path.join(self.persist_dir, tmp_name)
+            if os.path.exists(tmp):
+                os.replace(tmp, os.path.join(self.persist_dir, name))
+        self._saved_at_ms = saved_at
+        return True
+
+    def load_indexes(self) -> bool:
+        """Load a persisted snapshot; False if absent/invalid/other
+        format version (caller falls back to full rebuild)."""
+        if not self.persist_dir:
+            return False
+        import json
+        import os
+
+        meta_path = os.path.join(self.persist_dir, "meta.json")
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("format") != self._FORMAT_VERSION:
+                return False
+            with open(os.path.join(self.persist_dir, "bm25.json")) as f:
+                bm25 = BM25Index.from_dict(json.load(f))
+            vectors = BruteForceIndex.load(
+                os.path.join(self.persist_dir, "vectors.npz"))
+            hnsw = None
+            if meta.get("has_hnsw"):
+                hnsw = HNSWIndex.load(
+                    os.path.join(self.persist_dir, "hnsw.npz"))
+        except (OSError, ValueError, KeyError):
+            return False
+        with self._lock:
+            self.bm25 = bm25
+            # swap contents into the registered vector space so the
+            # space's index IS still the live service index
+            self._doc_space.index = vectors
+            self.vectors = vectors
+            self.hnsw = hnsw
+            self._saved_at_ms = int(meta.get("saved_at_ms", 0))
+            self.stats.indexed_docs = len(self.bm25)
+            self.stats.indexed_vectors = len(self.vectors)
+            if hnsw is not None:
+                self.stats.strategy = "hnsw"
+        return True
+
+    def _schedule_save(self) -> None:
+        """Throttled persistence: at most one pending timer — a steady
+        write stream persists every debounce interval instead of pushing
+        the save out forever (and no Timer churn per indexed node)."""
+        if not self.persist_dir or self._closed:
+            return
+        with self._save_lock:
+            if self._save_timer is not None:
+                return
+            t = threading.Timer(self._save_debounce_s, self._save_quietly)
+            t.daemon = True
+            self._save_timer = t
+            t.start()
+
+    def _save_quietly(self) -> None:
+        with self._save_lock:
+            self._save_timer = None
+        try:
+            self.save_indexes()
+        except Exception:
+            pass  # a failed background save must not take down the app
+
+    def close(self) -> None:
+        """Final save; cancels any pending save timer."""
+        self._closed = True
+        with self._save_lock:
+            if self._save_timer is not None:
+                self._save_timer.cancel()
+                self._save_timer = None
+        if self.persist_dir:
+            try:
+                self.save_indexes()
+            except Exception:
+                pass
 
     # -- strategy state machine -------------------------------------------
 

@@ -189,3 +189,45 @@ class BruteForceIndex:
     def ids(self) -> List[str]:
         with self._lock:
             return [e for e in self._ext_ids if e is not None]
+
+    # -- persistence (reference: vector store save/load, search.go:496) --
+
+    def save(self, path: str) -> None:
+        """Snapshot live rows to an .npz (compacted: dead slots dropped)."""
+        with self._lock:
+            if self._matrix is None or self._n_alive == 0:
+                ids = np.asarray([], dtype="U1")
+                matrix = np.zeros((0, 0), np.float32)
+            else:
+                rows = [i for i, e in enumerate(self._ext_ids)
+                        if e is not None and self._valid[i]]
+                ids = np.asarray([self._ext_ids[i] for i in rows])
+                matrix = self._matrix[rows]
+        # write through a file object — np.savez would append ".npz" to a
+        # bare path, breaking the caller's atomic tmp-then-rename publish
+        with open(path, "wb") as f:
+            np.savez_compressed(f, ids=ids, matrix=matrix)
+
+    @classmethod
+    def load(cls, path: str, use_device: bool = True) -> "BruteForceIndex":
+        """Exact restore: rows go back verbatim (no re-normalization — a
+        second normalize of float32 rows drifts ~1e-7 and reorders
+        equal-score ties vs the saved index)."""
+        data = np.load(path, allow_pickle=False)
+        idx = cls(use_device=use_device)
+        ids = data["ids"]
+        matrix = np.asarray(data["matrix"], np.float32)
+        n = len(ids)
+        if n == 0:
+            return idx
+        idx._ensure_capacity(n, matrix.shape[1])
+        idx._matrix[:n] = matrix
+        idx._valid[:n] = True
+        for i in range(n):
+            eid = str(ids[i])
+            idx._ext_ids[i] = eid
+            idx._slot_of[eid] = i
+        idx._count = n
+        idx._n_alive = n
+        idx._dirty = True
+        return idx

@@ -224,7 +224,7 @@ class TestProcedures:
     def test_cypher_run_and_do_when(self, ex):
         ex.execute("CREATE (:Z {v: 42})")
         r = ex.execute("CALL apoc.cypher.run('MATCH (z:Z) RETURN z.v AS v', {}) "
-                       "YIELD v RETURN v")
+                       "YIELD value RETURN value.v")
         assert r.rows == [[42]]
         r = ex.execute(
             "CALL apoc.do.when(true, 'RETURN 1 AS x', 'RETURN 2 AS x', {}) "
@@ -252,3 +252,38 @@ def test_apoc_registry_size():
     from nornicdb_tpu.query.apoc import APOC_FUNCS
 
     assert len(APOC_FUNCS) >= 110, f"only {len(APOC_FUNCS)} APOC functions"
+
+
+def test_subgraph_on_dense_graph_is_fast(ex):
+    """NODE_GLOBAL uniqueness: a complete graph must not blow up
+    factorially (review regression)."""
+    import time as _t
+
+    for i in range(8):
+        ex.execute("CREATE (:K {i: $i})", {"i": i})
+    for i in range(8):
+        for j in range(i + 1, 8):
+            ex.execute("MATCH (a:K {i:$a}), (b:K {i:$b}) CREATE (a)-[:E]->(b)",
+                       {"a": i, "b": j})
+    t0 = _t.time()
+    r = ex.execute("MATCH (k:K {i: 0}) "
+                   "CALL apoc.path.subgraphNodes(k, {}) YIELD node "
+                   "RETURN count(node)")
+    assert r.rows == [[8]]
+    assert _t.time() - t0 < 5.0
+    r = ex.execute("MATCH (k:K {i: 0}) "
+                   "CALL apoc.path.subgraphAll(k, {}) "
+                   "YIELD nodes, relationships RETURN size(nodes), size(relationships)")
+    assert r.rows == [[8, 28]]
+
+
+def test_path_expand_min_level_zero(ex):
+    ex.execute("CREATE (:M1 {n: 'a'})-[:L]->(:M2 {n: 'b'})")
+    r = ex.execute("MATCH (m:M1) CALL apoc.path.expand(m, null, null, 0, 2) "
+                   "YIELD path RETURN length(path) ORDER BY length(path)")
+    assert [row[0] for row in r.rows] == [0, 1]
+
+
+def test_stdev_bias_corrected_default(ex):
+    assert _val(ex, "apoc.coll.stdev([1,2,3])") == pytest.approx(1.0)
+    assert _val(ex, "apoc.coll.stdev([1,2,3], false)") == pytest.approx(0.8165, abs=1e-3)
