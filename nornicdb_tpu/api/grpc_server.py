@@ -273,9 +273,21 @@ class GrpcServer:
             interceptors=interceptors)
         self.search_servicer = SearchServicer(db)
         self.qdrant_servicer = QdrantServicer(db.qdrant_compat)
+        # official qdrant wire contract (qdrant.Collections / qdrant.Points)
+        # alongside the native services — reference: pkg/qdrantgrpc serves
+        # the upstream proto so official SDKs connect (COMPAT.md)
+        from nornicdb_tpu.api.qdrant_official_grpc import (
+            OfficialCollectionsServicer,
+            OfficialPointsServicer,
+        )
+
+        self.official_collections = OfficialCollectionsServicer(db.qdrant_compat)
+        self.official_points = OfficialPointsServicer(db.qdrant_compat)
         self._server.add_generic_rpc_handlers((
             self.search_servicer.handlers(),
             self.qdrant_servicer.handlers(),
+            self.official_collections.handlers(),
+            self.official_points.handlers(),
         ))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host

@@ -325,7 +325,8 @@ class QdrantCompat:
             except (KeyError, NotFoundError):
                 continue
             if query_filter is not None and not _match_filter(
-                node.properties.get("payload") or {}, query_filter
+                node.properties.get("payload") or {}, query_filter,
+                point_id=node.properties.get("_point_id"),
             ):
                 continue
             d = self._point_dict(node, with_payload, with_vector)
@@ -407,24 +408,45 @@ class QdrantCompat:
         return d
 
 
-def _match_filter(payload: Dict[str, Any], flt: Dict[str, Any]) -> bool:
+def _match_filter(payload: Dict[str, Any], flt: Dict[str, Any],
+                  point_id: Optional[Any] = None) -> bool:
     """Qdrant filter subset: must / should / must_not with
-    match.value / match.any / range conditions on payload keys."""
+    match.value / match.any / range / has_id / is_null / is_empty
+    conditions on payload keys."""
     for cond in flt.get("must", []):
-        if not _match_condition(payload, cond):
+        if not _match_condition(payload, cond, point_id):
             return False
     for cond in flt.get("must_not", []):
-        if _match_condition(payload, cond):
+        if _match_condition(payload, cond, point_id):
             return False
     should = flt.get("should", [])
-    if should and not any(_match_condition(payload, c) for c in should):
+    if should and not any(
+        _match_condition(payload, c, point_id) for c in should
+    ):
         return False
     return True
 
 
-def _match_condition(payload: Dict[str, Any], cond: Dict[str, Any]) -> bool:
+def _match_condition(payload: Dict[str, Any], cond: Dict[str, Any],
+                     point_id: Optional[Any] = None) -> bool:
     if "filter" in cond:  # nested filter
-        return _match_filter(payload, cond["filter"])
+        return _match_filter(payload, cond["filter"], point_id)
+    if "has_id" in cond:
+        wanted = {str(x) for x in cond["has_id"]}
+        return point_id is not None and str(point_id) in wanted
+    if "is_null" in cond:
+        # accepts both the REST wire shape {"is_null": {"key": k}} and the
+        # gRPC-normalized bare string
+        k = cond["is_null"]
+        if isinstance(k, dict):
+            k = k.get("key")
+        return k in payload and payload[k] is None
+    if "is_empty" in cond:
+        k = cond["is_empty"]
+        if isinstance(k, dict):
+            k = k.get("key")
+        v = payload.get(k)
+        return v is None or v == [] or v == ""
     key = cond.get("key")
     if key is None:
         return True

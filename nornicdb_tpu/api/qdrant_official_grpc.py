@@ -1,0 +1,443 @@
+"""Official Qdrant gRPC wire contract served over the QdrantCompat layer.
+
+Reference: pkg/qdrantgrpc (COMPAT.md: "official qdrant proto, 100% SDK
+compat"; collections_service.go, points_service.go). The proto subset in
+``api/proto/qdrant.proto`` replicates the upstream package (`qdrant`),
+service names (`qdrant.Collections`, `qdrant.Points`), method names, and
+field numbers, so official qdrant client SDKs speak to this server
+without modification; handlers are registered generically (no
+grpc_python_plugin in this image).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import grpc
+
+from nornicdb_tpu.api.proto import qdrant_pb2 as q
+from nornicdb_tpu.api.qdrant import QdrantError
+
+
+# -- value conversion -----------------------------------------------------
+
+
+def value_to_py(v: "q.Value") -> Any:
+    kind = v.WhichOneof("kind")
+    if kind is None or kind == "null_value":
+        return None
+    if kind == "struct_value":
+        return {k: value_to_py(x) for k, x in v.struct_value.fields.items()}
+    if kind == "list_value":
+        return [value_to_py(x) for x in v.list_value.values]
+    return getattr(v, kind)
+
+
+def py_to_value(x: Any) -> "q.Value":
+    v = q.Value()
+    if x is None:
+        v.null_value = q.NULL_VALUE
+    elif isinstance(x, bool):
+        v.bool_value = x
+    elif isinstance(x, int):
+        v.integer_value = x
+    elif isinstance(x, float):
+        v.double_value = x
+    elif isinstance(x, str):
+        v.string_value = x
+    elif isinstance(x, dict):
+        for k, item in x.items():
+            v.struct_value.fields[str(k)].CopyFrom(py_to_value(item))
+    elif isinstance(x, (list, tuple)):
+        v.list_value.values.extend(py_to_value(i) for i in x)
+    else:
+        v.string_value = str(x)
+    return v
+
+
+def point_id_to_py(pid: "q.PointId") -> Any:
+    which = pid.WhichOneof("point_id_options")
+    if which == "num":
+        return int(pid.num)
+    return pid.uuid
+
+
+def py_to_point_id(x: Any) -> "q.PointId":
+    pid = q.PointId()
+    # stored point ids round-trip as strings; numeric strings go back out
+    # as the numeric id form the client upserted
+    try:
+        pid.num = int(x)
+    except (TypeError, ValueError):
+        pid.uuid = str(x)
+    return pid
+
+
+def filter_to_dict(flt: "q.Filter") -> Optional[Dict[str, Any]]:
+    if not (flt.must or flt.should or flt.must_not):
+        return None
+
+    def cond_to_dict(c: "q.Condition") -> Dict[str, Any]:
+        which = c.WhichOneof("condition_one_of")
+        if which == "field":
+            fc = c.field
+            out: Dict[str, Any] = {"key": fc.key}
+            mwhich = fc.match.WhichOneof("match_value")
+            if mwhich == "keyword":
+                out["match"] = {"value": fc.match.keyword}
+            elif mwhich == "integer":
+                out["match"] = {"value": int(fc.match.integer)}
+            elif mwhich == "boolean":
+                out["match"] = {"value": fc.match.boolean}
+            elif mwhich == "text":
+                out["match"] = {"text": fc.match.text}
+            rng = {}
+            for field in ("lt", "gt", "gte", "lte"):
+                if fc.range.HasField(field):
+                    rng[field] = getattr(fc.range, field)
+            if rng:
+                out["range"] = rng
+            return out
+        if which == "has_id":
+            ids = [point_id_to_py(p) for p in c.has_id.has_id]
+            return {"has_id": ids}
+        if which == "filter":
+            return {"filter": filter_to_dict(c.filter) or {}}
+        if which == "is_null":
+            return {"is_null": c.is_null.key}
+        if which == "is_empty":
+            return {"is_empty": c.is_empty.key}
+        return {}
+
+    return {
+        "must": [cond_to_dict(c) for c in flt.must],
+        "should": [cond_to_dict(c) for c in flt.should],
+        "must_not": [cond_to_dict(c) for c in flt.must_not],
+    }
+
+
+def _with_payload(sel: "q.WithPayloadSelector") -> bool:
+    which = sel.WhichOneof("selector_options")
+    if which is None:
+        return True  # qdrant default for search is payload on
+    if which == "enable":
+        return sel.enable
+    return True  # include/exclude subset: return full payload
+
+
+def _with_vectors(msg, field: str = "with_vectors") -> bool:
+    if not msg.HasField(field):
+        return False
+    sel = getattr(msg, field)
+    which = sel.WhichOneof("selector_options")
+    if which == "enable":
+        return sel.enable
+    return which is not None
+
+
+def _abort(context, e: Exception) -> None:
+    code = grpc.StatusCode.INVALID_ARGUMENT
+    if isinstance(e, QdrantError) and getattr(e, "status", 400) == 404:
+        code = grpc.StatusCode.NOT_FOUND
+    context.abort(code, str(e))
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda r: r.SerializeToString(),
+    )
+
+
+_DISTANCE_NAMES = {
+    q.Cosine: "Cosine", q.Euclid: "Euclid", q.Dot: "Dot",
+    q.Manhattan: "Manhattan", q.UnknownDistance: "Cosine",
+}
+_DISTANCE_ENUMS = {
+    "Cosine": q.Cosine, "Euclid": q.Euclid, "Dot": q.Dot,
+    "Manhattan": q.Manhattan,
+}
+
+
+class OfficialCollectionsServicer:
+    """qdrant.Collections (reference: collections_service.go)."""
+
+    def __init__(self, compat):
+        self.compat = compat
+
+    def Get(self, request, context):
+        t0 = time.time()
+        try:
+            info = self.compat.get_collection(request.collection_name)
+        except QdrantError as e:
+            _abort(context, e)
+        vec_cfg = info["config"]["params"].get("vectors", {})
+        resp = q.GetCollectionInfoResponse(
+            result=q.CollectionInfo(
+                status=q.Green,
+                vectors_count=int(info.get("indexed_vectors_count", 0)),
+                segments_count=int(info.get("segments_count", 1)),
+                points_count=int(info.get("points_count", 0)),
+            ),
+            time=time.time() - t0,
+        )
+        if vec_cfg:
+            params = q.VectorParams(
+                size=int(vec_cfg.get("size", 0)),
+                distance=_DISTANCE_ENUMS.get(
+                    vec_cfg.get("distance", "Cosine"), q.Cosine),
+            )
+            resp.result.config.params.vectors_config.params.CopyFrom(params)
+        return resp
+
+    def List(self, request, context):
+        t0 = time.time()
+        return q.ListCollectionsResponse(
+            collections=[
+                q.CollectionDescription(name=n)
+                for n in self.compat.list_collections()
+            ],
+            time=time.time() - t0,
+        )
+
+    def Create(self, request, context):
+        t0 = time.time()
+        size = 0
+        distance = "Cosine"
+        if request.HasField("vectors_config"):
+            which = request.vectors_config.WhichOneof("config")
+            if which == "params":
+                p = request.vectors_config.params
+                size = int(p.size)
+                distance = _DISTANCE_NAMES.get(p.distance, "Cosine")
+            elif which == "params_map":
+                # single-vector subset: first named vector wins
+                for _name, p in request.vectors_config.params_map.map.items():
+                    size = int(p.size)
+                    distance = _DISTANCE_NAMES.get(p.distance, "Cosine")
+                    break
+        try:
+            ok = self.compat.create_collection(
+                request.collection_name,
+                {"size": size, "distance": distance},
+            )
+        except QdrantError as e:
+            _abort(context, e)
+        return q.CollectionOperationResponse(result=ok, time=time.time() - t0)
+
+    def Delete(self, request, context):
+        t0 = time.time()
+        ok = self.compat.delete_collection(request.collection_name)
+        return q.CollectionOperationResponse(result=ok, time=time.time() - t0)
+
+    def CollectionExists(self, request, context):
+        t0 = time.time()
+        exists = request.collection_name in self.compat.list_collections()
+        return q.CollectionExistsResponse(
+            result=q.CollectionExists(exists=exists), time=time.time() - t0)
+
+    def handlers(self):
+        return grpc.method_handlers_generic_handler(
+            "qdrant.Collections",
+            {
+                "Get": _unary(self.Get, q.GetCollectionInfoRequest),
+                "List": _unary(self.List, q.ListCollectionsRequest),
+                "Create": _unary(self.Create, q.CreateCollection),
+                "Delete": _unary(self.Delete, q.DeleteCollection),
+                "CollectionExists": _unary(
+                    self.CollectionExists, q.CollectionExistsRequest),
+            },
+        )
+
+
+class OfficialPointsServicer:
+    """qdrant.Points (reference: points_service.go)."""
+
+    def __init__(self, compat):
+        self.compat = compat
+
+    # -- helpers --------------------------------------------------------
+
+    def _scored(self, d: Dict[str, Any]) -> "q.ScoredPoint":
+        sp = q.ScoredPoint(
+            id=py_to_point_id(d["id"]),
+            score=float(d.get("score", 0.0)),
+            version=0,
+        )
+        for k, v in (d.get("payload") or {}).items():
+            sp.payload[k].CopyFrom(py_to_value(v))
+        if d.get("vector") is not None:
+            sp.vectors.vector.data.extend(float(x) for x in d["vector"])
+        return sp
+
+    def _retrieved(self, d: Dict[str, Any]) -> "q.RetrievedPoint":
+        rp = q.RetrievedPoint(id=py_to_point_id(d["id"]))
+        for k, v in (d.get("payload") or {}).items():
+            rp.payload[k].CopyFrom(py_to_value(v))
+        if d.get("vector") is not None:
+            rp.vectors.vector.data.extend(float(x) for x in d["vector"])
+        return rp
+
+    # -- rpcs -----------------------------------------------------------
+
+    def Upsert(self, request, context):
+        t0 = time.time()
+        points = []
+        for p in request.points:
+            vec: List[float] = []
+            if p.HasField("vectors"):
+                which = p.vectors.WhichOneof("vectors_options")
+                if which == "vector":
+                    vec = list(p.vectors.vector.data)
+                elif which == "vectors":
+                    for _name, v in p.vectors.vectors.vectors.items():
+                        vec = list(v.data)
+                        break
+            points.append({
+                "id": point_id_to_py(p.id),
+                "vector": vec,
+                "payload": {k: value_to_py(v) for k, v in p.payload.items()},
+            })
+        try:
+            self.compat.upsert_points(request.collection_name, points)
+        except (QdrantError, ValueError, TypeError) as e:
+            _abort(context, e)
+        return q.PointsOperationResponse(
+            result=q.UpdateResult(operation_id=0, status=q.Completed),
+            time=time.time() - t0,
+        )
+
+    def Delete(self, request, context):
+        t0 = time.time()
+        which = request.points.WhichOneof("points_selector_one_of")
+        try:
+            if which == "points":
+                ids = [point_id_to_py(p) for p in request.points.points.ids]
+                self.compat.delete_points(request.collection_name, ids)
+            elif which == "filter":
+                flt = filter_to_dict(request.points.filter)
+                page = self.compat.scroll_points(
+                    request.collection_name, limit=1_000_000)
+                doomed = []
+                from nornicdb_tpu.api.qdrant import _match_filter
+
+                for d in page["points"]:
+                    if flt is None or _match_filter(
+                        d.get("payload") or {}, flt, point_id=d["id"]
+                    ):
+                        doomed.append(d["id"])
+                self.compat.delete_points(request.collection_name, doomed)
+        except QdrantError as e:
+            _abort(context, e)
+        return q.PointsOperationResponse(
+            result=q.UpdateResult(operation_id=0, status=q.Completed),
+            time=time.time() - t0,
+        )
+
+    def Get(self, request, context):
+        t0 = time.time()
+        ids = [point_id_to_py(p) for p in request.ids]
+        try:
+            points = self.compat.retrieve_points(
+                request.collection_name, ids,
+                with_payload=_with_payload(request.with_payload),
+                with_vector=_with_vectors(request),
+            )
+        except QdrantError as e:
+            _abort(context, e)
+        return q.GetResponse(
+            result=[self._retrieved(d) for d in points],
+            time=time.time() - t0,
+        )
+
+    def Search(self, request, context):
+        t0 = time.time()
+        try:
+            hits = self.compat.search_points(
+                request.collection_name,
+                list(request.vector),
+                limit=int(request.limit) or 10,
+                with_payload=_with_payload(request.with_payload),
+                with_vector=_with_vectors(request),
+                score_threshold=(
+                    request.score_threshold
+                    if request.HasField("score_threshold") else None),
+                query_filter=filter_to_dict(request.filter),
+            )
+        except QdrantError as e:
+            _abort(context, e)
+        offset = int(request.offset) if request.HasField("offset") else 0
+        return q.SearchResponse(
+            result=[self._scored(d) for d in hits[offset:]],
+            time=time.time() - t0,
+        )
+
+    def Scroll(self, request, context):
+        t0 = time.time()
+        offset = None
+        if request.HasField("offset"):
+            offset = point_id_to_py(request.offset)
+        try:
+            page = self.compat.scroll_points(
+                request.collection_name,
+                offset=offset,
+                limit=int(request.limit) if request.HasField("limit") else 10,
+                with_payload=_with_payload(request.with_payload),
+                with_vector=_with_vectors(request),
+            )
+        except QdrantError as e:
+            _abort(context, e)
+        flt = filter_to_dict(request.filter)
+        points = page["points"]
+        if flt is not None:
+            from nornicdb_tpu.api.qdrant import _match_filter
+
+            points = [
+                d for d in points
+                if _match_filter(d.get("payload") or {}, flt,
+                                 point_id=d["id"])
+            ]
+        resp = q.ScrollResponse(
+            result=[self._retrieved(d) for d in points],
+            time=time.time() - t0,
+        )
+        if page.get("next_page_offset") is not None:
+            resp.next_page_offset.CopyFrom(
+                py_to_point_id(page["next_page_offset"]))
+        return resp
+
+    def Count(self, request, context):
+        t0 = time.time()
+        flt = filter_to_dict(request.filter)
+        try:
+            if flt is None:
+                n = self.compat.count_points(request.collection_name)
+            else:
+                from nornicdb_tpu.api.qdrant import _match_filter
+
+                page = self.compat.scroll_points(
+                    request.collection_name, limit=1_000_000)
+                n = sum(
+                    1 for d in page["points"]
+                    if _match_filter(d.get("payload") or {}, flt,
+                                     point_id=d["id"])
+                )
+        except QdrantError as e:
+            _abort(context, e)
+        return q.CountResponse(
+            result=q.CountResult(count=n), time=time.time() - t0)
+
+    def handlers(self):
+        return grpc.method_handlers_generic_handler(
+            "qdrant.Points",
+            {
+                "Upsert": _unary(self.Upsert, q.UpsertPoints),
+                "Delete": _unary(self.Delete, q.DeletePoints),
+                "Get": _unary(self.Get, q.GetPoints),
+                "Search": _unary(self.Search, q.SearchPoints),
+                "Scroll": _unary(self.Scroll, q.ScrollPoints),
+                "Count": _unary(self.Count, q.CountPoints),
+            },
+        )

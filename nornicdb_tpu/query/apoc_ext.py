@@ -470,15 +470,23 @@ def _bfs_subgraph(storage, start: Node, rel_filter, label_filter,
     procedures) — each node visited once via its first (tree) path, so
     dense graphs stay linear instead of enumerating factorially many
     relationship-unique walks."""
+    from collections import deque
+
     from nornicdb_tpu.query.functions import PathValue
 
     allow, deny, term, end = label_filter
+
+    def rel_ok(e: Edge, direction: str) -> bool:
+        return rel_filter is None or any(
+            (not t or t == e.type) and d in (direction, "both")
+            for t, d in rel_filter
+        )
+
     visited = {start.id}
     tree_paths = [PathValue([start], [])]
-    all_rels: Dict[str, Edge] = {}
-    queue = [(start, [], [start])]
+    queue = deque([(start, [], [start])])
     while queue:
-        node, rels, nodes = queue.pop(0)
+        node, rels, nodes = queue.popleft()
         depth = len(rels)
         if depth >= max_level >= 0:
             continue
@@ -489,10 +497,7 @@ def _bfs_subgraph(storage, start: Node, rel_filter, label_filter,
                 other_id, direction = e.end_node, "out"
             else:
                 other_id, direction = e.start_node, "in"
-            if rel_filter is not None and not any(
-                (not t or t == e.type) and d in (direction, "both")
-                for t, d in rel_filter
-            ):
+            if not rel_ok(e, direction):
                 continue
             try:
                 other = storage.get_node(other_id)
@@ -502,13 +507,23 @@ def _bfs_subgraph(storage, start: Node, rel_filter, label_filter,
                 continue
             if allow and not (set(other.labels) & allow):
                 continue
-            all_rels[e.id] = e
             if other.id in visited:
                 continue
             visited.add(other.id)
             p = PathValue(nodes + [other], rels + [e])
             tree_paths.append(p)
             queue.append((other, rels + [e], nodes + [other]))
+    # relationships = ALL matching edges between subgraph nodes, including
+    # frontier-to-frontier edges never expanded by the tree walk (real
+    # APOC subgraphAll semantics)
+    all_rels: Dict[str, Edge] = {}
+    for nid in visited:
+        for e in storage.get_node_edges(nid, Direction.BOTH):
+            direction = "out" if e.start_node == nid else "in"
+            if not rel_ok(e, direction):
+                continue
+            if e.start_node in visited and e.end_node in visited:
+                all_rels[e.id] = e
     return tree_paths, all_rels
 
 

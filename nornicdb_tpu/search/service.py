@@ -190,6 +190,8 @@ class SearchService:
         return n
 
     def _needs_reindex(self, node: Node) -> bool:
+        if any(lbl.startswith("_") for lbl in node.labels):
+            return False  # system nodes never enter this index (index_node)
         if (node.updated_at or 0) > self._saved_at_ms:
             return True
         has_vec = node.embedding is not None or node.chunk_embeddings
@@ -215,19 +217,25 @@ class SearchService:
         import os
 
         os.makedirs(self.persist_dir, exist_ok=True)
+        # capture under the service lock, but do the (slow) compression
+        # and disk writes OUTSIDE it — the index objects snapshot under
+        # their own locks, so searches/indexing keep flowing during the
+        # multi-second write of a large matrix
         with self._lock:
             saved_at = int(time.time() * 1000)
             bm25_doc = self.bm25.to_dict()
-            self.vectors.save(os.path.join(self.persist_dir, "vectors.npz.tmp"))
-            if self.hnsw is not None:
-                # HNSWIndex.save appends .npz itself
-                self.hnsw.save(os.path.join(self.persist_dir, "hnsw.tmp"))
+            vectors = self.vectors
+            hnsw = self.hnsw
+        vectors.save(os.path.join(self.persist_dir, "vectors.npz.tmp"))
+        if hnsw is not None:
+            # HNSWIndex.save appends .npz itself
+            hnsw.save(os.path.join(self.persist_dir, "hnsw.tmp"))
         with open(os.path.join(self.persist_dir, "bm25.json.tmp"), "w") as f:
             json.dump(bm25_doc, f)
         meta = {
             "format": self._FORMAT_VERSION,
             "saved_at_ms": saved_at,
-            "has_hnsw": self.hnsw is not None,
+            "has_hnsw": hnsw is not None,
             "strategy": self.stats.strategy,
         }
         with open(os.path.join(self.persist_dir, "meta.json.tmp"), "w") as f:

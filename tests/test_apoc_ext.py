@@ -287,3 +287,13 @@ def test_path_expand_min_level_zero(ex):
 def test_stdev_bias_corrected_default(ex):
     assert _val(ex, "apoc.coll.stdev([1,2,3])") == pytest.approx(1.0)
     assert _val(ex, "apoc.coll.stdev([1,2,3], false)") == pytest.approx(0.8165, abs=1e-3)
+
+
+def test_subgraph_all_includes_frontier_edges(ex):
+    """Review regression: edges between two max-level nodes belong to the
+    subgraph (real APOC semantics)."""
+    ex.execute("CREATE (a:F {n:'a'})-[:E]->(b:F {n:'b'}), (a)-[:E]->(c:F {n:'c'}), "
+               "(b)-[:E]->(c)")
+    r = ex.execute("MATCH (a:F {n:'a'}) CALL apoc.path.subgraphAll(a, {maxLevel: 1}) "
+                   "YIELD nodes, relationships RETURN size(nodes), size(relationships)")
+    assert r.rows == [[3, 3]]
