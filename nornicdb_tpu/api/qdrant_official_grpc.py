@@ -17,7 +17,30 @@ from typing import Any, Dict, List, Optional
 import grpc
 
 from nornicdb_tpu.api.proto import qdrant_pb2 as q
-from nornicdb_tpu.api.qdrant import QdrantError
+from nornicdb_tpu.api.qdrant import QdrantError, _match_filter
+
+
+def _iter_matching_points(compat, name: str, flt: Optional[Dict[str, Any]],
+                          with_payload: bool = True,
+                          with_vector: bool = False):
+    """Stream points of a collection in scroll (id) order, filtered.
+    Pages through scroll_points so no full-collection materialization or
+    silent cap is involved."""
+    offset = None
+    while True:
+        page = compat.scroll_points(name, offset=offset, limit=10_000,
+                                    with_payload=True,
+                                    with_vector=with_vector)
+        for d in page["points"]:
+            if flt is None or _match_filter(
+                d.get("payload") or {}, flt, point_id=d["id"]
+            ):
+                if not with_payload:
+                    d = {**d, "payload": None}
+                yield d
+        offset = page.get("next_page_offset")
+        if offset is None:
+            return
 
 
 # -- value conversion -----------------------------------------------------
@@ -92,12 +115,18 @@ def filter_to_dict(flt: "q.Filter") -> Optional[Dict[str, Any]]:
                 out["match"] = {"value": fc.match.boolean}
             elif mwhich == "text":
                 out["match"] = {"text": fc.match.text}
+            elif mwhich is not None:
+                raise QdrantError(f"unsupported match kind {mwhich!r}")
             rng = {}
             for field in ("lt", "gt", "gte", "lte"):
                 if fc.range.HasField(field):
                     rng[field] = getattr(fc.range, field)
             if rng:
                 out["range"] = rng
+            if "match" not in out and "range" not in out:
+                raise QdrantError(
+                    f"field condition on {fc.key!r} has no supported "
+                    "match or range clause")
             return out
         if which == "has_id":
             ids = [point_id_to_py(p) for p in c.has_id.has_id]
@@ -108,7 +137,7 @@ def filter_to_dict(flt: "q.Filter") -> Optional[Dict[str, Any]]:
             return {"is_null": c.is_null.key}
         if which == "is_empty":
             return {"is_empty": c.is_empty.key}
-        return {}
+        raise QdrantError(f"unsupported filter condition {which!r}")
 
     return {
         "must": [cond_to_dict(c) for c in flt.must],
@@ -318,16 +347,10 @@ class OfficialPointsServicer:
                 self.compat.delete_points(request.collection_name, ids)
             elif which == "filter":
                 flt = filter_to_dict(request.points.filter)
-                page = self.compat.scroll_points(
-                    request.collection_name, limit=1_000_000)
-                doomed = []
-                from nornicdb_tpu.api.qdrant import _match_filter
-
-                for d in page["points"]:
-                    if flt is None or _match_filter(
-                        d.get("payload") or {}, flt, point_id=d["id"]
-                    ):
-                        doomed.append(d["id"])
+                doomed = [
+                    d["id"] for d in _iter_matching_points(
+                        self.compat, request.collection_name, flt)
+                ]
                 self.compat.delete_points(request.collection_name, doomed)
         except QdrantError as e:
             _abort(context, e)
@@ -354,11 +377,12 @@ class OfficialPointsServicer:
 
     def Search(self, request, context):
         t0 = time.time()
+        offset = int(request.offset) if request.HasField("offset") else 0
         try:
             hits = self.compat.search_points(
                 request.collection_name,
                 list(request.vector),
-                limit=int(request.limit) or 10,
+                limit=(int(request.limit) or 10) + offset,
                 with_payload=_with_payload(request.with_payload),
                 with_vector=_with_vectors(request),
                 score_threshold=(
@@ -368,7 +392,6 @@ class OfficialPointsServicer:
             )
         except QdrantError as e:
             _abort(context, e)
-        offset = int(request.offset) if request.HasField("offset") else 0
         return q.SearchResponse(
             result=[self._scored(d) for d in hits[offset:]],
             time=time.time() - t0,
@@ -379,51 +402,54 @@ class OfficialPointsServicer:
         offset = None
         if request.HasField("offset"):
             offset = point_id_to_py(request.offset)
+        limit = int(request.limit) if request.HasField("limit") else 10
         try:
-            page = self.compat.scroll_points(
-                request.collection_name,
-                offset=offset,
-                limit=int(request.limit) if request.HasField("limit") else 10,
-                with_payload=_with_payload(request.with_payload),
-                with_vector=_with_vectors(request),
-            )
+            flt = filter_to_dict(request.filter)
+            if flt is None:
+                page = self.compat.scroll_points(
+                    request.collection_name,
+                    offset=offset,
+                    limit=limit,
+                    with_payload=_with_payload(request.with_payload),
+                    with_vector=_with_vectors(request),
+                )
+                points = page["points"]
+                next_offset = page.get("next_page_offset")
+            else:
+                # qdrant semantics: a page holds up to `limit` MATCHING
+                # points; next_page_offset is the following match's id
+                points = []
+                next_offset = None
+                for d in _iter_matching_points(
+                    self.compat, request.collection_name, flt,
+                    with_payload=_with_payload(request.with_payload),
+                    with_vector=_with_vectors(request),
+                ):
+                    if offset is not None and str(d["id"]) < str(offset):
+                        continue
+                    if len(points) == limit:
+                        next_offset = d["id"]
+                        break
+                    points.append(d)
         except QdrantError as e:
             _abort(context, e)
-        flt = filter_to_dict(request.filter)
-        points = page["points"]
-        if flt is not None:
-            from nornicdb_tpu.api.qdrant import _match_filter
-
-            points = [
-                d for d in points
-                if _match_filter(d.get("payload") or {}, flt,
-                                 point_id=d["id"])
-            ]
         resp = q.ScrollResponse(
             result=[self._retrieved(d) for d in points],
             time=time.time() - t0,
         )
-        if page.get("next_page_offset") is not None:
-            resp.next_page_offset.CopyFrom(
-                py_to_point_id(page["next_page_offset"]))
+        if next_offset is not None:
+            resp.next_page_offset.CopyFrom(py_to_point_id(next_offset))
         return resp
 
     def Count(self, request, context):
         t0 = time.time()
-        flt = filter_to_dict(request.filter)
         try:
+            flt = filter_to_dict(request.filter)
             if flt is None:
                 n = self.compat.count_points(request.collection_name)
             else:
-                from nornicdb_tpu.api.qdrant import _match_filter
-
-                page = self.compat.scroll_points(
-                    request.collection_name, limit=1_000_000)
-                n = sum(
-                    1 for d in page["points"]
-                    if _match_filter(d.get("payload") or {}, flt,
-                                     point_id=d["id"])
-                )
+                n = sum(1 for _ in _iter_matching_points(
+                    self.compat, request.collection_name, flt))
         except QdrantError as e:
             _abort(context, e)
         return q.CountResponse(

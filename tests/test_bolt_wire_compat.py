@@ -1,0 +1,389 @@
+"""Bolt wire-format compatibility (VERDICT r1 weak #6).
+
+The official neo4j driver is not installed in this image, so two
+independent checks replace the driver e2e:
+
+1. GOLDEN VECTORS: exact byte encodings taken from the published
+   PackStream v1 / Bolt 4.x specifications (7687.org / Neo4j docs) —
+   asserted against BOTH directions of the repo codec. A self-consistent
+   wire bug (encoder and decoder wrong the same way) fails here.
+2. INDEPENDENT CLIENT: a from-spec mini Bolt client implemented in this
+   file with its OWN encoder/decoder (zero imports from the server's
+   packstream module) runs a real session: handshake, HELLO, RUN/PULL
+   with parameters, BEGIN/COMMIT, node decoding.
+
+Reference contract: pkg/bolt/server.go:141-158 (versions 4.0-4.4, magic
+0x6060B017, message signatures), packstream.go.
+"""
+
+import socket
+import struct
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.api.bolt import BoltServer
+
+
+# ---------------------------------------------------------------- golden
+
+# (value, spec bytes) — from the PackStream specification.
+GOLDEN = [
+    (None, b"\xC0"),
+    (True, b"\xC3"),
+    (False, b"\xC2"),
+    # TINY_INT: -16..127 inline
+    (0, b"\x00"),
+    (42, b"\x2A"),
+    (127, b"\x7F"),
+    (-1, b"\xFF"),
+    (-16, b"\xF0"),
+    # INT_8
+    (-17, b"\xC8\xEF"),
+    (-128, b"\xC8\x80"),
+    # INT_16
+    (128, b"\xC9\x00\x80"),
+    (-129, b"\xC9\xFF\x7F"),
+    (1234, b"\xC9\x04\xD2"),
+    # INT_32
+    (32768, b"\xCA\x00\x00\x80\x00"),
+    (-32769, b"\xCA\xFF\xFF\x7F\xFF"),
+    # INT_64
+    (2147483648, b"\xCB\x00\x00\x00\x00\x80\x00\x00\x00"),
+    # FLOAT_64
+    (1.23, b"\xC1\x3F\xF3\xAE\x14\x7A\xE1\x47\xAE"),
+    (-1.25, b"\xC1\xBF\xF4\x00\x00\x00\x00\x00\x00"),
+    # STRING
+    ("", b"\x80"),
+    ("a", b"\x81a"),
+    ("hello", b"\x85hello"),
+    ("é", b"\x82\xC3\xA9"),  # utf-8 multi-byte
+    ("a" * 16, b"\xD0\x10" + b"a" * 16),  # STRING_8 at length 16
+    ("a" * 256, b"\xD1\x01\x00" + b"a" * 256),  # STRING_16
+    # LIST
+    ([], b"\x90"),
+    ([1, 2, 3], b"\x93\x01\x02\x03"),
+    (list(range(16)), b"\xD4\x10" + bytes(range(16))),  # LIST_8
+    # MAP
+    ({}, b"\xA0"),
+    ({"a": 1}, b"\xA1\x81a\x01"),
+    ({"one": "eins"}, b"\xA1\x83one\x84eins"),
+    # BYTES
+    (b"\x01\x02", b"\xCC\x02\x01\x02"),
+]
+
+
+class TestGoldenVectors:
+    @pytest.mark.parametrize("value,wire", GOLDEN,
+                             ids=[repr(g[0])[:30] for g in GOLDEN])
+    def test_encode_matches_spec(self, value, wire):
+        from nornicdb_tpu.api.packstream import Packer
+
+        p = Packer()
+        p.pack(value)
+        assert p.data() == wire, (
+            f"encoder disagrees with PackStream spec for {value!r}: "
+            f"{p.data().hex()} != {wire.hex()}"
+        )
+
+    @pytest.mark.parametrize("value,wire", GOLDEN,
+                             ids=[repr(g[0])[:30] for g in GOLDEN])
+    def test_decode_matches_spec(self, value, wire):
+        from nornicdb_tpu.api.packstream import unpack
+
+        got = unpack(wire)
+        assert got == value
+        if isinstance(value, bool) or value is None:
+            assert type(got) is type(value)
+
+    def test_struct_encoding(self):
+        # Structure with tag 0x01 and one field "a": B1 01 81 61
+        from nornicdb_tpu.api.packstream import Packer, Structure
+
+        p = Packer()
+        p.pack(Structure(0x01, ["a"]))
+        assert p.data() == b"\xB1\x01\x81a"
+
+    def test_temporal_structure_tags(self):
+        # Bolt spec: Date 'D'=0x44 days; Duration 'E'=0x45 (months, days,
+        # seconds, nanoseconds); Point2D 'X'=0x58 (srid, x, y)
+        from nornicdb_tpu.api.packstream import Packer
+        from nornicdb_tpu.query.temporal_types import (
+            CypherDuration, make_date, make_point,
+        )
+
+        p = Packer()
+        p.pack(make_date("1970-01-02"))
+        assert p.data() == b"\xB1\x44\x01"  # 1 day since epoch
+        p = Packer()
+        p.pack(CypherDuration(0, 0, 1, 0))
+        assert p.data() == b"\xB4\x45\x00\x00\x01\x00"
+
+
+# ------------------------------------------------- independent mini client
+#
+# Everything below is written from the PackStream/Bolt specifications and
+# deliberately imports nothing from nornicdb_tpu.api.packstream.
+
+
+def enc(v) -> bytes:
+    if v is None:
+        return b"\xC0"
+    if v is True:
+        return b"\xC3"
+    if v is False:
+        return b"\xC2"
+    if isinstance(v, int):
+        if -16 <= v <= 127:
+            return struct.pack(">b", v) if v < 0 else bytes([v])
+        if -128 <= v <= 127:
+            return b"\xC8" + struct.pack(">b", v)
+        if -32768 <= v <= 32767:
+            return b"\xC9" + struct.pack(">h", v)
+        if -2147483648 <= v <= 2147483647:
+            return b"\xCA" + struct.pack(">i", v)
+        return b"\xCB" + struct.pack(">q", v)
+    if isinstance(v, float):
+        return b"\xC1" + struct.pack(">d", v)
+    if isinstance(v, str):
+        b = v.encode("utf-8")
+        n = len(b)
+        if n < 16:
+            return bytes([0x80 + n]) + b
+        if n < 256:
+            return b"\xD0" + bytes([n]) + b
+        return b"\xD1" + struct.pack(">H", n) + b
+    if isinstance(v, list):
+        n = len(v)
+        head = bytes([0x90 + n]) if n < 16 else b"\xD4" + bytes([n])
+        return head + b"".join(enc(x) for x in v)
+    if isinstance(v, dict):
+        n = len(v)
+        head = bytes([0xA0 + n]) if n < 16 else b"\xD8" + bytes([n])
+        return head + b"".join(enc(str(k)) + enc(x) for k, x in v.items())
+    raise TypeError(type(v))
+
+
+def enc_struct(tag: int, *fields) -> bytes:
+    return bytes([0xB0 + len(fields), tag]) + b"".join(enc(f) for f in fields)
+
+
+class _Dec:
+    def __init__(self, data: bytes):
+        self.d = data
+        self.i = 0
+
+    def take(self, n):
+        b = self.d[self.i:self.i + n]
+        self.i += n
+        return b
+
+    def value(self):
+        m = self.take(1)[0]
+        if m == 0xC0:
+            return None
+        if m == 0xC2:
+            return False
+        if m == 0xC3:
+            return True
+        if m <= 0x7F:
+            return m
+        if m >= 0xF0:
+            return m - 0x100
+        if m == 0xC8:
+            return struct.unpack(">b", self.take(1))[0]
+        if m == 0xC9:
+            return struct.unpack(">h", self.take(2))[0]
+        if m == 0xCA:
+            return struct.unpack(">i", self.take(4))[0]
+        if m == 0xCB:
+            return struct.unpack(">q", self.take(8))[0]
+        if m == 0xC1:
+            return struct.unpack(">d", self.take(8))[0]
+        if 0x80 <= m <= 0x8F:
+            return self.take(m - 0x80).decode()
+        if m == 0xD0:
+            return self.take(self.take(1)[0]).decode()
+        if m == 0xD1:
+            return self.take(struct.unpack(">H", self.take(2))[0]).decode()
+        if 0x90 <= m <= 0x9F:
+            return [self.value() for _ in range(m - 0x90)]
+        if m == 0xD4:
+            return [self.value() for _ in range(self.take(1)[0])]
+        if 0xA0 <= m <= 0xAF:
+            return {self.value(): self.value() for _ in range(m - 0xA0)}
+        if m == 0xD8:
+            return {self.value(): self.value() for _ in range(self.take(1)[0])}
+        if 0xB0 <= m <= 0xBF:
+            n = m - 0xB0
+            tag = self.take(1)[0]
+            return ("struct", tag, [self.value() for _ in range(n)])
+        if m == 0xCC:
+            return self.take(self.take(1)[0])
+        raise ValueError(f"marker {m:#x}")
+
+
+class SpecBoltClient:
+    """Minimal Bolt 4.4 client written from the spec."""
+
+    MAGIC = b"\x60\x60\xB0\x17"
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.sock.sendall(self.MAGIC)
+        versions = struct.pack(">I", 0x00000404) + b"\x00" * 12
+        self.sock.sendall(versions)
+        chosen = self.sock.recv(4)
+        assert chosen == b"\x00\x00\x04\x04", chosen.hex()
+
+    def send(self, tag: int, *fields):
+        payload = enc_struct(tag, *fields)
+        # chunked framing: 2-byte size header + data, 00 00 terminator
+        msg = b""
+        for i in range(0, len(payload), 0xFFFF):
+            chunk = payload[i:i + 0xFFFF]
+            msg += struct.pack(">H", len(chunk)) + chunk
+        msg += b"\x00\x00"
+        self.sock.sendall(msg)
+
+    def _read_exact(self, n):
+        out = b""
+        while len(out) < n:
+            b = self.sock.recv(n - len(out))
+            if not b:
+                raise ConnectionError("closed")
+            out += b
+        return out
+
+    def recv(self):
+        payload = b""
+        while True:
+            size = struct.unpack(">H", self._read_exact(2))[0]
+            if size == 0:
+                if payload:
+                    break
+                continue
+            payload += self._read_exact(size)
+        kind, tag, fields = _Dec(payload).value()
+        assert kind == "struct"
+        return tag, fields
+
+    def drain(self):
+        records = []
+        while True:
+            tag, fields = self.recv()
+            if tag == 0x71:  # RECORD
+                records.append(fields[0])
+            else:
+                return tag, fields, records
+
+    def close(self):
+        self.sock.close()
+
+
+MSG_HELLO, MSG_RUN, MSG_PULL = 0x01, 0x10, 0x3F
+MSG_BEGIN, MSG_COMMIT, MSG_ROLLBACK = 0x11, 0x12, 0x13
+MSG_SUCCESS, MSG_FAILURE = 0x70, 0x7F
+
+
+@pytest.fixture()
+def server():
+    db = nornicdb_tpu.open(auto_embed=False)
+    srv = BoltServer(db, port=0).start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+@pytest.fixture()
+def client(server):
+    c = SpecBoltClient(server.port)
+    c.send(MSG_HELLO, {"user_agent": "spec-client/1.0", "scheme": "none"})
+    tag, fields = c.recv()
+    assert tag == MSG_SUCCESS
+    assert "server" in fields[0]
+    yield c
+    c.close()
+
+
+class TestIndependentClient:
+    def test_handshake_and_hello(self, client):
+        pass  # the fixture IS the test
+
+    def test_create_and_match_roundtrip(self, client):
+        client.send(MSG_RUN,
+                    "CREATE (n:Wire {name: $n, count: $c}) RETURN n.name",
+                    {"n": "golden", "c": 7}, {})
+        tag, fields = client.recv()
+        assert tag == MSG_SUCCESS
+        assert fields[0]["fields"] == ["n.name"]
+        client.send(MSG_PULL, {"n": -1})
+        tag, fields, records = client.drain()
+        assert tag == MSG_SUCCESS
+        assert records == [["golden"]]
+
+        client.send(MSG_RUN, "MATCH (n:Wire) RETURN n.count + 1", {}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        _, _, records = client.drain()
+        assert records == [[8]]
+
+    def test_node_struct_decoding(self, client):
+        client.send(MSG_RUN, "CREATE (n:Wire {name: 'x'}) RETURN n", {}, {})
+        assert client.recv()[0] == MSG_SUCCESS
+        client.send(MSG_PULL, {"n": -1})
+        tag, _, records = client.drain()
+        assert tag == MSG_SUCCESS
+        node = records[0][0]
+        kind, struct_tag, fields = node
+        # Bolt Node structure: tag 0x4E ('N'), [id, labels, properties]
+        assert struct_tag == 0x4E
+        assert "Wire" in fields[1]
+        assert isinstance(fields[2], dict)
+
+    def test_explicit_transaction(self, client):
+        client.send(MSG_BEGIN, {})
+        assert client.recv()[0] == MSG_SUCCESS
+        client.send(MSG_RUN, "CREATE (:TxNode {v: 1})", {}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        client.drain()
+        client.send(MSG_COMMIT)
+        assert client.recv()[0] == MSG_SUCCESS
+        client.send(MSG_RUN, "MATCH (t:TxNode) RETURN count(t)", {}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        _, _, records = client.drain()
+        assert records == [[1]]
+
+    def test_rollback_discards(self, client):
+        client.send(MSG_BEGIN, {})
+        client.recv()
+        client.send(MSG_RUN, "CREATE (:Ghost)", {}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        client.drain()
+        client.send(MSG_ROLLBACK)
+        assert client.recv()[0] == MSG_SUCCESS
+        client.send(MSG_RUN, "MATCH (g:Ghost) RETURN count(g)", {}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        _, _, records = client.drain()
+        assert records == [[0]]
+
+    def test_failure_shape(self, client):
+        client.send(MSG_RUN, "THIS IS NOT CYPHER", {}, {})
+        tag, fields = client.recv()
+        assert tag == MSG_FAILURE
+        assert "code" in fields[0] and "message" in fields[0]
+        # RESET recovers the session
+        client.send(0x0F)  # RESET
+        assert client.recv()[0] == MSG_SUCCESS
+
+    def test_unicode_and_large_strings(self, client):
+        big = "é" * 300 + "🦉"
+        client.send(MSG_RUN, "RETURN $s AS s", {"s": big}, {})
+        client.recv()
+        client.send(MSG_PULL, {"n": -1})
+        _, _, records = client.drain()
+        assert records == [[big]]

@@ -237,3 +237,80 @@ def test_has_id_through_scroll_count_delete(channel):
     resp = _call(channel, "/qdrant.Points/Count",
                  q.CountPoints(collection_name="off6"), q.CountResponse)
     assert resp.result.count == 3
+
+
+def test_search_pagination_offset(channel):
+    req = q.CreateCollection(collection_name="off7")
+    req.vectors_config.params.size = 2
+    req.vectors_config.params.distance = q.Cosine
+    _call(channel, "/qdrant.Collections/Create", req,
+          q.CollectionOperationResponse)
+    up = q.UpsertPoints(collection_name="off7")
+    for i in range(20):
+        p = up.points.add()
+        p.id.num = i
+        p.vectors.vector.data.extend([1.0, float(i) * 0.01])
+    _call(channel, "/qdrant.Points/Upsert", up, q.PointsOperationResponse)
+    sr = q.SearchPoints(collection_name="off7", vector=[1, 0], limit=5)
+    page1 = _call(channel, "/qdrant.Points/Search", sr, q.SearchResponse)
+    sr.offset = 5
+    page2 = _call(channel, "/qdrant.Points/Search", sr, q.SearchResponse)
+    ids1 = [r.id.num for r in page1.result]
+    ids2 = [r.id.num for r in page2.result]
+    assert len(ids1) == 5 and len(ids2) == 5
+    assert not set(ids1) & set(ids2)
+
+
+def test_scroll_filter_fills_pages(channel):
+    req = q.CreateCollection(collection_name="off8")
+    req.vectors_config.params.size = 2
+    req.vectors_config.params.distance = q.Cosine
+    _call(channel, "/qdrant.Collections/Create", req,
+          q.CollectionOperationResponse)
+    up = q.UpsertPoints(collection_name="off8")
+    for i in range(30):
+        p = up.points.add()
+        p.id.num = i
+        p.vectors.vector.data.extend([1.0, 0.0])
+        p.payload["mod"].integer_value = i % 3
+    _call(channel, "/qdrant.Points/Upsert", up, q.PointsOperationResponse)
+    sc = q.ScrollPoints(collection_name="off8", limit=5)
+    c = sc.filter.must.add()
+    c.field.key = "mod"
+    c.field.match.integer = 0
+    got = []
+    while True:
+        resp = _call(channel, "/qdrant.Points/Scroll", sc, q.ScrollResponse)
+        assert len(resp.result) <= 5
+        got.extend(r.id.num for r in resp.result)
+        if not resp.HasField("next_page_offset"):
+            break
+        sc.offset.CopyFrom(resp.next_page_offset)
+    assert sorted(got) == [i for i in range(30) if i % 3 == 0]
+    # first page must be FULL of matches (filter before pagination)
+    sc.ClearField("offset")
+    resp = _call(channel, "/qdrant.Points/Scroll", sc, q.ScrollResponse)
+    assert len(resp.result) == 5
+
+
+def test_unsupported_filter_rejected_not_match_all(channel):
+    req = q.CreateCollection(collection_name="off9")
+    req.vectors_config.params.size = 2
+    req.vectors_config.params.distance = q.Cosine
+    _call(channel, "/qdrant.Collections/Create", req,
+          q.CollectionOperationResponse)
+    up = q.UpsertPoints(collection_name="off9")
+    p = up.points.add()
+    p.id.num = 1
+    p.vectors.vector.data.extend([1.0, 0.0])
+    _call(channel, "/qdrant.Points/Upsert", up, q.PointsOperationResponse)
+    # FieldCondition with no match/range clause would otherwise match all
+    dl = q.DeletePoints(collection_name="off9")
+    c = dl.points.filter.must.add()
+    c.field.key = "anything"
+    with pytest.raises(grpc.RpcError) as err:
+        _call(channel, "/qdrant.Points/Delete", dl, q.PointsOperationResponse)
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    resp = _call(channel, "/qdrant.Points/Count",
+                 q.CountPoints(collection_name="off9"), q.CountResponse)
+    assert resp.result.count == 1  # nothing was wiped
