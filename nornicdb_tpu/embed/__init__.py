@@ -12,5 +12,11 @@ from nornicdb_tpu.embed.embedder import (  # noqa: F401
     HashEmbedder,
     JaxEncoderEmbedder,
 )
+from nornicdb_tpu.embed.http_providers import (  # noqa: F401
+    EmbedHTTPError,
+    OllamaEmbedder,
+    OpenAIEmbedder,
+    make_http_embedder,
+)
 from nornicdb_tpu.embed.tokenizer import HashTokenizer, chunk_tokens  # noqa: F401
 from nornicdb_tpu.embed.queue import EmbedQueue  # noqa: F401

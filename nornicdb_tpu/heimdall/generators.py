@@ -60,13 +60,28 @@ class EchoGenerator:
 
 class JAXGenerator:
     """In-process TPU SLM (reference analog: local GGUF llama.cpp
-    backend). Weights come from a checkpoint when provided; otherwise
-    random init (serving machinery identical)."""
+    backend). Weights resolve in order: explicit params > checkpoint
+    path > the committed tiny checkpoint (trained in-repo,
+    heimdall/train.py) > random init as a last resort."""
 
-    def __init__(self, name: str = "heimdall-slm", cfg=None, params=None):
+    def __init__(self, name: str = "heimdall-slm", cfg=None, params=None,
+                 checkpoint: Optional[str] = None):
         from nornicdb_tpu.heimdall.model import DecoderModel
 
         self.name = name
+        if params is None:
+            from nornicdb_tpu.heimdall.train import (
+                default_checkpoint_path,
+                load_params,
+            )
+
+            path = checkpoint or default_checkpoint_path()
+            if path is not None:
+                try:
+                    cfg, params = load_params(path)
+                except (OSError, KeyError, ValueError):
+                    if checkpoint is not None:
+                        raise  # explicit checkpoint must not fail silently
         self.model = DecoderModel(cfg=cfg, params=params)
 
     def param_bytes(self) -> int:

@@ -106,18 +106,20 @@ def _block(cfg, lp, x, k_cache, v_cache, pos_mask):
     return x
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def prefill(cfg: DecoderConfig, params, tokens: jnp.ndarray,
-            length: jnp.ndarray):
-    """tokens: [max_seq] int32 (PAD-padded); length: scalar actual length.
-    Returns (logits_at_last, caches) where caches[l] = (k [S,D], v [S,D])."""
+def forward_full(cfg: DecoderConfig, params, tokens: jnp.ndarray,
+                 key_valid: jnp.ndarray):
+    """Shared full-sequence forward used by BOTH inference prefill and
+    training (heimdall/train.py) — one definition, so train-time and
+    generation-time math cannot drift. tokens: [S] int32; key_valid: [S]
+    bool (which key positions are real). Returns (all_logits [S, V],
+    caches)."""
     s = cfg.max_seq
     x = params["embed"][tokens] + params["pos"]
     x = x.astype(jnp.bfloat16)
     positions = jnp.arange(s)
     causal = positions[None, :] <= positions[:, None]  # [T, S]
-    valid = positions[None, :] < length  # keys must be real tokens
-    mask = causal & (valid | (positions[None, :] == positions[:, None]))
+    mask = causal & (key_valid[None, :]
+                     | (positions[None, :] == positions[:, None]))
     caches = []
     for lp in params["layers"]:
         lp16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), lp)
@@ -126,8 +128,18 @@ def prefill(cfg: DecoderConfig, params, tokens: jnp.ndarray,
         x = _block(cfg, lp16, x, k, v, mask)
         caches.append((k, v))
     x = _rms_norm(x, params["ln_f"].astype(jnp.bfloat16))
-    logits = (x[length - 1] @ params["embed"].astype(jnp.bfloat16).T)
+    logits = x @ params["embed"].astype(jnp.bfloat16).T
     return logits.astype(jnp.float32), caches
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill(cfg: DecoderConfig, params, tokens: jnp.ndarray,
+            length: jnp.ndarray):
+    """tokens: [max_seq] int32 (PAD-padded); length: scalar actual length.
+    Returns (logits_at_last, caches) where caches[l] = (k [S,D], v [S,D])."""
+    key_valid = jnp.arange(cfg.max_seq) < length
+    logits, caches = forward_full(cfg, params, tokens, key_valid)
+    return logits[length - 1], caches
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new"))

@@ -1,0 +1,196 @@
+"""HTTP embedding providers + trained Heimdall checkpoint (VERDICT r1
+item 10; reference: pkg/embed/embed.go:342 NewOllama, :640 NewOpenAI;
+pkg/heimdall shipping a real SLM)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.embed import (
+    EmbedHTTPError,
+    OllamaEmbedder,
+    OpenAIEmbedder,
+    make_http_embedder,
+)
+
+
+class _MockHandler(BaseHTTPRequestHandler):
+    """Speaks both the Ollama and OpenAI embedding wire contracts."""
+
+    fail_next = 0  # 5xx injections
+    seen_auth = []
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def do_POST(self):  # noqa: N802
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        if _MockHandler.fail_next > 0:
+            _MockHandler.fail_next -= 1
+            self.send_response(503)
+            self.end_headers()
+            self.wfile.write(b"overloaded")
+            return
+        if self.path == "/api/embeddings":
+            vec = self._vec(body["prompt"])
+            doc = {"embedding": vec}
+        elif self.path == "/v1/embeddings":
+            _MockHandler.seen_auth.append(
+                self.headers.get("Authorization"))
+            data = [
+                {"index": i, "embedding": self._vec(t)}
+                for i, t in enumerate(body["input"])
+            ]
+            data.reverse()  # clients must honor the index field
+            doc = {"data": data, "model": body["model"]}
+        elif self.path == "/v1/bad-shape/embeddings":
+            doc = {"data": []}
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        payload = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    @staticmethod
+    def _vec(text):
+        rng = np.random.default_rng(abs(hash(text)) % (2**32))
+        return [round(float(x), 6) for x in rng.standard_normal(8)]
+
+
+@pytest.fixture(scope="module")
+def mock_server():
+    srv = HTTPServer(("127.0.0.1", 0), _MockHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+class TestOllamaProvider:
+    def test_embed_roundtrip(self, mock_server):
+        e = OllamaEmbedder(base_url=mock_server, model="test-model")
+        v = e.embed("hello world")
+        assert len(v) == 8
+        assert v == e.embed("hello world")  # deterministic mock
+        assert v != e.embed("different")
+
+    def test_batch(self, mock_server):
+        e = OllamaEmbedder(base_url=mock_server)
+        vs = e.embed_batch(["a", "b"])
+        assert len(vs) == 2 and vs[0] != vs[1]
+
+    def test_retries_on_5xx(self, mock_server):
+        e = OllamaEmbedder(base_url=mock_server, retries=2)
+        _MockHandler.fail_next = 1
+        assert len(e.embed("after retry")) == 8
+
+    def test_hard_failure_raises(self):
+        e = OllamaEmbedder(base_url="http://127.0.0.1:1", retries=0,
+                           timeout=0.5)
+        with pytest.raises(EmbedHTTPError):
+            e.embed("x")
+
+
+class TestOpenAIProvider:
+    def test_batch_order_restored_from_index(self, mock_server):
+        e = OpenAIEmbedder(api_key="sk-test", base_url=mock_server + "/v1")
+        vs = e.embed_batch(["first", "second", "third"])
+        # mock reverses data; index field must restore order
+        assert vs[0] == OllamaEmbedder(base_url=mock_server).embed("first")
+
+    def test_bearer_auth_header_sent(self, mock_server):
+        _MockHandler.seen_auth.clear()
+        e = OpenAIEmbedder(api_key="sk-secret", base_url=mock_server + "/v1")
+        e.embed("x")
+        assert _MockHandler.seen_auth == ["Bearer sk-secret"]
+
+    def test_wrong_cardinality_raises(self, mock_server):
+        e = OpenAIEmbedder(base_url=mock_server + "/v1/bad-shape")
+        with pytest.raises(EmbedHTTPError):
+            e.embed("x")
+
+    def test_factory(self, mock_server):
+        assert isinstance(make_http_embedder("ollama", base_url=mock_server),
+                          OllamaEmbedder)
+        assert isinstance(make_http_embedder("openai"), OpenAIEmbedder)
+        with pytest.raises(ValueError):
+            make_http_embedder("huggingface")
+
+
+class TestEndToEndIngestViaHTTPProvider:
+    def test_store_embed_search(self, mock_server):
+        """ingest -> embed via HTTP provider -> hybrid search (VERDICT
+        done-criterion for item 10)."""
+        import nornicdb_tpu
+
+        db = nornicdb_tpu.open(
+            embedder=OllamaEmbedder(base_url=mock_server))
+        db.store("the aurora appears over northern norway", node_id="a1")
+        db.store("submarine cables cross the atlantic", node_id="a2")
+        db.flush()
+        hits = db.recall("aurora norway")
+        assert hits and hits[0]["id"] == "a1"
+        db.close()
+
+
+class TestHeimdallCheckpoint:
+    @property
+    def CKPT(self):
+        from nornicdb_tpu.heimdall.train import default_checkpoint_path
+
+        path = default_checkpoint_path()
+        assert path is not None, "committed checkpoint missing"
+        return path
+
+    def test_checkpoint_loads_and_generates_corpus_text(self):
+        from nornicdb_tpu.heimdall.model import DecoderModel
+        from nornicdb_tpu.heimdall.train import load_params
+
+        cfg, params = load_params(self.CKPT)
+        m = DecoderModel(cfg, params)
+        out = m.generate("vector search runs on", max_tokens=40,
+                         temperature=0.0)
+        # trained on DEFAULT_CORPUS: the greedy completion must finish
+        # the memorized sentence (non-noise, deterministic)
+        assert "tpu" in out, f"unexpected completion {out!r}"
+        assert out == m.generate("vector search runs on", max_tokens=40,
+                                 temperature=0.0)
+
+    def test_roundtrip_save_load_identical(self, tmp_path):
+        from nornicdb_tpu.heimdall.train import load_params, save_params
+
+        cfg, params = load_params(self.CKPT)
+        p2 = str(tmp_path / "copy.npz")
+        save_params(p2, cfg, params)
+        cfg2, params2 = load_params(p2)
+        assert cfg == cfg2
+        np.testing.assert_array_equal(np.asarray(params["embed"]),
+                                      np.asarray(params2["embed"]))
+
+    def test_training_reduces_loss(self):
+        from nornicdb_tpu.heimdall.model import DecoderConfig
+        from nornicdb_tpu.heimdall.train import DEFAULT_CORPUS, train
+
+        cfg = DecoderConfig(d_model=32, n_heads=2, n_layers=1, d_ff=64,
+                            max_seq=64)
+        _, l10 = train(DEFAULT_CORPUS, cfg, steps=10, seed=1)
+        _, l80 = train(DEFAULT_CORPUS, cfg, steps=80, seed=1)
+        assert l80 < l10
+
+
+def test_jax_generator_defaults_to_committed_checkpoint():
+    """The serving path (not just tests) must load the trained weights."""
+    from nornicdb_tpu.heimdall.generators import JAXGenerator
+
+    g = JAXGenerator()
+    out = g.generate("vector search runs on", max_tokens=40, temperature=0.0)
+    assert "tpu" in out, f"generator served random weights: {out!r}"
