@@ -75,7 +75,12 @@ class JAXGenerator:
                 load_params,
             )
 
-            path = checkpoint or default_checkpoint_path()
+            # the committed default only applies when the caller didn't
+            # pin an architecture — a supplied cfg means "that model",
+            # not "whatever the tiny checkpoint happens to be"
+            path = checkpoint or (
+                default_checkpoint_path() if cfg is None else None
+            )
             if path is not None:
                 try:
                     cfg, params = load_params(path)
@@ -102,14 +107,15 @@ class JAXGenerator:
 
 class _HttpGenerator:
     timeout = 60.0
+    retries = 1
 
     def _post(self, url: str, payload: dict, headers: dict) -> dict:
-        req = urllib.request.Request(
-            url, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json", **headers},
-            method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return json.loads(resp.read())
+        # shared retrying POST (embed/http_providers.py) — one HTTP
+        # helper for both the embedding and generation backends
+        from nornicdb_tpu.embed.http_providers import _post_json
+
+        return _post_json(url, payload, headers=headers,
+                          timeout=self.timeout, retries=self.retries)
 
 
 class OpenAIGenerator(_HttpGenerator):

@@ -94,8 +94,16 @@ class CypherExecutor:
     (reference: cypher.NewStorageExecutor, wired at db.go:974)."""
 
     def __init__(self, storage: Engine, cache_size: int = 1024,
-                 cache_ttl: float = 60.0):
+                 cache_ttl: float = 60.0, parser_mode: Optional[str] = None):
+        import os
+
         self.storage = storage
+        # 'fast' (default) or 'strict' (diagnostic validation before
+        # execution — reference: NORNICDB_PARSER antlr mode,
+        # cypher-parser-modes.md)
+        self.parser_mode = (
+            parser_mode or os.environ.get("NORNICDB_TPU_PARSER", "fast")
+        ).lower()
         self._search = None
         self._lock = threading.Lock()
         self._plugin_functions: Dict[str, Any] = {}
@@ -151,6 +159,10 @@ class CypherExecutor:
             return self._execute_explain(rest, params)
         if head == "PROFILE" and boundary:
             return self._execute_profile(rest, params)
+        if self.parser_mode == "strict":
+            from nornicdb_tpu.query.strict import assert_valid
+
+            assert_valid(query)
         uq = parse(query)
         cache_key = None
         if self.enable_query_cache and _is_read_only(uq):

@@ -101,6 +101,65 @@ def run_procedure(
             yield {"node": node, "score": float(score)}
         return
 
+    if name == "gds.version":
+        yield {"version": "2.x-compat (nornicdb-tpu)"}
+        return
+
+    if name.startswith("gds.graph.") or name == "gds.fastrp.stream":
+        # graph catalog + FastRP (reference: pkg/cypher/fastrp.go:8-26)
+        from nornicdb_tpu.ops.fastrp import GdsGraphCatalog
+
+        catalog = getattr(executor, "gds_catalog", None)
+        if catalog is None:
+            catalog = GdsGraphCatalog()
+            executor.gds_catalog = catalog
+        if name == "gds.graph.project":
+            if len(args) < 3:
+                raise CypherRuntimeError(
+                    "gds.graph.project(name, nodeProjection, relProjection)")
+            g = catalog.project(storage, str(args[0]),
+                                args[1] if args[1] != "*" else None,
+                                args[2] if args[2] != "*" else None)
+            yield {
+                "graphName": g["name"], "nodeCount": g["nodeCount"],
+                "relationshipCount": g["relationshipCount"],
+                "nodeProjection": g["nodeProjection"],
+                "relationshipProjection": g["relationshipProjection"],
+            }
+            return
+        if name == "gds.graph.list":
+            for g in catalog.list():
+                yield {"graphName": g["name"], "nodeCount": g["nodeCount"],
+                       "relationshipCount": g["relationshipCount"]}
+            return
+        if name == "gds.graph.drop":
+            g = catalog.drop(str(args[0]) if args else "")
+            if g is None:
+                raise CypherRuntimeError(f"graph {args[0]!r} not found")
+            yield {"graphName": g["name"]}
+            return
+        if name == "gds.fastrp.stream":
+            if not args:
+                raise CypherRuntimeError(
+                    "gds.fastRP.stream(graphName, config)")
+            cfg = args[1] if len(args) > 1 else {}
+            cfg = cfg or {}
+            try:
+                ids, emb = catalog.fastrp(
+                    str(args[0]),
+                    dim=int(cfg.get("embeddingDimension", 64)),
+                    iteration_weights=cfg.get("iterationWeights",
+                                              (0.0, 1.0, 1.0)),
+                    normalization_strength=float(
+                        cfg.get("normalizationStrength", 0.0)),
+                    seed=int(cfg.get("randomSeed", 42)),
+                )
+            except KeyError as e:
+                raise CypherRuntimeError(str(e))
+            for nid, vec in zip(ids, emb):
+                yield {"nodeId": nid, "embedding": [float(x) for x in vec]}
+            return
+
     if name.startswith("gds.linkprediction."):
         # Neo4j GDS link-prediction procedures (reference:
         # pkg/cypher/linkprediction.go:1-559 — always available, result

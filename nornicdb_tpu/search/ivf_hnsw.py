@@ -113,16 +113,44 @@ class IVFHNSWIndex:
 
     # -- search ----------------------------------------------------------
 
+    def route(
+        self, query: Sequence[float], nprobe: Optional[int] = None,
+        lexical_doc_ids: Optional[Sequence[str]] = None,
+        lexical_weight: float = 0.3,
+    ) -> np.ndarray:
+        """Pick the clusters to probe. With ``lexical_doc_ids`` (e.g.
+        BM25 top hits) the semantic centroid similarity is blended with
+        each cluster's share of the lexical hits — hybrid cluster routing
+        (reference: hybrid_cluster_routing.go:248-256): a cluster full of
+        keyword-matching docs gets probed even when its centroid is not
+        among the cosine-nearest."""
+        assert self.centroids is not None
+        nprobe = min(nprobe or self.nprobe, self.centroids.shape[0])
+        q = _normalize(np.asarray(query, dtype=np.float32))
+        sims = self.centroids @ q  # [-1, 1]
+        if lexical_doc_ids:
+            lex = np.zeros(self.centroids.shape[0], np.float32)
+            with self._lock:
+                for ext_id in lexical_doc_ids:
+                    c = self._where.get(ext_id)
+                    if c is not None:
+                        lex[c] += 1.0
+            if lex.sum() > 0:
+                lex /= lex.sum()
+                # lexical share scaled to [0, 2] so a keyword-dominant
+                # cluster can outrank a max-similarity centroid (1.0)
+                sims = (1.0 - lexical_weight) * sims + lexical_weight * 2.0 * lex
+        return np.argpartition(-sims, nprobe - 1)[:nprobe]
+
     def search(
         self, query: Sequence[float], k: int = 10,
         nprobe: Optional[int] = None, ef: Optional[int] = None,
+        lexical_doc_ids: Optional[Sequence[str]] = None,
     ) -> List[Tuple[str, float]]:
         if self.centroids is None:
             return []
         q = _normalize(np.asarray(query, dtype=np.float32))
-        nprobe = min(nprobe or self.nprobe, self.centroids.shape[0])
-        sims = self.centroids @ q
-        probe = np.argpartition(-sims, nprobe - 1)[:nprobe]
+        probe = self.route(q, nprobe, lexical_doc_ids=lexical_doc_ids)
         hits: List[Tuple[str, float]] = []
         for c in probe:
             idx = self.clusters.get(int(c))

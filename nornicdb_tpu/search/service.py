@@ -390,14 +390,21 @@ class SearchService:
         return out
 
     def vector_search_candidates(
-        self, query_vec: Sequence[float], k: int = 10, exact: bool = False
+        self, query_vec: Sequence[float], k: int = 10, exact: bool = False,
+        lexical_doc_ids: Optional[Sequence[str]] = None,
     ) -> List[Tuple[str, float]]:
         """Raw vector candidates (reference: VectorSearchCandidates
-        search.go:3045). Strategy: HNSW if built (unless exact), else brute."""
+        search.go:3045). Strategy: HNSW if built (unless exact), else the
+        doc space's index. Cluster-routed indexes (IVF-HNSW) additionally
+        take the BM25 top hits for hybrid probe selection
+        (reference: hybrid_cluster_routing.go:248-256)."""
         with self._lock:
             hnsw = self.hnsw
         if hnsw is not None and not exact:
             return hnsw.search(query_vec, k)
+        if lexical_doc_ids and hasattr(self.vectors, "route"):
+            return self.vectors.search(query_vec, k,
+                                       lexical_doc_ids=lexical_doc_ids)
         return self.vectors.search(query_vec, k)
 
     def search(
@@ -426,7 +433,10 @@ class SearchService:
                 else (self._query_embedding(query) if query.strip() else None)
             )
             if qv is not None and len(self.vectors) > 0:
-                vec_hits = self.vector_search_candidates(qv, overfetch)
+                vec_hits = self.vector_search_candidates(
+                    qv, overfetch,
+                    lexical_doc_ids=[d for d, _ in bm25_hits[:32]],
+                )
 
         if bm25_hits and vec_hits:
             fused = rrf_fuse([bm25_hits, vec_hits], limit=overfetch)

@@ -1,0 +1,386 @@
+"""Depth components from VERDICT r1: temporal pattern detector +
+relationship evolution (ref pkg/temporal), FastRP + GDS graph catalog
+(ref fastrp.go), hybrid cluster routing (ref
+hybrid_cluster_routing.go:248), strict parser mode (ref pkg/cypher/antlr
++ parser_comparison_test.go)."""
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+# ----------------------------------------------------- pattern detection
+
+
+class TestPatternDetector:
+    def test_daily_pattern(self):
+        from nornicdb_tpu.temporal import PatternDetector
+
+        pd = PatternDetector()
+        base = 1_700_000_000.0
+        base -= base % 86400  # midnight
+        # access at ~09:00 every day for a week
+        for day in range(7):
+            pd.record_access("n", base + day * 86400 + 9 * 3600)
+            pd.record_access("n", base + day * 86400 + 9 * 3600 + 600)
+        pats = pd.detect_patterns("n", now=base + 7 * 86400)
+        types = {p.type for p in pats}
+        assert "daily" in types
+        hour, _day, conf = pd.peak_access_time("n")
+        assert hour == 9
+        assert conf > 0.5
+
+    def test_weekly_pattern(self):
+        from nornicdb_tpu.temporal import PatternDetector
+
+        pd = PatternDetector()
+        base = 1_700_000_000.0
+        base -= base % 86400
+        # every Monday-ish (same weekday) for 6 weeks, random-ish hours
+        for week in range(6):
+            for h in (8, 13, 19):
+                pd.record_access("w", base + week * 7 * 86400 + h * 3600)
+        pats = pd.detect_patterns("w", now=base + 6 * 7 * 86400)
+        assert any(p.type == "weekly" for p in pats)
+
+    def test_burst_pattern(self):
+        from nornicdb_tpu.temporal import PatternDetector
+
+        pd = PatternDetector()
+        now = 1_700_000_000.0
+        for i in range(10):
+            pd.record_access("b", now - i * 60)  # all in the last 10 min
+        pats = pd.detect_patterns("b", now=now)
+        assert any(p.type == "burst" for p in pats)
+
+    def test_trend_patterns_from_velocity(self):
+        from nornicdb_tpu.temporal import PatternDetector
+
+        pd = PatternDetector()
+        assert pd.has_pattern("x", "growing", velocity=0.5)
+        assert pd.has_pattern("x", "decaying", velocity=-0.5)
+        assert not pd.has_pattern("x", "growing", velocity=0.0)
+
+    def test_no_pattern_on_sparse_history(self):
+        from nornicdb_tpu.temporal import PatternDetector
+
+        pd = PatternDetector()
+        pd.record_access("s", 1_700_000_000.0)
+        assert pd.detect_patterns("s", now=1_700_000_100.0) == []
+
+
+class TestRelationshipEvolution:
+    def test_strengthening_and_prediction(self):
+        from nornicdb_tpu.temporal import RelationshipEvolution
+
+        re_ = RelationshipEvolution()
+        t = 1_700_000_000.0
+        for i in range(10):
+            re_.record_co_access("a", "b", weight=1.0, at=t + i * 60)
+        tr = re_.get_trend("a", "b")
+        assert tr is not None
+        assert tr.trend == "strengthening"
+        assert tr.velocity > 0
+        assert re_.predict_strength("a", "b", steps=5) > tr.current_strength
+
+    def test_weakening_via_decayed_updates(self):
+        from nornicdb_tpu.temporal import RelationshipEvolution
+
+        re_ = RelationshipEvolution()
+        t = 1_700_000_000.0
+        weights = [20.0 - 2.0 * i for i in range(10)]  # steep decline
+        for i, w in enumerate(weights):
+            re_.update_weight("a", "b", w, at=t + i * 60)
+        tr = re_.get_trend("a", "b")
+        assert tr.trend == "weakening"
+        assert re_.weakening()[0].source_id == "a"
+
+    def test_emerging_and_prune(self):
+        from nornicdb_tpu.temporal import RelationshipEvolution
+
+        re_ = RelationshipEvolution()
+        t = 1_700_000_000.0
+        for i in range(5):
+            re_.record_co_access("new1", "new2", at=t + i * 30)
+        emerging = re_.emerging(now=t + 200)
+        assert [(e.source_id, e.target_id) for e in emerging] == [
+            ("new1", "new2")]
+        assert re_.should_prune("ghost", "edge")
+        assert not re_.should_prune("new1", "new2", threshold=0.1)
+
+    def test_symmetric_keying(self):
+        from nornicdb_tpu.temporal import RelationshipEvolution
+
+        re_ = RelationshipEvolution()
+        re_.record_co_access("b", "a")
+        assert re_.get_trend("a", "b") is not None
+
+
+# --------------------------------------------------------------- FastRP
+
+
+class TestFastRP:
+    def _community_graph(self):
+        """Two dense 10-node communities joined by one bridge edge."""
+        import random
+
+        rng = random.Random(3)
+        src, dst = [], []
+        for base in (0, 10):
+            for i in range(10):
+                for j in range(i + 1, 10):
+                    if rng.random() < 0.7:
+                        src.append(base + i)
+                        dst.append(base + j)
+        src.append(0)
+        dst.append(10)
+        return np.asarray(src), np.asarray(dst)
+
+    def test_embeddings_cluster_communities(self):
+        from nornicdb_tpu.ops.fastrp import fastrp_embeddings
+
+        src, dst = self._community_graph()
+        emb = fastrp_embeddings(20, src, dst, dim=32, seed=7)
+        assert emb.shape == (20, 32)
+        sims = emb @ emb.T
+        intra = np.mean([sims[i, j] for i in range(10) for j in range(10)
+                         if i != j])
+        inter = np.mean([sims[i, j] for i in range(10)
+                         for j in range(10, 20)])
+        assert intra > inter + 0.2, (intra, inter)
+
+    def test_deterministic_by_seed(self):
+        from nornicdb_tpu.ops.fastrp import fastrp_embeddings
+
+        src, dst = self._community_graph()
+        a = fastrp_embeddings(20, src, dst, dim=16, seed=1)
+        b = fastrp_embeddings(20, src, dst, dim=16, seed=1)
+        c = fastrp_embeddings(20, src, dst, dim=16, seed=2)
+        np.testing.assert_array_equal(a, b)
+        assert not np.allclose(a, c)
+
+    def test_gds_procedures_end_to_end(self):
+        ex = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+        for i in range(6):
+            ex.execute("CREATE (:P {i: $i})", {"i": i})
+        for a, b in [(0, 1), (1, 2), (2, 0), (3, 4), (4, 5), (5, 3)]:
+            ex.execute("MATCH (x:P {i:$a}), (y:P {i:$b}) "
+                       "CREATE (x)-[:KNOWS]->(y)", {"a": a, "b": b})
+        r = ex.execute("CALL gds.graph.project('g1', 'P', 'KNOWS') "
+                       "YIELD graphName, nodeCount, relationshipCount "
+                       "RETURN *")
+        rec = r.records()[0]
+        assert rec["nodeCount"] == 6 and rec["relationshipCount"] == 6
+        r = ex.execute(
+            "CALL gds.fastRP.stream('g1', {embeddingDimension: 16}) "
+            "YIELD nodeId, embedding RETURN nodeId, size(embedding)")
+        assert len(r.rows) == 6
+        assert all(row[1] == 16 for row in r.rows)
+        assert ex.execute("CALL gds.graph.list() YIELD graphName "
+                          "RETURN graphName").rows == [["g1"]]
+        ex.execute("CALL gds.graph.drop('g1')")
+        assert ex.execute("CALL gds.graph.list() YIELD graphName "
+                          "RETURN graphName").rows == []
+
+    def test_fastrp_unknown_graph_errors(self):
+        from nornicdb_tpu.errors import CypherRuntimeError
+
+        ex = CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+        with pytest.raises(CypherRuntimeError):
+            ex.execute("CALL gds.fastRP.stream('missing', {})")
+
+
+# ------------------------------------------------ hybrid cluster routing
+
+
+class TestHybridClusterRouting:
+    def _build_index(self):
+        from nornicdb_tpu.search.ivf_hnsw import IVFHNSWIndex
+
+        rng = np.random.default_rng(0)
+        # two well-separated clusters in 16d
+        a_center = np.zeros(16); a_center[0] = 1.0
+        b_center = np.zeros(16); b_center[1] = 1.0
+        items = []
+        for i in range(40):
+            items.append((f"a{i}", a_center + 0.05 * rng.standard_normal(16)))
+        for i in range(40):
+            items.append((f"b{i}", b_center + 0.05 * rng.standard_normal(16)))
+        idx = IVFHNSWIndex(n_clusters=2, nprobe=1)
+        idx.build(items)
+        return idx
+
+    def test_lexical_hits_redirect_probes(self):
+        idx = self._build_index()
+        # query semantically in cluster A...
+        q = np.zeros(16); q[0] = 1.0
+        sem_only = idx.route(q, nprobe=1)
+        # ...but every BM25 hit lives in cluster B
+        lex_ids = [f"b{i}" for i in range(30)]
+        hybrid = idx.route(q, nprobe=1, lexical_doc_ids=lex_ids,
+                           lexical_weight=0.8)
+        assert sem_only[0] != hybrid[0], "lexical evidence must reroute"
+
+    def test_search_accepts_lexical_ids(self):
+        idx = self._build_index()
+        q = np.zeros(16); q[0] = 1.0
+        hits = idx.search(q, k=3, lexical_doc_ids=[f"a{i}" for i in range(5)])
+        assert hits and hits[0][0].startswith("a")
+
+    def test_service_passes_bm25_hits_to_routed_index(self):
+        from nornicdb_tpu.search.service import SearchService
+
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        svc = SearchService(eng)
+        calls = {}
+
+        class _Routed:
+            def __len__(self):
+                return 1
+
+            def route(self, *a, **k):
+                return [0]
+
+            def search(self, q, k, lexical_doc_ids=None):
+                calls["lex"] = lexical_doc_ids
+                return []
+
+        from nornicdb_tpu.storage.types import Node
+
+        n = Node(id="d1", labels=["Doc"],
+                 properties={"content": "tigers roam"}, embedding=[1.0, 0.0])
+        eng.create_node(n)
+        svc.index_node(eng.get_node("d1"))
+        svc.vectors = _Routed()
+        svc.search("tigers", query_embedding=[1.0, 0.0])
+        assert calls.get("lex") == ["d1"]
+
+
+# ------------------------------------------------------ strict parser mode
+
+
+class TestStrictParserMode:
+    def test_undefined_variable_rejected(self):
+        from nornicdb_tpu.query.strict import validate
+
+        diags = validate("MATCH (n:P) RETURN m")
+        assert any(d.severity == "error" and "`m`" in d.message
+                   for d in diags)
+
+    def test_aggregate_in_where_rejected(self):
+        from nornicdb_tpu.query.strict import validate
+
+        diags = validate("MATCH (n:P) WHERE count(n) > 1 RETURN n")
+        assert any("aggregate" in d.message for d in diags)
+
+    def test_unknown_function_warns(self):
+        from nornicdb_tpu.query.strict import validate
+
+        diags = validate("RETURN totallyMadeUp(1)")
+        assert any(d.severity == "warning" for d in diags)
+
+    def test_syntax_error_has_line_col(self):
+        from nornicdb_tpu.query.strict import validate
+
+        diags = validate("MATCH (n:P)\nRETURN n + ")
+        assert diags[0].severity == "error"
+        assert diags[0].line == 2
+
+    def test_strict_executor_rejects_before_execution(self):
+        from nornicdb_tpu.errors import CypherSyntaxError
+
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        ex = CypherExecutor(eng, parser_mode="strict")
+        with pytest.raises(CypherSyntaxError):
+            ex.execute("MATCH (n:P) RETURN nope")
+        assert eng.count_nodes() == 0
+
+    # parity corpus: strict mode must accept exactly what the fast path
+    # accepts (reference: parser_comparison_test.go)
+    ACCEPT = [
+        "MATCH (n:Person) RETURN n.name",
+        "MATCH (a)-[r:KNOWS]->(b) WHERE a.age > 30 RETURN b, count(r)",
+        "CREATE (n:X {v: 1}) RETURN n",
+        "MATCH (n) WITH n.age AS age, count(*) AS c RETURN age, c",
+        "UNWIND [1,2,3] AS x RETURN x * 2",
+        "MATCH (n) WHERE all(l IN labels(n) WHERE l <> 'Banned') RETURN n",
+        "RETURN reduce(acc = 0, x IN [1,2] | acc + x)",
+        "MATCH (a:P), (b:Q) CREATE (a)-[:REL]->(b)",
+        "CALL db.labels() YIELD label RETURN label",
+        "MATCH p = (a)-[:K*1..3]->(b) RETURN length(p)",
+    ]
+    REJECT = [
+        "MATCH (n:P RETURN n",
+        "MATCH (n) RETURN undefined_var",
+        "MATCH (a)-[]->(b) CREATE (a)-[]->(b)",  # typeless CREATE rel
+        "RETURN 1 +",
+    ]
+
+    @pytest.mark.parametrize("query", ACCEPT)
+    def test_parity_accept(self, query):
+        from nornicdb_tpu.query.strict import validate
+
+        errors = [d for d in validate(query) if d.severity == "error"]
+        assert errors == [], f"strict rejected valid query: {errors}"
+
+    @pytest.mark.parametrize("query", REJECT)
+    def test_parity_reject(self, query):
+        from nornicdb_tpu.errors import CypherRuntimeError, CypherSyntaxError
+        from nornicdb_tpu.query.strict import validate
+
+        strict_errors = [d for d in validate(query) if d.severity == "error"]
+        # the fast path must also reject (parse or runtime). The fast
+        # path is lazy — errors surface only when rows flow — so seed a
+        # node; strict mode's value is catching these BEFORE execution.
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        ex = CypherExecutor(eng)
+        ex.execute("CREATE (:Seed {v: 1})-[:S]->(:Seed {v: 2})")
+        fast_rejects = False
+        try:
+            ex.execute(query)
+        except (CypherSyntaxError, CypherRuntimeError):
+            fast_rejects = True
+        assert strict_errors and fast_rejects, (
+            f"parity broken: strict={bool(strict_errors)} "
+            f"fast_rejects={fast_rejects}"
+        )
+
+
+def test_strict_yield_star_keeps_columns_usable():
+    """Review regression: CALL ... YIELD * must not flag yielded columns
+    as undefined."""
+    from nornicdb_tpu.query.strict import validate
+
+    errors = [d for d in validate(
+        "CALL db.labels() YIELD * RETURN label"
+    ) if d.severity == "error"]
+    assert errors == []
+
+
+def test_daily_peak_hour_exact():
+    """Review regression: single-hour concentration reports that hour."""
+    from nornicdb_tpu.temporal import PatternDetector
+
+    pd = PatternDetector()
+    base = 1_700_000_000.0
+    base -= base % 86400
+    for day in range(7):
+        pd.record_access("n", base + day * 86400 + 9 * 3600)
+        pd.record_access("n", base + day * 86400 + 9 * 3600 + 60)
+    pats = pd.detect_patterns("n", now=base + 7 * 86400)
+    daily = [p for p in pats if p.type == "daily"]
+    assert daily and daily[0].peak_hour == 9
+
+
+def test_jax_generator_respects_explicit_cfg():
+    """Review regression: a pinned architecture must not be silently
+    replaced by the committed default checkpoint."""
+    from nornicdb_tpu.heimdall.generators import JAXGenerator
+    from nornicdb_tpu.heimdall.model import DecoderConfig
+
+    cfg = DecoderConfig(d_model=32, n_heads=2, n_layers=1, d_ff=64,
+                        max_seq=64)
+    g = JAXGenerator(cfg=cfg)
+    assert g.model.cfg == cfg
