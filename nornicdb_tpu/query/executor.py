@@ -122,6 +122,10 @@ class CypherExecutor:
             max_size=cache_size, ttl_seconds=cache_ttl
         )
         self.enable_query_cache = True
+        # parsed-AST cache keyed by query text (reference: cached
+        # QueryAnalyzer.Analyze, executor.go:624) — parsing is ~15% of a
+        # fast-path query; ASTs are immutable after parse
+        self._parse_cache: LRUCache = LRUCache(max_size=512)
         # apoc.trigger.* registry; statements fire after updating queries
         from nornicdb_tpu.query.apoc_ext import TriggerRegistry
 
@@ -163,7 +167,7 @@ class CypherExecutor:
             from nornicdb_tpu.query.strict import assert_valid
 
             assert_valid(query)
-        uq = parse(query)
+        uq = self._parse_cached(query)
         cache_key = None
         if self.enable_query_cache and _is_read_only(uq):
             cache_key = _cache_key(query, params, uq)
@@ -216,15 +220,25 @@ class CypherExecutor:
                 self.triggers.fire(self)
         return result
 
+    def _parse_cached(self, query: str) -> "A.UnionQuery":
+        uq = self._parse_cache.get(query)
+        if uq is None:
+            uq = parse(query)
+            self._parse_cache.put(query, uq)
+        return uq
+
     def _execute_for_trigger(self, statement: str,
                              params: Optional[Dict[str, Any]] = None
                              ) -> "CypherResult":
         """Nested execution for triggers / apoc.periodic / apoc.cypher.run:
-        bypasses the read cache and suppresses re-entrant trigger firing."""
+        bypasses the read cache and suppresses re-entrant trigger firing.
+        Uses the parse cache — a trigger statement re-fires on every
+        updating query with identical text."""
         prev = self._in_trigger
         self._in_trigger = True
         try:
-            return self._execute_parsed(parse(statement), params or {})
+            return self._execute_parsed(self._parse_cached(statement),
+                                        params or {})
         finally:
             self._in_trigger = prev
 
@@ -235,7 +249,7 @@ class CypherExecutor:
         (reference: executeExplain, explain.go:95)."""
         from nornicdb_tpu.query.explain import build_plan, plan_rows
 
-        uq = parse(query)
+        uq = self._parse_cached(query)
         plan = build_plan(self.storage, uq)
         cols, rows = plan_rows(plan)
         return CypherResult(columns=cols, rows=rows, plan=plan.to_dict())
@@ -248,7 +262,7 @@ class CypherExecutor:
         explain.go:110)."""
         from nornicdb_tpu.query.explain import CountingEngine, build_plan
 
-        uq = parse(query)
+        uq = self._parse_cached(query)
         plan = build_plan(self.storage, uq)
         counting = CountingEngine(self.storage)
         result = self._execute_parsed(uq, params, storage=counting)

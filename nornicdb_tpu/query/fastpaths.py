@@ -667,6 +667,28 @@ def _first_occurrence(codes: np.ndarray) -> np.ndarray:
     return np.sort(first)
 
 
+def _dense_codes(rows: np.ndarray, n_max: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(uniq_values, inverse_codes) for an int array with values in
+    [0, n_max) — lookup-table based, no sort (argsort in np.unique is
+    the aggregation hot spot at scale)."""
+    flags = np.zeros(n_max, dtype=bool)
+    flags[rows] = True
+    uniq = np.nonzero(flags)[0]
+    lut = np.zeros(n_max, dtype=np.int64)
+    lut[uniq] = np.arange(len(uniq), dtype=np.int64)
+    return uniq, lut[rows]
+
+
+def _int_codes(rows: np.ndarray, n_max: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Strategy switch: dense lookup when the value domain is comparable
+    to the row count (O(n_max) allocation), else sort-based np.unique —
+    a 20-row group on a 50M-node graph must not allocate graph-sized
+    scratch."""
+    if 0 < n_max <= 4 * len(rows) + 4096:
+        return _dense_codes(rows, n_max)
+    return np.unique(rows, return_inverse=True)
+
+
 def _group_code_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
     """Dense int64 group codes for one grouping-key expression.
 
@@ -681,11 +703,11 @@ def _group_code_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
         name = e.target.name
         if name in b.node_cols:
             rows = b.node_cols[name]
-            uniq_rows, inv = np.unique(rows, return_inverse=True)
+            uniq_rows, inv = _int_codes(rows, catalog.n_nodes())
             vals = catalog.node_prop_col(e.name)[uniq_rows]
         elif name in b.edge_cols:
             table, erows = b.edge_cols[name]
-            uniq_rows, inv = np.unique(erows, return_inverse=True)
+            uniq_rows, inv = _int_codes(erows, len(table))
             vals = table.prop_col(e.name)[uniq_rows]
         else:
             _bail()
@@ -693,10 +715,11 @@ def _group_code_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
         return vcodes[inv]
     if isinstance(e, A.Var):
         if e.name in b.node_cols:
-            _, inv = np.unique(b.node_cols[e.name], return_inverse=True)
+            _, inv = _int_codes(b.node_cols[e.name], catalog.n_nodes())
             return inv
         if e.name in b.edge_cols:
-            _, inv = np.unique(b.edge_cols[e.name][1], return_inverse=True)
+            table, erows = b.edge_cols[e.name]
+            _, inv = _int_codes(erows, len(table))
             return inv
         _bail()
     # anything else: evaluate the value column and hash it
@@ -707,8 +730,15 @@ def _group_code_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
 
 def _combine_codes(code_cols: List[np.ndarray]) -> np.ndarray:
     combined = np.zeros(len(code_cols[0]), dtype=np.int64)
+    span = 1
     for c in code_cols:
-        combined = combined * (int(c.max()) + 1 if len(c) else 1) + c
+        width = int(c.max()) + 1 if len(c) else 1
+        combined = combined * width + c
+        span *= width
+    if 0 < span <= 4 * len(combined) + 4096:
+        # dense lookup beats the sort inside np.unique
+        _, codes = _dense_codes(combined, span)
+        return codes
     _, codes = np.unique(combined, return_inverse=True)
     return codes
 
@@ -818,8 +848,7 @@ def _agg_leaf(
     if name == "count" and e.star:
         cnt = np.bincount(codes, minlength=n_groups)[:n_groups]
         out = np.empty(n_groups, dtype=object)
-        for i in range(n_groups):
-            out[i] = int(cnt[i])
+        out[:] = cnt.tolist()  # C-speed int64 -> python int
         return out
     if not e.args:
         _bail()
@@ -840,7 +869,8 @@ def _agg_leaf(
 
                 vcodes, _ = _gc([values_obj])
             else:
-                _, vcodes = np.unique(vals, return_inverse=True)
+                _, vcodes = _int_codes(
+                    vals, int(vals.max()) + 1 if len(vals) else 1)
             sel = nonnull
             pair = codes[sel] * (int(vcodes.max()) + 1 if len(vcodes) else 1) + vcodes[sel]
             uniq_pairs = np.unique(pair)
@@ -850,8 +880,7 @@ def _agg_leaf(
         else:
             cnt = np.bincount(codes[nonnull], minlength=n_groups)[:n_groups]
         out = np.empty(n_groups, dtype=object)
-        for i in range(n_groups):
-            out[i] = int(cnt[i])
+        out[:] = cnt.tolist()
         return out
 
     if values_obj is None:
@@ -916,8 +945,7 @@ def _agg_leaf(
             for x in values_obj.tolist()
             if x is not None
         )
-        for i in range(n_groups):
-            out[i] = int(s[i]) if all_int else float(s[i])
+        out[:] = (s.astype(np.int64) if all_int else s).tolist()
         return out
     if name == "avg":
         s = np.bincount(codes, weights=safe, minlength=n_groups)[:n_groups]
