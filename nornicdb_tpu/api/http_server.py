@@ -11,6 +11,7 @@ admin. Built on stdlib ThreadingHTTPServer (no flask in this image).
 from __future__ import annotations
 
 import base64
+import functools
 import json
 import re
 import threading
@@ -278,7 +279,12 @@ class HttpServer:
             return 200, self.metrics.render(self._metric_snapshot())
         if parsed.path == "/" and method == "GET":
             return 200, {"server": SERVER_NAME, "version": API_VERSION,
-                         "bolt": "bolt://", "transaction": "/db/{name}/tx"}
+                         "bolt": "bolt://", "transaction": "/db/{name}/tx",
+                         "browser": "/browser"}
+        if parsed.path in ("/browser", "/browser/") and method == "GET":
+            # embedded admin browser (reference: ui/ React app served by
+            # the binary via embed.go)
+            return 200, _browser_html()
         if parsed.path == "/auth/login" and method == "POST":
             return self._login(payload)
 
@@ -366,12 +372,20 @@ class HttpServer:
         dbs: List[str] = [self.default_database]
         if self.database_manager is not None:
             dbs = [d.name for d in self.database_manager.list_databases()]
-        return {
+        doc = {
             "server": SERVER_NAME, "version": API_VERSION,
             "databases": dbs,
             "counts": {"nodes": self.db.storage.count_nodes(),
                        "edges": self.db.storage.count_edges()},
         }
+        svc = self.db._search  # don't force an index build from /status
+        if svc is not None:
+            doc["search"] = {
+                "indexed_docs": svc.stats.indexed_docs,
+                "indexed_vectors": svc.stats.indexed_vectors,
+                "strategy": svc.stats.strategy,
+            }
+        return doc
 
     def _login(self, payload: Dict[str, Any]) -> Tuple[int, Any]:
         if self.authenticator is None:
@@ -855,6 +869,18 @@ def _jsonable(value: Any) -> Any:
     except ImportError:  # pragma: no cover
         pass
     return value
+
+
+@functools.lru_cache(maxsize=1)
+def _browser_html() -> str:
+    """The embedded single-page admin browser (nornicdb_tpu/ui/),
+    loaded once per process (matches PLAYGROUND_HTML in graphql.py)."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ui", "browser.html")
+    with open(path, encoding="utf-8") as f:
+        return f.read()
 
 
 def _backup(storage, target_path: str) -> int:

@@ -270,3 +270,35 @@ class TestMcp:
     def test_unknown_method(self, server):
         code, body = self._rpc(server.port, "bogus/method")
         assert body["error"]["code"] == -32601
+
+
+class TestEmbeddedBrowser:
+    """Embedded admin browser (reference: ui/ React app via embed.go)."""
+
+    def test_browser_route_serves_spa(self, server):
+        code, body = req(server.port, "/browser", "GET")
+        assert code == 200
+        text = body if isinstance(body, str) else body.decode()
+        assert "NornicDB-TPU Browser" in text
+        # the page drives these endpoints; both must exist
+        for path, method, payload in [
+            ("/db/neo4j/tx/commit", "POST",
+             {"statements": [{"statement": "RETURN 1"}]}),
+            ("/status", "GET", None),
+        ]:
+            code, _doc = req(server.port, path, method, payload)
+            assert code == 200, path
+
+    def test_root_advertises_browser(self, server):
+        code, doc = req(server.port, "/", "GET")
+        assert code == 200
+        assert doc.get("browser") == "/browser"
+
+    def test_status_includes_search_block_after_use(self, server):
+        req(server.port, "/nornicdb/search", "POST",
+            {"query": "anything", "limit": 1})
+        code, doc = req(server.port, "/status", "GET")
+        assert code == 200
+        assert "search" in doc
+        assert set(doc["search"]) == {"indexed_docs", "indexed_vectors",
+                                      "strategy"}
