@@ -420,7 +420,8 @@ class HttpServer:
                 self.metrics.inc("cypher_writes_total")
                 self.audit.record(DATA_WRITE, "cypher", actor=username or "",
                                   database=database)
-            return 200, self._run_statements(executor, statements)
+            return 200, self._run_statements(executor, statements,
+                                               database=database)
 
         # POST /db/{name}/tx — open explicit tx
         if len(segments) == 3 and method == "POST":
@@ -432,7 +433,8 @@ class HttpServer:
             ex = CypherExecutor(tx)
             with self._lock:
                 self._tx_executors[tx_id] = ex
-            result = self._run_statements(ex, statements)
+            result = self._run_statements(ex, statements,
+                                          database=database)
             result["commit"] = f"/db/{database}/tx/{tx_id}/commit"
             result["transaction"] = {"id": tx_id}
             return 201, result
@@ -446,7 +448,8 @@ class HttpServer:
             raise HTTPError(404, "Neo.ClientError.Transaction.TransactionNotFound",
                             f"transaction {tx_id} not found")
         if len(segments) == 5 and segments[4] == "commit":
-            result = self._run_statements(ex, statements)
+            result = self._run_statements(ex, statements,
+                                          database=database)
             self.tx_manager.commit(tx_id)
             with self._lock:
                 self._tx_executors.pop(tx_id, None)
@@ -457,16 +460,24 @@ class HttpServer:
                 self._tx_executors.pop(tx_id, None)
             return 200, {"results": [], "errors": []}
         if method == "POST":
-            return 200, self._run_statements(ex, statements)
+            return 200, self._run_statements(ex, statements,
+                                           database=database)
         raise HTTPError(405, "Neo.ClientError.Request.Invalid", "bad method")
 
-    def _run_statements(self, executor, statements) -> Dict[str, Any]:
+    def _run_statements(self, executor, statements,
+                        database: Optional[str] = None) -> Dict[str, Any]:
         results, errors = [], []
         for stmt in statements:
             q = stmt.get("statement", "")
             params = stmt.get("parameters", {}) or {}
             try:
+                if database is not None and self.database_manager is not None:
+                    # per-db rate limits + result caps (reference:
+                    # pkg/multidb limits.go + enforcement.go)
+                    self.database_manager.enforce_query(database, _is_write(q))
                 r = executor.execute(q, params)
+                if database is not None and self.database_manager is not None:
+                    self.database_manager.truncate_result(database, r)
             except Exception as e:  # noqa: BLE001 — per-statement errors
                 errors.append({"code": _http_error_code(e), "message": str(e)})
                 break  # Neo4j stops at first error
@@ -837,9 +848,14 @@ def _is_write(query: str) -> bool:
 
 def _http_error_code(e: Exception) -> str:
     from nornicdb_tpu.errors import CypherSyntaxError
+    from nornicdb_tpu.multidb import DatabaseLimitExceeded
 
     if isinstance(e, CypherSyntaxError):
         return "Neo.ClientError.Statement.SyntaxError"
+    if isinstance(e, DatabaseLimitExceeded):
+        # distinct, retryable class: clients must be able to tell a
+        # throttle from a genuine execution failure
+        return "Neo.ClientError.Request.RateLimited"
     return "Neo.DatabaseError.Statement.ExecutionFailed"
 
 

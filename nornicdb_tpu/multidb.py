@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -33,10 +34,14 @@ class DatabaseLimitExceeded(DatabaseError):
 
 @dataclass
 class DatabaseLimits:
-    """Per-database quotas (reference: limits.go). 0 = unlimited."""
+    """Per-database quotas (reference: limits.go StorageLimits +
+    QueryLimits + RateLimits). 0 = unlimited."""
 
     max_nodes: int = 0
     max_edges: int = 0
+    max_results: int = 0            # rows returned per query
+    max_queries_per_second: int = 0
+    max_writes_per_second: int = 0
 
 
 @dataclass
@@ -79,6 +84,8 @@ class DatabaseManager:
         self._lock = threading.Lock()
         self._dbs: Dict[str, DatabaseInfo] = {}
         self._engines: Dict[str, ListenableEngine] = {}
+        # per-db (window_second, queries, writes) for rate enforcement
+        self._rate_windows: Dict[str, tuple] = {}
         self._dbs[SYSTEM_DB] = DatabaseInfo(name=SYSTEM_DB, system=True)
         self._dbs[default_database] = DatabaseInfo(name=default_database, default=True)
         # adopt pre-existing namespaces found in the store (restart path)
@@ -123,6 +130,7 @@ class DatabaseManager:
             # concurrent create_database(name) can't race the deletion
             info.status = "dropping"
             self._engines.pop(name, None)
+            self._rate_windows.pop(name, None)
         try:
             # prefix sweep outside the lock — can be large
             self._base.delete_by_prefix(name + ":")
@@ -179,6 +187,41 @@ class DatabaseManager:
                 eng = ListenableEngine(LimitedEngine(self._base, name, info.limits))
                 self._engines[name] = eng
             return eng
+
+    def enforce_query(self, name: str, is_write: bool = False) -> None:
+        """Per-database rate limiting (reference: enforcement.go; fixed
+        one-second windows). Raises DatabaseLimitExceeded when the
+        database's query or write rate is exhausted."""
+        info = self.get_info(name)
+        lim = info.limits
+        if not (lim.max_queries_per_second or lim.max_writes_per_second):
+            return
+        now = int(time.time())
+        with self._lock:
+            win, q, w = self._rate_windows.get(name, (now, 0, 0))
+            if win != now:
+                win, q, w = now, 0, 0
+            q += 1
+            if is_write:
+                w += 1
+            self._rate_windows[name] = (win, q, w)
+        if lim.max_queries_per_second and q > lim.max_queries_per_second:
+            raise DatabaseLimitExceeded(
+                f"database {name!r} query rate limit "
+                f"{lim.max_queries_per_second}/s exceeded")
+        if is_write and lim.max_writes_per_second and (
+            w > lim.max_writes_per_second
+        ):
+            raise DatabaseLimitExceeded(
+                f"database {name!r} write rate limit "
+                f"{lim.max_writes_per_second}/s exceeded")
+
+    def truncate_result(self, name: str, result) -> None:
+        """Cap result rows at the database's max_results (reference:
+        QueryLimits.MaxResults)."""
+        lim = self.get_info(name).limits
+        if lim.max_results and len(result.rows) > lim.max_results:
+            del result.rows[lim.max_results:]
 
     def counts(self, name: str) -> Dict[str, int]:
         eng = self.get_storage(name)

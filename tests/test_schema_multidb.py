@@ -223,3 +223,141 @@ class TestDatabaseManager:
         mgr.create_database("a")  # neo4j counts as user db #1
         with pytest.raises(DatabaseLimitExceeded):
             mgr.create_database("b")
+
+
+class TestQueryAndRateLimits:
+    """Per-database query/rate limits (reference: pkg/multidb/limits.go
+    QueryLimits + RateLimits, enforcement.go)."""
+
+    def _manager(self):
+        from nornicdb_tpu.multidb import DatabaseLimits, DatabaseManager
+        from nornicdb_tpu.storage import MemoryEngine
+
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("tenant", limits=DatabaseLimits(
+            max_results=3, max_queries_per_second=5,
+            max_writes_per_second=2))
+        return mgr
+
+    def test_result_truncation(self):
+        from nornicdb_tpu.query.executor import CypherExecutor
+
+        mgr = self._manager()
+        ex = CypherExecutor(mgr.get_storage("tenant"))
+        for i in range(10):
+            ex.execute("CREATE (:T {i: $i})", {"i": i})
+        r = ex.execute("MATCH (t:T) RETURN t.i")
+        mgr.truncate_result("tenant", r)
+        assert len(r.rows) == 3
+
+    def test_query_rate_limit(self):
+        from nornicdb_tpu.multidb import DatabaseLimitExceeded
+
+        mgr = self._manager()
+        for _ in range(5):
+            mgr.enforce_query("tenant")
+        import pytest as _pytest
+
+        with _pytest.raises(DatabaseLimitExceeded):
+            mgr.enforce_query("tenant")
+
+    def test_write_rate_limit_separate(self):
+        from nornicdb_tpu.multidb import DatabaseLimitExceeded
+
+        mgr = self._manager()
+        mgr.enforce_query("tenant", is_write=True)
+        mgr.enforce_query("tenant", is_write=True)
+        import pytest as _pytest
+
+        with _pytest.raises(DatabaseLimitExceeded):
+            mgr.enforce_query("tenant", is_write=True)
+
+    def test_unlimited_db_unaffected(self):
+        mgr = self._manager()
+        for _ in range(100):
+            mgr.enforce_query("neo4j")
+
+
+class TestEvidenceAndQC:
+    """Inference evidence buffer + Heimdall QC (reference:
+    pkg/inference/evidence.go, heimdall_qc.go)."""
+
+    def test_evidence_threshold_crossing(self):
+        from nornicdb_tpu.inference import EvidenceBuffer, EvidenceThreshold
+
+        buf = EvidenceBuffer(default=EvidenceThreshold(
+            min_count=3, min_score=1.5, min_sessions=1))
+        assert buf.add("a", "b", "REL", 0.6, session="s1") is None
+        assert buf.add("a", "b", "REL", 0.6, session="s1") is None
+        ev = buf.add("a", "b", "REL", 0.6, session="s1")
+        assert ev is not None and ev.count == 3
+        assert buf.stats()["materialized"] == 1
+
+    def test_evidence_expiry(self):
+        from nornicdb_tpu.inference import EvidenceBuffer, EvidenceThreshold
+
+        buf = EvidenceBuffer(default=EvidenceThreshold(
+            min_count=2, min_score=0.5, max_age_s=10.0))
+        t = 1_000_000.0
+        buf.add("a", "b", "REL", 1.0, at=t)
+        # second signal arrives after expiry: the stale entry resets
+        assert buf.add("a", "b", "REL", 1.0, at=t + 100) is None
+        assert buf.stats()["expired"] == 1
+
+    def test_coaccess_routed_through_evidence(self):
+        from nornicdb_tpu.inference import (
+            EvidenceBuffer, EvidenceThreshold, InferenceEngine,
+        )
+        from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+        from nornicdb_tpu.storage.types import Node
+
+        eng = NamespacedEngine(MemoryEngine(), "test")
+        for nid in ("x", "y"):
+            eng.create_node(Node(id=nid, labels=["M"], properties={}))
+        buf = EvidenceBuffer(default=EvidenceThreshold(
+            min_count=2, min_score=1.0))
+        inf = InferenceEngine(eng, evidence=buf)
+
+        class _Tracker:
+            def co_accessed(self, node_id):
+                return [("y", 5)]
+
+        assert inf.on_access(_Tracker(), "x") == []  # first signal buffered
+        out = inf.on_access(_Tracker(), "x")  # second crosses threshold
+        assert len(out) == 1
+        assert out[0].rel_type == "CO_ACCESSED_WITH"
+
+    def test_heimdall_qc_filters_batch(self):
+        from nornicdb_tpu.inference import HeimdallQC, Suggestion
+        from nornicdb_tpu.storage import MemoryEngine
+
+        qc = HeimdallQC(lambda prompt: "Y\nN\nY", min_confidence_to_skip=0.99)
+        sugs = [Suggestion("a", "b", "R", 0.6, "t"),
+                Suggestion("a", "c", "R", 0.6, "t"),
+                Suggestion("a", "d", "R", 0.6, "t")]
+        approved = qc.review_batch(MemoryEngine(), sugs)
+        assert [s.to_id for s in approved] == ["b", "d"]
+        assert qc.suggestions_in == 3 and qc.suggestions_out == 2
+
+    def test_heimdall_qc_fails_open(self):
+        from nornicdb_tpu.inference import HeimdallQC, Suggestion
+        from nornicdb_tpu.storage import MemoryEngine
+
+        def broken(prompt):
+            raise RuntimeError("model down")
+
+        qc = HeimdallQC(broken)
+        sugs = [Suggestion("a", "b", "R", 0.5, "t")]
+        assert qc.review_batch(MemoryEngine(), sugs) == sugs
+        assert qc.errors == 1
+
+    def test_high_confidence_skips_review(self):
+        from nornicdb_tpu.inference import HeimdallQC, Suggestion
+        from nornicdb_tpu.storage import MemoryEngine
+
+        calls = []
+        qc = HeimdallQC(lambda p: calls.append(p) or "N",
+                        min_confidence_to_skip=0.9)
+        sugs = [Suggestion("a", "b", "R", 0.95, "t")]
+        assert qc.review_batch(MemoryEngine(), sugs) == sugs
+        assert calls == []
