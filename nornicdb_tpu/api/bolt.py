@@ -324,6 +324,11 @@ class BoltServer:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):  # noqa: D102
                 try:
+                    # Bolt is a small-message request/response protocol:
+                    # without TCP_NODELAY, Nagle + delayed ACK stalls
+                    # every exchange ~40ms (observed 22 ops/s vs 2k+)
+                    self.request.setsockopt(socket.IPPROTO_TCP,
+                                            socket.TCP_NODELAY, 1)
                     outer._serve_connection(self.request)
                 except (ConnectionError, OSError, _Goodbye):
                     pass

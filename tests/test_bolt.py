@@ -150,6 +150,7 @@ class TestTransactionOverlay:
 class BoltClient:
     def __init__(self, port):
         self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.sendall(struct.pack(">I", BOLT_MAGIC))
         # propose 4.4 then zeros
         self.sock.sendall(struct.pack(">I", (4 << 8) | 4) + b"\x00" * 12)

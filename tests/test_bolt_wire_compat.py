@@ -230,6 +230,7 @@ class SpecBoltClient:
 
     def __init__(self, port):
         self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.sendall(self.MAGIC)
         versions = struct.pack(">I", 0x00000404) + b"\x00" * 12
         self.sock.sendall(versions)
