@@ -256,9 +256,12 @@ class DB:
     @property
     def inference(self):
         if self._inference is None:
-            from nornicdb_tpu.inference import InferenceEngine
+            from nornicdb_tpu.inference import EvidenceBuffer, InferenceEngine
 
-            self._inference = InferenceEngine(self.storage, self.search)
+            # co-access edges materialize only after accumulated evidence
+            # (reference wiring: evidence buffer ahead of Auto-TLP edges)
+            self._inference = InferenceEngine(
+                self.storage, self.search, evidence=EvidenceBuffer())
         return self._inference
 
     def _start_embed_queue(self):
@@ -305,11 +308,21 @@ class DB:
         return self.search.search(query, limit=limit, **kw)
 
     def remember(self, node_id: str) -> Node:
-        """Fetch a node and record the access for decay/temporal tracking
-        (reference: db.go:2026 Remember)."""
+        """Fetch a node and record the access for decay/temporal tracking;
+        repeated co-access accumulates evidence toward inferred edges
+        (reference: db.go:2026 Remember + inference.OnAccess :778)."""
         node = self.storage.get_node(node_id)
         self.decay.record_access(node_id)
         self.temporal.record_access(node_id)
+        # evidence-gated co-access inference. Only once the inference
+        # engine exists (store/auto-link path created it) — building the
+        # whole search stack as a side effect of a read would surprise
+        # pure-KV users on large stores.
+        if self._inference is not None:
+            try:
+                self._inference.on_access(self._temporal, node_id)
+            except Exception:
+                pass  # inference must never fail a read
         return node
 
     def link(

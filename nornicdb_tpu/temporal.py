@@ -42,6 +42,11 @@ class TemporalTracker:
         self._session_start: Optional[float] = None
         self._session_last: Optional[float] = None
         self._session_nodes: List[str] = []
+        self._session_times: List[Tuple[str, float]] = []
+        # integrated sub-trackers (reference: the Tracker owns pattern
+        # detection and relationship evolution, tracker.go:216)
+        self.patterns = PatternDetector()
+        self.evolution = RelationshipEvolution()
 
     # -- recording ---------------------------------------------------------
 
@@ -61,7 +66,30 @@ class TemporalTracker:
                 self._session_start = at
                 self._session_nodes = []
             self._session_last = at
+            # only accesses inside the co-access window count as
+            # "together" (CO_ACCESS_WINDOW_S — same definition as
+            # co_accessed()); session membership alone can span hours
+            recent = {
+                n for n, t in self._session_times[-8:]
+                if n != node_id and at - t <= CO_ACCESS_WINDOW_S
+            }
             self._session_nodes.append(node_id)
+            self._session_times.append((node_id, at))
+            del self._session_times[:-8]
+        # feed the integrated sub-trackers outside the main lock (they
+        # lock themselves): access histogram + co-access edge strengths
+        self.patterns.record_access(node_id, at)
+        for other in recent:
+            self.evolution.record_co_access(node_id, other, at=at)
+
+    def detect_patterns(self, node_id: str,
+                        now: Optional[float] = None) -> List["DetectedPattern"]:
+        """Patterns for a node, fed with its current Kalman velocity.
+        Pass ``now`` when analyzing replayed/historical timestamps so
+        burst detection judges against the data's clock."""
+        st = self.stats(node_id)
+        vel = st.velocity if st else 0.0
+        return self.patterns.detect_patterns(node_id, velocity=vel, now=now)
 
     # -- queries -----------------------------------------------------------
 
@@ -258,13 +286,25 @@ class RelationshipEvolution:
     def __init__(self, strengthen_threshold: float = 0.01,
                  weaken_threshold: float = -0.01,
                  emerging_max_age_s: float = 7 * 86400.0,
-                 decay_per_day: float = 0.02):
+                 decay_per_day: float = 0.02,
+                 max_edges: int = 50_000):
         self.strengthen_threshold = strengthen_threshold
         self.weaken_threshold = weaken_threshold
         self.emerging_max_age_s = emerging_max_age_s
         self.decay_per_day = decay_per_day
+        self.max_edges = max_edges
         self._edges: Dict[Tuple[str, str], Dict] = {}
         self._lock = threading.Lock()
+
+    def _evict_locked(self) -> None:
+        """Bound per-pair state: on overflow drop the least-recently
+        bumped 10% (the tracker feeds this from the access hot path, so
+        unbounded growth would be O(accessed-pairs) memory)."""
+        if len(self._edges) < self.max_edges:
+            return
+        by_age = sorted(self._edges.items(), key=lambda kv: kv[1]["last_at"])
+        for k, _ in by_age[: max(1, self.max_edges // 10)]:
+            del self._edges[k]
 
     @staticmethod
     def _key(a: str, b: str) -> Tuple[str, str]:
@@ -278,6 +318,7 @@ class RelationshipEvolution:
         with self._lock:
             tr = self._edges.get(key)
             if tr is None:
+                self._evict_locked()
                 tr = {"filter": VelocityKalmanFilter(), "raw": 0.0,
                       "first_at": at, "last_at": at}
                 self._edges[key] = tr
@@ -295,6 +336,7 @@ class RelationshipEvolution:
         with self._lock:
             tr = self._edges.get(key)
             if tr is None:
+                self._evict_locked()
                 tr = {"filter": VelocityKalmanFilter(), "raw": new_weight,
                       "first_at": at, "last_at": at}
                 self._edges[key] = tr

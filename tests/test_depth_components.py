@@ -384,3 +384,45 @@ def test_jax_generator_respects_explicit_cfg():
                         max_seq=64)
     g = JAXGenerator(cfg=cfg)
     assert g.model.cfg == cfg
+
+
+def test_tracker_integrates_patterns_and_evolution():
+    """PatternDetector/RelationshipEvolution are live INSIDE the tracker
+    (not standalone-only): record_access feeds both."""
+    from nornicdb_tpu.temporal import TemporalTracker
+
+    tr = TemporalTracker()
+    base = 1_700_000_000.0
+    base -= base % 86400
+    for day in range(4):
+        t = base + day * 86400 + 9 * 3600
+        tr.record_access("a", t)
+        tr.record_access("a", t + 600)  # min_accesses needs >= 6 samples
+        tr.record_access("b", t + 30)  # same session: co-access
+    pats = tr.detect_patterns("a")
+    assert any(p.type == "daily" for p in pats)
+    trend = tr.evolution.get_trend("a", "b")
+    assert trend is not None and trend.current_strength > 0
+
+
+def test_db_inference_uses_evidence_buffer():
+    """remember() feeds evidence-gated co-access inference end to end:
+    enough co-accesses materialize a CO_ACCESSED_WITH edge, fewer don't."""
+    import nornicdb_tpu
+
+    db = nornicdb_tpu.open(auto_embed=False)
+    assert db.inference.evidence is not None  # wired by default
+    db.store("doc a", node_id="a")
+    db.store("doc b", node_id="b")
+    _ = db.inference  # materialize the engine (store/auto-link path)
+    t = 1_700_000_000.0
+    for i in range(8):
+        db.decay.record_access("a")
+        db.temporal.record_access("a", at=t + i * 20)
+        db.temporal.record_access("b", at=t + i * 20 + 5)
+        db.inference.on_access(db.temporal, "b")
+    edges = [e for e in db.storage.all_edges()
+             if e.type == "CO_ACCESSED_WITH"]
+    assert edges, "co-access evidence never materialized an edge"
+    assert edges[0].properties.get("inferred") is True
+    db.close()
