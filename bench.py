@@ -126,12 +126,14 @@ def main():
     print(json.dumps(result))
 
 
-# LDBC-SNB published reference numbers (BASELINE.md rows 1-4, M3 Max).
+# LDBC-SNB published reference numbers (BASELINE.md rows 1-4, M3 Max)
+# plus the Northwind write bench (create/delete rel, 4,920 ops/s).
 _LDBC_BASELINES = {
     "msg_content_lookup": 6389.0,
     "recent_messages_friends": 2769.0,
     "avg_friends_per_city": 4713.0,
     "tag_cooccurrence": 2076.0,
+    "northwind_writes": 4920.0,
 }
 
 
@@ -224,6 +226,14 @@ def _bench_cypher():
             if dt > 2.0 or n_done >= 20000:
                 break
         return n_done / dt
+
+    # Northwind write shape: MATCH two indexed nodes, CREATE a rel
+    # (BASELINE "Northwind write ops (create/delete rel)": 4,920 ops/s)
+    queries["northwind_writes"] = (
+        "MATCH (a:Person {id: $a}), (b:Person {id: $b}) "
+        "CREATE (a)-[:BOUGHT_WITH]->(b)",
+        lambda it: {"a": (it * 7) % n_people, "b": (it * 13 + 1) % n_people},
+    )
 
     out = {}
     ratios = []

@@ -185,16 +185,16 @@ class DB:
 
             class _CacheInvalidator(MutationListener):
                 def on_node_upsert(self, node):
-                    ex.invalidate_caches()
+                    ex.on_external_mutation()
 
                 def on_node_delete(self, node_id):
-                    ex.invalidate_caches()
+                    ex.on_external_mutation()
 
                 def on_edge_upsert(self, edge):
-                    ex.invalidate_caches()
+                    ex.on_external_mutation()
 
                 def on_edge_delete(self, edge_id):
-                    ex.invalidate_caches()
+                    ex.on_external_mutation()
 
             self._listenable.add_listener(_CacheInvalidator())
         return self._executor

@@ -74,6 +74,15 @@ class EdgeTable:
             self._prop_cols[name] = col
         return col
 
+    def append_edge(self, src_row: int, dst_row: int, edge: Edge) -> None:
+        """Create-delta append; drops derived caches (CSR, prop cols)."""
+        self.src = np.append(self.src, np.int32(src_row))
+        self.dst = np.append(self.dst, np.int32(dst_row))
+        self.edges.append(edge)
+        self._csr_out = None
+        self._csr_in = None
+        self._prop_cols.clear()
+
 
 def _build_csr(keys: np.ndarray, n_nodes: int) -> Tuple[np.ndarray, np.ndarray]:
     order = np.argsort(keys, kind="stable").astype(np.int32)
@@ -115,6 +124,65 @@ class ColumnarCatalog:
         with self._lock:
             self._version += 1
             self._reset_locked()
+
+    # -- create deltas ----------------------------------------------------
+    #
+    # Pure creations extend the snapshot in place instead of discarding
+    # it — the write-heavy compound shapes (MATCH…CREATE, reference
+    # Northwind write bench) would otherwise rebuild O(N) structures on
+    # every statement. Updates/deletes still invalidate wholesale.
+    # Appends are O(existing) array copies: fine for the sizes where the
+    # catalog wins; gigantic stores amortize via the usual lazy rebuild.
+
+    def apply_node_created(self, node: Node) -> None:
+        with self._lock:
+            self._version += 1
+            if self._nodes is None:
+                return  # nothing built yet; lazy build sees the node
+            i = len(self._nodes)
+            self._nodes.append(node)
+            self._node_pos[node.id] = i
+            for lbl, rows in self._label_rows.items():
+                if lbl in node.labels:
+                    self._label_rows[lbl] = np.append(rows, np.int32(i))
+            for lbl, mask in list(self._label_mask.items()):
+                self._label_mask[lbl] = np.append(mask, lbl in node.labels)
+            for name, col in list(self._node_prop_cols.items()):
+                ext = np.empty(1, dtype=object)
+                ext[0] = node.properties.get(name)
+                self._node_prop_cols[name] = np.concatenate([col, ext])
+            for (lbl, prop), idx in self._prop_index.items():
+                if lbl in node.labels:
+                    v = node.properties.get(prop)
+                    if v is not None and not isinstance(v, (list, dict)):
+                        rows = idx.get(v)
+                        idx[v] = (np.append(rows, np.int32(i))
+                                  if rows is not None
+                                  else np.asarray([i], dtype=np.int32))
+            # CSR indptr arrays are indexed by node row and sized
+            # n_nodes+1: a grown node table invalidates every CSR
+            for tbl in self._edge_tables.values():
+                tbl._csr_out = None
+                tbl._csr_in = None
+
+    def apply_edge_created(self, edge: Edge) -> None:
+        with self._lock:
+            self._version += 1
+            tbl = self._edge_tables.get(edge.type)
+            if tbl is not None:
+                if self._node_pos is None:
+                    self._edge_tables.pop(edge.type, None)
+                else:
+                    s = self._node_pos.get(edge.start_node)
+                    d = self._node_pos.get(edge.end_node)
+                    if s is None or d is None:
+                        self._edge_tables.pop(edge.type, None)
+                    else:
+                        tbl.append_edge(int(s), int(d), edge)
+            if (self._all_edge_types is not None
+                    and edge.type not in self._all_edge_types):
+                self._all_edge_types.append(edge.type)
+                self._all_edge_types.sort()
 
     # -- node table -------------------------------------------------------
 

@@ -87,6 +87,12 @@ class _Ctx:
         self.storage = storage if storage is not None else executor.storage
         self.params = params
         self.stats = QueryStats()
+        # create-delta tracking for granular cache maintenance: pure
+        # creations extend the columnar snapshot instead of rebuilding it
+        self.created_nodes: List[Node] = []
+        self.created_edges: List[Edge] = []
+        self.created_props = 0  # properties set BY those creations
+        self.non_create_writes = False
 
 
 class CypherExecutor:
@@ -131,6 +137,19 @@ class CypherExecutor:
 
         self.triggers = TriggerRegistry()
         self._in_trigger = False
+        self._tls = threading.local()
+
+    def on_external_mutation(self) -> None:
+        """Storage-listener entry point (db.py wires this): invalidate for
+        writes arriving OUTSIDE this executor's own execution (Store,
+        embed queue, replication apply). The executor's own writes fire
+        the same listeners mid-query; those are handled at end-of-query
+        (delta-extend or full invalidate), so they are skipped here —
+        otherwise the listener wipes the catalog before the delta path
+        runs and MATCH…CREATE pays a full O(N) rebuild per statement."""
+        if getattr(self._tls, "depth", 0) > 0:
+            return
+        self.invalidate_caches()
 
     def invalidate_caches(self) -> None:
         """Drop the query-result cache and columnar snapshot. Called after
@@ -191,6 +210,18 @@ class CypherExecutor:
         storage: Optional[Engine] = None,
     ) -> CypherResult:
         ctx = _Ctx(self, params or {}, storage=storage)
+        self._tls.depth = getattr(self._tls, "depth", 0) + 1
+        try:
+            return self._execute_parsed_inner(uq, ctx, storage)
+        finally:
+            self._tls.depth -= 1
+
+    def _execute_parsed_inner(
+        self,
+        uq: "A.UnionQuery",
+        ctx: "_Ctx",
+        storage: Optional[Engine] = None,
+    ) -> CypherResult:
         result: Optional[CypherResult] = None
         for i, part in enumerate(uq.parts):
             r = self._run_query(part, ctx)
@@ -213,8 +244,33 @@ class CypherExecutor:
         result.stats = ctx.stats
         if ctx.stats.contains_updates:
             # write invalidation for every execution route (including
-            # PROFILE and txn overlays) — reference: cache_policy.go
-            self.invalidate_caches()
+            # PROFILE and txn overlays) — reference: cache_policy.go.
+            # Pure creations (delta lists match the stats counters and no
+            # other write kind ran) extend the columnar snapshot in place
+            # instead of forcing an O(N) rebuild per statement.
+            pure_creates = (
+                not ctx.non_create_writes
+                and storage is None
+                and ctx.stats.nodes_deleted == 0
+                and ctx.stats.relationships_deleted == 0
+                and ctx.stats.labels_removed == 0
+                and len(ctx.created_nodes) == ctx.stats.nodes_created
+                and len(ctx.created_edges) == ctx.stats.relationships_created
+                # every counted write must be explained by the deltas —
+                # a procedure mutating properties (apoc.create.setProperty)
+                # bumps these counters without touching the delta lists
+                and ctx.stats.properties_set == ctx.created_props
+                and ctx.stats.labels_added == sum(
+                    len(n.labels) for n in ctx.created_nodes)
+            )
+            if pure_creates:
+                self.query_cache.clear()
+                for n in ctx.created_nodes:
+                    self.columnar.apply_node_created(n)
+                for e in ctx.created_edges:
+                    self.columnar.apply_edge_created(e)
+            else:
+                self.invalidate_caches()
             # apoc triggers ('after' phase); guarded against recursion
             if self.triggers.triggers and not self._in_trigger:
                 self.triggers.fire(self)
@@ -275,7 +331,10 @@ class CypherExecutor:
         return result
 
     def _run_query(self, q: A.Query, ctx: _Ctx) -> CypherResult:
-        from nornicdb_tpu.query.fastpaths import try_fast_path
+        from nornicdb_tpu.query.fastpaths import (
+            try_fast_match_rows,
+            try_fast_path,
+        )
 
         fast = try_fast_path(self, q, ctx)
         if fast is not None:
@@ -286,6 +345,13 @@ class CypherExecutor:
         for idx, clause in enumerate(clauses):
             is_last = idx == len(clauses) - 1
             if isinstance(clause, A.MatchClause):
+                if idx == 0:
+                    # vectorized binding resolution for the leading MATCH
+                    # (compound fast path: MATCH…CREATE/SET/DELETE etc.)
+                    fast_rows = try_fast_match_rows(self, clause, ctx)
+                    if fast_rows is not None:
+                        rows = fast_rows
+                        continue
                 rows = self._exec_match(clause, rows, ctx)
             elif isinstance(clause, A.UnwindClause):
                 rows = self._exec_unwind(clause, rows, ctx)
@@ -928,7 +994,10 @@ class CypherExecutor:
         ctx.stats.nodes_created += 1
         ctx.stats.labels_added += len(pn.labels)
         ctx.stats.properties_set += len(props)
-        return ctx.storage.get_node(node.id)
+        created = ctx.storage.get_node(node.id)
+        ctx.created_nodes.append(created)
+        ctx.created_props += len(props)
+        return created
 
     def _exec_create(self, clause: A.CreateClause, rows, ctx) -> Iterator[Dict]:
         for row in rows:
@@ -968,6 +1037,8 @@ class CypherExecutor:
                         ctx.stats.relationships_created += 1
                         ctx.stats.properties_set += len(props)
                         edge = ctx.storage.get_edge(edge.id)
+                        ctx.created_edges.append(edge)
+                        ctx.created_props += len(props)
                         if pr.var:
                             out[pr.var] = edge
                         path_rels.append(edge)
@@ -998,6 +1069,7 @@ class CypherExecutor:
     # -- SET / REMOVE / DELETE --------------------------------------------
 
     def _apply_set_items(self, items: List[A.SetItem], row, ctx) -> Dict:
+        ctx.non_create_writes = True
         out = dict(row)
         for item in items:
             if item.labels:
@@ -1076,6 +1148,7 @@ class CypherExecutor:
             yield self._apply_set_items(items, row, ctx)
 
     def _exec_remove(self, clause: A.RemoveClause, rows, ctx) -> Iterator[Dict]:
+        ctx.non_create_writes = True
         for row in rows:
             out = dict(row)
             for item in clause.items:
@@ -1108,6 +1181,7 @@ class CypherExecutor:
             yield out
 
     def _exec_delete(self, clause: A.DeleteClause, rows, ctx) -> Iterator[Dict]:
+        ctx.non_create_writes = True
         for row in rows:
             for e in clause.exprs:
                 v = self._eval(e, row, ctx)

@@ -143,6 +143,32 @@ def _bail() -> None:
     raise _Unsupported
 
 
+def _path_supported(path: A.PatternPath, seen_vars: set) -> bool:
+    """Shared shape gate for the vectorized chain family (used by both
+    the pure-vectorized path and the MATCH-prefix path — one definition,
+    so supported shapes cannot drift apart)."""
+    if path.path_var or not path.nodes or len(path.nodes) > 4:
+        return False
+    for pr in path.rels:
+        if pr.min_hops != 1 or pr.max_hops != 1 or pr.props is not None:
+            return False
+        if pr.direction not in ("out", "in"):
+            return False
+        if len(pr.types) != 1:
+            return False
+    for pn in path.nodes:
+        if pn.var:
+            if pn.var in seen_vars:
+                return False
+            seen_vars.add(pn.var)
+    for pr in path.rels:
+        if pr.var:
+            if pr.var in seen_vars:
+                return False
+            seen_vars.add(pr.var)
+    return True
+
+
 class _Bindings:
     """Parallel binding columns over match rows.
 
@@ -184,24 +210,10 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
     if m.optional or len(m.paths) != 1:
         return None
     path = m.paths[0]
-    if path.path_var or not path.nodes or len(path.nodes) > 4:
-        return None
     if ret.star:
         return None
-    for pr in path.rels:
-        if pr.min_hops != 1 or pr.max_hops != 1 or pr.props is not None:
-            return None
-        if pr.direction not in ("out", "in"):
-            return None
-        if len(pr.types) != 1:
-            return None
-    # variable sanity: all node vars distinct (cycles fall back)
-    seen_vars = set()
-    for pn in path.nodes:
-        if pn.var:
-            if pn.var in seen_vars:
-                return None
-            seen_vars.add(pn.var)
+    if not _path_supported(path, set()):
+        return None
 
     b = _match_chain(catalog, path, ctx)
     if b is None:
@@ -570,6 +582,113 @@ def _vec_cmp_cols(lcol: np.ndarray, rcol: np.ndarray, op: str) -> np.ndarray:
                 out[i] = x >= y
         except TypeError:
             pass
+    return out
+
+
+# -- vectorized MATCH prefix for the general pipeline --------------------
+
+_MAX_MATERIALIZED_ROWS = 20_000
+
+
+def try_fast_match_rows(executor, clause: A.MatchClause, ctx):
+    """Vectorized binding computation for a leading MATCH clause whose
+    remaining query is NOT in the pure-vectorized family (MATCH…CREATE,
+    MATCH…SET, multi-clause reads). Returns a list of binding dicts for
+    the general pipeline, or None to fall back.
+
+    This is the analog of the reference's compound fast path
+    (tryFastPathCompoundQuery executor.go:1421): the expensive part of
+    `MATCH (a:P {id: $a}), (b:P {id: $b}) CREATE (a)-[:R]->(b)` is the
+    lookup, not the write — resolve it through the hash property indexes
+    instead of a per-row Python label scan.
+
+    Supports comma-separated paths when at most one path carries
+    relationships and paths share no variables (cartesian join).
+    """
+    if not getattr(executor, "enable_fastpaths", True):
+        return None
+    if ctx.storage is not executor.storage:
+        return None
+    catalog = getattr(executor, "columnar", None)
+    if catalog is None or clause.optional:
+        return None
+    paths = clause.paths
+    if not paths or len(paths) > 3:
+        return None
+    seen_vars: set = set()
+    n_rel_paths = 0
+    for path in paths:
+        if not _path_supported(path, seen_vars):
+            return None  # unsupported shape or shared vars: general join
+        if path.rels:
+            n_rel_paths += 1
+    if n_rel_paths > 1:
+        return None  # same-type edge uniqueness across paths: general
+    try:
+        bindings = [_match_chain(catalog, p, ctx) for p in paths]
+        combined = _cartesian(bindings)
+        if combined is None:
+            return None
+        if clause.where is not None:
+            for conj in _split_and(clause.where):
+                combined.take(_vec_predicate(conj, combined, catalog, ctx))
+        return _materialize_rows(combined, catalog)
+    except _Unsupported:
+        return None
+
+
+def _cartesian(bindings: List[_Bindings]) -> Optional[_Bindings]:
+    """Cross-join independent per-path bindings (no shared vars)."""
+    if len(bindings) == 1:
+        return bindings[0]
+    total = 1
+    for b in bindings:
+        total *= max(b.n_rows, 0)
+        if total > _MAX_MATERIALIZED_ROWS:
+            return None
+    out = _Bindings()
+    out.n_rows = total
+    if total == 0:
+        for b in bindings:
+            for k in b.node_cols:
+                out.node_cols[k] = np.empty(0, np.int32)
+            for k, (t, _v) in b.edge_cols.items():
+                out.edge_cols[k] = (t, np.empty(0, np.int32))
+        return out
+    # repeat/tile index pattern per path
+    reps_after = [1] * len(bindings)
+    for i in range(len(bindings) - 2, -1, -1):
+        reps_after[i] = reps_after[i + 1] * bindings[i + 1].n_rows
+    reps_before = [1] * len(bindings)
+    for i in range(1, len(bindings)):
+        reps_before[i] = reps_before[i - 1] * bindings[i - 1].n_rows
+    for i, b in enumerate(bindings):
+        idx = np.tile(
+            np.repeat(np.arange(b.n_rows, dtype=np.int64), reps_after[i]),
+            reps_before[i],
+        )
+        for k, v in b.node_cols.items():
+            out.node_cols[k] = v[idx]
+        for k, (t, v) in b.edge_cols.items():
+            out.edge_cols[k] = (t, v[idx])
+        out.hop_edges.extend((t, v[idx]) for t, v in b.hop_edges)
+    return out
+
+
+def _materialize_rows(b: _Bindings, catalog) -> Optional[List[Dict[str, Any]]]:
+    """Binding columns -> general-pipeline row dicts (Node/Edge values)."""
+    if b.n_rows > _MAX_MATERIALIZED_ROWS:
+        return None  # let the streaming general path handle huge matches
+    nodes = catalog.nodes()
+    cols: List[Tuple[str, List[Any]]] = []
+    for var, rows in b.node_cols.items():
+        cols.append((var, [nodes[i] for i in rows.tolist()]))
+    for var, (table, erows) in b.edge_cols.items():
+        edges = table.edges
+        cols.append((var, [edges[i] for i in erows.tolist()]))
+    out: List[Dict[str, Any]] = []
+    for i in range(b.n_rows):
+        out.append({var: vals[i] for var, vals in cols})
     return out
 
 
