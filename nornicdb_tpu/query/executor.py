@@ -213,6 +213,16 @@ class CypherExecutor:
         self._tls.depth = getattr(self._tls, "depth", 0) + 1
         try:
             return self._execute_parsed_inner(uq, ctx, storage)
+        except BaseException:
+            # a failing write query may have applied SOME mutations before
+            # raising (listener invalidation is suppressed at depth>0, and
+            # the end-of-query delta path never runs on this path) —
+            # conservatively drop the caches
+            if ctx.stats.contains_updates or ctx.created_nodes or (
+                ctx.created_edges or ctx.non_create_writes
+            ):
+                self.invalidate_caches()
+            raise
         finally:
             self._tls.depth -= 1
 
@@ -223,8 +233,16 @@ class CypherExecutor:
         storage: Optional[Engine] = None,
     ) -> CypherResult:
         result: Optional[CypherResult] = None
+        multi_part = len(uq.parts) > 1
         for i, part in enumerate(uq.parts):
             r = self._run_query(part, ctx)
+            if multi_part and ctx.stats.contains_updates:
+                # later UNION parts must see this part's writes; the
+                # delta path only applies after ALL parts, so multi-part
+                # writes invalidate between parts (writes in UNION are
+                # rare — correctness over the delta micro-optimization)
+                self.invalidate_caches()
+                ctx.non_create_writes = True  # disable end-of-query delta
             if result is None:
                 result = r
             else:

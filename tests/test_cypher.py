@@ -578,3 +578,79 @@ class TestExplainProfile:
         )
         details = " ".join(str(row[1]) for row in r.rows)
         assert "(b)-->[:Y](c)" in details
+
+
+class TestTemporalTxlogProcedures:
+    """db.temporal.asOf / assertNoOverlap + db.txlog.entries + index mgmt
+    (reference: call_temporal.go:29,98; call_txlog.go:17;
+    call_index_mgmt.go)."""
+
+    @pytest.fixture()
+    def ex(self):
+        from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+        return CypherExecutor(NamespacedEngine(MemoryEngine(), "test"))
+
+    def test_as_of_picks_covering_version(self, ex):
+        for vf, vt, ver in [("2026-01-01T00:00:00Z", "2026-02-01T00:00:00Z", 1),
+                            ("2026-02-01T00:00:00Z", "2026-03-01T00:00:00Z", 2),
+                            ("2026-03-01T00:00:00Z", None, 3)]:
+            ex.execute(
+                "CREATE (:Price {sku: 'x', validFrom: $vf, validTo: $vt, "
+                "version: $v})", {"vf": vf, "vt": vt, "v": ver})
+        r = ex.execute(
+            "CALL db.temporal.asOf('Price', 'sku', 'x', 'validFrom', "
+            "'validTo', '2026-02-15T00:00:00Z') YIELD node "
+            "RETURN node.version")
+        assert r.rows == [[2]]
+        # open-ended interval covers far future
+        r = ex.execute(
+            "CALL db.temporal.asOf('Price', 'sku', 'x', 'validFrom', "
+            "'validTo', '2030-01-01T00:00:00Z') YIELD node "
+            "RETURN node.version")
+        assert r.rows == [[3]]
+        # before any interval: no rows
+        r = ex.execute(
+            "CALL db.temporal.asOf('Price', 'sku', 'x', 'validFrom', "
+            "'validTo', '2020-01-01T00:00:00Z') YIELD node RETURN node")
+        assert r.rows == []
+
+    def test_assert_no_overlap(self, ex):
+        from nornicdb_tpu.errors import CypherRuntimeError
+
+        ex.execute("CREATE (:Lease {unit: 'A', validFrom: "
+                   "'2026-01-01T00:00:00Z', validTo: '2026-06-01T00:00:00Z'})")
+        r = ex.execute(
+            "CALL db.temporal.assertNoOverlap('Lease', 'unit', 'validFrom', "
+            "'validTo', 'A', '2026-06-01T00:00:00Z', '2026-12-01T00:00:00Z') "
+            "YIELD ok RETURN ok")
+        assert r.rows == [[True]]
+        with pytest.raises(CypherRuntimeError, match="overlap"):
+            ex.execute(
+                "CALL db.temporal.assertNoOverlap('Lease', 'unit', "
+                "'validFrom', 'validTo', 'A', '2026-03-01T00:00:00Z', null) "
+                "YIELD ok RETURN ok")
+
+    def test_txlog_entries(self, tmp_path):
+        import nornicdb_tpu
+
+        db = nornicdb_tpu.open(str(tmp_path / "d"), engine="python",
+                               auto_embed=False)
+        db.cypher("CREATE (:T {v: 1})")
+        db.cypher("CREATE (:T {v: 2})")
+        r = db.cypher("CALL db.txlog.entries(1) "
+                      "YIELD sequence, operation RETURN sequence, operation")
+        assert len(r.rows) >= 2
+        assert all(op == "create_node" for _seq, op in r.rows[:2])
+        seqs = [s for s, _ in r.rows]
+        assert seqs == sorted(seqs)
+        db.close()
+
+    def test_index_mgmt_and_stats(self, ex):
+        assert ex.execute("CALL db.awaitIndexes(300) YIELD ok RETURN ok"
+                          ).rows == [[True]]
+        ex.execute("CALL db.stats.collect()")
+        r = ex.execute("CALL db.stats.retrieve('QUERIES') "
+                       "YIELD section, data RETURN section, data")
+        assert r.rows[0][0] == "QUERIES"
+        ex.execute("CALL db.stats.clear()")

@@ -274,3 +274,28 @@ class TestCreateDeltaFreshness:
         db.store("external", node_id="x1", labels=["P"])
         assert db.cypher("MATCH (p:P) RETURN count(p)").rows == [[3]]
         db.close()
+
+
+def test_failed_write_query_invalidates_caches():
+    """Review regression: partial writes from a raising query must not
+    leave the columnar snapshot stale."""
+    from nornicdb_tpu.errors import CypherRuntimeError
+
+    eng = NamespacedEngine(MemoryEngine(), "test")
+    ex = CypherExecutor(eng)
+    ex.execute("CREATE (:P {v: 0})")
+    assert ex.execute("MATCH (p:P) RETURN count(p)").rows == [[1]]
+    with pytest.raises(CypherRuntimeError):
+        ex.execute("UNWIND [1, 0] AS i CREATE (:P {v: i}) RETURN 1 / i")
+    # both CREATEs hit storage before the error
+    assert ex.execute("MATCH (p:P) RETURN count(p)").rows == [[3]]
+
+
+def test_union_later_parts_see_earlier_writes():
+    eng = NamespacedEngine(MemoryEngine(), "test")
+    ex = CypherExecutor(eng)
+    ex.execute("CREATE (:X {v: 1})")
+    ex.execute("MATCH (x:X) RETURN x.v")  # warm the catalog
+    r = ex.execute("CREATE (:X {v: 2}) RETURN 99 AS `x.v` "
+                   "UNION ALL MATCH (x:X) RETURN x.v")
+    assert sorted(r.rows) == [[1], [2], [99]]
