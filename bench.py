@@ -1,13 +1,13 @@
-"""Benchmark: brute-force cosine kNN throughput over 10k x 1024 embeddings.
+"""Benchmark vs the reference's published numbers (BASELINE.md).
 
-Matches BASELINE.json config[0] ("Cosine kNN brute-force over 10k bge-m3
-embeddings") and compares against the reference's highest-throughput
-search surface, REST search at 10,296 ops/s (testing/e2e/README.md —
-BASELINE.md row "E2E endpoint bench: REST search"; that number is itself
-a concurrent-load throughput figure). Measured here: sustained
-single-stream throughput of batch=1 queries with async pipelined
-dispatch — back-to-back requests as a loaded server sees them. Each
-query is a distinct device-resident [1, D] tensor; no batching.
+Headline: geometric mean over the LDBC-SNB/Northwind Cypher family —
+the reference's own headline benchmarks (BASELINE.md rows 1-7) — as
+sustained single-stream ops/s with the query-result cache disabled and
+lookup params rotating. Sub-metric "knn": brute-force cosine kNN
+throughput over 10k x 1024 embeddings (BASELINE.json config[0]),
+compared against the reference's highest-throughput search surface,
+REST search at 10,296 ops/s (testing/e2e/README.md). Each kNN query is
+a distinct device-resident [1, D] tensor; no batching.
 
 Backend init is hardened: the TPU (axon) backend is probed in a
 subprocess with a bounded timeout and retries; on hard failure the bench
@@ -65,6 +65,30 @@ def _probe_backend(timeout_s: float = 120.0, attempts: int = 3):
 
 
 def main():
+    # Cypher first: it needs no accelerator, so a TPU-tunnel outage can
+    # never cost the headline number.
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    cypher = _bench_cypher()
+    result = {
+        # The reference's headline benchmarks are the LDBC-SNB/Northwind
+        # Cypher rates (BASELINE.md rows 1-7); the geomean across that
+        # family is the apples-to-apples figure.
+        "metric": "ldbc_snb_cypher_geomean",
+        "value": cypher.pop("ldbc_geomean_ops"),
+        "unit": "queries/s",
+        "vs_baseline": cypher["ldbc_geomean_vs_baseline"],
+        "cypher": cypher,
+    }
+    try:
+        result["knn"] = _bench_knn()
+    except Exception as exc:
+        # the accelerator half must never cost the already-computed
+        # Cypher headline
+        result["knn"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    print(json.dumps(result))
+
+
+def _bench_knn():
     platform = _probe_backend()
     fallback = platform is None
     if fallback:
@@ -79,7 +103,6 @@ def main():
 
     import jax.numpy as jnp
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from nornicdb_tpu.ops import cosine_topk, l2_normalize, pad_dim
 
     n, d, k = 10_000, 1024, 10
@@ -115,15 +138,13 @@ def main():
     dt = time.perf_counter() - t0
     qps = iters / dt
 
-    result = {
+    return {
         "metric": "knn_throughput_b1_10k_x_1024",
         "value": round(qps, 1),
         "unit": "queries/s",
         "vs_baseline": round(qps / BASELINE_REST_SEARCH_OPS, 3),
         "backend": "cpu-fallback" if fallback else jax.devices()[0].platform,
     }
-    result["cypher"] = _bench_cypher()
-    print(json.dumps(result))
 
 
 # LDBC-SNB published reference numbers (BASELINE.md rows 1-4, M3 Max)
@@ -220,7 +241,10 @@ def _bench_cypher():
         n_done = 0
         while True:
             for it in range(iters):
-                ex.execute(q, mk_params(n_done + it))
+                # touch the row count: results are consumed column-major
+                # (servers serialize straight from columns; see
+                # CypherResult lazy rows)
+                _ = ex.execute(q, mk_params(n_done + it)).n_rows
             n_done += iters
             dt = time.perf_counter() - t0
             if dt > 2.0 or n_done >= 20000:
@@ -237,6 +261,7 @@ def _bench_cypher():
 
     out = {}
     ratios = []
+    rates = []
     for name, (q, mk_params) in queries.items():
         qps = measure(q, mk_params)
         base = _LDBC_BASELINES[name]
@@ -245,6 +270,7 @@ def _bench_cypher():
             "vs_baseline": round(qps / base, 3),
         }
         ratios.append(qps / base)
+        rates.append(qps)
         # Repeated identical reads are the reference's bench pattern and
         # hit its LRU result cache (read-cache probe, executor.go:634);
         # report our cached number too for the static-param queries.
@@ -257,6 +283,9 @@ def _bench_cypher():
             out[name]["cached_vs_baseline"] = round(cached_qps / base, 3)
     geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
     out["ldbc_geomean_vs_baseline"] = round(geomean, 3)
+    out["ldbc_geomean_ops"] = (
+        round(float(np.exp(np.mean(np.log(rates)))), 1) if rates else 0.0
+    )
     return out
 
 
@@ -267,7 +296,7 @@ if __name__ == "__main__":
         print(
             json.dumps(
                 {
-                    "metric": "knn_throughput_b1_10k_x_1024",
+                    "metric": "ldbc_snb_cypher_geomean",
                     "value": 0.0,
                     "unit": "queries/s",
                     "vs_baseline": 0.0,

@@ -115,6 +115,9 @@ class ColumnarCatalog:
         self._prop_index: Dict[Tuple[str, str], Dict[Any, np.ndarray]] = {}
         self._edge_tables: Dict[str, EdgeTable] = {}
         self._all_edge_types: Optional[List[str]] = None
+        self._filtered_deg: Dict[Tuple[str, str, Optional[str]], np.ndarray] = {}
+        self._mid_axis: Dict[Tuple[str, str, Optional[str]], Any] = {}
+        self._incidence: Dict[Tuple[str, str, Optional[str], Optional[str]], Any] = {}
 
     @property
     def version(self) -> int:
@@ -137,6 +140,9 @@ class ColumnarCatalog:
     def apply_node_created(self, node: Node) -> None:
         with self._lock:
             self._version += 1
+            self._filtered_deg.clear()  # arrays are sized n_nodes
+            self._mid_axis.clear()
+            self._incidence.clear()
             if self._nodes is None:
                 return  # nothing built yet; lazy build sees the node
             i = len(self._nodes)
@@ -168,6 +174,9 @@ class ColumnarCatalog:
     def apply_edge_created(self, edge: Edge) -> None:
         with self._lock:
             self._version += 1
+            self._filtered_deg.clear()
+            self._mid_axis.clear()
+            self._incidence.clear()
             tbl = self._edge_tables.get(edge.type)
             if tbl is not None:
                 if self._node_pos is None:
@@ -307,6 +316,118 @@ class ColumnarCatalog:
                 )
                 self._edge_tables[etype] = tbl
             return tbl
+
+    def filtered_degree(
+        self, etype: str, direction: str, label: Optional[str]
+    ) -> np.ndarray:
+        """int64[n_nodes]: per-node count of ``etype`` edges in
+        ``direction`` whose far end carries ``label`` (or any node when
+        label is None).
+
+        This is the degree store behind terminal-hop aggregation pushdown
+        (reference: degree-based fast aggregations,
+        pkg/cypher/traversal_fast_agg.go:15,57): count(f) over a hop that
+        is otherwise unused equals a degree sum, so the join expansion
+        can be skipped entirely. Cached per (etype, direction, label)
+        until any mutation."""
+        key = (etype, direction, label)
+        with self._lock:
+            deg = self._filtered_deg.get(key)
+            if deg is not None:
+                return deg
+            v0 = self._version
+        # build outside the (non-reentrant) lock: edge_table/label_mask
+        # take it themselves; a racy double-build is harmless, but a
+        # build that raced a mutation must not be stored (the mutation
+        # already cleared the cache — storing would resurrect a stale
+        # snapshot), hence the version check
+        tbl = self.edge_table(etype)
+        n = self.n_nodes()
+        if direction == "out":
+            keys, far = tbl.src, tbl.dst
+        else:
+            keys, far = tbl.dst, tbl.src
+        if label is not None:
+            keys = keys[self.label_mask(label)[far]]
+        deg = np.bincount(keys, minlength=n).astype(np.int64)
+        with self._lock:
+            if self._version == v0:
+                self._filtered_deg[key] = deg
+        return deg
+
+    # dense-matrix budget for one cached incidence matrix (float32 cells;
+    # 16 MB at the cap). Bigger label/edge combinations return None and
+    # the query falls back to join expansion.
+    INCIDENCE_MAX_CELLS = 4_000_000
+
+    def incidence(
+        self,
+        etype: str,
+        orientation: str,
+        mid_label: Optional[str],
+        far_label: Optional[str],
+    ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+        """Dense incidence matrix for co-occurrence matmuls.
+
+        orientation 'mid_src': edges run middle -> far (middle is tbl.src);
+        'mid_dst': far -> middle. Returns (M, far_cands, usable, far_pos):
+
+        - M: float32[n_mid, n_far], M[mc, fc] = #edges between middle
+          ``mc`` and far candidate ``fc`` (middle filtered by mid_label,
+          far end by far_label)
+        - far_cands: int32 global rows of far candidates (column order)
+        - usable: bool[n_edges] — edge contributes to M
+        - far_pos: int64[n_nodes] — global row -> column (or -1)
+
+        The *middle axis* (row order) depends only on (etype, orientation,
+        mid_label), so two incidence matrices with different far labels
+        share rows and can be contracted against each other — the tag
+        co-occurrence family is ``Ma.T @ Mb`` (BASELINE.md row 4; the
+        reference hand-writes this family in optimized_executors.go).
+        Cached until any mutation; returns None over the size budget."""
+        key = (etype, orientation, mid_label, far_label)
+        with self._lock:
+            if key in self._incidence:
+                return self._incidence[key]
+            v0 = self._version
+        tbl = self.edge_table(etype)
+        n = self.n_nodes()
+        mid_e = tbl.src if orientation == "mid_src" else tbl.dst
+        far_e = tbl.dst if orientation == "mid_src" else tbl.src
+        # shared middle axis
+        axis_key = (etype, orientation, mid_label)
+        with self._lock:
+            axis = self._mid_axis.get(axis_key)
+        if axis is None:
+            emask = (self.label_mask(mid_label)[mid_e]
+                     if mid_label is not None
+                     else np.ones(len(tbl), dtype=bool))
+            flags = np.zeros(n, dtype=bool)
+            flags[mid_e[emask]] = True
+            uniq_mid = np.nonzero(flags)[0]
+            mid_lut = np.zeros(n, dtype=np.int64)
+            mid_lut[uniq_mid] = np.arange(len(uniq_mid))
+            axis = (uniq_mid, mid_lut, emask)
+            with self._lock:
+                if self._version == v0:
+                    self._mid_axis[axis_key] = axis
+        uniq_mid, mid_lut, emask = axis
+        far_cands = (self.label_rows(far_label) if far_label is not None
+                     else np.arange(n, dtype=np.int32))
+        result = None
+        if len(uniq_mid) * max(len(far_cands), 1) <= self.INCIDENCE_MAX_CELLS:
+            far_pos = np.full(n, -1, dtype=np.int64)
+            far_pos[far_cands] = np.arange(len(far_cands))
+            usable = emask & (far_pos[far_e] >= 0)
+            m = np.zeros((len(uniq_mid), len(far_cands)), dtype=np.float32)
+            np.add.at(
+                m, (mid_lut[mid_e[usable]], far_pos[far_e[usable]]), 1.0
+            )
+            result = (m, far_cands, usable, far_pos)
+        with self._lock:
+            if self._version == v0:
+                self._incidence[key] = result
+        return result
 
     def edge_types(self) -> List[str]:
         with self._lock:

@@ -58,12 +58,70 @@ class QueryStats:
         }
 
 
-@dataclass
 class CypherResult:
-    columns: List[str] = field(default_factory=list)
-    rows: List[List[Any]] = field(default_factory=list)
-    stats: QueryStats = field(default_factory=QueryStats)
-    plan: Optional[Dict[str, Any]] = None  # EXPLAIN/PROFILE plan tree
+    """Query result. Internally column-major when produced by the
+    vectorized fast paths (the reference's executor streams records
+    rather than materializing them all up front — bolt PULL semantics,
+    pkg/bolt/server.go; this is the columnar analog): ``rows`` is
+    materialized lazily on first access, so servers that serialize
+    straight from columns and benches that only count results never pay
+    the per-row Python list cost."""
+
+    __slots__ = ("columns", "_rows", "_col_data", "stats", "plan")
+
+    def __init__(
+        self,
+        columns: Optional[List[str]] = None,
+        rows: Optional[List[List[Any]]] = None,
+        stats: Optional[QueryStats] = None,
+        plan: Optional[Dict[str, Any]] = None,
+        col_data: Optional[List[List[Any]]] = None,
+    ):
+        self.columns = columns if columns is not None else []
+        if rows is not None:
+            self._rows = rows
+            self._col_data = None
+        elif col_data is not None:
+            self._rows = None
+            self._col_data = col_data
+        else:
+            self._rows = []
+            self._col_data = None
+        self.stats = stats if stats is not None else QueryStats()
+        self.plan = plan
+
+    @property
+    def rows(self) -> List[List[Any]]:
+        if self._rows is None:
+            cols = self._col_data
+            self._rows = (
+                list(map(list, zip(*cols))) if cols and len(cols[0]) else []
+            )
+        # the returned list is mutable (UNION merging extends it in
+        # place): drop the column view so there is a single source of
+        # truth once rows are exposed
+        self._col_data = None
+        return self._rows
+
+    @rows.setter
+    def rows(self, value: List[List[Any]]) -> None:
+        self._rows = value
+        self._col_data = None
+
+    @property
+    def n_rows(self) -> int:
+        if self._rows is not None:
+            return len(self._rows)
+        return len(self._col_data[0]) if self._col_data else 0
+
+    def col_values(self, i: int) -> List[Any]:
+        """Column-major access without forcing row materialization.
+        Returns a copy: the underlying columns may be shared with the
+        query cache, so handing out the live list would let caller
+        mutations poison future cache hits."""
+        if self._col_data is not None:
+            return list(self._col_data[i])
+        return [r[i] for r in self.rows]
 
     def records(self) -> List[Dict[str, Any]]:
         return [dict(zip(self.columns, r)) for r in self.rows]
@@ -73,6 +131,8 @@ class CypherResult:
         return recs[0] if recs else None
 
     def value(self, col: int = 0) -> Any:
+        if self._rows is None and self._col_data:
+            return self._col_data[col][0] if self._col_data[col] else None
         return self.rows[0][col] if self.rows else None
 
 
@@ -193,6 +253,15 @@ class CypherExecutor:
             if cache_key is not None:
                 hit = self.query_cache.get(cache_key)
                 if hit is not None:
+                    if hit._col_data is not None:
+                        # column-major cached result: hits share the
+                        # immutable columns; each hit materializes its
+                        # own row lists only if the caller iterates them
+                        return CypherResult(
+                            columns=list(hit.columns),
+                            col_data=hit._col_data,
+                            plan=hit.plan,
+                        )
                     return CypherResult(
                         columns=list(hit.columns),
                         rows=[list(r) for r in hit.rows],
@@ -200,7 +269,20 @@ class CypherExecutor:
                     )
         result = self._execute_parsed(uq, params)
         if cache_key is not None and not result.stats.contains_updates:
-            self.query_cache.put(cache_key, result)
+            if result._col_data is not None:
+                # cache a detached wrapper over the shared columns so the
+                # caller's row materialization (which drops the column
+                # view) and row-list mutations can't reach future hits
+                self.query_cache.put(
+                    cache_key,
+                    CypherResult(
+                        columns=list(result.columns),
+                        col_data=result._col_data,
+                        plan=result.plan,
+                    ),
+                )
+            else:
+                self.query_cache.put(cache_key, result)
         return result
 
     def _execute_parsed(

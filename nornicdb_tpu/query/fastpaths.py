@@ -181,15 +181,33 @@ class _Bindings:
         self.edge_cols: Dict[str, Tuple[Any, np.ndarray]] = {}
         self.hop_edges: List[Tuple[str, np.ndarray]] = []  # (etype, edge rows)
         self.n_rows = 0
+        # multiplicity weight per binding row (terminal-hop pushdown /
+        # co-occurrence: one row stands for `weight` full match rows)
+        self.row_weights: Optional[np.ndarray] = None
+        # pattern vars folded out of the bindings; referenceable only as
+        # non-distinct count(var), which equals the weighted row count
+        self.stripped_vars: set = set()
+        # var -> (candidate rows, per-row code into candidates): dense
+        # group codes already known for these vars (co-occurrence path)
+        self.cand_map: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        # binding rows are known pairwise-distinct over cand_map codes
+        self.rows_are_groups = False
 
     def take(self, sel: np.ndarray) -> None:
         """Keep only selected row positions (index array or bool mask)."""
         self.node_cols = {k: v[sel] for k, v in self.node_cols.items()}
         self.edge_cols = {k: (t, v[sel]) for k, (t, v) in self.edge_cols.items()}
         self.hop_edges = [(t, v[sel]) for t, v in self.hop_edges]
+        if self.row_weights is not None:
+            self.row_weights = self.row_weights[sel]
+        self.cand_map = {
+            k: (c, v[sel]) for k, (c, v) in self.cand_map.items()
+        }
         some = next(iter(self.node_cols.values()), None)
         if some is None and self.hop_edges:
             some = self.hop_edges[0][1]
+        if some is None and self.row_weights is not None:
+            some = self.row_weights
         if some is not None:
             self.n_rows = len(some)
         elif sel.dtype == bool:
@@ -198,8 +216,46 @@ class _Bindings:
             self.n_rows = len(sel)
 
 
+_NO_PLAN = object()
+
+
 def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResult"]:
     from nornicdb_tpu.query.executor import CypherResult
+
+    # Vectorized-plan cache (the executable-plan-cache analog, reference
+    # executor.go:634 + plan reuse): shape analysis is pure AST work and
+    # the parsed AST is itself cached, so the decision — which strategy,
+    # which columns, which items aggregate — is computed once and pinned
+    # to the AST object. Per-execution work is then only array ops.
+    plan = getattr(q, "_vec_plan", _NO_PLAN)
+    if plan is _NO_PLAN:
+        plan = _analyze_vectorized(q)
+        try:
+            q._vec_plan = plan
+        except AttributeError:
+            pass
+    if plan is None:
+        return None
+
+    strip, cooc = plan["strip"], plan["cooc"]
+    if strip is not None:
+        b = _exec_strip(catalog, strip, ctx)
+    elif cooc is not None:
+        b = _exec_cooc(catalog, cooc, ctx)
+    else:
+        b = _match_chain(catalog, plan["path"], ctx)
+    if b is None:
+        return None  # over budget / unsupported at runtime
+
+    for conj in plan["where_conjs"]:
+        b.take(_vec_predicate(conj, b, catalog, ctx))
+
+    return _project(executor, catalog, plan["ret"], b, ctx, CypherResult, plan)
+
+
+def _analyze_vectorized(q: A.Query) -> Optional[Dict[str, Any]]:
+    """One-time AST shape analysis for the vectorized chain family."""
+    from nornicdb_tpu.query.executor import _contains_agg
 
     clauses = q.clauses
     if len(clauses) != 2:
@@ -207,25 +263,266 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
     m, ret = clauses[0], clauses[1]
     if not isinstance(m, A.MatchClause) or not isinstance(ret, A.ReturnClause):
         return None
-    if m.optional or len(m.paths) != 1:
+    if m.optional or len(m.paths) != 1 or ret.star:
         return None
     path = m.paths[0]
-    if ret.star:
-        return None
     if not _path_supported(path, set()):
         return None
 
-    b = _match_chain(catalog, path, ctx)
+    cols = []
+    for item in ret.items:
+        if item.alias:
+            cols.append(item.alias)
+        elif isinstance(item.expr, A.Var):
+            cols.append(item.expr.name)
+        elif isinstance(item.expr, A.Prop) and isinstance(item.expr.target, A.Var):
+            cols.append(f"{item.expr.target.name}.{item.expr.name}")
+        else:
+            cols.append(item.text)
+    agg_flags = [_contains_agg(i.expr) for i in ret.items]
+    has_agg = any(agg_flags)
+
+    strip = _analyze_strip(path, m, ret) if has_agg else None
+    cooc = None
+    if strip is None and has_agg:
+        cooc = _analyze_cooc(path, m, ret)
+    return {
+        "m": m,
+        "ret": ret,
+        "path": path,
+        "where_conjs": _split_and(m.where) if m.where is not None else [],
+        "strip": strip,
+        "cooc": cooc,
+        "cols": cols,
+        "agg_flags": agg_flags,
+        "has_agg": has_agg,
+    }
+
+
+# -- aggregation pushdown shapes ------------------------------------------
+
+
+def _mentions_var(obj: Any, name: str) -> bool:
+    """Conservative AST walk: does ``obj`` reference variable ``name``
+    anywhere? (Shadowing by list-comprehension/reduce locals counts as a
+    mention — over-reporting only costs the fast path, never
+    correctness.)"""
+    import dataclasses
+
+    if isinstance(obj, A.Var):
+        return obj.name == name
+    if isinstance(obj, (A.LabelCheck,)):
+        return obj.var == name
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            if _mentions_var(getattr(obj, f.name), name):
+                return True
+        return False
+    if isinstance(obj, (list, tuple)):
+        return any(_mentions_var(x, name) for x in obj)
+    return False
+
+
+def _var_only_counted(e: A.Expr, name: str) -> bool:
+    """True iff every reference to ``name`` inside ``e`` is exactly the
+    argument of a non-distinct count()."""
+    import dataclasses
+
+    if (
+        isinstance(e, A.FuncCall)
+        and e.name == "count"
+        and not e.distinct
+        and not e.star
+        and len(e.args) == 1
+        and isinstance(e.args[0], A.Var)
+        and e.args[0].name == name
+    ):
+        return True
+    if isinstance(e, A.Var):
+        return e.name != name
+    if isinstance(e, A.LabelCheck):
+        return e.var != name
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        return all(
+            _var_only_counted(getattr(e, f.name), name)
+            for f in dataclasses.fields(e)
+        )
+    if isinstance(e, (list, tuple)):
+        return all(_var_only_counted(x, name) for x in e)
+    return True
+
+
+def _count_only_usage(var: Optional[str], m: A.MatchClause,
+                      ret: A.ReturnClause) -> bool:
+    """May ``var`` be folded out of the bindings? Requires it to appear
+    (if at all) only as non-distinct count(var) in RETURN, and nowhere in
+    WHERE or ORDER BY."""
+    if var is None:
+        return True
+    if m.where is not None and _mentions_var(m.where, var):
+        return False
+    for item in ret.items:
+        if not _var_only_counted(item.expr, var):
+            return False
+    for expr, _desc in ret.order_by or []:
+        if _mentions_var(expr, var):
+            return False
+    return True
+
+
+def _analyze_strip(path: A.PatternPath, m: A.MatchClause,
+                   ret: A.ReturnClause) -> Optional[Dict[str, Any]]:
+    """Terminal-hop aggregation pushdown analysis (reference:
+    traversal_fast_agg.go:15,57): when the chain's last node is consumed
+    only by non-distinct count(), the final join expansion collapses to a
+    per-source filtered-degree lookup and the surviving rows carry
+    multiplicity weights. AST-only; cached on the parsed query."""
+    if len(path.nodes) < 2 or not path.rels:
+        return None
+    pn, pr = path.nodes[-1], path.rels[-1]
+    if pn.props is not None or len(pn.labels) > 1:
+        return None
+    if pr.var is not None:
+        return None
+    # a same-type hop elsewhere in the chain brings relationship
+    # uniqueness into play; degrees can't see edge identity
+    if any(r.types[0] == pr.types[0] for r in path.rels[:-1]):
+        return None
+    if not _count_only_usage(pn.var, m, ret):
+        return None
+
+    src_node = path.nodes[-2]
+    if src_node.var is None:
+        if any(n.var == "__strip_src__" for n in path.nodes) or any(
+            r.var == "__strip_src__" for r in path.rels
+        ):
+            return None
+        src_node = A.PatternNode(
+            var="__strip_src__", labels=src_node.labels, props=src_node.props
+        )
+    tpath = A.PatternPath(
+        nodes=list(path.nodes[:-2]) + [src_node],
+        rels=list(path.rels[:-1]),
+    )
+    return {
+        "tpath": tpath,
+        "src_var": src_node.var,
+        "etype": pr.types[0],
+        "direction": pr.direction,
+        "label": pn.labels[0] if pn.labels else None,
+        "var": pn.var,
+    }
+
+
+def _exec_strip(catalog, strip: Dict[str, Any], ctx) -> Optional[_Bindings]:
+    b = _match_chain(catalog, strip["tpath"], ctx)
     if b is None:
-        return None  # empty graph handled below via n_rows == 0
+        return None
+    src_rows = b.node_cols[strip["src_var"]]
+    deg = catalog.filtered_degree(
+        strip["etype"], strip["direction"], strip["label"]
+    )
+    w = deg[src_rows]
+    keep = w > 0
+    b.take(keep)
+    b.row_weights = w[keep]
+    if strip["var"]:
+        b.stripped_vars.add(strip["var"])
+    return b
 
-    # WHERE
-    if m.where is not None:
-        for conj in _split_and(m.where):
-            mask = _vec_predicate(conj, b, catalog, ctx)
-            b.take(mask)
 
-    return _project(executor, catalog, ret, b, ctx, CypherResult)
+def _analyze_cooc(path: A.PatternPath, m: A.MatchClause,
+                  ret: A.ReturnClause) -> Optional[Dict[str, Any]]:
+    """Co-occurrence shape analysis for (a)<-[:T]-(mid)-[:T]->(b): the
+    per-(a, b) match count is the off-diagonal of an incidence-matrix
+    product MaT @ Mb — an MXU-shaped contraction instead of a join
+    expansion (reference serves this family through hand-written
+    executors, optimized_executors.go:25-282; LDBC "tag co-occurrence",
+    BASELINE.md). The middle node may only be count()ed; both hops must
+    be the same single type so relationship uniqueness reduces to the
+    same-edge diagonal correction. AST-only; cached on the parsed
+    query."""
+    nodes, rels = path.nodes, path.rels
+    if len(nodes) != 3 or len(rels) != 2:
+        return None
+    r0, r1 = rels
+    if r0.types[0] != r1.types[0] or r0.var or r1.var:
+        return None
+    dirs = (r0.direction, r1.direction)
+    if dirs not in (("in", "out"), ("out", "in")):
+        return None
+    a, mid, bn = nodes
+    for pn in nodes:
+        if pn.props is not None or len(pn.labels) > 1:
+            return None
+    if not _count_only_usage(mid.var, m, ret):
+        return None
+    return {
+        "etype": r0.types[0],
+        "orientation": "mid_src" if dirs == ("in", "out") else "mid_dst",
+        "mid_label": mid.labels[0] if mid.labels else None,
+        "a_label": a.labels[0] if a.labels else None,
+        "b_label": bn.labels[0] if bn.labels else None,
+        "a_var": a.var,
+        "b_var": bn.var,
+        "mid_var": mid.var,
+    }
+
+
+def _exec_cooc(catalog, cooc: Dict[str, Any], ctx) -> Optional[_Bindings]:
+    etype = cooc["etype"]
+    orientation = cooc["orientation"]
+    inc_a = catalog.incidence(
+        etype, orientation, cooc["mid_label"], cooc["a_label"]
+    )
+    inc_b = catalog.incidence(
+        etype, orientation, cooc["mid_label"], cooc["b_label"]
+    )
+    if inc_a is None or inc_b is None:
+        return None  # over the dense-matrix budget: join expansion instead
+    ma, a_c, ea, a_pos = inc_a
+    mb, b_c, eb, b_pos = inc_b
+    # the two incidence fetches (and the edge table below) can straddle a
+    # concurrent write's cache invalidation; mismatched snapshots must
+    # fall back to the general path, not crash the read
+    if ma.shape[0] != mb.shape[0] or len(ea) != len(eb):
+        return None
+
+    # float32 loses integer exactness past 2^24; a cheap upper bound on
+    # any per-pair count is n_mid * max(ma) * max(mb)
+    if ma.size and mb.size and (
+        float(ma.shape[0]) * float(ma.max()) * float(mb.max()) >= 2.0 ** 24
+    ):
+        c = ma.astype(np.float64).T @ mb.astype(np.float64)
+    else:
+        c = ma.T @ mb
+    # relationship uniqueness: a match may not use one edge for both
+    # hops; such pairs land at (far, far) of each doubly-usable edge
+    both = ea & eb
+    if both.any():
+        tbl = catalog.edge_table(etype)
+        far_e = tbl.dst if orientation == "mid_src" else tbl.src
+        if len(far_e) != len(both):
+            return None  # edge table raced a write; general path instead
+        flat = a_pos[far_e[both]] * c.shape[1] + b_pos[far_e[both]]
+        c -= np.bincount(flat, minlength=c.size).reshape(c.shape)
+
+    ii, jj = np.nonzero(c >= 0.5)
+    b_out = _Bindings()
+    if cooc["a_var"]:
+        b_out.node_cols[cooc["a_var"]] = a_c[ii].astype(np.int32, copy=False)
+        b_out.cand_map[cooc["a_var"]] = (a_c, ii)
+    if cooc["b_var"]:
+        b_out.node_cols[cooc["b_var"]] = b_c[jj].astype(np.int32, copy=False)
+        b_out.cand_map[cooc["b_var"]] = (b_c, jj)
+    b_out.row_weights = np.rint(c[ii, jj]).astype(np.int64)
+    b_out.n_rows = len(ii)
+    # (a, b) pairs are distinct by construction — but only the full pair;
+    # with one endpoint anonymous the remaining codes repeat
+    b_out.rows_are_groups = bool(cooc["a_var"] and cooc["b_var"])
+    if cooc["mid_var"]:
+        b_out.stripped_vars.add(cooc["mid_var"])
+    return b_out
 
 
 def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
@@ -279,6 +576,9 @@ def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
     b = _Bindings()
     slot_cols: List[Optional[np.ndarray]] = [None] * len(nodes)
     slot_cols[anchor] = rows0.astype(np.int32, copy=False)
+    # anchor group codes ride along through every replication for free,
+    # so grouping by the anchor var later skips a dense-coding pass
+    anchor_codes = [np.arange(len(rows0), dtype=np.int64)]
 
     def take_all(sel) -> None:
         for i in range(len(nodes)):
@@ -286,6 +586,7 @@ def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
                 slot_cols[i] = slot_cols[i][sel]
         b.edge_cols = {k: (t, x[sel]) for k, (t, x) in b.edge_cols.items()}
         b.hop_edges = [(t, x[sel]) for t, x in b.hop_edges]
+        anchor_codes[0] = anchor_codes[0][sel]
 
     def expand(frm: int, to: int, rel_idx: int) -> None:
         pr = rels[rel_idx]
@@ -305,6 +606,7 @@ def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
                 slot_cols[i] = slot_cols[i][rep]
         b.edge_cols = {k: (t, x[rep]) for k, (t, x) in b.edge_cols.items()}
         b.hop_edges = [(t, x[rep]) for t, x in b.hop_edges]
+        anchor_codes[0] = anchor_codes[0][rep]
         slot_cols[to] = targets
         if pr.var:
             b.edge_cols[pr.var] = (table, edge_rows)
@@ -329,6 +631,10 @@ def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
     for i, pn in enumerate(nodes):
         if pn.var:
             b.node_cols[pn.var] = slot_cols[i]
+    if nodes[anchor].var:
+        b.cand_map[nodes[anchor].var] = (
+            rows0.astype(np.int32, copy=False), anchor_codes[0]
+        )
     b.n_rows = len(slot_cols[anchor]) if slot_cols[anchor] is not None else 0
     return b
 
@@ -695,23 +1001,15 @@ def _materialize_rows(b: _Bindings, catalog) -> Optional[List[Dict[str, Any]]]:
 # -- projection / aggregation --------------------------------------------
 
 
-def _project(executor, catalog, ret: A.ReturnClause, b: _Bindings, ctx, CypherResult):
-    from nornicdb_tpu.query.executor import _contains_agg
-
-    has_agg = any(_contains_agg(i.expr) for i in ret.items)
-    cols = []
-    for item in ret.items:
-        if item.alias:
-            cols.append(item.alias)
-        elif isinstance(item.expr, A.Var):
-            cols.append(item.expr.name)
-        elif isinstance(item.expr, A.Prop) and isinstance(item.expr.target, A.Var):
-            cols.append(f"{item.expr.target.name}.{item.expr.name}")
-        else:
-            cols.append(item.text)
+def _project(executor, catalog, ret: A.ReturnClause, b: _Bindings, ctx,
+             CypherResult, plan: Dict[str, Any]):
+    has_agg = plan["has_agg"]
+    if b.row_weights is not None and not has_agg:
+        _bail()  # multiplicity weights are only meaningful under aggregation
+    cols = plan["cols"]
 
     if has_agg:
-        out_cols = _aggregate(catalog, ret, b, ctx)
+        out_cols = _aggregate(catalog, ret, b, ctx, plan)
     else:
         out_cols = []
         for item in ret.items:
@@ -744,8 +1042,9 @@ def _project(executor, catalog, ret: A.ReturnClause, b: _Bindings, ctx, CypherRe
             nodes = catalog.nodes()
             lst = [nodes[v.row] for v in lst]
         py_cols.append(lst)
-    rows = [list(t) for t in zip(*py_cols)] if py_cols else []
-    return CypherResult(columns=cols, rows=rows)
+    if not py_cols:
+        return CypherResult(columns=cols, rows=[])
+    return CypherResult(columns=cols, col_data=py_cols)
 
 
 class _NodeRef:
@@ -779,10 +1078,10 @@ def _codeable(col: np.ndarray, b: _Bindings, catalog) -> np.ndarray:
 
 def _first_occurrence(codes: np.ndarray) -> np.ndarray:
     """Row index of the first occurrence of each group code, in
-    first-encounter order (matches the general path's insertion order)."""
-    n_groups = int(codes.max()) + 1 if len(codes) else 0
-    first = np.full(n_groups, np.iinfo(np.int64).max, dtype=np.int64)
-    np.minimum.at(first, codes, np.arange(len(codes), dtype=np.int64))
+    first-encounter order (matches the general path's insertion order).
+    np.unique's return_index gives first occurrences (stable sort);
+    ufunc.at is an order of magnitude slower here."""
+    _, first = np.unique(codes, return_index=True)
     return np.sort(first)
 
 
@@ -798,12 +1097,18 @@ def _dense_codes(rows: np.ndarray, n_max: int) -> Tuple[np.ndarray, np.ndarray]:
     return uniq, lut[rows]
 
 
+def _dense_ok(domain: int, n_rows: int, floor: int = 0) -> bool:
+    """Dense lookup-table strategy budget: allocate O(domain) scratch
+    only when the domain is comparable to the row count — a 20-row group
+    on a 50M-node graph must not allocate graph-sized scratch. Single
+    definition so every dense/sparse strategy switch tunes together."""
+    return 0 < domain <= max(floor, 4 * n_rows + 4096)
+
+
 def _int_codes(rows: np.ndarray, n_max: int) -> Tuple[np.ndarray, np.ndarray]:
     """Strategy switch: dense lookup when the value domain is comparable
-    to the row count (O(n_max) allocation), else sort-based np.unique —
-    a 20-row group on a 50M-node graph must not allocate graph-sized
-    scratch."""
-    if 0 < n_max <= 4 * len(rows) + 4096:
+    to the row count (O(n_max) allocation), else sort-based np.unique."""
+    if _dense_ok(n_max, len(rows)):
         return _dense_codes(rows, n_max)
     return np.unique(rows, return_inverse=True)
 
@@ -820,7 +1125,11 @@ def _group_code_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
 
     if isinstance(e, A.Prop) and isinstance(e.target, A.Var):
         name = e.target.name
-        if name in b.node_cols:
+        cm = b.cand_map.get(name)
+        if cm is not None and _dense_ok(len(cm[0]), len(cm[1])):
+            uniq_rows, inv = cm
+            vals = catalog.node_prop_col(e.name)[uniq_rows]
+        elif name in b.node_cols:
             rows = b.node_cols[name]
             uniq_rows, inv = _int_codes(rows, catalog.n_nodes())
             vals = catalog.node_prop_col(e.name)[uniq_rows]
@@ -833,6 +1142,9 @@ def _group_code_col(e: A.Expr, b: _Bindings, catalog, ctx) -> np.ndarray:
         _, vcodes = _unique_inverse(vals)
         return vcodes[inv]
     if isinstance(e, A.Var):
+        cm = b.cand_map.get(e.name)
+        if cm is not None and _dense_ok(len(cm[0]), len(cm[1])):
+            return cm[1].astype(np.int64, copy=False)
         if e.name in b.node_cols:
             _, inv = _int_codes(b.node_cols[e.name], catalog.n_nodes())
             return inv
@@ -854,7 +1166,7 @@ def _combine_codes(code_cols: List[np.ndarray]) -> np.ndarray:
         width = int(c.max()) + 1 if len(c) else 1
         combined = combined * width + c
         span *= width
-    if 0 < span <= 4 * len(combined) + 4096:
+    if _dense_ok(span, len(combined)):
         # dense lookup beats the sort inside np.unique
         _, codes = _dense_codes(combined, span)
         return codes
@@ -862,35 +1174,70 @@ def _combine_codes(code_cols: List[np.ndarray]) -> np.ndarray:
     return codes
 
 
-def _aggregate(catalog, ret: A.ReturnClause, b: _Bindings, ctx) -> List[np.ndarray]:
-    from nornicdb_tpu.query.executor import _contains_agg
+def _rows_are_value_groups(group_items, b: _Bindings, catalog) -> bool:
+    """True when binding rows are already exactly the output groups:
+    rows are pairwise-distinct over the cand_map codes (co-occurrence
+    guarantees this), every group key is a property of a cand_map var,
+    the keys cover all cand_map vars, and each key's values over its
+    candidates are non-null and injective — then value-grouping cannot
+    merge anything and the whole coding machinery is an identity."""
+    if not b.rows_are_groups or not group_items or not b.cand_map:
+        return False
+    for cands, _codes in b.cand_map.values():
+        if not _dense_ok(len(cands), b.n_rows):
+            return False  # candidate table much larger than the rows
+    vars_used = set()
+    for item in group_items:
+        e = item.expr
+        if not (isinstance(e, A.Prop) and isinstance(e.target, A.Var)
+                and e.target.name in b.cand_map):
+            return False
+        vars_used.add(e.target.name)
+        cands, _codes = b.cand_map[e.target.name]
+        vals = catalog.node_prop_col(e.name)[cands].tolist()
+        seen = set()
+        for v in vals:
+            if v is None or isinstance(v, (list, dict)) or v in seen:
+                return False
+            seen.add(v)
+    return vars_used == set(b.cand_map)
 
-    group_items = [i for i in ret.items if not _contains_agg(i.expr)]
-    key_cols = [
-        _group_code_col(i.expr, b, catalog, ctx) for i in group_items
-    ]
-    if key_cols:
-        codes = _combine_codes(key_cols)
-        first = _first_occurrence(codes)
-        # remap codes so group ids follow first-encounter order (matches
-        # the general path's insertion-ordered groups); `first` is sorted,
-        # so codes[first] lists groups in encounter order.
-        rank = np.empty(len(first), dtype=np.int64)
-        rank[codes[first]] = np.arange(len(first))
-        codes = rank[codes]
-        n_groups = len(first)
+
+def _aggregate(catalog, ret: A.ReturnClause, b: _Bindings, ctx,
+               plan: Dict[str, Any]) -> List[np.ndarray]:
+    agg_flags = plan["agg_flags"]
+    group_items = [i for i, f in zip(ret.items, agg_flags) if not f]
+    identity_groups = _rows_are_value_groups(group_items, b, catalog)
+    if identity_groups:
+        codes = np.arange(b.n_rows, dtype=np.int64)
+        first = codes
+        n_groups = b.n_rows
     else:
-        codes = np.zeros(b.n_rows, dtype=np.int64)
-        first = np.zeros(1, dtype=np.int64) if b.n_rows else np.empty(0, np.int64)
-        n_groups = 1  # global aggregation has exactly one output row
+        key_cols = [
+            _group_code_col(i.expr, b, catalog, ctx) for i in group_items
+        ]
+        if key_cols:
+            codes = _combine_codes(key_cols)
+            first = _first_occurrence(codes)
+            # remap codes so group ids follow first-encounter order
+            # (matches the general path's insertion-ordered groups);
+            # `first` is sorted, so codes[first] lists groups in
+            # encounter order.
+            rank = np.empty(len(first), dtype=np.int64)
+            rank[codes[first]] = np.arange(len(first))
+            codes = rank[codes]
+            n_groups = len(first)
+        else:
+            codes = np.zeros(b.n_rows, dtype=np.int64)
+            first = (np.zeros(1, dtype=np.int64) if b.n_rows
+                     else np.empty(0, np.int64))
+            n_groups = 1  # global aggregation has exactly one output row
 
     out: List[np.ndarray] = []
-    gi = 0
-    for item in ret.items:
-        if not _contains_agg(item.expr):
+    for item, is_agg in zip(ret.items, agg_flags):
+        if not is_agg:
             full = _out_col(item.expr, b, catalog, ctx)
-            out.append(full[first])
-            gi += 1
+            out.append(full if identity_groups else full[first])
         else:
             out.append(_agg_expr(item.expr, b, catalog, ctx, codes, n_groups))
     return out
@@ -964,14 +1311,34 @@ def _agg_leaf(
     e: A.FuncCall, b: _Bindings, catalog, ctx, codes: np.ndarray, n_groups: int
 ) -> np.ndarray:
     name = e.name
+    w = b.row_weights
+
+    def _row_count(sel_codes, sel_w):
+        if sel_w is None:
+            return np.bincount(sel_codes, minlength=n_groups)[:n_groups]
+        return np.bincount(
+            sel_codes, weights=sel_w, minlength=n_groups
+        )[:n_groups].astype(np.int64)
+
     if name == "count" and e.star:
-        cnt = np.bincount(codes, minlength=n_groups)[:n_groups]
         out = np.empty(n_groups, dtype=object)
-        out[:] = cnt.tolist()  # C-speed int64 -> python int
+        out[:] = _row_count(codes, w).tolist()  # int64 -> python int
         return out
     if not e.args:
         _bail()
     arg = e.args[0]
+    if (
+        name == "count"
+        and isinstance(arg, A.Var)
+        and arg.name in b.stripped_vars
+    ):
+        # the folded-out hop target: bound (non-null) in every match row
+        # a binding row stands for, so count(var) == weighted row count
+        if e.distinct:
+            _bail()
+        out = np.empty(n_groups, dtype=object)
+        out[:] = _row_count(codes, w).tolist()
+        return out
     if isinstance(arg, A.Var) and arg.name in b.node_cols:
         vals = b.node_cols[arg.name].astype(np.int64)
         nonnull = np.ones(b.n_rows, dtype=bool)
@@ -983,6 +1350,19 @@ def _agg_leaf(
 
     if name == "count":
         if e.distinct:
+            if vals is not None and len(vals):
+                # node rows are already small dense ints: flag-table
+                # distinct count, no sort, no re-coding pass
+                k = int(vals.max()) + 1
+                span = n_groups * k
+                if _dense_ok(span, len(vals), floor=1_000_000):
+                    flags = np.zeros(span, dtype=bool)
+                    flags[codes * k + vals] = True
+                    nz = np.flatnonzero(flags)
+                    cnt = np.bincount(nz // k, minlength=n_groups)[:n_groups]
+                    out = np.empty(n_groups, dtype=object)
+                    out[:] = cnt.tolist()
+                    return out
             if vals is None:
                 from nornicdb_tpu.query.columnar import group_codes as _gc
 
@@ -997,7 +1377,7 @@ def _agg_leaf(
             grp = uniq_pairs // denom
             cnt = np.bincount(grp, minlength=n_groups)[:n_groups]
         else:
-            cnt = np.bincount(codes[nonnull], minlength=n_groups)[:n_groups]
+            cnt = _row_count(codes[nonnull], w[nonnull] if w is not None else None)
         out = np.empty(n_groups, dtype=object)
         out[:] = cnt.tolist()
         return out
@@ -1006,6 +1386,8 @@ def _agg_leaf(
         _bail()
 
     if name == "collect":
+        if w is not None:
+            _bail()  # collect is order/multiplicity sensitive
         src = values_obj
         sel = nonnull
         if e.distinct:
@@ -1055,7 +1437,13 @@ def _agg_leaf(
     if e.distinct:
         _bail()
     safe = np.where(fmask, fvals, 0.0)
+    if w is not None:
+        safe = safe * w  # multiplicity-weighted sums
     cnt = np.bincount(codes[fmask], minlength=n_groups)[:n_groups]
+    if name == "avg" and w is not None:
+        cnt = np.bincount(
+            codes[fmask], weights=w[fmask], minlength=n_groups
+        )[:n_groups]
     if name == "sum":
         s = np.bincount(codes, weights=safe, minlength=n_groups)[:n_groups]
         out = np.empty(n_groups, dtype=object)

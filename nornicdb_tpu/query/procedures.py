@@ -13,13 +13,17 @@ from nornicdb_tpu.errors import CypherRuntimeError
 
 
 def _coerce_instant(v: Any):
-    """Any temporal-ish value -> comparable instant (epoch seconds)."""
+    """Any temporal-ish value -> comparable instant (epoch seconds).
+    Bare numbers are epoch MILLIS, matching the datetime() builtin's
+    convention (temporal_types.make_datetime; Neo4j
+    datetime({epochMillis: v})) so mixed string/numeric temporal
+    properties compare on one scale."""
     from nornicdb_tpu.query import temporal_types as T
 
     if v is None:
         return None
     if isinstance(v, (int, float)) and not isinstance(v, bool):
-        return float(v)
+        return float(v) / 1000.0
     if isinstance(v, str):
         return T.make_datetime(v)._epoch_seconds()
     if isinstance(v, (T.CypherDateTime, T.CypherLocalDateTime)):

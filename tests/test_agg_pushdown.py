@@ -1,0 +1,252 @@
+"""Aggregation-pushdown fast paths: terminal-hop degree folding and
+co-occurrence incidence matmul (reference: traversal_fast_agg.go:15,57,
+optimized_executors.go:25-282).
+
+Every query here runs with fast paths on and off and must agree exactly
+(up to row order). These shapes are the LDBC "avg friends per city" /
+"tag co-occurrence" family — the two hardest rows in BASELINE.md.
+"""
+
+import random
+import uuid
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+def _sorted_rows(result):
+    return sorted(repr(r) for r in result.rows)
+
+
+@pytest.fixture(scope="module")
+def graph():
+    eng = NamespacedEngine(MemoryEngine(), "pushdown")
+    rng = random.Random(3)
+
+    def add_node(labels, props):
+        n = Node(id=str(uuid.uuid4()), labels=labels, properties=props)
+        eng.create_node(n)
+        return n.id
+
+    def add_edge(etype, a, b):
+        eng.create_edge(
+            Edge(id=str(uuid.uuid4()), type=etype, start_node=a,
+                 end_node=b, properties={})
+        )
+
+    cities = [add_node(["City"], {"name": c})
+              for c in ["Oslo", "Bergen", "Pune"]]
+    # one city with no residents: must not appear in grouped output
+    add_node(["City"], {"name": "Ghost"})
+    tags = [add_node(["Tag"], {"name": f"t{i}"}) for i in range(8)]
+    # two tags sharing a name: value-grouping must merge them
+    tags.append(add_node(["Tag"], {"name": "t0"}))
+    # a tag with a null name: null group key
+    tags.append(add_node(["Tag"], {}))
+    people = [add_node(["Person"], {"id": i, "age": 20 + i})
+              for i in range(30)]
+    for i, pid in enumerate(people):
+        add_edge("LIVES_IN", pid, cities[i % 3])
+        for j in rng.sample(range(30), 4):
+            if j != i:
+                add_edge("KNOWS", pid, people[j])
+    # one person with no KNOWS edges at all
+    loner = add_node(["Person"], {"id": 99, "age": 77})
+    add_edge("LIVES_IN", loner, cities[0])
+    msgs = []
+    for m in range(60):
+        mid = add_node(["Message"], {"id": m})
+        msgs.append(mid)
+        for t in rng.sample(range(len(tags)), rng.randrange(1, 4)):
+            add_edge("TAGGED", mid, tags[t])
+    # duplicate edge: same message tagged twice with the same tag
+    add_edge("TAGGED", msgs[0], tags[1])
+    add_edge("TAGGED", msgs[0], tags[1])
+    return eng
+
+
+def _both(graph, query, params=None):
+    fast = CypherExecutor(graph)
+    fast.enable_query_cache = False
+    slow = CypherExecutor(graph)
+    slow.enable_query_cache = False
+    slow.enable_fastpaths = False
+    rf = fast.execute(query, params or {})
+    rs = slow.execute(query, params or {})
+    assert rf.columns == rs.columns
+    assert _sorted_rows(rf) == _sorted_rows(rs)
+    return rf
+
+
+PUSHDOWN_CORPUS = [
+    # terminal-hop count -> degree fold
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+    "RETURN c.name, count(f)",
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+    "RETURN c.name, count(f) / count(DISTINCT p) AS avg",
+    # anonymous terminal node
+    "MATCH (p:Person)-[:KNOWS]->(:Person) RETURN count(*)",
+    # unlabeled terminal node (unfiltered degree)
+    "MATCH (p:Person)-[:KNOWS]->(x) RETURN p.id, count(x)",
+    # terminal hop inbound
+    "MATCH (p:Person)<-[:KNOWS]-(f:Person) RETURN p.id, count(f)",
+    # weighted sum/avg over a non-stripped column
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f) "
+    "RETURN c.name, sum(p.age), count(f)",
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f) "
+    "RETURN c.name, avg(p.age)",
+    # min/max are multiplicity-insensitive but ride the weighted path
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f) "
+    "RETURN c.name, min(p.age), max(p.age)",
+    # global aggregation (no group keys) with stripped tail
+    "MATCH (p:Person)-[:KNOWS]->(f:Person) RETURN count(f)",
+    # ORDER BY over aggregated output
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+    "RETURN c.name, count(f) AS k ORDER BY k DESC",
+    # NOT strippable: terminal var projected -> general/chain path parity
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+    "RETURN c.name, count(f.age)",
+    # NOT strippable: count(DISTINCT f)
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+    "RETURN c.name, count(DISTINCT f)",
+    # NOT strippable: terminal var in WHERE
+    "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+    "WHERE f.age > 30 RETURN c.name, count(f)",
+]
+
+COOC_CORPUS = [
+    # flagship co-occurrence (duplicate-name tags merge; null-name tag
+    # groups; duplicate edges feed the same-edge correction)
+    "MATCH (t1:Tag)<-[:TAGGED]-(m:Message)-[:TAGGED]->(t2:Tag) "
+    "WHERE t1 <> t2 RETURN t1.name, t2.name, count(m) AS freq",
+    # without the inequality: diagonal pairs from duplicate edges remain
+    "MATCH (t1:Tag)<-[:TAGGED]-(m)-[:TAGGED]->(t2:Tag) "
+    "RETURN t1.name, t2.name, count(m)",
+    # unlabeled middle
+    "MATCH (t1:Tag)<-[:TAGGED]-(x)-[:TAGGED]->(t2:Tag) "
+    "WHERE t1 <> t2 RETURN t1.name, t2.name, count(*)",
+    # reversed orientation (ends point at middle)
+    "MATCH (m1:Message)-[:TAGGED]->(t:Tag)<-[:TAGGED]-(m2:Message) "
+    "WHERE m1 <> m2 RETURN count(*)",
+    # grouping by only one endpoint (rows-are-groups must NOT trigger)
+    "MATCH (t1:Tag)<-[:TAGGED]-(m)-[:TAGGED]->(t2:Tag) "
+    "WHERE t1 <> t2 RETURN t1.name, count(m)",
+    # node-identity group keys
+    "MATCH (t1:Tag)<-[:TAGGED]-(m)-[:TAGGED]->(t2:Tag) "
+    "RETURN t1, t2, count(m)",
+    # ORDER BY / LIMIT over pairs (total order so LIMIT is deterministic)
+    "MATCH (t1:Tag)<-[:TAGGED]-(m)-[:TAGGED]->(t2:Tag) "
+    "WHERE t1 <> t2 AND t1.name IS NOT NULL AND t2.name IS NOT NULL "
+    "RETURN t1.name AS a, t2.name AS b, count(m) AS freq "
+    "ORDER BY freq DESC, a, b LIMIT 5",
+]
+
+
+@pytest.mark.parametrize("query", PUSHDOWN_CORPUS)
+def test_pushdown_parity(graph, query):
+    _both(graph, query)
+
+
+@pytest.mark.parametrize("query", COOC_CORPUS)
+def test_cooccurrence_parity(graph, query):
+    _both(graph, query)
+
+
+def test_pushdown_actually_triggers(graph):
+    """The two flagship shapes must not silently fall back."""
+    from nornicdb_tpu.query import fastpaths
+    from nornicdb_tpu.query.parser import parse
+
+    q = parse(
+        "MATCH (c:City)<-[:LIVES_IN]-(p:Person)-[:KNOWS]->(f:Person) "
+        "RETURN c.name, count(f)"
+    ).parts[0]
+    plan = fastpaths._analyze_vectorized(q)
+    assert plan is not None and plan["strip"] is not None
+
+    q2 = parse(
+        "MATCH (t1:Tag)<-[:TAGGED]-(m)-[:TAGGED]->(t2:Tag) "
+        "WHERE t1 <> t2 RETURN t1.name, t2.name, count(m)"
+    ).parts[0]
+    plan2 = fastpaths._analyze_vectorized(q2)
+    assert plan2 is not None and plan2["cooc"] is not None
+
+
+def test_filtered_degree_index(graph):
+    from nornicdb_tpu.query.columnar import ColumnarCatalog
+
+    cat = ColumnarCatalog(graph)
+    deg = cat.filtered_degree("KNOWS", "out", "Person")
+    nodes = cat.nodes()
+    for row in range(len(nodes)):
+        n = nodes[row]
+        if "Person" not in n.labels:
+            continue
+        expect = sum(
+            1 for e in graph.get_node_edges(n.id, direction="out")
+            if e.type == "KNOWS" and e.start_node == n.id
+        )
+        assert deg[row] == expect, n.properties
+
+
+def test_pushdown_sees_writes(graph_factory=None):
+    """Degree/incidence caches must invalidate on mutation."""
+    eng = NamespacedEngine(MemoryEngine(), "inv")
+    ex = CypherExecutor(eng)
+    ex.enable_query_cache = False
+    ex.execute("CREATE (:P {id: 1})-[:R]->(:Q)")
+    q = "MATCH (p:P)-[:R]->(x:Q) RETURN p.id, count(x)"
+    assert ex.execute(q).rows == [[1, 1]]
+    ex.execute("MATCH (p:P {id: 1}) CREATE (p)-[:R]->(:Q)")
+    assert ex.execute(q).rows == [[1, 2]]
+    ex.execute("CREATE (:P {id: 2})-[:R]->(:Q)")
+    assert _sorted_rows(ex.execute(q)) == sorted(
+        [repr([1, 2]), repr([2, 1])]
+    )
+
+
+def test_lazy_result_contract():
+    """Column-major CypherResult: rows materialize lazily and are safe
+    to mutate per consumer; cache hits share columns, not rows."""
+    from nornicdb_tpu.query.executor import CypherResult
+
+    r = CypherResult(columns=["a", "b"], col_data=[[1, 2], ["x", "y"]])
+    assert r.n_rows == 2
+    assert r.col_values(1) == ["x", "y"]
+    rows = r.rows
+    assert rows == [[1, "x"], [2, "y"]]
+    rows[0][0] = 99  # mutation sticks to this materialization
+    assert r.rows[0][0] == 99
+
+    # cache round-trip: hits see original values, not a prior consumer's
+    # mutations
+    eng = NamespacedEngine(MemoryEngine(), "lazy")
+    ex = CypherExecutor(eng)
+    ex.execute("CREATE (:T {v: 1}), (:T {v: 2})")
+    q = "MATCH (t:T) RETURN t.v ORDER BY t.v"
+    r1 = ex.execute(q)
+    assert r1.rows == [[1], [2]]
+    r1.rows[0][0] = 42
+    r2 = ex.execute(q)  # cache hit
+    assert r2.rows == [[1], [2]]
+
+
+def test_union_all_with_columnar_parts():
+    """UNION ALL merges parts by extending rows in place; a column-major
+    first part must not replay only its own rows on cache hits
+    (regression: stale _col_data shadowing merged rows)."""
+    eng = NamespacedEngine(MemoryEngine(), "union")
+    ex = CypherExecutor(eng)
+    ex.execute("CREATE (:A {v: 1})")
+    ex.execute("CREATE (:B {v: 2})")
+    q = ("MATCH (a:A) RETURN a.v AS v "
+         "UNION ALL MATCH (b:B) RETURN b.v AS v")
+    r1 = ex.execute(q)
+    assert sorted(r1.rows) == [[1], [2]]
+    assert sorted([r1.col_values(0)[i] for i in range(r1.n_rows)]) == [1, 2]
+    r2 = ex.execute(q)  # cache hit must carry both parts
+    assert sorted(r2.rows) == [[1], [2]]
