@@ -795,6 +795,16 @@ class CypherExecutor:
             return self._shortest_path(
                 pat.pattern, row, ctx, all_paths=name == "allshortestpaths"
             )
+        if name in ("degree", "indegree", "outdegree"):
+            # storage-backed degree functions (reference
+            # functions_eval_functions.go:534-560): 0 for non-nodes
+            v = self._eval(e.args[0], row, ctx) if e.args else None
+            if not isinstance(v, Node):
+                return 0
+            direction = {"degree": Direction.BOTH,
+                         "indegree": Direction.INCOMING,
+                         "outdegree": Direction.OUTGOING}[name]
+            return ctx.storage.degree(v.id, direction)
         args = [self._eval(a, row, ctx) for a in e.args]
         fn = self._plugin_functions.get(name) or lookup_fn(name)
         if fn is None:

@@ -505,6 +505,255 @@ def _raise(exc):
     raise exc
 
 
+def _install_reference_tail() -> None:
+    """Long-tail builtins for reference parity (functions_eval_math.go,
+    functions_eval_functions.go, kalman_functions.go): hyperbolic math,
+    string padding, legacy aliases, component-accessor function forms,
+    spatial geometry, vector similarity, Kalman filters."""
+    from nornicdb_tpu.query import temporal_types as T
+
+    # hyperbolic / aliases
+    register("sinh", lambda x: None if x is None else math.sinh(_num(x)))
+    register("cosh", lambda x: None if x is None else math.cosh(_num(x)))
+    register("tanh", lambda x: None if x is None else math.tanh(_num(x)))
+    register("coth", lambda x: None if x is None else (
+        float("inf") if math.tanh(_num(x)) == 0
+        else 1.0 / math.tanh(_num(x))))
+    def _power(x, y):
+        if x is None or y is None:
+            return None
+        xv, yv = _num(x), _num(y)
+        if (isinstance(xv, int) and isinstance(yv, int) and yv >= 0):
+            return xv ** yv
+        try:
+            return math.pow(xv, yv)  # NaN/domain cases below
+        except (ValueError, ZeroDivisionError):
+            if xv == 0 and yv < 0:
+                return float("inf")  # 0 ^ negative (IEEE pow)
+            return float("nan")  # e.g. (-2) ^ 0.5
+
+    register("power", _power)
+    register("toint", REGISTRY["tointeger"])
+    register("lower", REGISTRY["tolower"])
+    register("upper", REGISTRY["toupper"])
+
+    # string padding / search
+    def _lpad(s, width, pad=" "):
+        if s is None or width is None:
+            return None
+        s = str(s)
+        pad = " " if pad is None else (str(pad) or " ")
+        w = int(width)
+        if len(s) >= w:
+            return s
+        fill = (pad * w)[: w - len(s)]
+        return fill + s
+
+    def _rpad(s, width, pad=" "):
+        if s is None or width is None:
+            return None
+        s = str(s)
+        pad = " " if pad is None else (str(pad) or " ")
+        w = int(width)
+        if len(s) >= w:
+            return s
+        return s + (pad * w)[: w - len(s)]
+
+    register("lpad", _lpad)
+    register("rpad", _rpad)
+
+    def _index_of(coll, item):
+        if coll is None:
+            return None
+        if isinstance(coll, str):
+            return coll.find("" if item is None else str(item))
+        if isinstance(coll, list):
+            for i, x in enumerate(coll):
+                if x == item and isinstance(x, bool) == isinstance(item, bool):
+                    return i
+            return -1
+        raise CypherRuntimeError("indexOf() expects list or string")
+
+    register("indexof", _index_of)
+    register("nullif", lambda a, b: (
+        None if a == b and isinstance(a, bool) == isinstance(b, bool)
+        else a))
+
+    def _format(template, *args):
+        if template is None:
+            return None
+        t = str(template).replace("%v", "%s")
+        try:
+            return t % tuple(args)
+        except (TypeError, ValueError):
+            try:
+                return t % tuple(str(a) for a in args)
+            except (TypeError, ValueError):
+                return t
+
+    register("format", _format)
+
+    def _slice(lst, start, end=None):
+        if lst is None or start is None:
+            return None
+        if not isinstance(lst, list):
+            raise CypherRuntimeError("slice() expects a list")
+        n = len(lst)
+        s = int(start)
+        e = n if end is None else int(end)
+        if s < 0:
+            s += n
+        if e < 0:
+            e += n
+        s = max(s, 0)
+        e = min(e, n)
+        return lst[s:e] if s < e else []
+
+    register("slice", _slice)
+
+    def _has_labels(node, labels):
+        if not isinstance(node, Node):
+            return False
+        want = labels if isinstance(labels, list) else [labels]
+        return all(lb in node.labels for lb in want)
+
+    register("haslabels", _has_labels)
+
+    # component-accessor function forms: date.year(d), datetime.hour(x)…
+    def _component(name):
+        def get(v):
+            if v is None:
+                return None
+            comp = getattr(v, "component", None)
+            if comp is None:
+                v2 = T.make_datetime(v)
+                return v2.component(name)
+            return comp(name)
+        return get
+
+    for comp in ("year", "quarter", "month", "week", "weekyear", "day",
+                 "dayofweek", "dayofyear", "ordinalday"):
+        register(f"date.{comp}", _component(comp))
+    for comp in ("year", "month", "day", "hour", "minute", "second"):
+        register(f"datetime.{comp}", _component(comp))
+    register("time.truncate",
+             lambda unit, v=None: T.truncate(unit, v if v is not None
+                                             else T.make_time(), "time"))
+    register("localtime.truncate",
+             lambda unit, v=None: T.truncate(unit, v if v is not None
+                                             else T.make_localtime(),
+                                             "localtime"))
+
+    # point component accessors
+    def _point_comp(name):
+        def get(p):
+            if p is None:
+                return None
+            if not isinstance(p, T.CypherPoint):
+                raise CypherRuntimeError(f"point.{name}() expects a point")
+            return p.component(name)
+        return get
+
+    for comp in ("x", "y", "z", "crs", "srid", "latitude", "longitude",
+                 "height"):
+        register(f"point.{comp}", _point_comp(comp))
+
+    def _within_distance(p, center, dist):
+        if p is None or center is None or dist is None:
+            return None
+        d = T.point_distance(p, center)
+        if d is None:  # cross-CRS distance is null
+            return None
+        return d <= _num(dist)
+
+    register("point.withindistance", _within_distance)
+    register("withinbbox", REGISTRY["point.withinbbox"])
+
+    # geometry constructors + predicates (reference returns plain maps,
+    # functions_eval_math.go:1090-1230)
+    def _geom_points(pts, kind):
+        if not isinstance(pts, list) or len(pts) < (2 if kind ==
+                                                    "linestring" else 3):
+            raise CypherRuntimeError(
+                f"{kind}() expects a list of at least "
+                f"{2 if kind == 'linestring' else 3} points")
+        out = []
+        for p in pts:
+            q = T.make_point(p) if not isinstance(p, T.CypherPoint) else p
+            if q is None:
+                raise CypherRuntimeError(f"{kind}(): bad point {p!r}")
+            out.append(q)
+        return out
+
+    register("linestring", lambda pts: {
+        "type": "linestring", "points": _geom_points(pts, "linestring")})
+    register("polygon", lambda pts: {
+        "type": "polygon", "points": _geom_points(pts, "polygon")})
+
+    def _poly_pts(geom):
+        if isinstance(geom, dict) and isinstance(geom.get("points"), list):
+            return [p for p in geom["points"] if isinstance(p, T.CypherPoint)]
+        return None
+
+    def _point_in_polygon(poly, p):
+        """Ray casting on the x/y plane."""
+        pts = _poly_pts(poly)
+        q = p if isinstance(p, T.CypherPoint) else (
+            T.make_point(p) if isinstance(p, dict) else None)
+        if not pts or q is None:
+            return False
+        inside = False
+        j = len(pts) - 1
+        for i in range(len(pts)):
+            xi, yi = pts[i].x, pts[i].y
+            xj, yj = pts[j].x, pts[j].y
+            if (yi > q.y) != (yj > q.y) and (
+                q.x < (xj - xi) * (q.y - yi) / (yj - yi) + xi
+            ):
+                inside = not inside
+            j = i
+        return inside
+
+    register("point.contains", _point_in_polygon)
+    register("point.intersects",
+             lambda p, poly: _point_in_polygon(poly, p))
+
+    # vector similarity (reference pkg/math/vector/similarity.go)
+    def _fvec(v):
+        if not isinstance(v, list) or not v:
+            return None
+        try:
+            return [float(x) for x in v]
+        except (TypeError, ValueError):
+            return None
+
+    def _cos_sim(a, b):
+        va, vb = _fvec(a), _fvec(b)
+        if va is None or vb is None or len(va) != len(vb):
+            return None
+        dot = sum(x * y for x, y in zip(va, vb))
+        na = math.sqrt(sum(x * x for x in va))
+        nb = math.sqrt(sum(y * y for y in vb))
+        if na == 0 or nb == 0:
+            return 0.0
+        return dot / (na * nb)
+
+    def _euc_sim(a, b):
+        va, vb = _fvec(a), _fvec(b)
+        if va is None or vb is None or len(va) != len(vb):
+            return None
+        return 1.0 / (1.0 + math.sqrt(
+            sum((x - y) ** 2 for x, y in zip(va, vb))))
+
+    register("vector.similarity.cosine", _cos_sim)
+    register("vector.similarity.euclidean", _euc_sim)
+
+    from nornicdb_tpu.query import kalman_fns
+
+    kalman_fns.register_all(register)
+
+
 _install_core()
 _install_temporal_spatial()
 _install_extended()
+_install_reference_tail()

@@ -662,6 +662,33 @@ def _parse_atom(ts: TokenStream, stop_at_eq: bool = False) -> Expr:
             expr = parse_expression(ts)
             ts.expect(")")
             return Reduce(acc=acc, init=init, var=var, source=source, expr=expr)
+        if (
+            kw in ("EXTRACT", "FILTER")
+            and ts.peek(1).kind == PUNCT and ts.peek(1).value == "("
+            and ts.peek(2).kind == IDENT
+            and ts.peek(3).kind == IDENT and ts.peek(3).upper() == "IN"
+        ):
+            # legacy forms (reference functions_eval_math.go:1388):
+            # extract(x IN list | expr), filter(x IN list WHERE pred) —
+            # sugar for list comprehensions
+            ts.next()
+            ts.expect("(")
+            var = ts.next().value
+            ts.next()  # IN
+            source = parse_expression(ts)
+            where = None
+            proj = None
+            if kw == "FILTER":
+                if not ts.accept_kw("WHERE"):
+                    raise CypherSyntaxError("filter() requires WHERE")
+                where = parse_expression(ts)
+            else:
+                if not ts.accept("|", PUNCT):
+                    raise CypherSyntaxError("extract() expects `| expr`")
+                proj = parse_expression(ts)
+            ts.expect(")")
+            return ListComp(var=var, source=source, where=where,
+                            projection=proj)
         if kw == "COUNT" and ts.peek(1).kind == PUNCT and ts.peek(1).value == "{":
             # COUNT { (n)--() } subquery-count — parse pattern inside
             ts.next()

@@ -238,7 +238,7 @@ class _TemporalBase:
                 return d.isocalendar()[0]
             if n == "day":
                 return d.day
-            if n == "ordinalday":
+            if n in ("ordinalday", "dayofyear"):
                 return d.timetuple().tm_yday
             if n == "dayofweek":
                 return d.isoweekday()
@@ -687,6 +687,11 @@ def truncate(unit: str, value: Any, kind: str):
     elif isinstance(value, (CypherDateTime, CypherLocalDateTime)):
         src = value._dt
         tz = getattr(src, "tzinfo", None)
+    elif isinstance(value, (CypherTime, CypherLocalTime)):
+        t = value._dt
+        src = _dt.datetime(1970, 1, 1, t.hour, t.minute, t.second,
+                           t.microsecond)
+        tz = getattr(t, "tzinfo", None)
     else:
         raise CypherRuntimeError("truncate expects a temporal value")
     d = src
@@ -716,6 +721,13 @@ def truncate(unit: str, value: Any, kind: str):
     if kind == "datetime":
         return CypherDateTime(d if d.tzinfo else d.replace(
             tzinfo=_dt.timezone.utc))
+    if kind == "time":
+        return CypherTime(_dt.time(d.hour, d.minute, d.second,
+                                   d.microsecond,
+                                   tzinfo=tz or _dt.timezone.utc))
+    if kind == "localtime":
+        return CypherLocalTime(_dt.time(d.hour, d.minute, d.second,
+                                        d.microsecond))
     return CypherLocalDateTime(d.replace(tzinfo=None))
 
 

@@ -1,0 +1,181 @@
+"""Long-tail builtin functions (reference parity: functions_eval_math.go,
+functions_eval_functions.go, kalman_functions.go)."""
+
+import json
+import math
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture()
+def ex():
+    return CypherExecutor(NamespacedEngine(MemoryEngine(), "tail"))
+
+
+def q1(ex, s, p=None):
+    return ex.execute(s, p or {}).rows[0][0]
+
+
+CASES = [
+    ("RETURN sinh(0)", 0.0),
+    ("RETURN cosh(0)", 1.0),
+    ("RETURN tanh(0)", 0.0),
+    ("RETURN power(2, 10)", 1024),
+    ("RETURN toInt('42')", 42),
+    ("RETURN lower('AbC')", "abc"),
+    ("RETURN upper('AbC')", "ABC"),
+    ("RETURN lpad('7', 3, '0')", "007"),
+    ("RETURN rpad('ab', 4, '-')", "ab--"),
+    ("RETURN lpad('longer', 3, '0')", "longer"),
+    ("RETURN indexOf([1,2,3], 2)", 1),
+    ("RETURN indexOf([1,2,3], 9)", -1),
+    ("RETURN indexOf('hello', 'll')", 2),
+    ("RETURN nullif(5, 5)", None),
+    ("RETURN nullif(5, 6)", 5),
+    ("RETURN format('%s has %s items', 'cart', 3)", "cart has 3 items"),
+    ("RETURN format('%v/%v', 1, 2)", "1/2"),
+    ("RETURN slice([1,2,3,4], 1, 3)", [2, 3]),
+    ("RETURN slice([1,2,3,4], -2)", [3, 4]),
+    ("RETURN slice([1,2,3,4], 2, 99)", [3, 4]),
+    ("RETURN extract(x IN [1,2,3] | x * 2)", [2, 4, 6]),
+    ("RETURN filter(x IN [1,2,3,4] WHERE x > 2)", [3, 4]),
+    ("RETURN date.year(date('2026-07-30'))", 2026),
+    ("RETURN date.quarter(date('2026-07-30'))", 3),
+    ("RETURN date.dayOfWeek(date('2026-07-30'))", 4),  # Thursday
+    ("RETURN datetime.hour(datetime('2026-07-30T14:05:00Z'))", 14),
+    ("RETURN datetime.second(datetime('2026-07-30T14:05:33Z'))", 33),
+    ("RETURN point.x(point({x: 3, y: 4}))", 3.0),
+    ("RETURN point.y(point({x: 3, y: 4}))", 4.0),
+    ("RETURN point.srid(point({x: 3, y: 4}))", 7203),
+    ("RETURN point.latitude(point({latitude: 60, longitude: 10}))", 60.0),
+    ("RETURN point.withinDistance(point({x:0,y:0}), point({x:3,y:4}), 5.1)",
+     True),
+    ("RETURN point.withinDistance(point({x:0,y:0}), point({x:3,y:4}), 4.9)",
+     False),
+]
+
+
+@pytest.mark.parametrize("query,expected", CASES)
+def test_builtin(ex, query, expected):
+    got = q1(ex, query)
+    if isinstance(expected, float):
+        assert got == pytest.approx(expected)
+    else:
+        assert got == expected
+
+
+NULL_EDGE_CASES = [
+    # cross-CRS distance is null, not a crash
+    ("RETURN point.withinDistance(point({x:1,y:2}), "
+     "point({latitude:1,longitude:2}), 10)", None),
+    ("RETURN date.dayOfYear(date('2020-03-05'))", 65),
+    ("RETURN slice([1,2,3], null)", None),
+    ("RETURN lpad('x', 5, null)", "    x"),
+    ("RETURN nullif(0, false)", 0),
+    ("RETURN nullif(1, true)", 1),
+]
+
+
+@pytest.mark.parametrize("query,expected", NULL_EDGE_CASES)
+def test_null_edges(ex, query, expected):
+    assert q1(ex, query) == expected
+
+
+def test_power_edge_cases(ex):
+    assert math.isnan(q1(ex, "RETURN power(-2, 0.5)"))
+    assert q1(ex, "RETURN power(0, -1)") == float("inf")
+    assert q1(ex, "RETURN power(null, 2)") is None
+
+
+def test_time_truncate(ex):
+    assert q1(ex, "RETURN toString(time.truncate('hour', time('14:05:33Z')))"
+              ).startswith("14:00")
+    assert q1(ex, "RETURN toString(localtime.truncate('minute', "
+                  "localtime('14:05:33')))").startswith("14:05:00")
+
+
+def test_vector_similarity(ex):
+    assert q1(ex, "RETURN vector.similarity.cosine([1,0],[1,0])") == \
+        pytest.approx(1.0)
+    assert q1(ex, "RETURN vector.similarity.cosine([1,0],[0,1])") == \
+        pytest.approx(0.0)
+    assert q1(ex, "RETURN vector.similarity.euclidean([0,0],[3,4])") == \
+        pytest.approx(1.0 / 6.0)
+    # length mismatch -> null, not crash
+    assert q1(ex, "RETURN vector.similarity.cosine([1,0],[1])") is None
+
+
+def test_geometry(ex):
+    square = ("polygon([point({x:0,y:0}),point({x:10,y:0}),"
+              "point({x:10,y:10}),point({x:0,y:10})])")
+    assert q1(ex, f"RETURN point.contains({square}, point({{x:5,y:5}}))") \
+        is True
+    assert q1(ex, f"RETURN point.contains({square}, point({{x:15,y:5}}))") \
+        is False
+    assert q1(ex, f"RETURN point.intersects(point({{x:5,y:5}}), {square})") \
+        is True
+    ls = q1(ex, "RETURN linestring([point({x:0,y:0}), point({x:1,y:1})])")
+    assert ls["type"] == "linestring" and len(ls["points"]) == 2
+
+
+def test_kalman_basic_smooths(ex):
+    state = q1(ex, "RETURN kalman.init()")
+    # feed a constant signal with one outlier; filtered value must stay
+    # closer to the signal than the outlier
+    for m in [10.0, 10.0, 10.0, 10.0]:
+        r = ex.execute("RETURN kalman.process($m, $s) AS r",
+                       {"m": m, "s": state}).rows[0][0]
+        state = r["state"]
+    r = ex.execute("RETURN kalman.process(100.0, $s) AS r",
+                   {"s": state}).rows[0][0]
+    assert r["value"] < 60.0  # outlier damped
+    assert isinstance(q1(ex, "RETURN kalman.state($s)", {"s": r["state"]}),
+                      float)
+    # reset keeps configured noise but zeroes the estimate
+    fresh = q1(ex, "RETURN kalman.reset($s)", {"s": r["state"]})
+    assert json.loads(fresh)["x"] == 0.0
+
+
+def test_kalman_invalid_state_fails_open(ex):
+    r = ex.execute("RETURN kalman.process(5.0, 'not json') AS r").rows[0][0]
+    assert r["value"] == 5.0 and r["error"] == "invalid state"
+
+
+def test_kalman_velocity_tracks_trend(ex):
+    state = q1(ex, "RETURN kalman.velocity.init()")
+    # linear ramp: velocity estimate must become positive, prediction
+    # ahead of current position
+    for i in range(12):
+        r = ex.execute("RETURN kalman.velocity.process($m, $s) AS r",
+                       {"m": float(i), "s": state}).rows[0][0]
+        state = r["state"]
+    assert r["velocity"] > 0.5
+    pred = q1(ex, "RETURN kalman.velocity.predict($s, 5)", {"s": state})
+    assert pred > r["value"]
+
+
+def test_kalman_adaptive_switches_on_trend(ex):
+    state = q1(ex, "RETURN kalman.adaptive.init({hysteresis: 3})")
+    mode = "basic"
+    for i in range(20):
+        r = ex.execute("RETURN kalman.adaptive.process($m, $s) AS r",
+                       {"m": float(i * 2), "s": state}).rows[0][0]
+        state = r["state"]
+        mode = r["mode"]
+    assert mode == "velocity"  # strong ramp forces velocity mode
+
+
+def test_degree_functions(ex):
+    ex.execute("CREATE (:P {id:1})-[:R]->(:P {id:2})")
+    ex.execute("MATCH (a:P {id:1}), (b:P {id:2}) CREATE (b)-[:S]->(a)")
+    assert ex.execute("MATCH (p:P {id:1}) RETURN outDegree(p)").rows == [[1]]
+    assert ex.execute("MATCH (p:P {id:1}) RETURN inDegree(p)").rows == [[1]]
+    assert ex.execute("MATCH (p:P {id:1}) RETURN degree(p)").rows == [[2]]
+    assert q1(ex, "RETURN degree(null)") == 0
+    assert ex.execute(
+        "MATCH (p:P {id:1}) RETURN hasLabels(p, ['P'])").rows == [[True]]
+    assert ex.execute(
+        "MATCH (p:P {id:1}) RETURN hasLabels(p, ['P', 'Q'])").rows == [[False]]
