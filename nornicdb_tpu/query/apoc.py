@@ -214,8 +214,11 @@ def _meta_type(v):
 _install()
 
 # extended categories (periodic/trigger/path/export/create/merge/util —
-# apoc_ext.py) register into the same table on import
+# apoc_ext.py) register into the same table on import, as does the
+# value-level bulk tail (bitwise/number/math/stats/scoring/temporal/
+# text/util/json/diff/convert/xml/hashing/agg — apoc_bulk.py)
 from nornicdb_tpu.query import apoc_ext as _apoc_ext  # noqa: E402,F401
+from nornicdb_tpu.query import apoc_bulk as _apoc_bulk  # noqa: E402,F401
 
 # -- APOC procedures (CALL apoc.*) ---------------------------------------
 

@@ -26,6 +26,12 @@ _AGG_FUNCS = {
 }
 
 
+def _is_agg_name(name: str) -> bool:
+    """Builtin aggregates plus the apoc.agg.* family (reference
+    apoc/agg: first/last/nth/slice/median/statistics/...)."""
+    return name in _AGG_FUNCS or name.startswith("apoc.agg.")
+
+
 @dataclass
 class QueryStats:
     nodes_created: int = 0
@@ -778,7 +784,7 @@ class CypherExecutor:
 
     def _eval_func(self, e: A.FuncCall, row, ctx) -> Any:
         name = e.name
-        if name in _AGG_FUNCS:
+        if _is_agg_name(name):
             raise CypherRuntimeError(
                 f"aggregate function {name}() not allowed here"
             )
@@ -1475,7 +1481,7 @@ class CypherExecutor:
 
     def _eval_agg(self, e: A.Expr, rows: List[Dict], ctx) -> Any:
         """Evaluate an expression containing aggregate calls over a group."""
-        if isinstance(e, A.FuncCall) and e.name in _AGG_FUNCS:
+        if isinstance(e, A.FuncCall) and _is_agg_name(e.name):
             return self._run_agg(e, rows, ctx)
         if isinstance(e, A.Binary):
             l = self._eval_agg(e.left, rows, ctx)
@@ -1513,6 +1519,26 @@ class CypherExecutor:
 
     def _run_agg(self, e: A.FuncCall, rows: List[Dict], ctx) -> Any:
         name = e.name
+        if name.startswith("apoc.agg."):
+            from nornicdb_tpu.query.apoc_bulk import AGG_FINALIZERS
+
+            fin = AGG_FINALIZERS.get(name)
+            if fin is None:
+                raise CypherRuntimeError(f"unknown aggregate {name}()")
+            arg_rows = [
+                tuple(self._eval(a, row, ctx) for a in e.args)
+                for row in rows
+            ]
+            if e.distinct:
+                seen = set()
+                dd = []
+                for t in arg_rows:
+                    key = _hashable(list(t))
+                    if key not in seen:
+                        seen.add(key)
+                        dd.append(t)
+                arg_rows = dd
+            return fin(arg_rows)
         if name == "count" and e.star:
             return len(rows)
         values = []
@@ -1766,7 +1792,7 @@ def _refresh_edge(row: Dict, ctx, edge_id: str) -> Dict:
 
 def _contains_agg(e: A.Expr) -> bool:
     if isinstance(e, A.FuncCall):
-        if e.name in _AGG_FUNCS:
+        if _is_agg_name(e.name):
             return True
         return any(_contains_agg(a) for a in e.args)
     if isinstance(e, A.Binary):

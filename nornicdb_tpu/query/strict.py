@@ -47,6 +47,10 @@ _AGG = {"count", "sum", "avg", "min", "max", "collect", "stdev", "stdevp",
         "percentilecont", "percentiledisc"}
 
 
+def _is_agg(name: str) -> bool:
+    return name in _AGG or name.startswith("apoc.agg.")
+
+
 def validate(query: str) -> List[Diagnostic]:
     """Full-strictness validation; empty list = clean."""
     from nornicdb_tpu.query.parser import parse
@@ -98,11 +102,11 @@ def _validate_query(q: A.Query) -> List[Diagnostic]:
                     "error", f"variable `{e.name}` not defined ({where})"))
             return
         if isinstance(e, A.FuncCall):
-            if e.name in _AGG and not allow_agg:
+            if _is_agg(e.name) and not allow_agg:
                 diags.append(Diagnostic(
                     "error",
                     f"aggregate {e.name}() is not allowed in {where}"))
-            elif e.name not in _AGG and not _known_function(e.name):
+            elif not _is_agg(e.name) and not _known_function(e.name):
                 diags.append(Diagnostic(
                     "warning", f"unknown function {e.name}()"))
             for a in e.args:
@@ -206,8 +210,12 @@ def _known_function(name: str) -> bool:
 
     if lookup(name) is not None or lookup_apoc(name) is not None:
         return True
+    if name.startswith("apoc.agg."):
+        from nornicdb_tpu.query.apoc_bulk import AGG_FINALIZERS
+
+        return name in AGG_FINALIZERS
     return name in ("exists", "shortestpath", "allshortestpaths",
-                    "__pattern_count__")
+                    "degree", "indegree", "outdegree", "__pattern_count__")
 
 
 def assert_valid(query: str) -> None:

@@ -1,0 +1,228 @@
+"""APOC value-level long tail (apoc_bulk.py) — representative coverage
+per category (reference: apoc/apoc.go registerAllFunctions)."""
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture()
+def ex():
+    return CypherExecutor(NamespacedEngine(MemoryEngine(), "bulk"))
+
+
+def q1(ex, s, p=None):
+    return ex.execute(s, p or {}).rows[0][0]
+
+
+CASES = [
+    # bitwise — 64-bit two's complement semantics
+    ("RETURN apoc.bitwise.and(12, 10)", 8),
+    ("RETURN apoc.bitwise.or(12, 10)", 14),
+    ("RETURN apoc.bitwise.xor(12, 10)", 6),
+    ("RETURN apoc.bitwise.not(0)", -1),
+    ("RETURN apoc.bitwise.leftShift(1, 63)", -9223372036854775808),
+    ("RETURN apoc.bitwise.rightShift(-8, 1)", -4),
+    ("RETURN apoc.bitwise.rotateLeft(1, 1)", 2),
+    ("RETURN apoc.bitwise.rotateRight(1, 1)", -9223372036854775808),
+    ("RETURN apoc.bitwise.setBit(0, 3)", 8),
+    ("RETURN apoc.bitwise.clearBit(15, 0)", 14),
+    ("RETURN apoc.bitwise.toggleBit(8, 3)", 0),
+    ("RETURN apoc.bitwise.testBit(8, 3)", True),
+    ("RETURN apoc.bitwise.countBits(255)", 8),
+    ("RETURN apoc.bitwise.op(6, '&', 3)", 2),
+    # number
+    ("RETURN apoc.number.romanize(1987)", "MCMLXXXVII"),
+    ("RETURN apoc.number.arabize('XIV')", 14),
+    ("RETURN apoc.number.isPrime(97)", True),
+    ("RETURN apoc.number.isPrime(1)", False),
+    ("RETURN apoc.number.nextPrime(14)", 17),
+    ("RETURN apoc.number.fibonacci(10)", 55),
+    ("RETURN apoc.number.factorial(5)", 120),
+    ("RETURN apoc.number.gcd(12, 18)", 6),
+    ("RETURN apoc.number.lcm(4, 6)", 12),
+    ("RETURN apoc.number.isEven(4)", True),
+    ("RETURN apoc.number.toHex(255)", "ff"),
+    ("RETURN apoc.number.fromHex('ff')", 255),
+    ("RETURN apoc.number.toBase(255, 36)", "73"),
+    ("RETURN apoc.number.fromBase('73', 36)", 255),
+    ("RETURN apoc.number.clamp(15, 0, 10)", 10.0),
+    ("RETURN apoc.number.lerp(0, 10, 0.5)", 5.0),
+    ("RETURN apoc.number.parse('1,234')", 1234),
+    # math / stats
+    ("RETURN apoc.math.median([1,2,3,4])", 2.5),
+    ("RETURN apoc.math.mode([1,2,2,3])", 2),
+    ("RETURN apoc.math.product([2,3,4])", 24.0),
+    ("RETURN apoc.stats.count([1,2,3])", 3),
+    ("RETURN apoc.stats.range([1,9,4])", 8.0),
+    ("RETURN apoc.stats.iqr([1,2,3,4,5])", 2.0),
+    # scoring
+    ("RETURN apoc.scoring.jaccard([1,2,3],[2,3,4])", 0.5),
+    ("RETURN apoc.scoring.dice([1,2],[2,3])", 0.5),
+    ("RETURN apoc.scoring.sigmoid(0)", 0.5),
+    ("RETURN apoc.scoring.tf(2, 10)", 0.2),
+    ("RETURN apoc.scoring.rank([30, 10, 20])", [1, 3, 2]),
+    ("RETURN apoc.scoring.topK([5,1,9,3], 2)", [9.0, 5.0]),
+    # coll extras
+    ("RETURN apoc.coll.containsDuplicates([1,2,2])", True),
+    ("RETURN apoc.coll.containsSorted([1,3,5,7], 5)", True),
+    ("RETURN apoc.coll.disjunction([1,2,3],[2,3,4])", [1, 4]),
+    ("RETURN apoc.coll.isEmpty([])", True),
+    ("RETURN apoc.coll.insertAll([1,4], 1, [2,3])", [1, 2, 3, 4]),
+    ("RETURN apoc.coll.pairsMin([1,2,3])", [[1, 2], [2, 3]]),
+    ("RETURN apoc.coll.slice([1,2,3,4], 1, 2)", [2, 3]),
+    # text
+    ("RETURN apoc.text.base64Encode('hi')", "aGk="),
+    ("RETURN apoc.text.base64Decode('aGk=')", "hi"),
+    ("RETURN apoc.text.capitalizeAll('ab cd')", "Ab Cd"),
+    ("RETURN apoc.text.indexesOf('banana', 'a')", [1, 3, 5]),
+    ("RETURN apoc.text.urlencode('a b&c')", "a%20b%26c"),
+    ("RETURN apoc.text.urldecode('a%20b%26c')", "a b&c"),
+    ("RETURN apoc.text.phonetic('Robert')", "R163"),
+    ("RETURN apoc.text.fromCodePoint(72, 105)", "Hi"),
+    ("RETURN apoc.text.compareCleaned('Hello!', 'hello')", True),
+    # util
+    ("RETURN apoc.util.coalesce(null, null, 3)", 3),
+    ("RETURN apoc.util.when(true, 'a', 'b')", "a"),
+    ("RETURN apoc.util.case([false, 'x', true, 'y'], 'z')", "y"),
+    ("RETURN apoc.util.md5Hex('abc')", "900150983cd24fb0d6963f7d28e17f72"),
+    ("RETURN apoc.util.sha1Hex('abc')",
+     "a9993e364706816aba3e25717850c26c9cd0d89d"),
+    ("RETURN apoc.util.partition([1,2,3,4,5], 2)", [[1, 2], [3, 4], [5]]),
+    ("RETURN apoc.util.repeat('ab', 3)", "ababab"),
+    ("RETURN apoc.util.isNode(1)", False),
+    ("RETURN apoc.util.typeof('x')", "STRING"),
+    # json
+    ("RETURN apoc.json.get({a: {b: [1,2,3]}}, '$.a.b[1]')", 2),
+    ("RETURN apoc.json.flatten({a: {b: 1}})", {"a.b": 1}),
+    ("RETURN apoc.json.unflatten({`a.b`: 1})", {"a": {"b": 1}}),
+    ("RETURN apoc.json.size({a:1, b:2})", 2),
+    ("RETURN apoc.json.validate('{\"a\": 1}')", True),
+    ("RETURN apoc.json.validate('nope{')", False),
+    ("RETURN apoc.json.type([1,2])", "LIST"),
+    # temporal
+    ("RETURN apoc.temporal.dayOfWeek(datetime('2026-07-30T00:00:00Z'))", 4),
+    ("RETURN apoc.temporal.quarter(datetime('2026-07-30T00:00:00Z'))", 3),
+    ("RETURN apoc.temporal.isLeapYear(2024)", True),
+    ("RETURN apoc.temporal.isWeekend(datetime('2026-08-01T00:00:00Z'))",
+     True),
+    ("RETURN apoc.temporal.daysInMonth(datetime('2026-02-01T00:00:00Z'))",
+     28),
+    ("RETURN apoc.temporal.toEpochMillis(datetime('1970-01-01T00:00:01Z'))",
+     1000),
+    ("RETURN apoc.temporal.isBetween(datetime('2026-02-01T00:00:00Z'), "
+     "datetime('2026-01-01T00:00:00Z'), datetime('2026-03-01T00:00:00Z'))",
+     True),
+    ("RETURN apoc.temporal.formatDuration(90061000)", "1d 1h 1m 1s"),
+    # convert
+    ("RETURN apoc.convert.toIntList(['1','2'])", [1, 2]),
+    ("RETURN apoc.convert.toSet([1,2,2,3])", [1, 2, 3]),
+    # hashing
+    ("RETURN apoc.hashing.sha256('abc')",
+     "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"),
+    ("RETURN apoc.hashing.fnv1a('a')", 3826002220),
+    ("RETURN apoc.hashing.murmurhash3('hello')", 613153351),
+    # date
+    ("RETURN apoc.date.fromUnixtime(0)", "1970-01-01 00:00:00"),
+    ("RETURN apoc.date.toYears(0)", 0.0),
+]
+
+
+@pytest.mark.parametrize("query,expected", CASES)
+def test_case(ex, query, expected):
+    got = q1(ex, query)
+    if isinstance(expected, float):
+        assert got == pytest.approx(expected)
+    else:
+        assert got == expected
+
+
+def test_temporal_month_arithmetic(ex):
+    # Jan 31 + 1 month clamps to Feb 28 (non-leap)
+    assert q1(
+        ex, "RETURN toString(apoc.temporal.add("
+            "datetime('2026-01-31T00:00:00Z'), 1, 'month'))"
+    ).startswith("2026-02-28")
+    assert q1(
+        ex, "RETURN toString(apoc.temporal.subtract("
+            "datetime('2026-03-31T00:00:00Z'), 1, 'month'))"
+    ).startswith("2026-02-28")
+    assert q1(
+        ex, "RETURN toString(apoc.temporal.startOf("
+            "datetime('2026-07-30T14:22:00Z'), 'month'))"
+    ).startswith("2026-07-01T00:00")
+
+
+def test_compress_roundtrip(ex):
+    comp = q1(ex, "RETURN apoc.util.compress('hello world')")
+    assert q1(ex, "RETURN apoc.util.decompress($c)", {"c": comp}) == \
+        "hello world"
+    gz = q1(ex, "RETURN apoc.util.compressWithAlgorithm('abc', 'gzip')")
+    assert q1(ex, "RETURN apoc.util.decompressWithAlgorithm($c, 'gzip')",
+              {"c": gz}) == "abc"
+
+
+def test_util_validate(ex):
+    from nornicdb_tpu.errors import CypherRuntimeError
+
+    with pytest.raises(CypherRuntimeError, match="boom"):
+        ex.execute("RETURN apoc.util.validate(true, 'boom')")
+    assert q1(ex, "RETURN apoc.util.validate(false, 'boom')") is None
+    with pytest.raises(CypherRuntimeError):
+        ex.execute("RETURN apoc.util.validatePattern('abc', '[0-9]+')")
+
+
+def test_xml_roundtrip(ex):
+    m = q1(ex, "RETURN apoc.xml.parse('<a x=\"1\"><b>t</b></a>')")
+    assert m["_type"] == "a" and m["x"] == "1"
+    assert m["_children"][0]["_text"] == "t"
+    assert q1(ex, "RETURN apoc.xml.getText('<a>hi <b>there</b></a>')") == \
+        "hi there"
+    assert q1(ex, "RETURN apoc.xml.minify('<a> <b>t</b> </a>')") == \
+        "<a><b>t</b></a>"
+    assert q1(ex, "RETURN apoc.xml.validate('<a/>')") is True
+    assert q1(ex, "RETURN apoc.xml.validate('<a>')") is False
+    out = q1(ex, "RETURN apoc.xml.setAttribute('<a/>', 'k', 'v')")
+    assert 'k="v"' in out
+
+
+def test_diff(ex):
+    d = q1(ex, "RETURN apoc.diff.maps({a:1, b:2}, {b:3, c:4})")
+    assert d["leftOnly"] == {"a": 1}
+    assert d["rightOnly"] == {"c": 4}
+    assert d["different"] == {"b": {"left": 2, "right": 3}}
+    assert q1(ex, "RETURN apoc.diff.strings('kitten','sitting')")[
+        "distance"] == 3
+    deep = q1(ex, "RETURN apoc.diff.deep({a: {b: 1}}, {a: {b: 2}})")
+    assert deep == [{"path": "a.b", "kind": "changed", "left": 1,
+                     "right": 2}]
+
+
+def test_agg_family(ex):
+    ex.execute("UNWIND [3,1,2,2] AS x CREATE (:V {v: x})")
+    assert q1(ex, "MATCH (n:V) RETURN apoc.agg.median(n.v)") == 2.0
+    assert q1(ex, "MATCH (n:V) RETURN apoc.agg.mode(n.v)") == 2
+    assert q1(ex, "MATCH (n:V) RETURN apoc.agg.product(n.v)") == 12
+    st = q1(ex, "MATCH (n:V) RETURN apoc.agg.statistics(n.v)")
+    assert st["count"] == 4 and st["min"] == 1.0 and st["max"] == 3.0
+    mx = q1(ex, "MATCH (n:V) RETURN apoc.agg.maxItems(n.v, n.v)")
+    assert mx["value"] == 3
+    freq = q1(ex, "MATCH (n:V) RETURN apoc.agg.frequencies(n.v)")
+    assert {"value": 2, "count": 2} in freq
+    # grouped aggregation
+    ex.execute("UNWIND [['a',1],['a',2],['b',5]] AS p "
+               "CREATE (:G {g: p[0], v: p[1]})")
+    r = ex.execute("MATCH (n:G) RETURN n.g AS g, apoc.agg.first(n.v) "
+                   "ORDER BY g")
+    assert [row[1] for row in r.rows] == [1, 5]
+
+
+def test_agg_percentile_and_slice(ex):
+    ex.execute("UNWIND range(1, 10) AS x CREATE (:P {v: x})")
+    assert q1(ex, "MATCH (n:P) RETURN apoc.agg.percentile(n.v, 0.5)") == \
+        pytest.approx(5.5)
+    assert q1(ex, "MATCH (n:P) WITH n ORDER BY n.v "
+                  "RETURN apoc.agg.slice(n.v, 2, 3)") == [3, 4, 5]
+    assert q1(ex, "MATCH (n:P) WITH n ORDER BY n.v "
+                  "RETURN apoc.agg.nth(n.v, 4)") == 5
