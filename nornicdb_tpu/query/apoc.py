@@ -27,6 +27,21 @@ def lookup_apoc(name: str) -> Optional[Callable[..., Any]]:
     return APOC_FUNCS.get(name.lower())
 
 
+# storage-backed APOC functions: impls take (ctx, *args) where ctx is the
+# executor's query context (ctx.storage, ctx.ex). The reference gives its
+# whole apoc registry storage access via apoc.GetStorage (apoc/apoc.go:110);
+# here only the functions that need it are context-aware.
+APOC_CTX_FUNCS: Dict[str, Callable[..., Any]] = {}
+
+
+def register_ctx(name: str, fn: Callable[..., Any]) -> None:
+    APOC_CTX_FUNCS[name.lower()] = fn
+
+
+def lookup_apoc_ctx(name: str) -> Optional[Callable[..., Any]]:
+    return APOC_CTX_FUNCS.get(name.lower())
+
+
 def _flatten(lst, out):
     for x in lst:
         if isinstance(x, list):
@@ -219,6 +234,8 @@ _install()
 # text/util/json/diff/convert/xml/hashing/agg — apoc_bulk.py)
 from nornicdb_tpu.query import apoc_ext as _apoc_ext  # noqa: E402,F401
 from nornicdb_tpu.query import apoc_bulk as _apoc_bulk  # noqa: E402,F401
+from nornicdb_tpu.query import apoc_graph as _apoc_graph  # noqa: E402,F401
+from nornicdb_tpu.query import apoc_algo as _apoc_algo  # noqa: E402,F401
 
 # -- APOC procedures (CALL apoc.*) ---------------------------------------
 

@@ -924,16 +924,41 @@ def _install_json_diff() -> None:
         out = copy.deepcopy(obj)
         cur = out
         for part in parts[:-1]:
+            if isinstance(cur, list):
+                try:
+                    idx = int(part)
+                except ValueError:
+                    raise CypherRuntimeError(
+                        f"list index expected at {part!r}")
+                if not 0 <= idx < len(cur):
+                    raise CypherRuntimeError(f"index {idx} out of range")
+                cur = cur[idx]
+                continue
             nxt = cur.get(part) if isinstance(cur, dict) else None
             if not isinstance(nxt, (dict, list)):
                 nxt = {}
                 cur[part] = nxt
             cur = nxt
-        if delete:
+        last = parts[-1]
+        if isinstance(cur, list):
+            try:
+                idx = int(last)
+            except ValueError:
+                raise CypherRuntimeError(f"list index expected at {last!r}")
+            if delete:
+                if 0 <= idx < len(cur):
+                    cur.pop(idx)
+            elif 0 <= idx < len(cur):
+                cur[idx] = value
+            elif idx == len(cur):
+                cur.append(value)
+            else:
+                raise CypherRuntimeError(f"index {idx} out of range")
+        elif delete:
             if isinstance(cur, dict):
-                cur.pop(parts[-1], None)
+                cur.pop(last, None)
         else:
-            cur[parts[-1]] = value
+            cur[last] = value
         return out
 
     register(j + "get", _path_get)

@@ -814,8 +814,11 @@ class CypherExecutor:
         args = [self._eval(a, row, ctx) for a in e.args]
         fn = self._plugin_functions.get(name) or lookup_fn(name)
         if fn is None:
-            from nornicdb_tpu.query.apoc import lookup_apoc
+            from nornicdb_tpu.query.apoc import lookup_apoc, lookup_apoc_ctx
 
+            cfn = lookup_apoc_ctx(name)
+            if cfn is not None:
+                return cfn(ctx, *args)
             fn = lookup_apoc(name)
         if fn is None:
             raise CypherRuntimeError(f"unknown function {name}()")

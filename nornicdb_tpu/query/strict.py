@@ -205,10 +205,11 @@ def _validate_query(q: A.Query) -> List[Diagnostic]:
 
 
 def _known_function(name: str) -> bool:
-    from nornicdb_tpu.query.apoc import lookup_apoc
+    from nornicdb_tpu.query.apoc import lookup_apoc, lookup_apoc_ctx
     from nornicdb_tpu.query.functions import lookup
 
-    if lookup(name) is not None or lookup_apoc(name) is not None:
+    if (lookup(name) is not None or lookup_apoc(name) is not None
+            or lookup_apoc_ctx(name) is not None):
         return True
     if name.startswith("apoc.agg."):
         from nornicdb_tpu.query.apoc_bulk import AGG_FINALIZERS

@@ -1,0 +1,241 @@
+"""APOC graph/algorithm long tail (apoc_graph.py + apoc_algo.py).
+
+Graph fixture: two directed triangles 0->1->2->0 and 3->4->5->3 joined
+by a one-way bridge 2->3, plus an isolated node 6.
+"""
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture()
+def ex():
+    ex = CypherExecutor(NamespacedEngine(MemoryEngine(), "algo"))
+    for i in range(7):
+        ex.execute("CREATE (:N {id: $i, name: $n})",
+                   {"i": i, "n": f"node{i}"})
+    for a, b in [(0, 1), (1, 2), (2, 0), (3, 4), (4, 5), (5, 3), (2, 3)]:
+        ex.execute(
+            "MATCH (x:N {id:$a}), (y:N {id:$b}) "
+            "CREATE (x)-[:R {weight: 1}]->(y)", {"a": a, "b": b})
+    return ex
+
+
+def q1(ex, s, p=None):
+    return ex.execute(s, p or {}).rows[0][0]
+
+
+def _by_id(results, value_key):
+    return {d["node"].properties["id"]: d[value_key] for d in results}
+
+
+class TestCommunity:
+    def test_components(self, ex):
+        assert q1(ex, "RETURN apoc.community.numComponents()") == 2
+        cc = _by_id(q1(ex, "RETURN apoc.community.connectedComponents()"),
+                    "communityId")
+        assert cc[0] == cc[5] and cc[6] != cc[0]
+        wcc = _by_id(
+            q1(ex, "RETURN apoc.community.weaklyConnectedComponents()"),
+            "communityId")
+        assert wcc == cc
+
+    def test_scc_respects_direction(self, ex):
+        scc = _by_id(
+            q1(ex, "RETURN apoc.community.stronglyConnectedComponents()"),
+            "communityId")
+        assert scc[0] == scc[1] == scc[2]
+        assert scc[3] == scc[4] == scc[5]
+        assert scc[0] != scc[3]  # the 2->3 bridge is one-way
+
+    def test_triangles_and_clustering(self, ex):
+        assert q1(ex, "RETURN apoc.community.totalTriangles()") == 2
+        tri = _by_id(q1(ex, "RETURN apoc.community.triangleCount()"),
+                     "triangles")
+        assert tri[0] == 1 and tri[6] == 0
+        cl = _by_id(
+            q1(ex, "RETURN apoc.community.clusteringCoefficient()"),
+            "coefficient")
+        assert cl[0] == 1.0 and cl[6] == 0.0
+        assert 0 < q1(
+            ex, "RETURN apoc.community.averageClusteringCoefficient()") < 1
+
+    def test_louvain_and_labelprop(self, ex):
+        comm = _by_id(q1(ex, "RETURN apoc.community.louvain()"),
+                      "communityId")
+        assert comm[0] == comm[1] == comm[2]
+        assert comm[3] == comm[4] == comm[5]
+        assert len(q1(ex, "RETURN apoc.community.labelPropagation()")) == 7
+        # reference aliases (community.go:803,1056)
+        assert len(q1(ex, "RETURN apoc.community.infomap()")) == 7
+        assert len(q1(ex, "RETURN apoc.community.walktrap()")) == 7
+
+    def test_density_kcore_conductance(self, ex):
+        assert q1(ex, "RETURN apoc.community.density()") == \
+            pytest.approx(2 * 7 / (7 * 6))
+        core = _by_id(q1(ex, "RETURN apoc.community.coreNumber()"),
+                      "coreNumber")
+        assert core[0] == 2 and core[6] == 0
+        assert len(q1(ex, "RETURN apoc.community.kcore(2)")) == 6
+        cond = q1(ex, "MATCH (n:N) WHERE n.id < 3 WITH collect(n) AS c "
+                      "RETURN apoc.community.conductance(c)")
+        assert 0 < cond < 1
+
+    def test_modularity(self, ex):
+        assert q1(ex, "RETURN apoc.community.modularity()") > 0
+
+
+class TestPaths:
+    def test_distance_and_exists(self, ex):
+        # directed: 0 -> 1 -> 2 -> 3 -> 4 -> 5
+        assert q1(ex, "MATCH (a:N {id:0}), (b:N {id:5}) "
+                      "RETURN apoc.paths.distance(a, b)") == 5
+        assert q1(ex, "MATCH (a:N {id:0}), (b:N {id:6}) "
+                      "RETURN apoc.paths.distance(a, b)") is None
+        assert q1(ex, "MATCH (a:N {id:0}), (b:N {id:6}) "
+                      "RETURN apoc.paths.exists(a, b)") is False
+        assert q1(ex, "MATCH (a:N {id:3}), (b:N {id:0}) "
+                      "RETURN apoc.paths.exists(a, b)") is False  # one-way
+
+    def test_shortest_and_k(self, ex):
+        sp = q1(ex, "MATCH (a:N {id:0}), (b:N {id:3}) "
+                    "RETURN apoc.paths.shortest(a, b)")
+        assert len(sp) == 4  # 0,1,2,3
+        ks = q1(ex, "MATCH (a:N {id:0}), (b:N {id:3}) "
+                    "RETURN apoc.paths.kShortest(a, b, 2)")
+        assert len(ks) >= 1 and len(ks[0]) == 4
+
+    def test_cycles_and_eulerian(self, ex):
+        cy = q1(ex, "MATCH (a:N {id:0}) RETURN apoc.paths.cycles(a)")
+        assert any(len(c) == 4 for c in cy)  # the triangle
+        assert q1(ex, "RETURN apoc.paths.eulerian()") is False
+
+    def test_common_neighbors(self, ex):
+        common = q1(ex, "MATCH (a:N {id:0}), (b:N {id:1}) "
+                        "RETURN apoc.paths.common(a, b)")
+        assert len(common) == 1  # node 2 neighbors both
+
+
+class TestAlgo:
+    def test_dijkstra(self, ex):
+        dj = q1(ex, "MATCH (a:N {id:0}), (b:N {id:5}) "
+                    "RETURN apoc.algo.dijkstra(a, b)")
+        assert dj["weight"] == 5.0 and len(dj["path"]) == 6
+        assert q1(ex, "MATCH (a:N {id:3}), (b:N {id:0}) "
+                      "RETURN apoc.algo.dijkstra(a, b)") is None
+
+    def test_astar_falls_back_without_coords(self, ex):
+        res = q1(ex, "MATCH (a:N {id:0}), (b:N {id:3}) "
+                     "RETURN apoc.algo.astar(a, b)")
+        assert res["weight"] == 3.0
+
+    def test_centralities(self, ex):
+        bw = _by_id(q1(ex, "RETURN apoc.algo.betweennessCentrality()"),
+                    "centrality")
+        assert bw[2] > bw[0]  # bridge endpoint is most central
+        assert bw[6] == 0.0
+        dc = _by_id(q1(ex, "RETURN apoc.algo.degreeCentrality()"),
+                    "centrality")
+        assert dc[2] > dc[6] == 0.0
+        cl = _by_id(q1(ex, "RETURN apoc.algo.closenessCentrality()"),
+                    "centrality")
+        assert cl[0] > 0.0 and cl[6] == 0.0
+
+    def test_pagerank_sums_to_one(self, ex):
+        pr = q1(ex, "RETURN apoc.algo.pagerank()")
+        assert sum(d["score"] for d in pr) == pytest.approx(1.0, abs=1e-6)
+
+    def test_cover_and_allpairs(self, ex):
+        cov = q1(ex, "MATCH (n:N) WHERE n.id IN [0,1,2] "
+                     "WITH collect(n) AS c RETURN apoc.algo.cover(c)")
+        assert len(cov) == 3  # the triangle's edges
+        ap = q1(ex, "RETURN apoc.algo.allPairs()")
+        assert {"source", "target", "distance"} <= set(ap[0].keys())
+
+
+class TestGraphSurface:
+    def test_node_functions(self, ex):
+        assert q1(ex, "MATCH (a:N {id:2}) RETURN apoc.node.degree(a)") == 3
+        assert q1(ex, "MATCH (a:N {id:2}) "
+                      "RETURN apoc.node.degreeOut(a)") == 2
+        assert q1(ex, "MATCH (a:N {id:2}) "
+                      "RETURN apoc.node.relationshipTypes(a)") == ["R"]
+        assert q1(ex, "MATCH (a:N {id:2}), (b:N {id:3}) "
+                      "RETURN apoc.node.connected(a, b)") is True
+        ns = q1(ex, "MATCH (a:N {id:2}) RETURN apoc.node.neighbors(a)")
+        assert sorted(n.properties["id"] for n in ns) == [0, 1, 3]
+
+    def test_rel_functions(self, ex):
+        assert q1(ex, "MATCH (:N {id:0})-[r]->(:N {id:1}) "
+                      "RETURN apoc.rel.startNode(r).id") == 0
+        assert q1(ex, "MATCH (:N {id:0})-[r]->(:N {id:1}) "
+                      "RETURN apoc.rel.isLoop(r)") is False
+        assert q1(ex, "MATCH (a:N {id:0})-[r]->(b:N {id:1}) "
+                      "RETURN apoc.rel.otherNode(r, a).id") == 1
+
+    def test_label_functions(self, ex):
+        assert q1(ex, "RETURN apoc.label.count('N')") == 7
+        assert q1(ex, "RETURN apoc.label.list()") == ["N"]
+        assert q1(ex, "MATCH (a:N {id:0}) "
+                      "RETURN apoc.label.format(a)") == ":N"
+
+    def test_neighbors_hops(self, ex):
+        assert q1(ex, "MATCH (a:N {id:0}) "
+                      "RETURN apoc.neighbors.count(a, 'R>', 2)") == 2
+        at2 = q1(ex, "MATCH (a:N {id:0}) "
+                     "RETURN apoc.neighbors.atHop(a, 'R>', 2)")
+        assert [n.properties["id"] for n in at2] == [2]
+
+    def test_meta(self, ex):
+        st = q1(ex, "RETURN apoc.meta.stats()")
+        assert st["nodeCount"] == 7 and st["relCount"] == 7
+        assert q1(ex, "RETURN apoc.meta.nodeLabels()") == ["N"]
+        assert q1(ex, "RETURN apoc.meta.relTypes()") == ["R"]
+        props = q1(ex, "RETURN apoc.meta.nodeTypeProperties()")
+        assert {"nodeType": "N", "propertyName": "id"} in props
+
+    def test_search(self, ex):
+        assert len(q1(ex, "RETURN apoc.search.prefix('N', 'name', 'node')")
+                   ) == 7
+        assert q1(ex, "RETURN apoc.search.didYouMean('N', 'name', "
+                      "'node00', 1)") == ["node0"]
+        r = q1(ex, "RETURN apoc.search.range('N', 'id', 2, 4)")
+        assert sorted(n.properties["id"] for n in r) == [2, 3, 4]
+
+    def test_label_exists_keeps_node_form(self, ex):
+        """Regression: the ctx table must not shadow the original
+        apoc.label.exists(node, label)."""
+        assert q1(ex, "MATCH (a:N {id:0}) "
+                      "RETURN apoc.label.exists(a, 'N')") is True
+
+    def test_json_set_through_lists(self, ex):
+        assert q1(ex, "RETURN apoc.json.set({a: [{b: 1}]}, "
+                      "'$.a[0].b', 2)") == {"a": [{"b": 2}]}
+        assert q1(ex, "RETURN apoc.json.delete({a: [1, 2, 3]}, "
+                      "'$.a[1]')") == {"a": [1, 3]}
+
+    def test_neighbors_one_way_type_checked(self, ex):
+        from nornicdb_tpu.errors import CypherRuntimeError
+
+        with pytest.raises(CypherRuntimeError, match="expects a node"):
+            ex.execute("RETURN apoc.node.neighborsIn(42)")
+        out = q1(ex, "MATCH (a:N {id:2}) "
+                     "RETURN apoc.node.neighborsOut(a)")
+        assert sorted(n.properties["id"] for n in out) == [0, 3]
+
+    def test_spatial(self, ex):
+        d = q1(ex, "RETURN apoc.spatial.haversineDistance("
+                   "{latitude: 59.91, longitude: 10.75}, "
+                   "{latitude: 60.39, longitude: 5.32})")
+        assert 295_000 < d < 320_000  # Oslo-Bergen ~305 km
+        gh = q1(ex, "RETURN apoc.spatial.encodeGeohash("
+                    "{latitude: 57.64911, longitude: 10.40744}, 11)")
+        assert gh == "u4pruydqqvj"
+        dec = q1(ex, "RETURN apoc.spatial.decodeGeohash('u4pruydqqvj')")
+        assert dec["latitude"] == pytest.approx(57.64911, abs=1e-3)
+        v = q1(ex, "RETURN apoc.spatial.vincentyDistance("
+                   "{latitude: 0, longitude: 0}, "
+                   "{latitude: 0, longitude: 1})")
+        assert v == pytest.approx(111_319.49, rel=1e-3)
