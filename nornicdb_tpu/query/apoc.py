@@ -236,6 +236,7 @@ from nornicdb_tpu.query import apoc_ext as _apoc_ext  # noqa: E402,F401
 from nornicdb_tpu.query import apoc_bulk as _apoc_bulk  # noqa: E402,F401
 from nornicdb_tpu.query import apoc_graph as _apoc_graph  # noqa: E402,F401
 from nornicdb_tpu.query import apoc_algo as _apoc_algo  # noqa: E402,F401
+from nornicdb_tpu.query import apoc_admin as _apoc_admin  # noqa: E402,F401
 
 # -- APOC procedures (CALL apoc.*) ---------------------------------------
 
@@ -280,6 +281,18 @@ def run_apoc_procedure(executor, name: str, args: List[Any], ctx) -> Iterator[Di
             "labels": labels,
             "relTypes": rel_types,
         }
+        return
+    cfn = lookup_apoc_ctx(name)
+    if cfn is not None:
+        out = cfn(ctx, *args)
+        # procedure form: map results yield their fields as columns
+        if isinstance(out, dict):
+            yield out
+        elif isinstance(out, list) and out and all(
+                isinstance(x, dict) for x in out):
+            yield from out
+        else:
+            yield {"value": out}
         return
     fn = lookup_apoc(name)
     if fn is not None:

@@ -1630,8 +1630,21 @@ _WRITE_CLAUSES = (
 # argument; their .transaction/.statement/.realtime variants always are.
 _VOLATILE_ALWAYS = frozenset({
     "rand", "randomuuid", "timestamp", "apoc.create.uuid",
-    "apoc.create.uuidbase64",
+    "apoc.create.uuidbase64", "apoc.create.uuids", "apoc.util.uuid",
+    "apoc.util.randomuuid", "apoc.util.now", "apoc.util.nowinseconds",
+    "apoc.util.timestamp", "apoc.util.sleep", "apoc.number.random",
+    "apoc.number.randomint", "apoc.math.random", "apoc.math.randomint",
+    "apoc.coll.shuffle", "apoc.coll.randomitems",
 })
+# whole families whose state lives outside storage (schema registry,
+# lock table, log ring, trigger/job registries): results must never be
+# served from the query-result cache because storage writes are not
+# what invalidates them
+_VOLATILE_PREFIXES = (
+    "apoc.schema.", "apoc.lock.", "apoc.log.", "apoc.trigger.",
+    "apoc.periodic.", "apoc.warmup.", "apoc.atomic.", "apoc.merge.",
+    "apoc.refactor.", "apoc.create.",
+)
 _CLOCK_FUNCS = frozenset({
     "date", "datetime", "localdatetime", "time", "localtime",
 })
@@ -1652,6 +1665,8 @@ def _has_volatile_call(obj: Any) -> bool:
     if isinstance(obj, A.FuncCall):
         name = obj.name
         if name in _VOLATILE_ALWAYS:
+            return True
+        if name.startswith(_VOLATILE_PREFIXES):
             return True
         if name in _CLOCK_FUNCS and not obj.args and not obj.star:
             return True

@@ -1,0 +1,288 @@
+"""APOC admin/write long tail (apoc_admin.py): atomic, create/merge
+extras, refactor, schema, lock, log, warmup."""
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture()
+def ex():
+    return CypherExecutor(NamespacedEngine(MemoryEngine(), "admin"))
+
+
+def q1(ex, s, p=None):
+    return ex.execute(s, p or {}).rows[0][0]
+
+
+class TestAtomic:
+    def test_add_persists_and_invalidates(self, ex):
+        ex.execute("CREATE (:C {id: 1, n: 10})")
+        assert q1(ex, "MATCH (c:C {id:1}) "
+                      "RETURN apoc.atomic.add(c, 'n', 5)") == 15
+        # the write must be visible to subsequent (cached) reads
+        assert q1(ex, "MATCH (c:C {id:1}) RETURN c.n") == 15
+        assert q1(ex, "MATCH (c:C {id:1}) "
+                      "RETURN apoc.atomic.subtract(c, 'n', 3)") == 12
+        assert q1(ex, "MATCH (c:C {id:1}) "
+                      "RETURN apoc.atomic.increment(c, 'n')") == 13
+
+    def test_cas(self, ex):
+        ex.execute("CREATE (:C {id: 2, v: 'a'})")
+        assert q1(ex, "MATCH (c:C {id:2}) RETURN "
+                      "apoc.atomic.compareAndSwap(c, 'v', 'a', 'b')") is True
+        assert q1(ex, "MATCH (c:C {id:2}) RETURN "
+                      "apoc.atomic.compareAndSwap(c, 'v', 'a', 'z')") is False
+        assert q1(ex, "MATCH (c:C {id:2}) RETURN c.v") == "b"
+
+    def test_list_ops(self, ex):
+        ex.execute("CREATE (:C {id: 3, l: [1, 3]})")
+        assert q1(ex, "MATCH (c:C {id:3}) "
+                      "RETURN apoc.atomic.insert(c, 'l', 1, 2)") == [1, 2, 3]
+        assert q1(ex, "MATCH (c:C {id:3}) "
+                      "RETURN apoc.atomic.remove(c, 'l', 0)") == [2, 3]
+
+    def test_non_numeric_errors(self, ex):
+        from nornicdb_tpu.errors import CypherRuntimeError
+
+        ex.execute("CREATE (:C {id: 4, s: 'text'})")
+        with pytest.raises(CypherRuntimeError, match="not numeric"):
+            ex.execute("MATCH (c:C {id:4}) "
+                       "RETURN apoc.atomic.add(c, 's', 1)")
+
+
+class TestCreateMerge:
+    def test_labels_roundtrip(self, ex):
+        ex.execute("CREATE (:C {id: 1})")
+        ex.execute("MATCH (c:C {id:1}) "
+                   "RETURN apoc.create.addLabels(c, ['X', 'Y'])")
+        assert q1(ex, "MATCH (c:C {id:1}) RETURN labels(c)") == \
+            ["C", "X", "Y"]
+        ex.execute("MATCH (c:C {id:1}) "
+                   "RETURN apoc.create.removeLabels(c, ['X'])")
+        assert q1(ex, "MATCH (c:C {id:1}) RETURN labels(c)") == ["C", "Y"]
+
+    def test_virtual_entities_not_persisted(self, ex):
+        v = q1(ex, "RETURN apoc.create.vNode(['V'], {x: 1})")
+        assert v.id.startswith("vnode-")
+        assert q1(ex, "MATCH (n:V) RETURN count(n)") == 0
+        assert len(q1(ex, "RETURN apoc.create.uuids(3)")) == 3
+
+    def test_merge_node_idempotent(self, ex):
+        a = q1(ex, "RETURN apoc.merge.mergeNode(['M'], {k: 'x'}, "
+                   "{created: true})")
+        b = q1(ex, "RETURN apoc.merge.mergeNode(['M'], {k: 'x'})")
+        assert a.id == b.id
+        assert q1(ex, "MATCH (m:M) RETURN count(m)") == 1
+        assert a.properties["created"] is True
+
+    def test_merge_relationship(self, ex):
+        ex.execute("CREATE (:A {id:1}), (:B {id:2})")
+        r1 = q1(ex, "MATCH (a:A), (b:B) "
+                    "RETURN apoc.merge.mergeRelationship(a, 'R', "
+                    "{k: 1}, b)")
+        r2 = q1(ex, "MATCH (a:A), (b:B) "
+                    "RETURN apoc.merge.mergeRelationship(a, 'R', "
+                    "{k: 1}, b)")
+        assert r1.id == r2.id
+        assert q1(ex, "MATCH ()-[r:R]->() RETURN count(r)") == 1
+
+    def test_merge_preview_pure(self, ex):
+        p = q1(ex, "RETURN apoc.merge.preview({a: 1, b: 2}, "
+                   "{b: 3, c: 4})")
+        assert p["added"] == {"c": 4}
+        assert p["overwritten"] == {"b": {"old": 2, "new": 3}}
+
+
+class TestRefactor:
+    def test_rename_label_and_type(self, ex):
+        ex.execute("CREATE (:Old {id:1})-[:T1]->(:Old {id:2})")
+        assert q1(ex, "RETURN apoc.refactor.renameLabel('Old', 'New')") == 2
+        assert q1(ex, "MATCH (n:New) RETURN count(n)") == 2
+        assert q1(ex, "RETURN apoc.refactor.renameType('T1', 'T2')") == 1
+        assert q1(ex, "MATCH ()-[r:T2]->() RETURN count(r)") == 1
+
+    def test_merge_nodes_rehomes_edges(self, ex):
+        ex.execute("CREATE (:D {id:'d1', a: 1}), (:D {id:'d2', b: 2})")
+        ex.execute("CREATE (:E {id:'e'})")
+        ex.execute("MATCH (d:D {id:'d2'}), (e:E) CREATE (d)-[:L]->(e)")
+        merged = q1(ex, "MATCH (d:D) WITH collect(d) AS ds "
+                        "RETURN apoc.refactor.mergeNodes(ds)")
+        assert merged.properties["a"] == 1
+        assert merged.properties["b"] == 2
+        assert q1(ex, "MATCH (d:D) RETURN count(d)") == 1
+        assert q1(ex, "MATCH (:D)-[:L]->(:E) RETURN count(*)") == 1
+
+    def test_invert_and_redirect(self, ex):
+        ex.execute("CREATE (:A {id:1})-[:R]->(:B {id:2})")
+        ex.execute("MATCH ()-[r:R]->() "
+                   "RETURN apoc.refactor.invertRelationship(r)")
+        assert q1(ex, "MATCH (:B)-[:R]->(:A) RETURN count(*)") == 1
+        ex.execute("CREATE (:Cc {id:3})")
+        ex.execute("MATCH ()-[r:R]->(), (c:Cc) "
+                   "RETURN apoc.refactor.redirectRelationship(r, c)")
+        assert q1(ex, "MATCH (:B)-[:R]->(:Cc) RETURN count(*)") == 1
+
+    def test_extract_and_collapse(self, ex):
+        ex.execute("CREATE (:A {id:1})-[:OWNS {since: 2020}]->(:B {id:2})")
+        mid = q1(ex, "MATCH ()-[r:OWNS]->() "
+                     "RETURN apoc.refactor.extractNode(r, ['Ownership'])")
+        assert mid.properties["since"] == 2020
+        assert q1(ex, "MATCH (:A)-[:OWNS_FROM]->(:Ownership)"
+                      "-[:OWNS_TO]->(:B) RETURN count(*)") == 1
+        back = q1(ex, "MATCH (o:Ownership) "
+                      "RETURN apoc.refactor.collapseNode(o, 'OWNS')")
+        assert back.type == "OWNS"
+        assert q1(ex, "MATCH (:A)-[:OWNS]->(:B) RETURN count(*)") == 1
+
+    def test_categorize_property(self, ex):
+        for color in ("red", "blue", "red"):
+            ex.execute("CREATE (:Item {color: $c})", {"c": color})
+        n = q1(ex, "RETURN apoc.refactor.categorizeProperty("
+                   "'color', 'HAS_COLOR', 'Color')")
+        assert n == 3
+        assert q1(ex, "MATCH (c:Color) RETURN count(c)") == 2
+        assert q1(ex, "MATCH (:Item)-[:HAS_COLOR]->(:Color {name: 'red'}) "
+                      "RETURN count(*)") == 2
+
+
+class TestSchema:
+    def test_constraint_lifecycle(self, ex):
+        made = q1(ex, "RETURN apoc.schema.createUniqueConstraint("
+                      "'P', 'email')")
+        assert made[0]["kind"] == "unique"
+        assert q1(ex, "RETURN apoc.schema.nodeConstraintExists("
+                      "'P', 'email')") is True
+        info = q1(ex, "RETURN apoc.schema.info()")
+        assert len(info["constraints"]) == 1
+        assert q1(ex, "RETURN apoc.schema.dropConstraint("
+                      "'unique_P_email')") is True
+        assert q1(ex, "RETURN apoc.schema.info()")["constraints"] == []
+
+    def test_validate_finds_duplicates(self, ex):
+        q1(ex, "RETURN apoc.schema.createUniqueConstraint('U', 'k')")
+        ex.execute("CREATE (:U {k: 1}), (:U {k: 1}), (:U {k: 2})")
+        v = q1(ex, "RETURN apoc.schema.validate()")
+        assert len(v) == 1 and "duplicate" in v[0]
+
+    def test_assert_declarative(self, ex):
+        out = q1(ex, "RETURN apoc.schema.assert({}, {Q: ['a', 'b']})")
+        assert sorted(out["created"]) == ["unique_Q_a", "unique_Q_b"]
+        out2 = q1(ex, "RETURN apoc.schema.assert({}, {Q: ['a']})")
+        assert out2["dropped"] == ["unique_Q_b"]
+
+
+class TestLockLogWarmup:
+    def test_lock_cycle(self, ex):
+        ex.execute("CREATE (:L {id: 1})")
+        assert q1(ex, "MATCH (l:L) RETURN apoc.lock.tryLock([l])") is True
+        assert q1(ex, "MATCH (l:L) RETURN apoc.lock.isLocked(l)") is True
+        assert q1(ex, "MATCH (l:L) RETURN apoc.lock.unlockNodes([l])") == 1
+        assert q1(ex, "MATCH (l:L) RETURN apoc.lock.isLocked(l)") is False
+        assert q1(ex, "RETURN apoc.lock.stats()")["locks"] >= 1
+
+    def test_log_ring(self, ex):
+        q1(ex, "RETURN apoc.log.clear()")
+        q1(ex, "RETURN apoc.log.info('hello %s', 'world')")
+        q1(ex, "RETURN apoc.log.warn('watch out')")
+        tail = q1(ex, "RETURN apoc.log.tail(2)")
+        assert tail[0]["message"] == "hello world"
+        assert tail[1]["level"] == "warn"
+        assert len(q1(ex, "RETURN apoc.log.search('watch')")) == 1
+        stats = q1(ex, "RETURN apoc.log.stats()")
+        assert stats["byLevel"]["warn"] == 1
+
+    def test_log_level_filters(self, ex):
+        q1(ex, "RETURN apoc.log.clear()")
+        q1(ex, "RETURN apoc.log.setLevel('warn')")
+        try:
+            q1(ex, "RETURN apoc.log.debug('quiet')")
+            assert q1(ex, "RETURN apoc.log.tail(5)") == []
+            q1(ex, "RETURN apoc.log.error('loud')")
+            assert len(q1(ex, "RETURN apoc.log.tail(5)")) == 1
+        finally:
+            q1(ex, "RETURN apoc.log.setLevel('info')")
+
+    def test_lock_acquire_rolls_back_on_timeout(self, ex):
+        """Regression: a failed multi-key acquire must not leak the keys
+        it already locked."""
+        import threading
+
+        from nornicdb_tpu.query.apoc_admin import LOCKS
+
+        hold = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            LOCKS.acquire(["zz-held"], timeout=1.0)
+            hold.set()
+            release.wait(5.0)
+            LOCKS.release(["zz-held"])
+
+        t = threading.Thread(target=holder)
+        t.start()
+        hold.wait(5.0)
+        try:
+            # 'aa-free' sorts before 'zz-held': acquired then rolled back
+            assert LOCKS.acquire(["aa-free", "zz-held"],
+                                 timeout=0.1) is False
+            assert LOCKS.is_locked("aa-free") is False
+        finally:
+            release.set()
+            t.join(5.0)
+
+    def test_atomic_rmw_uses_fresh_read(self, ex):
+        """Regression: atomic ops must re-read inside the lock, not
+        trust the query-bound entity copy."""
+        ex.execute("CREATE (:F {id: 1, n: 0})")
+        # bind the node once, then mutate it behind the binding's back
+        from nornicdb_tpu.query.apoc import APOC_CTX_FUNCS
+
+        node = q1(ex, "MATCH (f:F {id:1}) RETURN f")
+        ex.execute("MATCH (f:F {id:1}) SET f.n = 100")
+
+        class _Ctx:
+            storage = ex.storage
+            stats = type("S", (), {"properties_set": 0})()
+            non_create_writes = False
+
+        out = APOC_CTX_FUNCS["apoc.atomic.add"](_Ctx(), node, "n", 1)
+        assert out == 101  # 100 + 1, not the stale 0 + 1
+
+    def test_schema_import_idempotent(self, ex):
+        q1(ex, "RETURN apoc.schema.createUniqueConstraint('I', 'k')")
+        # re-creating and round-trip restore must be no-ops, not raise
+        q1(ex, "RETURN apoc.schema.createUniqueConstraint('I', 'k')")
+        exported = q1(ex, "RETURN apoc.schema.export()")
+        assert q1(ex, "RETURN apoc.schema.import($d)",
+                  {"d": exported}) == 0
+
+    def test_ctx_functions_callable_as_procedures(self, ex):
+        ex.execute("CREATE (:W2 {id: 1})")
+        rows = ex.execute("CALL apoc.warmup.run() YIELD status "
+                          "RETURN status").rows
+        assert rows == [["ok"]]
+
+    def test_fresh_node_stats_keep_delta_invariant(self, ex):
+        """Created nodes must report labels/properties stats so the
+        executor's pure-creates delta fast path stays valid."""
+        r = ex.execute("RETURN apoc.merge.mergeNode(['S'], {k: 1})")
+        assert r.stats.nodes_created == 1
+        assert r.stats.labels_added == 1
+        assert r.stats.properties_set >= 1
+
+    def test_relationship_eager_reference_signature(self, ex):
+        ex.execute("CREATE (:RA {id:1}), (:RB {id:2})")
+        r = q1(ex, "MATCH (a:RA), (b:RB) RETURN "
+                   "apoc.merge.relationshipEager(a, 'R', {k: 1}, "
+                   "{since: 2020}, b)")
+        assert r.type == "R" and r.properties["since"] == 2020
+
+    def test_warmup(self, ex):
+        ex.execute("CREATE (:W {id: 1})-[:R]->(:W {id: 2})")
+        out = q1(ex, "RETURN apoc.warmup.run()")
+        assert out["status"] == "ok"
+        assert out["nodesLoaded"] == 2
+        assert out["relationshipsLoaded"] == 1
+        assert q1(ex, "RETURN apoc.warmup.stats()")["nodeCount"] == 2
