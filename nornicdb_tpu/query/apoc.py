@@ -237,6 +237,7 @@ from nornicdb_tpu.query import apoc_bulk as _apoc_bulk  # noqa: E402,F401
 from nornicdb_tpu.query import apoc_graph as _apoc_graph  # noqa: E402,F401
 from nornicdb_tpu.query import apoc_algo as _apoc_algo  # noqa: E402,F401
 from nornicdb_tpu.query import apoc_admin as _apoc_admin  # noqa: E402,F401
+from nornicdb_tpu.query import apoc_io as _apoc_io  # noqa: E402,F401
 
 # -- APOC procedures (CALL apoc.*) ---------------------------------------
 
@@ -288,9 +289,9 @@ def run_apoc_procedure(executor, name: str, args: List[Any], ctx) -> Iterator[Di
         # procedure form: map results yield their fields as columns
         if isinstance(out, dict):
             yield out
-        elif isinstance(out, list) and out and all(
+        elif isinstance(out, list) and all(
                 isinstance(x, dict) for x in out):
-            yield from out
+            yield from out  # empty list = zero rows, stable columns
         else:
             yield {"value": out}
         return

@@ -918,17 +918,15 @@ class _LockManager:
         return True
 
     def try_acquire(self, keys: List[str]) -> bool:
-        got = []
+        got: List[str] = []
         for key in sorted(keys):
             if self._get(key).acquire(blocking=False):
-                got.append(key)
+                with self._guard:  # count immediately: release() on
+                    self._held[key] = self._held.get(key, 0) + 1
+                got.append(key)  # rollback decrements symmetrically
             else:
-                for k in got:
-                    self.release([k])
+                self.release(got)
                 return False
-        with self._guard:
-            for key in got:
-                self._held[key] = self._held.get(key, 0) + 1
         return True
 
     def release(self, keys: List[str]) -> int:

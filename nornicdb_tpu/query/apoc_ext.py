@@ -554,6 +554,76 @@ def run_ext_procedure(executor, name: str, args: List[Any],
             int(max_l) if max_l is not None else 5,
         )
         return iter([{"path": p} for p in paths])
+    if name == "apoc.path.expandconfig":
+        # config-map form (reference apoc/path expandConfig)
+        start, cfg = (list(args) + [{}])[:2]
+        cfg = cfg or {}
+        paths = _expand_paths(
+            storage, _as_node(storage, start),
+            _parse_rel_filter(cfg.get("relationshipFilter")),
+            _parse_label_filter(cfg.get("labelFilter")),
+            int(cfg.get("minLevel", 1)),
+            int(cfg.get("maxLevel", 5)),
+            bfs=bool(cfg.get("bfs", True)),
+            uniqueness=str(cfg.get("uniqueness", "RELATIONSHIP_PATH")),
+        )
+        limit = cfg.get("limit")
+        if limit is not None:
+            paths = paths[: int(limit)]
+        return iter([{"path": p} for p in paths])
+    if name in ("apoc.path.shortestpath", "apoc.path.allshortestpaths"):
+        from nornicdb_tpu.query.functions import PathValue
+
+        a, b = (list(args) + [None, None])[:2]
+        a = _as_node(storage, a)
+        b = _as_node(storage, b)
+        # BFS with parent tracking; allshortestpaths collects every
+        # parent at the shortest depth
+        # undirected BFS (shortestPath semantics ignore direction)
+        prev: Dict[str, List[tuple]] = {a.id: []}
+        frontier = [a.id]
+        depth_of = {a.id: 0}
+        found_depth = None
+        while frontier and found_depth is None:
+            nxt = []
+            for nid in frontier:
+                for e in storage.get_node_edges(nid, direction="both"):
+                    other = (e.end_node if e.start_node == nid
+                             else e.start_node)
+                    if other not in depth_of:
+                        depth_of[other] = depth_of[nid] + 1
+                        prev[other] = [(nid, e)]
+                        nxt.append(other)
+                    elif depth_of[other] == depth_of[nid] + 1:
+                        prev[other].append((nid, e))
+                    if other == b.id:
+                        found_depth = depth_of[other]
+            frontier = nxt
+        if b.id not in prev and a.id != b.id:
+            return iter([])
+
+        def build(nid) -> List[List[tuple]]:
+            if nid == a.id:
+                return [[]]
+            out = []
+            for pnode, e in prev[nid]:
+                for tail in build(pnode):
+                    out.append(tail + [(pnode, e)])
+            return out
+
+        combos = build(b.id)
+        if name == "apoc.path.shortestpath":
+            combos = combos[:1]
+        results = []
+        for combo in combos:
+            nodes = [a]
+            rels = []
+            for pnode, e in combo:
+                rels.append(e)
+                other = e.end_node if e.start_node == pnode else e.start_node
+                nodes.append(storage.get_node(other))
+            results.append({"path": PathValue(nodes, rels)})
+        return iter(results)
     if name in ("apoc.path.subgraphnodes", "apoc.path.subgraphall",
                 "apoc.path.spanningtree"):
         start, cfg = (list(args) + [{}])[:2]
@@ -910,7 +980,8 @@ def _trigger_proc(executor, name: str, args) -> Iterator[Dict]:
     if name == "apoc.trigger.resume":
         reg.set_paused(args[0], False)
         return iter([{"name": args[0], "paused": False}])
-    raise CypherRuntimeError(f"unknown trigger procedure {name}")
+    return None  # unknown trigger name: fall through to the ctx table
+    # (apoc_io registers show/install/before/onCreate/... there)
 
 
 _install_functions()

@@ -1643,7 +1643,8 @@ _VOLATILE_ALWAYS = frozenset({
 _VOLATILE_PREFIXES = (
     "apoc.schema.", "apoc.lock.", "apoc.log.", "apoc.trigger.",
     "apoc.periodic.", "apoc.warmup.", "apoc.atomic.", "apoc.merge.",
-    "apoc.refactor.", "apoc.create.",
+    "apoc.refactor.", "apoc.create.", "apoc.cypher.", "apoc.import.",
+    "apoc.export.", "apoc.load.", "apoc.meta.",
 )
 _CLOCK_FUNCS = frozenset({
     "date", "datetime", "localdatetime", "time", "localtime",

@@ -1,0 +1,303 @@
+"""APOC IO/orchestration tail (apoc_io.py): cypher subqueries,
+export/import round trips, loaders, virtual graphs, triggers, periodic
+registry, and category leftovers."""
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+@pytest.fixture()
+def ex():
+    ex = CypherExecutor(NamespacedEngine(MemoryEngine(), "io"))
+    ex.execute("CREATE (:P {id: 1, name: 'a'})-[:R {w: 1}]->"
+               "(:P {id: 2, name: 'b'})")
+    return ex
+
+
+def q1(ex, s, p=None):
+    return ex.execute(s, p or {}).rows[0][0]
+
+
+class TestCypherSubqueries:
+    def test_run_and_first_column(self, ex):
+        rows = q1(ex, "RETURN apoc.cypher.run("
+                      "'MATCH (p:P) RETURN p.id AS id ORDER BY id')")
+        assert [r["id"] for r in rows] == [1, 2]
+        assert q1(ex, "RETURN apoc.cypher.runFirstColumnSingle("
+                      "'MATCH (p:P) RETURN count(p)')") == 2
+        assert q1(ex, "RETURN apoc.cypher.runFirstColumnMany("
+                      "'MATCH (p:P) RETURN p.id ORDER BY p.id')") == [1, 2]
+
+    def test_run_many(self, ex):
+        out = q1(ex, "RETURN apoc.cypher.runMany("
+                     "'RETURN 1 AS a; RETURN 2 AS b')")
+        assert len(out) == 2 and out[1]["rows"] == [[2]]
+
+    def test_validate_and_parse(self, ex):
+        assert q1(ex, "RETURN apoc.cypher.validate('MATCH (n RETURN n')")
+        p = q1(ex, "RETURN apoc.cypher.parse('MATCH (n) RETURN n')")
+        assert p["clauses"] == ["MatchClause", "ReturnClause"]
+
+    def test_subquery_sees_writes_not_cached(self, ex):
+        n0 = q1(ex, "RETURN apoc.cypher.runFirstColumnSingle("
+                    "'MATCH (p:P) RETURN count(p)')")
+        ex.execute("CREATE (:P {id: 3})")
+        n1 = q1(ex, "RETURN apoc.cypher.runFirstColumnSingle("
+                    "'MATCH (p:P) RETURN count(p)')")
+        assert (n0, n1) == (2, 3)
+
+
+class TestExportImport:
+    def test_json_round_trip(self, ex):
+        js = q1(ex, "RETURN apoc.export.jsonAll()")
+        ex2 = CypherExecutor(NamespacedEngine(MemoryEngine(), "io2"))
+        out = ex2.execute("RETURN apoc.import.json($j)",
+                          {"j": js}).rows[0][0]
+        assert out == {"nodes": 2, "relationships": 1}
+        assert ex2.execute("MATCH (:P {id:1})-[r:R]->(:P {id:2}) "
+                           "RETURN r.w").rows == [["1"]] or \
+            ex2.execute("MATCH (:P)-[r:R]->(:P) RETURN count(r)"
+                        ).rows == [[1]]
+
+    def test_graphml_round_trip(self, ex):
+        gml = q1(ex, "RETURN apoc.export.graphmlAll()")
+        ex2 = CypherExecutor(NamespacedEngine(MemoryEngine(), "io3"))
+        out = ex2.execute("RETURN apoc.import.graphml($g)",
+                          {"g": gml}).rows[0][0]
+        assert out["nodes"] == 2 and out["relationships"] == 1
+
+    def test_csv_round_trip(self, ex):
+        csvs = q1(ex, "RETURN apoc.export.csvAll()")
+        assert "_id,_labels" in csvs["nodes"]
+        ex2 = CypherExecutor(NamespacedEngine(MemoryEngine(), "io4"))
+        out = ex2.execute(
+            "RETURN apoc.import.csv($n, $r)",
+            {"n": csvs["nodes"], "r": csvs["relationships"]}).rows[0][0]
+        assert out["nodes"] == 2 and out["relationships"] == 1
+
+    def test_cypher_script_export(self, ex):
+        script = q1(ex, "RETURN apoc.export.cypherAll()")
+        assert "CREATE" in script and "_import_id" in script
+        ex2 = CypherExecutor(NamespacedEngine(MemoryEngine(), "io5"))
+        out = ex2.execute("RETURN apoc.import.cypher($s)",
+                          {"s": script}).rows[0][0]
+        assert out["statements"] == 3
+        assert ex2.execute("MATCH (:P)-[r:R]->(:P) RETURN count(r)"
+                           ).rows == [[1]]
+
+    def test_import_helpers(self, ex):
+        assert q1(ex, "RETURN apoc.import.parseCsvLine('a,\"b,c\",d')"
+                  ) == ["a", "b,c", "d"]
+        assert q1(ex, "RETURN apoc.import.convertType('42', 'int')") == 42
+        v = q1(ex, "RETURN apoc.import.validateSchema("
+                   "[{a: '1'}, {b: '2'}], {a: 'int'})")
+        assert v["valid"] is False and "row 1" in v["errors"][0]
+
+
+class TestLoaders:
+    def test_local_formats(self, ex):
+        assert q1(ex, "RETURN apoc.load.csv('a,b\\n1,2')") == \
+            [{"a": "1", "b": "2"}]
+        assert q1(ex, "RETURN apoc.load.json('{\"x\": 1}')") == {"x": 1}
+        assert q1(ex, "RETURN apoc.load.jsonArray('[1,2]')") == [1, 2]
+        assert q1(ex, "RETURN apoc.load.jsonSchema("
+                      "'{\"a\": 1, \"b\": [\"x\"]}')") == \
+            {"a": "int", "b": ["str"]}
+
+    def test_html(self, ex):
+        h = q1(ex, "RETURN apoc.load.html('<html><title>T</title>"
+                   "<a href=\"/x\">l</a><p>body text</p></html>')")
+        assert h["title"] == "T"
+        assert h["links"] == ["/x"]
+        assert "body text" in h["text"]
+
+    def test_external_placeholders(self, ex):
+        # reference behavior: external loaders acknowledge with empty
+        # results (apoc/load/load.go placeholders)
+        assert q1(ex, "RETURN apoc.load.kafka('b', 't', {})") == []
+        assert q1(ex, "RETURN apoc.load.jdbc('url', 'q')") == []
+        assert q1(ex, "RETURN apoc.load.s3('bucket')") == []
+
+
+class TestVirtualGraph:
+    def test_from_and_stats(self, ex):
+        st = q1(ex, "MATCH (a:P)-[r]->(b:P) RETURN apoc.graph.stats("
+                    "apoc.graph.from([a, b], [r], 'g'))")
+        assert st["nodeCount"] == 2 and st["relCount"] == 1
+        assert st["labels"] == ["P"]
+
+    def test_from_document(self, ex):
+        doc = q1(ex, "RETURN apoc.graph.fromDocument('"
+                     '{"name": "root", "children": [{"name": "kid"}]}'
+                     "')")
+        assert len(doc["nodes"]) == 2
+        assert doc["relationships"][0].type == "CHILDREN"
+
+    def test_validate_dangling(self, ex):
+        bad = q1(ex, "MATCH (a:P)-[r]->(b:P) RETURN apoc.graph.validate("
+                     "apoc.graph.from([a], [r], 'g'))")
+        assert bad["valid"] is False and len(
+            bad["danglingRelationships"]) == 1
+
+
+class TestTriggerPeriodic:
+    def test_trigger_function_surface(self, ex):
+        q1(ex, "RETURN apoc.trigger.add('t1', 'RETURN 1')")
+        assert q1(ex, "RETURN apoc.trigger.count()") == 1
+        assert q1(ex, "RETURN apoc.trigger.isEnabled('t1')") is True
+        q1(ex, "RETURN apoc.trigger.pause('t1')")
+        assert q1(ex, "RETURN apoc.trigger.isEnabled('t1')") is False
+        exported = q1(ex, "RETURN apoc.trigger.export()")
+        q1(ex, "RETURN apoc.trigger.removeAll()")
+        assert q1(ex, "RETURN apoc.trigger.count()") == 0
+        assert q1(ex, "RETURN apoc.trigger.import($d)",
+                  {"d": exported}) == 1
+        q1(ex, "RETURN apoc.trigger.removeAll()")
+
+    def test_periodic_registry(self, ex):
+        q1(ex, "RETURN apoc.periodic.submit('j1', 'RETURN 1')")
+        jobs = q1(ex, "RETURN apoc.periodic.list()")
+        assert any(j["name"] == "j1" for j in jobs)
+        assert q1(ex, "RETURN apoc.periodic.cancel('j1')") is True
+
+    def test_periodic_truncate(self, ex):
+        out = q1(ex, "RETURN apoc.periodic.truncate()")
+        assert out["deleted"] == 2
+        assert q1(ex, "MATCH (n) RETURN count(n)") == 0
+
+
+class TestPathProcedures:
+    def test_shortest_path_procedure(self, ex):
+        ex.execute("MATCH (b:P {id:2}) CREATE (b)-[:R]->(:P {id: 3})")
+        r = ex.execute("MATCH (a:P {id:1}), (b:P {id:3}) "
+                       "CALL apoc.path.shortestPath(a, b) YIELD path "
+                       "RETURN length(path)").rows
+        assert r == [[2]]
+        r2 = ex.execute("MATCH (a:P {id:1}) "
+                        "CALL apoc.path.expandConfig(a, {maxLevel: 2}) "
+                        "YIELD path RETURN count(path)").rows
+        assert r2[0][0] >= 2
+
+    def test_all_shortest_paths(self, ex):
+        # diamond: two equal-length paths
+        ex.execute("CREATE (:Q {id: 1})")
+        ex.execute("MATCH (a:Q {id:1}) CREATE (a)-[:S]->(:Q {id: 2}), "
+                   "(a)-[:S]->(:Q {id: 3})")
+        ex.execute("MATCH (b:Q {id:2}), (c:Q {id:3}) "
+                   "CREATE (b)-[:S]->(:Q {id: 4})")
+        ex.execute("MATCH (c:Q {id:3}), (d:Q {id:4}) "
+                   "CREATE (c)-[:S]->(d)")
+        r = ex.execute("MATCH (a:Q {id:1}), (d:Q {id:4}) "
+                       "CALL apoc.path.allShortestPaths(a, d) YIELD path "
+                       "RETURN count(path)").rows
+        assert r == [[2]]
+
+
+class TestReviewRegressions:
+    def test_trigger_ctx_names_reachable_via_call(self, ex):
+        rows = ex.execute("CALL apoc.trigger.install('t9', 'RETURN 1') "
+                          "YIELD name RETURN name").rows
+        assert rows == [["t9"]]
+        shown = ex.execute("CALL apoc.trigger.show() YIELD name "
+                           "RETURN name").rows
+        assert ["t9"] in shown
+        q1(ex, "RETURN apoc.trigger.removeAll()")
+
+    def test_meta_constraints_not_cached_stale(self, ex):
+        assert q1(ex, "RETURN apoc.meta.constraints()") == []
+        q1(ex, "RETURN apoc.schema.createUniqueConstraint('MC', 'k')")
+        assert len(q1(ex, "RETURN apoc.meta.constraints()")) == 1
+
+    def test_from_cypher_executes_once(self, ex):
+        q1(ex, "RETURN apoc.graph.fromCypher('CREATE (x:Zz) RETURN x')")
+        assert q1(ex, "MATCH (z:Zz) RETURN count(z)") == 1
+
+    def test_shortest_path_follows_incoming_edges(self, ex):
+        ex.execute("CREATE (:U {id: 1})")
+        ex.execute("MATCH (u:U {id:1}) CREATE (:U {id: 2})-[:B]->(u)")
+        rows = ex.execute("MATCH (a:U {id:1}), (b:U {id:2}) "
+                          "CALL apoc.path.shortestPath(a, b) YIELD path "
+                          "RETURN length(path)").rows
+        assert rows == [[1]]
+
+    def test_empty_procedure_result_zero_rows(self, ex):
+        rows = ex.execute("CALL apoc.schema.nodeConstraints() "
+                          "YIELD name RETURN name").rows
+        assert rows == []
+
+    def test_try_acquire_reentrant_rollback_accounting(self, ex):
+        import threading
+
+        from nornicdb_tpu.query.apoc_admin import LOCKS
+
+        assert LOCKS.acquire(["re-a"], timeout=1.0)
+        hold = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            LOCKS.acquire(["re-b"], timeout=1.0)
+            hold.set()
+            release.wait(5.0)
+            LOCKS.release(["re-b"])
+
+        t = threading.Thread(target=holder)
+        t.start()
+        hold.wait(5.0)
+        try:
+            assert LOCKS.try_acquire(["re-a", "re-b"]) is False
+            # the original hold must still be counted
+            assert LOCKS.is_locked("re-a") is True
+        finally:
+            release.set()
+            t.join(5.0)
+            LOCKS.release(["re-a"])
+
+
+class TestLeftovers:
+    def test_map(self, ex):
+        assert q1(ex, "RETURN apoc.map.get({a: 1}, 'a')") == 1
+        assert q1(ex, "RETURN apoc.map.get({a: 1}, 'z', 9)") == 9
+        assert q1(ex, "RETURN apoc.map.dropNullValues({a: 1, b: null})"
+                  ) == {"a": 1}
+        assert q1(ex, "RETURN apoc.map.unflatten({`a.b`: 1})") == \
+            {"a": {"b": 1}}
+        assert q1(ex, "RETURN apoc.map.setPairs([['a', 1], ['b', 2]])"
+                  ) == {"a": 1, "b": 2}
+
+    def test_node_rel_write_forms(self, ex):
+        ex.execute("MATCH (p:P {id:1}) "
+                   "RETURN apoc.node.setProperty(p, 'extra', 7)")
+        assert q1(ex, "MATCH (p:P {id:1}) RETURN p.extra") == 7
+        ex.execute("MATCH (p:P {id:1}) RETURN apoc.label.add(p, 'Z')")
+        assert q1(ex, "MATCH (p:P {id:1}) RETURN labels(p)") == ["P", "Z"]
+        ex.execute("MATCH (p:P {id:1}) "
+                   "RETURN apoc.label.replace(p, 'Z', 'Y')")
+        assert q1(ex, "MATCH (p:P {id:1}) RETURN labels(p)") == ["P", "Y"]
+
+    def test_lock_with_lock(self, ex):
+        out = q1(ex, "MATCH (p:P {id:1}) "
+                     "RETURN apoc.lock.withLock([p], 'RETURN 42 AS v')")
+        assert out == [{"v": 42}]
+        # lock must be released afterwards
+        assert q1(ex, "MATCH (p:P {id:1}) "
+                      "RETURN apoc.lock.isLocked(p)") is False
+
+    def test_hashing(self, ex):
+        # cityhash64 delegates to fnv1a64 (reference hashing.go:302)
+        assert q1(ex, "RETURN apoc.hashing.cityhash64('x')") == \
+            q1(ex, "RETURN apoc.hashing.fnv1a64('x')")
+        a = q1(ex, "RETURN apoc.hashing.xxhash32('hello')")
+        b = q1(ex, "RETURN apoc.hashing.xxhash32('hello', 1)")
+        assert a != b and 0 <= a <= 0xFFFFFFFF
+
+    def test_merge_pattern_and_rollback(self, ex):
+        out = q1(ex, "RETURN apoc.merge.pattern(['A'], {k: 1}, 'REL', "
+                     "['B'], {k: 2})")
+        assert out["rel"].type == "REL"
+        snap = q1(ex, "MATCH (a:A) RETURN apoc.merge.snapshot(a)")
+        ex.execute("MATCH (a:A) SET a.k = 99")
+        ex.execute("MATCH (a:A) RETURN apoc.merge.rollback(a, $s)",
+                   {"s": snap})
+        assert q1(ex, "MATCH (a:A) RETURN a.k") == 1
