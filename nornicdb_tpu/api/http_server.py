@@ -285,6 +285,17 @@ class HttpServer:
             # embedded admin browser (reference: ui/ React app served by
             # the binary via embed.go)
             return 200, _browser_html()
+        if parsed.path == "/openapi.json" and method == "GET":
+            from nornicdb_tpu.api.openapi import openapi_spec
+
+            return 200, openapi_spec()
+        if parsed.path in ("/swagger", "/swagger/", "/docs") and \
+                method == "GET":
+            # interactive API docs (reference: cmd/swagger-ui); single
+            # self-contained page, no CDN assets
+            from nornicdb_tpu.api.openapi import docs_page
+
+            return 200, docs_page()
         if parsed.path == "/auth/login" and method == "POST":
             return self._login(payload)
 

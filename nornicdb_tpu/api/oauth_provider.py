@@ -1,0 +1,263 @@
+"""Standalone OAuth 2.0 authorization-code provider.
+
+Reference: cmd/oauth-provider/main.go (a separate process serving the
+authorization-code flow with a consent form, discovery document, token
+exchange, and userinfo). Same surface here:
+
+- ``GET  /.well-known/oauth-authorization-server`` — discovery
+- ``GET  /oauth2/v1/authorize``  — consent form (HTML)
+- ``POST /oauth2/v1/consent``    — approve -> redirect with code
+- ``POST /oauth2/v1/token``      — authorization_code -> access token
+- ``GET  /oauth2/v1/userinfo``   — bearer token -> profile
+- ``GET  /health``
+
+Tokens and codes are in-memory with expiry, like the reference; start
+via ``python -m nornicdb_tpu.cli oauth-provider --port 8888``.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import secrets
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+_CODE_TTL_S = 600.0
+_TOKEN_TTL_S = 3600.0
+
+
+class OAuthProvider:
+    def __init__(self, port: int = 8888, client_id: str = "nornicdb",
+                 client_secret: str = "nornicdb-secret",
+                 issuer: Optional[str] = None, host: str = "127.0.0.1",
+                 allowed_redirects: Optional[list] = None):
+        self.port = port
+        self.host = host
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.issuer = issuer or f"http://{host}:{port}"
+        # redirect_uri allowlist (prefix match). Codes must never be
+        # delivered to unregistered URIs (OAuth code-exfiltration via
+        # open redirect); default covers local development only.
+        self.allowed_redirects = list(allowed_redirects) if \
+            allowed_redirects is not None else \
+            ["http://localhost", "http://127.0.0.1", "http://app/cb"]
+        self.users: Dict[str, Dict[str, Any]] = {
+            "demo": {"sub": "demo", "preferred_username": "demo",
+                     "roles": ["reader"]},
+        }
+        self._codes: Dict[str, Dict[str, Any]] = {}
+        self._tokens: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- core flows ------------------------------------------------------
+
+    def discovery(self) -> Dict[str, Any]:
+        return {
+            "issuer": self.issuer,
+            "authorization_endpoint": f"{self.issuer}/oauth2/v1/authorize",
+            "token_endpoint": f"{self.issuer}/oauth2/v1/token",
+            "userinfo_endpoint": f"{self.issuer}/oauth2/v1/userinfo",
+            "response_types_supported": ["code"],
+            "grant_types_supported": ["authorization_code"],
+            "token_endpoint_auth_methods_supported": ["client_secret_post"],
+        }
+
+    def redirect_allowed(self, redirect_uri: str) -> bool:
+        return any(str(redirect_uri).startswith(prefix)
+                   for prefix in self.allowed_redirects)
+
+    def issue_code(self, client_id: str, redirect_uri: str,
+                   user_id: str) -> str:
+        if client_id != self.client_id:
+            raise ValueError("unknown client_id")
+        if not self.redirect_allowed(redirect_uri):
+            raise ValueError("redirect_uri not registered")
+        if user_id not in self.users:
+            raise ValueError("unknown user")
+        code = secrets.token_urlsafe(32)
+        with self._lock:
+            self._gc_locked()
+            self._codes[code] = {
+                "client_id": client_id, "redirect_uri": redirect_uri,
+                "user_id": user_id,
+                "expires_at": time.time() + _CODE_TTL_S,
+            }
+        return code
+
+    def exchange(self, grant_type: str, code: str, client_id: str,
+                 client_secret: str,
+                 redirect_uri: str) -> Dict[str, Any]:
+        if grant_type != "authorization_code":
+            return {"error": "unsupported_grant_type"}
+        if client_id != self.client_id or \
+                client_secret != self.client_secret:
+            return {"error": "invalid_client"}
+        with self._lock:
+            self._gc_locked()
+            entry = self._codes.pop(code, None)  # single use
+            if entry is None or entry["expires_at"] < time.time():
+                return {"error": "invalid_grant"}
+            if entry["redirect_uri"] != redirect_uri or \
+                    entry["client_id"] != client_id:
+                return {"error": "invalid_grant"}
+            token = secrets.token_urlsafe(32)
+            self._tokens[token] = {
+                "user_id": entry["user_id"],
+                "expires_at": time.time() + _TOKEN_TTL_S,
+            }
+        return {"access_token": token, "token_type": "Bearer",
+                "expires_in": int(_TOKEN_TTL_S)}
+
+    def userinfo(self, token: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            entry = self._tokens.get(token)
+            if entry is None or entry["expires_at"] < time.time():
+                return None
+            user = self.users.get(entry["user_id"])
+        return dict(user) if user else None
+
+    def _gc_locked(self) -> None:
+        now = time.time()
+        for table in (self._codes, self._tokens):
+            for key in [k for k, v in table.items()
+                        if v["expires_at"] < now]:
+                table.pop(key, None)
+
+    # -- HTTP ------------------------------------------------------------
+
+    def start(self) -> "OAuthProvider":
+        provider = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _json(self, obj: Dict[str, Any], status: int = 200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _html(self, text: str, status: int = 200):
+                body = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _form(self) -> Dict[str, str]:
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length).decode()
+                ctype = self.headers.get("Content-Type", "")
+                if "application/json" in ctype:
+                    try:
+                        return {str(k): str(v) for k, v in
+                                json.loads(raw or "{}").items()}
+                    except ValueError:
+                        return {}
+                return {k: v[0] for k, v in
+                        urllib.parse.parse_qs(raw).items()}
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                qs = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(parsed.query).items()}
+                if parsed.path == "/.well-known/oauth-authorization-server":
+                    return self._json(provider.discovery())
+                if parsed.path == "/health":
+                    return self._json({"status": "ok",
+                                       "users": len(provider.users)})
+                if parsed.path == "/oauth2/v1/authorize":
+                    if qs.get("response_type") != "code":
+                        return self._json(
+                            {"error": "unsupported_response_type"}, 400)
+                    if qs.get("client_id") != provider.client_id:
+                        return self._json({"error": "invalid_client"}, 400)
+                    if not provider.redirect_allowed(
+                            qs.get("redirect_uri", "")):
+                        return self._json(
+                            {"error": "invalid_redirect_uri"}, 400)
+                    return self._html(_consent_form(
+                        qs.get("client_id", ""),
+                        qs.get("redirect_uri", ""),
+                        qs.get("state", ""), qs.get("scope", "")))
+                if parsed.path == "/oauth2/v1/userinfo":
+                    auth = self.headers.get("Authorization", "")
+                    token = auth.removeprefix("Bearer ").strip()
+                    info = provider.userinfo(token)
+                    if info is None:
+                        return self._json({"error": "invalid_token"}, 401)
+                    return self._json(info)
+                return self._json({"error": "not_found"}, 404)
+
+            def do_POST(self):
+                parsed = urllib.parse.urlparse(self.path)
+                form = self._form()
+                if parsed.path == "/oauth2/v1/consent":
+                    try:
+                        code = provider.issue_code(
+                            form.get("client_id", ""),
+                            form.get("redirect_uri", ""),
+                            form.get("user_id", "demo"))
+                    except ValueError as exc:
+                        return self._json(
+                            {"error": "invalid_request",
+                             "error_description": str(exc)}, 400)
+                    target = form.get("redirect_uri", "")
+                    sep = "&" if "?" in target else "?"
+                    location = (f"{target}{sep}code={code}"
+                                f"&state={urllib.parse.quote(form.get('state', ''))}")
+                    self.send_response(302)
+                    self.send_header("Location", location)
+                    self.end_headers()
+                    return None
+                if parsed.path == "/oauth2/v1/token":
+                    out = provider.exchange(
+                        form.get("grant_type", ""), form.get("code", ""),
+                        form.get("client_id", ""),
+                        form.get("client_secret", ""),
+                        form.get("redirect_uri", ""))
+                    status = 200 if "access_token" in out else 400
+                    return self._json(out, status)
+                return self._json({"error": "not_found"}, 404)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        if "://" not in (self.issuer or "") or self.issuer.endswith(":0"):
+            self.issuer = f"http://{self.host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def _consent_form(client_id: str, redirect_uri: str, state: str,
+                  scope: str) -> str:
+    esc = html.escape
+    return f"""<!doctype html><html><head><title>Authorize</title></head>
+<body><h1>Authorize {esc(client_id)}</h1>
+<p>The application requests access{' to ' + esc(scope) if scope else ''}.</p>
+<form method="POST" action="/oauth2/v1/consent">
+<input type="hidden" name="client_id" value="{esc(client_id)}">
+<input type="hidden" name="redirect_uri" value="{esc(redirect_uri)}">
+<input type="hidden" name="state" value="{esc(state)}">
+<input type="hidden" name="scope" value="{esc(scope)}">
+<label>User: <input name="user_id" value="demo"></label>
+<button type="submit">Approve</button>
+</form></body></html>"""

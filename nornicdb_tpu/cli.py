@@ -50,6 +50,16 @@ def _build_parser() -> argparse.ArgumentParser:
     exp.add_argument("--data-dir", default=None)
     exp.add_argument("--database", default="neo4j")
 
+    oauth = sub.add_parser(
+        "oauth-provider",
+        help="start the standalone OAuth 2.0 provider (reference: "
+             "cmd/oauth-provider)")
+    oauth.add_argument("--port", type=int, default=8888)
+    oauth.add_argument("--host", default="127.0.0.1")
+    oauth.add_argument("--client-id", default="nornicdb")
+    oauth.add_argument("--client-secret", default="nornicdb-secret")
+    oauth.add_argument("--issuer", default=None)
+
     ev = sub.add_parser("eval", help="run a search-quality eval suite")
     ev.add_argument("suite", help="JSONL suite file")
     ev.add_argument("--data-dir", default=None)
@@ -232,6 +242,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_export(args)
     if args.command == "eval":
         return cmd_eval(args)
+    if args.command == "oauth-provider":
+        from nornicdb_tpu.api.oauth_provider import OAuthProvider
+
+        provider = OAuthProvider(
+            port=args.port, host=args.host, client_id=args.client_id,
+            client_secret=args.client_secret, issuer=args.issuer).start()
+        print(f"oauth-provider listening on {provider.issuer}")
+        try:
+            import time as _t
+
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            provider.stop()
+        return 0
     parser.print_help()
     return 2
 

@@ -1,0 +1,146 @@
+"""Aux command surfaces: OAuth 2.0 provider + OpenAPI/swagger docs
+(reference: cmd/oauth-provider, cmd/swagger-ui)."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from nornicdb_tpu.api.oauth_provider import OAuthProvider
+
+
+@pytest.fixture()
+def provider():
+    p = OAuthProvider(port=0).start()  # ephemeral port
+    yield p
+    p.stop()
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def _post(url, form):
+    data = urllib.parse.urlencode(form).encode()
+    req = urllib.request.Request(url, data=data, headers={
+        "Content-Type": "application/x-www-form-urlencoded"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read().decode(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+class TestOAuthProvider:
+    def test_discovery(self, provider):
+        status, body = _get(
+            f"{provider.issuer}/.well-known/oauth-authorization-server")
+        assert status == 200
+        d = json.loads(body)
+        assert d["token_endpoint"] == \
+            f"{provider.issuer}/oauth2/v1/token"
+        assert d["grant_types_supported"] == ["authorization_code"]
+
+    def test_full_authorization_code_flow(self, provider):
+        # 1. authorize: consent form renders
+        status, body = _get(
+            f"{provider.issuer}/oauth2/v1/authorize?response_type=code"
+            f"&client_id=nornicdb&redirect_uri=http://app/cb&state=xyz")
+        assert status == 200 and "<form" in body
+
+        # 2. consent: approve -> redirect carrying the code
+        import http.client
+
+        parsed = urllib.parse.urlparse(provider.issuer)
+        conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                          timeout=10)
+        conn.request("POST", "/oauth2/v1/consent",
+                     urllib.parse.urlencode({
+                         "client_id": "nornicdb",
+                         "redirect_uri": "http://app/cb",
+                         "state": "xyz", "user_id": "demo"}),
+                     {"Content-Type":
+                      "application/x-www-form-urlencoded"})
+        resp = conn.getresponse()
+        assert resp.status == 302
+        location = resp.getheader("Location")
+        qs = urllib.parse.parse_qs(urllib.parse.urlparse(location).query)
+        assert qs["state"] == ["xyz"]
+        code = qs["code"][0]
+
+        # 3. token exchange
+        status, body, _ = _post(f"{provider.issuer}/oauth2/v1/token", {
+            "grant_type": "authorization_code", "code": code,
+            "client_id": "nornicdb", "client_secret": "nornicdb-secret",
+            "redirect_uri": "http://app/cb"})
+        assert status == 200
+        token = json.loads(body)["access_token"]
+
+        # 4. userinfo with the bearer token
+        status, body = _get(f"{provider.issuer}/oauth2/v1/userinfo",
+                            {"Authorization": f"Bearer {token}"})
+        assert status == 200
+        assert json.loads(body)["preferred_username"] == "demo"
+
+        # 5. codes are single-use
+        status, body, _ = _post(f"{provider.issuer}/oauth2/v1/token", {
+            "grant_type": "authorization_code", "code": code,
+            "client_id": "nornicdb", "client_secret": "nornicdb-secret",
+            "redirect_uri": "http://app/cb"})
+        assert status == 400
+        assert json.loads(body)["error"] == "invalid_grant"
+
+    def test_bad_client_secret_rejected(self, provider):
+        code = provider.issue_code("nornicdb", "http://app/cb", "demo")
+        status, body, _ = _post(f"{provider.issuer}/oauth2/v1/token", {
+            "grant_type": "authorization_code", "code": code,
+            "client_id": "nornicdb", "client_secret": "wrong",
+            "redirect_uri": "http://app/cb"})
+        assert status == 400
+        assert json.loads(body)["error"] == "invalid_client"
+
+    def test_redirect_uri_must_match(self, provider):
+        code = provider.issue_code("nornicdb", "http://app/cb", "demo")
+        out = provider.exchange("authorization_code", code, "nornicdb",
+                                "nornicdb-secret", "http://evil/cb")
+        assert out == {"error": "invalid_grant"}
+
+    def test_userinfo_rejects_bad_token(self, provider):
+        try:
+            _get(f"{provider.issuer}/oauth2/v1/userinfo",
+                 {"Authorization": "Bearer nope"})
+            assert False, "expected 401"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+
+
+class TestOpenApiDocs:
+    def test_spec_and_docs_served(self):
+        import nornicdb_tpu
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        db = nornicdb_tpu.open()
+        srv = HttpServer(db, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, body = _get(f"{base}/openapi.json")
+            assert status == 200
+            spec = json.loads(body)
+            assert spec["openapi"].startswith("3.")
+            assert "/db/{database}/tx/commit" in spec["paths"]
+            status, body = _get(f"{base}/swagger")
+            assert status == 200 and body.startswith("<!doctype")
+            assert "nornicdb-tpu HTTP API" in body
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_cli_has_oauth_subcommand(self):
+        from nornicdb_tpu.cli import _build_parser
+
+        parser = _build_parser()
+        args = parser.parse_args(["oauth-provider", "--port", "0"])
+        assert args.command == "oauth-provider" and args.port == 0
