@@ -70,8 +70,24 @@ class OAuthProvider:
         }
 
     def redirect_allowed(self, redirect_uri: str) -> bool:
-        return any(str(redirect_uri).startswith(prefix)
-                   for prefix in self.allowed_redirects)
+        """Exact scheme+host+port match against a registered entry, with
+        path prefix match. A raw string prefix is NOT enough: a host like
+        ``localhost.evil.example`` starts with an allowed prefix but must
+        be rejected."""
+        try:
+            target = urllib.parse.urlsplit(str(redirect_uri))
+        except ValueError:
+            return False
+        if not target.scheme or not target.hostname:
+            return False
+        for entry in self.allowed_redirects:
+            allowed = urllib.parse.urlsplit(entry)
+            if (target.scheme == allowed.scheme
+                    and target.hostname == allowed.hostname
+                    and target.port == allowed.port
+                    and target.path.startswith(allowed.path)):
+                return True
+        return False
 
     def issue_code(self, client_id: str, redirect_uri: str,
                    user_id: str) -> str:

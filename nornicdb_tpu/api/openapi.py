@@ -67,13 +67,25 @@ def openapi_spec() -> Dict[str, Any]:
                 "Open an explicit transaction", "cypher",
                 request=stmt_req, params=[("database", "path",
                                            "string")])},
-            "/search": {"post": op(
+            "/nornicdb/search": {"post": op(
                 "Hybrid search (BM25 + vector + RRF)", "search",
                 request={"type": "object", "properties": {
                     "query": {"type": "string"},
-                    "k": {"type": "integer"},
-                    "mode": {"type": "string",
-                             "enum": ["hybrid", "bm25", "vector"]}}},
+                    "limit": {"type": "integer"}}},
+                response=obj)},
+            "/nornicdb/store": {"post": op(
+                "Store content (auto-embeds via the queue)", "search",
+                request={"type": "object", "properties": {
+                    "content": {"type": "string"},
+                    "labels": {"type": "array",
+                               "items": {"type": "string"}},
+                    "properties": obj}},
+                response=obj)},
+            "/nornicdb/similar": {"post": op(
+                "Find nodes similar to an existing node", "search",
+                request={"type": "object", "properties": {
+                    "node_id": {"type": "string"},
+                    "limit": {"type": "integer"}}},
                 response=obj)},
             "/graphql": {"post": op("GraphQL endpoint", "graphql",
                                     request=obj, response=obj)},
@@ -88,9 +100,12 @@ def openapi_spec() -> Dict[str, Any]:
             "/collections/{name}/points/search": {"post": op(
                 "Qdrant-compatible vector search", "qdrant",
                 request=obj, params=[("name", "path", "string")])},
-            "/gdpr/export/{node_id}": {"get": op(
-                "GDPR subject data export", "gdpr",
-                params=[("node_id", "path", "string")])},
+            "/nornicdb/gdpr/export": {"post": op(
+                "GDPR subject data export by property match", "gdpr",
+                request={"type": "object", "properties": {
+                    "property": {"type": "string"},
+                    "value": {}}},
+                response=obj)},
         },
     }
 

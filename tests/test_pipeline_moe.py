@@ -1,0 +1,134 @@
+"""Pipeline (pp) and expert (ep) parallelism (parallel/pipeline.py).
+
+Runs on the 8-device virtual CPU mesh from conftest. Correctness is
+checked exactly: the GPipe schedule must reproduce the sequential stage
+stack, and MoE dispatch/combine must reproduce dense per-token expert
+compute when capacity admits every token.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nornicdb_tpu.parallel.pipeline import (
+    _stage_block,
+    init_moe_params,
+    init_pipeline_params,
+    make_pp_ep_mesh,
+    make_pp_ep_train_step,
+    moe_apply,
+    pipeline_apply,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_pp_ep_mesh(8, devs)
+
+
+def _sequential(params, x, pp):
+    ref = x
+    for s in range(pp):
+        ref = _stage_block({k: v[s:s + 1] for k, v in params.items()}, ref)
+    return ref
+
+
+class TestPipeline:
+    def test_matches_sequential(self, mesh):
+        pp = mesh.shape["pp"]
+        params = init_pipeline_params(jax.random.PRNGKey(0), pp, 16)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+        out = pipeline_apply(params, x, mesh, n_microbatches=4)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_sequential(params, x, pp)),
+            rtol=1e-5, atol=1e-5)
+
+    def test_microbatch_count_invariance(self, mesh):
+        pp = mesh.shape["pp"]
+        params = init_pipeline_params(jax.random.PRNGKey(2), pp, 8)
+        x = jax.random.normal(jax.random.PRNGKey(3), (8, 8))
+        a = pipeline_apply(params, x, mesh, n_microbatches=2)
+        b = pipeline_apply(params, x, mesh, n_microbatches=8)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradients_flow_to_every_stage(self, mesh):
+        pp = mesh.shape["pp"]
+        params = init_pipeline_params(jax.random.PRNGKey(4), pp, 8)
+        x = jax.random.normal(jax.random.PRNGKey(5), (4, 8))
+
+        def loss(p):
+            return jnp.sum(pipeline_apply(p, x, mesh, 2) ** 2)
+
+        g = jax.grad(loss)(params)
+        for name, grad in g.items():
+            per_stage = np.asarray(
+                jnp.sqrt(jnp.sum(grad.reshape(pp, -1) ** 2, axis=1)))
+            assert (per_stage > 0).all(), (name, per_stage)
+
+
+class TestMoE:
+    def test_matches_dense_when_no_drops(self, mesh):
+        ep = mesh.shape["ep"]
+        params = init_moe_params(jax.random.PRNGKey(2), ep, 16, 32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+        y, aux = moe_apply(params, x, mesh, capacity_factor=8.0)
+        scores = jax.nn.softmax(x @ params["router"], -1)
+        eidx = jnp.argmax(scores, -1)
+        gate = jnp.max(scores, -1)
+        ref = jnp.stack([
+            (jax.nn.gelu(x[i] @ params["wi"][int(eidx[i])])
+             @ params["wo"][int(eidx[i])]) * gate[i]
+            for i in range(8)])
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        assert np.isfinite(float(aux)) and float(aux) > 0
+
+    def test_capacity_drops_tokens_not_crash(self, mesh):
+        ep = mesh.shape["ep"]
+        params = init_moe_params(jax.random.PRNGKey(6), ep, 16, 32)
+        # steer every token to one expert: capacity 1 forces drops
+        params = {**params,
+                  "router": params["router"].at[:, 0].set(10.0)}
+        x = jax.random.normal(jax.random.PRNGKey(7), (8, 16))
+        y, _aux = moe_apply(params, x, mesh, capacity_factor=0.5)
+        assert np.isfinite(np.asarray(y)).all()
+        # dropped tokens produce zero output rows
+        zero_rows = int(np.sum(np.all(np.asarray(y) == 0.0, axis=1)))
+        assert zero_rows >= 1
+
+    def test_gradients_reach_every_expert_shard(self, mesh):
+        ep = mesh.shape["ep"]
+        params = init_moe_params(jax.random.PRNGKey(8), ep, 8, 16)
+        x = jax.random.normal(jax.random.PRNGKey(9), (16, 8))
+
+        def loss(p):
+            out, aux = moe_apply(p, x, mesh, capacity_factor=8.0)
+            return jnp.sum(out ** 2) + aux
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.linalg.norm(g["router"])) > 0
+        assert float(jnp.linalg.norm(g["wi"])) > 0
+
+
+class TestCombined:
+    def test_pp_ep_train_step_learns(self, mesh):
+        init_fn, step = make_pp_ep_train_step(
+            mesh, width=16, hidden=32, n_microbatches=2,
+            learning_rate=0.2)
+        params, shardings = init_fn(jax.random.PRNGKey(3))
+        # param placement: pipeline stages over pp, experts over ep
+        assert "pp" in str(shardings["pipe"]["w1"])
+        assert "ep" in str(shardings["moe"]["wi"])
+        x = jax.random.normal(jax.random.PRNGKey(4), (16, 16))
+        y = x * 0.5
+        losses = []
+        for _ in range(40):
+            params, loss = step(params, x, y)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] * 0.95  # monotone-ish decrease
