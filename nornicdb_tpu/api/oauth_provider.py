@@ -76,16 +76,22 @@ class OAuthProvider:
         be rejected."""
         try:
             target = urllib.parse.urlsplit(str(redirect_uri))
+            target_port = target.port  # .port parses lazily: may raise
         except ValueError:
             return False
         if not target.scheme or not target.hostname:
             return False
         for entry in self.allowed_redirects:
             allowed = urllib.parse.urlsplit(entry)
-            if (target.scheme == allowed.scheme
-                    and target.hostname == allowed.hostname
-                    and target.port == allowed.port
-                    and target.path.startswith(allowed.path)):
+            if target.scheme != allowed.scheme:
+                continue
+            if target.hostname != allowed.hostname:
+                continue
+            # an entry without an explicit port accepts any port on that
+            # exact host (dev servers move ports); an explicit port pins
+            if allowed.port is not None and target_port != allowed.port:
+                continue
+            if target.path.startswith(allowed.path):
                 return True
         return False
 

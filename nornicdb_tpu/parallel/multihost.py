@@ -1,0 +1,136 @@
+"""Multi-host distributed execution: DCN x ICI meshes and per-process
+data feeding.
+
+Reference: the reference scales across machines with an NCCL/MPI cluster
+backend (pkg/cluster). The TPU-native equivalent is JAX multi-process
+execution: every host runs this same program, ``init_distributed`` wires
+them into one runtime (coordinator handshake), and collectives are
+placed by mesh axis so that the slow cross-host hops ride the *leading*
+mesh axis (DCN) while bandwidth-hungry tp/sp/ep collectives stay inside
+a host's ICI domain — the scaling-book layout rule.
+
+Single-host processes (the common dev case, and this repo's test
+environment) degrade gracefully: ``init_distributed`` is a no-op when no
+coordinator is configured and ``hybrid_mesh`` collapses to an ordinary
+mesh with a singleton dcn axis.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Dict[str, int]:
+    """Join the multi-process JAX runtime.
+
+    Arguments default from the standard environment variables
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, the
+    TPU pod launcher contract). With no coordinator configured this is a
+    single-process no-op — the same binary runs unchanged on a laptop,
+    one TPU host, or a pod slice.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if coordinator_address:
+        num_processes = int(num_processes
+                            or os.environ.get("JAX_NUM_PROCESSES", "1"))
+        process_id = int(process_id
+                         or os.environ.get("JAX_PROCESS_ID", "0"))
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axis: str = "dcn",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh with a leading cross-host (DCN) axis and intra-host (ICI)
+    axes, e.g. hybrid_mesh({"tp": 2, "sp": 2}) on a 2-host x 4-chip
+    topology -> Mesh('dcn'=2, 'tp'=2, 'sp'=2).
+
+    The leading axis spans hosts, so only collectives over ``dcn_axis``
+    (typically the data-parallel gradient all-reduce) cross the data
+    center network; tp/sp/ep traffic stays on ICI. Falls back to a
+    singleton dcn axis in single-process runs.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_proc = max(
+        len({getattr(d, "process_index", 0) for d in devices}), 1)
+    per_host = len(devices) // n_proc
+    ici_size = 1
+    for v in ici_axes.values():
+        ici_size *= v
+    if per_host % ici_size != 0:
+        raise ValueError(
+            f"ici axes {ici_axes} (size {ici_size}) do not divide the "
+            f"{per_host} devices per host")
+    dcn = len(devices) // ici_size
+    shape = (dcn,) + tuple(ici_axes.values())
+    if n_proc > 1:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_host // ici_size,) + tuple(ici_axes.values()),
+            dcn_mesh_shape=(n_proc,) + (1,) * len(ici_axes),
+            devices=devices,
+        )
+        arr = arr.reshape(shape)
+    else:
+        arr = np.array(devices).reshape(shape)
+    return Mesh(arr, axis_names=(dcn_axis,) + tuple(ici_axes.keys()))
+
+
+def process_local_batch(
+    mesh: Mesh,
+    local_data: np.ndarray,
+    batch_axis: str = "dcn",
+) -> jax.Array:
+    """Assemble the global batch array from this process's local shard.
+
+    Every host loads only its own slice of the batch (the data-loader
+    contract of multi-host training); the returned jax.Array is globally
+    sharded over ``batch_axis`` without any host ever materializing the
+    full batch.
+    """
+    sharding = NamedSharding(
+        mesh, P(batch_axis, *([None] * (local_data.ndim - 1))))
+    if jax.process_count() == 1:
+        return jax.device_put(local_data, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_data)
+
+
+def replicate_to_mesh(mesh: Mesh, value: np.ndarray) -> jax.Array:
+    """Place ``value`` fully replicated on every mesh device."""
+    return jax.device_put(value, NamedSharding(mesh, P()))
+
+
+def dcn_allreduce_bytes_per_step(
+    param_count: int, dtype_bytes: int = 4, dcn_size: int = 2
+) -> Tuple[int, str]:
+    """Back-of-envelope: bytes each host exchanges over DCN per gradient
+    all-reduce (ring: 2 * (n-1)/n * payload). Exposed for capacity
+    planning in deployment docs/tests."""
+    payload = param_count * dtype_bytes
+    per_host = int(2 * (dcn_size - 1) / dcn_size * payload)
+    return per_host, (
+        f"{per_host / 1e6:.1f} MB/host/step over DCN for "
+        f"{param_count / 1e6:.1f}M params at {dtype_bytes}B")

@@ -108,6 +108,24 @@ class TestOAuthProvider:
                                 "nornicdb-secret", "http://evil/cb")
         assert out == {"error": "invalid_grant"}
 
+    def test_redirect_allowlist_exact_origin(self, provider):
+        """Lookalike hosts, malformed ports, and scheme changes are
+        rejected; portless allowlist entries accept any port on that
+        exact host (dev servers move ports)."""
+        assert provider.redirect_allowed("http://localhost:3000/cb") is True
+        assert provider.redirect_allowed(
+            "http://localhost.evil.example/cb") is False
+        assert provider.redirect_allowed(
+            "http://localhost:99999/cb") is False  # port out of range
+        assert provider.redirect_allowed("http://h:abc/") is False
+        assert provider.redirect_allowed("https://localhost/cb") is False
+        pinned = OAuthProvider(
+            allowed_redirects=["https://app.example:8443/cb"])
+        assert pinned.redirect_allowed(
+            "https://app.example:8443/cb/done") is True
+        assert pinned.redirect_allowed(
+            "https://app.example:9000/cb") is False
+
     def test_userinfo_rejects_bad_token(self, provider):
         try:
             _get(f"{provider.issuer}/oauth2/v1/userinfo",
