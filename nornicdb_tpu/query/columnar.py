@@ -32,7 +32,7 @@ class EdgeTable:
 
     __slots__ = (
         "etype", "src", "dst", "edges",
-        "_csr_out", "_csr_in", "_prop_cols",
+        "_csr_out", "_csr_in", "_prop_cols", "_edge_ids",
     )
 
     def __init__(self, etype: str, src: np.ndarray, dst: np.ndarray,
@@ -44,6 +44,7 @@ class EdgeTable:
         self._csr_out: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._csr_in: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._prop_cols: Dict[str, np.ndarray] = {}
+        self._edge_ids = {e.id for e in edges}
 
     def __len__(self) -> int:
         return len(self.edges)
@@ -75,7 +76,15 @@ class EdgeTable:
         return col
 
     def append_edge(self, src_row: int, dst_row: int, edge: Edge) -> None:
-        """Create-delta append; drops derived caches (CSR, prop cols)."""
+        """Create-delta append; drops derived caches (CSR, prop cols).
+
+        Idempotent: a lazy table build that raced the write may have
+        already read this edge from storage before the create listener
+        fired — appending again would duplicate it in every join and
+        degree count."""
+        if edge.id in self._edge_ids:
+            return
+        self._edge_ids.add(edge.id)
         self.src = np.append(self.src, np.int32(src_row))
         self.dst = np.append(self.dst, np.int32(dst_row))
         self.edges.append(edge)
@@ -145,6 +154,8 @@ class ColumnarCatalog:
             self._incidence.clear()
             if self._nodes is None:
                 return  # nothing built yet; lazy build sees the node
+            if node.id in self._node_pos:
+                return  # lazy build raced the write and already has it
             i = len(self._nodes)
             self._nodes.append(node)
             self._node_pos[node.id] = i
@@ -340,13 +351,18 @@ class ColumnarCatalog:
         # take it themselves; a racy double-build is harmless, but a
         # build that raced a mutation must not be stored (the mutation
         # already cleared the cache — storing would resurrect a stale
-        # snapshot), hence the version check
+        # snapshot), hence the version check. Ordering matters: src/dst
+        # are snapshotted under the lock (no torn pair), and the label
+        # mask is fetched AFTER the snapshot — cached masks are extended
+        # on node create, so a mask taken after the snapshot always
+        # covers every row the snapshot references.
         tbl = self.edge_table(etype)
+        with self._lock:
+            if direction == "out":
+                keys, far = tbl.src, tbl.dst
+            else:
+                keys, far = tbl.dst, tbl.src
         n = self.n_nodes()
-        if direction == "out":
-            keys, far = tbl.src, tbl.dst
-        else:
-            keys, far = tbl.dst, tbl.src
         if label is not None:
             keys = keys[self.label_mask(label)[far]]
         deg = np.bincount(keys, minlength=n).astype(np.int64)
@@ -390,18 +406,29 @@ class ColumnarCatalog:
             if key in self._incidence:
                 return self._incidence[key]
             v0 = self._version
+        # Ordering vs concurrent writers: snapshot src/dst under the lock
+        # (no torn pair), derive every length from the snapshot itself,
+        # and fetch masks/candidate rows AFTER the snapshot — those
+        # caches are extended on node create, so post-snapshot fetches
+        # always cover every row the snapshot references.
         tbl = self.edge_table(etype)
+        with self._lock:
+            if orientation == "mid_src":
+                mid_e, far_e = tbl.src, tbl.dst
+            else:
+                mid_e, far_e = tbl.dst, tbl.src
+        ne = len(mid_e)
         n = self.n_nodes()
-        mid_e = tbl.src if orientation == "mid_src" else tbl.dst
-        far_e = tbl.dst if orientation == "mid_src" else tbl.src
-        # shared middle axis
+        # shared middle axis; a cached axis is usable only if it was
+        # built from a same-length (hence identical: appends-only +
+        # wholesale invalidation) edge snapshot
         axis_key = (etype, orientation, mid_label)
         with self._lock:
             axis = self._mid_axis.get(axis_key)
-        if axis is None:
+        if axis is None or len(axis[2]) != ne:
             emask = (self.label_mask(mid_label)[mid_e]
                      if mid_label is not None
-                     else np.ones(len(tbl), dtype=bool))
+                     else np.ones(ne, dtype=bool))
             flags = np.zeros(n, dtype=bool)
             flags[mid_e[emask]] = True
             uniq_mid = np.nonzero(flags)[0]

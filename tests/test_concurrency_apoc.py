@@ -1,0 +1,296 @@
+"""Concurrency adversarial tests for this round's machinery: atomic APOC
+writes, columnar degree/incidence caches racing mutations, the lock
+manager under contention, and plan-cache safety across threads.
+
+The HTTP server runs queries from a thread pool, so every one of these
+interleavings is reachable in production."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+
+
+def _executor():
+    ex = CypherExecutor(NamespacedEngine(MemoryEngine(), "conc"))
+    ex.enable_query_cache = False
+    return ex
+
+
+class TestAtomicUnderThreads:
+    def test_concurrent_atomic_add_loses_nothing(self):
+        ex = _executor()
+        ex.execute("CREATE (:Counter {id: 1, n: 0})")
+        n_threads, n_iter = 8, 25
+        errors = []
+
+        def worker():
+            try:
+                for _ in range(n_iter):
+                    ex.execute("MATCH (c:Counter {id:1}) "
+                               "RETURN apoc.atomic.add(c, 'n', 1)")
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        final = ex.execute(
+            "MATCH (c:Counter {id:1}) RETURN c.n").rows[0][0]
+        assert final == n_threads * n_iter  # no lost updates
+
+    def test_concurrent_cas_exactly_one_winner(self):
+        ex = _executor()
+        ex.execute("CREATE (:Flag {id: 1, state: 'free'})")
+        wins = []
+
+        def claim(tag):
+            r = ex.execute(
+                "MATCH (f:Flag {id:1}) RETURN "
+                "apoc.atomic.compareAndSwap(f, 'state', 'free', $t)",
+                {"t": tag}).rows[0][0]
+            if r:
+                wins.append(tag)
+
+        threads = [threading.Thread(target=claim, args=(f"t{i}",))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+        state = ex.execute(
+            "MATCH (f:Flag {id:1}) RETURN f.state").rows[0][0]
+        assert state == wins[0]
+
+
+class TestColumnarCachesUnderWrites:
+    def test_degree_pushdown_never_stale_under_interleaved_writes(self):
+        """Writers add KNOWS edges while readers run the degree-pushdown
+        aggregate; after the dust settles the aggregate must agree with
+        ground truth exactly."""
+        ex = _executor()
+        for i in range(20):
+            ex.execute("CREATE (:P {id: $i})", {"i": i})
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            try:
+                k = 0
+                while not stop.is_set() and k < 60:
+                    ex.execute(
+                        "MATCH (a:P {id:$a}), (b:P {id:$b}) "
+                        "CREATE (a)-[:KNOWS]->(b)",
+                        {"a": k % 20, "b": (k + 1) % 20})
+                    k += 1
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    r = ex.execute(
+                        "MATCH (p:P)-[:KNOWS]->(f:P) RETURN count(f)")
+                    assert r.rows[0][0] >= 0
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        threads[0].join()
+        stop.set()
+        for t in threads[1:]:
+            t.join()
+        assert not errors
+        fast = ex.execute(
+            "MATCH (p:P)-[:KNOWS]->(f:P) RETURN count(f)").rows[0][0]
+        slow_ex = CypherExecutor(ex.storage)
+        slow_ex.enable_fastpaths = False
+        slow_ex.enable_query_cache = False
+        truth = slow_ex.execute(
+            "MATCH (p:P)-[:KNOWS]->(f:P) RETURN count(f)").rows[0][0]
+        assert fast == truth == 60
+
+    def test_cooccurrence_consistent_after_racing_writes(self):
+        ex = _executor()
+        for t in range(6):
+            ex.execute("CREATE (:Tag {name: $n})", {"n": f"t{t}"})
+        for m in range(10):
+            ex.execute("CREATE (:Msg {id: $i})", {"i": m})
+
+        def tagger(offset):
+            for m in range(10):
+                ex.execute(
+                    "MATCH (m:Msg {id:$m}), (t:Tag {name:$t}) "
+                    "CREATE (m)-[:HAS]->(t)",
+                    {"m": m, "t": f"t{(m + offset) % 6}"})
+
+        threads = [threading.Thread(target=tagger, args=(o,))
+                   for o in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        q = ("MATCH (a:Tag)<-[:HAS]-(m:Msg)-[:HAS]->(b:Tag) "
+             "WHERE a <> b RETURN a.name, b.name, count(m)")
+        fast = sorted(map(repr, ex.execute(q).rows))
+        slow_ex = CypherExecutor(ex.storage)
+        slow_ex.enable_fastpaths = False
+        slow_ex.enable_query_cache = False
+        slow = sorted(map(repr, slow_ex.execute(q).rows))
+        assert fast == slow
+
+
+class TestCacheBuildersRacingWriters:
+    def test_degree_and_incidence_builders_never_crash(self):
+        """Hammer filtered_degree/incidence while a writer creates nodes
+        and edges: builders must never raise (torn src/dst pairs, masks
+        shorter than referenced rows) and final values must be exact."""
+        ex = _executor()
+        for t in range(4):
+            ex.execute("CREATE (:T {name: $n})", {"n": f"t{t}"})
+        errors = []
+        stop = threading.Event()
+
+        def writer():
+            try:
+                for m in range(40):
+                    ex.execute("CREATE (:M {id: $i})", {"i": m})
+                    ex.execute(
+                        "MATCH (m:M {id:$i}), (t:T {name:$t}) "
+                        "CREATE (m)-[:HAS]->(t)",
+                        {"i": m, "t": f"t{m % 4}"})
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    ex.columnar.filtered_degree("HAS", "out", "T")
+                    ex.columnar.incidence("HAS", "mid_src", "M", "T")
+                    ex.columnar.incidence("HAS", "mid_src", None, "T")
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        threads[0].join()
+        stop.set()
+        for t in threads[1:]:
+            t.join()
+        assert not errors, errors
+        deg = ex.columnar.filtered_degree("HAS", "out", "T")
+        assert int(deg.sum()) == 40
+        fast = ex.execute(
+            "MATCH (a:T)<-[:HAS]-(m:M)-[:HAS]->(b:T) "
+            "RETURN count(*)").rows[0][0]
+        slow_ex = CypherExecutor(ex.storage)
+        slow_ex.enable_fastpaths = False
+        slow_ex.enable_query_cache = False
+        truth = slow_ex.execute(
+            "MATCH (a:T)<-[:HAS]-(m:M)-[:HAS]->(b:T) "
+            "RETURN count(*)").rows[0][0]
+        assert fast == truth
+
+
+class TestLockManagerContention:
+    def test_mutual_exclusion_holds(self):
+        from nornicdb_tpu.query.apoc_admin import _LockManager
+
+        locks = _LockManager()
+        counter = {"n": 0}
+        errors = []
+
+        def worker():
+            try:
+                for _ in range(50):
+                    assert locks.acquire(["shared"], timeout=5.0)
+                    v = counter["n"]
+                    counter["n"] = v + 1  # not atomic without the lock
+                    locks.release(["shared"])
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert counter["n"] == 300
+        assert locks.stats()["held"] == 0  # everything released
+
+    def test_multi_key_acquire_no_deadlock(self):
+        """Two threads acquiring overlapping key sets in opposite call
+        order must not deadlock (keys are locked in total order)."""
+        from nornicdb_tpu.query.apoc_admin import _LockManager
+
+        locks = _LockManager()
+        done = []
+
+        def worker(keys):
+            for _ in range(30):
+                assert locks.acquire(keys, timeout=10.0)
+                locks.release(keys)
+            done.append(True)
+
+        t1 = threading.Thread(target=worker, args=(["a", "b", "c"],))
+        t2 = threading.Thread(target=worker, args=(["c", "b", "a"],))
+        t1.start()
+        t2.start()
+        t1.join(30.0)
+        t2.join(30.0)
+        assert len(done) == 2
+
+
+class TestPlanCacheThreadSafety:
+    def test_shared_ast_plan_under_concurrent_first_use(self):
+        """Many threads racing the first execution of the same query (the
+        point where the vectorized plan is attached to the shared AST)
+        must all get correct results."""
+        ex = _executor()
+        for i in range(30):
+            ex.execute("CREATE (:Q {id: $i, g: $g})",
+                       {"i": i, "g": i % 3})
+        for i in range(30):
+            ex.execute("MATCH (a:Q {id:$a}), (b:Q {id:$b}) "
+                       "CREATE (a)-[:R]->(b)",
+                       {"a": i, "b": (i + 7) % 30})
+        results = []
+        errors = []
+        barrier = threading.Barrier(6)
+        query = "MATCH (q:Q)-[:R]->(x:Q) RETURN q.g, count(x)"
+
+        def worker():
+            try:
+                barrier.wait(10.0)
+                r = ex.execute(query)
+                results.append(sorted(map(repr, r.rows)))
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert all(r == results[0] for r in results)
+        slow_ex = CypherExecutor(ex.storage)
+        slow_ex.enable_fastpaths = False
+        slow_ex.enable_query_cache = False
+        truth = sorted(map(repr, slow_ex.execute(query).rows))
+        assert results[0] == truth
