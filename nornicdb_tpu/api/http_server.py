@@ -332,6 +332,12 @@ class HttpServer:
 
         if parsed.path == "/status":
             return 200, self._status()
+        if parsed.path == "/debug/profile" and method == "POST":
+            # pprof analog (reference keeps pprof routes behind a build
+            # flag, server_router.go:302-314): profile one statement and
+            # return the hottest frames. Admin-only.
+            self.authorize(username, self.default_database, ADMIN)
+            return self._debug_profile(payload)
 
         # Neo4j transactional HTTP API: /db/{name}/tx[/commit|/{txid}...]
         if segments[:1] == ["db"] and len(segments) >= 3:
@@ -379,6 +385,50 @@ class HttpServer:
             pass
         return out
 
+    def _debug_profile(self, payload: Dict[str, Any]) -> Tuple[int, Any]:
+        """Run one Cypher statement under cProfile; return wall time and
+        the top frames by cumulative time."""
+        import cProfile
+        import pstats
+
+        statement = str(payload.get("statement") or "")
+        if not statement:
+            return 400, {"error": "statement required"}
+        params = payload.get("parameters") or {}
+        try:
+            repeat = int(payload.get("repeat", 1))
+        except (TypeError, ValueError):
+            return 400, {"error": "repeat must be an integer"}
+        repeat = max(1, min(repeat, 1000))
+        executor = self.db.executor
+        prof = cProfile.Profile()
+        t0 = time.perf_counter()
+        prof.enable()
+        try:
+            for _ in range(repeat):
+                result = executor.execute(statement, params)
+        finally:
+            prof.disable()
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        stats = pstats.Stats(prof)
+        frames = []
+        for func, (cc, nc, tt, ct, _callers) in sorted(
+                stats.stats.items(), key=lambda kv: -kv[1][3])[:25]:
+            filename, line, name = func
+            frames.append({
+                "function": f"{filename.rsplit('/', 1)[-1]}:{line}({name})",
+                "calls": nc,
+                "tottime_ms": round(tt * 1e3, 3),
+                "cumtime_ms": round(ct * 1e3, 3),
+            })
+        return 200, {
+            "statement": statement,
+            "repeat": repeat,
+            "wall_ms": round(wall_ms, 3),
+            "rows": result.n_rows,
+            "top_frames": frames,
+        }
+
     def _status(self) -> Dict[str, Any]:
         dbs: List[str] = [self.default_database]
         if self.database_manager is not None:
@@ -396,6 +446,10 @@ class HttpServer:
                 "indexed_vectors": svc.stats.indexed_vectors,
                 "strategy": svc.stats.strategy,
             }
+            if svc.stats.last_timings:  # NORNICDB_TPU_SEARCH_DIAG set
+                doc["search"]["last_timings_ms"] = {
+                    k: round(v, 3) for k, v in svc.stats.last_timings.items()
+                }
         return doc
 
     def _login(self, payload: Dict[str, Any]) -> Tuple[int, Any]:

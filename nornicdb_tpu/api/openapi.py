@@ -52,6 +52,13 @@ def openapi_spec() -> Dict[str, Any]:
             "/status": {"get": op("Server status + search stats", "ops")},
             "/metrics": {"get": op("Prometheus metrics", "ops")},
             "/openapi.json": {"get": op("This document", "ops")},
+            "/debug/profile": {"post": op(
+                "Profile one Cypher statement (admin)", "ops",
+                request={"type": "object", "properties": {
+                    "statement": {"type": "string"},
+                    "parameters": {"type": "object"},
+                    "repeat": {"type": "integer"}}},
+                response={"type": "object"})},
             "/auth/login": {"post": op(
                 "Exchange credentials for a JWT", "auth",
                 request={"type": "object", "properties": {

@@ -10,6 +10,7 @@ BM25-seeded build.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -65,6 +66,10 @@ class SearchStats:
     strategy: str = "brute"
     searches: int = 0
     hnsw_builds: int = 0
+    # per-stage timings of the most recent search, populated when
+    # NORNICDB_TPU_SEARCH_DIAG is set (reference:
+    # NORNICDB_SEARCH_DIAG_TIMINGS)
+    last_timings: Dict[str, float] = field(default_factory=dict)
 
 
 class SearchService:
@@ -420,11 +425,20 @@ class SearchService:
         """Hybrid search (reference: Service.Search search.go:2841):
         BM25 + vector candidate lists fused with RRF, enriched from storage."""
         self.stats.searches += 1
+        # opt-in per-stage timing diagnostics (reference:
+        # NORNICDB_SEARCH_DIAG_TIMINGS, server_nornicdb.go:282-286);
+        # recorded on stats.last_timings for /status and log inspection
+        diag = bool(os.environ.get("NORNICDB_TPU_SEARCH_DIAG"))
+        timings: Dict[str, float] = {}
+        t0 = time.perf_counter() if diag else 0.0
         overfetch = max(limit * 3, 30)
         bm25_hits: List[Tuple[str, float]] = []
         vec_hits: List[Tuple[str, float]] = []
         if mode in ("hybrid", "text") and query:
             bm25_hits = self.bm25.search(query, overfetch)
+        if diag:
+            timings["bm25_ms"] = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
         qv = None
         if mode in ("hybrid", "vector"):
             qv = (
@@ -432,11 +446,17 @@ class SearchService:
                 if query_embedding is not None
                 else (self._query_embedding(query) if query.strip() else None)
             )
+            if diag:
+                timings["embed_ms"] = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
             if qv is not None and len(self.vectors) > 0:
                 vec_hits = self.vector_search_candidates(
                     qv, overfetch,
                     lexical_doc_ids=[d for d, _ in bm25_hits[:32]],
                 )
+            if diag:
+                timings["vector_ms"] = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
 
         if bm25_hits and vec_hits:
             fused = rrf_fuse([bm25_hits, vec_hits], limit=overfetch)
@@ -444,6 +464,9 @@ class SearchService:
             fused = bm25_hits[:overfetch]
         else:
             fused = vec_hits[:overfetch]
+        if diag:
+            timings["fuse_ms"] = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
 
         bm = dict(bm25_hits)
         vs = dict(vec_hits)
@@ -485,4 +508,7 @@ class SearchService:
                                            query_embedding=qv)
             except Exception:
                 out = out[:limit]  # fail-open (reference: llm_rerank.go)
+        if diag:
+            timings["enrich_rerank_ms"] = (time.perf_counter() - t0) * 1e3
+            self.stats.last_timings = timings
         return out[:limit]

@@ -162,3 +162,85 @@ class TestOpenApiDocs:
         parser = _build_parser()
         args = parser.parse_args(["oauth-provider", "--port", "0"])
         assert args.command == "oauth-provider" and args.port == 0
+
+
+class TestDiagnostics:
+    def test_search_stage_timings_opt_in(self, monkeypatch):
+        import nornicdb_tpu
+
+        monkeypatch.setenv("NORNICDB_TPU_SEARCH_DIAG", "1")
+        db = nornicdb_tpu.open()
+        try:
+            db.store("the capital of norway is oslo", node_id="a")
+            db.flush()
+            assert db.recall("oslo")
+            t = db.search.stats.last_timings
+            assert {"bm25_ms", "fuse_ms", "enrich_rerank_ms"} <= set(t)
+            assert all(v >= 0 for v in t.values())
+        finally:
+            db.close()
+
+    def test_search_timings_absent_by_default(self, monkeypatch):
+        import nornicdb_tpu
+
+        monkeypatch.delenv("NORNICDB_TPU_SEARCH_DIAG", raising=False)
+        db = nornicdb_tpu.open()
+        try:
+            db.store("bergen by the fjord", node_id="b")
+            db.flush()
+            db.recall("fjord")
+            assert db.search.stats.last_timings == {}
+        finally:
+            db.close()
+
+    def test_debug_profile_endpoint(self):
+        import nornicdb_tpu
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        db = nornicdb_tpu.open()
+        db.cypher("CREATE (:X {id: 1})-[:R]->(:X {id: 2})")
+        srv = HttpServer(db, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = json.dumps({
+                "statement": "MATCH (x:X)-[:R]->(y:X) RETURN count(y)",
+                "repeat": 20}).encode()
+            req = urllib.request.Request(
+                f"{base}/debug/profile", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=15) as r:
+                out = json.loads(r.read())
+            assert out["repeat"] == 20 and out["rows"] == 1
+            assert out["wall_ms"] > 0
+            assert any("execute" in f["function"]
+                       for f in out["top_frames"])
+            # missing statement -> 400, not a crash
+            req2 = urllib.request.Request(
+                f"{base}/debug/profile", data=b"{}",
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req2, timeout=15)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            # repeat <= 0 clamps to 1; non-integer repeat -> 400
+            body3 = json.dumps({"statement": "RETURN 1",
+                                "repeat": 0}).encode()
+            req3 = urllib.request.Request(
+                f"{base}/debug/profile", data=body3,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req3, timeout=15) as r:
+                assert json.loads(r.read())["repeat"] == 1
+            body4 = json.dumps({"statement": "RETURN 1",
+                                "repeat": "abc"}).encode()
+            req4 = urllib.request.Request(
+                f"{base}/debug/profile", data=body4,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req4, timeout=15)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.stop()
+            db.close()
