@@ -391,6 +391,8 @@ class HttpServer:
         import cProfile
         import pstats
 
+        if not isinstance(payload, dict):
+            return 400, {"error": "JSON object body required"}
         statement = str(payload.get("statement") or "")
         if not statement:
             return 400, {"error": "statement required"}
@@ -407,6 +409,10 @@ class HttpServer:
         try:
             for _ in range(repeat):
                 result = executor.execute(statement, params)
+        except Exception as exc:
+            prof.disable()
+            # caller's statement failed: client error, not a server fault
+            return 400, {"error": f"{type(exc).__name__}: {exc}"[:400]}
         finally:
             prof.disable()
         wall_ms = (time.perf_counter() - t0) * 1e3

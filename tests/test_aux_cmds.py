@@ -193,6 +193,25 @@ class TestDiagnostics:
         finally:
             db.close()
 
+    def test_search_diag_zero_means_off_and_stale_cleared(
+            self, monkeypatch):
+        import nornicdb_tpu
+
+        db = nornicdb_tpu.open()
+        try:
+            db.store("stavanger oil town", node_id="s")
+            db.flush()
+            monkeypatch.setenv("NORNICDB_TPU_SEARCH_DIAG", "1")
+            db.recall("oil")
+            assert db.search.stats.last_timings
+            # "0" disables (env-flag convention), and stale timings are
+            # cleared on the next search rather than served forever
+            monkeypatch.setenv("NORNICDB_TPU_SEARCH_DIAG", "0")
+            db.recall("oil")
+            assert db.search.stats.last_timings == {}
+        finally:
+            db.close()
+
     def test_debug_profile_endpoint(self):
         import nornicdb_tpu
         from nornicdb_tpu.api.http_server import HttpServer
@@ -238,6 +257,16 @@ class TestDiagnostics:
                 headers={"Content-Type": "application/json"})
             try:
                 urllib.request.urlopen(req4, timeout=15)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            # syntactically invalid statement -> client error, not 500
+            body5 = json.dumps({"statement": "MATCH ("}).encode()
+            req5 = urllib.request.Request(
+                f"{base}/debug/profile", data=body5,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req5, timeout=15)
                 assert False, "expected 400"
             except urllib.error.HTTPError as e:
                 assert e.code == 400

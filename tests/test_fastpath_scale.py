@@ -1,0 +1,115 @@
+"""Fast paths at 10x bench scale: parity against the general executor
+holds, the dense/sparse strategy switches and incidence budgets engage,
+and throughput stays in the fast-path regime (orders of magnitude above
+the row interpreter, asserted loosely to stay robust on shared CI)."""
+
+import random
+import time
+import uuid
+
+import pytest
+
+from nornicdb_tpu.query.executor import CypherExecutor
+from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+@pytest.fixture(scope="module")
+def big_graph():
+    eng = NamespacedEngine(MemoryEngine(), "scale")
+    rng = random.Random(29)
+
+    def add_node(labels, props):
+        n = Node(id=str(uuid.uuid4()), labels=labels, properties=props)
+        eng.create_node(n)
+        return n.id
+
+    def add_edge(etype, a, b):
+        eng.create_edge(Edge(id=str(uuid.uuid4()), type=etype,
+                             start_node=a, end_node=b, properties={}))
+
+    cities = [add_node(["City"], {"name": f"c{i}"}) for i in range(40)]
+    tags = [add_node(["Tag"], {"name": f"t{i}"}) for i in range(300)]
+    people = [add_node(["Person"], {"id": i}) for i in range(10_000)]
+    for i, pid in enumerate(people):
+        add_edge("LOC", pid, cities[i % 40])
+        for j in rng.sample(range(10_000), 5):
+            if j != i:
+                add_edge("KNOWS", pid, people[j])
+    for m in range(5_000):
+        mid = add_node(["Msg"], {"id": m})
+        for t in rng.sample(range(300), 2):
+            add_edge("TAG", mid, tags[t])
+    return eng
+
+
+def _both(eng, query):
+    fast = CypherExecutor(eng)
+    fast.enable_query_cache = False
+    slow = CypherExecutor(eng)
+    slow.enable_query_cache = False
+    slow.enable_fastpaths = False
+    rf = fast.execute(query)
+    rs = slow.execute(query)
+    assert sorted(map(repr, rf.rows)) == sorted(map(repr, rs.rows))
+    return fast
+
+
+def test_degree_pushdown_parity_and_speed(big_graph):
+    q = ("MATCH (c:City)<-[:LOC]-(p:Person)-[:KNOWS]->(f:Person) "
+         "RETURN c.name, count(f)")
+    ex = _both(big_graph, q)
+    ex.execute(q)  # caches warm
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 1.0:
+        ex.execute(q)
+        n += 1
+    qps = n / (time.perf_counter() - t0)
+    # the row interpreter manages ~1/s on this shape at this scale; the
+    # pushdown path must stay orders of magnitude above it
+    assert qps > 50, qps
+
+
+def test_cooccurrence_parity_at_scale(big_graph):
+    q = ("MATCH (a:Tag)<-[:TAG]-(m:Msg)-[:TAG]->(b:Tag) "
+         "WHERE a <> b RETURN a.name, b.name, count(m)")
+    ex = _both(big_graph, q)
+    # 300 tags x ~5k messages stays inside the incidence budget
+    inc = ex.columnar.incidence("TAG", "mid_src", "Msg", "Tag")
+    assert inc is not None
+    assert inc[0].shape[1] == 300
+
+
+def test_incidence_budget_falls_back_not_wrong(big_graph):
+    """Force the dense budget to zero: the matmul path must bow out and
+    the join expansion must still return identical results."""
+    from nornicdb_tpu.query.columnar import ColumnarCatalog
+
+    old = ColumnarCatalog.INCIDENCE_MAX_CELLS
+    ColumnarCatalog.INCIDENCE_MAX_CELLS = 1
+    try:
+        q = ("MATCH (a:Tag)<-[:TAG]-(m:Msg)-[:TAG]->(b:Tag) "
+             "WHERE a <> b RETURN count(*)")
+        fast = CypherExecutor(big_graph)
+        fast.enable_query_cache = False
+        slow = CypherExecutor(big_graph)
+        slow.enable_query_cache = False
+        slow.enable_fastpaths = False
+        assert fast.execute(q).rows == slow.execute(q).rows
+    finally:
+        ColumnarCatalog.INCIDENCE_MAX_CELLS = old
+
+
+def test_point_lookup_stays_fast_at_scale(big_graph):
+    ex = CypherExecutor(big_graph)
+    ex.enable_query_cache = False
+    q = "MATCH (p:Person {id: $i}) RETURN p.id"
+    assert ex.execute(q, {"i": 9_999}).rows == [[9_999]]
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 1.0:
+        ex.execute(q, {"i": n % 10_000})
+        n += 1
+    qps = n / (time.perf_counter() - t0)
+    assert qps > 2_000, qps  # hash-index lookups, not label scans
