@@ -410,8 +410,8 @@ class HttpServer:
             for _ in range(repeat):
                 result = executor.execute(statement, params)
         except Exception as exc:
-            prof.disable()
-            # caller's statement failed: client error, not a server fault
+            # caller's statement failed: client error, not a server
+            # fault (the finally disables the profiler)
             return 400, {"error": f"{type(exc).__name__}: {exc}"[:400]}
         finally:
             prof.disable()

@@ -237,6 +237,12 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
     if plan is None:
         return None
 
+    point = plan["point"]
+    if point is not None:
+        r = _exec_point(catalog, point, plan, ctx, CypherResult)
+        if r is not None:
+            return r
+
     strip, cooc = plan["strip"], plan["cooc"]
     if strip is not None:
         b = _exec_strip(catalog, strip, ctx)
@@ -293,10 +299,78 @@ def _analyze_vectorized(q: A.Query) -> Optional[Dict[str, Any]]:
         "where_conjs": _split_and(m.where) if m.where is not None else [],
         "strip": strip,
         "cooc": cooc,
+        "point": _analyze_point(path, m, ret) if not has_agg else None,
         "cols": cols,
         "agg_flags": agg_flags,
         "has_agg": has_agg,
     }
+
+
+def _analyze_point(path: A.PatternPath, m: A.MatchClause,
+                   ret: A.ReturnClause) -> Optional[Dict[str, Any]]:
+    """Compiled point lookup: MATCH (x:L {k: $p}) RETURN x.a, x.b — the
+    reference's indexed-access hot path (LDBC message content lookup,
+    storage_fastpaths.go). Per-execution work reduces to one hash-index
+    probe plus per-hit property reads; the generic chain machinery
+    (candidate arrays, bindings, projection arrays) is skipped."""
+    if len(path.nodes) != 1 or path.rels or m.where is not None:
+        return None
+    pn = path.nodes[0]
+    if not pn.var or len(pn.labels) != 1 or pn.props is None:
+        return None
+    items = list(pn.props.items)
+    if len(items) != 1:
+        return None
+    key, vexpr = items[0]
+    if not isinstance(vexpr, (A.Literal, A.Param)):
+        return None
+    if ret.distinct or ret.order_by or ret.skip or ret.limit:
+        return None
+    projections = []  # (kind, prop-or-None) per RETURN item
+    for item in ret.items:
+        e = item.expr
+        if isinstance(e, A.Var) and e.name == pn.var:
+            projections.append(("node", None))
+        elif (isinstance(e, A.Prop) and isinstance(e.target, A.Var)
+                and e.target.name == pn.var):
+            projections.append(("prop", e.name))
+        else:
+            return None
+    return {
+        "label": pn.labels[0],
+        "key": key,
+        "vexpr": vexpr,
+        "projections": projections,
+    }
+
+
+def _exec_point(catalog, point: Dict[str, Any], plan: Dict[str, Any],
+                ctx, CypherResult):
+    vexpr = point["vexpr"]
+    if isinstance(vexpr, A.Param):
+        if vexpr.name not in ctx.params:
+            return None  # let the general path raise the proper error
+        value = ctx.params[vexpr.name]
+    else:
+        value = vexpr.value
+    if isinstance(value, (list, dict)):
+        return None  # unhashable key: general path semantics
+    hit = catalog.prop_index(point["label"], point["key"]).get(value)
+    if hit is None:
+        return CypherResult(columns=plan["cols"], rows=[])
+    rows_idx = hit.tolist()
+    nodes = catalog.nodes()
+    if isinstance(value, bool) or value in (0, 1):
+        rows_idx = _rows_matching_bool_type(
+            nodes, rows_idx, point["key"], value)
+    cols_out = []
+    for kind, prop in point["projections"]:
+        if kind == "node":
+            cols_out.append([nodes[i] for i in rows_idx])
+        else:
+            cols_out.append(
+                [nodes[i].properties.get(prop) for i in rows_idx])
+    return CypherResult(columns=plan["cols"], col_data=cols_out)
 
 
 # -- aggregation pushdown shapes ------------------------------------------
@@ -544,10 +618,16 @@ def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
                 # point lookup via the hash property index (reference:
                 # LDBC message-content-lookup path, storage_fastpaths.go)
                 k0, vexpr0 = items[0]
-                hit = catalog.prop_index(pn.labels[0], k0).get(
-                    _const_value(vexpr0, ctx)
-                )
+                v0 = _const_value(vexpr0, ctx)
+                if isinstance(v0, (list, dict)):
+                    _bail()  # unhashable probe: general path semantics
+                hit = catalog.prop_index(pn.labels[0], k0).get(v0)
                 hit = hit if hit is not None else np.empty(0, np.int32)
+                if len(hit) and (isinstance(v0, bool) or v0 in (0, 1)):
+                    hit = np.asarray(
+                        _rows_matching_bool_type(
+                            catalog.nodes(), hit.tolist(), k0, v0),
+                        dtype=np.int32)
                 mask = catalog.label_mask(pn.labels[0])  # noqa: F841 (built)
                 rows = (
                     np.intersect1d(rows, hit).astype(np.int32)
@@ -637,6 +717,15 @@ def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
         )
     b.n_rows = len(slot_cols[anchor]) if slot_cols[anchor] is not None else 0
     return b
+
+
+def _rows_matching_bool_type(nodes, rows_idx, key, value):
+    """dict keys conflate True/1 and False/0; Cypher treats bool and int
+    as distinct. Filter hash-index hits to rows whose stored value has
+    the same bool-ness as the probe value."""
+    want_bool = isinstance(value, bool)
+    return [i for i in rows_idx
+            if isinstance(nodes[i].properties.get(key), bool) == want_bool]
 
 
 def _const_value(e: A.Expr, ctx) -> Any:

@@ -428,7 +428,9 @@ class SearchService:
         # opt-in per-stage timing diagnostics (reference:
         # NORNICDB_SEARCH_DIAG_TIMINGS, server_nornicdb.go:282-286);
         # recorded on stats.last_timings for /status and log inspection
-        diag = os.environ.get("NORNICDB_TPU_SEARCH_DIAG", "") not in ("", "0", "false")
+        from nornicdb_tpu.config import env_bool
+
+        diag = env_bool("TPU_SEARCH_DIAG")
         if not diag and self.stats.last_timings:
             self.stats.last_timings = {}  # never serve stale timings
         timings: Dict[str, float] = {}

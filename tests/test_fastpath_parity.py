@@ -192,6 +192,57 @@ def test_parity(graph, query, params, ordered):
         assert _sorted_rows(fast) == _sorted_rows(slow)
 
 
+def test_point_lookup_plan_compiles(graph):
+    """The flagship point-lookup shape must take the compiled plan, and
+    its edge cases must match the general path."""
+    from nornicdb_tpu.query import fastpaths
+    from nornicdb_tpu.query.parser import parse
+
+    q = parse("MATCH (p:Person {id: $i}) RETURN p.name").parts[0]
+    plan = fastpaths._analyze_vectorized(q)
+    assert plan is not None and plan["point"] is not None
+
+    fast = CypherExecutor(graph)
+    fast.enable_query_cache = False
+    slow = CypherExecutor(graph)
+    slow.enable_query_cache = False
+    slow.enable_fastpaths = False
+    for params in ({"i": 0}, {"i": 59}, {"i": -1}, {"i": "0"},
+                   {"i": None}, {"i": True}):
+        qq = "MATCH (p:Person {id: $i}) RETURN p.name"
+        assert fast.execute(qq, params).rows == \
+            slow.execute(qq, params).rows, params
+    # whole-node projection and aliasing
+    qq2 = "MATCH (p:Person {id: $i}) RETURN p, p.age AS a"
+    rf = fast.execute(qq2, {"i": 3})
+    rs = slow.execute(qq2, {"i": 3})
+    assert rf.columns == rs.columns
+    assert rf.rows[0][0].id == rs.rows[0][0].id
+    assert rf.rows[0][1] == rs.rows[0][1]
+    # shapes the compiled plan must NOT claim (ORDER BY, multi-prop)
+    q3 = parse("MATCH (p:Person {id: $i}) RETURN p.name "
+               "ORDER BY p.name").parts[0]
+    p3 = fastpaths._analyze_vectorized(q3)
+    assert p3 is None or p3["point"] is None
+    q4 = parse("MATCH (p:Person {id: $i, name: $n}) "
+               "RETURN p.name").parts[0]
+    p4 = fastpaths._analyze_vectorized(q4)
+    assert p4 is None or p4["point"] is None
+
+
+def test_point_lookup_sees_writes(graph):
+    eng = NamespacedEngine(MemoryEngine(), "pointw")
+    ex = CypherExecutor(eng)
+    ex.enable_query_cache = False
+    ex.execute("CREATE (:M {id: 1, v: 'a'})")
+    q = "MATCH (m:M {id: $i}) RETURN m.v"
+    assert ex.execute(q, {"i": 1}).rows == [["a"]]
+    ex.execute("MATCH (m:M {id: 1}) SET m.v = 'b'")
+    assert ex.execute(q, {"i": 1}).rows == [["b"]]
+    ex.execute("CREATE (:M {id: 2, v: 'c'})")
+    assert ex.execute(q, {"i": 2}).rows == [["c"]]
+
+
 def test_fastpath_actually_triggers(graph):
     """Guard against silently falling back to the general path for the
     flagship shapes (the corpus above would still pass)."""
