@@ -73,7 +73,10 @@ class JaxEncoderEmbedder:
         from nornicdb_tpu.models.encoder import Encoder, EncoderConfig
 
         if cfg is None:
-            cfg = EncoderConfig()
+            from nornicdb_tpu.models.encoder import flash_attention_enabled
+
+            cfg = EncoderConfig(
+                use_flash_attention=flash_attention_enabled())
         if model is None:
             model = Encoder(cfg)
         if params is None:

@@ -39,6 +39,11 @@ class EncoderConfig:
     # when a mesh with sp > 1 is attached, attention routes through ring
     # attention (sequence-sharded, no [S, S] materialization)
     mesh: Any = None
+    # single-chip fused Pallas attention; resolved at CONSTRUCTION by the
+    # inference stack (never set for training: the kernel has no vjp, and
+    # never combined with a multi-device mesh: pallas_call has no GSPMD
+    # partitioning rule)
+    use_flash_attention: bool = False
 
     @staticmethod
     def tiny() -> "EncoderConfig":
@@ -68,6 +73,17 @@ def _maybe_shard(x: jnp.ndarray, cfg: EncoderConfig, spec: P) -> jnp.ndarray:
         raise
 
 
+def flash_attention_enabled() -> bool:
+    """Opt-in fused Pallas attention (NORNICDB_PALLAS_ATTENTION=1). Off
+    by default for the same reason as the top-k kernel: interpret mode
+    is test-only and real-TPU validation gates enabling it broadly.
+    Consumed at encoder CONSTRUCTION by the inference embedder; the
+    training path never opts in (the kernel has no vjp)."""
+    import os
+
+    return os.environ.get("NORNICDB_PALLAS_ATTENTION", "0") == "1"
+
+
 class MultiHeadAttention(nn.Module):
     cfg: EncoderConfig
 
@@ -94,6 +110,14 @@ class MultiHeadAttention(nn.Module):
                 q, k, v, mask, mesh=cfg.mesh,
                 axis_name="sp", batch_axis="dp", head_axis="tp",
             )
+        elif cfg.use_flash_attention and cfg.mesh is None:
+            # fused Pallas path: blockwise online-softmax attention, no
+            # [S, S] HBM matrix (ops/pallas_attention.py). Construction-
+            # time opt-in for single-chip inference only — no vjp, and
+            # no GSPMD partitioning rule for the custom call.
+            from nornicdb_tpu.ops.pallas_attention import flash_attention
+
+            out = flash_attention(q, k, v, mask)
         else:
             k = _maybe_shard(k, cfg, P("dp", None, "tp", None))
             v = _maybe_shard(v, cfg, P("dp", None, "tp", None))
