@@ -256,6 +256,9 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
     for conj in plan["where_conjs"]:
         b.take(_vec_predicate(conj, b, catalog, ctx))
 
+    if plan.get("pipeline") is not None:
+        return _exec_with_pipeline(executor, catalog, plan, ctx, b,
+                                   CypherResult)
     return _project(executor, catalog, plan["ret"], b, ctx, CypherResult, plan)
 
 
@@ -264,6 +267,8 @@ def _analyze_vectorized(q: A.Query) -> Optional[Dict[str, Any]]:
     from nornicdb_tpu.query.executor import _contains_agg
 
     clauses = q.clauses
+    if len(clauses) == 3:
+        return _analyze_with_pipeline(q)
     if len(clauses) != 2:
         return None
     m, ret = clauses[0], clauses[1]
@@ -371,6 +376,225 @@ def _exec_point(catalog, point: Dict[str, Any], plan: Dict[str, Any],
             cols_out.append(
                 [nodes[i].properties.get(prop) for i in rows_idx])
     return CypherResult(columns=plan["cols"], col_data=cols_out)
+
+
+def _analyze_with_pipeline(q: A.Query) -> Optional[Dict[str, Any]]:
+    """MATCH chain -> WITH group/aggregate [WHERE] -> RETURN [ORDER BY
+    SKIP LIMIT]: the top-N-groups family (reference serves these through
+    the same optimized executors; e.g. "top posters", "most-used tags").
+    The WITH stage reuses the chain aggregation machinery; the RETURN
+    stage projects only WITH outputs, so the whole pipeline stays
+    columnar."""
+    from nornicdb_tpu.query.executor import _contains_agg
+
+    m, w, ret = q.clauses
+    if not (isinstance(m, A.MatchClause) and isinstance(w, A.WithClause)
+            and isinstance(ret, A.ReturnClause)):
+        return None
+    if m.optional or len(m.paths) != 1 or ret.star or w.star:
+        return None
+    if ret.distinct:
+        return None  # post-aggregate dedup: general path
+    if w.distinct or w.order_by or w.skip is not None or w.limit is not None:
+        return None  # WITH-level ordering/dedup: general path
+    path = m.paths[0]
+    if not _path_supported(path, set()):
+        return None
+
+    w_flags = [_contains_agg(i.expr) for i in w.items]
+    if not any(w_flags):
+        return None  # pure projection WITH adds nothing here
+    w_names: List[str] = []
+    for item in w.items:
+        if item.alias:
+            w_names.append(item.alias)
+        elif isinstance(item.expr, A.Var):
+            w_names.append(item.expr.name)
+        else:
+            return None  # non-var WITH items must be aliased to be usable
+    if len(set(w_names)) != len(w_names):
+        return None
+
+    # RETURN may reference only WITH outputs (Var or Prop-on-node-var);
+    # no second aggregation stage
+    known = set(w_names)
+    ret_cols: List[str] = []
+    for item in ret.items:
+        e = item.expr
+        if _contains_agg(e):
+            return None
+        if isinstance(e, A.Var) and e.name in known:
+            ret_cols.append(item.alias or e.name)
+        elif (isinstance(e, A.Prop) and isinstance(e.target, A.Var)
+                and e.target.name in known):
+            ret_cols.append(item.alias or f"{e.target.name}.{e.name}")
+        else:
+            return None
+    for expr, _desc in ret.order_by or []:
+        if not _order_expr_known(expr, known, ret):
+            return None
+
+    strip = _analyze_strip(path, m, w)
+    cooc = None if strip is not None else _analyze_cooc(path, m, w)
+    return {
+        "pipeline": {
+            "w": w,
+            "w_flags": w_flags,
+            "w_names": w_names,
+            "ret": ret,
+            "ret_cols": ret_cols,
+        },
+        "m": m,
+        "ret": ret,
+        "path": path,
+        "where_conjs": _split_and(m.where) if m.where is not None else [],
+        "strip": strip,
+        "cooc": cooc,
+        "point": None,
+        "cols": ret_cols,
+        "agg_flags": [False] * len(ret.items),
+        "has_agg": True,
+    }
+
+
+def _order_expr_known(expr: A.Expr, known: set, ret: A.ReturnClause) -> bool:
+    if isinstance(expr, A.Var):
+        if expr.name in known:
+            return True
+        return any(item.alias == expr.name for item in ret.items)
+    if isinstance(expr, A.Prop) and isinstance(expr.target, A.Var):
+        return expr.target.name in known
+    return False
+
+
+def _exec_with_pipeline(executor, catalog, plan, ctx, b, CypherResult):
+    """Stage 2+3 of the WITH pipeline over computed chain bindings."""
+    pipe = plan["pipeline"]
+    w = pipe["w"]
+
+    with_cols = _aggregate(catalog, w, b, ctx, {"agg_flags": pipe["w_flags"]})
+    named = dict(zip(pipe["w_names"], with_cols))
+
+    # WITH ... WHERE over aggregated columns
+    if w.where is not None:
+        n = len(with_cols[0]) if with_cols else 0
+        mask = np.ones(n, dtype=bool)
+        for conj in _split_and(w.where):
+            mask &= _named_predicate(
+                conj, lambda e: _resolve_named(named, catalog, e), ctx)
+        named = {k: v[mask] for k, v in named.items()}
+
+    out_cols = [_resolve_named(named, catalog, item.expr)
+                for item in pipe["ret"].items]
+
+    ret = pipe["ret"]
+    cols = pipe["ret_cols"]
+    if ret.order_by:
+        keys = []
+        for expr, desc in ret.order_by:
+            col = _resolve_order(expr, named, catalog, ret, cols, out_cols)
+            keys.append((col, desc))
+        order = _order_from_keys(keys, len(out_cols[0]) if out_cols else 0)
+        out_cols = [c[order] for c in out_cols]
+    if ret.skip is not None:
+        k = int(_const_value(ret.skip, ctx))
+        out_cols = [c[k:] for c in out_cols]
+    if ret.limit is not None:
+        k = int(_const_value(ret.limit, ctx))
+        out_cols = [c[:k] for c in out_cols]
+
+    py_cols: List[List[Any]] = []
+    for col in out_cols:
+        lst = col.tolist()
+        if lst and isinstance(lst[0], _NodeRef):
+            nodes = catalog.nodes()
+            lst = [nodes[v.row] for v in lst]
+        py_cols.append(lst)
+    if not py_cols:
+        return CypherResult(columns=cols, rows=[])
+    return CypherResult(columns=cols, col_data=py_cols)
+
+
+def _resolve_named(named, catalog, e: A.Expr) -> np.ndarray:
+    """Column for an expression over the WITH output table: a named
+    column directly, or a property gathered over a NodeRef column via
+    the catalog's vectorized property columns."""
+    if isinstance(e, A.Var) and e.name in named:
+        return named[e.name]
+    if (isinstance(e, A.Prop) and isinstance(e.target, A.Var)
+            and e.target.name in named):
+        col = named[e.target.name]
+        if len(col) == 0:
+            return np.empty(0, dtype=object)
+        if not isinstance(col[0], _NodeRef):
+            _bail()
+        rows = np.fromiter((ref.row for ref in col.tolist()),
+                           dtype=np.int64, count=len(col))
+        return catalog.node_prop_col(e.name)[rows]
+    _bail()
+
+
+def _resolve_order(expr, named, catalog, ret, cols, out_cols) -> np.ndarray:
+    if isinstance(expr, A.Var) and expr.name in cols:
+        return out_cols[cols.index(expr.name)]
+    return _resolve_named(named, catalog, expr)
+
+
+def _order_from_keys(keys, n: int) -> np.ndarray:
+    """Row order for (column, desc) sort keys: numeric lexsort lane with
+    Neo4j null-last-ASC semantics (null -> +inf BEFORE desc negation),
+    falling back to a stable _cypher_cmp python sort for mixed types."""
+    float_keys = []
+    for col, desc in keys:
+        f = _as_float(col) if col.dtype == object else (
+            col.astype(np.float64), np.ones(len(col), bool))
+        if f is None:
+            from nornicdb_tpu.query.executor import _cypher_cmp
+            import functools as _ft
+
+            idx = list(range(n))
+
+            def cmp(a, bx):
+                for c, d in keys:
+                    va, vb = c[a], c[bx]
+                    if isinstance(va, _NodeRef) or isinstance(vb, _NodeRef):
+                        _bail()
+                    r = _cypher_cmp(va, vb)
+                    if r != 0:
+                        return -r if d else r
+                return 0
+
+            idx.sort(key=_ft.cmp_to_key(cmp))
+            return np.asarray(idx, dtype=np.int64)
+        vals, maskv = f
+        vals = np.where(maskv, vals, np.inf)
+        float_keys.append(-vals if desc else vals)
+    if not float_keys:
+        return np.arange(n)
+    return np.lexsort(list(reversed(float_keys)))
+
+
+def _named_predicate(e: A.Expr, resolve, ctx) -> np.ndarray:
+    """WHERE conjunct over named aggregate columns."""
+    if isinstance(e, A.Binary) and e.op in ("=", "<>", "<", "<=", ">", ">="):
+        lconst = _is_const(e.left)
+        rconst = _is_const(e.right)
+        if lconst and rconst:
+            _bail()
+        if lconst:
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+            return _vec_cmp_const(resolve(e.right),
+                                  flip.get(e.op, e.op),
+                                  _const_value(e.left, ctx))
+        if rconst:
+            return _vec_cmp_const(resolve(e.left), e.op,
+                                  _const_value(e.right, ctx))
+        return _vec_cmp_cols(resolve(e.left), resolve(e.right), e.op)
+    if isinstance(e, A.IsNull):
+        col = resolve(e.operand)
+        isnull = np.array([x is None for x in col.tolist()], dtype=bool)
+        return ~isnull if e.negated else isnull
+    _bail()
 
 
 # -- aggregation pushdown shapes ------------------------------------------
@@ -1570,50 +1794,14 @@ def _agg_leaf(
 
 
 def _order(ret, cols, out_cols, b, catalog, ctx) -> np.ndarray:
-    """Row order for ORDER BY over the projected columns."""
+    """Row order for ORDER BY over the projected columns (key-list sort
+    shared with the WITH pipeline via _order_from_keys)."""
     n = len(out_cols[0]) if out_cols else 0
     keys: List[Tuple[np.ndarray, bool]] = []
     for expr, desc in ret.order_by:
         col = _order_key(expr, ret, cols, out_cols, b, catalog, ctx)
         keys.append((col, desc))
-    # numeric lane: all keys float-able -> lexsort
-    float_keys = []
-    ok = True
-    for col, desc in keys:
-        f = _as_float(col) if col.dtype == object else (
-            col.astype(np.float64), np.ones(len(col), bool)
-        )
-        if f is None:
-            ok = False
-            break
-        vals, mask = f
-        # Neo4j treats null as the largest value: last in ASC, first in
-        # DESC (general path _cypher_cmp returns 1 for None) — so map
-        # null to +inf BEFORE the DESC negation.
-        vals = np.where(mask, vals, np.inf)
-        float_keys.append(-vals if desc else vals)
-    if ok and float_keys:
-        order = np.lexsort(list(reversed(float_keys)))
-        return order
-    # general: stable python sort
-    from nornicdb_tpu.query.executor import _cypher_cmp
-    import functools
-
-    idx = list(range(n))
-
-    def cmp(a: int, bidx: int) -> int:
-        for col, desc in keys:
-            va = col[a]
-            vb = col[bidx]
-            if isinstance(va, _NodeRef) or isinstance(vb, _NodeRef):
-                _bail()
-            c = _cypher_cmp(va, vb)
-            if c != 0:
-                return -c if desc else c
-        return 0
-
-    idx.sort(key=functools.cmp_to_key(cmp))
-    return np.asarray(idx, dtype=np.int64)
+    return _order_from_keys(keys, n)
 
 
 def _order_key(expr, ret, cols, out_cols, b, catalog, ctx) -> np.ndarray:
