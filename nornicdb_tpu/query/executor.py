@@ -870,6 +870,11 @@ class CypherExecutor:
                 raise CypherRuntimeError(f"`{pn.var}` is not a node")
             return [v]
         if pn.labels:
+            if (pn.props is not None and pn.props.items
+                    and getattr(self, "enable_fastpaths", True)):
+                hit = self._indexed_candidates(pn, row, ctx)
+                if hit is not None:
+                    return hit
             # smallest label set first
             best: Optional[List[Node]] = None
             for lbl in pn.labels:
@@ -878,6 +883,46 @@ class CypherExecutor:
                     best = cand
             return best or []
         return ctx.storage.all_nodes()
+
+    def _indexed_candidates(self, pn: A.PatternNode, row,
+                            ctx) -> Optional[List[Node]]:
+        """Hash-index candidate narrowing for (:Label {k: <expr>}) in the
+        ROW interpreter — the difference between O(1) and a label scan
+        per row in UNWIND/loop-shaped ingest (reference resolves the same
+        shape through indexed access, storage_fastpaths.go). Candidates
+        are verified by _node_ok afterward, so the probe only needs to be
+        a superset of the true matches; returns None to fall back to the
+        label scan whenever the columnar snapshot cannot be trusted."""
+        if ctx.storage is not self.storage:
+            return None  # txn overlay / PROFILE proxy: snapshot mismatch
+        if (ctx.non_create_writes or ctx.stats.nodes_deleted
+                or ctx.stats.labels_removed):
+            # updates/deletes earlier in this statement are not yet in
+            # the snapshot (deltas apply at end of query)
+            return None
+        k, vexpr = pn.props.items[0]
+        try:
+            v = self._eval(vexpr, row, ctx)
+        except CypherRuntimeError:
+            return None
+        if v is None or isinstance(v, (list, dict, Node, Edge)):
+            return None
+        try:
+            hit = self.columnar.prop_index(pn.labels[0], k).get(v)
+        except TypeError:
+            return None  # unhashable probe value
+        snapshot = self.columnar.nodes()
+        out = [snapshot[i].copy()
+               for i in (hit.tolist() if hit is not None else [])]
+        # nodes created earlier in THIS statement are visible to MATCH;
+        # append only the ones the snapshot does NOT already contain (a
+        # lazy snapshot built after the CREATE has already read them
+        # from storage — appending again would double the match)
+        label = pn.labels[0]
+        for n in ctx.created_nodes:
+            if label in n.labels and self.columnar.node_row(n.id) is None:
+                out.append(n)
+        return out
 
     def _node_ok(self, pn: A.PatternNode, node: Node, row, ctx) -> bool:
         if any(l not in node.labels for l in pn.labels):
