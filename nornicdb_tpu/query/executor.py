@@ -159,6 +159,9 @@ class _Ctx:
         self.created_edges: List[Edge] = []
         self.created_props = 0  # properties set BY those creations
         self.non_create_writes = False
+        # incrementally-built (label, prop) -> value -> nodes map over
+        # created_nodes, so per-row index probes stay O(1) amortized
+        self.created_probe_index: Dict[Tuple[str, str], Dict] = {}
 
 
 class CypherExecutor:
@@ -917,10 +920,30 @@ class CypherExecutor:
         # nodes created earlier in THIS statement are visible to MATCH;
         # append only the ones the snapshot does NOT already contain (a
         # lazy snapshot built after the CREATE has already read them
-        # from storage — appending again would double the match)
+        # from storage — appending again would double the match). The
+        # created list is consulted through an incrementally-extended
+        # (label, key) -> value map so a 10k-row UNWIND MERGE stays
+        # O(rows), not O(rows^2).
         label = pn.labels[0]
-        for n in ctx.created_nodes:
-            if label in n.labels and self.columnar.node_row(n.id) is None:
+        cache = ctx.created_probe_index
+        entry = cache.get((label, k))
+        if entry is None:
+            entry = {"pos": 0, "map": {}}
+            cache[(label, k)] = entry
+        mp = entry["map"]
+        created = ctx.created_nodes
+        for n in created[entry["pos"]:]:
+            if label in n.labels:
+                key_val = n.properties.get(k)
+                if key_val is None:
+                    continue  # probe values are never None (guard above)
+                try:
+                    mp.setdefault(key_val, []).append(n)
+                except TypeError:
+                    pass  # unhashable stored value can't equal probe v
+        entry["pos"] = len(created)
+        for n in mp.get(v, []):
+            if self.columnar.node_row(n.id) is None:
                 out.append(n)
         return out
 

@@ -117,3 +117,29 @@ def test_multi_label_and_second_prop_still_verified(ex):
     assert ex.execute(
         "UNWIND [1] AS v MATCH (n:A {k: v, j: 'y'}) RETURN count(n)"
     ).rows == [[1]]
+
+
+def test_merge_bulk_ingest_linear(ex):
+    """UNWIND MERGE must stay O(rows): the create-side probe consults an
+    incrementally-built map over same-statement creates."""
+    rows = [{"id": i} for i in range(5_000)]
+    t0 = time.perf_counter()
+    r = ex.execute("UNWIND $rows AS r MERGE (:Mi {id: r.id})",
+                   {"rows": rows})
+    dt = time.perf_counter() - t0
+    assert r.stats.nodes_created == 5_000
+    assert dt < 10.0, f"{dt:.1f}s — quadratic created-list scan"
+    # idempotent second pass
+    r2 = ex.execute("UNWIND $rows AS r MERGE (:Mi {id: r.id})",
+                    {"rows": rows})
+    assert r2.stats.nodes_created == 0
+
+
+def test_merge_dedups_within_statement(ex):
+    r = ex.execute("UNWIND [1, 1, 2, 2, 2] AS i MERGE (:Md {id: i})")
+    assert r.stats.nodes_created == 2
+    assert ex.execute("MATCH (d:Md) RETURN count(d)").rows == [[2]]
+    scan_ex = CypherExecutor(NamespacedEngine(MemoryEngine(), "mscan"))
+    scan_ex.enable_fastpaths = False
+    rs = scan_ex.execute("UNWIND [1, 1, 2, 2, 2] AS i MERGE (:Md {id: i})")
+    assert rs.stats.nodes_created == 2
