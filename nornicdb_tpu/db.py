@@ -185,7 +185,7 @@ class DB:
 
             class _CacheInvalidator(MutationListener):
                 def on_node_upsert(self, node):
-                    ex.on_external_mutation()
+                    ex.on_external_node_upsert(node)
 
                 def on_node_delete(self, node_id):
                     ex.on_external_mutation()

@@ -204,6 +204,45 @@ class ColumnarCatalog:
                 self._all_edge_types.append(edge.type)
                 self._all_edge_types.sort()
 
+    def note_external_upsert(self, node: Node) -> bool:
+        """Absorb an out-of-band node upsert without wholesale
+        invalidation when possible. Three cases:
+
+        - known node, query-visible content (labels, properties)
+          unchanged — the embed queue's embedding write-backs — swap the
+          snapshot's object in place;
+        - unseen node (e.g. created by a statement still running, whose
+          deltas apply at end-of-query) — append it as a create delta;
+        - known node with changed content — return False, the caller
+          must invalidate.
+
+        Wholesale invalidation here would force a full snapshot rebuild
+        per index probe while bulk ingest races the embed worker."""
+        with self._lock:
+            if self._nodes is None:
+                return True  # nothing built; nothing can be stale
+            i = self._node_pos.get(node.id) if self._node_pos else None
+            if i is not None:
+                cur = self._nodes[i]
+                try:
+                    same = (cur.labels == node.labels
+                            and bool(cur.properties == node.properties))
+                except (TypeError, ValueError):
+                    same = False  # e.g. numpy-valued property __eq__
+                if same:
+                    # defensive copy: the listener hands us the writer's
+                    # live object; the snapshot must own its nodes
+                    self._nodes[i] = node.copy()
+                    return True
+                return False
+            if len(self._nodes) >= self.EXTERNAL_APPEND_MAX_NODES:
+                # appending extends every cached O(N) array; past this
+                # size one wholesale invalidation + lazy rebuild is
+                # cheaper than per-create array copies
+                return False
+        self.apply_node_created(node.copy())  # idempotent; own lock
+        return True
+
     # -- node table -------------------------------------------------------
 
     def _ensure_nodes(self) -> List[Node]:
@@ -375,6 +414,10 @@ class ColumnarCatalog:
     # 16 MB at the cap). Bigger label/edge combinations return None and
     # the query falls back to join expansion.
     INCIDENCE_MAX_CELLS = 4_000_000
+    # above this snapshot size, external unseen-node upserts invalidate
+    # wholesale instead of create-delta appending (each append copies
+    # every cached O(N) array)
+    EXTERNAL_APPEND_MAX_NODES = 20_000
 
     def incidence(
         self,

@@ -220,6 +220,20 @@ class CypherExecutor:
             return
         self.invalidate_caches()
 
+    def on_external_node_upsert(self, node) -> None:
+        """Upsert-shaped external mutation: when only the embedding (or
+        other non-query-visible fields) changed, swap the snapshot's node
+        in place instead of invalidating wholesale — the embed queue's
+        write-backs would otherwise force a full catalog rebuild per
+        probe while a bulk ingest runs concurrently."""
+        if getattr(self._tls, "depth", 0) > 0:
+            return
+        if self.columnar.note_external_upsert(node):
+            # projected nodes can carry embeddings: drop only results
+            self.query_cache.clear()
+            return
+        self.invalidate_caches()
+
     def invalidate_caches(self) -> None:
         """Drop the query-result cache and columnar snapshot. Called after
         any write this executor performs, and wired to storage mutation

@@ -294,3 +294,68 @@ class TestPlanCacheThreadSafety:
         slow_ex.enable_query_cache = False
         truth = sorted(map(repr, slow_ex.execute(query).rows))
         assert results[0] == truth
+
+
+class TestExternalUpsertAbsorption:
+    def test_embedding_writeback_keeps_catalog_warm(self):
+        """The embed worker's write-backs (content-identical upserts)
+        must not invalidate the snapshot."""
+        from nornicdb_tpu.storage.types import Node
+
+        ex = _executor()
+        ex.execute("CREATE (:W {id: 1})")
+        cat = ex.columnar
+        cat.prop_index("W", "id")  # warm
+        v0 = cat.version
+        node = ex.storage.get_node(
+            ex.execute("MATCH (w:W) RETURN w").rows[0][0].id)
+        node.embedding = [0.1, 0.2]
+        ex.on_external_node_upsert(node)
+        assert cat.version == v0  # swap, not invalidation
+        assert ex.execute("MATCH (w:W {id: 1}) RETURN count(w)"
+                          ).rows == [[1]]
+
+    def test_listener_object_not_shared_with_snapshot(self):
+        """Regression: the snapshot must copy the listener's node; a
+        caller mutating their object after the write must not corrupt
+        indexed matching."""
+        ex = _executor()
+        ex.execute("CREATE (:W2 {id: 1, k: 'a'})")
+        ex.columnar.prop_index("W2", "id")
+        node = ex.storage.get_node(
+            ex.execute("MATCH (w:W2) RETURN w").rows[0][0].id)
+        node.embedding = [0.5]
+        ex.on_external_node_upsert(node)
+        node.properties["k"] = "MUTATED-AFTER-WRITE"
+        r = ex.execute("MATCH (w:W2 {id: 1}) RETURN w.k")
+        assert r.rows == [["a"]]  # snapshot unaffected by scratch edit
+
+    def test_numpy_property_comparison_does_not_crash(self):
+        import numpy as np
+
+        from nornicdb_tpu.storage.types import Node
+
+        ex = _executor()
+        n = Node(id="np1", labels=["Np"],
+                 properties={"vec": np.array([1.0, 2.0])})
+        ex.storage.create_node(n)
+        ex.execute("MATCH (x:Np) RETURN count(x)")  # build snapshot
+        n2 = ex.storage.get_node("np1")
+        n2.embedding = [0.1]
+        ex.on_external_node_upsert(n2)  # must not raise
+        assert ex.execute("MATCH (x:Np) RETURN count(x)").rows == [[1]]
+
+    def test_unchanged_content_update_visible(self):
+        """A genuine content change still invalidates and is visible."""
+        ex = _executor()
+        ex.execute("CREATE (:W3 {id: 1, s: 'old'})")
+        ex.columnar.prop_index("W3", "s")
+        node = ex.storage.get_node(
+            ex.execute("MATCH (w:W3) RETURN w").rows[0][0].id)
+        node.properties["s"] = "new"
+        ex.storage.update_node(node)
+        ex.on_external_node_upsert(node)
+        assert ex.execute("MATCH (w:W3 {s: 'new'}) RETURN count(w)"
+                          ).rows == [[1]]
+        assert ex.execute("MATCH (w:W3 {s: 'old'}) RETURN count(w)"
+                          ).rows == [[0]]
