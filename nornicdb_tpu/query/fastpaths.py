@@ -190,6 +190,9 @@ class _Bindings:
         # var -> (candidate rows, per-row code into candidates): dense
         # group codes already known for these vars (co-occurrence path)
         self.cand_map: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        # stripped vars whose count() uses a DIFFERENT weight channel
+        # than row_weights (OPTIONAL MATCH: raw degree vs max(deg, 1))
+        self.stripped_var_weights: Dict[str, np.ndarray] = {}
         # binding rows are known pairwise-distinct over cand_map codes
         self.rows_are_groups = False
 
@@ -200,6 +203,9 @@ class _Bindings:
         self.hop_edges = [(t, v[sel]) for t, v in self.hop_edges]
         if self.row_weights is not None:
             self.row_weights = self.row_weights[sel]
+        self.stripped_var_weights = {
+            k: v[sel] for k, v in self.stripped_var_weights.items()
+        }
         self.cand_map = {
             k: (c, v[sel]) for k, (c, v) in self.cand_map.items()
         }
@@ -256,10 +262,30 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
     for conj in plan["where_conjs"]:
         b.take(_vec_predicate(conj, b, catalog, ctx))
 
+    oc = plan.get("optional_count")
+    if oc is not None:
+        _apply_optional_count(catalog, oc, b)
+
     if plan.get("pipeline") is not None:
         return _exec_with_pipeline(executor, catalog, plan, ctx, b,
                                    CypherResult)
     return _project(executor, catalog, plan["ret"], b, ctx, CypherResult, plan)
+
+
+def _ret_col_names(ret: A.ReturnClause) -> List[str]:
+    """Output column names: alias > var name > var.prop > raw text."""
+    cols: List[str] = []
+    for item in ret.items:
+        if item.alias:
+            cols.append(item.alias)
+        elif isinstance(item.expr, A.Var):
+            cols.append(item.expr.name)
+        elif isinstance(item.expr, A.Prop) and isinstance(
+                item.expr.target, A.Var):
+            cols.append(f"{item.expr.target.name}.{item.expr.name}")
+        else:
+            cols.append(item.text)
+    return cols
 
 
 def _analyze_vectorized(q: A.Query) -> Optional[Dict[str, Any]]:
@@ -268,7 +294,14 @@ def _analyze_vectorized(q: A.Query) -> Optional[Dict[str, Any]]:
 
     clauses = q.clauses
     if len(clauses) == 3:
-        return _analyze_with_pipeline(q)
+        if not isinstance(clauses[0], A.MatchClause):
+            return None
+        if isinstance(clauses[1], A.WithClause):
+            return _analyze_with_pipeline(q)
+        if (isinstance(clauses[1], A.MatchClause)
+                and clauses[1].optional):
+            return _analyze_optional_count(q)
+        return None
     if len(clauses) != 2:
         return None
     m, ret = clauses[0], clauses[1]
@@ -280,16 +313,7 @@ def _analyze_vectorized(q: A.Query) -> Optional[Dict[str, Any]]:
     if not _path_supported(path, set()):
         return None
 
-    cols = []
-    for item in ret.items:
-        if item.alias:
-            cols.append(item.alias)
-        elif isinstance(item.expr, A.Var):
-            cols.append(item.expr.name)
-        elif isinstance(item.expr, A.Prop) and isinstance(item.expr.target, A.Var):
-            cols.append(f"{item.expr.target.name}.{item.expr.name}")
-        else:
-            cols.append(item.text)
+    cols = _ret_col_names(ret)
     agg_flags = [_contains_agg(i.expr) for i in ret.items]
     has_agg = any(agg_flags)
 
@@ -455,6 +479,84 @@ def _analyze_with_pipeline(q: A.Query) -> Optional[Dict[str, Any]]:
         "agg_flags": [False] * len(ret.items),
         "has_agg": True,
     }
+
+
+def _analyze_optional_count(q: A.Query) -> Optional[Dict[str, Any]]:
+    """MATCH chain OPTIONAL MATCH (anchor)-[:T]->(x) RETURN keys,
+    count(x): the "counts including zeros" family the inner-join degree
+    pushdown cannot express (an unmatched anchor still produces a group
+    with count 0 via its null-extended row). Compiles to per-anchor
+    filtered degrees: row multiplicity is max(degree, 1) and count(x)
+    uses the raw degree."""
+    from nornicdb_tpu.query.executor import _contains_agg
+
+    m, om, ret = q.clauses
+    if not isinstance(ret, A.ReturnClause) or ret.star or ret.distinct:
+        return None
+    if m.optional or len(m.paths) != 1 or len(om.paths) != 1:
+        return None
+    if om.where is not None:
+        return None  # WHERE on the optional side: general path
+    path = m.paths[0]
+    opath = om.paths[0]
+    if not _path_supported(path, set()):
+        return None
+    # optional chain: exactly (anchor)-[:T]->(x), anchor bound by chain1
+    if len(opath.nodes) != 2 or len(opath.rels) != 1 or opath.path_var:
+        return None
+    oa, ox = opath.nodes
+    orel = opath.rels[0]
+    if (orel.min_hops != 1 or orel.max_hops != 1 or orel.props is not None
+            or len(orel.types) != 1 or orel.direction not in ("out", "in")
+            or orel.var is not None):
+        return None
+    chain_vars = {pn.var for pn in path.nodes if pn.var}
+    if not oa.var or oa.var not in chain_vars or oa.labels or oa.props:
+        return None
+    if ox.props is not None or len(ox.labels) > 1:
+        return None
+    if ox.var and ox.var in chain_vars:
+        return None
+    agg_flags = [_contains_agg(i.expr) for i in ret.items]
+    if not any(agg_flags):
+        return None  # non-aggregated optional rows: general path
+    if not _count_only_usage(ox.var, m, ret):
+        return None
+
+    cols = _ret_col_names(ret)
+
+    return {
+        "m": m,
+        "ret": ret,
+        "path": path,
+        "where_conjs": _split_and(m.where) if m.where is not None else [],
+        "strip": None,
+        "cooc": None,
+        "point": None,
+        "pipeline": None,
+        "optional_count": {
+            "anchor": oa.var,
+            "etype": orel.types[0],
+            "direction": orel.direction,
+            "label": ox.labels[0] if ox.labels else None,
+            "var": ox.var,
+        },
+        "cols": cols,
+        "agg_flags": agg_flags,
+        "has_agg": True,
+    }
+
+
+def _apply_optional_count(catalog, oc: Dict[str, Any], b: _Bindings) -> None:
+    """Attach optional-hop multiplicity to computed chain bindings."""
+    deg = catalog.filtered_degree(oc["etype"], oc["direction"], oc["label"])
+    w = deg[b.node_cols[oc["anchor"]]]
+    # a row with no optional match still exists once (null-extended)
+    b.row_weights = np.maximum(w, 1)
+    if oc["var"]:
+        b.stripped_vars.add(oc["var"])
+        # count(x) must use the RAW degree (0 for unmatched anchors)
+        b.stripped_var_weights[oc["var"]] = w.astype(np.int64)
 
 
 def _order_expr_known(expr: A.Expr, known: set, ret: A.ReturnClause) -> bool:
@@ -1647,10 +1749,13 @@ def _agg_leaf(
     ):
         # the folded-out hop target: bound (non-null) in every match row
         # a binding row stands for, so count(var) == weighted row count
+        # (OPTIONAL MATCH strips carry their own channel: raw degree,
+        # which is 0 for null-extended rows)
         if e.distinct:
             _bail()
+        vw = b.stripped_var_weights.get(arg.name, w)
         out = np.empty(n_groups, dtype=object)
-        out[:] = _row_count(codes, w).tolist()
+        out[:] = _row_count(codes, vw).tolist()
         return out
     if isinstance(arg, A.Var) and arg.name in b.node_cols:
         vals = b.node_cols[arg.name].astype(np.int64)
