@@ -12,9 +12,25 @@ small/latency-bound shapes, with the same layout streaming to the device
 data plane (ops/) for large scans. SURVEY §2.8 row 1 maps the
 reference's multicore chunk parallelism to exactly this design.
 
-The catalog is invalidated wholesale on any mutation (cheap: builds are
-lazy and per-label/type) via `invalidate()`, wired to executor write
-stats and to storage mutation listeners in db.py.
+The catalog is invalidated wholesale on updates/deletes via
+`invalidate()`, wired to executor write stats and to storage mutation
+listeners in db.py. Pure creations are *incremental*: node/edge create
+deltas extend the snapshot, the per-(etype, direction, label) degree
+arrays, and two families of materialized aggregate views in place —
+the count-store analog of the reference's single-hop fast aggregations
+(pkg/cypher/traversal_fast_agg.go:15,57) and hand-written co-occurrence
+executors (optimized_executors.go:25-282):
+
+- `_StripView`: per-anchor-node sums of terminal-hop filtered degrees,
+  grouped by the adjacent node over one relationship type — answers the
+  "avg friends per city" family in O(#groups) per query.
+- `_GramView`: the co-occurrence Gram matrix C = Ma^T @ Mb with the
+  same-edge diagonal correction folded in — answers the "tag
+  co-occurrence" family in O(nnz(C)) per query.
+
+Without these, both shapes re-run O(edges) array work per query, which
+is fine at 10^3 nodes and hopeless at 10^5 (the scale VERDICT r02
+demands).
 """
 
 from __future__ import annotations
@@ -33,11 +49,18 @@ class EdgeTable:
     __slots__ = (
         "etype", "src", "dst", "edges",
         "_csr_out", "_csr_in", "_prop_cols", "_edge_ids",
+        "_buf_src", "_buf_dst",
     )
 
     def __init__(self, etype: str, src: np.ndarray, dst: np.ndarray,
                  edges: List[Edge]):
         self.etype = etype
+        # src/dst are exact-length views over capacity buffers so appends
+        # are amortized O(1) (a write-heavy compound loop would otherwise
+        # pay an O(len) array copy per created edge). Readers snapshot
+        # the views; the region behind a view is never rewritten.
+        self._buf_src = src
+        self._buf_dst = dst
         self.src = src  # int32[ne] global node row of start
         self.dst = dst  # int32[ne] global node row of end
         self.edges = edges  # Edge objects aligned with src/dst
@@ -85,12 +108,73 @@ class EdgeTable:
         if edge.id in self._edge_ids:
             return
         self._edge_ids.add(edge.id)
-        self.src = np.append(self.src, np.int32(src_row))
-        self.dst = np.append(self.dst, np.int32(dst_row))
+        n = len(self.src)
+        if n == len(self._buf_src):
+            cap = max(16, 2 * n)
+            grown = np.empty(cap, dtype=np.int32)
+            grown[:n] = self._buf_src
+            self._buf_src = grown
+            grown = np.empty(cap, dtype=np.int32)
+            grown[:n] = self._buf_dst
+            self._buf_dst = grown
+        self._buf_src[n] = src_row
+        self._buf_dst[n] = dst_row
+        self.src = self._buf_src[:n + 1]
+        self.dst = self._buf_dst[:n + 1]
         self.edges.append(edge)
         self._csr_out = None
         self._csr_in = None
         self._prop_cols.clear()
+
+
+class _StripView:
+    """Materialized two-hop grouped degree aggregation.
+
+    For a chain (g)-[:ETYPE1]-(p:PLabel)-[:ETYPE2]-(f:FLabel) where the
+    terminal f is consumed only by count(), the per-g aggregates are
+    maintained densely over ALL global node rows (g's label filter is a
+    query-time row selection, so it is not part of the key):
+
+    - ``deg[p]``: # ETYPE2 edges of p in dir2 whose far end has FLabel
+      (a private copy — updates must read the pre-increment value)
+    - ``sum_deg[g]``: sum of deg[p] over ETYPE1 edges (g, p) with p
+      carrying PLabel == count(f) per g == count(p) per g (weighted)
+    - ``nnz[g]``: # *distinct* p with PLabel, an ETYPE1 edge to g, and
+      deg[p] > 0 == count(DISTINCT p) per g
+
+    Incrementally maintained on edge creates of either type; the catalog
+    drops the view when it cannot update exactly (unknown node rows,
+    missing adjacency). Arrays are copy-on-write: readers hold
+    internally-consistent snapshots.
+    """
+
+    __slots__ = ("deg", "sum_deg", "nnz")
+
+    def __init__(self, deg: np.ndarray, sum_deg: np.ndarray, nnz: np.ndarray):
+        self.deg = deg
+        self.sum_deg = sum_deg
+        self.nnz = nnz
+
+
+class _GramView:
+    """Materialized co-occurrence Gram matrix for (a)<-[:T]-(mid)-[:T]->(b).
+
+    ``C[i, j]`` = # mids with an edge to a-candidate i and a *different*
+    edge to b-candidate j (the same-edge diagonal correction is folded
+    in at build). ``far_lists`` maps mid global row -> list of far
+    global rows of its existing usable edges, so an edge create updates
+    C in O(deg(mid)). C is copy-on-write for lock-free readers.
+    """
+
+    __slots__ = ("C", "a_cands", "b_cands", "a_pos", "b_pos", "far_lists")
+
+    def __init__(self, C, a_cands, b_cands, a_pos, b_pos, far_lists):
+        self.C = C
+        self.a_cands = a_cands
+        self.b_cands = b_cands
+        self.a_pos = a_pos
+        self.b_pos = b_pos
+        self.far_lists = far_lists
 
 
 def _build_csr(keys: np.ndarray, n_nodes: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -127,6 +211,9 @@ class ColumnarCatalog:
         self._filtered_deg: Dict[Tuple[str, str, Optional[str]], np.ndarray] = {}
         self._mid_axis: Dict[Tuple[str, str, Optional[str]], Any] = {}
         self._incidence: Dict[Tuple[str, str, Optional[str], Optional[str]], Any] = {}
+        # materialized aggregate views (see module docstring)
+        self._strip_views: Dict[Tuple, _StripView] = {}
+        self._gram_views: Dict[Tuple, Optional[_GramView]] = {}
 
     @property
     def version(self) -> int:
@@ -149,13 +236,33 @@ class ColumnarCatalog:
     def apply_node_created(self, node: Node) -> None:
         with self._lock:
             self._version += 1
-            self._filtered_deg.clear()  # arrays are sized n_nodes
+            # mid-axis/incidence candidate sets are label-dependent and
+            # cheap to rebuild; the maintained views below extend instead
             self._mid_axis.clear()
             self._incidence.clear()
             if self._nodes is None:
                 return  # nothing built yet; lazy build sees the node
             if node.id in self._node_pos:
                 return  # lazy build raced the write and already has it
+            # a brand-new node has no edges: degree/aggregate arrays gain
+            # a zero slot (np.append = copy-on-write for live readers)
+            for key, deg in list(self._filtered_deg.items()):
+                self._filtered_deg[key] = np.append(deg, np.int64(0))
+            for sv in self._strip_views.values():
+                sv.deg = np.append(sv.deg, np.int64(0))
+                sv.sum_deg = np.append(sv.sum_deg, np.int64(0))
+                sv.nnz = np.append(sv.nnz, np.int64(0))
+            for key, gv in list(self._gram_views.items()):
+                if gv is None:
+                    continue  # over budget; creates only grow the graph
+                _etype, _orient, _mid_l, a_l, b_l = key
+                if (a_l is None or b_l is None
+                        or a_l in node.labels or b_l in node.labels):
+                    # candidate axes grow: rebuild lazily
+                    self._gram_views.pop(key)
+                else:
+                    gv.a_pos = np.append(gv.a_pos, np.int64(-1))
+                    gv.b_pos = np.append(gv.b_pos, np.int64(-1))
             i = len(self._nodes)
             self._nodes.append(node)
             self._node_pos[node.id] = i
@@ -177,32 +284,182 @@ class ColumnarCatalog:
                                   if rows is not None
                                   else np.asarray([i], dtype=np.int32))
             # CSR indptr arrays are indexed by node row and sized
-            # n_nodes+1: a grown node table invalidates every CSR
+            # n_nodes+1: the new (edgeless) node extends each cached
+            # indptr with a repeat of its last offset (copy-on-write)
             for tbl in self._edge_tables.values():
-                tbl._csr_out = None
-                tbl._csr_in = None
+                if tbl._csr_out is not None:
+                    indptr, order = tbl._csr_out
+                    tbl._csr_out = (np.append(indptr, indptr[-1]), order)
+                if tbl._csr_in is not None:
+                    indptr, order = tbl._csr_in
+                    tbl._csr_in = (np.append(indptr, indptr[-1]), order)
 
     def apply_edge_created(self, edge: Edge) -> None:
         with self._lock:
             self._version += 1
-            self._filtered_deg.clear()
-            self._mid_axis.clear()
-            self._incidence.clear()
-            tbl = self._edge_tables.get(edge.type)
-            if tbl is not None:
-                if self._node_pos is None:
-                    self._edge_tables.pop(edge.type, None)
-                else:
-                    s = self._node_pos.get(edge.start_node)
-                    d = self._node_pos.get(edge.end_node)
-                    if s is None or d is None:
-                        self._edge_tables.pop(edge.type, None)
-                    else:
-                        tbl.append_edge(int(s), int(d), edge)
+            et = edge.type
+            # per-etype drop of the (non-maintained) incidence caches
+            for key in [k for k in self._mid_axis if k[0] == et]:
+                self._mid_axis.pop(key)
+            for key in [k for k in self._incidence if k[0] == et]:
+                self._incidence.pop(key)
+
+            tbl = self._edge_tables.get(et)
+            s = d = None
+            if self._node_pos is not None:
+                s = self._node_pos.get(edge.start_node)
+                d = self._node_pos.get(edge.end_node)
+            if s is None or d is None:
+                # endpoints unseen by the snapshot: every structure
+                # derived from this etype is unmaintainable — drop them
+                self._edge_tables.pop(et, None)
+                self._drop_etype_aggregates_locked(et)
+            else:
+                # Freshness gate: every maintained structure (degree
+                # arrays, strip/gram views) is built FROM the edge table,
+                # whose appends dedupe by edge id. A lazy build that
+                # raced this write may already include the edge; in that
+                # case incrementing again would double count. The table's
+                # id set is the single source of truth.
+                fresh = tbl is not None and edge.id not in tbl._edge_ids
+                if tbl is None:
+                    # no table ⇒ no table-derived caches can exist for
+                    # this etype (builds force the table; pops drop them)
+                    self._drop_etype_aggregates_locked(et)
+                elif fresh:
+                    # view updates FIRST: they read pre-increment degrees
+                    # and the pre-append adjacency of the edge table
+                    self._update_strip_views_locked(et, int(s), int(d))
+                    self._update_gram_views_locked(et, int(s), int(d))
+                    self._update_degrees_locked(et, int(s), int(d))
+                if tbl is not None:
+                    tbl.append_edge(int(s), int(d), edge)
             if (self._all_edge_types is not None
-                    and edge.type not in self._all_edge_types):
-                self._all_edge_types.append(edge.type)
+                    and et not in self._all_edge_types):
+                self._all_edge_types.append(et)
                 self._all_edge_types.sort()
+
+    # -- incremental maintenance helpers (call with self._lock held) ------
+
+    def _drop_etype_aggregates_locked(self, et: str) -> None:
+        for key in [k for k in self._filtered_deg if k[0] == et]:
+            self._filtered_deg.pop(key)
+        for key in [k for k in self._strip_views
+                    if k[0] == et or k[3] == et]:
+            self._strip_views.pop(key)
+        for key in [k for k in self._gram_views if k[0] == et]:
+            self._gram_views.pop(key)
+
+    def _update_degrees_locked(self, et: str, s: int, d: int) -> None:
+        """Copy-on-write += on cached (etype, direction, label) degrees."""
+        for key in [k for k in self._filtered_deg if k[0] == et]:
+            _et, kdir, klabel = key
+            row, far = (s, d) if kdir == "out" else (d, s)
+            if klabel is None or klabel in self._nodes[far].labels:
+                arr = self._filtered_deg[key].copy()
+                arr[row] += 1
+                self._filtered_deg[key] = arr
+
+    def _table_neighbors_locked(
+        self, tbl: EdgeTable, probe_side: str, row: int
+    ) -> np.ndarray:
+        """Rows on the OTHER side of ``tbl`` edges whose ``probe_side``
+        ('src'|'dst') endpoint is ``row`` — with multiplicity. Uses the
+        cached CSR when built, else one vectorized scan of the table."""
+        if probe_side == "src":
+            csr, keys, other = tbl._csr_out, tbl.src, tbl.dst
+        else:
+            csr, keys, other = tbl._csr_in, tbl.dst, tbl.src
+        if csr is not None:
+            indptr, order = csr
+            return other[order[indptr[row]:indptr[row + 1]]]
+        return other[keys == row]
+
+    def _update_strip_views_locked(self, et: str, s: int, d: int) -> None:
+        for key in list(self._strip_views):
+            etype1, g_side, p_label, etype2, dir2, f_label = key
+            sv = self._strip_views[key]
+            if et == etype1:
+                g, p = (s, d) if g_side == "src" else (d, s)
+                if p_label is not None and p_label not in self._nodes[p].labels:
+                    continue
+                dp = int(sv.deg[p])
+                if dp == 0:
+                    continue  # zero-degree p adds nothing to sum or nnz
+                tbl1 = self._edge_tables.get(etype1)
+                if tbl1 is None:
+                    self._strip_views.pop(key)
+                    continue
+                sum_deg = sv.sum_deg.copy()
+                sum_deg[g] += dp
+                sv.sum_deg = sum_deg
+                # nnz counts DISTINCT p per g: a second parallel edge
+                # (g, p) must not re-count p
+                p_side = "dst" if g_side == "src" else "src"
+                known_gs = self._table_neighbors_locked(tbl1, p_side, p)
+                if not (known_gs == g).any():
+                    nnz = sv.nnz.copy()
+                    nnz[g] += 1
+                    sv.nnz = nnz
+            elif et == etype2:
+                p, f = (s, d) if dir2 == "out" else (d, s)
+                if f_label is not None and f_label not in self._nodes[f].labels:
+                    continue
+                old = int(sv.deg[p])
+                deg = sv.deg.copy()
+                deg[p] += 1
+                sv.deg = deg
+                if p_label is not None and p_label not in self._nodes[p].labels:
+                    continue
+                tbl1 = self._edge_tables.get(etype1)
+                if tbl1 is None:
+                    self._strip_views.pop(key)
+                    continue
+                p_side = "dst" if g_side == "src" else "src"
+                gs = self._table_neighbors_locked(tbl1, p_side, p)
+                if len(gs) == 0:
+                    continue
+                sum_deg = sv.sum_deg.copy()
+                np.add.at(sum_deg, gs, 1)
+                sv.sum_deg = sum_deg
+                if old == 0:
+                    nnz = sv.nnz.copy()
+                    nnz[np.unique(gs)] += 1
+                    sv.nnz = nnz
+
+    def _update_gram_views_locked(self, et: str, s: int, d: int) -> None:
+        for key in list(self._gram_views):
+            etype, orientation, mid_label, _a_l, _b_l = key
+            if et != etype:
+                continue
+            gv = self._gram_views[key]
+            if gv is None:
+                continue  # over budget; creates only grow the graph
+            mid, far = (s, d) if orientation == "mid_src" else (d, s)
+            if (mid_label is not None
+                    and mid_label not in self._nodes[mid].labels):
+                continue
+            fa = int(gv.a_pos[far]) >= 0
+            fb = int(gv.b_pos[far]) >= 0
+            if not (fa or fb):
+                continue
+            lst = gv.far_lists.get(mid)
+            if lst:
+                C = gv.C.copy()
+                for f2 in lst:
+                    if fb:
+                        ap = int(gv.a_pos[f2])
+                        if ap >= 0:
+                            C[ap, int(gv.b_pos[far])] += 1
+                    if fa:
+                        bp = int(gv.b_pos[f2])
+                        if bp >= 0:
+                            C[int(gv.a_pos[far]), bp] += 1
+                gv.C = C
+            if lst is None:
+                gv.far_lists[mid] = [far]
+            else:
+                lst.append(far)
 
     def note_external_upsert(self, node: Node) -> bool:
         """Absorb an out-of-band node upsert without wholesale
@@ -411,9 +668,12 @@ class ColumnarCatalog:
         return deg
 
     # dense-matrix budget for one cached incidence matrix (float32 cells;
-    # 16 MB at the cap). Bigger label/edge combinations return None and
-    # the query falls back to join expansion.
-    INCIDENCE_MAX_CELLS = 4_000_000
+    # 32 MB at the cap). Bigger label/edge combinations return None and
+    # the query falls back to join expansion. Sized so LDBC-scale
+    # co-occurrence (100k messages x 40 tags) stays comfortably inside —
+    # the incidence matrix is a build-time input to the maintained Gram
+    # view, so the cost is one-time, not per-query.
+    INCIDENCE_MAX_CELLS = 8_000_000
     # above this snapshot size, external unseen-node upserts invalidate
     # wholesale instead of create-delta appending (each append copies
     # every cached O(N) array)
@@ -497,6 +757,133 @@ class ColumnarCatalog:
         with self._lock:
             if self._version == v0:
                 self._incidence[key] = result
+        return result
+
+    def strip_view(
+        self,
+        etype1: str,
+        g_side: str,
+        p_label: Optional[str],
+        etype2: str,
+        dir2: str,
+        f_label: Optional[str],
+    ) -> Optional[_StripView]:
+        """Materialized two-hop grouped degree aggregation (see
+        _StripView). g_side is the group node's side of ETYPE1 edges
+        ('src'|'dst'); dir2 is the terminal hop's direction from p.
+        Returns None when a concurrent write tore the build (callers
+        fall back to per-query chain expansion)."""
+        if etype1 == etype2:
+            # relationship uniqueness: the same edge could serve both
+            # hops, which degree products cannot see — and the update
+            # path's etype dispatch would silently stop maintaining deg.
+            # Callers (fastpaths._analyze_strip) reject this shape.
+            raise ValueError("strip_view requires distinct edge types")
+        key = (etype1, g_side, p_label, etype2, dir2, f_label)
+        with self._lock:
+            sv = self._strip_views.get(key)
+            if sv is not None:
+                return sv
+            v0 = self._version
+        try:
+            tbl = self.edge_table(etype1)
+            with self._lock:
+                g_e = tbl.src if g_side == "src" else tbl.dst
+                p_e = tbl.dst if g_side == "src" else tbl.src
+            # private copy: incremental updates must read pre-increment
+            # values even if the shared degree array advances
+            deg = self.filtered_degree(etype2, dir2, f_label).copy()
+            n = len(deg)
+            if p_label is not None:
+                pmask = self.label_mask(p_label)[p_e]
+                gm = g_e[pmask].astype(np.int64)
+                pm = p_e[pmask].astype(np.int64)
+            else:
+                gm = g_e.astype(np.int64)
+                pm = p_e.astype(np.int64)
+            w = deg[pm]
+            sum_deg = np.bincount(
+                gm, weights=w.astype(np.float64), minlength=n
+            ).astype(np.int64)
+            act = w > 0
+            pairs = np.unique(gm[act] * n + pm[act])  # DISTINCT (g, p)
+            nnz = np.bincount(pairs // n, minlength=n).astype(np.int64)
+        except (IndexError, ValueError):
+            return None  # torn build under a concurrent write
+        sv = _StripView(deg, sum_deg, nnz)
+        with self._lock:
+            if self._version == v0:
+                self._strip_views[key] = sv
+        return sv
+
+    def cooc_gram(
+        self,
+        etype: str,
+        orientation: str,
+        mid_label: Optional[str],
+        a_label: Optional[str],
+        b_label: Optional[str],
+    ) -> Optional[_GramView]:
+        """Materialized co-occurrence Gram matrix (see _GramView).
+        Returns None when the incidence matrices are over the dense
+        budget (cached: the verdict can only flip via invalidate()) or
+        when a concurrent write tore the build."""
+        key = (etype, orientation, mid_label, a_label, b_label)
+        with self._lock:
+            if key in self._gram_views:
+                return self._gram_views[key]
+            v0 = self._version
+        inc_a = self.incidence(etype, orientation, mid_label, a_label)
+        inc_b = (inc_a if b_label == a_label
+                 else self.incidence(etype, orientation, mid_label, b_label))
+        result = None
+        if inc_a is not None and inc_b is not None:
+            ma, a_c, ea, a_pos = inc_a
+            mb, b_c, eb, b_pos = inc_b
+            if ma.shape[0] != mb.shape[0] or len(ea) != len(eb):
+                return None  # mismatched snapshots (raced a write)
+            # float32 loses integer exactness past 2^24; cheap upper
+            # bound on any per-pair count is n_mid * max(ma) * max(mb)
+            if ma.size and mb.size and (
+                float(ma.shape[0]) * float(ma.max()) * float(mb.max())
+                >= 2.0 ** 24
+            ):
+                c = ma.astype(np.float64).T @ mb.astype(np.float64)
+            else:
+                c = (ma.T @ mb).astype(np.float64)
+            tbl = self.edge_table(etype)
+            with self._lock:
+                if orientation == "mid_src":
+                    mid_e, far_e = tbl.src, tbl.dst
+                else:
+                    mid_e, far_e = tbl.dst, tbl.src
+            if len(far_e) != len(ea):
+                return None  # edge table raced a write
+            # relationship uniqueness: a match may not use one edge for
+            # both hops; such pairs land at (far, far) of each
+            # doubly-usable edge
+            both = ea & eb
+            if both.any():
+                flat = a_pos[far_e[both]] * c.shape[1] + b_pos[far_e[both]]
+                c -= np.bincount(flat, minlength=c.size).reshape(c.shape)
+            try:
+                usable = (a_pos[far_e] >= 0) | (b_pos[far_e] >= 0)
+                if mid_label is not None:
+                    usable &= self.label_mask(mid_label)[mid_e]
+                far_lists: Dict[int, List[int]] = {}
+                for m_row, f_row in zip(
+                    mid_e[usable].tolist(), far_e[usable].tolist()
+                ):
+                    far_lists.setdefault(m_row, []).append(f_row)
+            except (IndexError, ValueError):
+                return None
+            result = _GramView(
+                np.rint(c).astype(np.int64), a_c, b_c, a_pos, b_pos,
+                far_lists,
+            )
+        with self._lock:
+            if self._version == v0:
+                self._gram_views[key] = result
         return result
 
     def edge_types(self) -> List[str]:

@@ -193,6 +193,11 @@ class _Bindings:
         # stripped vars whose count() uses a DIFFERENT weight channel
         # than row_weights (OPTIONAL MATCH: raw degree vs max(deg, 1))
         self.stripped_var_weights: Dict[str, np.ndarray] = {}
+        # folded-out vars carrying a per-row count of DISTINCT original
+        # values (strip-view route: nnz per group node). Valid only
+        # while no two rows of one output group can share a member —
+        # _agg_leaf enforces one-row-per-group before using it.
+        self.stripped_distinct_counts: Dict[str, np.ndarray] = {}
         # binding rows are known pairwise-distinct over cand_map codes
         self.rows_are_groups = False
 
@@ -205,6 +210,9 @@ class _Bindings:
             self.row_weights = self.row_weights[sel]
         self.stripped_var_weights = {
             k: v[sel] for k, v in self.stripped_var_weights.items()
+        }
+        self.stripped_distinct_counts = {
+            k: v[sel] for k, v in self.stripped_distinct_counts.items()
         }
         self.cand_map = {
             k: (c, v[sel]) for k, (c, v) in self.cand_map.items()
@@ -251,7 +259,7 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
 
     strip, cooc = plan["strip"], plan["cooc"]
     if strip is not None:
-        b = _exec_strip(catalog, strip, ctx)
+        b = _exec_strip(catalog, strip, ctx, plan)
     elif cooc is not None:
         b = _exec_cooc(catalog, cooc, ctx)
     else:
@@ -814,7 +822,14 @@ def _analyze_strip(path: A.PatternPath, m: A.MatchClause,
     }
 
 
-def _exec_strip(catalog, strip: Dict[str, Any], ctx) -> Optional[_Bindings]:
+def _exec_strip(catalog, strip: Dict[str, Any], ctx,
+                plan: Optional[Dict[str, Any]] = None) -> Optional[_Bindings]:
+    if plan is not None:
+        spec = _strip_view_spec(plan, strip)
+        if spec is not None:
+            b = _exec_strip_view(catalog, strip, spec)
+            if b is not None:
+                return b
     b = _match_chain(catalog, strip["tpath"], ctx)
     if b is None:
         return None
@@ -828,6 +843,139 @@ def _exec_strip(catalog, strip: Dict[str, Any], ctx) -> Optional[_Bindings]:
     b.row_weights = w[keep]
     if strip["var"]:
         b.stripped_vars.add(strip["var"])
+    return b
+
+
+_NO_SPEC = object()
+
+
+def _strip_view_spec(plan: Dict[str, Any],
+                     strip: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Eligibility for the materialized strip view (columnar.strip_view):
+    the remaining chain is exactly one hop (g)-[:T1]-(p), there are no
+    runtime filters, and every RETURN item is either a g reference or a
+    count-family aggregate over {*, f, p, g} — then the whole query
+    collapses to per-group reads of maintained arrays. AST-only; cached
+    on the (AST-pinned) plan."""
+    spec = strip.get("view_spec", _NO_SPEC)
+    if spec is not _NO_SPEC:
+        return spec
+    spec = _analyze_strip_view(plan, strip)
+    strip["view_spec"] = spec
+    return spec
+
+
+def _analyze_strip_view(plan: Dict[str, Any],
+                        strip: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    if plan.get("pipeline") is not None or plan.get("optional_count"):
+        return None
+    if plan["where_conjs"]:
+        return None
+    tpath = strip["tpath"]
+    if len(tpath.nodes) != 2 or len(tpath.rels) != 1:
+        return None
+    g, p = tpath.nodes
+    rel = tpath.rels[0]
+    if (rel.min_hops != 1 or rel.max_hops != 1 or rel.props is not None
+            or len(rel.types) != 1 or rel.var is not None
+            or rel.direction not in ("out", "in")):
+        return None
+    if g.var is None or g.props is not None or len(g.labels) > 1:
+        return None
+    if p.props is not None or len(p.labels) > 1:
+        return None
+    if p.var != strip["src_var"]:
+        return None
+    ret = plan["ret"]
+    if ret.distinct:
+        return None
+    f_var = strip["var"]
+    count_vars = {v for v in (f_var, p.var, g.var) if v}
+    for item, is_agg in zip(ret.items, plan["agg_flags"]):
+        if is_agg:
+            if not _view_agg_supported(item.expr, count_vars, f_var):
+                return None
+        else:
+            e = item.expr
+            ok = (isinstance(e, A.Var) and e.name == g.var) or (
+                isinstance(e, A.Prop) and isinstance(e.target, A.Var)
+                and e.target.name == g.var
+            )
+            if not ok:
+                return None
+    for expr, _desc in ret.order_by or []:
+        if _mentions_var(expr, p.var) or (f_var and _mentions_var(expr, f_var)):
+            return None
+    return {
+        "g_var": g.var,
+        "g_label": g.labels[0] if g.labels else None,
+        "p_var": p.var,
+        "p_label": p.labels[0] if p.labels else None,
+        "etype1": rel.types[0],
+        "g_side": "src" if rel.direction == "out" else "dst",
+    }
+
+
+def _view_agg_supported(e: A.Expr, count_vars: set,
+                        f_var: Optional[str]) -> bool:
+    """Mirror of _agg_expr's structure: combinators over count leaves.
+    f (the stripped terminal) may only be counted non-distinct; p and g
+    may be counted with or without DISTINCT (p's distinct channel is the
+    maintained nnz array)."""
+    if isinstance(e, A.FuncCall) and e.name in _AGG_NAMES:
+        if e.name != "count":
+            return False
+        if e.star:
+            return True
+        if len(e.args) != 1 or not isinstance(e.args[0], A.Var):
+            return False
+        name = e.args[0].name
+        if name not in count_vars:
+            return False
+        if e.distinct and name == f_var:
+            return False
+        return True
+    if isinstance(e, A.Binary) and e.op in ("+", "-", "*", "/", "%"):
+        return (_view_agg_supported(e.left, count_vars, f_var)
+                and _view_agg_supported(e.right, count_vars, f_var))
+    if isinstance(e, (A.Literal, A.Param)):
+        return True
+    if isinstance(e, A.FuncCall) and e.name in ("tofloat", "tointeger",
+                                                "round"):
+        return all(_view_agg_supported(a, count_vars, f_var)
+                   for a in e.args)
+    return False
+
+
+def _exec_strip_view(catalog, strip: Dict[str, Any],
+                     spec: Dict[str, Any]) -> Optional[_Bindings]:
+    sv = catalog.strip_view(
+        spec["etype1"], spec["g_side"], spec["p_label"],
+        strip["etype"], strip["direction"], strip["label"],
+    )
+    if sv is None:
+        return None
+    try:
+        if spec["g_label"] is not None:
+            g_rows = catalog.label_rows(spec["g_label"])
+        else:
+            g_rows = np.arange(catalog.n_nodes(), dtype=np.int32)
+        sum_g = sv.sum_deg[g_rows]
+        keep = sum_g > 0
+        g_rows = g_rows[keep]
+        nnz_g = sv.nnz[g_rows]
+    except (IndexError, ValueError):
+        return None  # raced a write; per-query expansion instead
+    b = _Bindings()
+    b.node_cols[spec["g_var"]] = g_rows.astype(np.int32, copy=False)
+    b.n_rows = len(g_rows)
+    b.row_weights = sum_g[keep]
+    if strip["var"]:
+        b.stripped_vars.add(strip["var"])
+        b.stripped_var_weights[strip["var"]] = b.row_weights
+    b.stripped_vars.add(spec["p_var"])
+    b.stripped_var_weights[spec["p_var"]] = b.row_weights
+    b.stripped_distinct_counts[spec["p_var"]] = nnz_g
     return b
 
 
@@ -872,6 +1020,32 @@ def _analyze_cooc(path: A.PatternPath, m: A.MatchClause,
 def _exec_cooc(catalog, cooc: Dict[str, Any], ctx) -> Optional[_Bindings]:
     etype = cooc["etype"]
     orientation = cooc["orientation"]
+    # materialized Gram matrix: O(nnz(C)) per query, maintained across
+    # creates (columnar.cooc_gram). Falls through to the per-query
+    # incidence matmul only on a torn concurrent build.
+    gram = catalog.cooc_gram(
+        etype, orientation, cooc["mid_label"], cooc["a_label"],
+        cooc["b_label"],
+    )
+    if gram is not None:
+        c = gram.C
+        ii, jj = np.nonzero(c > 0)
+        b_out = _Bindings()
+        if cooc["a_var"]:
+            b_out.node_cols[cooc["a_var"]] = gram.a_cands[ii].astype(
+                np.int32, copy=False)
+            b_out.cand_map[cooc["a_var"]] = (gram.a_cands, ii)
+        if cooc["b_var"]:
+            b_out.node_cols[cooc["b_var"]] = gram.b_cands[jj].astype(
+                np.int32, copy=False)
+            b_out.cand_map[cooc["b_var"]] = (gram.b_cands, jj)
+        b_out.row_weights = c[ii, jj]
+        b_out.n_rows = len(ii)
+        b_out.rows_are_groups = bool(cooc["a_var"] and cooc["b_var"])
+        if cooc["mid_var"]:
+            b_out.stripped_vars.add(cooc["mid_var"])
+        return b_out
+
     inc_a = catalog.incidence(
         etype, orientation, cooc["mid_label"], cooc["a_label"]
     )
@@ -970,6 +1144,14 @@ def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
         return rows
 
     cand = [candidates(pn) for pn in nodes]
+    # membership masks for hop-target filtering: the cached label mask
+    # when the candidate set IS a label (no per-query O(n_nodes) scatter
+    # build — at 10^5 nodes that build dominated the whole query)
+    cand_masks = [
+        catalog.label_mask(pn.labels[0])
+        if (len(pn.labels) == 1 and pn.props is None) else None
+        for pn in nodes
+    ]
 
     def size(i: int) -> int:
         return len(cand[i]) if cand[i] is not None else n_nodes_total
@@ -1019,9 +1201,12 @@ def _match_chain(catalog, path: A.PatternPath, ctx) -> Optional[_Bindings]:
         b.hop_edges.append((pr.types[0], edge_rows))
         # constrain targets by the `to` node's label/prop candidate set
         if cand[to] is not None:
-            keep = np.zeros(n_nodes_total, dtype=bool)
-            keep[cand[to]] = True
-            take_all(keep[targets])
+            if cand_masks[to] is not None:
+                take_all(cand_masks[to][targets])
+            else:
+                keep = np.zeros(n_nodes_total, dtype=bool)
+                keep[cand[to]] = True
+                take_all(keep[targets])
         # Cypher relationship uniqueness: a match may not reuse an edge.
         # Only same-type hops can collide (edge rows are per-type).
         latest = len(b.hop_edges) - 1
@@ -1742,6 +1927,28 @@ def _agg_leaf(
     if not e.args:
         _bail()
     arg = e.args[0]
+    if (
+        name == "count"
+        and e.distinct
+        and isinstance(arg, A.Var)
+        and arg.name in b.stripped_distinct_counts
+    ):
+        # per-row counts of DISTINCT folded-out values (strip view nnz).
+        # Summing them per group is exact only while no two rows of one
+        # group can share a member — rows are distinct group nodes, so
+        # any group holding >1 row (duplicate group-key values) may
+        # overlap and must fall back to real expansion.
+        per_group = np.bincount(codes, minlength=n_groups)[:n_groups]
+        if len(per_group) and per_group.max() > 1:
+            _bail()
+        cnt = np.bincount(
+            codes,
+            weights=b.stripped_distinct_counts[arg.name].astype(np.float64),
+            minlength=n_groups,
+        )[:n_groups].astype(np.int64)
+        out = np.empty(n_groups, dtype=object)
+        out[:] = cnt.tolist()
+        return out
     if (
         name == "count"
         and isinstance(arg, A.Var)
