@@ -85,7 +85,233 @@ def main():
         # the accelerator half must never cost the already-computed
         # Cypher headline
         result["knn"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # north-star configs (BASELINE.json 1/3/4): HNSW build wall-clock
+    # with/without BM25 seeding, ANN QPS@recall95, device PageRank.
+    # Runs AFTER _bench_knn so the jax platform is already safely pinned
+    # (cpu fallback) or live (tpu).
+    try:
+        result["northstar"] = _bench_northstar()
+    except Exception as exc:
+        result["northstar"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
     print(json.dumps(result))
+
+
+def _bench_northstar():
+    """BASELINE.json north-star configs the headline doesn't cover:
+
+    - ``hnsw_build_100k``: wall-clock to build a 100k-embedding HNSW,
+      unseeded vs BM25-seeded insertion order (the reference's marquee
+      2.7x result, docs/release-notes-since-v1.0.11.md:75-151). The
+      seeds come from the real BM25 seed provider over a synthetic
+      clustered corpus (cluster tokens = the high-IDF terms).
+    - ``ann_qps_recall95``: recall@10 vs QPS sweep for HNSW / IVF-HNSW /
+      IVF-PQ against brute force (BASELINE.json's own kNN metric).
+    - ``pagerank_device``: on-device PageRank at LDBC scale (100k nodes,
+      2M edges) vs a pure-NumPy reference loop.
+    """
+    from nornicdb_tpu.search.bm25 import BM25Index
+    from nornicdb_tpu.search.hnsw import HNSWIndex
+    from nornicdb_tpu.search.ivf_hnsw import IVFHNSWIndex
+    from nornicdb_tpu.search.ivfpq import IVFPQIndex
+
+    out = {}
+    rng = np.random.default_rng(5)
+    n, d, centers = 100_000, 64, 256
+    cent = (rng.standard_normal((centers, d)) * 2.0).astype(np.float32)
+    assign = rng.integers(0, centers, n)
+    vecs = (cent[assign]
+            + rng.standard_normal((n, d)).astype(np.float32))
+    ids = [f"v{i}" for i in range(n)]
+    vn = vecs / np.maximum(
+        np.linalg.norm(vecs, axis=1, keepdims=True), 1e-12)
+
+    nq = 200
+    qrows = rng.choice(n, nq, replace=False)
+    qs = vecs[qrows] + 0.3 * rng.standard_normal((nq, d)).astype(np.float32)
+    qn = qs / np.maximum(np.linalg.norm(qs, axis=1, keepdims=True), 1e-12)
+    gt = np.argsort(-(qn @ vn.T), axis=1)[:, :10]
+    gt_sets = [set(f"v{j}" for j in row) for row in gt]
+
+    def recall_of(index, ef=None, nprobe=None):
+        hit = 0
+        for qi in range(nq):
+            kwargs = {}
+            if ef is not None:
+                kwargs["ef"] = ef
+            if nprobe is not None:
+                kwargs["nprobe"] = nprobe
+            res = {h[0] for h in index.search(qs[qi], k=10, **kwargs)}
+            hit += len(res & gt_sets[qi])
+        return hit / (nq * 10)
+
+    def qps_of(index, ef=None, nprobe=None):
+        t0 = time.perf_counter()
+        m = 0
+        while True:
+            for qi in range(nq):
+                kwargs = {}
+                if ef is not None:
+                    kwargs["ef"] = ef
+                if nprobe is not None:
+                    kwargs["nprobe"] = nprobe
+                index.search(qs[qi], k=10, **kwargs)
+            m += nq
+            if time.perf_counter() - t0 > 1.5:
+                break
+        return m / (time.perf_counter() - t0)
+
+    # (1) HNSW build wall-clock, unseeded vs BM25-seeded
+    texts = [f"c{assign[i]} f{i % 7} g{i % 11} common" for i in range(n)]
+    bm25 = BM25Index()
+    bm25.index_batch(list(zip(ids, texts)))
+    seeds = bm25.seed_doc_ids(max_seeds=2048)
+    items = list(zip(ids, vecs))
+    sys.stderr.write("bench: northstar hnsw unseeded build...\n")
+    h1 = HNSWIndex(ef_construction=128)
+    t0 = time.perf_counter()
+    h1.build(items)
+    dt_unseeded = time.perf_counter() - t0
+    r_unseeded = recall_of(h1)
+    sys.stderr.write("bench: northstar hnsw seeded build...\n")
+    h2 = HNSWIndex(ef_construction=128)
+    t0 = time.perf_counter()
+    h2.build(items, seed_ids=seeds)
+    dt_seeded = time.perf_counter() - t0
+    r_seeded = recall_of(h2)
+    out["hnsw_build_100k"] = {
+        "n": n, "dims": d, "ef_construction": 128,
+        "unseeded_wall_s": round(dt_unseeded, 1),
+        "unseeded_recall10": round(r_unseeded, 3),
+        "seeded_wall_s": round(dt_seeded, 1),
+        "seeded_recall10": round(r_seeded, 3),
+        # In the reference, seed-first insertion cuts wall-clock 2.7x
+        # because its serial heap search does less work over a good
+        # backbone. Our batched wave build does ef-bounded work per
+        # insert regardless of backbone quality, so seeding shows up as
+        # recall (backbone quality), not wall-clock — report both.
+        "seeded_speedup": round(dt_unseeded / dt_seeded, 3),
+        "bm25_seeds": len(seeds),
+        "inserts_per_s": round(n / dt_seeded, 1),
+        # reference marquee: 1M x 1024d in ~10 min on a 16-core M3 Max
+        # = ~1,666 inserts/s (docs/release-notes-since-v1.0.11.md:75).
+        # This config is 100k x 64d on one CPU core — stated so the
+        # ratio is read with its caveats.
+        "vs_baseline": round((n / dt_seeded) / 1666.7, 3),
+        "baseline_note": "ref 1M x 1024d @ ~1666 inserts/s on M3 Max; "
+                         "this config 100k x 64d, 1 CPU core",
+    }
+
+    # (2) ANN QPS@recall95 curves vs brute force (reuse the seeded HNSW)
+    sys.stderr.write("bench: northstar ann sweeps...\n")
+    t0 = time.perf_counter()
+    for qi in range(nq):
+        x = qn[qi] @ vn.T
+        np.argpartition(-x, 9)[:10]
+    brute_qps = nq / (time.perf_counter() - t0)
+
+    curves = {"brute_force": {"recall": 1.0, "qps": round(brute_qps, 1)}}
+    sweep = []
+    for ef in (16, 32, 64, 128):
+        sweep.append({"ef": ef, "recall": round(recall_of(h2, ef=ef), 3),
+                      "qps": round(qps_of(h2, ef=ef), 1)})
+    curves["hnsw"] = sweep
+
+    sub = 50_000
+    sub_items = items[:sub]
+    ivf = IVFHNSWIndex(n_clusters=32, ef_construction=128)
+    ivf.build(sub_items, seed_ids=seeds)
+    gt_sub = np.argsort(-(qn @ vn[:sub].T), axis=1)[:, :10]
+    gt_sets_sub = [set(f"v{j}" for j in row) for row in gt_sub]
+
+    def recall_sub(index, **kw):
+        hit = 0
+        for qi in range(nq):
+            res = {h[0] for h in index.search(qs[qi], k=10, **kw)}
+            hit += len(res & gt_sets_sub[qi])
+        return hit / (nq * 10)
+
+    sweep = []
+    for nprobe in (1, 2, 4, 8):
+        t0 = time.perf_counter()
+        for qi in range(nq):
+            ivf.search(qs[qi], k=10, nprobe=nprobe)
+        sweep.append({
+            "nprobe": nprobe,
+            "recall": round(recall_sub(ivf, nprobe=nprobe), 3),
+            "qps": round(nq / (time.perf_counter() - t0), 1),
+        })
+    curves["ivf_hnsw"] = sweep
+
+    pq = IVFPQIndex(n_clusters=64, n_subspaces=8)
+    pq.train(vecs[:20_000])
+    pq.add_batch(sub_items)
+    sweep = []
+    for nprobe in (1, 2, 4, 8):
+        t0 = time.perf_counter()
+        for qi in range(nq):
+            pq.search(qs[qi], k=10, nprobe=nprobe)
+        sweep.append({
+            "nprobe": nprobe,
+            "recall": round(recall_sub(pq, nprobe=nprobe), 3),
+            "qps": round(nq / (time.perf_counter() - t0), 1),
+        })
+    curves["ivfpq"] = sweep
+
+    def qps_at_recall95(entries):
+        ok = [e for e in entries if e["recall"] >= 0.95]
+        return max((e["qps"] for e in ok), default=None)
+
+    out["ann_qps_recall95"] = {
+        "n": n, "n_ivf": sub, "dims": d, "curves": curves,
+        "qps_at_recall95": {
+            "brute_force": round(brute_qps, 1),
+            "hnsw": qps_at_recall95(curves["hnsw"]),
+            "ivf_hnsw": qps_at_recall95(curves["ivf_hnsw"]),
+            "ivfpq": qps_at_recall95(curves["ivfpq"]),
+        },
+    }
+
+    # (3) device PageRank at LDBC scale
+    sys.stderr.write("bench: northstar pagerank...\n")
+    import jax
+
+    from nornicdb_tpu.ops.graph import pagerank_arrays
+
+    pn, pe = 100_000, 2_000_000
+    src = rng.integers(0, pn, pe).astype(np.int32)
+    dst = rng.integers(0, pn, pe).astype(np.int32)
+    iters = 20
+    pagerank_arrays(src, dst, pn, iters=2)  # compile warm-up
+    t0 = time.perf_counter()
+    pr = pagerank_arrays(src, dst, pn, iters=iters)
+    dt_dev = time.perf_counter() - t0
+
+    def pagerank_numpy(src, dst, n, iters, damping=0.85):
+        deg = np.bincount(src, minlength=n).astype(np.float32)
+        p = np.full(n, 1.0 / n, np.float32)
+        for _ in range(iters):
+            contrib = np.where(deg > 0, p / np.maximum(deg, 1), 0.0)
+            nxt = np.zeros(n, np.float32)
+            np.add.at(nxt, dst, contrib[src])
+            dangling = p[deg == 0].sum() / n
+            p = (1 - damping) / n + damping * (nxt + dangling)
+        return p
+
+    t0 = time.perf_counter()
+    pr_np = pagerank_numpy(src, dst, pn, iters)
+    dt_np = time.perf_counter() - t0
+    agree = bool(
+        np.allclose(np.asarray(pr), pr_np, rtol=5e-3, atol=1e-7)
+    )
+    out["pagerank_device"] = {
+        "nodes": pn, "edges": pe, "iters": iters,
+        "backend": jax.devices()[0].platform,
+        "wall_s": round(dt_dev, 3),
+        "edge_iters_per_s": round(pe * iters / dt_dev, 1),
+        "speedup_vs_numpy": round(dt_np / dt_dev, 2),
+        "matches_numpy_reference": agree,
+    }
+    return out
 
 
 def _bench_knn():

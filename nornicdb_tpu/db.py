@@ -132,7 +132,17 @@ class DB:
         transport = ClusterTransport(cfg.node_id, cfg.listen)
         transport.start()
         self._cluster_transport = transport
-        if cfg.mode in ("ha_standby", "multi_region"):
+        if cfg.mode == "multi_region":
+            from nornicdb_tpu.replication import MultiRegionNode
+
+            def mr_apply_fn(op, data, _chain=chain):
+                getattr(_chain, op)(*decode_op_args(op, data))
+
+            rep = MultiRegionNode(transport, cfg, mr_apply_fn)
+            rep.start()
+            self.replicator = rep
+            return ReplicatedEngine(chain, rep)
+        if cfg.mode == "ha_standby":
             if not isinstance(self._base, WALEngine):
                 transport.close()
                 raise ValueError(

@@ -144,8 +144,9 @@ class _StripView:
 
     Incrementally maintained on edge creates of either type; the catalog
     drops the view when it cannot update exactly (unknown node rows,
-    missing adjacency). Arrays are copy-on-write: readers hold
-    internally-consistent snapshots.
+    missing adjacency, over-budget probes). Updates are in-place single
+    int64 stores — aligned and untearable for concurrent readers; node
+    creates extend arrays copy-on-write (np.append).
     """
 
     __slots__ = ("deg", "sum_deg", "nnz")
@@ -163,7 +164,7 @@ class _GramView:
     edge to b-candidate j (the same-edge diagonal correction is folded
     in at build). ``far_lists`` maps mid global row -> list of far
     global rows of its existing usable edges, so an edge create updates
-    C in O(deg(mid)). C is copy-on-write for lock-free readers.
+    C in O(deg(mid)) with in-place (untearable) int64 stores.
     """
 
     __slots__ = ("C", "a_cands", "b_cands", "a_pos", "b_pos", "far_lists")
@@ -350,22 +351,31 @@ class ColumnarCatalog:
         for key in [k for k in self._gram_views if k[0] == et]:
             self._gram_views.pop(key)
 
+    # a view update without a CSR falls back to one vectorized scan of
+    # the etype1 table; past this size, dropping the view (lazy rebuild
+    # on next read) is cheaper than scanning per create
+    NEIGHBOR_SCAN_MAX_EDGES = 200_000
+
     def _update_degrees_locked(self, et: str, s: int, d: int) -> None:
-        """Copy-on-write += on cached (etype, direction, label) degrees."""
+        """In-place += on cached (etype, direction, label) degrees.
+        Single aligned int64 stores can't tear for concurrent readers;
+        cross-array consistency during a write is no weaker than the
+        copy-on-write alternative (arrays swap independently either
+        way) and this keeps per-create cost O(1) instead of O(n)."""
         for key in [k for k in self._filtered_deg if k[0] == et]:
             _et, kdir, klabel = key
             row, far = (s, d) if kdir == "out" else (d, s)
             if klabel is None or klabel in self._nodes[far].labels:
-                arr = self._filtered_deg[key].copy()
-                arr[row] += 1
-                self._filtered_deg[key] = arr
+                self._filtered_deg[key][row] += 1
 
     def _table_neighbors_locked(
         self, tbl: EdgeTable, probe_side: str, row: int
-    ) -> np.ndarray:
+    ) -> Optional[np.ndarray]:
         """Rows on the OTHER side of ``tbl`` edges whose ``probe_side``
         ('src'|'dst') endpoint is ``row`` — with multiplicity. Uses the
-        cached CSR when built, else one vectorized scan of the table."""
+        cached CSR when built, else one vectorized scan of the table;
+        None when the table is too big to scan per create (the caller
+        drops its view)."""
         if probe_side == "src":
             csr, keys, other = tbl._csr_out, tbl.src, tbl.dst
         else:
@@ -373,6 +383,8 @@ class ColumnarCatalog:
         if csr is not None:
             indptr, order = csr
             return other[order[indptr[row]:indptr[row + 1]]]
+        if len(keys) > self.NEIGHBOR_SCAN_MAX_EDGES:
+            return None
         return other[keys == row]
 
     def _update_strip_views_locked(self, et: str, s: int, d: int) -> None:
@@ -390,25 +402,22 @@ class ColumnarCatalog:
                 if tbl1 is None:
                     self._strip_views.pop(key)
                     continue
-                sum_deg = sv.sum_deg.copy()
-                sum_deg[g] += dp
-                sv.sum_deg = sum_deg
                 # nnz counts DISTINCT p per g: a second parallel edge
                 # (g, p) must not re-count p
                 p_side = "dst" if g_side == "src" else "src"
                 known_gs = self._table_neighbors_locked(tbl1, p_side, p)
+                if known_gs is None:
+                    self._strip_views.pop(key)  # too big to probe
+                    continue
+                sv.sum_deg[g] += dp
                 if not (known_gs == g).any():
-                    nnz = sv.nnz.copy()
-                    nnz[g] += 1
-                    sv.nnz = nnz
+                    sv.nnz[g] += 1
             elif et == etype2:
                 p, f = (s, d) if dir2 == "out" else (d, s)
                 if f_label is not None and f_label not in self._nodes[f].labels:
                     continue
                 old = int(sv.deg[p])
-                deg = sv.deg.copy()
-                deg[p] += 1
-                sv.deg = deg
+                sv.deg[p] += 1
                 if p_label is not None and p_label not in self._nodes[p].labels:
                     continue
                 tbl1 = self._edge_tables.get(etype1)
@@ -417,15 +426,14 @@ class ColumnarCatalog:
                     continue
                 p_side = "dst" if g_side == "src" else "src"
                 gs = self._table_neighbors_locked(tbl1, p_side, p)
+                if gs is None:
+                    self._strip_views.pop(key)  # too big to probe
+                    continue
                 if len(gs) == 0:
                     continue
-                sum_deg = sv.sum_deg.copy()
-                np.add.at(sum_deg, gs, 1)
-                sv.sum_deg = sum_deg
+                np.add.at(sv.sum_deg, gs, 1)
                 if old == 0:
-                    nnz = sv.nnz.copy()
-                    nnz[np.unique(gs)] += 1
-                    sv.nnz = nnz
+                    sv.nnz[np.unique(gs)] += 1
 
     def _update_gram_views_locked(self, et: str, s: int, d: int) -> None:
         for key in list(self._gram_views):
@@ -445,7 +453,7 @@ class ColumnarCatalog:
                 continue
             lst = gv.far_lists.get(mid)
             if lst:
-                C = gv.C.copy()
+                C = gv.C  # in-place: single int64 cells can't tear
                 for f2 in lst:
                     if fb:
                         ap = int(gv.a_pos[f2])
@@ -455,7 +463,6 @@ class ColumnarCatalog:
                         bp = int(gv.b_pos[f2])
                         if bp >= 0:
                             C[int(gv.a_pos[far]), bp] += 1
-                gv.C = C
             if lst is None:
                 gv.far_lists[mid] = [far]
             else:

@@ -23,13 +23,19 @@ from nornicdb_tpu.replication.replicator import (
 from nornicdb_tpu.replication.replicated_engine import ReplicatedEngine
 from nornicdb_tpu.replication.ha_standby import HAPrimary, HAStandby
 from nornicdb_tpu.replication.raft import RaftNode
+from nornicdb_tpu.replication.multi_region import (
+    MultiRegionNode,
+    NotPrimaryRegionError,
+)
 
 __all__ = [
     "ClusterMessage",
     "ClusterTransport",
     "HAPrimary",
     "HAStandby",
+    "MultiRegionNode",
     "NotPrimaryError",
+    "NotPrimaryRegionError",
     "RaftNode",
     "ReplicatedEngine",
     "ReplicationConfig",

@@ -1,0 +1,332 @@
+"""Multi-region replication: Raft per region, async streams between.
+
+Reference: pkg/replication/multi_region.go — each region runs its own
+Raft cluster for strong local consistency; the primary region's raft
+leader asynchronously streams committed entries to remote region
+coordinators; region failover promotes a remote region to primary.
+
+This redesign tightens two things the reference leaves loose:
+
+- **Region fencing.** Every cross-region message carries a region
+  epoch. ``promote_region()`` bumps the epoch and broadcasts a fence;
+  the deposed primary region demotes itself the moment it sees the
+  higher epoch, so two regions can never both accept writes after a
+  failover heals (the reference only flips an ``isPrimary`` bool).
+- **Exact convergence.** The raft log index doubles as the cross-region
+  sequence: receivers apply strictly in order, buffer out-of-order
+  batches, and pull gaps via ``xr_sync`` catch-up — the same
+  watermark + reorder-buffer discipline the HA standby uses
+  (ha_standby.py), so a partitioned region converges exactly once the
+  link heals.
+
+All handlers are plain methods over the loopback ClusterTransport, so
+multi-region clusters run in one process for tests (SURVEY.md §4
+"multi-node without a real cluster").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nornicdb_tpu.replication.raft import RaftNode
+from nornicdb_tpu.replication.replicator import (
+    NotPrimaryError,
+    ReplicationConfig,
+    Replicator,
+    Role,
+)
+from nornicdb_tpu.replication.transport import ClusterMessage, ClusterTransport
+
+Addr = Tuple[str, int]
+
+
+class NotPrimaryRegionError(NotPrimaryError):
+    """Write landed on a region that is not the primary region."""
+
+
+class MultiRegionNode(Replicator):
+    """One node of one region in a multi-region deployment.
+
+    ``config.peers`` are the node's in-region raft peers;
+    ``config.remote_regions`` maps remote region ids to their node
+    addresses. ``config.region_primary`` marks the initially-primary
+    region (reference: first region listed is primary).
+    """
+
+    def __init__(
+        self,
+        transport: ClusterTransport,
+        config: ReplicationConfig,
+        apply_fn: Callable[[str, Dict[str, Any]], None],
+    ):
+        self.transport = transport
+        self.config = config
+        self._apply_fn = apply_fn
+        self.region_id = config.region_id
+        self.region_epoch = 1
+        self._is_primary_region = bool(config.region_primary)
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        # streaming state (leader of primary region): per remote region,
+        # the highest raft index acked by that region
+        self._streamed: Dict[str, int] = {}
+        # receiving state: per origin region, applied watermark and the
+        # out-of-order buffer
+        self._applied_from: Dict[str, int] = {}
+        self._reorder: Dict[str, Dict[int, Dict[str, Any]]] = {}
+
+        self._raft = RaftNode(transport, config, self._apply_local)
+        transport.register_handler("xr_batch", self.handle_xr_batch)
+        transport.register_handler("xr_sync", self.handle_xr_sync)
+        transport.register_handler("region_fence", self.handle_region_fence)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._raft.start()
+        threading.Thread(
+            target=self._stream_loop, daemon=True,
+            name=f"xregion-{self.config.node_id}",
+        ).start()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._raft.close()
+
+    # -- replicator ------------------------------------------------------
+
+    def apply(self, op: str, data: Dict[str, Any]) -> None:
+        """Client write: only the primary region coordinates writes
+        (reference: 'one region is designated as primary for write
+        coordination'); within it, only the local raft leader accepts."""
+        with self._lock:
+            if not self._is_primary_region:
+                raise NotPrimaryRegionError(self.primary_region_hint())
+        self._raft.apply(op, data)
+
+    def _apply_local(self, op: str, data: Dict[str, Any]) -> None:
+        """Raft commit callback — every committed entry (local write or
+        cross-region import) lands here on every in-region node."""
+        self._apply_fn(op, data)
+
+    @property
+    def role(self) -> Role:
+        return self._raft.role
+
+    @property
+    def is_primary_region(self) -> bool:
+        with self._lock:
+            return self._is_primary_region
+
+    def primary_region_hint(self) -> Optional[str]:
+        return None  # a deposed region learns the new primary by fence
+
+    # -- cross-region streaming (primary-region raft leader only) ---------
+
+    def _stream_loop(self) -> None:
+        interval = getattr(self.config, "xregion_interval", 0.1)
+        while not self._closed.wait(interval):
+            with self._lock:
+                if not self._is_primary_region:
+                    continue
+                epoch = self.region_epoch
+            if self._raft.role is not Role.PRIMARY:
+                continue
+            self._stream_once(epoch)
+
+    def _stream_once(self, epoch: int) -> None:
+        for region, addrs in self.config.remote_regions:
+            acked = self._streamed.get(region, 0)
+            entries = self._raft.committed_entries(acked)
+            if not entries:
+                continue
+            msg: ClusterMessage = {
+                "type": "xr_batch",
+                "region": self.region_id,
+                "epoch": epoch,
+                "records": [
+                    {"xseq": i, "op": op, "data": data}
+                    for i, op, data in entries
+                ],
+            }
+            for addr in addrs:
+                try:
+                    reply = self.transport.request(tuple(addr), msg)
+                except ConnectionError:
+                    continue
+                if reply.get("ok"):
+                    self._streamed[region] = int(
+                        reply.get("applied_xseq", acked)
+                    )
+                    break
+                if reply.get("error") == "fenced":
+                    # a higher-epoch region exists: demote ourselves
+                    self._demote(int(reply.get("epoch", epoch)))
+                    return
+                # not the remote leader: try the next address
+
+    # -- receiving side ---------------------------------------------------
+
+    def handle_xr_batch(self, msg: ClusterMessage) -> ClusterMessage:
+        origin = msg.get("region", "?")
+        epoch = int(msg.get("epoch", 0))
+        with self._lock:
+            if epoch < self.region_epoch:
+                return {"ok": False, "error": "fenced",
+                        "epoch": self.region_epoch}
+            if epoch > self.region_epoch:
+                # a newer primary region is streaming: adopt its epoch
+                # and drop any stale primary claim of our own
+                self.region_epoch = epoch
+                self._is_primary_region = False
+        if self._raft.role is not Role.PRIMARY:
+            return {"ok": False, "error": "not_leader",
+                    "leader": self._raft.leader_id}
+        try:
+            applied = self._apply_batch(origin, msg.get("records", []))
+            if not applied:
+                # a gap precedes the buffered records: pull the missing
+                # range from the origin region
+                self._catch_up(origin, msg)
+        except NotPrimaryError:
+            # lost in-region leadership mid-batch; the streamer retries
+            # against the new leader next tick
+            return {"ok": False, "error": "not_leader",
+                    "leader": self._raft.leader_id}
+        with self._lock:
+            return {
+                "ok": True,
+                "applied_xseq": self._applied_from.get(origin, 0),
+            }
+
+    def _apply_batch(
+        self, origin: str, records: List[Dict[str, Any]]
+    ) -> bool:
+        """Apply in xseq order through the LOCAL raft so the whole
+        region converges; buffer out-of-order. Returns False when a gap
+        blocked progress."""
+        progressed = True
+        for rec in sorted(records, key=lambda r: r.get("xseq", 0)):
+            xseq = int(rec.get("xseq", 0))
+            with self._lock:
+                watermark = self._applied_from.get(origin, 0)
+                if xseq <= watermark:
+                    continue  # duplicate (re-stream after failover)
+                if xseq > watermark + 1:
+                    self._reorder.setdefault(origin, {})[xseq] = rec
+                    progressed = False
+                    continue
+            self._raft.apply(rec["op"], rec["data"])
+            with self._lock:
+                self._applied_from[origin] = xseq
+                buf = self._reorder.get(origin, {})
+            # drain any directly-following buffered records
+            while True:
+                with self._lock:
+                    nxt = buf.pop(self._applied_from.get(origin, 0) + 1,
+                                  None)
+                if nxt is None:
+                    break
+                self._raft.apply(nxt["op"], nxt["data"])
+                with self._lock:
+                    self._applied_from[origin] += 1
+        return progressed
+
+    def _catch_up(self, origin: str, msg: ClusterMessage) -> None:
+        addrs = dict(self.config.remote_regions).get(origin)
+        if not addrs:
+            return
+        with self._lock:
+            from_xseq = self._applied_from.get(origin, 0)
+        req = {"type": "xr_sync", "region": self.region_id,
+               "from_xseq": from_xseq}
+        for addr in addrs:
+            try:
+                reply = self.transport.request(tuple(addr), req)
+            except ConnectionError:
+                continue
+            if reply.get("ok"):
+                self._apply_batch(origin, reply.get("records", []))
+                return
+
+    def handle_xr_sync(self, msg: ClusterMessage) -> ClusterMessage:
+        """Serve a catch-up request from a remote region: committed raft
+        entries after its watermark."""
+        from_xseq = int(msg.get("from_xseq", 0))
+        entries = self._raft.committed_entries(from_xseq)
+        return {
+            "ok": True,
+            "records": [
+                {"xseq": i, "op": op, "data": data}
+                for i, op, data in entries
+            ],
+        }
+
+    # -- failover ---------------------------------------------------------
+
+    def promote_region(self) -> None:
+        """Promote this region to primary (reference: RegionFailover).
+        Must run on the region's raft leader. Bumps the region epoch and
+        fences every remote region — the deposed primary demotes on
+        sight of the higher epoch."""
+        if self._raft.role is not Role.PRIMARY:
+            raise NotPrimaryError(self._raft.leader_id)
+        with self._lock:
+            self.region_epoch += 1
+            self._is_primary_region = True
+            epoch = self.region_epoch
+            # everything committed here so far was imported from (or
+            # already shared with) the other regions — streaming it back
+            # would re-append the whole history to their logs on every
+            # failover. Start the outbound stream at the promotion point.
+            start = self._raft.commit_index
+            for region, _addrs in self.config.remote_regions:
+                self._streamed.setdefault(region, 0)
+                self._streamed[region] = max(self._streamed[region], start)
+        fence: ClusterMessage = {
+            "type": "region_fence",
+            "region": self.region_id,
+            "epoch": epoch,
+        }
+        for _region, addrs in self.config.remote_regions:
+            for addr in addrs:
+                try:
+                    self.transport.request(tuple(addr), fence)
+                    break
+                except ConnectionError:
+                    continue
+
+    def handle_region_fence(self, msg: ClusterMessage) -> ClusterMessage:
+        epoch = int(msg.get("epoch", 0))
+        with self._lock:
+            if epoch > self.region_epoch:
+                self.region_epoch = epoch
+                self._is_primary_region = False
+                return {"ok": True}
+            return {"ok": False, "error": "stale fence epoch",
+                    "epoch": self.region_epoch}
+
+    def _demote(self, epoch: int) -> None:
+        with self._lock:
+            if epoch > self.region_epoch:
+                self.region_epoch = epoch
+            self._is_primary_region = False
+
+    # -- introspection ----------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Reference: Health() — mode, region, role, epoch, stream state."""
+        with self._lock:
+            return {
+                "mode": "multi_region",
+                "node_id": self.config.node_id,
+                "region": self.region_id,
+                "region_epoch": self.region_epoch,
+                "is_primary_region": self._is_primary_region,
+                "raft_role": self._raft.role.value,
+                "raft_leader": self._raft.leader_id,
+                "streamed": dict(self._streamed),
+                "applied_from": dict(self._applied_from),
+            }

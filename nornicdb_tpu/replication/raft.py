@@ -100,6 +100,19 @@ class RaftNode(Replicator):
                     raise TimeoutError("raft commit timeout")
                 self._commit_cv.wait(timeout=min(remaining, 0.2))
 
+    def committed_entries(
+        self, from_index: int
+    ) -> List[Tuple[int, str, Dict[str, Any]]]:
+        """Committed log entries with 1-based index > ``from_index`` —
+        the cross-region streaming feed (multi_region.py): the raft log
+        index doubles as the region's replication sequence."""
+        with self._lock:
+            hi = self.commit_index
+            return [
+                (i, self.log[i - 1]["op"], self.log[i - 1]["data"])
+                for i in range(from_index + 1, hi + 1)
+            ]
+
     # -- election --------------------------------------------------------
 
     def _election_timeout(self) -> float:
@@ -224,6 +237,12 @@ class RaftNode(Replicator):
     def _advance_commit(self, match_counts: Dict[int, int]) -> None:
         with self._commit_cv:
             if self._state is not Role.PRIMARY:
+                return
+            if not self.config.peers:
+                # single-node cluster: the leader alone is the majority
+                self.commit_index = len(self.log)
+                self._apply_committed()
+                self._commit_cv.notify_all()
                 return
             need = (len(self.config.peers) + 1) // 2 + 1
             for idx in sorted(match_counts, reverse=True):

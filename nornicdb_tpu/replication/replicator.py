@@ -42,8 +42,18 @@ class ReplicationConfig:
     heartbeat_interval: float = 0.5
     election_timeout: Tuple[float, float] = (1.5, 3.0)  # randomized range
     failover_timeout: float = 3.0  # missed-heartbeat window before takeover
-    ha_role: str = "primary"  # primary | standby (ha_standby/multi_region)
+    ha_role: str = "primary"  # primary | standby (ha_standby)
     primary_addr: Optional[Tuple[str, int]] = None  # standby's upstream
+    # multi_region (reference: config.go:125-129 MultiRegion section):
+    # this node's region, whether that region starts as the primary
+    # (write-coordinating) region, the remote regions' node addresses,
+    # and the async cross-region streaming tick
+    region_id: str = "region-0"
+    region_primary: bool = True
+    remote_regions: List[Tuple[str, List[Tuple[str, int]]]] = field(
+        default_factory=list
+    )
+    xregion_interval: float = 0.1
 
 
 class Replicator:

@@ -1,15 +1,22 @@
-"""HNSW approximate-nearest-neighbor index.
+"""HNSW approximate-nearest-neighbor index with batched wave builds.
 
 Re-expresses the reference's custom HNSW (pkg/search/hnsw_index.go:74
 ``HNSWIndex``, Add :174, SearchWithEf :342, heap-pooled layer search :973,
 tombstones + ShouldRebuild :456, Save/Load :490,568) for the TPU design:
 
-- the graph walk is inherently serial/pointer-chasing and stays on CPU
-  (SURVEY.md §7 "hard parts");
-- distance evaluations are *batched*: a node's whole neighbor list is
-  scored with one NumPy matrix-vector product (the CPU analog of the
-  reference's GPU distance batches), and build candidate sets can be
-  scored on-device for large indexes;
+- the graph walk is inherently serial/pointer-chasing per query and
+  stays on the host (SURVEY.md §7 "hard parts") — but it vectorizes
+  *across queries*: adjacency is stored as padded int32 matrices (one
+  [n, width] matrix per level), so a whole batch of beam searches runs
+  as gathers + one ``einsum`` per expansion step instead of per-node
+  Python heap churn. This is the layout GPU/TPU bulk builders use
+  (batch-parallel construction), and the arrays feed the device
+  data plane unchanged.
+- ``build()`` inserts in *waves*: each wave's beam searches run batched
+  against the pre-wave graph, then links are connected host-side. Wave
+  sizes are capped relative to the current graph so intra-wave
+  blindness (wave members not seeing each other) cannot degrade the
+  backbone — the same trade bulk GPU HNSW builders make.
 - **BM25-seeded insertion order**: lexically discriminative docs are
   inserted first to form a high-quality backbone (reference
   search.go:3785-3871; 2.7x faster 1M-vector builds).
@@ -55,11 +62,17 @@ class HNSWIndex:
         self._slot_of: Dict[str, int] = {}
         self._alive: List[bool] = []
         self._levels: List[int] = []
-        # _neighbors[slot][level] -> list of neighbor slots
-        self._neighbors: List[List[List[int]]] = []
+        # per-level padded adjacency: _nbrL[lv] int32 [cap, width] (-1
+        # pad), _cntL[lv] int32 [cap]; width = m0 at level 0 else m
+        self._nbrL: List[np.ndarray] = []
+        self._cntL: List[np.ndarray] = []
         self._entry: int = -1
         self._max_level: int = -1
         self._tombstones = 0
+        # reusable visited-stamp scratch for batched searches (guarded by
+        # self._lock); zeroed only when the uint8 generation space wraps
+        self._visit_buf: Optional[np.ndarray] = None
+        self._visit_base = 0
 
     def __len__(self) -> int:
         return len(self._slot_of)
@@ -90,6 +103,24 @@ class HNSWIndex:
         n = np.linalg.norm(v)
         return v / n if n > 1e-12 else v
 
+    # adjacency rows carry this much slack past the degree cap; a row is
+    # pruned back to the cap only when the slack fills, amortizing the
+    # (vectorized but still per-node) diversity prune across ~SLACK
+    # back-link insertions
+    SLACK = 8
+
+    def _level_width(self, lv: int) -> int:
+        return (self.m0 if lv == 0 else self.m) + self.SLACK
+
+    def _level_cap(self, lv: int) -> int:
+        return self.m0 if lv == 0 else self.m
+
+    def _ensure_level(self, lv: int) -> None:
+        while len(self._nbrL) <= lv:
+            w = self._level_width(len(self._nbrL))
+            self._nbrL.append(np.full((self._capacity, w), -1, np.int32))
+            self._cntL.append(np.zeros(self._capacity, np.int32))
+
     def _grow(self, needed: int, dims: int) -> None:
         if self.dims is None:
             self.dims = dims
@@ -102,19 +133,37 @@ class HNSWIndex:
         if self._vectors is not None:
             new_m[: self._capacity] = self._vectors
         self._vectors = new_m
+        for lv in range(len(self._nbrL)):
+            w = self._nbrL[lv].shape[1]
+            grown = np.full((new_cap, w), -1, np.int32)
+            grown[: self._capacity] = self._nbrL[lv]
+            self._nbrL[lv] = grown
+            gcnt = np.zeros(new_cap, np.int32)
+            gcnt[: self._capacity] = self._cntL[lv]
+            self._cntL[lv] = gcnt
         self._capacity = new_cap
 
-    def _dist_many(self, q: np.ndarray, slots: Sequence[int]) -> np.ndarray:
+    def _neighbors_of(self, slot: int, lv: int) -> np.ndarray:
+        return self._nbrL[lv][slot, : self._cntL[lv][slot]]
+
+    def _set_neighbors(self, slot: int, lv: int, nbrs: Sequence[int]) -> None:
+        w = self._nbrL[lv].shape[1]
+        nbrs = list(nbrs)[:w]
+        self._nbrL[lv][slot, : len(nbrs)] = nbrs
+        self._nbrL[lv][slot, len(nbrs):] = -1
+        self._cntL[lv][slot] = len(nbrs)
+
+    def _dist_many(self, q: np.ndarray, slots: np.ndarray) -> np.ndarray:
         """Batched cosine distances (1 - dot) — one mat-vec per call."""
-        idx = np.asarray(slots, dtype=np.int64)
-        return 1.0 - self._vectors[idx] @ q
+        return 1.0 - self._vectors[slots] @ q
 
     # -- layer search (reference: searchLayerHeapPooled :973) --------------
 
     def _search_layer(
         self, q: np.ndarray, entries: List[Tuple[float, int]], ef: int, level: int
     ) -> List[Tuple[float, int]]:
-        """Beam search one layer. entries/result: (dist, slot) min-heaps."""
+        """Beam search one layer, single query (latency path).
+        entries/result: (dist, slot) min-heaps."""
         visited = {s for _, s in entries}
         candidates = list(entries)  # min-heap by dist
         heapq.heapify(candidates)
@@ -124,13 +173,12 @@ class HNSWIndex:
             d, slot = heapq.heappop(candidates)
             if result and d > -result[0][0]:
                 break
-            neigh = [
-                n for n in self._neighbors[slot][level] if n not in visited
-            ]
+            row = self._neighbors_of(slot, level)
+            neigh = [n for n in row.tolist() if n not in visited]
             if not neigh:
                 continue
             visited.update(neigh)
-            dists = self._dist_many(q, neigh)
+            dists = self._dist_many(q, np.asarray(neigh, np.int64))
             worst = -result[0][0] if result else float("inf")
             for nd, ns in zip(dists, neigh):
                 nd = float(nd)
@@ -147,25 +195,76 @@ class HNSWIndex:
     ) -> List[int]:
         """Heuristic neighbor selection with diversity pruning: a candidate
         is kept only if it is closer to the query than to any already-kept
-        neighbor (standard HNSW heuristic)."""
+        neighbor (standard HNSW heuristic). Vectorized: one pairwise
+        distance matrix over the (4m-capped) candidate list, then a
+        greedy mask update per kept neighbor — no per-candidate matvec."""
+        cands = cands[: 4 * m]
+        C = len(cands)
+        if C <= m:
+            return [s for _, s in cands]
+        slots = np.fromiter((s for _, s in cands), dtype=np.int64, count=C)
+        dq = np.fromiter((d for d, _ in cands), dtype=np.float32, count=C)
+        V = self._vectors[slots]
+        M = 1.0 - V @ V.T  # [C, C] candidate-candidate distances
+        ok = np.ones(C, dtype=bool)
+        taken = np.zeros(C, dtype=bool)
         kept: List[int] = []
-        for d, slot in cands:
+        for i in range(C):
+            if not ok[i]:
+                continue
+            kept.append(int(slots[i]))
+            taken[i] = True
             if len(kept) >= m:
                 break
-            if not kept:
-                kept.append(slot)
-                continue
-            d_to_kept = 1.0 - self._vectors[kept] @ self._vectors[slot]
-            if np.all(d < d_to_kept):
-                kept.append(slot)
+            # survivors must be closer to the query than to neighbor i
+            ok &= dq < M[:, i]
+            ok[i] = False
         # backfill with closest if the heuristic was too aggressive
         if len(kept) < m:
-            for d, slot in cands:
-                if slot not in kept:
-                    kept.append(slot)
+            for i in range(C):
+                if not taken[i]:
+                    kept.append(int(slots[i]))
+                    taken[i] = True
                     if len(kept) >= m:
                         break
         return kept
+
+    def _visit_scratch(self, rows: int) -> Tuple[np.ndarray, np.ndarray]:
+        """[rows, capacity] stamp buffer + per-row generation starts
+        (caller holds the lock and consumes at most 16 generations —
+        one per (level, phase), far above any real level count). The
+        buffer is reallocated only on growth and zeroed only when the
+        uint8 generation space wraps, instead of ~100MB of fresh zeroed
+        pages per wave."""
+        buf = self._visit_buf
+        if (buf is None or buf.shape[0] < rows
+                or buf.shape[1] < self._capacity):
+            rows_cap = max(rows, self.WAVE_MAX)
+            self._visit_buf = buf = np.zeros(
+                (rows_cap, self._capacity), np.uint8)
+            self._visit_base = 0
+        if self._visit_base > 239:
+            buf[:] = 0
+            self._visit_base = 0
+        base = self._visit_base
+        self._visit_base = base + 16
+        return buf, np.full(rows, base, np.uint8)
+
+    def _add_link(self, c: int, lv: int, slot: int) -> None:
+        """Append back-link c -> slot; when the slack fills, prune the
+        row back to the level's degree cap."""
+        cnt = int(self._cntL[lv][c])
+        w = self._nbrL[lv].shape[1]
+        if cnt < w:
+            self._nbrL[lv][c, cnt] = slot
+            self._cntL[lv][c] = cnt + 1
+            return
+        nb = self._nbrL[lv][c].tolist() + [slot]
+        d = 1.0 - self._vectors[nb] @ self._vectors[c]
+        order = sorted(zip(d.tolist(), nb))
+        self._set_neighbors(
+            c, lv, self._select_neighbors(order, self._level_cap(lv))
+        )
 
     # -- insert (reference: Add :174) --------------------------------------
 
@@ -177,47 +276,56 @@ class HNSWIndex:
                 # edges anchored in the old region (silent recall loss);
                 # tombstone the old slot and insert fresh so links re-form
                 self.remove(ext_id)
-            self._grow(self._count + 1, v.shape[0])
-            slot = self._count
-            self._count += 1
-            self._vectors[slot] = v
-            self._ext_ids.append(ext_id)
-            self._slot_of[ext_id] = slot
-            self._alive.append(True)
             level = int(-math.log(max(self._rng.random(), 1e-12)) * self._ml)
-            self._levels.append(level)
-            self._neighbors.append([[] for _ in range(level + 1)])
-
+            slot = self._alloc_slot(ext_id, v, level)
             if self._entry < 0:
                 self._entry = slot
                 self._max_level = level
                 return
-
-            # greedy descend from the top to level+1
-            ep = [(float(1.0 - self._vectors[self._entry] @ v), self._entry)]
-            for lv in range(self._max_level, level, -1):
-                ep = self._search_layer(v, ep, 1, lv)
-
-            # connect on each level from min(max_level, level) down to 0
-            for lv in range(min(self._max_level, level), -1, -1):
-                cands = self._search_layer(v, ep, self.ef_construction, lv)
-                m_max = self.m0 if lv == 0 else self.m
-                chosen = self._select_neighbors(cands, self.m)
-                self._neighbors[slot][lv] = list(chosen)
-                for c in chosen:
-                    nb = self._neighbors[c][lv]
-                    nb.append(slot)
-                    if len(nb) > m_max:
-                        # re-prune the overfull neighbor's list
-                        d = 1.0 - self._vectors[nb] @ self._vectors[c]
-                        order = sorted(zip(d.tolist(), nb))
-                        self._neighbors[c][lv] = self._select_neighbors(
-                            order, m_max
-                        )
-                ep = cands
+            self._connect(slot, v, level)
             if level > self._max_level:
                 self._max_level = level
                 self._entry = slot
+
+    def _alloc_slot(self, ext_id: str, v: np.ndarray, level: int) -> int:
+        self._grow(self._count + 1, v.shape[0])
+        slot = self._count
+        self._count += 1
+        self._vectors[slot] = v
+        self._ext_ids.append(ext_id)
+        self._slot_of[ext_id] = slot
+        self._alive.append(True)
+        self._levels.append(level)
+        self._ensure_level(max(level, 0))
+        return slot
+
+    def _connect(self, slot: int, v: np.ndarray, level: int) -> None:
+        """Descend + link one node (single-query latency path)."""
+        ep = [(float(1.0 - self._vectors[self._entry] @ v), self._entry)]
+        for lv in range(self._max_level, level, -1):
+            ep = self._search_layer(v, ep, 1, lv)
+        for lv in range(min(self._max_level, level), -1, -1):
+            cands = self._search_layer(v, ep, self.ef_construction, lv)
+            self._link_from_cands(slot, lv, cands)
+            ep = cands
+
+    def _link_from_cands(
+        self, slot: int, lv: int, cands: List[Tuple[float, int]]
+    ) -> None:
+        chosen = self._select_neighbors(cands, self.m)
+        self._set_neighbors(slot, lv, chosen)
+        for c in chosen:
+            self._add_link(c, lv, slot)
+
+    # -- bulk build (batched waves) -----------------------------------------
+
+    # Wave members search the pre-wave graph only; capping the wave at
+    # this fraction of the current graph keeps the backbone intact. The
+    # absolute cap bounds the [wave, capacity] visited buffer and keeps
+    # per-step gathers cache-sized.
+    WAVE_FRACTION = 8
+    WAVE_MAX = 1024
+    BOOTSTRAP = 256
 
     def build(
         self,
@@ -226,7 +334,9 @@ class HNSWIndex:
     ) -> None:
         """Bulk build; if ``seed_ids`` given (BM25 seeds), those docs are
         inserted first to form the backbone (reference: seed-first build,
-        search.go:3785-3871)."""
+        search.go:3785-3871). Inserts run in batched waves: every wave's
+        beam searches are vectorized across the wave (one einsum per
+        expansion step), then links connect host-side."""
         if seed_ids:
             seed_set = set(seed_ids)
             by_id = {i: v for i, v in items}
@@ -234,8 +344,209 @@ class HNSWIndex:
             ordered += [(i, v) for i, v in items if i not in seed_set]
         else:
             ordered = list(items)
-        for ext_id, vec in ordered:
-            self.add(ext_id, vec)
+        with self._lock:
+            i = 0
+            n = len(ordered)
+            while i < n and self._count < self.BOOTSTRAP:
+                self.add(*ordered[i])
+                i += 1
+            while i < n:
+                wave = min(
+                    max(64, self._count // self.WAVE_FRACTION),
+                    self.WAVE_MAX,
+                )
+                batch = ordered[i: i + wave]
+                i += len(batch)
+                self._build_wave(batch)
+
+    def _build_wave(self, batch: Sequence[Tuple[str, Sequence[float]]]) -> None:
+        # intra-wave duplicate ids: keep the last occurrence (add()'s
+        # overwrite order); without this, two alive slots share one id
+        # and remove() can only ever reach the tracked one
+        last = {ext_id: i for i, (ext_id, _) in enumerate(batch)}
+        if len(last) != len(batch):
+            batch = [bv for i, bv in enumerate(batch)
+                     if last[bv[0]] == i]
+        B = len(batch)
+        Q = np.stack([
+            self._normalize(np.asarray(v, dtype=np.float32))
+            for _, v in batch
+        ])
+        # duplicate ids: tombstone + reinsert (same semantics as add())
+        for ext_id, _ in batch:
+            if ext_id in self._slot_of:
+                self.remove(ext_id)
+        levels = [
+            int(-math.log(max(self._rng.random(), 1e-12)) * self._ml)
+            for _ in range(B)
+        ]
+        pre_entry, pre_max = self._entry, self._max_level
+        slots = [
+            self._alloc_slot(batch[j][0], Q[j], levels[j]) for j in range(B)
+        ]
+        if pre_entry < 0:
+            # empty index: seed sequentially (rare — build() bootstraps)
+            self._entry = slots[0]
+            self._max_level = levels[0]
+            for j in range(1, B):
+                self._connect(slots[j], Q[j], levels[j])
+                if levels[j] > self._max_level:
+                    self._max_level = levels[j]
+                    self._entry = slots[j]
+            return
+
+        efc = self.ef_construction
+        lvq = np.asarray(levels)
+        visited, gen = self._visit_scratch(B)
+
+        d0 = 1.0 - Q @ self._vectors[pre_entry]
+        bd = np.full((B, efc), np.inf, dtype=np.float32)
+        bs = np.full((B, efc), -1, dtype=np.int64)
+        bd[:, 0] = d0
+        bs[:, 0] = pre_entry
+        cands_at: Dict[int, List[Tuple[int, List[Tuple[float, int]]]]] = {}
+        for lv in range(pre_max, -1, -1):
+            collect = np.nonzero(lvq >= lv)[0]
+            greedy = np.nonzero(lvq < lv)[0]
+            for sub, ef in ((greedy, 1), (collect, efc)):
+                if len(sub) == 0:
+                    continue
+                gen[sub] += 1
+                rd, rs = self._batched_search_layer(
+                    Q, bd, bs, sub, ef, lv, visited, gen
+                )
+                bd[sub] = np.inf
+                bs[sub] = -1
+                bd[sub, : rd.shape[1]] = rd
+                bs[sub, : rs.shape[1]] = rs
+            if len(collect):
+                per = []
+                for row, j in enumerate(collect):
+                    dd = bd[j]
+                    ss = bs[j]
+                    ok = ss >= 0
+                    order = np.argsort(dd[ok], kind="stable")
+                    per.append((
+                        int(j),
+                        list(zip(dd[ok][order].tolist(),
+                                 ss[ok][order].tolist())),
+                    ))
+                cands_at[lv] = per
+
+        # connect phase (host): wave nodes link against the pre-wave graph
+        for lv in sorted(cands_at.keys(), reverse=True):
+            for j, cands in cands_at[lv]:
+                self._link_from_cands(slots[j], lv, cands)
+        top = int(np.argmax(lvq))
+        if levels[top] > self._max_level:
+            self._max_level = levels[top]
+            self._entry = slots[top]
+
+    def _batched_search_layer(
+        self,
+        Q: np.ndarray,
+        bd: np.ndarray,
+        bs: np.ndarray,
+        sub: np.ndarray,
+        ef: int,
+        lv: int,
+        visited: np.ndarray,
+        gen: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Beam search one layer for the query subset ``sub``, batched.
+
+        The beam doubles as the candidate pool (the bulk-builder variant
+        of HNSW's search: every beam entry is expanded exactly once; an
+        entry that leaves the beam is abandoned). Each expansion step is
+        a gather + one einsum over [A, width, D] — no per-node Python.
+        Entry beams arrive in bd/bs[sub]; returns (dist, slot) arrays
+        [A, ef], +inf/-1 padded.
+        """
+        A = len(sub)
+        qd = np.where(bs[sub] >= 0, bd[sub], np.inf)[:, :ef]
+        qs = bs[sub][:, :ef]
+        if qd.shape[1] < ef:
+            pad = ef - qd.shape[1]
+            qd = np.pad(qd, ((0, 0), (0, pad)), constant_values=np.inf)
+            qs = np.pad(qs, ((0, 0), (0, pad)), constant_values=-1)
+        exp = qs < 0  # padding counts as already-expanded
+        # stamp entries visited
+        for r in range(A):
+            ent = qs[r][qs[r] >= 0]
+            visited[sub[r], ent] = gen[sub[r]]
+        nbr = self._nbrL[lv]
+        Qs = Q[sub]
+        # expand the E best unexpanded beam entries per step: total
+        # expansions are unchanged (every beam slot expands at most
+        # once), but the per-step Python/alloc overhead is amortized E
+        # ways — this is what makes the wave build fast
+        E = max(1, min(16, ef))
+        while True:
+            dmask = np.where(exp, np.inf, qd)
+            if E == 1:
+                j = np.argmin(dmask, axis=1)[:, None]
+            else:
+                j = np.argpartition(dmask, E - 1, axis=1)[:, :E]
+            jd = np.take_along_axis(dmask, j, axis=1)  # [A, E]
+            act = np.nonzero(np.isfinite(jd).any(axis=1))[0]
+            if len(act) == 0:
+                return qd, qs
+            ja = j[act]
+            fin = np.isfinite(jd[act])
+            rows = np.where(fin, np.take_along_axis(qs[act], ja, axis=1), -1)
+            ea = exp[act]
+            np.put_along_axis(ea, ja, True, axis=1)
+            exp[act] = ea
+            w = nbr.shape[1]
+            nb = np.where(rows[:, :, None] >= 0, nbr[np.maximum(rows, 0)],
+                          -1).reshape(len(act), -1)  # [A', E*W]
+            valid = nb >= 0
+            nb0 = np.where(valid, nb, 0)
+            suba = sub[act]
+            seen = visited[suba[:, None], nb0] == gen[suba][:, None]
+            valid &= ~seen
+            # compact to the unvisited entries before touching vectors:
+            # typically most neighbor slots were already visited, and the
+            # [A, E*W, D] gather would dwarf every other cost
+            vr, vc = np.nonzero(valid)
+            dd = np.full(nb.shape, np.inf, dtype=np.float32)
+            if len(vr):
+                flat_slots = nb0[vr, vc]
+                # E>1 concatenates several nodes' neighbor lists into one
+                # row, so a slot can repeat within this step — the seen
+                # stamp can't catch that; keep first occurrences only
+                key = vr.astype(np.int64) * self._capacity + flat_slots
+                _, first = np.unique(key, return_index=True)
+                if len(first) != len(vr):
+                    vr, vc = vr[first], vc[first]
+                    flat_slots = flat_slots[first]
+                visited[suba[vr], flat_slots] = gen[suba[vr]]
+                dd[vr, vc] = 1.0 - np.einsum(
+                    "nd,nd->n", self._vectors[flat_slots], Qs[act][vr],
+                    optimize=True,
+                )
+            # convergence: a query whose step produced nothing better
+            # than its current worst beam entry is done — the classic
+            # search's best-candidate > worst-result stop, batched. (A
+            # filling beam has +inf padding, so its worst is +inf and it
+            # always continues.)
+            worst = qd[act].max(axis=1)
+            stalled = dd.min(axis=1) >= worst
+            md = np.concatenate([qd[act], dd], axis=1)
+            ms = np.concatenate([qs[act], nb0], axis=1)
+            me = np.concatenate(
+                [ea, np.zeros((len(act), nb.shape[1]), dtype=bool)], axis=1
+            )
+            me |= ~np.isfinite(md)
+            sel = np.argpartition(md, ef - 1, axis=1)[:, :ef]
+            qd[act] = np.take_along_axis(md, sel, axis=1)
+            qs[act] = np.where(
+                np.isfinite(qd[act]),
+                np.take_along_axis(ms, sel, axis=1), -1,
+            )
+            newexp = np.take_along_axis(me, sel, axis=1)
+            newexp |= stalled[:, None]
+            exp[act] = newexp
 
     # -- delete (tombstones) ----------------------------------------------
 
@@ -275,10 +586,72 @@ class HNSWIndex:
                     break
             return out
 
+    def search_batch(
+        self,
+        queries: np.ndarray,
+        k: int = 10,
+        ef: Optional[int] = None,
+    ) -> List[List[Tuple[str, float]]]:
+        """Batched queries over the same matrices the builder uses —
+        amortizes the per-step Python across the whole batch (the
+        throughput path; ``search`` is the latency path)."""
+        Q = np.asarray(queries, dtype=np.float32)
+        norms = np.linalg.norm(Q, axis=1, keepdims=True)
+        Q = Q / np.maximum(norms, 1e-12)
+        if len(Q) > self.WAVE_MAX:
+            out: List[List[Tuple[str, float]]] = []
+            for i in range(0, len(Q), self.WAVE_MAX):
+                out.extend(self.search_batch(Q[i: i + self.WAVE_MAX], k, ef))
+            return out
+        with self._lock:
+            if self._entry < 0 or not self._slot_of:
+                return [[] for _ in range(len(Q))]
+            B = len(Q)
+            ef = max(ef or self.ef_search, k)
+            if self._tombstones:
+                ef = int(ef * (1.0 + 2.0 * self.tombstone_ratio)) + 1
+            visited, gen = self._visit_scratch(B)
+            d0 = 1.0 - Q @ self._vectors[self._entry]
+            bd = np.full((B, ef), np.inf, dtype=np.float32)
+            bs = np.full((B, ef), -1, dtype=np.int64)
+            bd[:, 0] = d0
+            bs[:, 0] = self._entry
+            allq = np.arange(B)
+            for lv in range(self._max_level, -1, -1):
+                width = 1 if lv > 0 else ef
+                gen += 1
+                rd, rs = self._batched_search_layer(
+                    Q, bd, bs, allq, width, lv, visited, gen
+                )
+                bd[:] = np.inf
+                bs[:] = -1
+                bd[:, : rd.shape[1]] = rd
+                bs[:, : rs.shape[1]] = rs
+            out: List[List[Tuple[str, float]]] = []
+            for r in range(B):
+                ok = bs[r] >= 0
+                dd, ss = bd[r][ok], bs[r][ok]
+                order = np.argsort(dd, kind="stable")
+                hits: List[Tuple[str, float]] = []
+                for d, slot in zip(dd[order].tolist(), ss[order].tolist()):
+                    if not self._alive[slot]:
+                        continue
+                    hits.append((self._ext_ids[slot], 1.0 - d))
+                    if len(hits) >= k:
+                        break
+                out.append(hits)
+            return out
+
     # -- persistence -------------------------------------------------------
 
     def save(self, path: str) -> None:
         with self._lock:
+            neighbors = np.empty(self._count, dtype=object)
+            for slot in range(self._count):
+                neighbors[slot] = [
+                    self._neighbors_of(slot, lv).tolist()
+                    for lv in range(self._levels[slot] + 1)
+                ]
             np.savez_compressed(
                 path,
                 vectors=self._vectors[: self._count]
@@ -290,13 +663,7 @@ class HNSWIndex:
                     [e if e is not None else "" for e in self._ext_ids],
                     dtype=object,
                 ),
-                neighbors=np.asarray(
-                    [
-                        [list(map(int, lv)) for lv in per_node]
-                        for per_node in self._neighbors
-                    ],
-                    dtype=object,
-                ),
+                neighbors=neighbors,
                 meta=np.asarray(
                     [self._entry, self._max_level, self.m, self.dims or 0,
                      self.ef_construction, self.ef_search],
@@ -322,9 +689,10 @@ class HNSWIndex:
         idx._levels = [int(x) for x in data["levels"]]
         idx._alive = [bool(x) for x in data["alive"]]
         idx._ext_ids = [str(e) if e else None for e in data["ext_ids"]]
-        idx._neighbors = [
-            [list(lv) for lv in per_node] for per_node in data["neighbors"]
-        ]
+        idx._ensure_level(max(idx._levels, default=0))
+        for slot, per_node in enumerate(data["neighbors"]):
+            for lv, lst in enumerate(per_node):
+                idx._set_neighbors(slot, lv, [int(x) for x in lst])
         idx._slot_of = {
             e: i
             for i, e in enumerate(idx._ext_ids)

@@ -1,0 +1,230 @@
+"""Multi-region replication: Raft per region + async cross-region
+streaming + region fencing (reference: pkg/replication/multi_region.go).
+
+Single-process multi-replica style (SURVEY.md §4): every node is a
+MultiRegionNode over a loopback ClusterTransport; regions are just
+disjoint raft peer sets wired to each other via remote_regions.
+"""
+
+import time
+
+import pytest
+
+from nornicdb_tpu.replication import (
+    ClusterTransport,
+    MultiRegionNode,
+    NotPrimaryRegionError,
+    ReplicationConfig,
+    Role,
+)
+from nornicdb_tpu.replication.replicator import NotPrimaryError, decode_op_args
+from nornicdb_tpu.storage import MemoryEngine
+
+
+def _mk_region(region_id, n, primary, remote_regions):
+    transports = [ClusterTransport(f"{region_id}-n{i}") for i in range(n)]
+    for t in transports:
+        t.start()
+    addrs = [t.addr for t in transports]
+    engines = [MemoryEngine() for _ in range(n)]
+    nodes = []
+    for i, t in enumerate(transports):
+        cfg = ReplicationConfig(
+            mode="multi_region",
+            node_id=f"{region_id}-n{i}",
+            peers=[a for j, a in enumerate(addrs) if j != i],
+            heartbeat_interval=0.1,
+            election_timeout=(0.3, 0.6),
+            region_id=region_id,
+            region_primary=primary,
+            remote_regions=remote_regions,
+            xregion_interval=0.05,
+        )
+        eng = engines[i]
+
+        def apply_fn(op, data, _eng=eng):
+            getattr(_eng, op)(*decode_op_args(op, data))
+
+        nodes.append(MultiRegionNode(t, cfg, apply_fn))
+    return nodes, transports, engines, addrs
+
+
+def _wait_leader(nodes, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [n for n in nodes if n.role is Role.PRIMARY]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no single leader elected")
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture()
+def two_regions():
+    """Region A (primary, 2 nodes) + region B (standby, 2 nodes),
+    cross-wired. Registration order lets A know B's addrs and vice
+    versa before anything starts."""
+    # allocate B's transports first so A can list them
+    b_nodes, b_tp, b_eng, b_addrs = _mk_region("rb", 2, False, [])
+    a_nodes, a_tp, a_eng, a_addrs = _mk_region(
+        "ra", 2, True, [("rb", b_addrs)]
+    )
+    for n in b_nodes:
+        n.config.remote_regions = [("ra", a_addrs)]
+    for n in a_nodes + b_nodes:
+        n.start()
+    try:
+        yield a_nodes, a_eng, b_nodes, b_eng
+    finally:
+        for n in a_nodes + b_nodes:
+            n.close()
+        for t in a_tp + b_tp:
+            t.close()
+
+
+def _write(leader, node_id, v=1):
+    leader.apply(
+        "create_node",
+        {"id": node_id, "labels": ["L"], "properties": {"v": v}},
+    )
+
+
+class TestMultiRegion:
+    def test_write_converges_across_regions(self, two_regions):
+        a_nodes, a_eng, b_nodes, b_eng = two_regions
+        leader = _wait_leader(a_nodes)
+        _wait_leader(b_nodes)
+        for i in range(5):
+            _write(leader, f"x{i}", i)
+        _wait(
+            lambda: all(
+                all(e.has_node(f"x{i}") for i in range(5))
+                for e in a_eng + b_eng
+            ),
+            msg="all 4 engines to hold all 5 nodes",
+        )
+
+    def test_standby_region_rejects_writes(self, two_regions):
+        a_nodes, _a_eng, b_nodes, _b_eng = two_regions
+        _wait_leader(a_nodes)
+        b_leader = _wait_leader(b_nodes)
+        with pytest.raises(NotPrimaryRegionError):
+            _write(b_leader, "nope")
+
+    def test_region_failover_fences_old_primary(self, two_regions):
+        a_nodes, a_eng, b_nodes, b_eng = two_regions
+        a_leader = _wait_leader(a_nodes)
+        b_leader = _wait_leader(b_nodes)
+        _write(a_leader, "before")
+        _wait(lambda: all(e.has_node("before") for e in b_eng),
+              msg="pre-failover convergence")
+
+        b_leader.promote_region()
+        assert b_leader.is_primary_region
+        # the fence demoted region A: its nodes reject writes now
+        _wait(lambda: not a_leader.is_primary_region,
+              msg="old primary region demoted")
+        with pytest.raises(NotPrimaryError):
+            _write(a_leader, "rejected")
+        # writes to the new primary stream back to region A
+        _write(b_leader, "after")
+        _wait(lambda: all(e.has_node("after") for e in a_eng),
+              msg="post-failover reverse streaming")
+
+    def test_stale_fence_rejected(self, two_regions):
+        a_nodes, _a, b_nodes, _b = two_regions
+        _wait_leader(a_nodes)
+        b_leader = _wait_leader(b_nodes)
+        b_leader.promote_region()  # epoch 2
+        # a stale fence (epoch 1) must not demote the new primary
+        reply = b_leader.handle_region_fence(
+            {"type": "region_fence", "region": "ra", "epoch": 1}
+        )
+        assert reply["ok"] is False
+        assert b_leader.is_primary_region
+
+    def test_partitioned_region_converges_after_heal(self, two_regions):
+        """Chaos: region B unreachable while primary keeps writing; on
+        heal, streaming + catch-up converge exactly (VERDICT r03 item 5
+        'chaos test with a partitioned region converging')."""
+        a_nodes, a_eng, b_nodes, b_eng = two_regions
+        a_leader = _wait_leader(a_nodes)
+        _wait_leader(b_nodes)
+        _write(a_leader, "p0")
+        _wait(lambda: all(e.has_node("p0") for e in b_eng),
+              msg="baseline convergence")
+
+        # partition: point region A at a dead address for B
+        healthy = [
+            (r, list(addrs)) for r, addrs in a_leader.config.remote_regions
+        ]
+        for n in a_nodes:
+            n.config.remote_regions = [("rb", [("127.0.0.1", 1)])]
+        for i in range(1, 6):
+            _write(a_leader, f"p{i}", i)
+        time.sleep(0.3)
+        assert not any(e.has_node("p5") for e in b_eng)
+
+        # heal: restore addresses; the streamer's per-region watermark
+        # resends everything B never acked
+        for n in a_nodes:
+            n.config.remote_regions = healthy
+        _wait(
+            lambda: all(
+                all(e.has_node(f"p{i}") for i in range(6))
+                for e in b_eng
+            ),
+            msg="post-heal convergence",
+        )
+        # exact convergence: same node sets on every engine
+        ids = {
+            frozenset(n.id for n in e.all_nodes())
+            for e in a_eng + b_eng
+        }
+        assert len(ids) == 1
+
+    def test_health_reports_region_state(self, two_regions):
+        a_nodes, _a, b_nodes, _b = two_regions
+        a_leader = _wait_leader(a_nodes)
+        h = a_leader.health()
+        assert h["mode"] == "multi_region"
+        assert h["region"] == "ra"
+        assert h["is_primary_region"] is True
+        assert h["region_epoch"] == 1
+
+
+class TestMultiRegionConfigWiring:
+    def test_db_open_multi_region_mode(self, tmp_path):
+        """mode='multi_region' is reachable from the public open()
+        config path (VERDICT: 'mode reachable from config')."""
+        import nornicdb_tpu
+
+        db = nornicdb_tpu.open(
+            replication=ReplicationConfig(
+                mode="multi_region",
+                node_id="solo-0",
+                region_id="solo",
+                region_primary=True,
+                heartbeat_interval=0.1,
+                election_timeout=(0.2, 0.4),
+            )
+        )
+        try:
+            rep = db.replicator
+            assert rep.health()["mode"] == "multi_region"
+            _wait(lambda: rep.role is Role.PRIMARY,
+                  msg="single-node region elects itself")
+            db.cypher("CREATE (:T {id: 1})")
+            assert db.cypher(
+                "MATCH (n:T) RETURN count(n)").rows[0][0] == 1
+        finally:
+            db.close()
