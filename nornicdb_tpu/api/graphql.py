@@ -22,6 +22,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nornicdb_tpu.storage.types import Direction
+
 
 class GraphQLError(Exception):
     pass
@@ -655,6 +657,295 @@ def _m_rebuild_search_index(parent, args, api):
     return api.db.search.build_indexes()
 
 
+def _q_labels(parent, args, api):
+    labels = set()
+    for n in api.db.storage.all_nodes():
+        labels.update(n.labels)
+    return sorted(labels)
+
+
+def _q_rel_types(parent, args, api):
+    return sorted({e.type for e in api.db.storage.all_edges()})
+
+
+def _q_stats(parent, args, api):
+    """Reference: schema.graphql GraphStats (nodeCount,
+    relationshipCount, labels, relationshipTypes, embeddedNodeCount)."""
+    storage = api.db.storage
+    label_counts: Dict[str, int] = {}
+    embedded = 0
+    for n in storage.all_nodes():
+        if n.embedding is not None and len(n.embedding or []):
+            embedded += 1
+        for lbl in n.labels:
+            label_counts[lbl] = label_counts.get(lbl, 0) + 1
+    type_counts: Dict[str, int] = {}
+    for e in storage.all_edges():
+        type_counts[e.type] = type_counts.get(e.type, 0) + 1
+    stats = {
+        "nodeCount": storage.count_nodes(),
+        "relationshipCount": storage.count_edges(),
+        "labels": [
+            {"label": k, "count": v}
+            for k, v in sorted(label_counts.items())
+        ],
+        "relationshipTypes": [
+            {"type": k, "count": v}
+            for k, v in sorted(type_counts.items())
+        ],
+        "embeddedNodeCount": embedded,
+    }
+    fields = {k: (lambda p, a, _api, _k=k: p[_k]) for k in stats}
+    return _Object("GraphStats", fields, stats)
+
+
+def _q_schema(parent, args, api):
+    """Graph schema summary (reference: Query.schema / db.schema.*):
+    labels, relationship types, and property keys in use."""
+    storage = api.db.storage
+    prop_keys = set()
+    for n in storage.all_nodes():
+        prop_keys.update(n.properties.keys())
+    for e in storage.all_edges():
+        prop_keys.update(e.properties.keys())
+    data = {
+        "labels": _q_labels(parent, args, api),
+        "relationshipTypes": _q_rel_types(parent, args, api),
+        "propertyKeys": sorted(prop_keys),
+    }
+    fields = {k: (lambda p, a, _api, _k=k: p[_k]) for k in data}
+    return _Object("GraphSchema", fields, data)
+
+
+def _q_search_by_property(parent, args, api):
+    limit = int(args.get("limit", 100))
+    label = args.get("label")
+    key, value = args["property"], args.get("value")
+    nodes = (api.db.storage.get_nodes_by_label(label) if label
+             else api.db.storage.all_nodes())
+    hits = [n for n in nodes if n.properties.get(key) == value]
+    return [_node_obj(n) for n in sorted(hits, key=lambda n: n.id)[:limit]]
+
+
+def _edges_between(storage, a: str, b: str, types=None):
+    out = []
+    for e in storage.get_node_edges(a, Direction.BOTH):
+        if types and e.type not in types:
+            continue
+        if (e.start_node == a and e.end_node == b) or (
+            e.start_node == b and e.end_node == a
+        ):
+            out.append(e)
+    return out
+
+
+def _q_rels_between(parent, args, api):
+    edges = _edges_between(
+        api.db.storage, args["startId"], args["endId"], args.get("types"))
+    return [_rel_obj(e) for e in sorted(edges, key=lambda e: e.id)]
+
+
+def _path_obj(storage, node_ids, edges):
+    data = {
+        "nodes": [_node_obj(storage.get_node(i)) for i in node_ids],
+        "relationships": [_rel_obj(e) for e in edges],
+        "length": len(edges),
+    }
+    fields = {k: (lambda p, a, _api, _k=k: p[_k]) for k in data}
+    return _Object("Path", fields, data)
+
+
+def _q_shortest_path(parent, args, api):
+    """BFS shortest path (reference: Query.shortestPath; apoc.algo)."""
+    storage = api.db.storage
+    start, end = args["startId"], args["endId"]
+    types = args.get("types")
+    if start == end:
+        return _path_obj(storage, [start], [])
+    prev: Dict[str, Any] = {start: None}
+    frontier = [start]
+    max_depth = int(args.get("maxDepth", 15))
+    for _ in range(max_depth):
+        nxt = []
+        for nid in frontier:
+            for e in storage.get_node_edges(nid, Direction.BOTH):
+                if types and e.type not in types:
+                    continue
+                other = e.end_node if e.start_node == nid else e.start_node
+                if other in prev:
+                    continue
+                prev[other] = (nid, e)
+                if other == end:
+                    ids, edges = [end], []
+                    cur = end
+                    while prev[cur] is not None:
+                        p, pe = prev[cur]
+                        edges.append(pe)
+                        ids.append(p)
+                        cur = p
+                    return _path_obj(
+                        storage, list(reversed(ids)),
+                        list(reversed(edges)))
+                nxt.append(other)
+        frontier = nxt
+        if not frontier:
+            break
+    return None
+
+
+def _q_all_paths(parent, args, api):
+    """Bounded DFS path enumeration (reference: Query.allPaths)."""
+    storage = api.db.storage
+    start, end = args["startId"], args["endId"]
+    max_depth = int(args.get("maxDepth", 4))
+    limit = int(args.get("limit", 25))
+    out = []
+
+    def dfs(nid, path_ids, path_edges, used_edges):
+        if len(out) >= limit:
+            return
+        if nid == end and path_edges:
+            out.append(_path_obj(storage, list(path_ids), list(path_edges)))
+            return
+        if len(path_edges) >= max_depth:
+            return
+        for e in sorted(storage.get_node_edges(nid, Direction.BOTH),
+                        key=lambda e: e.id):
+            if e.id in used_edges:
+                continue
+            other = e.end_node if e.start_node == nid else e.start_node
+            if other in path_ids and other != end:
+                continue  # simple paths only
+            used_edges.add(e.id)
+            path_ids.append(other)
+            path_edges.append(e)
+            dfs(other, path_ids, path_edges, used_edges)
+            used_edges.discard(e.id)
+            path_ids.pop()
+            path_edges.pop()
+
+    dfs(start, [start], [], set())
+    return out
+
+
+def _q_neighborhood(parent, args, api):
+    """BFS neighborhood subgraph (reference: Query.neighborhood)."""
+    storage = api.db.storage
+    depth = int(args.get("depth", 1))
+    limit = int(args.get("limit", 100))
+    seen = {args["id"]}
+    frontier = [args["id"]]
+    edges = {}
+    for _ in range(depth):
+        nxt = []
+        for nid in frontier:
+            for e in storage.get_node_edges(nid, Direction.BOTH):
+                edges[e.id] = e
+                other = e.end_node if e.start_node == nid else e.start_node
+                if other not in seen and len(seen) < limit:
+                    seen.add(other)
+                    nxt.append(other)
+        frontier = nxt
+    data = {
+        "nodes": [_node_obj(storage.get_node(i)) for i in sorted(seen)],
+        "relationships": [
+            _rel_obj(e)
+            for _, e in sorted(edges.items())
+        ],
+    }
+    fields = {k: (lambda p, a, _api, _k=k: p[_k]) for k in data}
+    return _Object("Neighborhood", fields, data)
+
+
+def _m_update_relationship(parent, args, api):
+    e = api.db.storage.get_edge(args["id"])
+    props = args.get("properties") or {}
+    if args.get("replace"):
+        e.properties = dict(props)
+    else:
+        e.properties.update(props)
+    api.db.storage.update_edge(e)
+    return _rel_obj(api.db.storage.get_edge(args["id"]))
+
+
+def _m_merge_relationship(parent, args, api):
+    """Find-or-create by (start, end, type) (reference:
+    Mutation.mergeRelationship)."""
+    start = args.get("startId", args.get("startNodeId"))
+    end = args.get("endId", args.get("endNodeId"))
+    existing = [
+        e for e in _edges_between(api.db.storage, start, end, [args["type"]])
+        if e.start_node == start
+    ]
+    if existing:
+        e = existing[0]
+        if args.get("properties"):
+            e.properties.update(args["properties"])
+            api.db.storage.update_edge(e)
+        return _rel_obj(e)
+    return _m_create_relationship(parent, {
+        "startNodeId": start, "endNodeId": end, "type": args["type"],
+        "properties": args.get("properties", {}),
+    }, api)
+
+
+def _m_bulk_create_relationships(parent, args, api):
+    return [
+        _m_create_relationship(parent, item, api)
+        for item in args.get("relationships", args.get("inputs", []))
+    ]
+
+
+def _m_bulk_delete_relationships(parent, args, api):
+    n = 0
+    for rid in args.get("ids", []):
+        try:
+            api.db.storage.delete_edge(rid)
+            n += 1
+        except Exception:
+            continue
+    return n
+
+
+def _m_clear_all(parent, args, api):
+    """Dangerous full wipe; requires confirm: true (reference:
+    Mutation.clearAll)."""
+    if not args.get("confirm"):
+        raise GraphQLError("clearAll requires confirm: true")
+    storage = api.db.storage
+    n_edges = 0
+    for e in list(storage.all_edges()):
+        try:
+            storage.delete_edge(e.id)
+            n_edges += 1
+        except Exception:
+            pass
+    n_nodes = 0
+    for n in list(storage.all_nodes()):
+        try:
+            storage.delete_node(n.id)
+            n_nodes += 1
+        except Exception:
+            pass
+    return {"nodesDeleted": n_nodes, "relationshipsDeleted": n_edges}
+
+
+def _m_run_decay(parent, args, api):
+    """One decay sweep now (reference: Mutation.runDecay)."""
+    scored, archived = api.db.decay.sweep()
+    return {"processed": scored, "archived": archived}
+
+
+def _m_trigger_embedding(parent, args, api):
+    """Queue a node for (re-)embedding (reference:
+    Mutation.triggerEmbedding)."""
+    queue = getattr(api.db, "_embed_queue", None)
+    if queue is None:
+        return False
+    queue.enqueue(args["id"])
+    return True
+
+
 class GraphQLAPI:
     """The NornicDB GraphQL endpoint (reference: pkg/graphql handler.go)."""
 
@@ -677,9 +968,18 @@ class GraphQLAPI:
             [:int(a.get("limit", 100))]
         ],
         "relationshipCount": lambda p, a, api: api.db.storage.count_edges(),
+        "relationshipsBetween": _q_rels_between,
         "search": _q_search,
+        "searchByProperty": _q_search_by_property,
         "similar": _q_similar,
         "cypher": _q_cypher_readonly,
+        "labels": _q_labels,
+        "relationshipTypes": _q_rel_types,
+        "stats": _q_stats,
+        "schema": _q_schema,
+        "shortestPath": _q_shortest_path,
+        "allPaths": _q_all_paths,
+        "neighborhood": _q_neighborhood,
     }
     mutation_fields: Dict[str, Resolver] = {
         "createNode": _m_create_node,
@@ -689,10 +989,17 @@ class GraphQLAPI:
         "bulkCreateNodes": _m_bulk_create_nodes,
         "bulkDeleteNodes": _m_bulk_delete_nodes,
         "createRelationship": _m_create_relationship,
+        "updateRelationship": _m_update_relationship,
+        "mergeRelationship": _m_merge_relationship,
         "deleteRelationship": _m_delete_relationship,
+        "bulkCreateRelationships": _m_bulk_create_relationships,
+        "bulkDeleteRelationships": _m_bulk_delete_relationships,
         "executeCypher": _q_cypher,
         "cypher": _q_cypher,
         "rebuildSearchIndex": _m_rebuild_search_index,
+        "clearAll": _m_clear_all,
+        "runDecay": _m_run_decay,
+        "triggerEmbedding": _m_trigger_embedding,
     }
 
     def __init__(self, db):

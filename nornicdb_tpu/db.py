@@ -8,6 +8,7 @@ wired in as those layers land.
 
 from __future__ import annotations
 
+import os
 import threading
 import uuid
 from typing import Any, Dict, List, Optional, Sequence
@@ -98,14 +99,25 @@ class DB:
         self._executor = None
         self._search = None
         if embedder is None:
-            # default local embedder: deterministic hash bag-of-features
-            # behind an LRU — store→recall works out of the box with zero
-            # model downloads (reference default: local embedding always
-            # on, embed.go; swap in JaxEncoderEmbedder for semantic
-            # quality via the embedder= kwarg or config)
+            # default local embedder (reference default: local embedding
+            # always on, embed.go — a real bge-m3 via llama.cpp). Here:
+            # the committed contrastively-trained mini encoder
+            # (models/pretrain.py) behind an LRU; HashEmbedder only when
+            # the checkpoint is absent or explicitly forced
+            # (NORNICDB_TPU_EMBEDDER=hash).
             from nornicdb_tpu.embed.embedder import CachedEmbedder, HashEmbedder
 
-            embedder = CachedEmbedder(HashEmbedder())
+            inner = None
+            if os.environ.get("NORNICDB_TPU_EMBEDDER", "") != "hash":
+                try:
+                    from nornicdb_tpu.models.pretrain import (
+                        load_default_embedder,
+                    )
+
+                    inner = load_default_embedder()
+                except Exception:
+                    inner = None  # jax/backend trouble: hash still works
+            embedder = CachedEmbedder(inner or HashEmbedder())
         self._embedder = embedder
         self._embed_queue = None
         self._decay = None

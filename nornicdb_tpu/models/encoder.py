@@ -51,6 +51,15 @@ class EncoderConfig:
                              num_heads=4, mlp_dim=128, max_len=128)
 
     @staticmethod
+    def mini() -> "EncoderConfig":
+        """The committed-checkpoint shape (models/pretrain.py): big
+        enough to learn lexical co-occurrence structure, small enough
+        that the fp16 checkpoint stays ~1-2 MB in git."""
+        return EncoderConfig(vocab_size=4096, hidden_size=128,
+                             num_layers=2, num_heads=4, mlp_dim=512,
+                             max_len=512, dtype=jnp.float32)
+
+    @staticmethod
     def bge_m3_like() -> "EncoderConfig":
         """XLM-R-large shape (bge-m3's backbone)."""
         return EncoderConfig(vocab_size=250_002, hidden_size=1024,

@@ -1,0 +1,454 @@
+"""Round-3 depth parity: multidb limits breadth + legacy migration,
+retention policy variety + legal holds, and a GraphQL conformance corpus
+derived from the reference schema (VERDICT r02 item 7).
+
+Every test asserts reference-documented behavior with the file:line it
+mirrors (pkg/multidb/limits.go + enforcement.go + migration.go,
+pkg/retention/retention.go, pkg/graphql/schema/schema.graphql).
+"""
+
+import json
+
+import pytest
+
+from nornicdb_tpu.multidb import (
+    ConnectionTracker,
+    DatabaseLimitExceeded,
+    DatabaseLimits,
+    DatabaseManager,
+    entity_size,
+)
+from nornicdb_tpu.retention import (
+    RetentionManager,
+    RetentionPolicy,
+    default_policies,
+    gdpr_delete,
+)
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.types import Edge, Node, now_ms
+
+
+def _node(i, labels=None, props=None):
+    return Node(id=str(i), labels=labels or [], properties=props or {})
+
+
+# -- multidb: limits breadth (limits.go:34-160, enforcement.go) ------------
+
+
+class TestMultidbLimits:
+    def _mgr(self, **limits):
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("tenant", limits=DatabaseLimits(**limits))
+        return mgr
+
+    def test_is_unlimited_default(self):
+        # limits.go:136 IsUnlimited: the zero value means no limits
+        assert DatabaseLimits().is_unlimited()
+        assert not DatabaseLimits(max_bytes=1).is_unlimited()
+
+    def test_max_nodes_error_message(self):
+        # enforcement.go:136: "has reached max_nodes limit (N/M)"
+        mgr = self._mgr(max_nodes=2)
+        eng = mgr.get_storage("tenant")
+        eng.create_node(_node(1))
+        eng.create_node(_node(2))
+        with pytest.raises(DatabaseLimitExceeded, match=r"max_nodes limit \(2/2\)"):
+            eng.create_node(_node(3))
+
+    def test_max_edges_enforced(self):
+        mgr = self._mgr(max_edges=1)
+        eng = mgr.get_storage("tenant")
+        eng.create_node(_node(1))
+        eng.create_node(_node(2))
+        eng.create_edge(Edge(id="e1", type="R", start_node="1", end_node="2"))
+        with pytest.raises(DatabaseLimitExceeded, match="max_edges"):
+            eng.create_edge(
+                Edge(id="e2", type="R", start_node="2", end_node="1"))
+
+    def test_max_bytes_exact_and_incremental(self):
+        # limits.go:59: exact serialized size, incrementally tracked
+        n = _node("x", ["L"], {"v": "hello"})
+        size = entity_size(n)
+        mgr = self._mgr(max_bytes=size + 5)
+        eng = mgr.get_storage("tenant")
+        eng.create_node(n)
+        assert eng.current_bytes() > 0
+        with pytest.raises(DatabaseLimitExceeded,
+                           match="would exceed max_bytes limit"):
+            eng.create_node(_node("y", ["L"], {"v": "hello"}))
+
+    def test_max_bytes_freed_by_delete(self):
+        n = _node("x", ["L"], {"v": "hello"})
+        mgr = self._mgr(max_bytes=entity_size(n) + 5)
+        eng = mgr.get_storage("tenant")
+        eng.create_node(n)
+        eng.delete_node("x")
+        eng.create_node(_node("y", ["L"], {"v": "hello"}))  # fits again
+
+    def test_max_bytes_error_carries_sizes(self):
+        # enforcement.go: "(current: X bytes, limit: Y bytes, new
+        # entity: Z bytes)"
+        n = _node("x", [], {"v": 1})
+        mgr = self._mgr(max_bytes=entity_size(n))
+        eng = mgr.get_storage("tenant")
+        eng.create_node(n)
+        with pytest.raises(DatabaseLimitExceeded,
+                           match=r"current: \d+ bytes, limit: \d+ bytes, "
+                                 r"new entity: \d+ bytes"):
+            eng.create_node(_node("y", [], {"v": 2}))
+
+    def test_connection_tracker(self):
+        # enforcement.go:513 ConnectionTracker + MaxConnections
+        mgr = self._mgr(max_connections=2)
+        tracker = ConnectionTracker()
+        tracker.try_increment(mgr, "tenant")
+        tracker.try_increment(mgr, "tenant")
+        assert tracker.count("tenant") == 2
+        with pytest.raises(DatabaseLimitExceeded, match="max_connections"):
+            tracker.try_increment(mgr, "tenant")
+        tracker.decrement("tenant")
+        tracker.try_increment(mgr, "tenant")  # slot freed
+
+    def test_concurrent_query_slots(self):
+        # enforcement.go:382 CheckQueryLimits / MaxConcurrentQueries
+        mgr = self._mgr(max_concurrent_queries=1)
+        with mgr.query_slot("tenant"):
+            with pytest.raises(DatabaseLimitExceeded,
+                               match="max_concurrent_queries"):
+                with mgr.query_slot("tenant"):
+                    pass
+        with mgr.query_slot("tenant"):  # released on exit
+            pass
+
+    def test_unlimited_database_untouched(self):
+        mgr = self._mgr()
+        eng = mgr.get_storage("tenant")
+        for i in range(50):
+            eng.create_node(_node(i))
+        assert eng.count_nodes() == 50
+
+
+class TestMultidbMigration:
+    def test_legacy_data_migrated_to_default_db(self):
+        # migration.go:53 migrateLegacyData + :152 detectUnprefixedData
+        base = MemoryEngine()
+        base.create_node(_node("legacy1", ["L"], {"v": 1}))
+        base.create_node(_node("legacy2", ["L"], {"v": 2}))
+        base.create_edge(Edge(id="le", type="R", start_node="legacy1",
+                              end_node="legacy2"))
+        mgr = DatabaseManager(base)
+        moved = mgr.migrate_legacy_data()
+        assert moved == {"nodes": 2, "edges": 1, "skipped": 0}
+        eng = mgr.get_storage("neo4j")
+        assert eng.count_nodes() == 2
+        assert eng.count_edges() == 1
+        assert not base.has_node("legacy1")
+
+    def test_migration_idempotent_via_marker(self):
+        # migration.go:98 isMigrationComplete / :122 markMigrationComplete
+        base = MemoryEngine()
+        base.create_node(_node("legacy", [], {}))
+        mgr = DatabaseManager(base)
+        assert not mgr.is_migration_complete()
+        mgr.migrate_legacy_data()
+        assert mgr.is_migration_complete()
+        again = mgr.migrate_legacy_data()
+        assert again["skipped"] == 1 and again["nodes"] == 0
+
+    def test_prefixed_data_not_touched(self):
+        base = MemoryEngine()
+        mgr = DatabaseManager(base)
+        eng = mgr.get_storage("neo4j")
+        eng.create_node(_node("a"))
+        moved = mgr.migrate_legacy_data()
+        assert moved["nodes"] == 0
+        assert eng.count_nodes() == 1
+
+
+# -- retention: policy variety (retention.go) ------------------------------
+
+
+class TestRetentionDepth:
+    def _old_node(self, i, labels, days_old, props=None):
+        ts = now_ms() - int(days_old * 86_400_000)
+        n = Node(id=str(i), labels=labels, properties=props or {},
+                 created_at=ts, updated_at=ts)
+        return n
+
+    def test_default_policies_cover_frameworks(self):
+        # retention.go package doc: GDPR / HIPAA / FISMA / SOC2 / SOX
+        frameworks = {p.framework.split()[0] for p in default_policies()}
+        assert {"GDPR", "HIPAA", "FISMA", "SOC2", "SOX"} <= frameworks
+
+    def test_sox_seven_year_financial_retention(self):
+        # retention.go: "SOX: Financial records (7 years)"
+        sox = next(p for p in default_policies() if p.framework == "SOX")
+        assert sox.max_age_days == 7 * 365
+        assert sox.action == "archive"
+
+    def test_hipaa_six_year_minimum(self):
+        # retention.go: "HIPAA §164.530(j): Record retention (6 years)"
+        hipaa = next(p for p in default_policies()
+                     if "HIPAA" in p.framework)
+        assert hipaa.max_age_days >= 6 * 365
+
+    def test_delete_policy_sweeps_expired(self):
+        eng = MemoryEngine()
+        eng.create_node(self._old_node("old", ["PII"], 4 * 365))
+        eng.create_node(self._old_node("fresh", ["PII"], 10))
+        mgr = RetentionManager(eng)
+        for p in default_policies():
+            mgr.add_policy(p)
+        res = mgr.sweep()
+        assert res.deleted == 1
+        assert eng.has_node("fresh") and not eng.has_node("old")
+
+    def test_legal_hold_blocks_deletion(self):
+        # retention.go: "Legal hold support (prevents deletion during
+        # litigation)"
+        eng = MemoryEngine()
+        eng.create_node(self._old_node(
+            "held", ["PII"], 4 * 365, {"subject": "u1"}))
+        mgr = RetentionManager(eng)
+        mgr.add_policy(RetentionPolicy(
+            name="pii", label="PII", max_age_days=365, action="delete"))
+        mgr.add_legal_hold("subject", "u1")
+        res = mgr.sweep()
+        assert res.held == 1 and res.deleted == 0
+        assert eng.has_node("held")
+        assert mgr.release_legal_hold("subject", "u1")
+        assert mgr.sweep().deleted == 1
+
+    def test_erasure_respects_legal_hold(self):
+        # retention.go: ProcessErasure "(respects legal holds)"
+        eng = MemoryEngine()
+        eng.create_node(_node("u", ["User"], {"subject": "u1"}))
+        mgr = RetentionManager(eng)
+        mgr.add_legal_hold("subject", "u1")
+        assert gdpr_delete(eng, "subject", "u1", retention=mgr) == 0
+        mgr.release_legal_hold("subject", "u1")
+        assert gdpr_delete(eng, "subject", "u1", retention=mgr) == 1
+
+    def test_archive_before_delete_callback(self):
+        # retention.go: "Archive-before-delete option for compliance"
+        eng = MemoryEngine()
+        eng.create_node(self._old_node("x", ["PII"], 400, {"k": "v"}))
+        archived = []
+        mgr = RetentionManager(eng, archive_callback=archived.append)
+        mgr.add_policy(RetentionPolicy(
+            name="pii", label="PII", max_age_days=365, action="delete"))
+        res = mgr.sweep()
+        assert res.deleted == 1
+        assert len(archived) == 1 and archived[0]["id"] == "x"
+
+    def test_policy_persistence_roundtrip(self, tmp_path):
+        # retention.go: "Policy persistence (save/load from JSON)"
+        eng = MemoryEngine()
+        mgr = RetentionManager(eng)
+        for p in default_policies():
+            mgr.add_policy(p)
+        path = str(tmp_path / "policies.json")
+        mgr.save_policies(path)
+        mgr2 = RetentionManager(MemoryEngine())
+        assert mgr2.load_policies(path) == len(default_policies())
+        assert {p.name for p in mgr2.policies()} == {
+            p.name for p in default_policies()}
+        with open(path) as f:
+            assert "GDPR" in json.dumps(json.load(f))
+
+    def test_legal_holds_listing(self):
+        mgr = RetentionManager(MemoryEngine())
+        mgr.add_legal_hold("subject", "a")
+        mgr.add_legal_hold("subject", "b")
+        assert mgr.legal_holds() == {"subject": ["a", "b"]}
+
+
+# -- GraphQL conformance corpus (schema.graphql Query/Mutation roots) ------
+
+
+@pytest.fixture()
+def gql():
+    import nornicdb_tpu
+    from nornicdb_tpu.api.graphql import GraphQLAPI
+
+    db = nornicdb_tpu.open(auto_embed=False)
+    ex = db.executor
+    ex.execute("CREATE (:Person {id: 1, name: 'ada'})")
+    ex.execute("CREATE (:Person {id: 2, name: 'bob'})")
+    ex.execute("CREATE (:City {id: 3, name: 'oslo'})")
+    ex.execute(
+        "MATCH (a:Person {id: 1}), (b:Person {id: 2}) "
+        "CREATE (a)-[:KNOWS {w: 1}]->(b)")
+    ex.execute(
+        "MATCH (a:Person {id: 2}), (c:City {id: 3}) "
+        "CREATE (a)-[:LIVES_IN]->(c)")
+    api = GraphQLAPI(db)
+    yield api, db
+    db.close()
+
+
+def _run(api, q, variables=None):
+    out = api.execute(q, variables=variables or {})
+    assert not out.get("errors"), out
+    return out["data"]
+
+
+class TestGraphQLConformance:
+    """Each test exercises a Query/Mutation root field the reference
+    schema defines (pkg/graphql/schema/schema.graphql)."""
+
+    def test_labels(self, gql):
+        api, _ = gql
+        data = _run(api, "{ labels }")
+        assert set(data["labels"]) >= {"Person", "City"}
+
+    def test_relationship_types(self, gql):
+        api, _ = gql
+        data = _run(api, "{ relationshipTypes }")
+        assert set(data["relationshipTypes"]) == {"KNOWS", "LIVES_IN"}
+
+    def test_stats(self, gql):
+        # schema.graphql GraphStats: nodeCount/relationshipCount/labels/
+        # relationshipTypes/embeddedNodeCount
+        api, _ = gql
+        data = _run(api, "{ stats { nodeCount relationshipCount "
+                         "labels relationshipTypes embeddedNodeCount } }")
+        s = data["stats"]
+        assert s["nodeCount"] == 3 and s["relationshipCount"] == 2
+        assert {"label": "Person", "count": 2} in s["labels"]
+        assert s["embeddedNodeCount"] == 0
+
+    def test_schema_summary(self, gql):
+        api, _ = gql
+        data = _run(api, "{ schema { labels relationshipTypes propertyKeys } }")
+        assert "name" in data["schema"]["propertyKeys"]
+
+    def test_search_by_property(self, gql):
+        api, _ = gql
+        data = _run(api, 'query($v: JSON) { searchByProperty('
+                         'label: "Person", property: "name", value: $v)'
+                         ' { id properties } }',
+                    {"v": "ada"})
+        hits = data["searchByProperty"]
+        assert len(hits) == 1 and hits[0]["properties"]["name"] == "ada"
+
+    def test_shortest_path(self, gql):
+        api, db = gql
+        ids = {r[0]: r[1] for r in db.executor.execute(
+            "MATCH (n) RETURN n.id, id(n)").rows}
+        data = _run(
+            api,
+            'query($a: ID!, $b: ID!) { shortestPath(startId: $a, '
+            'endId: $b) { length nodes { id } } }',
+            {"a": ids[1], "b": ids[3]},
+        )
+        assert data["shortestPath"]["length"] == 2
+        assert len(data["shortestPath"]["nodes"]) == 3
+
+    def test_all_paths(self, gql):
+        api, db = gql
+        ids = {r[0]: r[1] for r in db.executor.execute(
+            "MATCH (n) RETURN n.id, id(n)").rows}
+        data = _run(
+            api,
+            'query($a: ID!, $b: ID!) { allPaths(startId: $a, endId: $b, '
+            'maxDepth: 4) { length } }',
+            {"a": ids[1], "b": ids[3]},
+        )
+        assert [p["length"] for p in data["allPaths"]] == [2]
+
+    def test_neighborhood(self, gql):
+        api, db = gql
+        ids = {r[0]: r[1] for r in db.executor.execute(
+            "MATCH (n) RETURN n.id, id(n)").rows}
+        data = _run(
+            api,
+            'query($id: ID!) { neighborhood(id: $id, depth: 1) '
+            '{ nodes { id } relationships { type } } }',
+            {"id": ids[2]},
+        )
+        hood = data["neighborhood"]
+        assert len(hood["nodes"]) == 3  # bob + ada + oslo
+        assert {r["type"] for r in hood["relationships"]} == {
+            "KNOWS", "LIVES_IN"}
+
+    def test_relationships_between(self, gql):
+        api, db = gql
+        ids = {r[0]: r[1] for r in db.executor.execute(
+            "MATCH (n) RETURN n.id, id(n)").rows}
+        data = _run(
+            api,
+            'query($a: ID!, $b: ID!) { relationshipsBetween(startId: $a, '
+            'endId: $b) { type } }',
+            {"a": ids[1], "b": ids[2]},
+        )
+        assert [r["type"] for r in data["relationshipsBetween"]] == ["KNOWS"]
+
+    def test_update_relationship(self, gql):
+        api, db = gql
+        rid = db.executor.execute(
+            "MATCH ()-[r:KNOWS]->() RETURN id(r)").rows[0][0]
+        data = _run(
+            api,
+            'mutation($id: ID!) { updateRelationship(id: $id, '
+            'properties: {w: 9}) { properties } }',
+            {"id": rid},
+        )
+        assert data["updateRelationship"]["properties"]["w"] == 9
+
+    def test_merge_relationship_idempotent(self, gql):
+        api, db = gql
+        ids = {r[0]: r[1] for r in db.executor.execute(
+            "MATCH (n:Person) RETURN n.id, id(n)").rows}
+        q = ('mutation($a: ID!, $b: ID!) { mergeRelationship(startId: $a, '
+             'endId: $b, type: "KNOWS") { id } }')
+        r1 = _run(api, q, {"a": ids[1], "b": ids[2]})
+        r2 = _run(api, q, {"a": ids[1], "b": ids[2]})
+        assert r1["mergeRelationship"]["id"] == r2["mergeRelationship"]["id"]
+        n = db.executor.execute(
+            "MATCH ()-[r:KNOWS]->() RETURN count(r)").rows[0][0]
+        assert n == 1  # merged, not duplicated
+
+    def test_bulk_relationship_mutations(self, gql):
+        api, db = gql
+        ids = {r[0]: r[1] for r in db.executor.execute(
+            "MATCH (n) RETURN n.id, id(n)").rows}
+        data = _run(
+            api,
+            'mutation($rels: JSON) { bulkCreateRelationships('
+            'relationships: $rels) { id } }',
+            {"rels": [
+                {"startNodeId": ids[1], "endNodeId": ids[3],
+                 "type": "VISITED"},
+                {"startNodeId": ids[2], "endNodeId": ids[1],
+                 "type": "KNOWS"},
+            ]},
+        )
+        created = [r["id"] for r in data["bulkCreateRelationships"]]
+        assert len(created) == 2
+        data = _run(api, 'mutation($ids: JSON) { '
+                         'bulkDeleteRelationships(ids: $ids) }',
+                    {"ids": created})
+        assert data["bulkDeleteRelationships"] == 2
+
+    def test_clear_all_requires_confirm(self, gql):
+        api, db = gql
+        out = api.execute("mutation { clearAll }")
+        assert out.get("errors")
+        data = _run(api, "mutation { clearAll(confirm: true) }")
+        assert data["clearAll"]["nodesDeleted"] == 3
+        assert db.storage.count_nodes() == 0
+
+    def test_run_decay(self, gql):
+        api, _ = gql
+        data = _run(api, "mutation { runDecay }")
+        assert data["runDecay"]["processed"] >= 0
+
+    def test_trigger_embedding(self, gql):
+        api, db = gql
+        nid = db.executor.execute(
+            "MATCH (n:Person {id: 1}) RETURN id(n)").rows[0][0]
+        out = api.execute(
+            'mutation($id: ID!) { triggerEmbedding(id: $id) }',
+            variables={"id": nid})
+        assert not out.get("errors"), out
