@@ -93,7 +93,176 @@ def main():
         result["northstar"] = _bench_northstar()
     except Exception as exc:
         result["northstar"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # one-shot TPU proof (VERDICT r3 task 3): the first session where
+    # the tunnel is up must capture EVERYTHING the TPU claim rests on —
+    # compiled (non-interpret) Pallas kernels, batched device kNN, and
+    # encoder-forward MFU — in this same run, tagged with the real
+    # platform string. Skipped (with reason) on cpu fallback.
+    try:
+        import jax as _jax
+
+        plat = _jax.devices()[0].platform
+        if plat not in ("cpu", "host"):
+            result["tpu_proof"] = _bench_tpu_proof()
+        else:
+            result["tpu_proof"] = {
+                "skipped": f"backend is {plat!r}; compiled-Pallas and "
+                "MFU proof requires a real accelerator"}
+    except Exception as exc:
+        result["tpu_proof"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
     print(json.dumps(result))
+
+
+# bf16 peak FLOP/s per chip by device_kind substring (public specs);
+# None -> report raw flops/s with mfu=null rather than guessing
+_TPU_PEAK_FLOPS = (
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in _TPU_PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _bench_tpu_proof():
+    """Runs ONLY on a live accelerator. Captures, in one shot:
+
+    - compiled (interpret=False) Pallas fused cosine top-k, validated
+      against the XLA path and timed;
+    - compiled Pallas flash attention, validated against the naive
+      einsum reference and timed;
+    - batched device kNN (batch 64) alongside the headline batch-1;
+    - encoder forward MFU at the bge-m3-like shape: measured tokens/s
+      x analytic FLOPs/token over the chip's public bf16 peak.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out = {"platform": dev.platform,
+           "device_kind": getattr(dev, "device_kind", "unknown")}
+    rng = np.random.default_rng(7)
+
+    from nornicdb_tpu.ops import cosine_topk, l2_normalize, pad_dim
+    from nornicdb_tpu.ops.pallas_topk import fused_cosine_topk
+
+    # -- compiled pallas top-k vs XLA path --------------------------------
+    n, d, k = 100_000, 1024, 10
+    cap = pad_dim(n)
+    m = np.zeros((cap, d), np.float32)
+    m[:n] = rng.standard_normal((n, d), dtype=np.float32)
+    valid = np.zeros(cap, bool)
+    valid[:n] = True
+    mj = l2_normalize(jnp.asarray(m))
+    vj = jnp.asarray(valid)
+    q = l2_normalize(jnp.asarray(
+        rng.standard_normal((64, d), dtype=np.float32)))
+    s_ref, i_ref = cosine_topk(q, mj, vj, k)
+    s_ref.block_until_ready()
+    s_pal, i_pal = fused_cosine_topk(q, mj, vj, k, interpret=False)
+    s_pal.block_until_ready()
+    exact = bool(jnp.all(i_ref == i_pal)) and bool(
+        jnp.allclose(s_ref, s_pal, atol=1e-3))
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s_pal, _ = fused_cosine_topk(q, mj, vj, k, interpret=False)
+    s_pal.block_until_ready()
+    dt_pal = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s_ref, _ = cosine_topk(q, mj, vj, k)
+    s_ref.block_until_ready()
+    dt_xla = time.perf_counter() - t0
+    out["pallas_topk_compiled"] = {
+        "n": n, "dims": d, "batch": 64, "matches_xla": exact,
+        "pallas_qps": round(64 * iters / dt_pal, 1),
+        "xla_qps": round(64 * iters / dt_xla, 1),
+    }
+
+    # -- compiled pallas flash attention vs naive reference ---------------
+    from nornicdb_tpu.ops.pallas_attention import (
+        flash_attention, reference_attention)
+
+    B, S, H, Dh = 4, 1024, 8, 64
+    qa = jnp.asarray(rng.standard_normal((B, S, H, Dh), dtype=np.float32))
+    ka = jnp.asarray(rng.standard_normal((B, S, H, Dh), dtype=np.float32))
+    va = jnp.asarray(rng.standard_normal((B, S, H, Dh), dtype=np.float32))
+    mask = jnp.ones((B, S), bool)
+    o_ref = reference_attention(qa, ka, va, mask)
+    o_pal = flash_attention(qa, ka, va, mask, interpret=False)
+    o_pal.block_until_ready()
+    att_exact = bool(jnp.allclose(o_ref, o_pal, atol=2e-3))
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        o_pal = flash_attention(qa, ka, va, mask, interpret=False)
+    o_pal.block_until_ready()
+    dt = time.perf_counter() - t0
+    att_flops = 4.0 * B * H * S * S * Dh  # QK^T + AV matmuls
+    out["pallas_attention_compiled"] = {
+        "shape": [B, S, H, Dh], "matches_reference": att_exact,
+        "tflops_per_s": round(att_flops * iters / dt / 1e12, 2),
+    }
+
+    # -- batched device kNN (the headline is batch-1) ---------------------
+    iters = 200
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s, _ = cosine_topk(q, mj, vj, k)
+    s.block_until_ready()
+    dt = time.perf_counter() - t0
+    out["knn_batched_64"] = {
+        "n": n, "dims": d,
+        "qps": round(64 * iters / dt, 1),
+        "vs_baseline": round(
+            (64 * iters / dt) / BASELINE_REST_SEARCH_OPS, 3),
+    }
+
+    # -- encoder forward MFU at the bge-m3-like shape ---------------------
+    from nornicdb_tpu.models.encoder import Encoder, EncoderConfig
+
+    cfg = EncoderConfig.bge_m3_like()
+    model = Encoder(cfg)
+    Bt, St = 8, 512
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (Bt, St)), jnp.int32)
+    params = jax.jit(lambda: model.init(
+        jax.random.PRNGKey(0), ids)["params"])()
+    fwd = jax.jit(lambda p, x: model.apply({"params": p}, x))
+    fwd(params, ids).block_until_ready()  # compile
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = fwd(params, ids)
+    y.block_until_ready()
+    dt = time.perf_counter() - t0
+    n_params = sum(int(np.prod(v.shape))
+                   for v in jax.tree_util.tree_leaves(params))
+    # matmul-dominated forward: 2 FLOPs/param/token + attention
+    # 4*L*S*Dmodel per token (QK^T + AV)
+    flops_per_token = (2.0 * n_params
+                       + 4.0 * cfg.num_layers * St * cfg.hidden_size)
+    tokens_per_s = Bt * St * iters / dt
+    achieved = tokens_per_s * flops_per_token
+    peak = _peak_flops(out["device_kind"])
+    out["encoder_forward_mfu"] = {
+        "config": "bge_m3_like", "batch": Bt, "seq": St,
+        "params_m": round(n_params / 1e6, 1),
+        "tokens_per_s": round(tokens_per_s, 1),
+        "achieved_tflops_per_s": round(achieved / 1e12, 2),
+        "peak_tflops_per_s": None if peak is None else round(peak / 1e12),
+        "mfu": None if peak is None else round(achieved / peak, 4),
+    }
+    return out
 
 
 def _bench_northstar():

@@ -848,9 +848,13 @@ def _q_neighborhood(parent, args, api):
         frontier = nxt
     data = {
         "nodes": [_node_obj(storage.get_node(i)) for i in sorted(seen)],
+        # induced subgraph (reference semantics): only edges with BOTH
+        # endpoints inside the returned node set — no dangling endpoints
+        # from the limit cap, no edges one hop past `depth`
         "relationships": [
             _rel_obj(e)
             for _, e in sorted(edges.items())
+            if e.start_node in seen and e.end_node in seen
         ],
     }
     fields = {k: (lambda p, a, _api, _k=k: p[_k]) for k in data}

@@ -99,25 +99,7 @@ class DB:
         self._executor = None
         self._search = None
         if embedder is None:
-            # default local embedder (reference default: local embedding
-            # always on, embed.go — a real bge-m3 via llama.cpp). Here:
-            # the committed contrastively-trained mini encoder
-            # (models/pretrain.py) behind an LRU; HashEmbedder only when
-            # the checkpoint is absent or explicitly forced
-            # (NORNICDB_TPU_EMBEDDER=hash).
-            from nornicdb_tpu.embed.embedder import CachedEmbedder, HashEmbedder
-
-            inner = None
-            if os.environ.get("NORNICDB_TPU_EMBEDDER", "") != "hash":
-                try:
-                    from nornicdb_tpu.models.pretrain import (
-                        load_default_embedder,
-                    )
-
-                    inner = load_default_embedder()
-                except Exception:
-                    inner = None  # jax/backend trouble: hash still works
-            embedder = CachedEmbedder(inner or HashEmbedder())
+            embedder = self._default_embedder()
         self._embedder = embedder
         self._embed_queue = None
         self._decay = None
@@ -125,6 +107,102 @@ class DB:
         self._inference = None
         if auto_embed:
             self._start_embed_queue()
+
+    def _default_embedder(self):
+        """Default local embedder (reference default: local embedding
+        always on, embed.go — a real bge-m3 via llama.cpp). Here: the
+        committed contrastively-trained mini encoder (models/pretrain.py)
+        behind an LRU; HashEmbedder when the checkpoint is absent or
+        forced (NORNICDB_TPU_EMBEDDER=hash).
+
+        The chosen embedder identity (kind + dims) is PERSISTED with
+        disk-backed stores (``embedder.json`` sidecar) and honored on
+        reopen, so an existing database keeps its embedding space even
+        when the default changes across versions — mixing spaces would
+        silently break recall (advisor r3: db.py:117)."""
+        import io as _io  # builtins.open is shadowed by module-level open()
+        import json as _json
+        import logging
+
+        from nornicdb_tpu.embed.embedder import CachedEmbedder, HashEmbedder
+
+        log = logging.getLogger("nornicdb_tpu.db")
+        sidecar = (
+            os.path.join(self._data_dir, "embedder.json")
+            if self._data_dir else None
+        )
+        recorded = None
+        if sidecar and os.path.exists(sidecar):
+            try:
+                with _io.open(sidecar, encoding="utf-8") as f:
+                    recorded = _json.load(f)
+            except Exception:
+                recorded = None
+
+        from nornicdb_tpu.models.hf_import import default_model_dir
+
+        def build(kind):
+            if kind == "hf":
+                from nornicdb_tpu.models.hf_import import HFEncoderEmbedder
+
+                d = default_model_dir()
+                if d is None:
+                    raise FileNotFoundError(
+                        "NORNICDB_TPU_MODEL_DIR not set or not a model "
+                        "dir, but the store was created with an "
+                        "imported-weights embedder")
+                return HFEncoderEmbedder(d)
+            if kind == "encoder-mini":
+                from nornicdb_tpu.models.pretrain import load_default_embedder
+
+                inner = load_default_embedder()
+                if inner is None:
+                    raise FileNotFoundError("encoder checkpoint missing")
+                return inner
+            return HashEmbedder(
+                # recorded dims only apply if the store really was hash:
+                # another kind's dims would silently change hash's space
+                dims=int(recorded.get("dims", 256))
+                if recorded and recorded.get("kind") == "hash" else 256
+            )
+
+        env_force = os.environ.get("NORNICDB_TPU_EMBEDDER", "")
+        if env_force == "hash":
+            # the explicit escape hatch ALWAYS wins — it exists for when
+            # the jax backend cannot even initialize (e.g. a hung TPU
+            # tunnel), so no recorded preference may route around it
+            want = "hash"
+        elif default_model_dir() is not None:
+            want = "hf"  # real imported weights beat the mini encoder
+        else:
+            want = "encoder-mini"
+        kind = want
+        if recorded and env_force != "hash":
+            kind = recorded.get("kind", want)
+        try:
+            inner = build(kind)
+        except Exception:
+            if kind != "hash":
+                log.warning(
+                    "default embedder %r unavailable; falling back to "
+                    "hash embedder — embeddings written now will be in a "
+                    "different space", kind,
+                )
+            kind = "hash"
+            inner = build("hash")
+        if recorded and recorded.get("kind") != kind:
+            log.warning(
+                "store was created with embedder %r but %r is active; "
+                "existing embeddings are in the recorded space — reindex "
+                "to migrate", recorded.get("kind"), kind,
+            )
+        if sidecar and recorded is None:
+            try:
+                with _io.open(sidecar, "w", encoding="utf-8") as f:
+                    _json.dump({"kind": kind, "dims": inner.dims}, f)
+            except OSError:
+                pass
+        return CachedEmbedder(inner)
 
     def _enable_replication(self, chain: Engine, cfg: Any) -> Engine:
         """Insert the ReplicatedEngine into the chain (reference:

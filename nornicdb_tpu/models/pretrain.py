@@ -54,19 +54,26 @@ _CORPUS_MODULES = [
 ]
 
 
-def build_corpus(min_words: int = 25) -> List[str]:
-    """Documents: stdlib module + member (class/function) docstrings +
-    repo docs. The member harvest matters — a ~100-doc corpus gets
-    memorized by even this mini model (loss -> 0, zero transfer); a few
-    thousand documents force it onto shared co-occurrence structure."""
-    docs: List[str] = []
+def build_corpus(min_words: int = 12) -> List[Tuple[str, str]]:
+    """(group, text) documents: stdlib module + member (class/function)
+    docstrings + repo doc sections.
+
+    The GROUP is the retrieval-relevant unit: all docstrings of one
+    stdlib module are about one topic, exactly the granularity search
+    eval groups documents at. Contrastive pairs drawn from two DIFFERENT
+    documents of the same group (make_batch) teach topic-level
+    clustering — same-document windows alone only teach document
+    identity, which is why the round-3 recipe's recall plateaued at the
+    lexical baseline. Repo doc sections cover many topics per file, so
+    each section is its own group (same-doc windows)."""
+    docs: List[Tuple[str, str]] = []
     seen = set()
 
-    def take(text: Optional[str]) -> None:
+    def take(group: str, text: Optional[str]) -> None:
         text = (text or "").strip()
         if len(text.split()) >= min_words and text[:80] not in seen:
             seen.add(text[:80])
-            docs.append(text)
+            docs.append((group, text))
 
     for name in _CORPUS_MODULES:
         try:
@@ -75,13 +82,14 @@ def build_corpus(min_words: int = 25) -> List[str]:
             mod = importlib.import_module(name)
         except Exception:
             continue
-        take(mod.__doc__)
+        group = name.split(".")[0]
+        take(group, mod.__doc__)
         for member in vars(mod).values():
             try:
-                take(getattr(member, "__doc__", None))
+                take(group, getattr(member, "__doc__", None))
                 if isinstance(member, type):
                     for sub in vars(member).values():
-                        take(getattr(sub, "__doc__", None))
+                        take(group, getattr(sub, "__doc__", None))
             except Exception:
                 continue
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -92,40 +100,52 @@ def build_corpus(min_words: int = 25) -> List[str]:
             with io.open(path, encoding="utf-8") as f:
                 text = f.read()
             # split large docs into section-sized documents
-            for part in re.split(r"\n#+ ", text):
-                if len(part.split()) >= min_words:
-                    docs.append(part)
+            for si, part in enumerate(re.split(r"\n#+ ", text)):
+                if len(part.split()) >= 25:
+                    docs.append((f"{fname}#{si}", part))
     return docs
 
 
-def _windows(words: List[str], rng: random.Random,
-             lo: int = 24, hi: int = 48,
-             drop: float = 0.15) -> Tuple[str, str]:
-    """Two word windows of one document, each with token dropout —
-    exact-token overlap alone cannot solve the contrastive task, so the
-    model must use distributional structure."""
+def _window(words: List[str], rng: random.Random,
+            lo: int, hi: int, drop: float) -> str:
     n = len(words)
-    out = []
-    for _ in range(2):
-        w = rng.randint(lo, hi)
-        start = rng.randint(0, max(0, n - w))
-        win = [t for t in words[start: start + w] if rng.random() > drop]
-        out.append(" ".join(win) if win else words[start])
-    return out[0], out[1]
+    w = rng.randint(lo, hi)
+    start = rng.randint(0, max(0, n - w))
+    win = [t for t in words[start: start + w] if rng.random() > drop]
+    return " ".join(win) if win else words[start]
 
 
 def make_batch(
-    docs: List[List[str]],
+    groups: Dict[str, List[List[str]]],
+    group_names: List[str],
     tokenizer,
     rng: random.Random,
     batch: int,
     seq_len: int,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    picks = rng.sample(range(len(docs)), min(batch, len(docs)))
+    """One (anchor, positive) pair per DISTINCT group.
+
+    - anchor: short (4-14 word) heavy-dropout window — query-shaped;
+    - positive: longer window from a DIFFERENT document of the same
+      group when the group has several (topic-level positive), else
+      from the same document (identity-level fallback);
+    - one pair per group per batch, so in-batch negatives are never
+      secretly same-topic (same-group negatives would push the very
+      structure we want apart)."""
+    picks = rng.sample(group_names, min(batch, len(group_names)))
     a = np.zeros((len(picks), seq_len), np.int32)
     p = np.zeros((len(picks), seq_len), np.int32)
-    for row, di in enumerate(picks):
-        wa, wp = _windows(docs[di], rng)
+    for row, g in enumerate(picks):
+        members = groups[g]
+        d1 = rng.randrange(len(members))
+        if len(members) > 1:  # topic-level positive: a DIFFERENT doc
+            d2 = rng.randrange(len(members) - 1)
+            if d2 >= d1:
+                d2 += 1
+        else:
+            d2 = d1  # singleton group: identity-level fallback
+        wa = _window(members[d1], rng, 4, 14, drop=0.3)
+        wp = _window(members[d2], rng, 16, 48, drop=0.1)
         for arr, text in ((a, wa), (p, wp)):
             ids = tokenizer.encode(text, max_len=seq_len)
             arr[row, : len(ids)] = ids
@@ -133,17 +153,22 @@ def make_batch(
 
 
 def train_mini(
-    steps: int = 400,
-    batch: int = 48,
+    steps: int = 3000,
+    batch: int = 128,
     seq_len: int = 64,
     learning_rate: float = 3e-4,
     seed: int = 0,
-    log_every: int = 50,
+    log_every: int = 200,
+    eval_hook=None,
 ):
-    """Train the mini encoder; returns (cfg, params, final_loss)."""
+    """Train the mini encoder; returns (cfg, params, final_loss).
+
+    ``eval_hook(step, params)`` (optional) is called every ``log_every``
+    steps for in-training quality probes."""
     import functools
 
     import jax
+    import optax
 
     from nornicdb_tpu.embed.tokenizer import HashTokenizer
     from nornicdb_tpu.models.encoder import EncoderConfig
@@ -154,22 +179,32 @@ def train_mini(
 
     cfg = EncoderConfig.mini()
     tokenizer = HashTokenizer(cfg.vocab_size)
-    docs = [d.split() for d in build_corpus()]
-    if len(docs) < batch:
-        batch = max(8, len(docs))
+    groups: Dict[str, List[List[str]]] = {}
+    for g, text in build_corpus():
+        groups.setdefault(g, []).append(text.split())
+    group_names = sorted(groups)
+    batch = min(batch, len(group_names))
     rng = random.Random(seed)
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=learning_rate,
+        warmup_steps=min(100, steps // 10), decay_steps=steps,
+        end_value=learning_rate * 0.03,
+    )
     model, state = create_train_state(
-        cfg, jax.random.PRNGKey(seed), learning_rate=learning_rate,
+        cfg, jax.random.PRNGKey(seed), learning_rate=schedule,
         seq_len=seq_len,
     )
     step_fn = jax.jit(functools.partial(contrastive_train_step, model))
     loss = float("nan")
     for step in range(steps):
-        a, p = make_batch(docs, tokenizer, rng, batch, seq_len)
+        a, p = make_batch(groups, group_names, tokenizer, rng, batch,
+                          seq_len)
         state, loss_arr = step_fn(state, a, p)
         if log_every and (step + 1) % log_every == 0:
             loss = float(loss_arr)
-            print(f"step {step + 1}/{steps} loss {loss:.4f}")
+            print(f"step {step + 1}/{steps} loss {loss:.4f}", flush=True)
+            if eval_hook is not None:
+                eval_hook(step + 1, state.params)
     return cfg, state.params, float(loss_arr)
 
 

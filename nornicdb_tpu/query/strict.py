@@ -4,11 +4,12 @@ Reference: pkg/cypher/antlr/ — the reference runs a second, full
 OpenCypher ANTLR parser for strict validation with line/column
 diagnostics (73-4,753x slower than the nornic fast path;
 docs/architecture/cypher-parser-modes.md), selected by
-NORNICDB_PARSER. The TPU build's fast parser is already a real
-tokenizer+AST parser, so the diagnostic mode layers *semantic*
-validation on the same AST instead of a second grammar: undefined
-variables, aggregates in WHERE, unknown functions/procedures, and
-precise line/col positions for syntax errors.
+NORNICDB_PARSER. The TPU build's diagnostic mode runs a genuine second
+parser: a grammar-complete recursive-descent implementation
+(strict_grammar.py) enforcing the clause-order/shape rules the fast
+parser skips on the hot path, then layers semantic validation on the
+fast parser's AST — undefined variables, aggregates in WHERE, unknown
+functions/procedures — all with line/col diagnostics.
 
 Executor wiring: ``CypherExecutor(parser_mode="strict")`` (or the
 NORNICDB_TPU_PARSER env var) validates every query before execution and
@@ -52,10 +53,27 @@ def _is_agg(name: str) -> bool:
 
 
 def validate(query: str) -> List[Diagnostic]:
-    """Full-strictness validation; empty list = clean."""
+    """Full-strictness validation; empty list = clean.
+
+    Two passes, mirroring the reference's ANTLR mode:
+    1. grammar: the independent strict parser (strict_grammar.py) —
+       clause order, UNION mixing, pagination types, pattern shape —
+       the class of syntax errors the fast parser tolerates;
+    2. semantics: undefined variables, aggregates in WHERE, unknown
+       functions, over the fast parser's AST."""
     from nornicdb_tpu.query.parser import parse
+    from nornicdb_tpu.query.strict_grammar import StrictParser, \
+        StrictSyntaxError
 
     diags: List[Diagnostic] = []
+    try:
+        StrictParser(query).parse()
+    except StrictSyntaxError as e:
+        diags.append(Diagnostic("error", e.bare_message, e.line, e.column))
+        return diags
+    except CypherSyntaxError as e:
+        diags.append(Diagnostic("error", str(e)))
+        return diags
     try:
         uq = parse(query)
     except CypherSyntaxError as e:

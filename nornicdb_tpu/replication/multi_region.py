@@ -10,14 +10,23 @@ This redesign tightens two things the reference leaves loose:
 - **Region fencing.** Every cross-region message carries a region
   epoch. ``promote_region()`` bumps the epoch and broadcasts a fence;
   the deposed primary region demotes itself the moment it sees the
-  higher epoch, so two regions can never both accept writes after a
-  failover heals (the reference only flips an ``isPrimary`` bool).
+  higher epoch (the reference only flips an ``isPrimary`` bool).
+  There IS a divergence window: writes the old primary accepted
+  between the promotion and its demotion were committed to its
+  regional raft but never streamed. On demotion they are detected
+  (entries past the new primary's acked watermark) and surfaced via
+  ``diverged_entries()`` / ``health()['diverged']`` for
+  reconciliation — they are never silently dropped, and never
+  silently merged either (the new primary's history wins).
 - **Exact convergence.** The raft log index doubles as the cross-region
   sequence: receivers apply strictly in order, buffer out-of-order
   batches, and pull gaps via ``xr_sync`` catch-up — the same
   watermark + reorder-buffer discipline the HA standby uses
   (ha_standby.py), so a partitioned region converges exactly once the
-  link heals.
+  link heals. A promoted region streams from its promotion point and
+  stamps that base on every fence/batch, so receivers fast-forward
+  their watermark instead of re-pulling the shared history from
+  xseq 0 on every failover.
 
 All handlers are plain methods over the loopback ClusterTransport, so
 multi-region clusters run in one process for tests (SURVEY.md §4
@@ -67,15 +76,25 @@ class MultiRegionNode(Replicator):
         self.region_id = config.region_id
         self.region_epoch = 1
         self._is_primary_region = bool(config.region_primary)
+        self._primary_region: Optional[str] = (
+            self.region_id if self._is_primary_region else None
+        )
         self._lock = threading.Lock()
         self._closed = threading.Event()
         # streaming state (leader of primary region): per remote region,
         # the highest raft index acked by that region
         self._streamed: Dict[str, int] = {}
+        # outbound stream base: raft index at which this region became
+        # primary (0 for the initial primary). Stamped on fences and
+        # batches so receivers fast-forward instead of catching up from 0.
+        self._xr_base = 0
         # receiving state: per origin region, applied watermark and the
         # out-of-order buffer
         self._applied_from: Dict[str, int] = {}
         self._reorder: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        # entries committed while primary that the NEW primary never
+        # acked, captured at demotion for reconciliation
+        self._diverged: List[Dict[str, Any]] = []
 
         self._raft = RaftNode(transport, config, self._apply_local)
         transport.register_handler("xr_batch", self.handle_xr_batch)
@@ -103,7 +122,9 @@ class MultiRegionNode(Replicator):
         coordination'); within it, only the local raft leader accepts."""
         with self._lock:
             if not self._is_primary_region:
-                raise NotPrimaryRegionError(self.primary_region_hint())
+                # read the hint inline: primary_region_hint() takes the
+                # same non-reentrant lock
+                raise NotPrimaryRegionError(self._primary_region)
         self._raft.apply(op, data)
 
     def _apply_local(self, op: str, data: Dict[str, Any]) -> None:
@@ -121,7 +142,8 @@ class MultiRegionNode(Replicator):
             return self._is_primary_region
 
     def primary_region_hint(self) -> Optional[str]:
-        return None  # a deposed region learns the new primary by fence
+        with self._lock:
+            return self._primary_region
 
     # -- cross-region streaming (primary-region raft leader only) ---------
 
@@ -138,7 +160,9 @@ class MultiRegionNode(Replicator):
 
     def _stream_once(self, epoch: int) -> None:
         for region, addrs in self.config.remote_regions:
-            acked = self._streamed.get(region, 0)
+            with self._lock:
+                acked = max(self._streamed.get(region, 0), self._xr_base)
+                base = self._xr_base
             entries = self._raft.committed_entries(acked)
             if not entries:
                 continue
@@ -146,6 +170,7 @@ class MultiRegionNode(Replicator):
                 "type": "xr_batch",
                 "region": self.region_id,
                 "epoch": epoch,
+                "base": base,
                 "records": [
                     {"xseq": i, "op": op, "data": data}
                     for i, op, data in entries
@@ -157,13 +182,17 @@ class MultiRegionNode(Replicator):
                 except ConnectionError:
                     continue
                 if reply.get("ok"):
-                    self._streamed[region] = int(
-                        reply.get("applied_xseq", acked)
-                    )
+                    with self._lock:
+                        self._streamed[region] = int(
+                            reply.get("applied_xseq", acked)
+                        )
                     break
                 if reply.get("error") == "fenced":
                     # a higher-epoch region exists: demote ourselves
-                    self._demote(int(reply.get("epoch", epoch)))
+                    self._demote(
+                        int(reply.get("epoch", epoch)),
+                        new_primary=reply.get("primary_region"),
+                    )
                     return
                 # not the remote leader: try the next address
 
@@ -172,15 +201,29 @@ class MultiRegionNode(Replicator):
     def handle_xr_batch(self, msg: ClusterMessage) -> ClusterMessage:
         origin = msg.get("region", "?")
         epoch = int(msg.get("epoch", 0))
+        was_primary = False
         with self._lock:
             if epoch < self.region_epoch:
                 return {"ok": False, "error": "fenced",
-                        "epoch": self.region_epoch}
+                        "epoch": self.region_epoch,
+                        "primary_region": self._primary_region}
             if epoch > self.region_epoch:
                 # a newer primary region is streaming: adopt its epoch
                 # and drop any stale primary claim of our own
                 self.region_epoch = epoch
+                was_primary = self._is_primary_region
                 self._is_primary_region = False
+            # only the primary region streams batches, so origin IS it
+            self._primary_region = origin
+            # fast-forward the origin watermark to the stream base (the
+            # origin's promotion point): everything at or below it is the
+            # shared pre-failover history, already applied via the OLD
+            # origin's stream — re-pulling it would replay O(history)
+            base = int(msg.get("base", 0))
+            if base > self._applied_from.get(origin, 0):
+                self._applied_from[origin] = base
+        if was_primary:
+            self._capture_divergence(origin)
         if self._raft.role is not Role.PRIMARY:
             return {"ok": False, "error": "not_leader",
                     "leader": self._raft.leader_id}
@@ -276,12 +319,15 @@ class MultiRegionNode(Replicator):
         with self._lock:
             self.region_epoch += 1
             self._is_primary_region = True
+            self._primary_region = self.region_id
             epoch = self.region_epoch
             # everything committed here so far was imported from (or
             # already shared with) the other regions — streaming it back
             # would re-append the whole history to their logs on every
-            # failover. Start the outbound stream at the promotion point.
+            # failover. Start the outbound stream at the promotion point
+            # and stamp it on fences/batches so receivers fast-forward.
             start = self._raft.commit_index
+            self._xr_base = start
             for region, _addrs in self.config.remote_regions:
                 self._streamed.setdefault(region, 0)
                 self._streamed[region] = max(self._streamed[region], start)
@@ -289,30 +335,79 @@ class MultiRegionNode(Replicator):
             "type": "region_fence",
             "region": self.region_id,
             "epoch": epoch,
+            "base": start,
         }
+        # fence EVERY node of every remote region, not first-success:
+        # regional roles aren't known here, and a fence that only
+        # reaches a follower leaves that region's leader accepting
+        # writes until the next stream exchange
         for _region, addrs in self.config.remote_regions:
             for addr in addrs:
                 try:
                     self.transport.request(tuple(addr), fence)
-                    break
                 except ConnectionError:
                     continue
 
     def handle_region_fence(self, msg: ClusterMessage) -> ClusterMessage:
         epoch = int(msg.get("epoch", 0))
+        origin = msg.get("region", "?")
         with self._lock:
-            if epoch > self.region_epoch:
-                self.region_epoch = epoch
-                self._is_primary_region = False
-                return {"ok": True}
-            return {"ok": False, "error": "stale fence epoch",
-                    "epoch": self.region_epoch}
-
-    def _demote(self, epoch: int) -> None:
-        with self._lock:
-            if epoch > self.region_epoch:
-                self.region_epoch = epoch
+            if epoch <= self.region_epoch:
+                return {"ok": False, "error": "stale fence epoch",
+                        "epoch": self.region_epoch,
+                        "primary_region": self._primary_region}
+            self.region_epoch = epoch
+            was_primary = self._is_primary_region
             self._is_primary_region = False
+            self._primary_region = origin
+            base = int(msg.get("base", 0))
+            if base > self._applied_from.get(origin, 0):
+                self._applied_from[origin] = base
+        if was_primary:
+            self._capture_divergence(origin)
+        return {"ok": True}
+
+    def _demote(self, epoch: int, new_primary: Optional[str] = None) -> None:
+        with self._lock:
+            if epoch > self.region_epoch:
+                self.region_epoch = epoch
+            was_primary = self._is_primary_region
+            self._is_primary_region = False
+            if new_primary:
+                self._primary_region = new_primary
+        if was_primary:
+            self._capture_divergence(new_primary)
+
+    def _capture_divergence(self, new_primary: Optional[str]) -> None:
+        """Record writes this region committed as primary that the new
+        primary never acked. They exist because fencing is detection,
+        not prevention: between the remote promotion and this demotion,
+        local clients could still commit here. The new primary's history
+        wins; these entries are surfaced (``diverged_entries()``,
+        ``health()['diverged']``) for explicit reconciliation rather
+        than silently dropped or silently merged."""
+        with self._lock:
+            if new_primary is not None and new_primary in self._streamed:
+                acked = self._streamed[new_primary]
+            elif self._streamed:
+                acked = min(self._streamed.values())
+            else:
+                acked = self._raft.commit_index
+        entries = self._raft.committed_entries(acked)
+        if entries:
+            with self._lock:
+                known = {d["xseq"] for d in self._diverged}
+                self._diverged.extend(
+                    {"xseq": i, "op": op, "data": data}
+                    for i, op, data in entries
+                    if i not in known
+                )
+
+    def diverged_entries(self) -> List[Dict[str, Any]]:
+        """Entries committed here as primary that the current primary
+        region never received (captured at demotion)."""
+        with self._lock:
+            return list(self._diverged)
 
     # -- introspection ----------------------------------------------------
 
@@ -327,6 +422,8 @@ class MultiRegionNode(Replicator):
                 "is_primary_region": self._is_primary_region,
                 "raft_role": self._raft.role.value,
                 "raft_leader": self._raft.leader_id,
+                "primary_region": self._primary_region,
                 "streamed": dict(self._streamed),
                 "applied_from": dict(self._applied_from),
+                "diverged": len(self._diverged),
             }

@@ -20,6 +20,12 @@ class ANNProfile:
     hnsw_ef_search: int = 64
     nprobe: int = 8
     pq_subspaces: int = 16
+    # exact-rerank refine store for the ivfpq tier: fp16 vector copy
+    # (2 bytes/dim) + ADC-pool reranking. On by default for the
+    # compressed profile — it is what makes ivfpq recall usable — with
+    # NORNICDB_VECTOR_PQ_REFINE=0 to opt out when the memory budget
+    # really is codes-only.
+    pq_refine: bool = True
 
 
 PROFILES = {

@@ -268,6 +268,16 @@ class HNSWIndex:
 
     # -- insert (reference: Add :174) --------------------------------------
 
+    MAX_LEVEL = 12  # clamp the geometric tail: _visit_scratch reserves 16
+    # generations per call (one per level + slack); an unbounded draw
+    # could overlap the next call's range and stamp false "visited"
+
+    def _sample_level(self) -> int:
+        return min(
+            int(-math.log(max(self._rng.random(), 1e-12)) * self._ml),
+            self.MAX_LEVEL,
+        )
+
     def add(self, ext_id: str, vector: Sequence[float]) -> None:
         v = self._normalize(np.asarray(vector, dtype=np.float32))
         with self._lock:
@@ -276,7 +286,7 @@ class HNSWIndex:
                 # edges anchored in the old region (silent recall loss);
                 # tombstone the old slot and insert fresh so links re-form
                 self.remove(ext_id)
-            level = int(-math.log(max(self._rng.random(), 1e-12)) * self._ml)
+            level = self._sample_level()
             slot = self._alloc_slot(ext_id, v, level)
             if self._entry < 0:
                 self._entry = slot
@@ -376,10 +386,7 @@ class HNSWIndex:
         for ext_id, _ in batch:
             if ext_id in self._slot_of:
                 self.remove(ext_id)
-        levels = [
-            int(-math.log(max(self._rng.random(), 1e-12)) * self._ml)
-            for _ in range(B)
-        ]
+        levels = [self._sample_level() for _ in range(B)]
         pre_entry, pre_max = self._entry, self._max_level
         slots = [
             self._alloc_slot(batch[j][0], Q[j], levels[j]) for j in range(B)

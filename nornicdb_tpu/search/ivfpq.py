@@ -39,19 +39,23 @@ def _euclid_kmeans(
         int(i) for i in (seed_ids or []) if 0 <= int(i) < n))[:k]
     if not chosen:
         chosen = [int(rng.integers(n))]
+    # incremental k-means++: keep the running min-distance-to-chosen
+    # array and update it against ONLY the newest center — O(k*n*d),
+    # not O(k^2*n*d) (the recompute-all version took ~9 min for one
+    # 256-code codebook at n=10k)
+    d2 = np.full(n, np.inf, dtype=np.float64)
+    for i in chosen:
+        d2 = np.minimum(d2, np.sum((x - x[i]) ** 2, axis=1))
     while len(chosen) < k:
-        c = x[chosen]
-        d2 = np.min(
-            np.sum((x[:, None, :] - c[None, :, :]) ** 2, axis=2), axis=1
-        ) if len(chosen) * n * x.shape[1] < 5e7 else np.min(
-            np.stack([np.sum((x - ci) ** 2, axis=1) for ci in c]), axis=0)
         total = d2.sum()
         if total <= 1e-12:
             # all remaining points coincide with a centroid (duplicate/
             # constant subvectors): fall back to uniform picks
-            chosen.append(int(rng.integers(n)))
-            continue
-        chosen.append(int(rng.choice(n, p=d2 / total)))
+            nxt = int(rng.integers(n))
+        else:
+            nxt = int(rng.choice(n, p=d2 / total))
+        chosen.append(nxt)
+        d2 = np.minimum(d2, np.sum((x - x[nxt]) ** 2, axis=1))
     cent = x[chosen].copy()
     assign = np.zeros(n, dtype=np.int64)
     for it in range(iters):
@@ -78,11 +82,27 @@ class IVFPQIndex:
         n_codes: int = 256,
         n_clusters: Optional[int] = None,
         nprobe: int = 8,
+        keep_vectors: bool = False,
+        refine_factor: int = 4,
+        min_refine_pool: int = 128,
     ):
+        """``keep_vectors`` retains an fp16 copy of every vector for an
+        exact-rerank refinement stage: ADC ranks a candidate pool of
+        ``max(refine_factor * k, min_refine_pool)``, then true cosine
+        re-scores it. PQ codes alone cap recall hard (8-32 bytes cannot
+        separate near neighbors); rerank buys back exactness for
+        2 bytes/dim — the standard IVFPQ+refine design (the reference
+        keeps a vector cache alongside its IVFPQ tier,
+        pkg/search/vector_index_cache.go). Default OFF: the compressed
+        tier exists for the memory budget, and a silent fp16 copy would
+        multiply it ~30x; quality-critical callers opt in."""
         self.m = n_subspaces
         self.n_codes = n_codes
         self.n_clusters = n_clusters
         self.nprobe = nprobe
+        self.keep_vectors = keep_vectors
+        self.refine_factor = max(1, refine_factor)
+        self.min_refine_pool = max(1, min_refine_pool)
 
         self.dims: Optional[int] = None
         self.coarse: Optional[np.ndarray] = None  # [K, D]
@@ -90,6 +110,7 @@ class IVFPQIndex:
         self._ids: List[str] = []
         self._codes: Optional[np.ndarray] = None  # [N, M] uint8
         self._assign: Optional[np.ndarray] = None  # [N] coarse cluster
+        self._vecs: Optional[np.ndarray] = None  # [N, D] fp16 (refine)
         self._id_pos: Dict[str, int] = {}
         self._alive: Optional[np.ndarray] = None  # [N] bool
         self._lock = threading.Lock()
@@ -183,6 +204,11 @@ class IVFPQIndex:
                     self._ids.append(ext_id)
                     staged[ext_id] = len(new_rows)
                     new_rows.append(row)
+            for row, (ext_id, _) in enumerate(items):
+                pos = self._id_pos.get(ext_id)
+                if (self.keep_vectors and pos is not None
+                        and pos < existing):
+                    self._vecs[pos] = vecs[row].astype(np.float16)
             if new_rows:
                 # one concatenate per batch, not per item (O(N*B) -> O(B))
                 nc = codes[new_rows]
@@ -191,10 +217,16 @@ class IVFPQIndex:
                 if self._codes is None:
                     self._codes, self._assign, self._alive = (
                         nc.copy(), na.copy(), nv)
+                    if self.keep_vectors:
+                        self._vecs = vecs[new_rows].astype(np.float16)
                 else:
                     self._codes = np.vstack([self._codes, nc])
                     self._assign = np.concatenate([self._assign, na])
                     self._alive = np.concatenate([self._alive, nv])
+                    if self.keep_vectors:
+                        self._vecs = np.vstack([
+                            self._vecs,
+                            vecs[new_rows].astype(np.float16)])
 
     def remove(self, ext_id: str) -> bool:
         with self._lock:
@@ -214,8 +246,10 @@ class IVFPQIndex:
         self, query: Sequence[float], k: int = 10,
         nprobe: Optional[int] = None,
     ) -> List[Tuple[str, float]]:
-        """Approximate top-k by ADC over the nprobe nearest clusters.
-        Scores are negated squared L2 distances (higher = closer)."""
+        """Approximate top-k: ADC over the nprobe nearest clusters ranks
+        a refine_factor*k candidate pool; when vectors are kept, exact
+        cosine reranks the pool (scores = cosine). Without the refine
+        store, scores are negated squared residual-ADC distances."""
         if not self.trained or self._codes is None:
             return []
         q = _normalize(np.asarray(query, dtype=np.float32))
@@ -231,6 +265,7 @@ class IVFPQIndex:
             codes = self._codes.copy()
             assign = self._assign.copy()
             alive = self._alive.copy()
+            has_refine = self._vecs is not None
         for c in probe:
             mask = (assign == c) & alive
             pos = np.nonzero(mask)[0]
@@ -251,10 +286,56 @@ class IVFPQIndex:
             return []
         scores = np.concatenate(out_scores)
         pos = np.concatenate(out_pos)
+        if has_refine:
+            # refinement: exact cosine over the ADC top pool. The pool
+            # floor matters — ADC ordering is noisy exactly when refine
+            # is needed, so k*refine_factor alone under-collects
+            pool = min(max(k * self.refine_factor, self.min_refine_pool),
+                       len(pos))
+            keep = np.argpartition(-scores, pool - 1)[:pool]
+            cand_pos = pos[keep]
+            with self._lock:
+                # copy the candidate rows under the lock: add_batch
+                # overwrites re-added ids' rows in place, and a torn
+                # fp16 row would mis-rank that candidate
+                exact = self._vecs[cand_pos].astype(np.float32) @ q
+            k_eff = min(k, pool)
+            top = np.argpartition(-exact, k_eff - 1)[:k_eff]
+            top = top[np.argsort(-exact[top])]
+            return [(self._ids[int(cand_pos[i])], float(exact[i]))
+                    for i in top]
         k_eff = min(k, len(pos))
         top = np.argpartition(-scores, k_eff - 1)[:k_eff]
         top = top[np.argsort(-scores[top])]
         return [(self._ids[int(pos[i])], float(scores[i])) for i in top]
+
+    # -- diagnostics ------------------------------------------------------
+
+    def coarse_hit_rate(
+        self, queries: np.ndarray, true_ids: Sequence[Sequence[str]],
+        nprobe: Optional[int] = None,
+    ) -> float:
+        """Fraction of ground-truth neighbors whose assigned cluster is
+        among the probed clusters — separates 'coarse index misses the
+        right cluster' (fix: more nprobe / better k-means) from 'PQ
+        codes cannot rank inside the cluster' (fix: more subspaces /
+        rerank). The r3 flat-recall bug class becomes diagnosable."""
+        if not self.trained or self._assign is None:
+            return 0.0
+        qn = _normalize(np.asarray(queries, dtype=np.float32))
+        nprobe = min(nprobe or self.nprobe, self.coarse.shape[0])
+        hits = total = 0
+        for qi in range(len(qn)):
+            cd = np.sum((self.coarse - qn[qi][None, :]) ** 2, axis=1)
+            probed = set(np.argpartition(cd, nprobe - 1)[:nprobe].tolist())
+            for tid in true_ids[qi]:
+                pos = self._id_pos.get(tid)
+                if pos is None:
+                    continue
+                total += 1
+                if int(self._assign[pos]) in probed:
+                    hits += 1
+        return hits / max(total, 1)
 
     # -- persistence (reference: ivfpq_persist.go:169) -------------------
 
@@ -270,13 +351,18 @@ class IVFPQIndex:
                       else np.zeros(0, np.int64))
             alive = (self._alive if self._alive is not None
                      else np.zeros(0, bool))
+            extra = {}
+            if self.keep_vectors and self._vecs is not None:
+                extra["vecs"] = self._vecs
             np.savez_compressed(
                 path,
                 m=self.m, n_codes=self.n_codes, nprobe=self.nprobe,
+                refine_factor=self.refine_factor,
+                min_refine_pool=self.min_refine_pool,
                 dims=self.dims, coarse=self.coarse,
                 codebooks=self.codebooks,
                 ids=np.asarray(self._ids, dtype=object),
-                codes=codes, assign=assign, alive=alive,
+                codes=codes, assign=assign, alive=alive, **extra,
             )
 
     @classmethod
@@ -284,7 +370,12 @@ class IVFPQIndex:
         z = np.load(path if path.endswith(".npz") else path + ".npz",
                     allow_pickle=True)
         idx = cls(n_subspaces=int(z["m"]), n_codes=int(z["n_codes"]),
-                  nprobe=int(z["nprobe"]))
+                  nprobe=int(z["nprobe"]),
+                  keep_vectors="vecs" in z.files,
+                  refine_factor=int(z["refine_factor"])
+                  if "refine_factor" in z.files else 4,
+                  min_refine_pool=int(z["min_refine_pool"])
+                  if "min_refine_pool" in z.files else 128)
         idx.dims = int(z["dims"])
         idx.coarse = z["coarse"]
         idx.codebooks = z["codebooks"]
@@ -292,5 +383,6 @@ class IVFPQIndex:
         idx._codes = z["codes"]
         idx._assign = z["assign"]
         idx._alive = z["alive"]
+        idx._vecs = z["vecs"] if "vecs" in z.files else None
         idx._id_pos = {e: i for i, e in enumerate(idx._ids)}
         return idx

@@ -70,9 +70,14 @@ class VectorSpace:
         elif kind == "ivfpq":
             from nornicdb_tpu.search.ivfpq import IVFPQIndex
 
+            import os
+
             p = current_profile()
+            refine = (p.pq_refine and os.environ.get(
+                "NORNICDB_VECTOR_PQ_REFINE", "1") != "0")
             self.index = IVFPQIndex(n_subspaces=p.pq_subspaces,
-                                    nprobe=p.nprobe)
+                                    nprobe=p.nprobe,
+                                    keep_vectors=refine)
         else:
             raise ValueError(f"unknown backend {kind!r}")
         return self.index

@@ -43,7 +43,7 @@ def stack():
     # qdrant collection mirroring the embeddings
     ch = grpc.insecure_channel(grpc_srv.address)
     req = q.CreateCollection(collection_name="people")
-    req.vectors_config.params.size = 256
+    req.vectors_config.params.size = db._embedder.dims
     req.vectors_config.params.distance = q.Cosine
     _grpc_call(ch, "/qdrant.Collections/Create", req,
                q.CollectionOperationResponse)

@@ -1,0 +1,232 @@
+"""Strict-grammar parser: accept/reject corpus diffed vs the fast parser.
+
+Reference: pkg/cypher/antlr/CypherParser.g4 + cypher-parser-modes.md —
+the strict mode's job is catching the malformed-query class the fast
+parser tolerates. The corpus pins three things:
+
+1. VALID queries (the executor's whole supported surface): strict must
+   accept everything the fast parser accepts — no false rejections;
+2. MALFORMED-BOTH: junk both parsers reject (strict with line/col);
+3. MALFORMED-STRICT-ONLY: the documented corpus of queries the fast
+   parser accepts but strict rejects — clause order, UNION mixing,
+   negative pagination, double WHERE, reserved-word names.
+"""
+
+import pytest
+
+from nornicdb_tpu.query import strict_grammar
+from nornicdb_tpu.query.parser import parse as fast_parse
+from nornicdb_tpu.errors import CypherSyntaxError
+from nornicdb_tpu.query.strict_grammar import StrictSyntaxError
+
+
+def fast_accepts(q):
+    try:
+        fast_parse(q)
+        return True
+    except CypherSyntaxError:
+        return False
+
+
+# -- 1. valid surface: strict accepts whatever fast accepts ---------------
+
+VALID = [
+    "MATCH (n) RETURN n",
+    "MATCH (n:Person) RETURN n.name AS name",
+    "MATCH (n:Person {name: 'Ann'}) RETURN n",
+    "MATCH (a)-[r:KNOWS]->(b) RETURN a, r, b",
+    "MATCH (a)-[:KNOWS|WORKS_AT]->(b) RETURN b",
+    "MATCH (a)-[:KNOWS|:WORKS_AT]->(b) RETURN b",
+    "MATCH (a)-[r*1..3]->(b) RETURN b",
+    "MATCH (a)-[*]->(b) RETURN b",
+    "MATCH (a)-[*..5]->(b) RETURN b",
+    "MATCH (a)-[*2]->(b) RETURN b",
+    "MATCH (a)--(b) RETURN b",
+    "MATCH (a)<-[r]-(b) RETURN r",
+    "MATCH p = (a)-[:X]->(b) RETURN p",
+    "MATCH (a), (b) RETURN shortestPath((a)-[*]-(b))",
+    "MATCH (n) WHERE n.age > 21 AND n.name STARTS WITH 'A' RETURN n",
+    "MATCH (n) WHERE n.name =~ '.*x.*' OR NOT n.flag RETURN n",
+    "MATCH (n) WHERE n.age IS NOT NULL RETURN n",
+    "MATCH (n) WHERE (n)-[:KNOWS]->() RETURN n",
+    "MATCH (n) WHERE exists((n)-[:X]->()) RETURN n",
+    "MATCH (n) WHERE n:Person:Admin RETURN n",
+    "MATCH (n) WHERE n.x IN [1, 2, 3] RETURN n",
+    "MATCH (n) RETURN n ORDER BY n.name DESC, n.age ASC SKIP 5 LIMIT 10",
+    "MATCH (n) RETURN DISTINCT n.city",
+    "MATCH (n) RETURN count(*) AS c",
+    "MATCH (n) RETURN count(DISTINCT n.city)",
+    "MATCH (n) WITH n.city AS city, count(*) AS c WHERE c > 1 "
+    "RETURN city, c",
+    "MATCH (n) WITH n ORDER BY n.age LIMIT 3 RETURN n",
+    "MATCH (n) WITH * RETURN n",
+    "UNWIND [1, 2, 3] AS x RETURN x * 2",
+    "UNWIND $rows AS row CREATE (n:Row {v: row}) RETURN n",
+    "UNWIND range(1, 10) AS i RETURN sum(i)",
+    "CREATE (n:Person {name: 'Bo'}) RETURN n",
+    "CREATE (a)-[:KNOWS {since: 2020}]->(b)",
+    "CREATE (a:X), (b:Y)",
+    "MERGE (n:Person {name: 'Cy'}) RETURN n",
+    "MERGE (n:P {k: 1}) ON CREATE SET n.created = 1 "
+    "ON MATCH SET n.seen = n.seen + 1 RETURN n",
+    "MATCH (n:Gone) DELETE n",
+    "MATCH (n:Gone) DETACH DELETE n",
+    "MATCH (n) SET n.x = 1, n.y = 2",
+    "MATCH (n) SET n += {a: 1}",
+    "MATCH (n) SET n:Flagged",
+    "MATCH (n) REMOVE n.x, n:Label",
+    "CALL db.labels()",
+    "CALL db.labels() YIELD label RETURN label",
+    "CALL dbms.components() YIELD name, versions AS v RETURN name, v",
+    "CALL db.labels() YIELD *",
+    "RETURN 1 + 2 * 3 ^ 2 - -4 AS v",
+    "RETURN 'a' + 'b' CONTAINS 'ab' AS t",
+    "RETURN [x IN [1,2,3] WHERE x > 1 | x * 10] AS xs",
+    "RETURN [x IN range(1, 5)] AS xs",
+    "RETURN all(x IN [1,2] WHERE x > 0) AS t",
+    "RETURN any(x IN [1,2] WHERE x > 1) AS t",
+    "RETURN none(x IN [] WHERE true) AS t",
+    "RETURN single(x IN [1] WHERE x = 1) AS t",
+    "RETURN reduce(acc = 0, x IN [1,2,3] | acc + x) AS s",
+    "RETURN filter(x IN [1,2] WHERE x > 1) AS xs",
+    "RETURN extract(x IN [1,2] | x + 1) AS xs",
+    "RETURN CASE WHEN 1 > 0 THEN 'y' ELSE 'n' END AS r",
+    "RETURN CASE 2 WHEN 1 THEN 'a' WHEN 2 THEN 'b' END AS r",
+    "RETURN {a: 1, b: [1, 2], c: {d: 'x'}} AS m",
+    "RETURN $param AS p",
+    "RETURN [1,2,3][0] AS h, [1,2,3][1..] AS t, [1,2,3][..2] AS i",
+    "RETURN apoc.coll.sum([1, 2.5]) AS s",
+    "MATCH (n) RETURN n LIMIT $lim",
+    "RETURN 1 AS a UNION RETURN 2 AS a",
+    "RETURN 1 AS a UNION ALL RETURN 1 AS a UNION ALL RETURN 2 AS a",
+    "MATCH (n) RETURN COUNT { (n)--() } AS deg",
+    "CREATE (n:A) WITH n MATCH (m:B) RETURN n, m",
+    "MATCH (n) RETURN n;",
+    "RETURN 0x1F AS h",
+    "RETURN 1.5e3 AS f",
+    "RETURN size([1,2]) > 1 = true AS chained",
+]
+
+
+# -- 2. malformed for both parsers ----------------------------------------
+
+MALFORMED_BOTH = [
+    "MATCH (n RETURN n",
+    "MATCH (n)) RETURN n",
+    "MATCH (n) RETURN",
+    "RETURN",
+    "MATCH (n) WHERE RETURN n",
+    "MATCH (n) RETURN n,",
+    "UNWIND [1,2] RETURN x",
+    "MATCH (a)-[r]>(b) RETURN r",
+    "CASE WHEN 1 THEN 2",
+    "RETURN CASE WHEN 1 > 0 THEN 1",
+    "RETURN reduce(acc, x IN [1] | acc)",
+    "RETURN all(x IN [1])",
+    "MATCH (n) SET RETURN n",
+    "MERGE RETURN 1",
+    "RETURN {a 1}",
+    "RETURN [1, 2",
+    "FOO (n) RETURN n",
+    "MATCH (n) FOO n RETURN n",
+    "MERGE (a:X), (b:Y)",
+    "MERGE (n:X) ON FOO SET n.x = 1",
+]
+
+
+# -- 3. the strict-only reject corpus (fast parser is lax here) -----------
+
+STRICT_ONLY = [
+    # clause order: nothing follows RETURN
+    "MATCH (n) RETURN n MATCH (m) RETURN m",
+    "MATCH (n) RETURN n CREATE (m)",
+    "MATCH (n) RETURN n SET n.x = 1",
+    "MATCH (n) DELETE n RETURN n SET n.x = 1",
+    # reading after updating without WITH
+    "CREATE (n) MATCH (m) RETURN m, n",
+    "MERGE (n:X) MATCH (m) RETURN m",
+    "CREATE (n) UNWIND [1] AS x RETURN x",
+    "CREATE (n) CALL db.labels() YIELD label RETURN label",
+    # UNION / UNION ALL mixing
+    "RETURN 1 AS a UNION RETURN 2 AS a UNION ALL RETURN 3 AS a",
+    "RETURN 1 AS a UNION ALL RETURN 2 AS a UNION RETURN 3 AS a",
+    # double WHERE merged silently by the fast parser
+    "MATCH (n) WHERE n.x > 0 WHERE n.x < 9 RETURN n",
+    "WITH 1 AS x WHERE x > 0 WHERE x < 2 RETURN x",
+    # pagination shape
+    "MATCH (n) RETURN n LIMIT -1",
+    "MATCH (n) RETURN n SKIP -3",
+    "MATCH (n) RETURN n LIMIT 1.5",
+    "MATCH (n) RETURN n SKIP 2.0",
+    # multiple ;-separated statements silently concatenated by fast
+    "MATCH (n) RETURN n; MATCH (m) RETURN m",
+    # empty input is not a query
+    "",
+    "   ",
+    # reserved words swallowed as names by the fast parser
+    "MATCH (n:RETURN) RETURN n",
+    "MATCH (n) RETURN n.MATCH",
+]
+
+
+class TestValidSurface:
+    @pytest.mark.parametrize("q", VALID)
+    def test_strict_accepts(self, q):
+        strict_grammar.parse(q)  # no exception
+
+    @pytest.mark.parametrize("q", VALID)
+    def test_fast_accepts_too(self, q):
+        assert fast_accepts(q), q
+
+
+class TestMalformedBoth:
+    @pytest.mark.parametrize("q", MALFORMED_BOTH)
+    def test_strict_rejects(self, q):
+        with pytest.raises(CypherSyntaxError):
+            strict_grammar.parse(q)
+
+    @pytest.mark.parametrize("q", MALFORMED_BOTH)
+    def test_fast_rejects_too(self, q):
+        assert not fast_accepts(q), q
+
+
+class TestStrictOnly:
+    @pytest.mark.parametrize("q", STRICT_ONLY)
+    def test_strict_rejects(self, q):
+        with pytest.raises(StrictSyntaxError):
+            strict_grammar.parse(q)
+
+    @pytest.mark.parametrize("q", STRICT_ONLY)
+    def test_fast_is_lax_here(self, q):
+        """Documents WHY strict mode exists: these parse on the fast
+        path. If the fast parser later tightens one of these, move the
+        case to MALFORMED_BOTH — the corpus is the contract."""
+        assert fast_accepts(q), q
+
+
+class TestDiagnosticPositions:
+    def test_line_and_column_attached(self):
+        with pytest.raises(StrictSyntaxError) as ei:
+            strict_grammar.parse("MATCH (n)\nRETURN n\nMATCH (m)")
+        assert ei.value.line == 3
+        assert ei.value.column == 1
+
+    def test_column_mid_line(self):
+        with pytest.raises(StrictSyntaxError) as ei:
+            strict_grammar.parse("MATCH (n) RETURN n LIMIT -1")
+        assert ei.value.line == 1
+        assert ei.value.column >= 20
+
+    def test_validate_integration(self):
+        from nornicdb_tpu.query.strict import validate
+
+        diags = validate("MATCH (n) RETURN n MATCH (m) RETURN m")
+        assert diags and diags[0].severity == "error"
+        assert "RETURN" in diags[0].message
+
+    def test_validate_clean_query_still_semantic(self):
+        from nornicdb_tpu.query.strict import validate
+
+        # grammar-clean but semantically wrong: undefined variable
+        diags = validate("MATCH (n) RETURN m")
+        assert any("not defined" in d.message for d in diags)
