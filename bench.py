@@ -93,6 +93,13 @@ def main():
         result["northstar"] = _bench_northstar()
     except Exception as exc:
         result["northstar"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # five-surface e2e throughput (reference: testing/e2e/README.md —
+    # bolt 2,489 / neo4j-http 4,082 / graphql 3,200 / REST search
+    # 10,296 / qdrant-grpc 29,331 ops/s on a 16-way dev box)
+    try:
+        result["surfaces"] = _bench_surfaces()
+    except Exception as exc:
+        result["surfaces"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
     # one-shot TPU proof (VERDICT r3 task 3): the first session where
     # the tunnel is up must capture EVERYTHING the TPU claim rests on —
     # compiled (non-interpret) Pallas kernels, batched device kNN, and
@@ -265,6 +272,120 @@ def _bench_tpu_proof():
     return out
 
 
+_SURFACE_BASELINES = {
+    "bolt": 2489.0,
+    "neo4j_http": 4082.0,
+    "graphql": 3200.0,
+    "rest_search": 10296.0,
+    "qdrant_grpc": 29331.0,
+}
+
+
+def _bench_surfaces(n_people: int = 1000, secs: float = 1.5):
+    """Sustained single-stream ops/s on every protocol surface over one
+    1k-node dataset (reference: testing/e2e/endpoints_bench_test.go).
+    Uses the in-repo from-spec bolt client; HTTP via urllib; qdrant via
+    grpc. Each surface gets a short warmup then ``secs`` of timing."""
+    import urllib.request
+
+    import grpc
+
+    import nornicdb_tpu
+    from nornicdb_tpu.api.bolt import BoltServer
+    from nornicdb_tpu.api.grpc_server import GrpcServer
+    from nornicdb_tpu.api.http_server import HttpServer
+    from nornicdb_tpu.api.proto import qdrant_pb2 as q
+    from tests.test_e2e_surfaces import _Bolt
+
+    os.environ.setdefault("NORNICDB_TPU_EMBEDDER", "hash")
+    db = nornicdb_tpu.open(auto_embed=False)
+    embedder = db._embedder
+    for i in range(n_people):
+        db.store(f"person{i} writes about topic{i % 7}",
+                 node_id=f"p{i}", labels=["Person"],
+                 properties={"name": f"person{i}", "idx": i},
+                 embedding=embedder.embed(f"person{i} topic{i % 7}"))
+    db.flush()
+    db.recall("warm")  # build search indexes
+    http = HttpServer(db, port=0).start()
+    bolt = BoltServer(db, port=0).start()
+    grpc_srv = GrpcServer(db, port=0).start()
+    ch = grpc.insecure_channel(grpc_srv.address)
+
+    def grpc_call(method, request, response_cls):
+        return ch.unary_unary(
+            method,
+            request_serializer=lambda r: r.SerializeToString(),
+            response_deserializer=response_cls.FromString,
+        )(request)
+
+    def http_json(path, body):
+        data = json.dumps(body).encode()
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}{path}", data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    req = q.CreateCollection(collection_name="bench")
+    req.vectors_config.params.size = embedder.dims
+    req.vectors_config.params.distance = q.Cosine
+    grpc_call("/qdrant.Collections/Create", req,
+              q.CollectionOperationResponse)
+    up = q.UpsertPoints(collection_name="bench")
+    for i in range(0, n_people, 4):
+        node = db.storage.get_node(f"p{i}")
+        p = up.points.add()
+        p.id.num = i
+        p.vectors.vector.data.extend(node.embedding)
+    grpc_call("/qdrant.Points/Upsert", up, q.PointsOperationResponse)
+
+    def sustain(fn):
+        fn()  # warmup
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < secs:
+            fn()
+            n += 1
+        return round(n / (time.perf_counter() - t0), 1)
+
+    out = {}
+    try:
+        b = _Bolt(bolt.port)
+        out["bolt"] = sustain(lambda: b.query_value(
+            "MATCH (p:Person {idx: 3}) RETURN p.name"))
+        b.close()
+        out["neo4j_http"] = sustain(lambda: http_json(
+            "/db/neo4j/tx/commit",
+            {"statements": [{"statement":
+                             "MATCH (p:Person {idx: 3}) "
+                             "RETURN p.name"}]}))
+        out["graphql"] = sustain(lambda: http_json(
+            "/graphql",
+            {"query": "{ nodes(label: \"Person\", limit: 5) "
+                      "{ id } }"}))
+        out["rest_search"] = sustain(lambda: http_json(
+            "/nornicdb/search", {"query": "topic1 person", "limit": 5}))
+        target = db.storage.get_node("p4")
+        sr = q.SearchPoints(collection_name="bench",
+                            vector=list(target.embedding), limit=5)
+        out["qdrant_grpc"] = sustain(lambda: grpc_call(
+            "/qdrant.Points/Search", sr, q.SearchResponse))
+    finally:
+        ch.close()
+        grpc_srv.stop()
+        bolt.stop()
+        http.stop()
+        db.close()
+    return {
+        name: {
+            "ops_per_s": ops,
+            "vs_baseline": round(ops / _SURFACE_BASELINES[name], 3),
+        }
+        for name, ops in out.items()
+    }
+
+
 def _bench_northstar():
     """BASELINE.json north-star configs the headline doesn't cover:
 
@@ -285,9 +406,15 @@ def _bench_northstar():
 
     out = {}
     rng = np.random.default_rng(5)
-    n, d, centers = 100_000, 64, 256
+    # 256-d topic-model corpus (VERDICT r3 tasks 4/5: >=256d with a
+    # real lexical backbone): vectors cluster by topic with Zipf-ish
+    # topic sizes, and each doc's TEXT draws from its topic's term
+    # pool, so BM25's high-IDF seeds genuinely cover the vector space
+    # the way bge-m3 embeddings of real docs do.
+    n, d, centers = 100_000, 256, 256
     cent = (rng.standard_normal((centers, d)) * 2.0).astype(np.float32)
-    assign = rng.integers(0, centers, n)
+    topic_p = rng.dirichlet(np.full(centers, 0.3))
+    assign = rng.choice(centers, n, p=topic_p)
     vecs = (cent[assign]
             + rng.standard_normal((n, d)).astype(np.float32))
     ids = [f"v{i}" for i in range(n)]
@@ -330,7 +457,14 @@ def _bench_northstar():
         return m / (time.perf_counter() - t0)
 
     # (1) HNSW build wall-clock, unseeded vs BM25-seeded
-    texts = [f"c{assign[i]} f{i % 7} g{i % 11} common" for i in range(n)]
+    # doc text = 5 draws from the topic's 12-term pool + shared terms
+    term_rng = np.random.default_rng(6)
+    topic_terms = [[f"t{c}w{j}" for j in range(12)] for c in range(centers)]
+    texts = [
+        " ".join(term_rng.choice(topic_terms[assign[i]], 5, replace=True))
+        + f" common f{i % 7}"
+        for i in range(n)
+    ]
     bm25 = BM25Index()
     bm25.index_batch(list(zip(ids, texts)))
     seeds = bm25.seed_doc_ids(max_seeds=2048)
@@ -353,21 +487,21 @@ def _bench_northstar():
         "unseeded_recall10": round(r_unseeded, 3),
         "seeded_wall_s": round(dt_seeded, 1),
         "seeded_recall10": round(r_seeded, 3),
-        # In the reference, seed-first insertion cuts wall-clock 2.7x
-        # because its serial heap search does less work over a good
-        # backbone. Our batched wave build does ef-bounded work per
-        # insert regardless of backbone quality, so seeding shows up as
-        # recall (backbone quality), not wall-clock — report both.
+        # Seed-first + adaptive bulk beam (hnsw.build bulk_ef_scale):
+        # the BM25-seeded backbone is topically representative, so the
+        # bulk phase builds with a halved construction beam at matched
+        # recall — the same less-work-over-a-good-backbone effect the
+        # reference reports as its 2.7x (release-notes-since-v1.0.11).
         "seeded_speedup": round(dt_unseeded / dt_seeded, 3),
         "bm25_seeds": len(seeds),
         "inserts_per_s": round(n / dt_seeded, 1),
         # reference marquee: 1M x 1024d in ~10 min on a 16-core M3 Max
         # = ~1,666 inserts/s (docs/release-notes-since-v1.0.11.md:75).
-        # This config is 100k x 64d on one CPU core — stated so the
+        # This config is 100k x 256d on fewer cores — stated so the
         # ratio is read with its caveats.
         "vs_baseline": round((n / dt_seeded) / 1666.7, 3),
         "baseline_note": "ref 1M x 1024d @ ~1666 inserts/s on M3 Max; "
-                         "this config 100k x 64d, 1 CPU core",
+                         "this config 100k x 256d",
     }
 
     # (2) ANN QPS@recall95 curves vs brute force (reuse the seeded HNSW)
@@ -411,9 +545,11 @@ def _bench_northstar():
         })
     curves["ivf_hnsw"] = sweep
 
-    pq = IVFPQIndex(n_clusters=64, n_subspaces=8)
+    pq = IVFPQIndex(n_clusters=64, n_subspaces=32, keep_vectors=True,
+                    min_refine_pool=512)
     pq.train(vecs[:20_000])
     pq.add_batch(sub_items)
+    gt_ids_sub = [[f"v{j}" for j in row] for row in gt_sub]
     sweep = []
     for nprobe in (1, 2, 4, 8):
         t0 = time.perf_counter()
@@ -423,8 +559,14 @@ def _bench_northstar():
             "nprobe": nprobe,
             "recall": round(recall_sub(pq, nprobe=nprobe), 3),
             "qps": round(nq / (time.perf_counter() - t0), 1),
+            "coarse_hit_rate": round(
+                pq.coarse_hit_rate(qn, gt_ids_sub, nprobe=nprobe), 3),
         })
     curves["ivfpq"] = sweep
+    curves["ivfpq_config"] = {
+        "subspaces": 32, "refine": True, "min_refine_pool": 512,
+        "code_bytes_per_vec": 32, "refine_bytes_per_vec": 2 * d,
+    }
 
     def qps_at_recall95(entries):
         ok = [e for e in entries if e["recall"] >= 0.95]

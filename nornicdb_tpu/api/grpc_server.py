@@ -14,6 +14,7 @@ generated ``nornic_pb2`` and handlers are plain methods.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Optional
 
@@ -260,11 +261,23 @@ class GrpcServer:
     per-collection index caches stay coherent across surfaces."""
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
-                 max_workers: int = 8, auth_token: Optional[str] = None):
+                 max_workers: int = 8, auth_token: Optional[str] = None,
+                 snapshot_dir: Optional[str] = None):
         import grpc
         from concurrent import futures
 
         self.db = db
+        if snapshot_dir is None:
+            # reference default: ./data/qdrant-snapshots (server.go:184);
+            # here snapshots live with the store when one exists
+            import tempfile
+
+            data_dir = getattr(db, "_data_dir", None)
+            snapshot_dir = (
+                os.path.join(data_dir, "qdrant-snapshots") if data_dir
+                else os.path.join(tempfile.gettempdir(),
+                                  "nornicdb-qdrant-snapshots"))
+        self.snapshot_dir = snapshot_dir
         interceptors = (
             [_token_interceptor(auth_token)] if auth_token else []
         )
@@ -279,15 +292,19 @@ class GrpcServer:
         from nornicdb_tpu.api.qdrant_official_grpc import (
             OfficialCollectionsServicer,
             OfficialPointsServicer,
+            OfficialSnapshotsServicer,
         )
 
         self.official_collections = OfficialCollectionsServicer(db.qdrant_compat)
         self.official_points = OfficialPointsServicer(db.qdrant_compat)
+        self.official_snapshots = OfficialSnapshotsServicer(
+            db.qdrant_compat, self.snapshot_dir)
         self._server.add_generic_rpc_handlers((
             self.search_servicer.handlers(),
             self.qdrant_servicer.handlers(),
             self.official_collections.handlers(),
             self.official_points.handlers(),
+            self.official_snapshots.handlers(),
         ))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host

@@ -28,6 +28,7 @@ from nornicdb_tpu.storage.types import Node, now_ms
 _META_PREFIX = "qdrant-meta/"
 _POINT_PREFIX = "qdrant/"
 _COLLECTION_LABEL = "_QdrantCollection"
+_ALIAS_META_ID = "qdrant-meta-aliases"
 
 
 class QdrantError(ValueError):
@@ -114,6 +115,7 @@ class QdrantCompat:
         )
 
     def get_collection(self, name: str) -> Dict[str, Any]:
+        name = self.resolve(name)
         meta = self._meta(name)
         return {
             "status": "green",
@@ -131,6 +133,199 @@ class QdrantCompat:
             return self.storage.get_node(_META_PREFIX + name)
         except (KeyError, NotFoundError):
             raise QdrantError(f"collection `{name}` not found", status=404)
+
+    # -- aliases (reference: Collections/UpdateAliases etc.,
+    # pkg/qdrantgrpc/server.go:658-665) --------------------------------
+
+    def _alias_map(self) -> Dict[str, str]:
+        try:
+            node = self.storage.get_node(_ALIAS_META_ID)
+            return dict(node.properties.get("aliases", {}))
+        except (KeyError, NotFoundError):
+            return {}
+
+    def _save_aliases(self, aliases: Dict[str, str]) -> None:
+        node = Node(id=_ALIAS_META_ID, labels=[_COLLECTION_LABEL + "Alias"],
+                    properties={"aliases": aliases})
+        if self.storage.has_node(_ALIAS_META_ID):
+            self.storage.update_node(node)
+        else:
+            self.storage.create_node(node)
+
+    def resolve(self, name: str) -> str:
+        """Alias -> collection name (identity when not an alias).
+        Point/read operations accept aliases, like upstream qdrant."""
+        return self._alias_map().get(name, name)
+
+    def update_aliases(self, actions: Sequence[Dict[str, Any]]) -> bool:
+        """Atomic batch of alias ops. Each action is one of:
+        {"create": {"alias": a, "collection": c}},
+        {"rename": {"old": o, "new": n}}, {"delete": {"alias": a}}."""
+        with self._lock:
+            aliases = self._alias_map()
+            for act in actions:
+                if "create" in act:
+                    a = act["create"]["alias"]
+                    c = act["create"]["collection"]
+                    if not self.storage.has_node(_META_PREFIX + c):
+                        raise QdrantError(
+                            f"collection `{c}` not found", status=404)
+                    if self.storage.has_node(_META_PREFIX + a):
+                        raise QdrantError(
+                            f"alias `{a}` collides with a collection")
+                    aliases[a] = c
+                elif "rename" in act:
+                    old = act["rename"]["old"]
+                    new = act["rename"]["new"]
+                    if old not in aliases:
+                        raise QdrantError(f"alias `{old}` not found",
+                                          status=404)
+                    aliases[new] = aliases.pop(old)
+                elif "delete" in act:
+                    a = act["delete"]["alias"]
+                    if a not in aliases:
+                        raise QdrantError(f"alias `{a}` not found",
+                                          status=404)
+                    del aliases[a]
+                else:
+                    raise QdrantError(f"unknown alias action {act!r}")
+            self._save_aliases(aliases)
+        return True
+
+    def list_aliases(
+        self, collection: Optional[str] = None
+    ) -> List[Dict[str, str]]:
+        return sorted(
+            ({"alias_name": a, "collection_name": c}
+             for a, c in self._alias_map().items()
+             if collection is None or c == collection),
+            key=lambda d: d["alias_name"],
+        )
+
+    # -- snapshots (reference: pkg/qdrantgrpc/snapshots_service.go) ------
+
+    def _snap_dir(self, base: str, name: Optional[str] = None) -> str:
+        import os
+
+        d = (os.path.join(base, "collections", name)
+             if name else os.path.join(base, "full"))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _snapshot_payload(self, name: str) -> Dict[str, Any]:
+        meta = self._meta(name)
+        points = []
+        for node in self.storage.get_nodes_by_label(self._label(name)):
+            points.append({
+                "id": node.properties.get("_point_id"),
+                "vector": node.properties.get("_vector") or [],
+                "payload": node.properties.get("payload") or {},
+            })
+        return {
+            "version": "nornicdb-tpu-qdrant-1",
+            "collection": name,
+            "config": meta.properties.get("config", {}),
+            "points": points,
+        }
+
+    def create_snapshot(self, name: str, base_dir: str) -> Dict[str, Any]:
+        import json as _json
+        import os
+
+        name = self.resolve(name)
+        payload = self._snapshot_payload(name)
+        ts = time.time()
+        snap_name = f"{name}-{int(ts * 1e9)}.snapshot"
+        path = os.path.join(self._snap_dir(base_dir, name), snap_name)
+        with open(path, "w", encoding="utf-8") as f:
+            _json.dump(payload, f)
+        return {"name": snap_name, "size": os.path.getsize(path),
+                "creation_time": ts}
+
+    def list_snapshots(self, name: str, base_dir: str) -> List[Dict[str, Any]]:
+        import os
+
+        name = self.resolve(name)
+        self._meta(name)
+        d = self._snap_dir(base_dir, name)
+        out = []
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".snapshot"):
+                st = os.stat(os.path.join(d, fn))
+                out.append({"name": fn, "size": st.st_size,
+                            "creation_time": st.st_mtime})
+        return out
+
+    def delete_snapshot(self, name: str, snap_name: str,
+                        base_dir: str) -> bool:
+        import os
+
+        name = self.resolve(name)
+        path = os.path.join(self._snap_dir(base_dir, name), snap_name)
+        if not os.path.exists(path):
+            raise QdrantError(f"snapshot `{snap_name}` not found",
+                              status=404)
+        os.remove(path)
+        return True
+
+    def create_full_snapshot(self, base_dir: str) -> Dict[str, Any]:
+        """One archive of every collection (reference CreateFull)."""
+        import json as _json
+        import os
+
+        ts = time.time()
+        snap_name = f"full-{int(ts * 1e9)}.snapshot"
+        payload = {
+            "version": "nornicdb-tpu-qdrant-1",
+            "collections": [self._snapshot_payload(n)
+                            for n in self.list_collections()],
+            "aliases": self._alias_map(),
+        }
+        path = os.path.join(self._snap_dir(base_dir), snap_name)
+        with open(path, "w", encoding="utf-8") as f:
+            _json.dump(payload, f)
+        return {"name": snap_name, "size": os.path.getsize(path),
+                "creation_time": ts}
+
+    def list_full_snapshots(self, base_dir: str) -> List[Dict[str, Any]]:
+        import os
+
+        d = self._snap_dir(base_dir)
+        out = []
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".snapshot"):
+                st = os.stat(os.path.join(d, fn))
+                out.append({"name": fn, "size": st.st_size,
+                            "creation_time": st.st_mtime})
+        return out
+
+    def delete_full_snapshot(self, snap_name: str, base_dir: str) -> bool:
+        import os
+
+        path = os.path.join(self._snap_dir(base_dir), snap_name)
+        if not os.path.exists(path):
+            raise QdrantError(f"snapshot `{snap_name}` not found",
+                              status=404)
+        os.remove(path)
+        return True
+
+    def recover_snapshot(self, name: str, snap_name: str,
+                         base_dir: str) -> int:
+        """Restore a collection from a snapshot file (drops current
+        contents first). Returns restored point count."""
+        import json as _json
+        import os
+
+        path = os.path.join(self._snap_dir(base_dir, name), snap_name)
+        if not os.path.exists(path):
+            raise QdrantError(f"snapshot `{snap_name}` not found",
+                              status=404)
+        with open(path, encoding="utf-8") as f:
+            payload = _json.load(f)
+        if self.storage.has_node(_META_PREFIX + name):
+            self.delete_collection(name)
+        self.create_collection(name, payload.get("config") or None)
+        return self.upsert_points(name, payload.get("points", []))
 
     @staticmethod
     def _label(name: str) -> str:
@@ -165,6 +360,7 @@ class QdrantCompat:
         authoritative (embedding-ownership rule, COMPAT.md:12-14).
         The whole batch is validated before any write so a bad point
         never leaves a partially-applied batch."""
+        name = self.resolve(name)
         meta = self._meta(name)
         want = meta.properties.get("config", {}).get("size", 0)
         idx = self._index(name)

@@ -172,6 +172,15 @@ def _abort(context, e: Exception) -> None:
     context.abort(code, str(e))
 
 
+
+def _guard(context, fn):
+    """Run a compat call, translating QdrantError into a grpc abort."""
+    try:
+        return fn()
+    except QdrantError as e:
+        _abort(context, e)
+
+
 def _unary(fn, req_cls):
     return grpc.unary_unary_rpc_method_handler(
         fn,
@@ -267,6 +276,39 @@ class OfficialCollectionsServicer:
         return q.CollectionExistsResponse(
             result=q.CollectionExists(exists=exists), time=time.time() - t0)
 
+    def UpdateAliases(self, request, context):
+        t0 = time.time()
+        actions = []
+        for op in request.actions:
+            which = op.WhichOneof("action")
+            if which == "create_alias":
+                actions.append({"create": {
+                    "alias": op.create_alias.alias_name,
+                    "collection": op.create_alias.collection_name}})
+            elif which == "rename_alias":
+                actions.append({"rename": {
+                    "old": op.rename_alias.old_alias_name,
+                    "new": op.rename_alias.new_alias_name}})
+            elif which == "delete_alias":
+                actions.append({"delete": {
+                    "alias": op.delete_alias.alias_name}})
+        ok = _guard(context, lambda: self.compat.update_aliases(actions))
+        return q.CollectionOperationResponse(result=ok, time=time.time() - t0)
+
+    def ListCollectionAliases(self, request, context):
+        t0 = time.time()
+        return q.ListAliasesResponse(
+            aliases=[q.AliasDescription(**d) for d in
+                     self.compat.list_aliases(request.collection_name)],
+            time=time.time() - t0)
+
+    def ListAliases(self, request, context):
+        t0 = time.time()
+        return q.ListAliasesResponse(
+            aliases=[q.AliasDescription(**d)
+                     for d in self.compat.list_aliases()],
+            time=time.time() - t0)
+
     def handlers(self):
         return grpc.method_handlers_generic_handler(
             "qdrant.Collections",
@@ -277,6 +319,90 @@ class OfficialCollectionsServicer:
                 "Delete": _unary(self.Delete, q.DeleteCollection),
                 "CollectionExists": _unary(
                     self.CollectionExists, q.CollectionExistsRequest),
+                "UpdateAliases": _unary(
+                    self.UpdateAliases, q.ChangeAliases),
+                "ListCollectionAliases": _unary(
+                    self.ListCollectionAliases,
+                    q.ListCollectionAliasesRequest),
+                "ListAliases": _unary(
+                    self.ListAliases, q.ListAliasesRequest),
+            },
+        )
+
+
+class OfficialSnapshotsServicer:
+    """qdrant.Snapshots (reference: snapshots_service.go — Create/List/
+    Delete per collection + CreateFull/ListFull/DeleteFull). Snapshot
+    files are JSON in ``snapshot_dir`` (the TPU build's own format; the
+    reference likewise writes NornicDB-native snapshots, not qdrant's
+    tar format)."""
+
+    def __init__(self, compat, snapshot_dir: str):
+        self.compat = compat
+        self.snapshot_dir = snapshot_dir
+
+    @staticmethod
+    def _desc(d):
+        return q.SnapshotDescription(
+            name=d["name"], creation_time=d["creation_time"],
+            size=d["size"])
+
+    def Create(self, request, context):
+        t0 = time.time()
+        d = _guard(context, lambda: self.compat.create_snapshot(
+            request.collection_name, self.snapshot_dir))
+        return q.CreateSnapshotResponse(
+            snapshot_description=self._desc(d), time=time.time() - t0)
+
+    def List(self, request, context):
+        t0 = time.time()
+        return q.ListSnapshotsResponse(
+            snapshot_descriptions=[
+                self._desc(d) for d in _guard(
+                    context, lambda: self.compat.list_snapshots(
+                        request.collection_name, self.snapshot_dir))],
+            time=time.time() - t0)
+
+    def Delete(self, request, context):
+        t0 = time.time()
+        _guard(context, lambda: self.compat.delete_snapshot(
+            request.collection_name, request.snapshot_name,
+            self.snapshot_dir))
+        return q.DeleteSnapshotResponse(time=time.time() - t0)
+
+    def CreateFull(self, request, context):
+        t0 = time.time()
+        d = self.compat.create_full_snapshot(self.snapshot_dir)
+        return q.CreateSnapshotResponse(
+            snapshot_description=self._desc(d), time=time.time() - t0)
+
+    def ListFull(self, request, context):
+        t0 = time.time()
+        return q.ListSnapshotsResponse(
+            snapshot_descriptions=[
+                self._desc(d) for d in
+                self.compat.list_full_snapshots(self.snapshot_dir)],
+            time=time.time() - t0)
+
+    def DeleteFull(self, request, context):
+        t0 = time.time()
+        _guard(context, lambda: self.compat.delete_full_snapshot(
+            request.snapshot_name, self.snapshot_dir))
+        return q.DeleteSnapshotResponse(time=time.time() - t0)
+
+    def handlers(self):
+        return grpc.method_handlers_generic_handler(
+            "qdrant.Snapshots",
+            {
+                "Create": _unary(self.Create, q.CreateSnapshotRequest),
+                "List": _unary(self.List, q.ListSnapshotsRequest),
+                "Delete": _unary(self.Delete, q.DeleteSnapshotRequest),
+                "CreateFull": _unary(
+                    self.CreateFull, q.CreateFullSnapshotRequest),
+                "ListFull": _unary(
+                    self.ListFull, q.ListFullSnapshotsRequest),
+                "DeleteFull": _unary(
+                    self.DeleteFull, q.DeleteFullSnapshotRequest),
             },
         )
 

@@ -53,10 +53,11 @@ class EncoderConfig:
     @staticmethod
     def mini() -> "EncoderConfig":
         """The committed-checkpoint shape (models/pretrain.py): big
-        enough to learn lexical co-occurrence structure, small enough
-        that the fp16 checkpoint stays ~1-2 MB in git."""
-        return EncoderConfig(vocab_size=4096, hidden_size=128,
-                             num_layers=2, num_heads=4, mlp_dim=512,
+        enough to learn topic-level co-occurrence structure (8k hash
+        vocab keeps collisions from blurring topical terms), small
+        enough that the fp16 checkpoint stays a few MB in git."""
+        return EncoderConfig(vocab_size=8192, hidden_size=160,
+                             num_layers=2, num_heads=4, mlp_dim=640,
                              max_len=512, dtype=jnp.float32)
 
     @staticmethod

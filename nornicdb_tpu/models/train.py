@@ -62,11 +62,17 @@ def create_train_state(
 def info_nce_loss(
     anchors: jnp.ndarray, positives: jnp.ndarray, temperature: float = 0.05
 ) -> jnp.ndarray:
-    """In-batch negatives: row i's positive is column i."""
+    """Symmetric in-batch negatives: row i's positive is column i, and
+    the loss runs both directions (anchor->positive and
+    positive->anchor) — the asymmetric query/document window pairs mean
+    each direction carries distinct gradient signal."""
     logits = anchors @ positives.T / temperature  # [B, B]
     labels = jnp.arange(logits.shape[0])
-    return jnp.mean(
-        optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return 0.5 * (
+        jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels))
+        + jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits.T, labels))
     )
 
 

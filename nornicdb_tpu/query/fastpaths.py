@@ -967,8 +967,15 @@ def _exec_strip_view(catalog, strip: Dict[str, Any],
     except (IndexError, ValueError):
         return None  # raced a write; per-query expansion instead
     b = _Bindings()
-    b.node_cols[spec["g_var"]] = g_rows.astype(np.int32, copy=False)
+    g_rows = g_rows.astype(np.int32, copy=False)
+    b.node_cols[spec["g_var"]] = g_rows
     b.n_rows = len(g_rows)
+    # anchor rows are pairwise-distinct label rows: rows ARE the groups
+    # when the keys are injective anchor props, letting _aggregate skip
+    # the whole group-coding pass (same identity the cooc route uses)
+    b.cand_map[spec["g_var"]] = (
+        g_rows, np.arange(len(g_rows), dtype=np.int64))
+    b.rows_are_groups = True
     b.row_weights = sum_g[keep]
     if strip["var"]:
         b.stripped_vars.add(strip["var"])

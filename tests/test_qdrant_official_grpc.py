@@ -314,3 +314,135 @@ def test_unsupported_filter_rejected_not_match_all(channel):
     resp = _call(channel, "/qdrant.Points/Count",
                  q.CountPoints(collection_name="off9"), q.CountResponse)
     assert resp.result.count == 1  # nothing was wiped
+
+
+class TestAliases:
+    """Collections alias RPCs (reference: server.go:658-665 —
+    UpdateAliases / ListCollectionAliases / ListAliases)."""
+
+    def test_alias_lifecycle(self, channel):
+        _call(channel, "/qdrant.Collections/Create",
+              q.CreateCollection(collection_name="alsrc"),
+              q.CollectionOperationResponse)
+        ch = q.ChangeAliases()
+        op = ch.actions.add()
+        op.create_alias.collection_name = "alsrc"
+        op.create_alias.alias_name = "al1"
+        resp = _call(channel, "/qdrant.Collections/UpdateAliases", ch,
+                     q.CollectionOperationResponse)
+        assert resp.result is True
+
+        resp = _call(channel, "/qdrant.Collections/ListAliases",
+                     q.ListAliasesRequest(), q.ListAliasesResponse)
+        pairs = {(a.alias_name, a.collection_name) for a in resp.aliases}
+        assert ("al1", "alsrc") in pairs
+
+        resp = _call(channel, "/qdrant.Collections/ListCollectionAliases",
+                     q.ListCollectionAliasesRequest(collection_name="alsrc"),
+                     q.ListAliasesResponse)
+        assert [a.alias_name for a in resp.aliases] == ["al1"]
+
+        # point ops resolve the alias like upstream qdrant
+        up = q.UpsertPoints(collection_name="al1")
+        p = up.points.add()
+        p.id.num = 1
+        p.vectors.vector.data.extend([1.0, 0.0])
+        _call(channel, "/qdrant.Points/Upsert", up,
+              q.PointsOperationResponse)
+        cnt = _call(channel, "/qdrant.Points/Count",
+                    q.CountPoints(collection_name="alsrc"),
+                    q.CountResponse)
+        assert cnt.result.count == 1
+
+        ch = q.ChangeAliases()
+        op = ch.actions.add()
+        op.rename_alias.old_alias_name = "al1"
+        op.rename_alias.new_alias_name = "al2"
+        _call(channel, "/qdrant.Collections/UpdateAliases", ch,
+              q.CollectionOperationResponse)
+        ch = q.ChangeAliases()
+        op = ch.actions.add()
+        op.delete_alias.alias_name = "al2"
+        _call(channel, "/qdrant.Collections/UpdateAliases", ch,
+              q.CollectionOperationResponse)
+        resp = _call(channel, "/qdrant.Collections/ListAliases",
+                     q.ListAliasesRequest(), q.ListAliasesResponse)
+        assert not [a for a in resp.aliases if a.alias_name == "al2"]
+
+    def test_alias_to_missing_collection_rejected(self, channel):
+        ch = q.ChangeAliases()
+        op = ch.actions.add()
+        op.create_alias.collection_name = "nope-no-such"
+        op.create_alias.alias_name = "alx"
+        with pytest.raises(grpc.RpcError):
+            _call(channel, "/qdrant.Collections/UpdateAliases", ch,
+                  q.CollectionOperationResponse)
+
+
+class TestSnapshots:
+    """qdrant.Snapshots service (reference: snapshots_service.go)."""
+
+    def test_collection_snapshot_lifecycle(self, channel, server):
+        _call(channel, "/qdrant.Collections/Create",
+              q.CreateCollection(collection_name="snapc"),
+              q.CollectionOperationResponse)
+        up = q.UpsertPoints(collection_name="snapc")
+        for i in range(5):
+            p = up.points.add()
+            p.id.num = i
+            p.vectors.vector.data.extend([float(i), 1.0])
+            p.payload["tag"].string_value = f"t{i}"
+        _call(channel, "/qdrant.Points/Upsert", up,
+              q.PointsOperationResponse)
+
+        resp = _call(channel, "/qdrant.Snapshots/Create",
+                     q.CreateSnapshotRequest(collection_name="snapc"),
+                     q.CreateSnapshotResponse)
+        name = resp.snapshot_description.name
+        assert name.startswith("snapc-") and name.endswith(".snapshot")
+        assert resp.snapshot_description.size > 0
+
+        resp = _call(channel, "/qdrant.Snapshots/List",
+                     q.ListSnapshotsRequest(collection_name="snapc"),
+                     q.ListSnapshotsResponse)
+        assert name in [d.name for d in resp.snapshot_descriptions]
+
+        # recover path (compat layer): drop + restore from the snapshot
+        compat = server.db.qdrant_compat
+        compat.delete_points("snapc", [0, 1, 2, 3, 4])
+        assert compat.count_points("snapc") == 0
+        restored = compat.recover_snapshot("snapc", name,
+                                           server.snapshot_dir)
+        assert restored == 5
+        assert compat.count_points("snapc") == 5
+
+        _call(channel, "/qdrant.Snapshots/Delete",
+              q.DeleteSnapshotRequest(collection_name="snapc",
+                                      snapshot_name=name),
+              q.DeleteSnapshotResponse)
+        resp = _call(channel, "/qdrant.Snapshots/List",
+                     q.ListSnapshotsRequest(collection_name="snapc"),
+                     q.ListSnapshotsResponse)
+        assert name not in [d.name for d in resp.snapshot_descriptions]
+
+    def test_full_snapshot_lifecycle(self, channel):
+        resp = _call(channel, "/qdrant.Snapshots/CreateFull",
+                     q.CreateFullSnapshotRequest(),
+                     q.CreateSnapshotResponse)
+        name = resp.snapshot_description.name
+        assert name.startswith("full-")
+        resp = _call(channel, "/qdrant.Snapshots/ListFull",
+                     q.ListFullSnapshotsRequest(), q.ListSnapshotsResponse)
+        assert name in [d.name for d in resp.snapshot_descriptions]
+        _call(channel, "/qdrant.Snapshots/DeleteFull",
+              q.DeleteFullSnapshotRequest(snapshot_name=name),
+              q.DeleteSnapshotResponse)
+
+    def test_missing_snapshot_delete_is_not_found(self, channel):
+        with pytest.raises(grpc.RpcError) as ei:
+            _call(channel, "/qdrant.Snapshots/Delete",
+                  q.DeleteSnapshotRequest(collection_name="snapc",
+                                          snapshot_name="ghost.snapshot"),
+                  q.DeleteSnapshotResponse)
+        assert ei.value.code() in (grpc.StatusCode.NOT_FOUND,
+                                   grpc.StatusCode.INVALID_ARGUMENT)
