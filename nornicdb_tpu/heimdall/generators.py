@@ -61,14 +61,24 @@ class EchoGenerator:
 class JAXGenerator:
     """In-process TPU SLM (reference analog: local GGUF llama.cpp
     backend). Weights resolve in order: explicit params > checkpoint
-    path > the committed tiny checkpoint (trained in-repo,
-    heimdall/train.py) > random init as a last resort."""
+    path > an imported LLaMA-class model (NORNICDB_TPU_SLM_DIR,
+    heimdall/hf_import.py) > the committed tiny checkpoint (trained
+    in-repo, heimdall/train.py) > random init as a last resort."""
 
     def __init__(self, name: str = "heimdall-slm", cfg=None, params=None,
                  checkpoint: Optional[str] = None):
         from nornicdb_tpu.heimdall.model import DecoderModel
 
         self.name = name
+        if params is None and cfg is None and checkpoint is None:
+            from nornicdb_tpu.heimdall.hf_import import default_slm_dir
+
+            slm_dir = default_slm_dir()
+            if slm_dir is not None:
+                from nornicdb_tpu.heimdall.hf_import import HFDecoderModel
+
+                self.model = HFDecoderModel(slm_dir)
+                return
         if params is None:
             from nornicdb_tpu.heimdall.train import (
                 default_checkpoint_path,

@@ -1,0 +1,227 @@
+"""Real-weight import path for the Heimdall SLM: LLaMA-class → JAX.
+
+The reference serves real reasoning SLMs (llama.cpp GGUF weights,
+pkg/heimdall/scheduler.go:22, pkg/localllm) — Qwen/LLaMA-family
+decoders. This image has no network, so the equivalent here is the same
+pattern models/hf_import.py uses for the encoder: a LLaMA-architecture-
+faithful JAX forward (RMSNorm, rotary embeddings, SwiGLU, grouped-query
+attention, no biases) plus a state-dict importer, validated numerically
+against transformers' torch LlamaForCausalLM with RANDOM weights at a
+shape-real config (tests/test_heimdall_hf_import.py). The day real SLM
+weights are reachable: point NORNICDB_TPU_SLM_DIR at the model
+directory and Heimdall serves them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class HFDecoderConfig:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    intermediate_size: int
+    max_position: int
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+
+    @staticmethod
+    def from_hf_config(cfg: Dict[str, Any]) -> "HFDecoderConfig":
+        return HFDecoderConfig(
+            vocab_size=int(cfg["vocab_size"]),
+            hidden_size=int(cfg["hidden_size"]),
+            num_layers=int(cfg["num_hidden_layers"]),
+            num_heads=int(cfg["num_attention_heads"]),
+            num_kv_heads=int(cfg.get("num_key_value_heads",
+                                     cfg["num_attention_heads"])),
+            intermediate_size=int(cfg["intermediate_size"]),
+            max_position=int(cfg.get("max_position_embeddings", 2048)),
+            rope_theta=float(cfg.get("rope_theta", 10000.0)),
+            rms_eps=float(cfg.get("rms_norm_eps", 1e-6)),
+            tie_word_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+        )
+
+
+def import_hf_decoder_params(
+    tensors: Dict[str, np.ndarray], cfg: HFDecoderConfig
+) -> Dict[str, Any]:
+    """Map a HF LLaMA-family state dict onto the JAX param tree.
+    Raises KeyError naming the missing tensor."""
+    pre = ""
+    if any(k.startswith("model.") for k in tensors):
+        pre = "model."
+
+    def t(name: str, transpose: bool = False) -> jnp.ndarray:
+        full = pre + name
+        if full not in tensors:
+            raise KeyError(f"checkpoint missing tensor {full!r}")
+        arr = np.asarray(tensors[full], np.float32)
+        return jnp.asarray(arr.T if transpose else arr)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}."
+        layers.append({
+            "ln1": t(p + "input_layernorm.weight"),
+            "ln2": t(p + "post_attention_layernorm.weight"),
+            # torch Linear [out, in] -> right-multiply [in, out]
+            "wq": t(p + "self_attn.q_proj.weight", transpose=True),
+            "wk": t(p + "self_attn.k_proj.weight", transpose=True),
+            "wv": t(p + "self_attn.v_proj.weight", transpose=True),
+            "wo": t(p + "self_attn.o_proj.weight", transpose=True),
+            "w_gate": t(p + "mlp.gate_proj.weight", transpose=True),
+            "w_up": t(p + "mlp.up_proj.weight", transpose=True),
+            "w_down": t(p + "mlp.down_proj.weight", transpose=True),
+        })
+    embed = t("embed_tokens.weight")
+    if cfg.tie_word_embeddings or "lm_head.weight" not in tensors:
+        lm_head = embed.T
+    else:
+        lm_head = jnp.asarray(
+            np.asarray(tensors["lm_head.weight"], np.float32).T)
+    return {
+        "embed": embed,
+        "norm": t("norm.weight"),
+        "lm_head": lm_head,
+        "layers": layers,
+    }
+
+
+def _rms(x: jnp.ndarray, g: jnp.ndarray, eps: float) -> jnp.ndarray:
+    return (x * jax.lax.rsqrt(
+        jnp.mean(x * x, axis=-1, keepdims=True) + eps)) * g
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray,
+          theta: float) -> jnp.ndarray:
+    """LLaMA rotary embedding over [T, H, Dh] (half-split convention:
+    rotate the first half against the second, matching HF's
+    rotate_half)."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(ang)[:, None, :]
+    sin = jnp.sin(ang)[:, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def forward(cfg: HFDecoderConfig, params: Dict[str, Any],
+            token_ids: jnp.ndarray) -> jnp.ndarray:
+    """[T] int32 -> [T, vocab] logits (causal, full prefill)."""
+    t = token_ids.shape[0]
+    h, kvh = cfg.num_heads, cfg.num_kv_heads
+    dh = cfg.hidden_size // h
+    positions = jnp.arange(t)
+    causal = positions[:, None] >= positions[None, :]
+    x = params["embed"][token_ids]
+    for lp in params["layers"]:
+        y = _rms(x, lp["ln1"], cfg.rms_eps)
+        q = _rope((y @ lp["wq"]).reshape(t, h, dh), positions,
+                  cfg.rope_theta)
+        k = _rope((y @ lp["wk"]).reshape(t, kvh, dh), positions,
+                  cfg.rope_theta)
+        v = (y @ lp["wv"]).reshape(t, kvh, dh)
+        if kvh != h:  # grouped-query attention: repeat kv heads
+            rep = h // kvh
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        scores = jnp.einsum("thd,shd->hts", q, k) / np.sqrt(dh)
+        scores = jnp.where(causal[None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("hts,shd->thd", probs, v).reshape(
+            t, cfg.hidden_size)
+        x = x + attn @ lp["wo"]
+        y = _rms(x, lp["ln2"], cfg.rms_eps)
+        x = x + (jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])) \
+            @ lp["w_down"]
+    x = _rms(x, params["norm"], cfg.rms_eps)
+    return x @ params["lm_head"]
+
+
+_WEIGHT_FILES = ("model.safetensors", "pytorch_model.bin", "model.npz")
+
+
+def load_hf_decoder_dir(model_dir: str):
+    """(cfg, params) from a local HF LLaMA-family model directory."""
+    with open(os.path.join(model_dir, "config.json"), encoding="utf-8") as f:
+        cfg = HFDecoderConfig.from_hf_config(json.load(f))
+    from nornicdb_tpu.models.hf_import import read_checkpoint_tensors
+
+    for fname in _WEIGHT_FILES:
+        path = os.path.join(model_dir, fname)
+        if os.path.exists(path):
+            return cfg, import_hf_decoder_params(
+                read_checkpoint_tensors(path), cfg)
+    raise FileNotFoundError(
+        f"no weight file in {model_dir!r} (looked for {_WEIGHT_FILES})")
+
+
+class HFDecoderModel:
+    """DecoderModel-interface wrapper over imported LLaMA-class weights
+    (heimdall/generators.py JaxGenerator-compatible: generate())."""
+
+    def __init__(self, model_dir: str):
+        import threading
+
+        self.cfg, self.params = load_hf_decoder_dir(model_dir)
+        from transformers import AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(
+            model_dir, local_files_only=True)
+        self._fwd = jax.jit(
+            lambda p, ids: forward(self.cfg, p, ids))
+        self._lock = threading.Lock()
+
+    def param_bytes(self) -> int:
+        return sum(int(np.prod(v.shape)) * 4
+                   for v in jax.tree_util.tree_leaves(self.params))
+
+    def generate(self, prompt: str, max_tokens: int = 64,
+                 temperature: float = 0.0, seed: int = 0) -> str:
+        """Greedy (temperature 0) or sampled decode. Re-runs the full
+        prefill per step — fine for the SLM tool-loop scale; a KV-cache
+        scan is the TPU-serving upgrade path."""
+        ids: List[int] = self.tokenizer.encode(prompt)
+        rng = np.random.default_rng(seed)
+        eos = self.tokenizer.eos_token_id
+        out: List[int] = []
+        with self._lock:
+            for _ in range(max_tokens):
+                window = ids[-self.cfg.max_position:]
+                logits = np.asarray(self._fwd(
+                    self.params, jnp.asarray(window, jnp.int32)))[-1]
+                if temperature and temperature > 0:
+                    z = logits / temperature
+                    z = z - z.max()
+                    p = np.exp(z) / np.exp(z).sum()
+                    nxt = int(rng.choice(len(p), p=p))
+                else:
+                    nxt = int(np.argmax(logits))
+                if eos is not None and nxt == eos:
+                    break
+                ids.append(nxt)
+                out.append(nxt)
+        return self.tokenizer.decode(out)
+
+
+def default_slm_dir() -> Optional[str]:
+    """NORNICDB_TPU_SLM_DIR when it points at a loadable model dir."""
+    d = os.environ.get("NORNICDB_TPU_SLM_DIR", "")
+    if d and os.path.exists(os.path.join(d, "config.json")):
+        return d
+    return None

@@ -172,6 +172,8 @@ class PatternPath:
     nodes: List[PatternNode]
     rels: List[PatternRel]
     path_var: Optional[str] = None  # p = (a)-[]->(b)
+    # MATCH p = shortestPath((a)-[*]-(b)): 'single' | 'all' | None
+    shortest: Optional[str] = None
 
 
 # -- clauses -------------------------------------------------------------

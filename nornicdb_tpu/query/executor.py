@@ -990,6 +990,9 @@ class CypherExecutor:
     ) -> Iterator[Tuple[Dict, frozenset]]:
         """Like _match_path but also yields the edge-id set consumed by the
         match, so callers can enforce uniqueness across multiple paths."""
+        if path.shortest:
+            yield from self._match_shortest(path, row, ctx, used_edges)
+            return
         nodes, rels = path.nodes, path.rels
 
         def expand(i: int, cur: Dict, cur_node: Node,
@@ -1096,10 +1099,52 @@ class CypherExecutor:
                     yield new_edges, other
                 stack.append((other, new_edges, local_used | {e.id}))
 
+    def _match_shortest(
+        self, path: A.PatternPath, row: Dict, ctx, used_edges
+    ) -> Iterator[Tuple[Dict, frozenset]]:
+        """MATCH-position shortestPath/allShortestPaths with possibly
+        UNBOUND endpoints (the form LDBC/neo4j docs use:
+        ``MATCH p = shortestPath((a:X)-[*]-(b:Y)) ...``). Endpoint
+        patterns scan candidates like ordinary node patterns; BFS runs
+        per (src, dst) pair. Reference: shortest_path.go served through
+        its MATCH planner."""
+        if len(path.nodes) != 2 or len(path.rels) != 1:
+            raise CypherRuntimeError("shortestPath expects a 2-node pattern")
+        src_pat, dst_pat, pr = path.nodes[0], path.nodes[1], path.rels[0]
+        for a in self._node_candidates(src_pat, row, ctx):
+            if not self._node_ok(src_pat, a, row, ctx):
+                continue
+            row_a = dict(row)
+            if src_pat.var:
+                row_a[src_pat.var] = a
+            for b in self._node_candidates(dst_pat, row_a, ctx):
+                if not self._node_ok(dst_pat, b, row_a, ctx):
+                    continue
+                if a.id == b.id and not (
+                    src_pat.var and src_pat.var == dst_pat.var
+                ):
+                    # neo4j: same-node endpoints only match when both
+                    # patterns name the same variable
+                    continue
+                res = self._bfs_shortest(
+                    a, b, pr, ctx, all_paths=path.shortest == "all")
+                paths = (res if isinstance(res, list)
+                         else [res] if res is not None else [])
+                for pv in paths:
+                    out = dict(row_a)
+                    if dst_pat.var:
+                        out[dst_pat.var] = b
+                    if pr.var:
+                        out[pr.var] = list(pv.rels)
+                    if path.path_var:
+                        out[path.path_var] = pv
+                    yield out, frozenset(used_edges)
+
     # -- shortest path ----------------------------------------------------
 
     def _shortest_path(self, path: A.PatternPath, row, ctx, all_paths=False):
-        """BFS shortest path(s) (reference: shortest_path.go)."""
+        """BFS shortest path(s) (reference: shortest_path.go) —
+        expression position: both endpoints must already be bound."""
         if len(path.nodes) != 2 or len(path.rels) != 1:
             raise CypherRuntimeError("shortestPath expects a 2-node pattern")
         src_pat, dst_pat, pr = path.nodes[0], path.nodes[1], path.rels[0]
@@ -1107,6 +1152,10 @@ class CypherExecutor:
         dst = row.get(dst_pat.var) if dst_pat.var else None
         if not isinstance(src, Node) or not isinstance(dst, Node):
             raise CypherRuntimeError("shortestPath endpoints must be bound nodes")
+        return self._bfs_shortest(src, dst, pr, ctx, all_paths)
+
+    def _bfs_shortest(self, src: Node, dst: Node, pr: A.PatternRel,
+                      ctx, all_paths: bool = False):
         if src.id == dst.id:
             return PathValue([src], [])
         max_hops = pr.max_hops if pr.max_hops >= 0 else 25

@@ -340,12 +340,32 @@ def _parse_path(ts: TokenStream) -> PatternPath:
         and ts.peek().upper() not in _CLAUSE_STARTERS
         and ts.peek(1).kind == OP
         and ts.peek(1).value == "="
-        and ts.peek(2).kind == PUNCT
-        and ts.peek(2).value == "("
+        and (
+            (ts.peek(2).kind == PUNCT and ts.peek(2).value == "(")
+            or (ts.peek(2).kind == IDENT
+                and ts.peek(2).upper() in ("SHORTESTPATH",
+                                           "ALLSHORTESTPATHS")
+                and ts.peek(3).kind == PUNCT and ts.peek(3).value == "(")
+        )
     ):
         path_var = ts.next().value
         ts.next()  # =
-    # shortestPath(...) handled as function by expression context; here direct
+    # MATCH-position shortestPath((a)-[*]-(b)) — endpoints may be
+    # UNBOUND here (the executor scans candidates and runs BFS per
+    # pair); the expression-position form still parses as a FuncCall
+    if (
+        ts.peek().kind == IDENT
+        and ts.peek().upper() in ("SHORTESTPATH", "ALLSHORTESTPATHS")
+        and ts.peek(1).kind == PUNCT and ts.peek(1).value == "("
+    ):
+        kind = "single" if ts.peek().upper() == "SHORTESTPATH" else "all"
+        ts.next()
+        ts.expect("(")
+        inner = _parse_path(ts)
+        ts.expect(")")
+        inner.path_var = path_var
+        inner.shortest = kind
+        return inner
     nodes = [_parse_pattern_node(ts)]
     rels: List[PatternRel] = []
     while True:

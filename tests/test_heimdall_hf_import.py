@@ -1,0 +1,119 @@
+"""Heimdall SLM real-weight import path + generation quality gate
+(VERDICT r3 task 10).
+
+The reference serves llama.cpp GGUF SLMs (pkg/heimdall/scheduler.go:22);
+here the import path is proven numerically: transformers' torch
+LlamaForCausalLM with RANDOM weights at a shape-real config must produce
+the same logits as the JAX forward over the imported state dict. The
+committed tiny checkpoint gets a generation-quality gate so the
+subsystem can't silently regress to babble.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from nornicdb_tpu.heimdall.hf_import import (  # noqa: E402
+    HFDecoderConfig,
+    forward,
+    import_hf_decoder_params,
+)
+
+SMALL = dict(
+    vocab_size=160,
+    hidden_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,  # grouped-query attention like real SLMs
+    intermediate_size=128,
+    max_position_embeddings=128,
+    attention_dropout=0.0,
+    tie_word_embeddings=False,
+)
+
+
+class TestLlamaImport:
+    def _models(self, seed=0):
+        hf_cfg = transformers.LlamaConfig(**SMALL)
+        torch.manual_seed(seed)
+        model = transformers.LlamaForCausalLM(hf_cfg).eval()
+        tensors = {k: v.detach().numpy()
+                   for k, v in model.state_dict().items()}
+        cfg = HFDecoderConfig.from_hf_config(hf_cfg.to_dict())
+        params = import_hf_decoder_params(tensors, cfg)
+        return model, cfg, params
+
+    def test_logits_match_torch_llama(self):
+        model, cfg, params = self._models()
+        ids = np.array([3, 17, 99, 4, 55, 120, 7], np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(ids[None].astype(np.int64))
+                         ).logits[0].numpy()
+        got = np.asarray(forward(cfg, params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, atol=3e-4, rtol=1e-3)
+
+    def test_gqa_heads_repeat_correctly(self):
+        # different kv-head count from attention heads is the config
+        # real Qwen/LLaMA SLMs ship with; covered by the same numeric
+        # parity (a wrong repeat order diverges immediately)
+        model, cfg, params = self._models(seed=1)
+        assert cfg.num_kv_heads != cfg.num_heads
+        ids = np.arange(20, dtype=np.int32) % SMALL["vocab_size"]
+        with torch.no_grad():
+            want = model(torch.tensor(ids[None].astype(np.int64))
+                         ).logits[0].numpy()
+        got = np.asarray(forward(cfg, params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, atol=3e-4, rtol=1e-3)
+
+    def test_missing_tensor_is_loud(self):
+        model, cfg, _ = self._models()
+        tensors = {k: v.detach().numpy()
+                   for k, v in model.state_dict().items()}
+        del tensors["model.layers.1.mlp.down_proj.weight"]
+        with pytest.raises(KeyError, match="down_proj"):
+            import_hf_decoder_params(tensors, cfg)
+
+
+class TestGenerationQualityGate:
+    """The committed tiny checkpoint must carry learned signal: far
+    lower next-byte loss than random init on its training corpus, and
+    greedy continuation of a corpus prompt reproduces the learned
+    text (byte-level memorization at tiny scale IS the capability the
+    checkpoint claims)."""
+
+    def test_trained_beats_random_next_byte_loss(self):
+        from nornicdb_tpu.heimdall.model import init_params
+        from nornicdb_tpu.heimdall.train import (
+            DEFAULT_CORPUS,
+            _loss_fn,
+            default_checkpoint_path,
+            encode_corpus,
+            load_params,
+        )
+
+        path = default_checkpoint_path()
+        assert path, "committed heimdall checkpoint missing"
+        cfg, params = load_params(path)
+        data = jnp.asarray(encode_corpus(DEFAULT_CORPUS, cfg))
+        trained = float(_loss_fn(cfg, params, data))
+        random_loss = float(_loss_fn(cfg, init_params(cfg, seed=5), data))
+        assert trained < random_loss * 0.5, (trained, random_loss)
+        assert trained < 2.0, trained  # absolute quality floor
+
+    def test_greedy_continuation_reproduces_corpus(self):
+        from nornicdb_tpu.heimdall.model import DecoderModel
+        from nornicdb_tpu.heimdall.train import (
+            default_checkpoint_path,
+            load_params,
+        )
+
+        cfg, params = load_params(default_checkpoint_path())
+        m = DecoderModel(cfg=cfg, params=params)
+        out = m.generate("vector search runs on the", max_tokens=24)
+        assert "tpu" in out.lower(), out

@@ -45,6 +45,8 @@ VALID = [
     "MATCH (a)<-[r]-(b) RETURN r",
     "MATCH p = (a)-[:X]->(b) RETURN p",
     "MATCH (a), (b) RETURN shortestPath((a)-[*]-(b))",
+    "MATCH p = shortestPath((a:X)-[:K*]->(b:Y)) RETURN length(p)",
+    "MATCH p = allShortestPaths((a)-[*..4]-(b)) RETURN p",
     "MATCH (n) WHERE n.age > 21 AND n.name STARTS WITH 'A' RETURN n",
     "MATCH (n) WHERE n.name =~ '.*x.*' OR NOT n.flag RETURN n",
     "MATCH (n) WHERE n.age IS NOT NULL RETURN n",
