@@ -872,6 +872,47 @@ class HttpServer:
                                   actor=username or "", target=segments[2])
                 return 200, {"dropped": segments[2]}
 
+        if action == "users":
+            # reference: AdminUsers.tsx over the users admin API
+            if self.authenticator is None:
+                raise HTTPError(400, "Neo.ClientError.Request.Invalid",
+                                "auth not enabled")
+            a = self.authenticator
+            if method == "GET":
+                return 200, {"users": [
+                    {"username": u,
+                     "roles": list(a._users[u].roles),
+                     "suspended": a._users[u].suspended}
+                    for u in a.list_users()]}
+            if method == "POST":
+                name = payload.get("username", "")
+                pw = payload.get("password", "")
+                if not name or not pw:
+                    raise HTTPError(400, "Neo.ClientError.Request.Invalid",
+                                    "username and password required")
+                a.create_user(name, pw, roles=payload.get("roles"))
+                self.audit.record(ADMIN_ACTION, "create_user",
+                                  actor=username or "", target=name)
+                return 201, {"username": name}
+            if method == "DELETE" and len(segments) > 2:
+                a.delete_user(segments[2])
+                self.audit.record(ADMIN_ACTION, "delete_user",
+                                  actor=username or "", target=segments[2])
+                return 200, {"deleted": segments[2]}
+            if method == "PUT" and len(segments) > 2:
+                target = segments[2]
+                if "suspended" in payload:
+                    a.suspend_user(target, bool(payload["suspended"]))
+                if "password" in payload:
+                    a.set_password(target, payload["password"])
+                for role in payload.get("grant_roles", []):
+                    a.grant_role(target, role)
+                for role in payload.get("revoke_roles", []):
+                    a.revoke_role(target, role)
+                self.audit.record(ADMIN_ACTION, "update_user",
+                                  actor=username or "", target=target)
+                return 200, {"username": target}
+
         if action == "backup" and method == "POST":
             target = payload.get("path", "")
             if not target:

@@ -1,0 +1,58 @@
+"""HTTP admin API tests (users admin routes; reference AdminUsers.tsx
+over the users admin API; auth.py Authenticator)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu.api.http_server import HttpServer
+from nornicdb_tpu.auth import Authenticator, bootstrap_admin
+
+
+class TestAdminUsers:
+    @pytest.fixture()
+    def auth_server(self):
+        db = nornicdb_tpu.open(auto_embed=False)
+        auth = Authenticator()
+        bootstrap_admin(auth, "admin", "secret")
+        srv = HttpServer(db, port=0, authenticator=auth).start()
+        yield srv
+        srv.stop()
+        db.close()
+
+    def _req(self, srv, path, method="GET", body=None, token=None):
+        data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["Authorization"] = "Bearer " + token
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}", data=data,
+            method=method, headers=headers)
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def test_user_lifecycle(self, auth_server):
+        tok = self._req(auth_server, "/auth/login", "POST",
+                        {"username": "admin", "password": "secret"})["token"]
+        users = self._req(auth_server, "/admin/users", token=tok)["users"]
+        assert any(u["username"] == "admin" for u in users)
+        self._req(auth_server, "/admin/users", "POST",
+                  {"username": "bob", "password": "pw",
+                   "roles": ["reader"]}, token=tok)
+        self._req(auth_server, "/admin/users/bob", "PUT",
+                  {"suspended": True, "grant_roles": ["editor"]}, token=tok)
+        users = {u["username"]: u for u in self._req(
+            auth_server, "/admin/users", token=tok)["users"]}
+        assert users["bob"]["suspended"] is True
+        assert "editor" in users["bob"]["roles"]
+        self._req(auth_server, "/admin/users/bob", "DELETE", token=tok)
+        users = self._req(auth_server, "/admin/users", token=tok)["users"]
+        assert not any(u["username"] == "bob" for u in users)
+
+    def test_users_requires_admin(self, auth_server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._req(auth_server, "/admin/users")
+        assert ei.value.code in (401, 403)
