@@ -495,10 +495,9 @@ class HNSWIndex:
             qd = np.pad(qd, ((0, 0), (0, pad)), constant_values=np.inf)
             qs = np.pad(qs, ((0, 0), (0, pad)), constant_values=-1)
         exp = qs < 0  # padding counts as already-expanded
-        # stamp entries visited
-        for r in range(A):
-            ent = qs[r][qs[r] >= 0]
-            visited[sub[r], ent] = gen[sub[r]]
+        # stamp entries visited (vectorized over the whole subset)
+        er, ec = np.nonzero(qs >= 0)
+        visited[sub[er], qs[er, ec]] = gen[sub[er]]
         nbr = self._nbrL[lv]
         Qs = Q[sub]
         # expand the E best unexpanded beam entries per step: total
