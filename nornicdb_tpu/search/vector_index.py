@@ -148,6 +148,32 @@ class BruteForceIndex:
     ) -> List[Tuple[str, float]]:
         return self.search_batch(np.asarray([query], dtype=np.float32), k)[0]
 
+    @staticmethod
+    def _search_host(queries, m, valid, ext_ids, k_eff):
+        qn = queries / np.maximum(
+            np.linalg.norm(queries, axis=1, keepdims=True), 1e-12)
+        scores = qn @ m.T
+        scores[:, ~valid] = -np.inf
+        out: List[List[Tuple[str, float]]] = []
+        for row in range(scores.shape[0]):
+            top = np.argpartition(-scores[row], k_eff - 1)[:k_eff]
+            top = top[np.argsort(-scores[row][top])]
+            hits = []
+            for idx in top:
+                if not np.isfinite(scores[row, idx]):
+                    break
+                eid = ext_ids[int(idx)]
+                if eid is not None:
+                    hits.append((eid, float(scores[row, idx])))
+            out.append(hits)
+        return out
+
+    # below this many matrix cells, host numpy beats a device dispatch
+    # (jit-call overhead alone is ~100us; through a TPU tunnel the
+    # transfer round-trip is ms) — small qdrant collections and early
+    # index life live here
+    _SMALL_HOST = 1 << 18
+
     def search_batch(
         self, queries: np.ndarray, k: int = 10
     ) -> List[List[Tuple[str, float]]]:
@@ -156,6 +182,13 @@ class BruteForceIndex:
             if self._n_alive == 0:
                 return [[] for _ in range(len(queries))]
             k_eff = min(k, self._n_alive)
+            if self._capacity * (self.dims or 1) <= self._SMALL_HOST:
+                mh = self._matrix.copy()
+                vh = self._valid.copy()
+                ext_ids = list(self._ext_ids)
+                return self._search_host(
+                    np.asarray(queries, np.float32), mh, vh, ext_ids,
+                    k_eff)
             m, valid = self._device_arrays()
             ext_ids = list(self._ext_ids)
         q = l2_normalize(jnp.asarray(queries, dtype=jnp.float32))
