@@ -13,6 +13,7 @@ from __future__ import annotations
 import base64
 import functools
 import json
+import os
 import re
 import threading
 import time
@@ -664,6 +665,14 @@ class HttpServer:
                 self._heimdall = mgr
             return self._heimdall
 
+    def _qdrant_snapshot_dir(self) -> str:
+        import tempfile
+
+        data_dir = getattr(self.db, "_data_dir", None)
+        return (os.path.join(data_dir, "qdrant-snapshots") if data_dir
+                else os.path.join(tempfile.gettempdir(),
+                                  "nornicdb-qdrant-snapshots"))
+
     def _qdrant_routes(self, method: str, segments: List[str],
                        payload: Dict[str, Any],
                        query: Dict[str, str]) -> Tuple[int, Any]:
@@ -683,6 +692,8 @@ class HttpServer:
                 return ok({"collections": [
                     {"name": n} for n in q.list_collections()
                 ]})
+            if segments[1:] == ["aliases"] and method == "GET":
+                return ok({"aliases": q.list_aliases()})
             name = segments[1] if len(segments) > 1 else ""
             if len(segments) == 2:
                 if method == "PUT":
@@ -692,6 +703,41 @@ class HttpServer:
                     return ok(q.delete_collection(name))
                 if method == "GET":
                     return ok(q.get_collection(name))
+            if segments[1:] == ["aliases"] and method == "POST":
+                # upstream POST /collections/aliases ChangeAliases body
+                actions = []
+                for act in payload.get("actions", []):
+                    if "create_alias" in act:
+                        a = act["create_alias"]
+                        actions.append({"create": {
+                            "alias": a.get("alias_name", ""),
+                            "collection": a.get("collection_name", "")}})
+                    elif "rename_alias" in act:
+                        a = act["rename_alias"]
+                        actions.append({"rename": {
+                            "old": a.get("old_alias_name", ""),
+                            "new": a.get("new_alias_name", "")}})
+                    elif "delete_alias" in act:
+                        actions.append({"delete": {
+                            "alias": act["delete_alias"].get(
+                                "alias_name", "")}})
+                return ok(q.update_aliases(actions))
+            if len(segments) == 3 and segments[2] == "aliases" \
+                    and method == "GET":
+                return ok({"aliases": q.list_aliases(name)})
+            if len(segments) >= 3 and segments[2] == "snapshots":
+                snap_dir = self._qdrant_snapshot_dir()
+                if method == "POST" and len(segments) == 3:
+                    return ok(q.create_snapshot(name, snap_dir))
+                if method == "GET" and len(segments) == 3:
+                    return ok(q.list_snapshots(name, snap_dir))
+                if method == "DELETE" and len(segments) == 4:
+                    return ok(q.delete_snapshot(name, segments[3],
+                                                snap_dir))
+                if method == "PUT" and len(segments) == 5 \
+                        and segments[4] == "recover":
+                    return ok({"restored": q.recover_snapshot(
+                        name, segments[3], snap_dir)})
             if len(segments) >= 3 and segments[2] == "points":
                 action = segments[3] if len(segments) > 3 else ""
                 if method == "PUT" and not action:

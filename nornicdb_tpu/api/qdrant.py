@@ -419,6 +419,7 @@ class QdrantCompat:
         with_payload: bool = True,
         with_vector: bool = False,
     ) -> List[Dict[str, Any]]:
+        name = self.resolve(name)
         self._meta(name)
         out = []
         for pid in ids:
@@ -430,6 +431,7 @@ class QdrantCompat:
         return out
 
     def delete_points(self, name: str, ids: Sequence[Any]) -> int:
+        name = self.resolve(name)
         self._meta(name)
         idx = self._index(name)
         n = 0
@@ -444,6 +446,7 @@ class QdrantCompat:
         return n
 
     def count_points(self, name: str) -> int:
+        name = self.resolve(name)
         self._meta(name)
         counter = getattr(self.storage, "count_nodes_by_label", None)
         if counter is not None:
@@ -458,6 +461,7 @@ class QdrantCompat:
         with_payload: bool = True,
         with_vector: bool = False,
     ) -> Dict[str, Any]:
+        name = self.resolve(name)
         self._meta(name)
         nodes = sorted(
             self.storage.get_nodes_by_label(self._label(name)),
@@ -501,6 +505,7 @@ class QdrantCompat:
         score_threshold compared on the true distance)."""
         if not vector:
             raise QdrantError("search vector is required")
+        name = self.resolve(name)
         meta = self._meta(name)
         distance = meta.properties.get("config", {}).get("distance", "Cosine")
         if distance == "Cosine":

@@ -56,3 +56,57 @@ class TestAdminUsers:
         with pytest.raises(urllib.error.HTTPError) as ei:
             self._req(auth_server, "/admin/users")
         assert ei.value.code in (401, 403)
+
+
+class TestQdrantRestAliasesSnapshots:
+    """Qdrant REST alias + snapshot routes (upstream REST surface
+    mirrored onto the shared compat layer)."""
+
+    @pytest.fixture()
+    def server(self):
+        db = nornicdb_tpu.open(auto_embed=False)
+        srv = HttpServer(db, port=0).start()
+        yield srv
+        srv.stop()
+        db.close()
+
+    def _req(self, srv, path, method="GET", body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}", data=data,
+            method=method, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def test_alias_and_snapshot_lifecycle(self, server):
+        self._req(server, "/collections/rsrc", "PUT",
+                  {"vectors": {"size": 2, "distance": "Cosine"}})
+        self._req(server, "/collections/rsrc/points", "PUT",
+                  {"points": [{"id": 1, "vector": [1.0, 0.0],
+                               "payload": {"k": "v"}}]})
+        # aliases: upstream POST /collections/aliases ChangeAliases
+        self._req(server, "/collections/aliases", "POST",
+                  {"actions": [{"create_alias": {
+                      "collection_name": "rsrc", "alias_name": "ra"}}]})
+        doc = self._req(server, "/collections/aliases")
+        assert {"alias_name": "ra", "collection_name": "rsrc"} \
+            in doc["result"]["aliases"]
+        doc = self._req(server, "/collections/rsrc/aliases")
+        assert doc["result"]["aliases"][0]["alias_name"] == "ra"
+        # alias resolves on the points surface
+        doc = self._req(server, "/collections/ra/points/count", "POST", {})
+        assert doc["result"]["count"] == 1
+        # snapshots
+        doc = self._req(server, "/collections/rsrc/snapshots", "POST", {})
+        snap = doc["result"]["name"]
+        doc = self._req(server, "/collections/rsrc/snapshots")
+        assert snap in [d["name"] for d in doc["result"]]
+        self._req(server, "/collections/rsrc/points/delete", "POST",
+                  {"points": [1]})
+        doc = self._req(server,
+                        f"/collections/rsrc/snapshots/{snap}/recover",
+                        "PUT", {})
+        assert doc["result"]["restored"] == 1
+        doc = self._req(server, "/collections/ra/points/count", "POST", {})
+        assert doc["result"]["count"] == 1
+        self._req(server, f"/collections/rsrc/snapshots/{snap}", "DELETE")
