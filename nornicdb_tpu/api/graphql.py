@@ -528,7 +528,13 @@ def _q_node(parent, args, api):
 def _q_all_nodes(parent, args, api):
     limit = int(args.get("limit", 100))
     offset = int(args.get("offset", 0))
-    nodes = sorted(api.db.storage.all_nodes(), key=lambda n: n.id)
+    if args.get("label"):
+        # label index, not a full scan — nodes(label:) is the UI's and
+        # the e2e bench's hot shape
+        pool = api.db.storage.get_nodes_by_label(args["label"])
+    else:
+        pool = api.db.storage.all_nodes()
+    nodes = sorted(pool, key=lambda n: n.id)
     return [_node_obj(n) for n in nodes[offset:offset + limit]]
 
 
