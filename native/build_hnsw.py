@@ -1,0 +1,35 @@
+"""Build the native HNSW connect-phase library with g++.
+
+Invoked automatically (and cached) by nornicdb_tpu.search.hnsw_native on
+first use; also runnable directly: ``python native/build_hnsw.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "nornichnsw.cpp")
+OUT = os.path.join(HERE, "libnornichnsw.so")
+
+
+def build(force: bool = False) -> str:
+    if (
+        not force
+        and os.path.exists(OUT)
+        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
+    ):
+        return OUT
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", OUT + ".tmp", SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(OUT + ".tmp", OUT)
+    return OUT
+
+
+if __name__ == "__main__":
+    print(build(force="--force" in sys.argv))

@@ -1,0 +1,154 @@
+// Native HNSW connect phase (diversity-select + link + back-link prune).
+//
+// The wave build (nornicdb_tpu/search/hnsw.py) vectorizes beam SEARCH
+// across a whole wave with numpy einsums, which leaves the LINK phase —
+// tens of thousands of small, sequential, data-dependent selections —
+// as the remaining Python hot loop (~40% of build wall-clock, and the
+// majority once the seeded bulk beam halves search work). This kernel
+// executes the connect phase for one (level, wave) batch. Semantics
+// mirror the Python reference implementation exactly:
+//
+// - _select_neighbors: keep a candidate (distance order) only if it is
+//   closer to the query than to every already-kept neighbor; backfill
+//   with the closest rejects if fewer than m survive; candidate list
+//   capped at 4m.
+// - _add_link: append a back-link while the row has slack; on overflow
+//   re-select over (existing row + new link) by distance to the row
+//   owner and rewrite the row.
+//
+// Equivalence with the Python path is pinned by
+// tests/test_ann_stack.py::TestNativeConnect.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC (native/build_hnsw.py, cached,
+// invoked on demand by nornicdb_tpu/search/hnsw_native.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+inline float dot(const float* a, const float* b, int64_t d) {
+    float s0 = 0.f, s1 = 0.f, s2 = 0.f, s3 = 0.f;
+    int64_t i = 0;
+    for (; i + 4 <= d; i += 4) {
+        s0 += a[i] * b[i];
+        s1 += a[i + 1] * b[i + 1];
+        s2 += a[i + 2] * b[i + 2];
+        s3 += a[i + 3] * b[i + 3];
+    }
+    float s = s0 + s1 + s2 + s3;
+    for (; i < d; ++i) s += a[i] * b[i];
+    return s;
+}
+
+// greedy diversity selection over candidates sorted by distance;
+// returns number kept, writing kept slots into `out` (size >= m)
+int64_t select_neighbors(const float* vectors, int64_t dims,
+                         const int64_t* cslots, const float* cdists,
+                         int64_t n_cand, int64_t m, int64_t* out) {
+    n_cand = std::min(n_cand, 4 * m);
+    if (n_cand <= m) {
+        for (int64_t i = 0; i < n_cand; ++i) out[i] = cslots[i];
+        return n_cand;
+    }
+    std::vector<char> taken(n_cand, 0);
+    int64_t kept = 0;
+    for (int64_t i = 0; i < n_cand && kept < m; ++i) {
+        const float* vi = vectors + cslots[i] * dims;
+        bool ok = true;
+        for (int64_t k = 0; k < kept; ++k) {
+            const float* vk = vectors + out[k] * dims;
+            // closer to an already-kept neighbor than to the query
+            if (cdists[i] >= 1.0f - dot(vi, vk, dims)) { ok = false; break; }
+        }
+        if (ok) {
+            out[kept++] = cslots[i];
+            taken[i] = 1;
+        }
+    }
+    // backfill with the closest rejects (Python parity)
+    for (int64_t i = 0; i < n_cand && kept < m; ++i) {
+        if (!taken[i]) {
+            out[kept++] = cslots[i];
+            taken[i] = 1;
+        }
+    }
+    return kept;
+}
+
+void set_row(int32_t* nbr, int32_t* cnt, int64_t width, int64_t row,
+             const int64_t* slots, int64_t n) {
+    n = std::min(n, width);
+    int32_t* r = nbr + row * width;
+    for (int64_t i = 0; i < n; ++i) r[i] = static_cast<int32_t>(slots[i]);
+    for (int64_t i = n; i < width; ++i) r[i] = -1;
+    cnt[row] = static_cast<int32_t>(n);
+}
+
+void add_link(const float* vectors, int64_t dims, int32_t* nbr,
+              int32_t* cnt, int64_t width, int64_t level_cap,
+              int64_t c, int64_t slot) {
+    int32_t n = cnt[c];
+    if (n < width) {
+        nbr[c * width + n] = static_cast<int32_t>(slot);
+        cnt[c] = n + 1;
+        return;
+    }
+    // overflow: re-select over (existing row + new) by distance to c
+    std::vector<std::pair<float, int64_t>> merged;
+    merged.reserve(width + 1);
+    const float* vc = vectors + c * dims;
+    const int32_t* row = nbr + c * width;
+    for (int64_t i = 0; i < width; ++i) {
+        int64_t s = row[i];
+        merged.emplace_back(1.0f - dot(vectors + s * dims, vc, dims), s);
+    }
+    merged.emplace_back(1.0f - dot(vectors + slot * dims, vc, dims), slot);
+    std::sort(merged.begin(), merged.end());
+    std::vector<int64_t> cs(merged.size());
+    std::vector<float> cd(merged.size());
+    for (size_t i = 0; i < merged.size(); ++i) {
+        cd[i] = merged[i].first;
+        cs[i] = merged[i].second;
+    }
+    std::vector<int64_t> out(level_cap);
+    int64_t kept = select_neighbors(vectors, dims, cs.data(), cd.data(),
+                                    static_cast<int64_t>(cs.size()),
+                                    level_cap, out.data());
+    set_row(nbr, cnt, width, c, out.data(), kept);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connect a wave's nodes at ONE level. Candidates arrive flattened:
+// node i's sorted-by-distance candidates are
+// cand_slots[cand_off[i] : cand_off[i+1]] (+ parallel cand_dists).
+// m_forward: forward-link selection size (the index's m at every
+// level); level_cap: back-link prune cap (m0 at level 0, m above) —
+// mirrors _link_from_cands(select m) + _add_link(prune to level cap).
+void hnsw_connect(const float* vectors, int64_t dims, int32_t* nbr,
+                  int32_t* cnt, int64_t width, int64_t m_forward,
+                  int64_t level_cap,
+                  const int64_t* wave_slots, const int64_t* cand_off,
+                  const int64_t* cand_slots, const float* cand_dists,
+                  int64_t n_wave) {
+    std::vector<int64_t> out(std::max(m_forward, level_cap));
+    for (int64_t i = 0; i < n_wave; ++i) {
+        int64_t lo = cand_off[i], hi = cand_off[i + 1];
+        int64_t kept = select_neighbors(
+            vectors, dims, cand_slots + lo, cand_dists + lo, hi - lo,
+            m_forward, out.data());
+        int64_t slot = wave_slots[i];
+        set_row(nbr, cnt, width, slot, out.data(), kept);
+        for (int64_t k = 0; k < kept; ++k) {
+            add_link(vectors, dims, nbr, cnt, width, level_cap, out[k],
+                     slot);
+        }
+    }
+}
+
+}  // extern "C"

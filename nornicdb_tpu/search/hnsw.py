@@ -458,10 +458,35 @@ class HNSWIndex:
                     ))
                 cands_at[lv] = per
 
-        # connect phase (host): wave nodes link against the pre-wave graph
+        # connect phase: wave nodes link against the pre-wave graph.
+        # Native kernel when available (diversity-select + back-link
+        # prune are the remaining per-node sequential hot loop,
+        # native/nornichnsw.cpp); Python fallback is semantics-identical.
+        from nornicdb_tpu.search.hnsw_native import connect_wave, get_lib
+
+        lib = get_lib()
         for lv in sorted(cands_at.keys(), reverse=True):
-            for j, cands in cands_at[lv]:
-                self._link_from_cands(slots[j], lv, cands)
+            per = cands_at[lv]
+            if lib is not None and per:
+                wave_slots = np.asarray([slots[j] for j, _ in per],
+                                        np.int64)
+                counts = [len(c) for _, c in per]
+                off = np.zeros(len(per) + 1, np.int64)
+                np.cumsum(counts, out=off[1:])
+                cs = np.empty(int(off[-1]), np.int64)
+                cd = np.empty(int(off[-1]), np.float32)
+                for i, (_, cands) in enumerate(per):
+                    lo = int(off[i])
+                    for k, (d, s) in enumerate(cands):
+                        cd[lo + k] = d
+                        cs[lo + k] = s
+                connect_wave(lib, self._vectors, self._nbrL[lv],
+                             self._cntL[lv], self.m,
+                             self._level_cap(lv),
+                             wave_slots, off, cs, cd)
+            else:
+                for j, cands in per:
+                    self._link_from_cands(slots[j], lv, cands)
         top = int(np.argmax(lvq))
         if levels[top] > self._max_level:
             self._max_level = levels[top]

@@ -1,0 +1,78 @@
+"""ctypes loader for the native HNSW connect-phase kernel.
+
+See native/nornichnsw.cpp. Loading is lazy and failure-tolerant: when
+the toolchain or .so is unavailable the wave build silently uses its
+Python connect path (same semantics, pinned by
+tests/test_ann_stack.py::TestNativeConnect)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    so = os.path.join(here, "native", "libnornichnsw.so")
+    try:
+        if not os.path.exists(so):
+            import sys
+
+            sys.path.insert(0, os.path.join(here, "native"))
+            from build_hnsw import build  # type: ignore
+
+            so = build()
+        lib = ctypes.CDLL(so)
+        lib.hnsw_connect.argtypes = [
+            ctypes.POINTER(ctypes.c_float),   # vectors
+            ctypes.c_int64,                   # dims
+            ctypes.POINTER(ctypes.c_int32),   # nbr
+            ctypes.POINTER(ctypes.c_int32),   # cnt
+            ctypes.c_int64,                   # width
+            ctypes.c_int64,                   # m_forward
+            ctypes.c_int64,                   # level_cap
+            ctypes.POINTER(ctypes.c_int64),   # wave_slots
+            ctypes.POINTER(ctypes.c_int64),   # cand_off
+            ctypes.POINTER(ctypes.c_int64),   # cand_slots
+            ctypes.POINTER(ctypes.c_float),   # cand_dists
+            ctypes.c_int64,                   # n_wave
+        ]
+        lib.hnsw_connect.restype = None
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def connect_wave(lib, vectors: np.ndarray, nbr: np.ndarray,
+                 cnt: np.ndarray, m_forward: int, level_cap: int,
+                 wave_slots: np.ndarray, cand_off: np.ndarray,
+                 cand_slots: np.ndarray, cand_dists: np.ndarray) -> None:
+    """All arrays must be C-contiguous with the dtypes the kernel
+    expects; adjacency (nbr/cnt) is mutated in place."""
+    p = ctypes.POINTER
+    lib.hnsw_connect(
+        vectors.ctypes.data_as(p(ctypes.c_float)),
+        vectors.shape[1],
+        nbr.ctypes.data_as(p(ctypes.c_int32)),
+        cnt.ctypes.data_as(p(ctypes.c_int32)),
+        nbr.shape[1],
+        m_forward,
+        level_cap,
+        wave_slots.ctypes.data_as(p(ctypes.c_int64)),
+        cand_off.ctypes.data_as(p(ctypes.c_int64)),
+        cand_slots.ctypes.data_as(p(ctypes.c_int64)),
+        cand_dists.ctypes.data_as(p(ctypes.c_float)),
+        len(wave_slots),
+    )

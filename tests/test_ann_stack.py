@@ -484,3 +484,34 @@ class TestSeededBuild:
         seeded.build(items, seed_ids=seeds)
         r_full, r_seeded = recall(full), recall(seeded)
         assert r_seeded >= r_full - 0.03, (r_seeded, r_full)
+
+
+class TestNativeConnect:
+    """The native connect kernel (native/nornichnsw.cpp) must produce
+    EXACTLY the graph the Python link phase produces — same diversity
+    selection, same back-link pruning, same tie-breaks."""
+
+    def test_native_matches_python_graph(self, monkeypatch):
+        from nornicdb_tpu.search import hnsw_native
+        from nornicdb_tpu.search.hnsw import HNSWIndex
+
+        lib = hnsw_native.get_lib()
+        if lib is None:
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(17)
+        vecs = rng.standard_normal((3000, 64)).astype(np.float32)
+        items = [(f"v{i}", v) for i, v in enumerate(vecs)]
+
+        native = HNSWIndex(ef_construction=96)
+        native.build(items)
+
+        monkeypatch.setattr(hnsw_native, "get_lib", lambda: None)
+        python = HNSWIndex(ef_construction=96)
+        python.build(items)
+
+        assert len(native._nbrL) == len(python._nbrL)
+        for lv in range(len(native._nbrL)):
+            np.testing.assert_array_equal(
+                native._cntL[lv], python._cntL[lv], err_msg=f"cnt lv{lv}")
+            np.testing.assert_array_equal(
+                native._nbrL[lv], python._nbrL[lv], err_msg=f"nbr lv{lv}")
