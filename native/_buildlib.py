@@ -1,0 +1,60 @@
+"""Shared content-hash-cached g++ build for the native libraries.
+
+The cache is keyed on a sha256 of the source, not mtimes: a fresh clone
+has arbitrary checkout mtimes, and a committed .so that no longer
+matches its .cpp must never be silently loaded (ADVICE r4). Degradation
+order when a rebuild is impossible: existing .so with a warning (still
+faster and behaviorally pinned by the parity tests) rather than an
+exception that would silently drop callers to their slow Python paths.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+
+log = logging.getLogger("nornicdb_tpu.native")
+
+
+def src_hash(src: str) -> str:
+    with open(src, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def build_cached(src: str, out: str, flags: list[str],
+                 force: bool = False) -> str:
+    """Compile ``src`` to ``out`` unless a stamp file proves the existing
+    ``out`` was built from byte-identical source. Returns the library
+    path; raises only when no usable library can be produced at all."""
+    stamp = out + ".srchash"
+    if not os.path.exists(src):
+        # deployment without sources: the prebuilt .so is all there is
+        if os.path.exists(out):
+            return out
+        raise FileNotFoundError(src)
+    want = src_hash(src)
+    if not force and os.path.exists(out) and os.path.exists(stamp):
+        try:
+            with open(stamp, encoding="utf-8") as f:
+                if f.read().strip() == want:
+                    return out
+        except OSError:
+            pass
+    cmd = ["g++", *flags, "-shared", "-fPIC", "-o", out + ".tmp", src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except (OSError, subprocess.CalledProcessError) as exc:
+        if os.path.exists(out):
+            log.warning(
+                "cannot rebuild %s (%s); loading the existing library, "
+                "which may not match %s", out, exc, src,
+            )
+            return out
+        raise
+    os.replace(out + ".tmp", out)
+    with open(stamp + ".tmp", "w", encoding="utf-8") as f:
+        f.write(want + "\n")
+    os.replace(stamp + ".tmp", stamp)
+    return out

@@ -1,36 +1,36 @@
 """Build the native nornickv shared library with g++.
 
-Invoked automatically (and cached) by nornicdb_tpu.storage.disk on first
-import; also runnable directly: ``python native/build.py``.
+Invoked automatically (and cached on a source content hash) by
+nornicdb_tpu.storage.disk on first import; also runnable directly:
+``python native/build.py``.
 """
 
 from __future__ import annotations
 
+import importlib.util
 import os
-import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+# load the shared helper by path — native/ must never go on sys.path
+# (it would shadow any top-level module named `build`)
+_spec = importlib.util.spec_from_file_location(
+    "nornicdb_tpu_native__buildlib", os.path.join(HERE, "_buildlib.py"))
+_buildlib = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_buildlib)
+build_cached, src_hash = _buildlib.build_cached, _buildlib.src_hash
+
 SRC = os.path.join(HERE, "nornickv.cpp")
 OUT = os.path.join(HERE, "libnornickv.so")
+STAMP = OUT + ".srchash"
+
+
+def _src_hash() -> str:
+    return src_hash(SRC)
 
 
 def build(force: bool = False) -> str:
-    """Compile if the .so is missing or older than the source. Returns the
-    library path; raises on compiler failure."""
-    if (
-        not force
-        and os.path.exists(OUT)
-        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
-    ):
-        return OUT
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-        "-o", OUT + ".tmp", SRC,
-    ]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(OUT + ".tmp", OUT)
-    return OUT
+    return build_cached(SRC, OUT, ["-O2", "-std=c++17"], force=force)
 
 
 if __name__ == "__main__":

@@ -1,34 +1,36 @@
 """Build the native HNSW connect-phase library with g++.
 
-Invoked automatically (and cached) by nornicdb_tpu.search.hnsw_native on
-first use; also runnable directly: ``python native/build_hnsw.py``.
+Invoked automatically (and cached on a source content hash) by
+nornicdb_tpu.search.hnsw_native on first use; also runnable directly:
+``python native/build_hnsw.py``.
 """
 
 from __future__ import annotations
 
+import importlib.util
 import os
-import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+# load the shared helper by path — native/ must never go on sys.path
+# (it would shadow any top-level module named `build`)
+_spec = importlib.util.spec_from_file_location(
+    "nornicdb_tpu_native__buildlib", os.path.join(HERE, "_buildlib.py"))
+_buildlib = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_buildlib)
+build_cached, src_hash = _buildlib.build_cached, _buildlib.src_hash
+
 SRC = os.path.join(HERE, "nornichnsw.cpp")
 OUT = os.path.join(HERE, "libnornichnsw.so")
+STAMP = OUT + ".srchash"
+
+
+def _src_hash() -> str:
+    return src_hash(SRC)
 
 
 def build(force: bool = False) -> str:
-    if (
-        not force
-        and os.path.exists(OUT)
-        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
-    ):
-        return OUT
-    cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", OUT + ".tmp", SRC,
-    ]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(OUT + ".tmp", OUT)
-    return OUT
+    return build_cached(SRC, OUT, ["-O3", "-std=c++17"], force=force)
 
 
 if __name__ == "__main__":

@@ -106,6 +106,15 @@ class QdrantCompat:
         with self._lock:
             self.vector_registry.drop(self._space_key(name))
             self._raw.pop(name, None)
+            # upstream qdrant drops aliases with the collection; keeping
+            # them would leave resolve() routing point ops at a missing
+            # collection and block alias-name reuse
+            aliases = self._alias_map()
+            dangling = [a for a, c in aliases.items() if c == name]
+            if dangling:
+                for a in dangling:
+                    del aliases[a]
+                self._save_aliases(aliases)
         return True
 
     def list_collections(self) -> List[str]:
@@ -204,13 +213,48 @@ class QdrantCompat:
 
     # -- snapshots (reference: pkg/qdrantgrpc/snapshots_service.go) ------
 
+    @staticmethod
+    def _check_path_component(kind: str, value: str) -> str:
+        """Reject names that could escape the snapshot tree. Both the
+        HTTP and gRPC surfaces pass client strings straight into
+        filesystem paths, so every component is validated here, at the
+        single choke point, rather than per-route."""
+        import os
+
+        if (not value or value in (".", "..")
+                or "/" in value or "\\" in value
+                or os.sep in value or (os.altsep and os.altsep in value)
+                or "\x00" in value):
+            raise QdrantError(f"invalid {kind} {value!r}", status=400)
+        return value
+
     def _snap_dir(self, base: str, name: Optional[str] = None) -> str:
         import os
 
+        if name is not None:
+            self._check_path_component("collection name", name)
         d = (os.path.join(base, "collections", name)
              if name else os.path.join(base, "full"))
         os.makedirs(d, exist_ok=True)
         return d
+
+    def _snap_path(self, base_dir: str, snap_name: str,
+                   collection: Optional[str] = None) -> str:
+        """Resolved path of one snapshot file, guaranteed to live under
+        the snapshot base dir (defense in depth on top of the component
+        check: symlinked bases still can't be escaped via `..`)."""
+        import os
+
+        self._check_path_component("snapshot name", snap_name)
+        d = self._snap_dir(base_dir, collection)
+        path = os.path.join(d, snap_name)
+        real_base = os.path.realpath(d)
+        if os.path.commonpath(
+            [real_base, os.path.realpath(path)]
+        ) != real_base:
+            raise QdrantError(f"invalid snapshot name {snap_name!r}",
+                              status=400)
+        return path
 
     def _snapshot_payload(self, name: str) -> Dict[str, Any]:
         meta = self._meta(name)
@@ -261,7 +305,7 @@ class QdrantCompat:
         import os
 
         name = self.resolve(name)
-        path = os.path.join(self._snap_dir(base_dir, name), snap_name)
+        path = self._snap_path(base_dir, snap_name, name)
         if not os.path.exists(path):
             raise QdrantError(f"snapshot `{snap_name}` not found",
                               status=404)
@@ -302,7 +346,7 @@ class QdrantCompat:
     def delete_full_snapshot(self, snap_name: str, base_dir: str) -> bool:
         import os
 
-        path = os.path.join(self._snap_dir(base_dir), snap_name)
+        path = self._snap_path(base_dir, snap_name)
         if not os.path.exists(path):
             raise QdrantError(f"snapshot `{snap_name}` not found",
                               status=404)
@@ -316,15 +360,29 @@ class QdrantCompat:
         import json as _json
         import os
 
-        path = os.path.join(self._snap_dir(base_dir, name), snap_name)
+        # resolve aliases like create/list/delete do — recovering by
+        # alias must land on the collection the snapshot was written
+        # under, not create a literal collection named like the alias
+        name = self.resolve(name)
+        path = self._snap_path(base_dir, snap_name, name)
         if not os.path.exists(path):
             raise QdrantError(f"snapshot `{snap_name}` not found",
                               status=404)
         with open(path, encoding="utf-8") as f:
             payload = _json.load(f)
+        # aliases survive recovery (upstream qdrant keeps them): the
+        # delete+recreate below would otherwise drop every alias of the
+        # recovered collection via delete_collection's cleanup
+        preserved = {a: c for a, c in self._alias_map().items()
+                     if c == name}
         if self.storage.has_node(_META_PREFIX + name):
             self.delete_collection(name)
         self.create_collection(name, payload.get("config") or None)
+        if preserved:
+            with self._lock:
+                aliases = self._alias_map()
+                aliases.update(preserved)
+                self._save_aliases(aliases)
         return self.upsert_points(name, payload.get("points", []))
 
     @staticmethod

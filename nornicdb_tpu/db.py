@@ -99,7 +99,14 @@ class DB:
         self._executor = None
         self._search = None
         if embedder is None:
-            embedder = self._default_embedder()
+            try:
+                embedder = self._default_embedder()
+            except Exception:
+                # don't leak the already-open engine chain (file locks,
+                # async flush thread) when e.g. the embedder sidecar is
+                # corrupt — same discipline as the replication path above
+                self._listenable.close()
+                raise
         self._embedder = embedder
         self._embed_queue = None
         self._decay = None
@@ -132,12 +139,31 @@ class DB:
             if self._data_dir else None
         )
         recorded = None
+        sidecar_unreadable = False
         if sidecar and os.path.exists(sidecar):
             try:
                 with _io.open(sidecar, encoding="utf-8") as f:
                     recorded = _json.load(f)
-            except Exception:
-                recorded = None
+            except Exception as exc:
+                # a corrupt sidecar must NOT be treated as "no recorded
+                # identity": the default embedder could then write new
+                # vectors into a different space before anyone notices.
+                # Fail loudly (the data damage would be done by the time
+                # a log line is read); NORNICDB_TPU_EMBEDDER=hash stays
+                # available as the explicit escape hatch.
+                sidecar_unreadable = True
+                if os.environ.get("NORNICDB_TPU_EMBEDDER", "") != "hash":
+                    raise ValueError(
+                        f"embedder sidecar {sidecar} is unreadable "
+                        f"({exc}); fix or remove the file to re-bind the "
+                        "store's embedding space, or force "
+                        "NORNICDB_TPU_EMBEDDER=hash to open anyway"
+                    ) from exc
+                log.error(
+                    "embedder sidecar %s is unreadable (%s); forced hash "
+                    "embedder is active — the recorded identity is NOT "
+                    "re-written", sidecar, exc,
+                )
 
         from nornicdb_tpu.models.hf_import import default_model_dir
 
@@ -196,7 +222,7 @@ class DB:
                 "existing embeddings are in the recorded space — reindex "
                 "to migrate", recorded.get("kind"), kind,
             )
-        if sidecar and recorded is None:
+        if sidecar and recorded is None and not sidecar_unreadable:
             try:
                 with _io.open(sidecar, "w", encoding="utf-8") as f:
                     _json.dump({"kind": kind, "dims": inner.dims}, f)

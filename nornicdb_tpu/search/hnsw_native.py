@@ -24,15 +24,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
     _tried = True
     here = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    so = os.path.join(here, "native", "libnornichnsw.so")
     try:
-        if not os.path.exists(so):
-            import sys
+        import importlib.util
 
-            sys.path.insert(0, os.path.join(here, "native"))
-            from build_hnsw import build  # type: ignore
-
-            so = build()
+        # always route through build(): its content-hash stamp check is
+        # what guarantees a committed/stale .so that no longer matches
+        # nornichnsw.cpp is rebuilt rather than silently loaded. Imported
+        # by path so native/ never lands on sys.path (it would shadow a
+        # top-level `build`).
+        spec = importlib.util.spec_from_file_location(
+            "nornicdb_tpu_native_build_hnsw",
+            os.path.join(here, "native", "build_hnsw.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        so = mod.build()
         lib = ctypes.CDLL(so)
         lib.hnsw_connect.argtypes = [
             ctypes.POINTER(ctypes.c_float),   # vectors

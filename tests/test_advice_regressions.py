@@ -305,3 +305,193 @@ class TestHeimdallDoubleLoad:
             t.join()
         assert mgr.memory_used == 100, "memory double-counted"
         assert len({id(g) for g in results}) == 1, "model built twice"
+
+
+class TestSnapshotPathTraversal:
+    """ADVICE r4 high: snapshot collection/snapshot names were joined
+    into filesystem paths unvalidated — a gRPC client could pass
+    '../../../etc/x' to os.remove / makedirs / open arbitrary paths."""
+
+    def _compat(self):
+        return QdrantCompat(NamespacedEngine(_Mem(), "t"))
+
+    def test_delete_snapshot_rejects_traversal(self, tmp_path):
+        compat = self._compat()
+        compat.create_collection("c", {"size": 2, "distance": "Cosine"})
+        victim = tmp_path / "victim.txt"
+        victim.write_text("keep me")
+        base = str(tmp_path / "snaps")
+        for evil in ("../../victim.txt", "..", "a/b.snapshot",
+                     "a\\b.snapshot", ""):
+            with pytest.raises(QdrantError) as ei:
+                compat.delete_snapshot("c", evil, base)
+            assert ei.value.status == 400
+        assert victim.read_text() == "keep me"
+
+    def test_delete_full_snapshot_rejects_traversal(self, tmp_path):
+        compat = self._compat()
+        base = str(tmp_path / "snaps")
+        with pytest.raises(QdrantError) as ei:
+            compat.delete_full_snapshot("../../../etc/passwd", base)
+        assert ei.value.status == 400
+
+    def test_recover_rejects_traversal(self, tmp_path):
+        compat = self._compat()
+        compat.create_collection("c", {"size": 2, "distance": "Cosine"})
+        # a JSON file outside the snapshot tree must not be readable
+        outside = tmp_path / "outside.json"
+        outside.write_text('{"points": []}')
+        with pytest.raises(QdrantError) as ei:
+            compat.recover_snapshot("c", "../../outside.json",
+                                    str(tmp_path / "snaps"))
+        assert ei.value.status == 400
+
+    def test_collection_name_with_sep_rejected_in_snapshot_ops(
+        self, tmp_path
+    ):
+        compat = self._compat()
+        with pytest.raises(QdrantError) as ei:
+            compat.create_snapshot("../c", str(tmp_path / "snaps"))
+        assert ei.value.status in (400, 404)
+
+    def test_legit_lifecycle_still_works(self, tmp_path):
+        compat = self._compat()
+        compat.create_collection("c", {"size": 2, "distance": "Cosine"})
+        compat.upsert_points("c", [{"id": 1, "vector": [1.0, 0.0]}])
+        base = str(tmp_path / "snaps")
+        desc = compat.create_snapshot("c", base)
+        assert desc["name"].endswith(".snapshot")
+        assert [s["name"] for s in compat.list_snapshots("c", base)] == [
+            desc["name"]
+        ]
+        assert compat.recover_snapshot("c", desc["name"], base) == 1
+        assert compat.delete_snapshot("c", desc["name"], base) is True
+
+
+class TestSnapshotAliasSemantics:
+    """ADVICE r4 medium/low: recover_snapshot didn't resolve aliases
+    (split restore), and delete_collection left dangling aliases."""
+
+    def _compat(self):
+        return QdrantCompat(NamespacedEngine(_Mem(), "t"))
+
+    def test_recover_by_alias_restores_target_collection(self, tmp_path):
+        compat = self._compat()
+        compat.create_collection("real", {"size": 2, "distance": "Cosine"})
+        compat.upsert_points("real", [{"id": 1, "vector": [1.0, 0.0]}])
+        compat.update_aliases(
+            [{"create": {"alias": "al", "collection": "real"}}]
+        )
+        base = str(tmp_path / "snaps")
+        desc = compat.create_snapshot("al", base)  # written under "real"
+        # recovering by alias must find that snapshot and restore into
+        # "real" — not 404, and not create a literal collection "al"
+        assert compat.recover_snapshot("al", desc["name"], base) == 1
+        assert "al" not in compat.list_collections()
+        assert compat.count_points("real") == 1
+        # and the alias survives recovery (upstream keeps aliases):
+        # point ops through it keep working
+        assert compat.list_aliases() == [
+            {"alias_name": "al", "collection_name": "real"}
+        ]
+        assert compat.count_points("al") == 1
+
+    def test_delete_collection_drops_its_aliases(self):
+        compat = self._compat()
+        compat.create_collection("real", {"size": 2, "distance": "Cosine"})
+        compat.update_aliases(
+            [{"create": {"alias": "al", "collection": "real"}}]
+        )
+        assert compat.delete_collection("real") is True
+        assert compat.list_aliases() == []
+        # alias name is reusable for a new collection now
+        compat.create_collection("al", {"size": 2, "distance": "Cosine"})
+        assert "al" in compat.list_collections()
+
+
+class TestCorruptEmbedderSidecar:
+    """ADVICE r4 low: an unreadable embedder.json was treated like a
+    missing one and overwritten — silently rebinding the store's
+    embedding space. Now the open fails loudly (escape hatch:
+    NORNICDB_TPU_EMBEDDER=hash) and the file is never rewritten."""
+
+    def test_corrupt_sidecar_fails_open(self, tmp_path, monkeypatch):
+        import nornicdb_tpu
+
+        monkeypatch.delenv("NORNICDB_TPU_EMBEDDER", raising=False)
+        d = str(tmp_path / "data")
+        db = nornicdb_tpu.open(d)
+        db.close()
+        sidecar = tmp_path / "data" / "embedder.json"
+        assert sidecar.exists()
+        sidecar.write_text("{not json", encoding="utf-8")
+        with pytest.raises(ValueError, match="embedder sidecar"):
+            nornicdb_tpu.open(d)
+        # the corrupt file is left untouched for the operator
+        assert sidecar.read_text(encoding="utf-8") == "{not json"
+        # and the failed open released the engine chain (file locks):
+        # fixing the sidecar makes a same-process retry succeed
+        sidecar.write_text('{"kind": "hash", "dims": 256}',
+                           encoding="utf-8")
+        db = nornicdb_tpu.open(d)
+        db.close()
+
+    def test_forced_hash_still_opens(self, tmp_path, monkeypatch):
+        import nornicdb_tpu
+
+        monkeypatch.delenv("NORNICDB_TPU_EMBEDDER", raising=False)
+        d = str(tmp_path / "data")
+        db = nornicdb_tpu.open(d)
+        db.close()
+        sidecar = tmp_path / "data" / "embedder.json"
+        sidecar.write_text("{not json", encoding="utf-8")
+        monkeypatch.setenv("NORNICDB_TPU_EMBEDDER", "hash")
+        db = nornicdb_tpu.open(d)
+        db.close()
+        # identity still not rewritten under the escape hatch
+        assert sidecar.read_text(encoding="utf-8") == "{not json"
+
+
+class TestNativeBuildStamp:
+    """ADVICE r4 low: the .so cache was keyed on mtimes, so a fresh
+    clone (arbitrary checkout mtimes) could silently load a stale
+    committed binary. Now build() is keyed on a content hash of the
+    source and the runtime loaders always route through it."""
+
+    def test_stamp_matches_source(self):
+        import hashlib
+        import os
+
+        for src, stamp in (
+            ("native/nornichnsw.cpp", "native/libnornichnsw.so.srchash"),
+            ("native/nornickv.cpp", "native/libnornickv.so.srchash"),
+        ):
+            src_p = os.path.join(os.path.dirname(__file__), "..", src)
+            stamp_p = os.path.join(os.path.dirname(__file__), "..", stamp)
+            if not os.path.exists(stamp_p):
+                continue  # not built yet in this checkout
+            with open(src_p, "rb") as f:
+                want = hashlib.sha256(f.read()).hexdigest()
+            with open(stamp_p, encoding="utf-8") as f:
+                assert f.read().strip() == want
+
+    def test_stale_stamp_triggers_rebuild(self):
+        import importlib.util
+        import os
+        import shutil
+
+        if shutil.which("g++") is None:
+            pytest.skip("no g++ in this environment")
+        path = os.path.join(os.path.dirname(__file__), "..", "native",
+                            "build_hnsw.py")
+        spec = importlib.util.spec_from_file_location("_t_build_hnsw", path)
+        build_hnsw = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(build_hnsw)
+        build_hnsw.build()  # ensure .so + stamp exist
+        # corrupt the stamp: build() must recompile and re-stamp with the
+        # true source hash, not trust the existing .so
+        with open(build_hnsw.STAMP, "w", encoding="utf-8") as f:
+            f.write("deadbeef\n")
+        build_hnsw.build()
+        with open(build_hnsw.STAMP, encoding="utf-8") as f:
+            assert f.read().strip() == build_hnsw._src_hash()
