@@ -14,7 +14,11 @@ subprocess with a bounded timeout and retries; on hard failure the bench
 falls back to the CPU PJRT backend (the result line then carries
 "backend": "cpu-fallback") instead of hanging or dying with a traceback.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Output is truncation-proof (VERDICT r4 #2: the driver records only the
+LAST 2000 chars of output): the full result JSON line prints FIRST, and
+a compact single-line summary carrying the complete headline set
+(geomean, per-shape vs_baseline, knn, hnsw build, qps@recall95,
+surfaces, pagerank, backend) prints LAST.
 """
 
 import json
@@ -117,7 +121,81 @@ def main():
                 "MFU proof requires a real accelerator"}
     except Exception as exc:
         result["tpu_proof"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # full result first, compact summary LAST: the driver keeps only the
+    # last 2000 chars, and round 4's headline numbers were lost to
+    # truncation because the headline printed first
     print(json.dumps(result))
+    sys.stdout.flush()
+    print(json.dumps(_compact_summary(result)))
+
+
+def _compact_summary(result):
+    """One short JSON object with every headline number; must stay well
+    under the driver's 2000-char tail window. Extraction is defensive —
+    a missing sub-result yields null, never an exception."""
+
+    def g(d, *path):
+        for p in path:
+            if not isinstance(d, dict) or p not in d:
+                return None
+            d = d[p]
+        return d
+
+    cy = result.get("cypher", {})
+    shapes = {
+        name: g(cy, name, "vs_baseline")
+        for name in _LDBC_BASELINES
+        if isinstance(cy.get(name), dict)
+    }
+    surfaces = {
+        name: [g(result, "surfaces", name, "ops_per_s"),
+               g(result, "surfaces", name, "vs_baseline")]
+        for name in _SURFACE_BASELINES
+        if isinstance(g(result, "surfaces", name), dict)
+    }
+    tpu = result.get("tpu_proof")
+    if isinstance(tpu, dict):
+        tpu_brief = (tpu.get("skipped") and "skipped") or (
+            tpu.get("error") and "error") or {
+            "platform": tpu.get("platform"),
+            "topk_matches_xla": g(tpu, "pallas_topk_compiled",
+                                  "matches_xla"),
+            "mfu": g(tpu, "encoder_forward_mfu", "mfu"),
+        }
+    else:
+        tpu_brief = None
+    return {
+        "summary": True,
+        "metric": result.get("metric"),
+        "value": result.get("value"),
+        "unit": result.get("unit"),
+        "vs_baseline": result.get("vs_baseline"),
+        "shapes_vs_baseline": shapes,
+        "knn": {
+            "b1_qps": g(result, "knn", "value"),
+            "vs_baseline": g(result, "knn", "vs_baseline"),
+            "b1_concurrent_qps": g(result, "knn", "b1_concurrent_qps"),
+            "b64_qps": g(result, "knn", "b64_qps"),
+            "backend": g(result, "knn", "backend"),
+        },
+        "hnsw_build": {
+            "inserts_per_s": g(result, "northstar", "hnsw_build_100k",
+                               "inserts_per_s"),
+            "vs_baseline": g(result, "northstar", "hnsw_build_100k",
+                             "vs_baseline"),
+            "seeded_speedup": g(result, "northstar", "hnsw_build_100k",
+                                "seeded_speedup"),
+            "seeded_recall10": g(result, "northstar", "hnsw_build_100k",
+                                 "seeded_recall10"),
+        },
+        "qps_at_recall95": g(result, "northstar", "ann_qps_recall95",
+                             "qps_at_recall95"),
+        "pagerank_speedup_vs_numpy": g(result, "northstar",
+                                       "pagerank_device",
+                                       "speedup_vs_numpy"),
+        "surfaces": surfaces,
+        "tpu_proof": tpu_brief,
+    }
 
 
 # bf16 peak FLOP/s per chip by device_kind substring (public specs);
@@ -140,8 +218,9 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def _bench_tpu_proof():
-    """Runs ONLY on a live accelerator. Captures, in one shot:
+def _bench_tpu_proof(interpret: bool = False, tiny: bool = False):
+    """Runs ONLY on a live accelerator (production path). Captures, in
+    one shot:
 
     - compiled (interpret=False) Pallas fused cosine top-k, validated
       against the XLA path and timed;
@@ -150,6 +229,10 @@ def _bench_tpu_proof():
     - batched device kNN (batch 64) alongside the headline batch-1;
     - encoder forward MFU at the bge-m3-like shape: measured tokens/s
       x analytic FLOPs/token over the chip's public bf16 peak.
+
+    ``interpret=True, tiny=True`` is the CPU dry-run mode (VERDICT r4
+    #6): same code path, same artifact schema, interpret-mode Pallas on
+    toy shapes — so a harness bug can't burn the first real TPU session.
     """
     import jax
     import jax.numpy as jnp
@@ -163,7 +246,7 @@ def _bench_tpu_proof():
     from nornicdb_tpu.ops.pallas_topk import fused_cosine_topk
 
     # -- compiled pallas top-k vs XLA path --------------------------------
-    n, d, k = 100_000, 1024, 10
+    n, d, k = (4096, 128, 10) if tiny else (100_000, 1024, 10)
     cap = pad_dim(n)
     m = np.zeros((cap, d), np.float32)
     m[:n] = rng.standard_normal((n, d), dtype=np.float32)
@@ -175,14 +258,14 @@ def _bench_tpu_proof():
         rng.standard_normal((64, d), dtype=np.float32)))
     s_ref, i_ref = cosine_topk(q, mj, vj, k)
     s_ref.block_until_ready()
-    s_pal, i_pal = fused_cosine_topk(q, mj, vj, k, interpret=False)
+    s_pal, i_pal = fused_cosine_topk(q, mj, vj, k, interpret=interpret)
     s_pal.block_until_ready()
     exact = bool(jnp.all(i_ref == i_pal)) and bool(
         jnp.allclose(s_ref, s_pal, atol=1e-3))
-    iters = 50
+    iters = 3 if tiny else 50
     t0 = time.perf_counter()
     for _ in range(iters):
-        s_pal, _ = fused_cosine_topk(q, mj, vj, k, interpret=False)
+        s_pal, _ = fused_cosine_topk(q, mj, vj, k, interpret=interpret)
     s_pal.block_until_ready()
     dt_pal = time.perf_counter() - t0
     t0 = time.perf_counter()
@@ -200,19 +283,19 @@ def _bench_tpu_proof():
     from nornicdb_tpu.ops.pallas_attention import (
         flash_attention, reference_attention)
 
-    B, S, H, Dh = 4, 1024, 8, 64
+    B, S, H, Dh = (1, 128, 2, 32) if tiny else (4, 1024, 8, 64)
     qa = jnp.asarray(rng.standard_normal((B, S, H, Dh), dtype=np.float32))
     ka = jnp.asarray(rng.standard_normal((B, S, H, Dh), dtype=np.float32))
     va = jnp.asarray(rng.standard_normal((B, S, H, Dh), dtype=np.float32))
     mask = jnp.ones((B, S), bool)
     o_ref = reference_attention(qa, ka, va, mask)
-    o_pal = flash_attention(qa, ka, va, mask, interpret=False)
+    o_pal = flash_attention(qa, ka, va, mask, interpret=interpret)
     o_pal.block_until_ready()
     att_exact = bool(jnp.allclose(o_ref, o_pal, atol=2e-3))
-    iters = 30
+    iters = 3 if tiny else 30
     t0 = time.perf_counter()
     for _ in range(iters):
-        o_pal = flash_attention(qa, ka, va, mask, interpret=False)
+        o_pal = flash_attention(qa, ka, va, mask, interpret=interpret)
     o_pal.block_until_ready()
     dt = time.perf_counter() - t0
     att_flops = 4.0 * B * H * S * S * Dh  # QK^T + AV matmuls
@@ -222,7 +305,7 @@ def _bench_tpu_proof():
     }
 
     # -- batched device kNN (the headline is batch-1) ---------------------
-    iters = 200
+    iters = 10 if tiny else 200
     t0 = time.perf_counter()
     for _ in range(iters):
         s, _ = cosine_topk(q, mj, vj, k)
@@ -238,15 +321,16 @@ def _bench_tpu_proof():
     # -- encoder forward MFU at the bge-m3-like shape ---------------------
     from nornicdb_tpu.models.encoder import Encoder, EncoderConfig
 
-    cfg = EncoderConfig.bge_m3_like()
+    cfg = (EncoderConfig.tiny() if tiny
+           else EncoderConfig.bge_m3_like())
     model = Encoder(cfg)
-    Bt, St = 8, 512
+    Bt, St = (2, 64) if tiny else (8, 512)
     ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (Bt, St)), jnp.int32)
     params = jax.jit(lambda: model.init(
         jax.random.PRNGKey(0), ids)["params"])()
     fwd = jax.jit(lambda p, x: model.apply({"params": p}, x))
     fwd(params, ids).block_until_ready()  # compile
-    iters = 10
+    iters = 3 if tiny else 10
     t0 = time.perf_counter()
     for _ in range(iters):
         y = fwd(params, ids)
@@ -843,15 +927,14 @@ if __name__ == "__main__":
     try:
         main()
     except Exception as exc:  # last-resort: a parseable line beats a traceback
-        print(
-            json.dumps(
-                {
-                    "metric": "ldbc_snb_cypher_geomean",
-                    "value": 0.0,
-                    "unit": "queries/s",
-                    "vs_baseline": 0.0,
-                    "error": f"{type(exc).__name__}: {exc}"[:400],
-                }
-            )
-        )
+        err = {
+            "metric": "ldbc_snb_cypher_geomean",
+            "value": 0.0,
+            "unit": "queries/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(exc).__name__}: {exc}"[:400],
+        }
+        print(json.dumps(err))
+        sys.stdout.flush()
+        print(json.dumps({**_compact_summary(err), "error": err["error"]}))
         sys.exit(0)

@@ -42,7 +42,12 @@ def build_cached(src: str, out: str, flags: list[str],
                     return out
         except OSError:
             pass
-    cmd = ["g++", *flags, "-shared", "-fPIC", "-o", out + ".tmp", src]
+    # per-process temp names: concurrent first-use builds (e.g. two
+    # services starting on a fresh clone) must not interleave writes to
+    # one shared .tmp and publish a truncated library
+    tmp_out = f"{out}.tmp.{os.getpid()}"
+    tmp_stamp = f"{stamp}.tmp.{os.getpid()}"
+    cmd = ["g++", *flags, "-shared", "-fPIC", "-o", tmp_out, src]
     try:
         subprocess.run(cmd, check=True, capture_output=True)
     except (OSError, subprocess.CalledProcessError) as exc:
@@ -53,8 +58,8 @@ def build_cached(src: str, out: str, flags: list[str],
             )
             return out
         raise
-    os.replace(out + ".tmp", out)
-    with open(stamp + ".tmp", "w", encoding="utf-8") as f:
+    os.replace(tmp_out, out)
+    with open(tmp_stamp, "w", encoding="utf-8") as f:
         f.write(want + "\n")
-    os.replace(stamp + ".tmp", stamp)
+    os.replace(tmp_stamp, stamp)
     return out

@@ -1,0 +1,25 @@
+"""Loader for the ``native/`` build scripts.
+
+Imports by file path so ``native/`` never lands on ``sys.path`` (it
+would shadow any top-level module named ``build``). Routing every
+native-library load through the build script matters: its content-hash
+stamp check is what guarantees a stale ``.so`` that no longer matches
+its ``.cpp`` is rebuilt rather than silently loaded (ADVICE r4).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+
+def load_build_module(script_name: str):
+    """Import ``native/<script_name>`` and return the module (exposing
+    ``build()``)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo_root, "native", script_name)
+    spec = importlib.util.spec_from_file_location(
+        "nornicdb_tpu_native_" + script_name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -22,22 +22,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _tried:
         return _lib
     _tried = True
-    here = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     try:
-        import importlib.util
+        from nornicdb_tpu._native import load_build_module
 
-        # always route through build(): its content-hash stamp check is
-        # what guarantees a committed/stale .so that no longer matches
-        # nornichnsw.cpp is rebuilt rather than silently loaded. Imported
-        # by path so native/ never lands on sys.path (it would shadow a
-        # top-level `build`).
-        spec = importlib.util.spec_from_file_location(
-            "nornicdb_tpu_native_build_hnsw",
-            os.path.join(here, "native", "build_hnsw.py"))
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        so = mod.build()
+        so = load_build_module("build_hnsw.py").build()
         lib = ctypes.CDLL(so)
         lib.hnsw_connect.argtypes = [
             ctypes.POINTER(ctypes.c_float),   # vectors

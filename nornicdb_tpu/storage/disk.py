@@ -32,18 +32,9 @@ _ENC_MAGIC = b"NKE1"
 
 
 def _load_lib() -> ctypes.CDLL:
-    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    import importlib.util
+    from nornicdb_tpu._native import load_build_module
 
-    # always route through build(): its content-hash stamp check is what
-    # guarantees a committed/stale .so that no longer matches nornickv.cpp
-    # is rebuilt rather than silently loaded. Imported by path so native/
-    # never lands on sys.path (it would shadow a top-level `build`).
-    spec = importlib.util.spec_from_file_location(
-        "nornicdb_tpu_native_build", os.path.join(here, "native", "build.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    so = mod.build()
+    so = load_build_module("build.py").build()
     lib = ctypes.CDLL(so)
     lib.nkv_open.restype = ctypes.c_void_p
     lib.nkv_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_long]

@@ -475,19 +475,27 @@ class TestNativeBuildStamp:
             with open(stamp_p, encoding="utf-8") as f:
                 assert f.read().strip() == want
 
-    def test_stale_stamp_triggers_rebuild(self):
+    def test_stale_stamp_triggers_rebuild(self, tmp_path):
         import importlib.util
         import os
         import shutil
 
         if shutil.which("g++") is None:
             pytest.skip("no g++ in this environment")
-        path = os.path.join(os.path.dirname(__file__), "..", "native",
-                            "build_hnsw.py")
-        spec = importlib.util.spec_from_file_location("_t_build_hnsw", path)
+        native = os.path.join(os.path.dirname(__file__), "..", "native")
+        spec = importlib.util.spec_from_file_location(
+            "_t_build_hnsw", os.path.join(native, "build_hnsw.py"))
         build_hnsw = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(build_hnsw)
-        build_hnsw.build()  # ensure .so + stamp exist
+        # build into tmp_path so the checkout's committed artifacts are
+        # never mutated by the suite
+        src = str(tmp_path / "nornichnsw.cpp")
+        shutil.copyfile(os.path.join(native, "nornichnsw.cpp"), src)
+        build_hnsw.SRC = src
+        build_hnsw.OUT = str(tmp_path / "libnornichnsw.so")
+        build_hnsw.STAMP = build_hnsw.OUT + ".srchash"
+        build_hnsw.build()
+        assert os.path.exists(build_hnsw.STAMP)
         # corrupt the stamp: build() must recompile and re-stamp with the
         # true source hash, not trust the existing .so
         with open(build_hnsw.STAMP, "w", encoding="utf-8") as f:
