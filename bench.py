@@ -365,12 +365,68 @@ _SURFACE_BASELINES = {
 }
 
 
-def _bench_surfaces(n_people: int = 1000, secs: float = 1.5):
-    """Sustained single-stream ops/s on every protocol surface over one
-    1k-node dataset (reference: testing/e2e/endpoints_bench_test.go).
-    Uses the in-repo from-spec bolt client; HTTP via urllib; qdrant via
-    grpc. Each surface gets a short warmup then ``secs`` of timing."""
-    import urllib.request
+class _LeanHttpClient:
+    """Persistent keep-alive HTTP/1.1 client over a raw socket with
+    prebuilt request bytes. The reference bench's clients are compiled
+    Go — a urllib/http.client loop spends more CPU in the client than
+    the server does serving it, and on a small box that client cost is
+    what gets measured. This measures the server."""
+
+    def __init__(self, port: int):
+        import socket
+
+        self.sock = socket.create_connection(("127.0.0.1", port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    @staticmethod
+    def build(path: str, body: dict) -> bytes:
+        data = json.dumps(body).encode()
+        return (
+            f"POST {path} HTTP/1.1\r\nHost: bench\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n\r\n"
+        ).encode() + data
+
+    def roundtrip(self, request: bytes) -> bytes:
+        import re as _re
+
+        self.sock.sendall(request)
+        while b"\r\n\r\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed connection")
+            self._buf += chunk
+        head, _, rest = self._buf.partition(b"\r\n\r\n")
+        m = _re.search(rb"content-length:\s*(\d+)", head, _re.I)
+        clen = int(m.group(1)) if m else 0
+        while len(rest) < clen:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed connection")
+            rest += chunk
+        body, self._buf = rest[:clen], rest[clen:]
+        if not head.startswith(b"HTTP/1.1 2"):
+            raise RuntimeError(f"bad status: {head[:40]!r} {body[:200]!r}")
+        return body
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+def _bench_surfaces(n_people: int = 1000, secs: float = 2.0,
+                    warmup_s: float = 0.5):
+    """Sustained ops/s on every protocol surface over one 1k-node
+    dataset, with the reference's e2e methodology
+    (testing/e2e/endpoints_bench_test.go): persistent per-worker
+    connections, fixed request per surface (its bolt/graphql shapes are
+    fixed count queries and its REST/qdrant searches repeat one query —
+    riding the server's result caches is part of the measured contract,
+    search.go:88-92), concurrency = NORNICDB_E2E_CONCURRENCY or cpu
+    count (the reference uses GOMAXPROCS; its baselines rode a 16-core
+    M3 Max, so absolute ops/s on a small box understate per-core
+    standing — `cpus` is reported alongside)."""
+    import threading
 
     import grpc
 
@@ -380,6 +436,9 @@ def _bench_surfaces(n_people: int = 1000, secs: float = 1.5):
     from nornicdb_tpu.api.http_server import HttpServer
     from nornicdb_tpu.api.proto import qdrant_pb2 as q
     from tests.test_e2e_surfaces import _Bolt
+
+    cpus = os.cpu_count() or 1
+    conc = int(os.environ.get("NORNICDB_E2E_CONCURRENCY", 0)) or min(cpus, 16)
 
     os.environ.setdefault("NORNICDB_TPU_EMBEDDER", "hash")
     db = nornicdb_tpu.open(auto_embed=False)
@@ -403,14 +462,6 @@ def _bench_surfaces(n_people: int = 1000, secs: float = 1.5):
             response_deserializer=response_cls.FromString,
         )(request)
 
-    def http_json(path, body):
-        data = json.dumps(body).encode()
-        r = urllib.request.Request(
-            f"http://127.0.0.1:{http.port}{path}", data=data,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(r, timeout=10) as resp:
-            return json.loads(resp.read())
-
     req = q.CreateCollection(collection_name="bench")
     req.vectors_config.params.size = embedder.dims
     req.vectors_config.params.distance = q.Cosine
@@ -424,50 +475,121 @@ def _bench_surfaces(n_people: int = 1000, secs: float = 1.5):
         p.vectors.vector.data.extend(node.embedding)
     grpc_call("/qdrant.Points/Upsert", up, q.PointsOperationResponse)
 
-    def sustain(fn):
-        fn()  # warmup
+    def sustain(make_worker):
+        """Reference runBench shape: N workers, each with its own
+        connection; warmup, then a timed window. A worker that dies
+        before its barrier aborts the barrier (instead of hanging the
+        whole bench forever — the artifact must always be produced)."""
+        stop = threading.Event()
+        counts = [0] * conc
+        barrier = threading.Barrier(conc + 1)
+
+        def run(idx):
+            try:
+                fn, cleanup = make_worker()
+            except Exception:
+                barrier.abort()
+                raise
+            try:
+                fn()  # connection + compile warmup
+                barrier.wait(timeout=120)
+                # warmup window (results discarded)
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < warmup_s:
+                    fn()
+                barrier.wait(timeout=120)
+                n = 0
+                while not stop.is_set():
+                    fn()
+                    n += 1
+                counts[idx] = n
+            except threading.BrokenBarrierError:
+                pass
+            except Exception:
+                barrier.abort()
+                raise
+            finally:
+                cleanup()
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in range(conc)]
+        for t in threads:
+            t.start()
+        try:
+            barrier.wait(timeout=120)  # all connected
+            barrier.wait(timeout=120)  # warmup done
+        except threading.BrokenBarrierError:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            raise RuntimeError("bench worker failed during setup/warmup")
         t0 = time.perf_counter()
-        n = 0
-        while time.perf_counter() - t0 < secs:
-            fn()
-            n += 1
-        return round(n / (time.perf_counter() - t0), 1)
+        time.sleep(secs)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        return round(sum(counts) / (time.perf_counter() - t0), 1)
+
+    def http_worker(path, body):
+        request = _LeanHttpClient.build(path, body)
+
+        def make():
+            client = _LeanHttpClient(http.port)
+            return (lambda: client.roundtrip(request)), client.close
+
+        return make
 
     out = {}
     try:
-        b = _Bolt(bolt.port)
-        out["bolt"] = sustain(lambda: b.query_value(
-            "MATCH (p:Person {idx: 3}) RETURN p.name"))
-        b.close()
-        out["neo4j_http"] = sustain(lambda: http_json(
+        def bolt_worker():
+            b = _Bolt(bolt.port)
+            return (lambda: b.query_value(
+                "MATCH (p:Person {idx: 3}) RETURN p.name")), b.close
+
+        out["bolt"] = sustain(bolt_worker)
+        out["neo4j_http"] = sustain(http_worker(
             "/db/neo4j/tx/commit",
             {"statements": [{"statement":
                              "MATCH (p:Person {idx: 3}) "
                              "RETURN p.name"}]}))
-        out["graphql"] = sustain(lambda: http_json(
+        out["graphql"] = sustain(http_worker(
             "/graphql",
             {"query": "{ nodes(label: \"Person\", limit: 5) "
                       "{ id } }"}))
-        out["rest_search"] = sustain(lambda: http_json(
+        out["rest_search"] = sustain(http_worker(
             "/nornicdb/search", {"query": "topic1 person", "limit": 5}))
         target = db.storage.get_node("p4")
         sr = q.SearchPoints(collection_name="bench",
                             vector=list(target.embedding), limit=5)
-        out["qdrant_grpc"] = sustain(lambda: grpc_call(
-            "/qdrant.Points/Search", sr, q.SearchResponse))
+
+        def grpc_worker():
+            stub = ch.unary_unary(
+                "/qdrant.Points/Search",
+                request_serializer=lambda r: r.SerializeToString(),
+                response_deserializer=q.SearchResponse.FromString)
+            return (lambda: stub(sr)), (lambda: None)
+
+        out["qdrant_grpc"] = sustain(grpc_worker)
     finally:
         ch.close()
         grpc_srv.stop()
         bolt.stop()
         http.stop()
         db.close()
-    return {
+    result = {
         name: {
             "ops_per_s": ops,
             "vs_baseline": round(ops / _SURFACE_BASELINES[name], 3),
         }
         for name, ops in out.items()
     }
+    result["config"] = {
+        "cpus": cpus, "concurrency": conc,
+        "baseline_note": "reference numbers from a 16-core M3 Max "
+                         "(testing/e2e/README.md); vs_baseline is the "
+                         "absolute ratio, not per-core",
+    }
+    return result
 
 
 def _bench_northstar():
@@ -762,11 +884,64 @@ def _bench_knn():
     dt = time.perf_counter() - t0
     qps = iters / dt
 
+    # batched throughput at b=64 (the shape the MXU actually wants)
+    b_iters = 100
+    s, _ = cosine_topk(queries, mj, vj, k)
+    s.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(b_iters):
+        s, _ = cosine_topk(queries, mj, vj, k)
+    s.block_until_ready()
+    b64_qps = 64 * b_iters / (time.perf_counter() - t0)
+
+    # concurrent b=1 through the micro-batching window (VERDICT r4 #5):
+    # N client threads each issue single-vector queries; the MicroBatcher
+    # coalesces whatever is pending into one batched device call
+    import threading
+
+    from nornicdb_tpu.search.microbatch import MicroBatcher
+
+    def search_batch(batch_q, kk):
+        bs, bi = cosine_topk(jnp.asarray(batch_q), mj, vj, kk)
+        bs.block_until_ready()
+        return list(zip(np.asarray(bs), np.asarray(bi)))
+
+    mb = MicroBatcher(search_batch, max_batch=64)
+    host_qs = [np.asarray(q[0]) for q in qs]
+    n_threads = 32
+    stop = threading.Event()
+    counts = [0] * n_threads
+
+    def worker(t):
+        j = t
+        while not stop.is_set():
+            mb.search(host_qs[j % 64], k)
+            counts[t] += 1
+            j += 1
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    mb.search(host_qs[0], k)  # warm
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    conc_qps = sum(counts) / (time.perf_counter() - t0)
+
     return {
         "metric": "knn_throughput_b1_10k_x_1024",
         "value": round(qps, 1),
         "unit": "queries/s",
         "vs_baseline": round(qps / BASELINE_REST_SEARCH_OPS, 3),
+        "b64_qps": round(b64_qps, 1),
+        "b1_concurrent_qps": round(conc_qps, 1),
+        "b1_concurrent_clients": n_threads,
+        "b1_concurrent_vs_serial_b1": round(conc_qps / qps, 2),
+        "microbatch_mean_batch": round(
+            mb.batched_queries / max(mb.batches, 1), 1),
         "backend": "cpu-fallback" if fallback else jax.devices()[0].platform,
     }
 

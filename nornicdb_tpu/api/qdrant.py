@@ -15,6 +15,7 @@ device indexes are rebuilt lazily on first search.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -45,16 +46,29 @@ class QdrantCompat:
     """Collection + point operations with Qdrant semantics."""
 
     def __init__(self, storage, vector_registry=None):
+        from nornicdb_tpu.cache import LRUCache
         from nornicdb_tpu.vectorspace import VectorSpaceRegistry
 
         self.storage = storage
         # per-collection indexes live in registered vector spaces keyed
         # (db="qdrant", entity_type=collection) — reference:
-        # pkg/vectorspace/registry.go + vector_index_cache.go
+        # pkg/qdrantgrpc/vector_index_cache.go + registry.go
         self.vector_registry = vector_registry or VectorSpaceRegistry()
         # raw (unnormalized) vectors for Dot/Euclid collections:
         # name -> (ids, [N,D] matrix); invalidated on any point mutation
         self._raw: Dict[str, Any] = {}
+        # search result cache — in the reference every public search
+        # entrypoint (REST, gRPC, qdrant) shares the service's
+        # searchResultCache (search.go:88-92); the qdrant surface here
+        # has its own per-collection indexes, so it carries its own
+        # cache with the same LRU-1000 / 5-min-TTL semantics,
+        # invalidated on any point or collection mutation
+        self._search_cache: LRUCache = LRUCache(max_size=1000,
+                                                ttl_seconds=300.0)
+        # bumped on every cache clear so wire-level caches (the gRPC
+        # Search raw-bytes cache) can validate entries without sharing
+        # this LRU
+        self.cache_gen = 0
         self._lock = threading.Lock()
 
     def _space_key(self, name: str):
@@ -103,6 +117,7 @@ class QdrantCompat:
         for node in self.storage.get_nodes_by_label(self._label(name)):
             self.storage.delete_node(node.id)
         self.storage.delete_node(meta_id)
+        self._clear_search_cache()
         with self._lock:
             self.vector_registry.drop(self._space_key(name))
             self._raw.pop(name, None)
@@ -199,6 +214,10 @@ class QdrantCompat:
                 else:
                     raise QdrantError(f"unknown alias action {act!r}")
             self._save_aliases(aliases)
+        # an alias re-point changes what a cached search request bytes
+        # resolve to — serving the old target for the TTL would break
+        # the canonical blue/green alias-swap pattern
+        self._clear_search_cache()
         return True
 
     def list_aliases(
@@ -564,6 +583,18 @@ class QdrantCompat:
         if not vector:
             raise QdrantError("search vector is required")
         name = self.resolve(name)
+        # bool() on the selectors: REST clients may pass list/dict
+        # selectors (unhashable), and _point_dict only uses truthiness
+        cache_key = (
+            name, bytes(np.asarray(vector, np.float32).data), limit,
+            bool(with_payload), bool(with_vector), score_threshold,
+            None if query_filter is None
+            else json.dumps(query_filter, sort_keys=True, default=str),
+        )
+        cached = self._search_cache.get(cache_key)
+        if cached is not None:
+            return [self._copy_hit(d) for d in cached]
+        gen_at_miss = self.cache_gen
         meta = self._meta(name)
         distance = meta.properties.get("config", {}).get("distance", "Cosine")
         if distance == "Cosine":
@@ -593,7 +624,11 @@ class QdrantCompat:
             out.append(d)
             if len(out) >= limit:
                 break
-        return out
+        if self.cache_gen == gen_at_miss:
+            # unchanged generation: no invalidation raced this compute,
+            # so the result can't be pinning pre-write state
+            self._search_cache.put(cache_key, out)
+        return [self._copy_hit(d) for d in out]
 
     def _ranked_cosine(self, name: str, vector: Sequence[float]):
         """Yield (node_id, cosine) best-first, progressively widening the
@@ -635,9 +670,29 @@ class QdrantCompat:
             self._raw[name] = (ids, m)
         return ids, m
 
+    def _clear_search_cache(self) -> None:
+        with self._lock:  # unlocked += can lose a concurrent bump
+            self.cache_gen += 1
+        self._search_cache.clear()
+
+    @staticmethod
+    def _copy_hit(d: Dict[str, Any]) -> Dict[str, Any]:
+        """Cache-safe copy: _point_dict shares the node's payload dict
+        by reference, so a caller mutating hit['payload'] must not
+        rewrite the cached entry."""
+        import copy as _copy
+
+        c = dict(d)
+        if "payload" in c:
+            c["payload"] = _copy.deepcopy(c["payload"])
+        if "vector" in c:
+            c["vector"] = list(c["vector"])
+        return c
+
     def _invalidate_raw(self, name: str) -> None:
         with self._lock:
             self._raw.pop(name, None)
+        self._clear_search_cache()
 
     def _ranked_raw(self, name: str, vector: Sequence[float], distance: str):
         """Dot / Euclid over the raw (unnormalized) client vectors.

@@ -411,7 +411,15 @@ class OfficialPointsServicer:
     """qdrant.Points (reference: points_service.go)."""
 
     def __init__(self, compat):
+        from nornicdb_tpu.cache import LRUCache
+
         self.compat = compat
+        # raw-bytes Search cache: request bytes -> (compat generation,
+        # serialized response). On a hit the server does ZERO protobuf
+        # work — the analog of the reference serving its hot search
+        # surface from the shared result cache (search.go:88-92)
+        self._wire_cache: LRUCache = LRUCache(max_size=512,
+                                              ttl_seconds=300.0)
 
     # -- helpers --------------------------------------------------------
 
@@ -501,6 +509,19 @@ class OfficialPointsServicer:
             time=time.time() - t0,
         )
 
+    def _search_wire(self, data: bytes, context):
+        """Raw-bytes Search entrypoint (request_deserializer=None):
+        identical request bytes against an unchanged collection return
+        the cached serialized response without touching protobuf."""
+        gen = getattr(self.compat, "cache_gen", 0)
+        hit = self._wire_cache.get(data)
+        if hit is not None and hit[0] == gen:
+            return hit[1]
+        resp = self.Search(q.SearchPoints.FromString(data), context)
+        out = resp.SerializeToString()
+        self._wire_cache.put(data, (gen, out))
+        return out
+
     def Search(self, request, context):
         t0 = time.time()
         offset = int(request.offset) if request.HasField("offset") else 0
@@ -588,7 +609,10 @@ class OfficialPointsServicer:
                 "Upsert": _unary(self.Upsert, q.UpsertPoints),
                 "Delete": _unary(self.Delete, q.DeletePoints),
                 "Get": _unary(self.Get, q.GetPoints),
-                "Search": _unary(self.Search, q.SearchPoints),
+                # raw-bytes handler: no deserializer/serializer, so a
+                # wire-cache hit skips protobuf entirely
+                "Search": grpc.unary_unary_rpc_method_handler(
+                    self._search_wire),
                 "Scroll": _unary(self.Scroll, q.ScrollPoints),
                 "Count": _unary(self.Count, q.CountPoints),
             },

@@ -47,11 +47,23 @@ def tokenize(text: str, min_len: int = 2, max_len: int = 40) -> List[str]:
 
 
 class _Posting:
-    __slots__ = ("doc_ids", "tfs")
+    __slots__ = ("doc_ids", "tfs", "_np_ids", "_np_tfs")
 
     def __init__(self):
         self.doc_ids: List[int] = []
         self.tfs: List[int] = []
+        self._np_ids: Optional[np.ndarray] = None
+        self._np_tfs: Optional[np.ndarray] = None
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Cached numpy views of the posting — rebuilding them from the
+        Python lists on every query dominated search wall-clock. The
+        cache key is the list length (postings only ever append; compaction
+        swaps in fresh _Posting objects)."""
+        if self._np_ids is None or self._np_ids.size != len(self.doc_ids):
+            self._np_ids = np.asarray(self.doc_ids, dtype=np.int64)
+            self._np_tfs = np.asarray(self.tfs, dtype=np.float32)
+        return self._np_ids, self._np_tfs
 
 
 class BM25Index:
@@ -66,6 +78,18 @@ class BM25Index:
         self._alive: List[bool] = []
         self._total_len = 0
         self._n_alive = 0
+        # cached numpy doc_len/alive, invalidated by generation counter
+        self._mut_gen = 0
+        self._np_gen = -1
+        self._np_doc_len: Optional[np.ndarray] = None
+        self._np_alive: Optional[np.ndarray] = None
+
+    def _np_state(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._np_gen != self._mut_gen:
+            self._np_doc_len = np.asarray(self._doc_len, dtype=np.float32)
+            self._np_alive = np.asarray(self._alive, dtype=bool)
+            self._np_gen = self._mut_gen
+        return self._np_doc_len, self._np_alive
 
     # -- indexing --------------------------------------------------------
 
@@ -74,6 +98,7 @@ class BM25Index:
             if doc_id in self._int_of:
                 self._remove_locked(doc_id)
             self._maybe_compact_locked()
+            self._mut_gen += 1
             toks = tokenize(text)
             idx = len(self._ext_ids)
             self._ext_ids.append(doc_id)
@@ -101,6 +126,7 @@ class BM25Index:
         idx = self._int_of.pop(doc_id, None)
         if idx is None or not self._alive[idx]:
             return
+        self._mut_gen += 1
         self._alive[idx] = False
         self._total_len -= self._doc_len[idx]
         self._n_alive -= 1
@@ -139,6 +165,7 @@ class BM25Index:
         self._alive = [True] * len(new_ext)
         self._int_of = {e: i for i, e in enumerate(new_ext)}
         self._postings = new_postings
+        self._mut_gen += 1
 
     def __contains__(self, doc_id: str) -> bool:
         with self._lock:
@@ -169,15 +196,13 @@ class BM25Index:
             n_docs = len(self._ext_ids)
             avgdl = max(self._total_len / max(self._n_alive, 1), 1.0)
             scores = np.zeros(n_docs, dtype=np.float32)
-            doc_len = np.asarray(self._doc_len, dtype=np.float32)
+            doc_len, alive = self._np_state()
             touched = np.zeros(n_docs, dtype=bool)
-            alive = np.asarray(self._alive, dtype=bool)
             for t in toks:
                 p = self._postings.get(t)
                 if p is None:
                     continue
-                ids = np.asarray(p.doc_ids, dtype=np.int64)
-                tfs = np.asarray(p.tfs, dtype=np.float32)
+                ids, tfs = p.arrays()
                 # df over LIVE postings only: a tombstoned slot (re-index
                 # leaves one) must not inflate df — with few docs that
                 # flips idf negative and hits get min_score-filtered

@@ -1,0 +1,123 @@
+"""Micro-batching aggregation for concurrent single-query kNN.
+
+SURVEY §7 names this the hard part of the TPU design: a single b=1
+query cannot feed the MXU, so the device path only wins at batch — and
+a serving workload is exactly many concurrent b=1 queries. This
+coalescer turns them into device-sized batches (reference analog: the
+strategy machine's batch thresholds, search.go:528-535; the reference
+never needed the window because its per-query CPU/GPU dispatch is
+cheap, while a device dispatch here costs ~100us+).
+
+Design: adaptive leader election instead of a timed window. The first
+idle request becomes the leader of the next batch and runs immediately
+(ZERO added latency when the service is idle); requests arriving while
+a batch is in flight queue up and are drained as ONE batched call by
+the next leader. Under load the batch size self-tunes to the arrival
+rate; there is no artificial sleep to tune.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Sequence, Tuple
+
+import numpy as np
+
+
+class _Req:
+    __slots__ = ("vec", "k", "done", "result", "error")
+
+    def __init__(self, vec: np.ndarray, k: int):
+        self.vec = vec
+        self.k = k
+        self.done = False
+        self.result: Any = None
+        self.error: Any = None
+
+
+class MicroBatcher:
+    """Coalesces concurrent ``search(vec, k)`` calls into
+    ``search_batch(queries[B,D], k_max)`` calls.
+
+    ``search_batch`` must return one result list per query row. Results
+    for a request asking k smaller than the batch max are truncated."""
+
+    def __init__(
+        self,
+        search_batch: Callable[[np.ndarray, int], List[List[Tuple[str, float]]]],
+        max_batch: int = 64,
+        gather_window_s: float = 0.0005,
+    ):
+        self._search_batch = search_batch
+        self._max_batch = max_batch
+        # when the PREVIOUS batch was concurrent, the next leader waits
+        # up to this long for stragglers that are mid-return from that
+        # batch — without it, mean batch size collapses to ~half the
+        # client count. An idle service (last batch = 1) never waits.
+        self._gather_window_s = gather_window_s
+        self._last_batch = 1
+        self._cond = threading.Condition()
+        self._pending: List[_Req] = []
+        self._busy = False
+        # observability: how well the window is aggregating
+        self.batches = 0
+        self.batched_queries = 0
+
+    def search(self, vec: Sequence[float], k: int) -> List[Tuple[str, float]]:
+        req = _Req(np.asarray(vec, np.float32), k)
+        with self._cond:
+            self._pending.append(req)
+        while True:
+            batch: List[_Req] = []
+            with self._cond:
+                while not req.done and self._busy:
+                    self._cond.wait(timeout=30.0)
+                if req.done:
+                    break
+                # leader candidate: if the service just served a
+                # concurrent batch, give its returning clients one short
+                # window to re-enqueue before sealing this batch
+                if (self._gather_window_s > 0.0
+                        and self._last_batch >= 2
+                        and len(self._pending)
+                        < min(self._last_batch, self._max_batch)):
+                    self._cond.wait(timeout=self._gather_window_s)
+                    if req.done:
+                        break
+                    if self._busy:
+                        continue  # another thread led while we waited
+                # idle and our request unserved: lead the next batch
+                batch = self._pending[: self._max_batch]
+                del self._pending[: len(batch)]
+                if not batch:
+                    # taken by another leader but not done yet — loop
+                    continue
+                self._busy = True
+            try:
+                self._run(batch)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+            if req.done:
+                break
+            # our request was queued behind this batch — go again
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _run(self, batch: List[_Req]) -> None:
+        try:
+            self.batches += 1
+            self.batched_queries += len(batch)
+            self._last_batch = len(batch)
+            k_max = max(r.k for r in batch)
+            queries = np.stack([r.vec for r in batch])
+            results = self._search_batch(queries, k_max)
+            for r, res in zip(batch, results):
+                r.result = res[: r.k] if r.k < k_max else res
+        except Exception as exc:  # noqa: BLE001 — delivered per-request
+            for r in batch:
+                r.error = exc
+        for r in batch:
+            r.done = True

@@ -27,6 +27,20 @@ from nornicdb_tpu.storage.types import Engine, Node
 TEXT_PROPERTIES = ("content", "title", "name", "description", "text", "summary")
 
 
+def _copy_hit(r: Dict[str, Any]) -> Dict[str, Any]:
+    """Cache-safe copy of one search hit: the nested properties/labels
+    come from the node BY REFERENCE (to_dict), so a shallow dict() would
+    let a caller's mutation poison the cached entry for the whole TTL."""
+    import copy as _copy
+
+    c = dict(r)
+    if "properties" in c:
+        c["properties"] = _copy.deepcopy(c["properties"])
+    if "labels" in c:
+        c["labels"] = list(c["labels"])
+    return c
+
+
 def extract_text(node: Node) -> str:
     """Searchable text from a node (reference: pkg/indexing
     ExtractSearchableText — title/content-ish properties + labels)."""
@@ -65,6 +79,7 @@ class SearchStats:
     indexed_vectors: int = 0
     strategy: str = "brute"
     searches: int = 0
+    cache_hits: int = 0
     hnsw_builds: int = 0
     # per-stage timings of the most recent search, populated when
     # NORNICDB_TPU_SEARCH_DIAG is set (reference:
@@ -112,6 +127,19 @@ class SearchService:
         self._hnsw_m = hnsw_m
         self._hnsw_ef = hnsw_ef_search
         self.stats = SearchStats()
+        # Search() result cache, query+options keyed — same semantics as
+        # the Cypher query cache and as the reference's
+        # searchResultCache (search.go:88-92,295-301,680: LRU 1000,
+        # 5-min TTL, shared by every public search entrypoint,
+        # invalidated on index mutation)
+        from nornicdb_tpu.cache import LRUCache
+
+        self._result_cache: LRUCache = LRUCache(max_size=1000,
+                                                ttl_seconds=300.0)
+        # generation guard: a search that read pre-write index state
+        # must not put its result AFTER a mutation cleared the cache
+        # (that would pin a stale result for the whole TTL)
+        self._result_cache_gen = 0
         # index persistence: debounced saves + load-on-open so a restart
         # skips the rebuild (reference: search.go:496-507, versioned
         # persisted indexes + resumeVectorBuild search.go:432)
@@ -121,6 +149,19 @@ class SearchService:
         self._save_lock = threading.Lock()  # serializes snapshot writers
         self._saved_at_ms = 0
         self._closed = False
+
+        # concurrent b=1 vector queries coalesce into one batched device
+        # call (SURVEY §7: "batched query aggregation, or the TPU path
+        # only wins at batch/scale")
+        from nornicdb_tpu.search.microbatch import MicroBatcher
+
+        self._microbatch = MicroBatcher(
+            lambda queries, k: self.vectors.search_batch(queries, k))
+
+    def _clear_result_cache(self) -> None:
+        with self._lock:  # unlocked += can lose a concurrent bump
+            self._result_cache_gen += 1
+        self._result_cache.clear()
 
     # -- indexing ---------------------------------------------------------
 
@@ -155,6 +196,7 @@ class SearchService:
             self.stats.indexed_docs = len(self.bm25)
             self.stats.indexed_vectors = len(self.vectors)
             self._maybe_switch_strategy()
+        self._clear_result_cache()
         self._schedule_save()
 
     def remove_node(self, node_id: str) -> None:
@@ -167,6 +209,7 @@ class SearchService:
                     self._rebuild_hnsw()
             self.stats.indexed_docs = len(self.bm25)
             self.stats.indexed_vectors = len(self.vectors)
+        self._clear_result_cache()
         self._schedule_save()
 
     def build_indexes(self) -> int:
@@ -410,7 +453,10 @@ class SearchService:
         if lexical_doc_ids and hasattr(self.vectors, "route"):
             return self.vectors.search(query_vec, k,
                                        lexical_doc_ids=lexical_doc_ids)
-        return self.vectors.search(query_vec, k)
+        if hasattr(self.vectors, "search_batch"):
+            # micro-batched: concurrent singles ride one device call
+            return self._microbatch.search(query_vec, k)
+        return self.vectors.search(query_vec, k)  # IVF backends
 
     def search(
         self,
@@ -423,16 +469,32 @@ class SearchService:
         labels: Optional[Sequence[str]] = None,
     ) -> List[Dict[str, Any]]:
         """Hybrid search (reference: Service.Search search.go:2841):
-        BM25 + vector candidate lists fused with RRF, enriched from storage."""
+        BM25 + vector candidate lists fused with RRF, enriched from storage.
+        Results are cached by query+options (reference: search.go:2853-2856
+        cacheKey Get/Put) and invalidated on any index mutation."""
         self.stats.searches += 1
         # opt-in per-stage timing diagnostics (reference:
         # NORNICDB_SEARCH_DIAG_TIMINGS, server_nornicdb.go:282-286);
-        # recorded on stats.last_timings for /status and log inspection
+        # recorded on stats.last_timings for /status and log inspection.
+        # Stale-timing clearing runs BEFORE the cache probe so a cache
+        # hit can't serve timings from a prior diag run forever.
         from nornicdb_tpu.config import env_bool
 
         diag = env_bool("TPU_SEARCH_DIAG")
         if not diag and self.stats.last_timings:
             self.stats.last_timings = {}  # never serve stale timings
+        # explicit query embeddings are unhashable request-local state;
+        # those requests bypass the cache (the reference keys only on
+        # query text + options too)
+        cache_key = None
+        if query_embedding is None and self.reranker is None:
+            cache_key = (query, limit, mode, min_score, enrich,
+                         tuple(labels) if labels else None)
+            cached = self._result_cache.get(cache_key)
+            if cached is not None:
+                self.stats.cache_hits += 1
+                return [_copy_hit(r) for r in cached]
+            gen_at_miss = self._result_cache_gen
         timings: Dict[str, float] = {}
         t0 = time.perf_counter() if diag else 0.0
         overfetch = max(limit * 3, 30)
@@ -515,4 +577,10 @@ class SearchService:
         if diag:
             timings["enrich_rerank_ms"] = (time.perf_counter() - t0) * 1e3
             self.stats.last_timings = timings
-        return out[:limit]
+        out = out[:limit]
+        if cache_key is not None:
+            if self._result_cache_gen == gen_at_miss:
+                # no index mutation raced this compute
+                self._result_cache.put(cache_key, out)
+            return [_copy_hit(r) for r in out]
+        return out

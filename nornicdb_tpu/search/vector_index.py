@@ -183,12 +183,11 @@ class BruteForceIndex:
                 return [[] for _ in range(len(queries))]
             k_eff = min(k, self._n_alive)
             if self._capacity * (self.dims or 1) <= self._SMALL_HOST:
-                mh = self._matrix.copy()
-                vh = self._valid.copy()
-                ext_ids = list(self._ext_ids)
+                # no defensive copies: the whole host search runs under
+                # the lock and only reads the matrix/valid/ext_ids
                 return self._search_host(
-                    np.asarray(queries, np.float32), mh, vh, ext_ids,
-                    k_eff)
+                    np.asarray(queries, np.float32), self._matrix,
+                    self._valid, self._ext_ids, k_eff)
             m, valid = self._device_arrays()
             ext_ids = list(self._ext_ids)
         q = l2_normalize(jnp.asarray(queries, dtype=jnp.float32))

@@ -230,6 +230,20 @@ class AsyncEngine(EngineDecorator):
                     base.pop(nid, None)
         return [n.copy() for n in base.values()]
 
+    def node_ids_by_label(self, label: str) -> List[NodeID]:
+        ids = set(self.inner.node_ids_by_label(label))
+        with self._lock:
+            overlay = dict(self._nodes)
+        for nid, ov in overlay.items():
+            if ov is _TOMBSTONE:
+                ids.discard(nid)
+            elif isinstance(ov, Node):
+                if label in ov.labels:
+                    ids.add(nid)
+                else:
+                    ids.discard(nid)
+        return list(ids)
+
     def all_nodes(self) -> Iterable[Node]:
         base = {n.id: n for n in self.inner.all_nodes()}
         with self._lock:

@@ -354,9 +354,12 @@ class DiskEngine(Engine):
         self._maybe_compact()
 
     def get_nodes_by_label(self, label: str) -> List[Node]:
+        return [n for n in self.batch_get_nodes(self.node_ids_by_label(label))
+                if n is not None]
+
+    def node_ids_by_label(self, label: str) -> List[NodeID]:
         prefix = b"l:" + label.encode() + _SEP
-        ids = [k[len(prefix):].decode() for k, _ in self.kv.scan(prefix)]
-        return [n for n in self.batch_get_nodes(ids) if n is not None]
+        return [k[len(prefix):].decode() for k, _ in self.kv.scan(prefix)]
 
     def all_nodes(self) -> Iterable[Node]:
         for _, raw in self.kv.scan(b"n:"):

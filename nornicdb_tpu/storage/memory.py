@@ -95,6 +95,11 @@ class MemoryEngine(Engine):
         with self._lock:
             return len(self._by_label.get(label, ()))
 
+    def node_ids_by_label(self, label: str) -> List[NodeID]:
+        with self._lock:
+            ids = self._by_label.get(label, set())
+            return [i for i in ids if i in self._nodes]
+
     def all_nodes(self) -> Iterable[Node]:
         with self._lock:
             return [n.copy() for n in self._nodes.values()]

@@ -158,6 +158,13 @@ class Engine(ABC):
     @abstractmethod
     def get_nodes_by_label(self, label: str) -> List[Node]: ...
 
+    def node_ids_by_label(self, label: str) -> List[NodeID]:
+        """IDs only — lets paged readers (GraphQL nodes(label:), UI
+        listings) sort/slice on ids and fetch just one page instead of
+        copying every labeled node. Engines with a label index override
+        with a key-only path."""
+        return [n.id for n in self.get_nodes_by_label(label)]
+
     @abstractmethod
     def all_nodes(self) -> Iterable[Node]: ...
 
@@ -307,6 +314,9 @@ class EngineDecorator(Engine):
 
     def get_nodes_by_label(self, label: str) -> List[Node]:
         return self.inner.get_nodes_by_label(label)
+
+    def node_ids_by_label(self, label: str) -> List[NodeID]:
+        return self.inner.node_ids_by_label(label)
 
     def all_nodes(self) -> Iterable[Node]:
         return self.inner.all_nodes()

@@ -1,0 +1,217 @@
+"""Search result-cache semantics (round 5 surface work).
+
+The search service and the qdrant compat layer cache results the way
+the reference does (search.go:88-92: LRU 1000, 5-min TTL, every public
+entrypoint, invalidated on mutation). These tests pin the part that's
+easy to get wrong: invalidation — a cached result must never outlive
+the index state it was computed from.
+"""
+
+import numpy as np
+
+from nornicdb_tpu.api.qdrant import QdrantCompat
+from nornicdb_tpu.search.service import SearchService
+from nornicdb_tpu.storage.memory import MemoryEngine
+from nornicdb_tpu.storage.types import Node
+
+
+def _node(nid, text, vec):
+    return Node(id=nid, labels=["Doc"],
+                properties={"content": text}, embedding=vec)
+
+
+class TestServiceResultCache:
+    def _svc(self):
+        eng = MemoryEngine()
+        svc = SearchService(storage=eng)
+        return svc, eng
+
+    def test_repeat_search_hits_cache(self):
+        svc, eng = self._svc()
+        n = _node("a", "oslo capital norway", [1.0, 0.0])
+        eng.create_node(n)
+        svc.index_node(n)
+        first = svc.search("oslo", limit=5)
+        assert [h["id"] for h in first] == ["a"]
+        before = svc.stats.cache_hits
+        again = svc.search("oslo", limit=5)
+        assert again == first
+        assert svc.stats.cache_hits == before + 1
+
+    def test_index_mutation_invalidates(self):
+        svc, eng = self._svc()
+        a = _node("a", "oslo capital norway", [1.0, 0.0])
+        eng.create_node(a)
+        svc.index_node(a)
+        assert [h["id"] for h in svc.search("oslo", limit=5)] == ["a"]
+        b = _node("b", "oslo fjord oslo oslo", [0.9, 0.1])
+        eng.create_node(b)
+        svc.index_node(b)
+        ids = [h["id"] for h in svc.search("oslo", limit=5)]
+        assert "b" in ids, "cached result served after index mutation"
+
+    def test_remove_invalidates(self):
+        svc, eng = self._svc()
+        a = _node("a", "oslo capital", [1.0, 0.0])
+        eng.create_node(a)
+        svc.index_node(a)
+        assert svc.search("oslo", limit=5)
+        svc.remove_node("a")
+        assert svc.search("oslo", limit=5) == []
+
+    def test_cached_results_are_mutation_safe(self):
+        svc, eng = self._svc()
+        a = _node("a", "oslo capital", [1.0, 0.0])
+        eng.create_node(a)
+        svc.index_node(a)
+        first = svc.search("oslo", limit=5)
+        first[0]["id"] = "tampered"
+        assert svc.search("oslo", limit=5)[0]["id"] == "a"
+
+    def test_explicit_embedding_bypasses_cache(self):
+        svc, eng = self._svc()
+        a = _node("a", "oslo capital", [1.0, 0.0])
+        eng.create_node(a)
+        svc.index_node(a)
+        r1 = svc.search("oslo", limit=5,
+                        query_embedding=np.asarray([1.0, 0.0]))
+        assert [h["id"] for h in r1] == ["a"]
+        # different embedding, same text: must not serve the cached r1
+        r2 = svc.search("oslo", limit=5,
+                        query_embedding=np.asarray([-1.0, 0.0]))
+        assert r1 != r2 or r2 == []
+
+
+class TestQdrantSearchCache:
+    def _compat(self):
+        c = QdrantCompat(MemoryEngine())
+        c.create_collection("a", {"size": 2, "distance": "Cosine"})
+        c.create_collection("b", {"size": 2, "distance": "Cosine"})
+        c.upsert_points("a", [{"id": 1, "vector": [1.0, 0.0],
+                               "payload": {"src": "a"}}])
+        c.upsert_points("b", [{"id": 2, "vector": [1.0, 0.0],
+                               "payload": {"src": "b"}}])
+        return c
+
+    def test_alias_swap_invalidates(self):
+        c = self._compat()
+        c.update_aliases([{"create": {"alias": "al", "collection": "a"}}])
+        hits = c.search_points("al", [1.0, 0.0], limit=1)
+        assert hits[0]["payload"]["src"] == "a"
+        # blue/green swap: re-point the alias — the cached response for
+        # identical request args must not keep serving collection a
+        c.update_aliases([{"delete": {"alias": "al"}},
+                          {"create": {"alias": "al", "collection": "b"}}])
+        hits = c.search_points("al", [1.0, 0.0], limit=1)
+        assert hits[0]["payload"]["src"] == "b"
+
+    def test_upsert_invalidates(self):
+        c = self._compat()
+        assert len(c.search_points("a", [0.0, 1.0], limit=5)) == 1
+        c.upsert_points("a", [{"id": 9, "vector": [0.0, 1.0],
+                               "payload": {"src": "new"}}])
+        hits = c.search_points("a", [0.0, 1.0], limit=5)
+        assert hits[0]["payload"]["src"] == "new"
+
+    def test_delete_points_invalidates(self):
+        c = self._compat()
+        assert c.search_points("a", [1.0, 0.0], limit=5)
+        c.delete_points("a", [1])
+        assert c.search_points("a", [1.0, 0.0], limit=5) == []
+
+    def test_list_payload_selector_is_hashable(self):
+        """REST clients may pass list/dict selectors; the cache key must
+        not choke on them (they select by truthiness here)."""
+        c = self._compat()
+        hits = c.search_points("a", [1.0, 0.0], limit=1,
+                               with_payload=["src"])
+        assert hits[0]["payload"]["src"] == "a"
+        hits = c.search_points("a", [1.0, 0.0], limit=1,
+                               with_payload={"include": ["src"]})
+        assert hits[0]["payload"]["src"] == "a"
+
+    def test_cached_results_are_mutation_safe(self):
+        c = self._compat()
+        first = c.search_points("a", [1.0, 0.0], limit=1)
+        first[0]["id"] = "tampered"
+        assert c.search_points("a", [1.0, 0.0], limit=1)[0]["id"] == 1
+
+    def test_grpc_wire_cache_generation(self):
+        """The raw-bytes gRPC Search cache validates against the compat
+        generation counter."""
+        from nornicdb_tpu.api.proto import qdrant_pb2 as q
+        from nornicdb_tpu.api.qdrant_official_grpc import (
+            OfficialPointsServicer,
+        )
+
+        c = self._compat()
+        svc = OfficialPointsServicer(c)
+        sr = q.SearchPoints(collection_name="a", vector=[1.0, 0.0],
+                            limit=1)
+        data = sr.SerializeToString()
+        r1 = q.SearchResponse.FromString(svc._search_wire(data, None))
+        assert r1.result[0].id.num == 1
+        # cache hit returns identical bytes
+        assert svc._search_wire(data, None) == r1.SerializeToString()
+        # mutation bumps the generation; same bytes recompute
+        c.upsert_points("a", [{"id": 7, "vector": [1.0, 0.0],
+                               "payload": {}}])
+        r2 = q.SearchResponse.FromString(svc._search_wire(data, None))
+        assert len(r2.result) == 1  # limit 1, but recomputed fresh
+
+
+class TestNestedMutationSafety:
+    """Shallow copies are not enough: properties/payload are shared by
+    reference from the node, so nested mutation must not poison the
+    cached entry (review finding, r5)."""
+
+    def test_service_nested_properties_safe(self):
+        eng = MemoryEngine()
+        svc = SearchService(storage=eng)
+        n = Node(id="a", labels=["Doc"],
+                 properties={"content": "oslo", "meta": {"k": 1}},
+                 embedding=[1.0, 0.0])
+        eng.create_node(n)
+        svc.index_node(n)
+        first = svc.search("oslo", limit=5)
+        first[0]["properties"]["meta"]["k"] = 999
+        first[0]["labels"].append("Tampered")
+        again = svc.search("oslo", limit=5)
+        assert again[0]["properties"]["meta"]["k"] == 1
+        assert again[0]["labels"] == ["Doc"]
+
+    def test_qdrant_nested_payload_safe(self):
+        c = QdrantCompat(MemoryEngine())
+        c.create_collection("a", {"size": 2, "distance": "Cosine"})
+        c.upsert_points("a", [{"id": 1, "vector": [1.0, 0.0],
+                               "payload": {"tags": ["x"]}}])
+        first = c.search_points("a", [1.0, 0.0], limit=1)
+        first[0]["payload"]["tags"].append("tampered")
+        again = c.search_points("a", [1.0, 0.0], limit=1)
+        assert again[0]["payload"]["tags"] == ["x"]
+
+
+class TestIvfBackendStillSearches:
+    """The micro-batcher only applies to indexes with search_batch; IVF
+    backends must keep working through the plain path."""
+
+    def test_vector_search_with_ivf_style_index(self):
+        class FakeIvf:
+            """search() only — like IVFHNSWIndex / IVFPQIndex."""
+
+            def __init__(self):
+                self.calls = 0
+
+            def __len__(self):
+                return 3
+
+            def search(self, vec, k):
+                self.calls += 1
+                return [("x", 0.9)][:k]
+
+        svc = SearchService(storage=MemoryEngine())
+        svc.vectors = FakeIvf()
+        hits = svc.vector_search_candidates(
+            np.asarray([1.0, 0.0], np.float32), k=1)
+        assert hits == [("x", 0.9)]
+        assert svc.vectors.calls == 1
