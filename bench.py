@@ -563,11 +563,15 @@ def _bench_surfaces(n_people: int = 1000, secs: float = 2.0,
                             vector=list(target.embedding), limit=5)
 
         def grpc_worker():
-            stub = ch.unary_unary(
+            # per-worker channel: one shared channel would multiplex all
+            # workers over a single TCP connection, unlike every other
+            # surface (and unlike the reference's per-worker clients)
+            wch = grpc.insecure_channel(grpc_srv.address)
+            stub = wch.unary_unary(
                 "/qdrant.Points/Search",
                 request_serializer=lambda r: r.SerializeToString(),
                 response_deserializer=q.SearchResponse.FromString)
-            return (lambda: stub(sr)), (lambda: None)
+            return (lambda: stub(sr)), wch.close
 
         out["qdrant_grpc"] = sustain(grpc_worker)
     finally:

@@ -1,4 +1,5 @@
-// Native HNSW connect phase (diversity-select + link + back-link prune).
+// Native HNSW build kernels: wave layer-search + connect phase
+// (diversity-select + link + back-link prune).
 //
 // The wave build (nornicdb_tpu/search/hnsw.py) vectorizes beam SEARCH
 // across a whole wave with numpy einsums, which leaves the LINK phase —
@@ -25,6 +26,8 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <limits>
+#include <queue>
 #include <vector>
 
 namespace {
@@ -120,9 +123,108 @@ void add_link(const float* vectors, int64_t dims, int32_t* nbr,
     set_row(nbr, cnt, width, c, out.data(), kept);
 }
 
+using DistSlot = std::pair<float, int64_t>;
+
+// Classic HNSW layer search (searchLayer of the paper; the wave
+// builder's per-query form). Entries seed both heaps; every candidate
+// expansion is bounded by the current worst result once the result set
+// is full. Results land in `out`, ascending by distance.
+void search_layer_classic(const float* vectors, int64_t dims,
+                          const float* q, const int32_t* nbr,
+                          const int32_t* cnt, int64_t width,
+                          const std::vector<DistSlot>& entries, int64_t ef,
+                          std::vector<int32_t>& visited, int32_t genv,
+                          std::vector<DistSlot>& out) {
+    std::priority_queue<DistSlot> result;  // max-heap: top = worst kept
+    std::priority_queue<DistSlot, std::vector<DistSlot>,
+                        std::greater<DistSlot>> cands;  // min-heap
+    for (const auto& e : entries) {
+        visited[e.second] = genv;
+        result.push(e);
+        cands.push(e);
+    }
+    while (result.size() > static_cast<size_t>(ef)) result.pop();
+    while (!cands.empty()) {
+        DistSlot c = cands.top();
+        if (result.size() >= static_cast<size_t>(ef) &&
+            c.first > result.top().first)
+            break;
+        cands.pop();
+        const int32_t* row = nbr + c.second * width;
+        int32_t n = cnt[c.second];
+        for (int32_t i = 0; i < n; ++i) {
+            int64_t s = row[i];
+            if (visited[s] == genv) continue;
+            visited[s] = genv;
+            float d = 1.0f - dot(q, vectors + s * dims, dims);
+            if (result.size() < static_cast<size_t>(ef) ||
+                d < result.top().first) {
+                cands.emplace(d, s);
+                result.emplace(d, s);
+                if (result.size() > static_cast<size_t>(ef)) result.pop();
+            }
+        }
+    }
+    out.resize(result.size());
+    for (int64_t i = static_cast<int64_t>(result.size()) - 1; i >= 0; --i) {
+        out[i] = result.top();
+        result.pop();
+    }
+}
+
 }  // namespace
 
 extern "C" {
+
+// Wave layer-search for the bulk build: for each of B queries, greedy-
+// descend from the global entry through levels above the query's level,
+// then collect an ef-beam at every level from min(query_level, top)
+// down to 0. Outputs land in [B, n_levels, ef] arrays (slot -1 / dist
+// +inf padded), ascending by distance per (query, level) — exactly the
+// per-level candidate lists hnsw.py's connect phase consumes.
+//
+// The graph traversed is the PRE-WAVE adjacency (wave slots exist in
+// `vectors` but have no links yet), matching the Python wave builder.
+void hnsw_wave_search(const float* vectors, int64_t dims,
+                      const int32_t* const* nbrs,
+                      const int32_t* const* cnts, const int64_t* widths,
+                      int64_t n_levels, const float* queries, int64_t B,
+                      const int64_t* query_levels, int64_t entry_slot,
+                      int64_t ef, int64_t capacity, int64_t* out_slots,
+                      float* out_dists) {
+    const float INF = std::numeric_limits<float>::infinity();
+    std::vector<int32_t> visited(capacity, 0);
+    int32_t gen = 0;
+    std::vector<DistSlot> beam, next;
+    std::fill(out_slots, out_slots + B * n_levels * ef, int64_t{-1});
+    std::fill(out_dists, out_dists + B * n_levels * ef, INF);
+    for (int64_t j = 0; j < B; ++j) {
+        const float* q = queries + j * dims;
+        beam.assign(
+            1, {1.0f - dot(q, vectors + entry_slot * dims, dims),
+                entry_slot});
+        int64_t top = std::min(query_levels[j], n_levels - 1);
+        for (int64_t lv = n_levels - 1; lv > top; --lv) {
+            ++gen;
+            search_layer_classic(vectors, dims, q, nbrs[lv], cnts[lv],
+                                 widths[lv], beam, 1, visited, gen, next);
+            beam.swap(next);
+        }
+        for (int64_t lv = top; lv >= 0; --lv) {
+            ++gen;
+            search_layer_classic(vectors, dims, q, nbrs[lv], cnts[lv],
+                                 widths[lv], beam, ef, visited, gen, next);
+            beam.swap(next);
+            int64_t* os = out_slots + (j * n_levels + lv) * ef;
+            float* od = out_dists + (j * n_levels + lv) * ef;
+            int64_t k = std::min<int64_t>(beam.size(), ef);
+            for (int64_t i = 0; i < k; ++i) {
+                od[i] = beam[i].first;
+                os[i] = beam[i].second;
+            }
+        }
+    }
+}
 
 // Connect a wave's nodes at ONE level. Candidates arrive flattened:
 // node i's sorted-by-distance candidates are

@@ -46,7 +46,7 @@ class QdrantCompat:
     """Collection + point operations with Qdrant semantics."""
 
     def __init__(self, storage, vector_registry=None):
-        from nornicdb_tpu.cache import LRUCache
+        from nornicdb_tpu.cache import ResultCache
         from nornicdb_tpu.vectorspace import VectorSpaceRegistry
 
         self.storage = storage
@@ -61,15 +61,54 @@ class QdrantCompat:
         # entrypoint (REST, gRPC, qdrant) shares the service's
         # searchResultCache (search.go:88-92); the qdrant surface here
         # has its own per-collection indexes, so it carries its own
-        # cache with the same LRU-1000 / 5-min-TTL semantics,
-        # invalidated on any point or collection mutation
-        self._search_cache: LRUCache = LRUCache(max_size=1000,
-                                                ttl_seconds=300.0)
-        # bumped on every cache clear so wire-level caches (the gRPC
-        # Search raw-bytes cache) can validate entries without sharing
-        # this LRU
-        self.cache_gen = 0
+        # ResultCache with the same semantics, invalidated on any point
+        # or collection mutation. The generation is also how the gRPC
+        # raw-bytes wire cache validates its entries.
+        self._search_cache: ResultCache = ResultCache(self._copy_hit)
         self._lock = threading.Lock()
+        # depth of in-progress writes by THIS layer (thread-local): its
+        # own storage writes already maintain the indexes incrementally,
+        # so the external-mutation listener must ignore them
+        self._own = threading.local()
+
+    # -- cross-surface invalidation --------------------------------------
+
+    def _own_write(self):
+        """Context manager marking storage writes issued by this layer."""
+        compat = self
+
+        class _Ctx:
+            def __enter__(self):
+                compat._own.depth = getattr(compat._own, "depth", 0) + 1
+
+            def __exit__(self, *exc):
+                compat._own.depth -= 1
+
+        return _Ctx()
+
+    def _on_external_mutation(self, node_id: str) -> None:
+        """A qdrant-owned node changed through a NON-qdrant surface
+        (Cypher SET/DELETE over Bolt/HTTP, GDPR delete, …). The cached
+        per-collection index and any cached search results no longer
+        reflect storage — drop them so the next read rebuilds lazily.
+        (Reference analog: every mutation path invalidates the shared
+        searchResultCache, search.go:88-92.)"""
+        if getattr(self._own, "depth", 0) > 0:
+            return
+        name = None
+        if node_id.startswith(_POINT_PREFIX):
+            name = node_id[len(_POINT_PREFIX):].split("/", 1)[0]
+        elif node_id.startswith(_META_PREFIX):
+            name = node_id[len(_META_PREFIX):]
+        elif node_id != _ALIAS_META_ID:
+            return
+        with self._lock:
+            if name is not None:
+                space = self.vector_registry.get(self._space_key(name))
+                if space is not None:
+                    space.index = None
+                self._raw.pop(name, None)
+        self._clear_search_cache()
 
     def _space_key(self, name: str):
         from nornicdb_tpu.vectorspace import DEFAULT_VECTOR_NAME, SpaceKey
@@ -100,12 +139,13 @@ class QdrantCompat:
             "size": int((vectors or {}).get("size", 0)),
             "distance": distance,
         }
-        self.storage.create_node(Node(
-            id=meta_id,
-            labels=[_COLLECTION_LABEL],
-            properties={"name": name, "config": cfg,
-                        "created_at": now_ms()},
-        ))
+        with self._own_write():
+            self.storage.create_node(Node(
+                id=meta_id,
+                labels=[_COLLECTION_LABEL],
+                properties={"name": name, "config": cfg,
+                            "created_at": now_ms()},
+            ))
         with self._lock:
             self._space(name).ensure_index()
         return True
@@ -114,9 +154,10 @@ class QdrantCompat:
         meta_id = _META_PREFIX + name
         if not self.storage.has_node(meta_id):
             return False
-        for node in self.storage.get_nodes_by_label(self._label(name)):
-            self.storage.delete_node(node.id)
-        self.storage.delete_node(meta_id)
+        with self._own_write():
+            for node in self.storage.get_nodes_by_label(self._label(name)):
+                self.storage.delete_node(node.id)
+            self.storage.delete_node(meta_id)
         self._clear_search_cache()
         with self._lock:
             self.vector_registry.drop(self._space_key(name))
@@ -171,10 +212,11 @@ class QdrantCompat:
     def _save_aliases(self, aliases: Dict[str, str]) -> None:
         node = Node(id=_ALIAS_META_ID, labels=[_COLLECTION_LABEL + "Alias"],
                     properties={"aliases": aliases})
-        if self.storage.has_node(_ALIAS_META_ID):
-            self.storage.update_node(node)
-        else:
-            self.storage.create_node(node)
+        with self._own_write():
+            if self.storage.has_node(_ALIAS_META_ID):
+                self.storage.update_node(node)
+            else:
+                self.storage.create_node(node)
 
     def resolve(self, name: str) -> str:
         """Alias -> collection name (identity when not an alias).
@@ -467,24 +509,25 @@ class QdrantCompat:
                 coerced.append([])
         # pass 2: apply
         n = 0
-        for p, vec in zip(points, coerced):
-            nid = _point_node_id(name, p["id"])
-            node = Node(
-                id=nid,
-                labels=[self._label(name)],
-                properties={
-                    "_point_id": p["id"],
-                    "_vector": vec,
-                    "payload": p.get("payload") or {},
-                },
-            )
-            if self.storage.has_node(nid):
-                self.storage.update_node(node)
-            else:
-                self.storage.create_node(node)
-            if vec:
-                idx.add(nid, vec)
-            n += 1
+        with self._own_write():
+            for p, vec in zip(points, coerced):
+                nid = _point_node_id(name, p["id"])
+                node = Node(
+                    id=nid,
+                    labels=[self._label(name)],
+                    properties={
+                        "_point_id": p["id"],
+                        "_vector": vec,
+                        "payload": p.get("payload") or {},
+                    },
+                )
+                if self.storage.has_node(nid):
+                    self.storage.update_node(node)
+                else:
+                    self.storage.create_node(node)
+                if vec:
+                    idx.add(nid, vec)
+                n += 1
         if n:
             self._invalidate_raw(name)
         return n
@@ -512,12 +555,13 @@ class QdrantCompat:
         self._meta(name)
         idx = self._index(name)
         n = 0
-        for pid in ids:
-            nid = _point_node_id(name, pid)
-            if self.storage.has_node(nid):
-                self.storage.delete_node(nid)
-                idx.remove(nid)
-                n += 1
+        with self._own_write():
+            for pid in ids:
+                nid = _point_node_id(name, pid)
+                if self.storage.has_node(nid):
+                    self.storage.delete_node(nid)
+                    idx.remove(nid)
+                    n += 1
         if n:
             self._invalidate_raw(name)
         return n
@@ -591,10 +635,10 @@ class QdrantCompat:
             None if query_filter is None
             else json.dumps(query_filter, sort_keys=True, default=str),
         )
-        cached = self._search_cache.get(cache_key)
+        cached = self._search_cache.get_hits(cache_key)
         if cached is not None:
-            return [self._copy_hit(d) for d in cached]
-        gen_at_miss = self.cache_gen
+            return cached
+        gen_at_miss = self._search_cache.generation
         meta = self._meta(name)
         distance = meta.properties.get("config", {}).get("distance", "Cosine")
         if distance == "Cosine":
@@ -624,11 +668,8 @@ class QdrantCompat:
             out.append(d)
             if len(out) >= limit:
                 break
-        if self.cache_gen == gen_at_miss:
-            # unchanged generation: no invalidation raced this compute,
-            # so the result can't be pinning pre-write state
-            self._search_cache.put(cache_key, out)
-        return [self._copy_hit(d) for d in out]
+        return self._search_cache.put_guarded(cache_key, out,
+                                              gen_at_miss)
 
     def _ranked_cosine(self, name: str, vector: Sequence[float]):
         """Yield (node_id, cosine) best-first, progressively widening the
@@ -671,9 +712,11 @@ class QdrantCompat:
         return ids, m
 
     def _clear_search_cache(self) -> None:
-        with self._lock:  # unlocked += can lose a concurrent bump
-            self.cache_gen += 1
-        self._search_cache.clear()
+        self._search_cache.bump_generation()
+
+    @property
+    def cache_gen(self) -> int:
+        return self._search_cache.generation
 
     @staticmethod
     def _copy_hit(d: Dict[str, Any]) -> Dict[str, Any]:

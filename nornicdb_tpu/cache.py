@@ -101,3 +101,45 @@ class GenerationalCache(LRUCache[V]):
     def generation(self) -> int:
         with self._lock:
             return self._generation
+
+
+class ResultCache(GenerationalCache[list]):
+    """Search-result cache with the reference searchResultCache
+    semantics (search.go:88-92: LRU 1000, 5-min TTL, invalidated on any
+    index mutation), hardened two ways:
+
+    - generation-guarded puts: a compute that read pre-write state and
+      raced an invalidation must not pin its stale result for the TTL
+      (the guard and the insert run under ONE lock acquisition);
+    - a per-hit copy hook applied on every get/put return, so callers
+      can never mutate a cached entry (hits often share nested dicts
+      with live nodes by reference).
+
+    One implementation carries the search service and the qdrant layer;
+    the gRPC wire cache validates its raw-bytes entries against
+    ``generation``.
+    """
+
+    def __init__(self, copy_hit: Callable[[Any], Any],
+                 max_size: int = 1000, ttl_seconds: float = 300.0):
+        super().__init__(max_size, ttl_seconds)
+        self._copy_hit = copy_hit
+
+    def get_hits(self, key: Hashable) -> Optional[list]:
+        hits = self.get(key)
+        if hits is None:
+            return None
+        return [self._copy_hit(h) for h in hits]
+
+    def put_guarded(self, key: Hashable, hits: list,
+                    gen_at_miss: int) -> list:
+        """Insert unless an invalidation raced the compute. Returns
+        caller-safe copies either way."""
+        expires = time.monotonic() + self.ttl if self.ttl else 0.0
+        with self._lock:
+            if self._generation == gen_at_miss:
+                self._data[key] = (hits, expires)
+                self._data.move_to_end(key)
+                while len(self._data) > self.max_size:
+                    self._data.popitem(last=False)
+        return [self._copy_hit(h) for h in hits]

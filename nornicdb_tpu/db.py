@@ -27,6 +27,21 @@ from nornicdb_tpu.storage import (
 )
 
 
+class _QdrantInvalidationListener(MutationListener):
+    """Routes node mutations from ANY surface into the qdrant layer's
+    cache invalidation (qdrant.py _on_external_mutation — the layer's
+    own writes are filtered out there by a thread-local guard)."""
+
+    def __init__(self, compat):
+        self._compat = compat
+
+    def on_node_upsert(self, node: Node) -> None:
+        self._compat._on_external_mutation(node.id)
+
+    def on_node_delete(self, node_id: str) -> None:
+        self._compat._on_external_mutation(node_id)
+
+
 class DB:
     """One logical NornicDB-style database instance."""
 
@@ -360,7 +375,15 @@ class DB:
         if getattr(self, "_qdrant_compat", None) is None:
             from nornicdb_tpu.api.qdrant import QdrantCompat
 
-            self._qdrant_compat = QdrantCompat(self.storage)
+            compat = QdrantCompat(self.storage)
+            # qdrant points are ordinary storage nodes: a Cypher
+            # SET/DELETE (or GDPR delete) over any surface must
+            # invalidate the per-collection index + search caches, not
+            # just qdrant's own ops
+            listener = _QdrantInvalidationListener(compat)
+            if hasattr(self.storage, "add_listener"):
+                self.storage.add_listener(listener)
+            self._qdrant_compat = compat
         return self._qdrant_compat
 
     @property

@@ -422,6 +422,50 @@ class HNSWIndex:
 
         efc = efc or self.ef_construction
         lvq = np.asarray(levels)
+        from nornicdb_tpu.search.hnsw_native import (
+            connect_wave, get_lib, wave_search,
+        )
+
+        lib = get_lib()
+        if lib is not None and hasattr(lib, "hnsw_wave_search"):
+            # fully native search + connect: the numpy wave search's
+            # per-step glue (argpartition/where/concatenate over
+            # [B, ef+E*W] arrays) was ~70% of build wall-clock — the
+            # classic per-query heap search in C++ does the same
+            # distance evaluations with none of it
+            n_levels = min(len(self._nbrL), pre_max + 1)
+            wd, ws = wave_search(
+                lib, self._vectors, self._nbrL[:n_levels],
+                self._cntL[:n_levels], Q, lvq, pre_entry, efc,
+                self._capacity)
+            for lv in range(min(int(lvq.max()), n_levels - 1), -1, -1):
+                collect = np.nonzero(lvq >= lv)[0]
+                if len(collect) == 0:
+                    continue
+                counts = []
+                for j in collect:
+                    counts.append(int((ws[j, lv] >= 0).sum()))
+                off = np.zeros(len(collect) + 1, np.int64)
+                np.cumsum(counts, out=off[1:])
+                cs = np.empty(int(off[-1]), np.int64)
+                cd = np.empty(int(off[-1]), np.float32)
+                for i, j in enumerate(collect):
+                    k = counts[i]
+                    lo = int(off[i])
+                    cs[lo:lo + k] = ws[j, lv, :k]
+                    cd[lo:lo + k] = wd[j, lv, :k]
+                wave_slots = np.asarray([slots[j] for j in collect],
+                                        np.int64)
+                connect_wave(lib, self._vectors, self._nbrL[lv],
+                             self._cntL[lv], self.m,
+                             self._level_cap(lv),
+                             wave_slots, off, cs, cd)
+            top = int(np.argmax(lvq))
+            if levels[top] > self._max_level:
+                self._max_level = levels[top]
+                self._entry = slots[top]
+            return
+
         visited, gen = self._visit_scratch(B)
 
         d0 = 1.0 - Q @ self._vectors[pre_entry]
@@ -462,9 +506,6 @@ class HNSWIndex:
         # Native kernel when available (diversity-select + back-link
         # prune are the remaining per-node sequential hot loop,
         # native/nornichnsw.cpp); Python fallback is semantics-identical.
-        from nornicdb_tpu.search.hnsw_native import connect_wave, get_lib
-
-        lib = get_lib()
         for lv in sorted(cands_at.keys(), reverse=True):
             per = cands_at[lv]
             if lib is not None and per:

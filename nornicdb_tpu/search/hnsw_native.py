@@ -42,10 +42,69 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,                   # n_wave
         ]
         lib.hnsw_connect.restype = None
+        # a stale fallback .so (rebuild impossible) may predate the wave
+        # kernel — keep the connect kernel usable without it
+        if not hasattr(lib, "hnsw_wave_search"):
+            _lib = lib
+            return _lib
+        lib.hnsw_wave_search.argtypes = [
+            ctypes.POINTER(ctypes.c_float),   # vectors
+            ctypes.c_int64,                   # dims
+            ctypes.POINTER(ctypes.c_void_p),  # nbr level pointers
+            ctypes.POINTER(ctypes.c_void_p),  # cnt level pointers
+            ctypes.POINTER(ctypes.c_int64),   # widths
+            ctypes.c_int64,                   # n_levels
+            ctypes.POINTER(ctypes.c_float),   # queries
+            ctypes.c_int64,                   # B
+            ctypes.POINTER(ctypes.c_int64),   # query_levels
+            ctypes.c_int64,                   # entry_slot
+            ctypes.c_int64,                   # ef
+            ctypes.c_int64,                   # capacity
+            ctypes.POINTER(ctypes.c_int64),   # out_slots
+            ctypes.POINTER(ctypes.c_float),   # out_dists
+        ]
+        lib.hnsw_wave_search.restype = None
         _lib = lib
     except Exception:
         _lib = None
     return _lib
+
+
+def wave_search(lib, vectors: np.ndarray, nbr_levels, cnt_levels,
+                queries: np.ndarray, query_levels: np.ndarray,
+                entry_slot: int, ef: int,
+                capacity: int) -> "tuple[np.ndarray, np.ndarray]":
+    """Run the native wave layer-search. Returns (dists, slots) shaped
+    [B, n_levels, ef] (+inf / -1 padded), ascending per (query, level).
+    All adjacency arrays must be C-contiguous int32."""
+    p = ctypes.POINTER
+    n_levels = len(nbr_levels)
+    B = queries.shape[0]
+    nbr_ptrs = (ctypes.c_void_p * n_levels)(
+        *[a.ctypes.data for a in nbr_levels])
+    cnt_ptrs = (ctypes.c_void_p * n_levels)(
+        *[a.ctypes.data for a in cnt_levels])
+    widths = np.asarray([a.shape[1] for a in nbr_levels], np.int64)
+    out_slots = np.empty((B, n_levels, ef), np.int64)
+    out_dists = np.empty((B, n_levels, ef), np.float32)
+    lib.hnsw_wave_search(
+        vectors.ctypes.data_as(p(ctypes.c_float)),
+        vectors.shape[1],
+        nbr_ptrs,
+        cnt_ptrs,
+        widths.ctypes.data_as(p(ctypes.c_int64)),
+        n_levels,
+        queries.ctypes.data_as(p(ctypes.c_float)),
+        B,
+        np.ascontiguousarray(query_levels, np.int64).ctypes.data_as(
+            p(ctypes.c_int64)),
+        entry_slot,
+        ef,
+        capacity,
+        out_slots.ctypes.data_as(p(ctypes.c_int64)),
+        out_dists.ctypes.data_as(p(ctypes.c_float)),
+    )
+    return out_dists, out_slots
 
 
 def connect_wave(lib, vectors: np.ndarray, nbr: np.ndarray,

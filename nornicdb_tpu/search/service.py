@@ -127,19 +127,13 @@ class SearchService:
         self._hnsw_m = hnsw_m
         self._hnsw_ef = hnsw_ef_search
         self.stats = SearchStats()
-        # Search() result cache, query+options keyed — same semantics as
-        # the Cypher query cache and as the reference's
-        # searchResultCache (search.go:88-92,295-301,680: LRU 1000,
-        # 5-min TTL, shared by every public search entrypoint,
-        # invalidated on index mutation)
-        from nornicdb_tpu.cache import LRUCache
+        # Search() result cache, query+options keyed — same semantics
+        # as the Cypher query cache and the reference's
+        # searchResultCache; generation-guarded puts + copy-on-return
+        # (cache.py ResultCache)
+        from nornicdb_tpu.cache import ResultCache
 
-        self._result_cache: LRUCache = LRUCache(max_size=1000,
-                                                ttl_seconds=300.0)
-        # generation guard: a search that read pre-write index state
-        # must not put its result AFTER a mutation cleared the cache
-        # (that would pin a stale result for the whole TTL)
-        self._result_cache_gen = 0
+        self._result_cache: ResultCache = ResultCache(_copy_hit)
         # index persistence: debounced saves + load-on-open so a restart
         # skips the rebuild (reference: search.go:496-507, versioned
         # persisted indexes + resumeVectorBuild search.go:432)
@@ -159,9 +153,7 @@ class SearchService:
             lambda queries, k: self.vectors.search_batch(queries, k))
 
     def _clear_result_cache(self) -> None:
-        with self._lock:  # unlocked += can lose a concurrent bump
-            self._result_cache_gen += 1
-        self._result_cache.clear()
+        self._result_cache.bump_generation()
 
     # -- indexing ---------------------------------------------------------
 
@@ -490,11 +482,11 @@ class SearchService:
         if query_embedding is None and self.reranker is None:
             cache_key = (query, limit, mode, min_score, enrich,
                          tuple(labels) if labels else None)
-            cached = self._result_cache.get(cache_key)
+            cached = self._result_cache.get_hits(cache_key)
             if cached is not None:
                 self.stats.cache_hits += 1
-                return [_copy_hit(r) for r in cached]
-            gen_at_miss = self._result_cache_gen
+                return cached
+            gen_at_miss = self._result_cache.generation
         timings: Dict[str, float] = {}
         t0 = time.perf_counter() if diag else 0.0
         overfetch = max(limit * 3, 30)
@@ -579,8 +571,6 @@ class SearchService:
             self.stats.last_timings = timings
         out = out[:limit]
         if cache_key is not None:
-            if self._result_cache_gen == gen_at_miss:
-                # no index mutation raced this compute
-                self._result_cache.put(cache_key, out)
-            return [_copy_hit(r) for r in out]
+            return self._result_cache.put_guarded(cache_key, out,
+                                                  gen_at_miss)
         return out

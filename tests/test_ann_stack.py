@@ -486,12 +486,25 @@ class TestSeededBuild:
         assert r_seeded >= r_full - 0.03, (r_seeded, r_full)
 
 
+class _ConnectOnlyLib:
+    """Proxy exposing only the connect kernel — forces the numpy wave
+    search while keeping the native link phase, so connect parity can be
+    pinned in isolation."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self.hnsw_connect = lib.hnsw_connect
+
+
 class TestNativeConnect:
     """The native connect kernel (native/nornichnsw.cpp) must produce
     EXACTLY the graph the Python link phase produces — same diversity
-    selection, same back-link pruning, same tie-breaks."""
+    selection, same back-link pruning, same tie-breaks. (The native
+    WAVE SEARCH is a different algorithm than the numpy batched search
+    — classic per-query heaps vs expand-every-beam-entry — so full
+    native builds are gated on recall, below, not graph equality.)"""
 
-    def test_native_matches_python_graph(self, monkeypatch):
+    def test_native_connect_matches_python_graph(self, monkeypatch):
         from nornicdb_tpu.search import hnsw_native
         from nornicdb_tpu.search.hnsw import HNSWIndex
 
@@ -502,6 +515,8 @@ class TestNativeConnect:
         vecs = rng.standard_normal((3000, 64)).astype(np.float32)
         items = [(f"v{i}", v) for i, v in enumerate(vecs)]
 
+        monkeypatch.setattr(hnsw_native, "get_lib",
+                            lambda: _ConnectOnlyLib(lib))
         native = HNSWIndex(ef_construction=96)
         native.build(items)
 
@@ -515,3 +530,44 @@ class TestNativeConnect:
                 native._cntL[lv], python._cntL[lv], err_msg=f"cnt lv{lv}")
             np.testing.assert_array_equal(
                 native._nbrL[lv], python._nbrL[lv], err_msg=f"nbr lv{lv}")
+
+    def test_native_wave_search_build_recall(self, monkeypatch):
+        """Full native build (search + connect) must match the Python
+        build's recall on the same data — the wave-search kernel is a
+        different traversal, so quality, not graph bytes, is the
+        contract."""
+        from nornicdb_tpu.search import hnsw_native
+        from nornicdb_tpu.search.hnsw import HNSWIndex
+
+        lib = hnsw_native.get_lib()
+        if lib is None or not hasattr(lib, "hnsw_wave_search"):
+            pytest.skip("native wave search unavailable")
+        rng = np.random.default_rng(23)
+        n, d = 4000, 64
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+        items = [(f"v{i}", v) for i, v in enumerate(vecs)]
+        vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+        nq = 100
+        qs = vecs[rng.choice(n, nq, replace=False)] + \
+            0.1 * rng.standard_normal((nq, d)).astype(np.float32)
+        qn = qs / np.linalg.norm(qs, axis=1, keepdims=True)
+        gt = np.argsort(-(qn @ vn.T), axis=1)[:, :10]
+        gt_sets = [{f"v{j}" for j in row} for row in gt]
+
+        def recall(index):
+            hit = 0
+            for qi in range(nq):
+                res = {h[0] for h in index.search(qs[qi], k=10)}
+                hit += len(res & gt_sets[qi])
+            return hit / (nq * 10)
+
+        native = HNSWIndex(ef_construction=96)
+        native.build(items)
+        r_native = recall(native)
+
+        monkeypatch.setattr(hnsw_native, "get_lib", lambda: None)
+        python = HNSWIndex(ef_construction=96)
+        python.build(items)
+        r_python = recall(python)
+        assert r_native >= r_python - 0.03, (r_native, r_python)
+        assert r_native >= 0.85, r_native

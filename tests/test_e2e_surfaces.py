@@ -8,6 +8,7 @@ surface is printed (not asserted: CI boxes vary).
 """
 
 import json
+import os
 import socket
 import struct
 import time
@@ -237,13 +238,15 @@ class TestFiveSurfaceParity:
     # clients (bolt 4.7k / http 3.1k / graphql 1.8k / rest 3.7k /
     # grpc 3.6k ops/s), so they absorb CI noise while still catching
     # order-of-magnitude regressions like the Nagle stall or a lost
-    # result cache.
+    # result cache. On a slower/oversubscribed box, scale them with
+    # NORNICDB_E2E_FLOOR_SCALE (e.g. 0.2) rather than deleting the gate.
+    FLOOR_SCALE = float(os.environ.get("NORNICDB_E2E_FLOOR_SCALE", "1.0"))
     FLOORS = {
-        "bolt": 1200.0,
-        "neo4j_http": 900.0,
-        "graphql": 500.0,
-        "rest_search": 1000.0,
-        "qdrant_grpc": 1000.0,
+        "bolt": 1200.0 * FLOOR_SCALE,
+        "neo4j_http": 900.0 * FLOOR_SCALE,
+        "graphql": 500.0 * FLOOR_SCALE,
+        "rest_search": 1000.0 * FLOOR_SCALE,
+        "qdrant_grpc": 1000.0 * FLOOR_SCALE,
     }
 
     def test_throughput_gate(self, stack):

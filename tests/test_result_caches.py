@@ -215,3 +215,72 @@ class TestIvfBackendStillSearches:
             np.asarray([1.0, 0.0], np.float32), k=1)
         assert hits == [("x", 0.9)]
         assert svc.vectors.calls == 1
+
+
+class TestCrossSurfaceInvalidation:
+    """Qdrant points are ordinary storage nodes — a mutation through any
+    OTHER surface (Cypher, GDPR delete, raw storage) must invalidate the
+    qdrant layer's index + result caches (r5 review finding)."""
+
+    def test_external_delete_invalidates(self):
+        import nornicdb_tpu
+
+        db = nornicdb_tpu.open(auto_embed=False)
+        try:
+            c = db.qdrant_compat
+            c.create_collection("col", {"size": 2, "distance": "Cosine"})
+            c.upsert_points("col", [
+                {"id": 1, "vector": [1.0, 0.0], "payload": {"v": "one"}},
+                {"id": 2, "vector": [0.0, 1.0], "payload": {"v": "two"}},
+            ])
+            hits = c.search_points("col", [1.0, 0.0], limit=1)
+            assert hits[0]["id"] == 1
+            # delete the point BEHIND qdrant's back, via raw storage
+            # (the route a Cypher DETACH DELETE takes)
+            db.storage.delete_node("qdrant/col/1")
+            hits = c.search_points("col", [1.0, 0.0], limit=2)
+            assert [h["id"] for h in hits] == [2], hits
+        finally:
+            db.close()
+
+    def test_external_update_invalidates_payload(self):
+        import nornicdb_tpu
+        from nornicdb_tpu.storage.types import Node
+
+        db = nornicdb_tpu.open(auto_embed=False)
+        try:
+            c = db.qdrant_compat
+            c.create_collection("col", {"size": 2, "distance": "Cosine"})
+            c.upsert_points("col", [
+                {"id": 1, "vector": [1.0, 0.0], "payload": {"v": "old"}}])
+            assert c.search_points("col", [1.0, 0.0], limit=1)[0][
+                "payload"]["v"] == "old"
+            node = db.storage.get_node("qdrant/col/1")
+            node.properties["payload"] = {"v": "new"}
+            db.storage.update_node(node)
+            assert c.search_points("col", [1.0, 0.0], limit=1)[0][
+                "payload"]["v"] == "new"
+        finally:
+            db.close()
+
+    def test_own_writes_do_not_drop_index(self):
+        """The listener must NOT nuke the per-collection index on the
+        layer's own writes (they maintain it incrementally)."""
+        import nornicdb_tpu
+
+        db = nornicdb_tpu.open(auto_embed=False)
+        try:
+            c = db.qdrant_compat
+            c.create_collection("col", {"size": 2, "distance": "Cosine"})
+            c.upsert_points("col", [
+                {"id": 1, "vector": [1.0, 0.0], "payload": {}}])
+            c.search_points("col", [1.0, 0.0], limit=1)  # build index
+            space = c.vector_registry.get(c._space_key("col"))
+            idx_before = space.index
+            assert idx_before is not None
+            c.upsert_points("col", [
+                {"id": 2, "vector": [0.0, 1.0], "payload": {}}])
+            assert space.index is idx_before, "own write dropped index"
+            assert len(space.index) == 2
+        finally:
+            db.close()
