@@ -301,7 +301,9 @@ def _bench_tpu_proof(interpret: bool = False, tiny: bool = False):
     att_flops = 4.0 * B * H * S * S * Dh  # QK^T + AV matmuls
     out["pallas_attention_compiled"] = {
         "shape": [B, S, H, Dh], "matches_reference": att_exact,
-        "tflops_per_s": round(att_flops * iters / dt / 1e12, 2),
+        # 3 significant digits, not fixed decimals: interpret-mode CPU
+        # dry-runs produce tiny values that round(x, 2) floors to 0.0
+        "tflops_per_s": float(f"{att_flops * iters / dt / 1e12:.3g}"),
     }
 
     # -- batched device kNN (the headline is batch-1) ---------------------
@@ -349,7 +351,7 @@ def _bench_tpu_proof(interpret: bool = False, tiny: bool = False):
         "config": "bge_m3_like", "batch": Bt, "seq": St,
         "params_m": round(n_params / 1e6, 1),
         "tokens_per_s": round(tokens_per_s, 1),
-        "achieved_tflops_per_s": round(achieved / 1e12, 2),
+        "achieved_tflops_per_s": float(f"{achieved / 1e12:.3g}"),
         "peak_tflops_per_s": None if peak is None else round(peak / 1e12),
         "mfu": None if peak is None else round(achieved / peak, 4),
     }

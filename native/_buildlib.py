@@ -23,25 +23,59 @@ def src_hash(src: str) -> str:
         return hashlib.sha256(f.read()).hexdigest()
 
 
+def host_tag() -> str:
+    """Fingerprint of the CPU the library was compiled on. -march=native
+    output must never execute on a CPU with a different ISA extension
+    set (SIGILL, not a catchable error), so the stamp pins the host and
+    a mismatch forces a rebuild — or a clean refusal when rebuild is
+    impossible, which drops callers to their Python fallbacks."""
+    import platform
+
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    tag += ":" + line.split(":", 1)[1]
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(tag.encode()).hexdigest()[:16]
+
+
+def _stamp_fields(stamp: str) -> list:
+    try:
+        with open(stamp, encoding="utf-8") as f:
+            return f.read().split()
+    except OSError:
+        return []
+
+
+def _stamp_ok(stamp: str, want, host: str) -> bool:
+    fields = _stamp_fields(stamp)
+    # legacy single-field stamps (no host tag) don't vouch for ISA
+    return (len(fields) >= 2 and fields[1] == host
+            and (want is None or fields[0] == want))
+
+
 def build_cached(src: str, out: str, flags: list[str],
                  force: bool = False) -> str:
     """Compile ``src`` to ``out`` unless a stamp file proves the existing
-    ``out`` was built from byte-identical source. Returns the library
-    path; raises only when no usable library can be produced at all."""
+    ``out`` was built from byte-identical source ON THIS CPU. Returns
+    the library path; raises only when no usable library can be
+    produced at all."""
     stamp = out + ".srchash"
+    host = host_tag()
     if not os.path.exists(src):
-        # deployment without sources: the prebuilt .so is all there is
-        if os.path.exists(out):
+        # deployment without sources: the prebuilt .so is all there is —
+        # but only if it was provably compiled on this CPU
+        if os.path.exists(out) and _stamp_ok(stamp, None, host):
             return out
-        raise FileNotFoundError(src)
+        raise FileNotFoundError(
+            f"{src} missing and no ISA-matched prebuilt {out}")
     want = src_hash(src)
-    if not force and os.path.exists(out) and os.path.exists(stamp):
-        try:
-            with open(stamp, encoding="utf-8") as f:
-                if f.read().strip() == want:
-                    return out
-        except OSError:
-            pass
+    if not force and os.path.exists(out) and _stamp_ok(stamp, want, host):
+        return out
     # per-process temp names: concurrent first-use builds (e.g. two
     # services starting on a fresh clone) must not interleave writes to
     # one shared .tmp and publish a truncated library
@@ -51,7 +85,9 @@ def build_cached(src: str, out: str, flags: list[str],
     try:
         subprocess.run(cmd, check=True, capture_output=True)
     except (OSError, subprocess.CalledProcessError) as exc:
-        if os.path.exists(out):
+        # stale content is tolerable (parity tests pin behavior); a
+        # foreign-ISA binary is not — executing it can SIGILL
+        if os.path.exists(out) and _stamp_ok(stamp, None, host):
             log.warning(
                 "cannot rebuild %s (%s); loading the existing library, "
                 "which may not match %s", out, exc, src,
@@ -60,6 +96,6 @@ def build_cached(src: str, out: str, flags: list[str],
         raise
     os.replace(tmp_out, out)
     with open(tmp_stamp, "w", encoding="utf-8") as f:
-        f.write(want + "\n")
+        f.write(want + "\n" + host + "\n")
     os.replace(tmp_stamp, stamp)
     return out

@@ -30,9 +30,9 @@ def _src_hash() -> str:
 
 
 def build(force: bool = False) -> str:
-    # -march=native is safe here: the library is always (re)built from
-    # source on the machine that runs it (content-hash stamps are local
-    # artifacts, so a fresh clone recompiles on first use)
+    # -march=native is safe here: the stamp pins source hash AND host
+    # CPU fingerprint, so a .so carried to a different machine is
+    # rebuilt — or refused (Python fallback) when rebuild is impossible
     return build_cached(SRC, OUT, ["-O3", "-march=native", "-std=c++17"],
                         force=force)
 

@@ -46,10 +46,13 @@ class LRUCache(Generic[V]):
         ttl = self.ttl if ttl_seconds is None else ttl_seconds
         expires = time.monotonic() + ttl if ttl else 0.0
         with self._lock:
-            self._data[key] = (value, expires)
-            self._data.move_to_end(key)
-            while len(self._data) > self.max_size:
-                self._data.popitem(last=False)
+            self._put_locked(key, value, expires)
+
+    def _put_locked(self, key: Hashable, value: V, expires: float) -> None:
+        self._data[key] = (value, expires)
+        self._data.move_to_end(key)
+        while len(self._data) > self.max_size:
+            self._data.popitem(last=False)
 
     def delete(self, key: Hashable) -> bool:
         with self._lock:
@@ -138,8 +141,5 @@ class ResultCache(GenerationalCache[list]):
         expires = time.monotonic() + self.ttl if self.ttl else 0.0
         with self._lock:
             if self._generation == gen_at_miss:
-                self._data[key] = (hits, expires)
-                self._data.move_to_end(key)
-                while len(self._data) > self.max_size:
-                    self._data.popitem(last=False)
+                self._put_locked(key, hits, expires)
         return [self._copy_hit(h) for h in hits]

@@ -96,13 +96,24 @@ class CypherResult:
         self.stats = stats if stats is not None else QueryStats()
         self.plan = plan
 
+    def _pycol(self, i: int) -> List[Any]:
+        """Column i as a Python list, converting a lazily-held numpy
+        column (np scalars -> natives) exactly once."""
+        col = self._col_data[i]
+        if not isinstance(col, list):
+            col = col.tolist()
+            self._col_data[i] = col
+        return col
+
     @property
     def rows(self) -> List[List[Any]]:
         if self._rows is None:
             cols = self._col_data
-            self._rows = (
-                list(map(list, zip(*cols))) if cols and len(cols[0]) else []
-            )
+            if cols and len(cols[0]):
+                cols = [self._pycol(i) for i in range(len(cols))]
+                self._rows = list(map(list, zip(*cols)))
+            else:
+                self._rows = []
         # the returned list is mutable (UNION merging extends it in
         # place): drop the column view so there is a single source of
         # truth once rows are exposed
@@ -126,7 +137,7 @@ class CypherResult:
         query cache, so handing out the live list would let caller
         mutations poison future cache hits."""
         if self._col_data is not None:
-            return list(self._col_data[i])
+            return list(self._pycol(i))
         return [r[i] for r in self.rows]
 
     def records(self) -> List[Dict[str, Any]]:
@@ -138,7 +149,8 @@ class CypherResult:
 
     def value(self, col: int = 0) -> Any:
         if self._rows is None and self._col_data:
-            return self._col_data[col][0] if self._col_data[col] else None
+            c = self._pycol(col)
+            return c[0] if c else None
         return self.rows[0][col] if self.rows else None
 
 

@@ -1035,18 +1035,15 @@ def _exec_cooc(catalog, cooc: Dict[str, Any], ctx) -> Optional[_Bindings]:
         cooc["b_label"],
     )
     if gram is not None:
-        c = gram.C
-        ii, jj = np.nonzero(c > 0)
+        ii, jj, w, a_rows, b_rows = gram.coo()
         b_out = _Bindings()
         if cooc["a_var"]:
-            b_out.node_cols[cooc["a_var"]] = gram.a_cands[ii].astype(
-                np.int32, copy=False)
+            b_out.node_cols[cooc["a_var"]] = a_rows
             b_out.cand_map[cooc["a_var"]] = (gram.a_cands, ii)
         if cooc["b_var"]:
-            b_out.node_cols[cooc["b_var"]] = gram.b_cands[jj].astype(
-                np.int32, copy=False)
+            b_out.node_cols[cooc["b_var"]] = b_rows
             b_out.cand_map[cooc["b_var"]] = (gram.b_cands, jj)
-        b_out.row_weights = c[ii, jj]
+        b_out.row_weights = w
         b_out.n_rows = len(ii)
         b_out.rows_are_groups = bool(cooc["a_var"] and cooc["b_var"])
         if cooc["mid_var"]:
@@ -1642,13 +1639,16 @@ def _project(executor, catalog, ret: A.ReturnClause, b: _Bindings, ctx,
         k = int(_const_value(ret.limit, ctx))
         out_cols = [c[:k] for c in out_cols]
 
-    py_cols: List[List[Any]] = []
+    py_cols: List[Any] = []
     for col in out_cols:
-        lst = col.tolist()  # np scalars -> python natives in one pass
-        if lst and isinstance(lst[0], _NodeRef):
+        if col.dtype == object and len(col) and isinstance(col[0], _NodeRef):
             nodes = catalog.nodes()
-            lst = [nodes[v.row] for v in lst]
-        py_cols.append(lst)
+            py_cols.append([nodes[v.row] for v in col.tolist()])
+        else:
+            # handed to CypherResult as-is; np scalars become natives
+            # lazily on first row/column access (benches and servers
+            # that stream column-major never pay an eager tolist)
+            py_cols.append(col)
     if not py_cols:
         return CypherResult(columns=cols, rows=[])
     return CypherResult(columns=cols, col_data=py_cols)
@@ -1801,12 +1801,8 @@ def _rows_are_value_groups(group_items, b: _Bindings, catalog) -> bool:
             return False
         vars_used.add(e.target.name)
         cands, _codes = b.cand_map[e.target.name]
-        vals = catalog.node_prop_col(e.name)[cands].tolist()
-        seen = set()
-        for v in vals:
-            if v is None or isinstance(v, (list, dict)) or v in seen:
-                return False
-            seen.add(v)
+        if not catalog.prop_injective_over(e.name, cands):
+            return False
     return vars_used == set(b.cand_map)
 
 
@@ -1846,19 +1842,23 @@ def _aggregate(catalog, ret: A.ReturnClause, b: _Bindings, ctx,
             full = _out_col(item.expr, b, catalog, ctx)
             out.append(full if identity_groups else full[first])
         else:
-            out.append(_agg_expr(item.expr, b, catalog, ctx, codes, n_groups))
+            out.append(_agg_expr(item.expr, b, catalog, ctx, codes,
+                                 n_groups, identity_groups))
     return out
 
 
 def _agg_expr(
-    e: A.Expr, b: _Bindings, catalog, ctx, codes: np.ndarray, n_groups: int
+    e: A.Expr, b: _Bindings, catalog, ctx, codes: np.ndarray, n_groups: int,
+    identity: bool = False,
 ) -> np.ndarray:
     """Per-group value of an aggregate-bearing expression."""
     if isinstance(e, A.FuncCall) and e.name in _AGG_NAMES:
-        return _agg_leaf(e, b, catalog, ctx, codes, n_groups)
+        return _agg_leaf(e, b, catalog, ctx, codes, n_groups, identity)
     if isinstance(e, A.Binary) and e.op in ("+", "-", "*", "/", "%"):
-        l = _agg_expr(e.left, b, catalog, ctx, codes, n_groups)
-        r = _agg_expr(e.right, b, catalog, ctx, codes, n_groups)
+        l = _agg_expr(e.left, b, catalog, ctx, codes, n_groups,
+                      identity).tolist()
+        r = _agg_expr(e.right, b, catalog, ctx, codes, n_groups,
+                      identity).tolist()
         out = np.empty(n_groups, dtype=object)
         for i in range(n_groups):
             lv, rv = l[i], r[i]
@@ -1893,7 +1893,8 @@ def _agg_expr(
         out[:] = v
         return out
     if isinstance(e, A.FuncCall) and e.name in ("tofloat", "tointeger"):
-        inner = _agg_expr(e.args[0], b, catalog, ctx, codes, n_groups)
+        inner = _agg_expr(e.args[0], b, catalog, ctx, codes, n_groups,
+                          identity).tolist()
         out = np.empty(n_groups, dtype=object)
         for i in range(n_groups):
             v = inner[i]
@@ -1905,7 +1906,8 @@ def _agg_expr(
                 out[i] = int(v)
         return out
     if isinstance(e, A.FuncCall) and e.name == "round":
-        inner = _agg_expr(e.args[0], b, catalog, ctx, codes, n_groups)
+        inner = _agg_expr(e.args[0], b, catalog, ctx, codes, n_groups,
+                          identity).tolist()
         out = np.empty(n_groups, dtype=object)
         for i in range(n_groups):
             v = inner[i]
@@ -1915,12 +1917,19 @@ def _agg_expr(
 
 
 def _agg_leaf(
-    e: A.FuncCall, b: _Bindings, catalog, ctx, codes: np.ndarray, n_groups: int
+    e: A.FuncCall, b: _Bindings, catalog, ctx, codes: np.ndarray,
+    n_groups: int, identity: bool = False,
 ) -> np.ndarray:
     name = e.name
     w = b.row_weights
 
     def _row_count(sel_codes, sel_w):
+        if identity and sel_codes is codes:
+            # rows ARE the groups (codes == arange): the per-group count
+            # is the row weight itself — no bincount pass
+            if sel_w is None:
+                return np.ones(n_groups, dtype=np.int64)
+            return sel_w.astype(np.int64, copy=False)
         if sel_w is None:
             return np.bincount(sel_codes, minlength=n_groups)[:n_groups]
         return np.bincount(
@@ -1928,9 +1937,7 @@ def _agg_leaf(
         )[:n_groups].astype(np.int64)
 
     if name == "count" and e.star:
-        out = np.empty(n_groups, dtype=object)
-        out[:] = _row_count(codes, w).tolist()  # int64 -> python int
-        return out
+        return _row_count(codes, w)  # numeric column; lazy-native later
     if not e.args:
         _bail()
     arg = e.args[0]
@@ -1953,9 +1960,7 @@ def _agg_leaf(
             weights=b.stripped_distinct_counts[arg.name].astype(np.float64),
             minlength=n_groups,
         )[:n_groups].astype(np.int64)
-        out = np.empty(n_groups, dtype=object)
-        out[:] = cnt.tolist()
-        return out
+        return cnt
     if (
         name == "count"
         and isinstance(arg, A.Var)
@@ -1968,9 +1973,7 @@ def _agg_leaf(
         if e.distinct:
             _bail()
         vw = b.stripped_var_weights.get(arg.name, w)
-        out = np.empty(n_groups, dtype=object)
-        out[:] = _row_count(codes, vw).tolist()
-        return out
+        return _row_count(codes, vw)
     if isinstance(arg, A.Var) and arg.name in b.node_cols:
         vals = b.node_cols[arg.name].astype(np.int64)
         nonnull = np.ones(b.n_rows, dtype=bool)
@@ -1992,9 +1995,7 @@ def _agg_leaf(
                     flags[codes * k + vals] = True
                     nz = np.flatnonzero(flags)
                     cnt = np.bincount(nz // k, minlength=n_groups)[:n_groups]
-                    out = np.empty(n_groups, dtype=object)
-                    out[:] = cnt.tolist()
-                    return out
+                    return cnt
             if vals is None:
                 from nornicdb_tpu.query.columnar import group_codes as _gc
 
@@ -2010,9 +2011,7 @@ def _agg_leaf(
             cnt = np.bincount(grp, minlength=n_groups)[:n_groups]
         else:
             cnt = _row_count(codes[nonnull], w[nonnull] if w is not None else None)
-        out = np.empty(n_groups, dtype=object)
-        out[:] = cnt.tolist()
-        return out
+        return cnt
 
     if values_obj is None:
         _bail()

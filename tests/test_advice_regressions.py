@@ -473,7 +473,11 @@ class TestNativeBuildStamp:
             with open(src_p, "rb") as f:
                 want = hashlib.sha256(f.read()).hexdigest()
             with open(stamp_p, encoding="utf-8") as f:
-                assert f.read().strip() == want
+                fields = f.read().split()
+            # two-field stamp: source hash + compile-host CPU tag
+            # (foreign-ISA -march=native binaries must never load)
+            assert fields[0] == want
+            assert len(fields) >= 2
 
     def test_stale_stamp_triggers_rebuild(self, tmp_path):
         import importlib.util
@@ -502,4 +506,59 @@ class TestNativeBuildStamp:
             f.write("deadbeef\n")
         build_hnsw.build()
         with open(build_hnsw.STAMP, encoding="utf-8") as f:
-            assert f.read().strip() == build_hnsw._src_hash()
+            assert f.read().split()[0] == build_hnsw._src_hash()
+
+
+class TestForeignISAPrebuilt:
+    """A -march=native .so compiled on another CPU must never be loaded
+    (SIGILL is not catchable); the stamp pins a host fingerprint and a
+    mismatch forces rebuild — or clean refusal without sources."""
+
+    def _buildlib(self):
+        import importlib.util
+        import os
+
+        native = os.path.join(os.path.dirname(__file__), "..", "native")
+        spec = importlib.util.spec_from_file_location(
+            "_t_buildlib", os.path.join(native, "_buildlib.py"))
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        return m
+
+    def test_prebuilt_without_sources_requires_host_match(self, tmp_path):
+        bl = self._buildlib()
+        out = str(tmp_path / "lib.so")
+        with open(out, "wb") as f:
+            f.write(b"\x7fELF fake")
+        # no stamp at all: refuse
+        with pytest.raises(FileNotFoundError):
+            bl.build_cached(str(tmp_path / "missing.cpp"), out, ["-O2"])
+        # stamp from a different host: refuse
+        with open(out + ".srchash", "w", encoding="utf-8") as f:
+            f.write("somehash\n" + "0" * 16 + "\n")
+        with pytest.raises(FileNotFoundError):
+            bl.build_cached(str(tmp_path / "missing.cpp"), out, ["-O2"])
+        # stamp from THIS host: accept
+        with open(out + ".srchash", "w", encoding="utf-8") as f:
+            f.write("somehash\n" + bl.host_tag() + "\n")
+        assert bl.build_cached(
+            str(tmp_path / "missing.cpp"), out, ["-O2"]) == out
+
+    def test_foreign_host_stamp_triggers_rebuild(self, tmp_path):
+        import shutil
+
+        if shutil.which("g++") is None:
+            pytest.skip("no g++ in this environment")
+        bl = self._buildlib()
+        src = str(tmp_path / "x.cpp")
+        with open(src, "w", encoding="utf-8") as f:
+            f.write('extern "C" int forty() { return 40; }\n')
+        out = str(tmp_path / "libx.so")
+        bl.build_cached(src, out, ["-O2"])
+        # rewrite the stamp as if compiled elsewhere; next call must
+        # recompile (observable: stamp host restored to this machine)
+        with open(out + ".srchash", "w", encoding="utf-8") as f:
+            f.write(bl.src_hash(src) + "\n" + "f" * 16 + "\n")
+        bl.build_cached(src, out, ["-O2"])
+        with open(out + ".srchash", encoding="utf-8") as f:
+            assert f.read().split()[1] == bl.host_tag()

@@ -311,3 +311,24 @@ def test_strip_view_arrays_match_bruteforce(ex):
     np.testing.assert_array_equal(sv.deg, deg)
     np.testing.assert_array_equal(sv.sum_deg, sum_deg)
     np.testing.assert_array_equal(sv.nnz, nnz)
+
+
+def test_gram_coo_cache_tracks_incremental_updates(ex):
+    """The pre-aggregated COO decomposition (gram.coo(), VERDICT r5) must
+    be invalidated by the in-place C maintenance, not just by rebuilds."""
+    before = _rows(ex.execute(COOC))
+    # warm: second run hits the cached COO and must agree
+    assert _rows(ex.execute(COOC)) == before
+    # in-place maintenance path: new HAS_TAG edges on an existing message
+    ex.execute("CREATE (:Message {id: 999001})")
+    for t in ("ai", "tpu"):
+        ex.execute(
+            "MATCH (m:Message {id: 999001}), (t:Tag {name: $t}) "
+            "CREATE (m)-[:HAS_TAG]->(t)", {"t": t},
+        )
+    after = _rows(ex.execute(COOC))
+    assert after != before
+    # parity with a fresh executor (no caches at all)
+    fresh = CypherExecutor(ex.storage)
+    fresh.enable_query_cache = False
+    assert _rows(fresh.execute(COOC)) == after
