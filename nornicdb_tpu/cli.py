@@ -86,7 +86,8 @@ def cmd_serve(args) -> int:
     from nornicdb_tpu.api.bolt import BoltServer
     from nornicdb_tpu.api.http_server import HttpServer
 
-    http = HttpServer(db, host=args.host, port=args.http_port).start()
+    http = HttpServer(db, host=args.host, port=args.http_port,
+                      database_manager=db.multidb_manager()).start()
     bolt = BoltServer(db, host=args.host, port=args.bolt_port).start()
     grpc_srv = None
     if args.grpc_port:

@@ -104,11 +104,13 @@ class DB:
                 # async flush thread) when replication wiring fails
                 chain.close()
                 raise
+        self._chain = chain  # pre-namespace engine: multidb roots here
         self._listenable = ListenableEngine(NamespacedEngine(chain, database))
         self.storage = self._listenable
         self.database = database
         self._lock = threading.Lock()
         self._closed = False
+        self._db_manager = None
 
         # lazily-built services (per logical DB)
         self._executor = None
@@ -507,6 +509,20 @@ class DB:
     ) -> "Any":
         """Execute a Cypher query (reference: db.go:2222 Cypher)."""
         return self.executor.execute(query, params or {})
+
+    def multidb_manager(self, max_databases: int = 64):
+        """Lazily-built multi-database manager rooted on the same engine
+        chain this facade namespaces — CREATE/DROP DATABASE and per-DB
+        storage views share durability with the default database
+        (reference: cmd wires pkg/multidb into every server surface)."""
+        with self._lock:
+            if self._db_manager is None:
+                from nornicdb_tpu.multidb import DatabaseManager
+
+                self._db_manager = DatabaseManager(
+                    self._chain, default_database=self.database,
+                    max_databases=max_databases)
+            return self._db_manager
 
     def flush(self) -> None:
         if self._embed_queue is not None:

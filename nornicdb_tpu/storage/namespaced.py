@@ -173,3 +173,28 @@ class NamespacedEngine(EngineDecorator):
 
     def drop_database(self) -> Tuple[int, int]:
         return self.inner.delete_by_prefix(self._prefix)
+
+    # -- optional bulk APIs ----------------------------------------------
+    #
+    # These exist on the concrete engines and would otherwise fall
+    # through EngineDecorator.__getattr__ UNQUALIFIED — a label count
+    # that sees every database, a clear() that wipes them all. Each is
+    # re-scoped to this namespace here.
+
+    def count_nodes_by_label(self, label: str) -> int:
+        # the inner count spans all namespaces; count through the
+        # prefix-filtered id listing instead
+        return len(self.node_ids_by_label(label))
+
+    def count_nodes_with_prefix(self, prefix: str) -> int:
+        return self.inner.count_nodes_with_prefix(self._prefix + prefix)
+
+    def count_edges_with_prefix(self, prefix: str) -> int:
+        return self.inner.count_edges_with_prefix(self._prefix + prefix)
+
+    def delete_by_prefix(self, prefix: str) -> Tuple[int, int]:
+        return self.inner.delete_by_prefix(self._prefix + prefix)
+
+    def clear(self) -> None:
+        # clear THIS database, not the shared store under it
+        self.inner.delete_by_prefix(self._prefix)

@@ -381,3 +381,45 @@ class TestReviewRegressions:
             f.write(b"\xff" * 16)
         with pytest.raises(WALCorruptionError):
             DurableEngine(str(tmp_path))
+
+
+class TestNamespacedOptionalAPIs:
+    """Optional bulk APIs used to fall through EngineDecorator.__getattr__
+    UNQUALIFIED — count_nodes_by_label saw every database and clear()
+    wiped them all (caught by the r5 admin-UI e2e; pinned here at the
+    engine layer)."""
+
+    def _two_dbs(self):
+        from nornicdb_tpu.storage import MemoryEngine, NamespacedEngine
+        from nornicdb_tpu.storage.types import Node
+
+        base = MemoryEngine()
+        a = NamespacedEngine(base, "alpha")
+        b = NamespacedEngine(base, "beta")
+        a.create_node(Node(id="n1", labels=["Person"], properties={}))
+        a.create_node(Node(id="n2", labels=["Person"], properties={}))
+        b.create_node(Node(id="n1", labels=["Person"], properties={}))
+        return base, a, b
+
+    def test_count_nodes_by_label_is_scoped(self):
+        _base, a, b = self._two_dbs()
+        assert a.count_nodes_by_label("Person") == 2
+        assert b.count_nodes_by_label("Person") == 1
+
+    def test_prefix_counts_are_qualified(self):
+        _base, a, b = self._two_dbs()
+        assert a.count_nodes_with_prefix("n") == 2
+        assert b.count_nodes_with_prefix("n") == 1
+        assert a.count_nodes_with_prefix("zzz") == 0
+
+    def test_clear_scoped_to_one_database(self):
+        _base, a, b = self._two_dbs()
+        a.clear()
+        assert a.count_nodes() == 0
+        assert b.count_nodes() == 1  # beta untouched
+
+    def test_delete_by_prefix_qualified(self):
+        _base, a, b = self._two_dbs()
+        deleted_nodes, _edges = a.delete_by_prefix("n")
+        assert deleted_nodes == 2
+        assert b.count_nodes() == 1
