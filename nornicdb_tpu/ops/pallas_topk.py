@@ -50,8 +50,10 @@ def _block_topk_kernel(q_ref, m_ref, mask_ref, s_out_ref, i_out_ref, *, k: int):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    # mask block is [1, BLOCK_C] float {0,1} (lane-major); invalid -> NEG_INF
-    scores = scores + (mask_ref[0][None, :] - 1.0) * 1e30
+    # mask block is [BLOCK_C] float {0,1} (1-D: lane tiling only, no
+    # sublane constraint — a [1, BLOCK_C] 2-D block violates the TPU's
+    # (8, 128) tiling requirement); invalid -> NEG_INF
+    scores = scores + (mask_ref[:][None, :] - 1.0) * 1e30
 
     col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
     base = step * block_c
@@ -83,7 +85,7 @@ def _block_topk_kernel(q_ref, m_ref, mask_ref, s_out_ref, i_out_ref, *, k: int):
 def _fused_cosine_topk_impl(
     queries: jnp.ndarray,  # [B, D] normalized, B % 8 == 0
     matrix: jnp.ndarray,  # [C, D] normalized, C % block_c == 0
-    maskf: jnp.ndarray,  # [nblocks, block_c] float32 {0,1}
+    maskf: jnp.ndarray,  # [C] float32 {0,1}
     k: int,
     block_c: int,
     interpret: bool,
@@ -108,7 +110,7 @@ def _fused_cosine_topk_impl(
                 (block_c, d), lambda i: (i, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (1, block_c), lambda i: (i, 0), memory_space=pltpu.VMEM
+                (block_c,), lambda i: (i,), memory_space=pltpu.VMEM
             ),
         ],
         out_specs=(
@@ -180,7 +182,7 @@ def fused_cosine_topk(
     b_pad = max(8, -(-b // 8) * 8)
     if b_pad != b:
         queries = jnp.pad(queries, ((0, b_pad - b), (0, 0)))
-    maskf = valid.astype(jnp.float32).reshape(c // block_c, block_c)
+    maskf = valid.astype(jnp.float32)
     s, idx = _fused_cosine_topk_impl(
         queries, matrix, maskf, k_eff, block_c, interpret
     )

@@ -112,8 +112,15 @@ class WALEngine(EngineDecorator):
         }
 
     def snapshot(self) -> str:
-        """Write a full-state snapshot, pruning old segments."""
-        return self.wal.write_snapshot(self._dump_state())
+        """Write a full-state snapshot, pruning old segments.
+
+        Holds the mutation lock across dump + seq stamp: without it, an
+        append landing between ``_dump_state()`` and the snapshot's seq
+        stamp gets pruned as "covered" while missing from the state —
+        replay then silently loses it (caught by
+        test_races_services.py::TestWALSnapshotVsAppend)."""
+        with self._mut:
+            return self.wal.write_snapshot(self._dump_state())
 
     def _maybe_compact(self) -> None:
         if self.auto_compact_every <= 0:
