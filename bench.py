@@ -68,6 +68,93 @@ def _probe_backend(timeout_s: float = 120.0, attempts: int = 3):
     return None
 
 
+def _stage_subprocess(stage: str, timeout_s: float):
+    """Run one device-touching bench stage in a subprocess with a hard
+    deadline, then retry pinned to CPU on timeout/crash.
+
+    Why: a live tunnel can DROP mid-run (observed r5: the pagerank stage
+    blocked forever on a device call with 0 CPU — no exception, no
+    timeout). A blocked XLA call can't be interrupted in-thread, so
+    isolation is the only reliable watchdog; without it the driver's
+    end-of-round bench produces NO artifact at all."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage]
+
+    def run(env, note):
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=timeout_s,
+                env=env, start_new_session=True,
+            )
+        except subprocess.TimeoutExpired:
+            return None, f"{stage}: timed out after {timeout_s:.0f}s ({note})"
+        if out.returncode != 0:
+            tail = (out.stderr or "")[-300:]
+            return None, f"{stage}: rc={out.returncode} ({note}): {tail}"
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+        return None, f"{stage}: no JSON in stage output ({note})"
+
+    doc, err = run(dict(os.environ), "default backend")
+    if doc is not None:
+        return doc
+    sys.stderr.write(f"bench: {err}; retrying stage on cpu\n")
+    env = dict(os.environ)
+    # the container's sitecustomize forces jax_platforms="axon,cpu" in
+    # jax.config AT IMPORT, which overrides JAX_PLATFORMS — run_stage
+    # honors this flag by re-pinning via jax.config post-import
+    env["JAX_PLATFORMS"] = "cpu"
+    env["NORNICDB_BENCH_FORCE_CPU"] = "1"
+    doc2, err2 = run(env, "cpu retry")
+    if doc2 is not None:
+        doc2["backend_note"] = err  # record why the accelerator lost it
+        return doc2
+    return {"error": err, "cpu_retry_error": err2}
+
+
+_DEVICE_STAGES = {
+    "knn": (lambda: _bench_knn(), 900.0),
+    "northstar": (lambda: _bench_northstar(), 1800.0),
+    "tpu_proof": (lambda: _run_tpu_proof_stage(), 900.0),
+}
+
+
+def _run_tpu_proof_stage():
+    import jax as _jax
+
+    plat = _jax.devices()[0].platform
+    if plat in ("cpu", "host"):
+        return {
+            "skipped": f"backend is {plat!r}; compiled-Pallas and "
+            "MFU proof requires a real accelerator"}
+    return _bench_tpu_proof()
+
+
+def run_stage(stage: str) -> int:
+    """``python bench.py --stage X``: one stage, one JSON line."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("NORNICDB_BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif _probe_backend(timeout_s=90.0, attempts=2) is None:
+        # tunnel down at stage start: pin cpu NOW instead of hanging on
+        # first device touch until the outer watchdog fires
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    fn, _timeout = _DEVICE_STAGES[stage]
+    try:
+        doc = fn()
+    except Exception as exc:
+        doc = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    print(json.dumps(doc))
+    return 0
+
+
 def main():
     # Cypher first: it needs no accelerator, so a TPU-tunnel outage can
     # never cost the headline number.
@@ -83,23 +170,18 @@ def main():
         "vs_baseline": cypher["ldbc_geomean_vs_baseline"],
         "cypher": cypher,
     }
-    try:
-        result["knn"] = _bench_knn()
-    except Exception as exc:
-        # the accelerator half must never cost the already-computed
-        # Cypher headline
-        result["knn"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # device-touching stages run subprocess-isolated under deadlines (a
+    # mid-run tunnel drop blocks forever otherwise); the accelerator
+    # half must never cost the already-computed Cypher headline
+    result["knn"] = _stage_subprocess("knn", _DEVICE_STAGES["knn"][1])
     # north-star configs (BASELINE.json 1/3/4): HNSW build wall-clock
     # with/without BM25 seeding, ANN QPS@recall95, device PageRank.
-    # Runs AFTER _bench_knn so the jax platform is already safely pinned
-    # (cpu fallback) or live (tpu).
-    try:
-        result["northstar"] = _bench_northstar()
-    except Exception as exc:
-        result["northstar"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    result["northstar"] = _stage_subprocess(
+        "northstar", _DEVICE_STAGES["northstar"][1])
     # five-surface e2e throughput (reference: testing/e2e/README.md —
     # bolt 2,489 / neo4j-http 4,082 / graphql 3,200 / REST search
-    # 10,296 / qdrant-grpc 29,331 ops/s on a 16-way dev box)
+    # 10,296 / qdrant-grpc 29,331 ops/s on a 16-way dev box). Pure
+    # host work: stays in-process.
     try:
         result["surfaces"] = _bench_surfaces()
     except Exception as exc:
@@ -107,20 +189,9 @@ def main():
     # one-shot TPU proof (VERDICT r3 task 3): the first session where
     # the tunnel is up must capture EVERYTHING the TPU claim rests on —
     # compiled (non-interpret) Pallas kernels, batched device kNN, and
-    # encoder-forward MFU — in this same run, tagged with the real
-    # platform string. Skipped (with reason) on cpu fallback.
-    try:
-        import jax as _jax
-
-        plat = _jax.devices()[0].platform
-        if plat not in ("cpu", "host"):
-            result["tpu_proof"] = _bench_tpu_proof()
-        else:
-            result["tpu_proof"] = {
-                "skipped": f"backend is {plat!r}; compiled-Pallas and "
-                "MFU proof requires a real accelerator"}
-    except Exception as exc:
-        result["tpu_proof"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # encoder-forward MFU — tagged with the real platform string.
+    result["tpu_proof"] = _stage_subprocess(
+        "tpu_proof", _DEVICE_STAGES["tpu_proof"][1])
     # full result first, compact summary LAST: the driver keeps only the
     # last 2000 chars, and round 4's headline numbers were lost to
     # truncation because the headline printed first
@@ -1114,6 +1185,8 @@ def _bench_cypher():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
+        sys.exit(run_stage(sys.argv[2]))
     try:
         main()
     except Exception as exc:  # last-resort: a parseable line beats a traceback

@@ -87,8 +87,9 @@ class TestCompactSummary:
             "import bench\n"
             "bench._bench_cypher = lambda: {"
             "'ldbc_geomean_ops': 1.0, 'ldbc_geomean_vs_baseline': 2.0}\n"
-            "bench._bench_knn = lambda: {'value': 3.0}\n"
-            "bench._bench_northstar = lambda: {}\n"
+            # device stages run subprocess-isolated (r5 watchdog); stub
+            # the stage runner itself, not the in-process functions
+            "bench._stage_subprocess = lambda stage, t: {'value': 3.0}\n"
             "bench._bench_surfaces = lambda: {}\n"
             "bench.main()\n"
         ) % (str(bench.__file__).rsplit('/', 1)[0],)
