@@ -1535,6 +1535,9 @@ def try_fast_match_rows(executor, clause: A.MatchClause, ctx):
     if n_rel_paths > 1:
         return None  # same-type edge uniqueness across paths: general
     try:
+        rows = _try_point_lookup_rows(catalog, clause, ctx)
+        if rows is not None:
+            return rows
         bindings = [_match_chain(catalog, p, ctx) for p in paths]
         combined = _cartesian(bindings)
         if combined is None:
@@ -1545,6 +1548,52 @@ def try_fast_match_rows(executor, clause: A.MatchClause, ctx):
         return _materialize_rows(combined, catalog)
     except _Unsupported:
         return None
+
+
+def _try_point_lookup_rows(catalog, clause: A.MatchClause, ctx):
+    """Short-circuit for the write-side hot shape: every comma path is a
+    bare single node `(v:Label {prop: $p})`. The general machinery
+    (candidate arrays -> cartesian tile/repeat -> column materialize)
+    costs ~100us for what is literally two hash-index gets — and this
+    is the MATCH half of the reference's Northwind write bench
+    (`MATCH (a:P {id:$a}), (b:P {id:$b}) CREATE (a)-[:R]->(b)`).
+
+    Returns row dicts sharing the catalog's node objects (same contract
+    as _materialize_rows), or None when any path needs the full path.
+    """
+    if clause.where is not None:
+        return None
+    resolved: List[Tuple[str, List[Any]]] = []
+    nodes_list = None
+    for path in clause.paths:
+        if path.rels or len(path.nodes) != 1 or path.path_var:
+            return None
+        pn = path.nodes[0]
+        if (not pn.var or len(pn.labels) != 1 or pn.props is None
+                or len(pn.props.items) != 1):
+            return None
+        k, vexpr = pn.props.items[0]
+        v = _const_value(vexpr, ctx)
+        if isinstance(v, (list, dict)) or isinstance(v, bool) or v in (0, 1):
+            return None  # bool/int-identity or unhashable: general path
+        hit = catalog.prop_index(pn.labels[0], k).get(v)
+        if hit is None or len(hit) == 0:
+            return []  # no match: zero rows, exact semantics
+        if nodes_list is None:
+            nodes_list = catalog.nodes()
+        resolved.append((pn.var, [nodes_list[i] for i in hit.tolist()]))
+    # cross product over paths (usually 1 x 1)
+    out: List[Dict[str, Any]] = [{}]
+    for var, cands in resolved:
+        if len(cands) == 1:
+            c = cands[0]
+            for row in out:
+                row[var] = c
+        else:
+            out = [dict(row, **{var: c}) for row in out for c in cands]
+            if len(out) > _MAX_MATERIALIZED_ROWS:
+                return None
+    return out
 
 
 def _cartesian(bindings: List[_Bindings]) -> Optional[_Bindings]:

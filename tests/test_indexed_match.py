@@ -143,3 +143,63 @@ def test_merge_dedups_within_statement(ex):
     scan_ex.enable_fastpaths = False
     rs = scan_ex.execute("UNWIND [1, 1, 2, 2, 2] AS i MERGE (:Md {id: i})")
     assert rs.stats.nodes_created == 2
+
+
+class TestPointLookupWriteRows:
+    """The r5 point-lookup short-circuit in try_fast_match_rows: bare
+    `(v:L {p: $x})` comma paths resolve via two hash-index gets instead
+    of the full binding machinery. Parity vs the general interpreter on
+    every edge the shortcut declines (bools, 0/1, multi-candidate,
+    missing, WHERE)."""
+
+    def _seed(self, ex, n=50):
+        for i in range(n):
+            ex.execute("CREATE (:P {id: $i, name: $n, flag: $f})",
+                       {"i": i + 2, "n": f"p{i}", "f": i % 2 == 0})
+        # duplicate name -> multi-candidate lookups
+        ex.execute("CREATE (:P {id: 1000, name: 'p1'})")
+
+    def test_create_rel_between_point_matches(self, ex):
+        self._seed(ex)
+        r = ex.execute(
+            "MATCH (a:P {id: $a}), (b:P {id: $b}) "
+            "CREATE (a)-[:R]->(b)", {"a": 5, "b": 9})
+        assert r.stats.relationships_created == 1
+        got = ex.execute(
+            "MATCH (a:P {id: 5})-[:R]->(b:P) RETURN b.id").rows
+        assert got == [[9]]
+
+    def test_multi_candidate_cross_product(self, ex):
+        self._seed(ex)
+        # name 'p1' matches two nodes: cross product = 2 rows, 2 edges
+        r = ex.execute(
+            "MATCH (a:P {name: 'p1'}), (b:P {id: 7}) "
+            "CREATE (a)-[:R2]->(b)")
+        assert r.stats.relationships_created == 2
+
+    def test_no_match_creates_nothing(self, ex):
+        self._seed(ex)
+        r = ex.execute(
+            "MATCH (a:P {id: 999999}), (b:P {id: 7}) "
+            "CREATE (a)-[:R3]->(b)")
+        assert r.stats.relationships_created == 0
+
+    def test_bool_and_int_identity_stay_exact(self, ex):
+        self._seed(ex)
+        # flag=true must not match flag=1-typed values and vice versa —
+        # the shortcut declines these; semantics must still hold
+        ex.execute("CREATE (:P {id: 2000, flag: 1})")
+        rows = ex.execute(
+            "MATCH (a:P {flag: $f}) RETURN count(a)", {"f": 1}).rows
+        assert rows == [[1]]
+        rows_t = ex.execute(
+            "MATCH (a:P {flag: true}) RETURN count(a)").rows
+        assert rows_t == [[25]]
+
+    def test_set_through_point_match(self, ex):
+        self._seed(ex)
+        ex.execute("MATCH (a:P {id: 5}), (b:P {id: 9}) "
+                   "SET a.touched = true, b.touched = true")
+        assert ex.execute(
+            "MATCH (p:P) WHERE p.touched = true RETURN count(p)"
+        ).rows == [[2]]
