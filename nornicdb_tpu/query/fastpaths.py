@@ -1286,6 +1286,18 @@ def _as_float(col: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     if col.dtype != object:
         f = col.astype(np.float64, copy=False)
         return f, np.ones(len(col), dtype=bool)
+    # all-numeric columns (the ORDER BY hot path) convert in one C pass;
+    # astype raises on None/str/dict and silently accepts bools, so a
+    # cheap type-scan preserves the bool-is-not-a-number contract
+    try:
+        f = col.astype(np.float64)
+    except (TypeError, ValueError):
+        pass
+    else:
+        types = set(map(type, col.tolist()))  # one C pass, no py frames
+        if bool in types or np.bool_ in types:
+            return None
+        return f, np.ones(len(col), dtype=bool)
     vals = np.empty(len(col), dtype=np.float64)
     mask = np.zeros(len(col), dtype=bool)
     for i, x in enumerate(col.tolist()):
