@@ -88,6 +88,41 @@ class HTTPError(Exception):
         self.message = message
 
 
+class _GraphGeneration:
+    """MutationListener that versions the whole graph: every mutation
+    event (including bulk clears) bumps one counter, giving response-
+    bytes caches a safe validity token. The bump is an itertools.count
+    next() — atomic under the GIL, unlike `gen += 1`, whose lost
+    updates could leave the generation unmoved across a racing pair of
+    writes and let a stale entry validate."""
+
+    __slots__ = ("gen", "_c")
+
+    def __init__(self):
+        import itertools
+
+        self._c = itertools.count(1)
+        self.gen = 0
+
+    def _bump(self) -> None:
+        self.gen = next(self._c)
+
+    def on_node_upsert(self, node) -> None:
+        self._bump()
+
+    def on_node_delete(self, node_id) -> None:
+        self._bump()
+
+    def on_edge_upsert(self, edge) -> None:
+        self._bump()
+
+    def on_edge_delete(self, edge_id) -> None:
+        self._bump()
+
+    def on_bulk_change(self) -> None:
+        self._bump()
+
+
 class HttpServer:
     """One HTTP surface over a DB (+ optional multidb manager, auth,
     audit)."""
@@ -112,6 +147,21 @@ class HttpServer:
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._mcp = None  # lazily-mounted MCP endpoint (/mcp)
+        # /nornicdb/search response-bytes cache: (auth, body) ->
+        # (search generation, serialized 200 response)
+        from nornicdb_tpu.cache import LRUCache
+
+        self._search_wire: LRUCache = LRUCache(max_size=512,
+                                               ttl_seconds=300.0)
+        # /graphql response-bytes cache for query-kind documents, keyed
+        # the same way and validated against a graph-mutation
+        # generation fed by a storage listener (any write through any
+        # surface — bolt, tx API, qdrant, bulk clears — invalidates)
+        self._graphql_wire: LRUCache = LRUCache(max_size=512,
+                                                ttl_seconds=300.0)
+        self._graph_gen = _GraphGeneration()
+        if hasattr(db, "storage") and hasattr(db.storage, "add_listener"):
+            db.storage.add_listener(self._graph_gen)
 
     @property
     def mcp(self):
@@ -208,6 +258,36 @@ class HttpServer:
                     return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                if method == "POST" and self.path in ("/nornicdb/search",
+                                                      "/graphql"):
+                    # response-bytes wire cache (same pattern as the
+                    # qdrant gRPC Search): identical request bytes
+                    # against unchanged state skip execution, hit
+                    # copies AND json serialization entirely
+                    try:
+                        data = (outer._search_response_bytes(
+                                    body, self.headers)
+                                if self.path == "/nornicdb/search" else
+                                outer._graphql_response_bytes(
+                                    body, self.headers))
+                    except HTTPError as e:
+                        outer.metrics.inc("http_errors_total")
+                        self._reply(e.status, {"errors": [
+                            {"code": e.code, "message": e.message}]})
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        outer.metrics.inc("http_errors_total")
+                        self._reply(500, {"errors": [
+                            {"code": "Neo.DatabaseError.General."
+                                     "UnknownError",
+                             "message": str(e)}]})
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 try:
                     status, payload = outer.route(
                         method, self.path, body, self.headers)
@@ -572,6 +652,59 @@ class HttpServer:
                 "stats": r.stats.to_dict() if hasattr(r.stats, "to_dict") else {},
             })
         return {"results": results, "errors": errors}
+
+    def _search_response_bytes(self, body: bytes, headers) -> bytes:
+        """Serve POST /nornicdb/search from the response-bytes cache,
+        computing + storing on miss. Keyed on (Authorization, body) so a
+        differently-privileged caller can never ride another's entry;
+        generation-validated against the search result cache so any
+        index mutation invalidates (reference: searchResultCache
+        semantics, search.go:88-92)."""
+        svc = self.db.search
+        gen = svc._result_cache.generation
+        key = (headers.get("Authorization", ""), body)
+        hit = self._search_wire.get(key)
+        if hit is not None and hit[0] == gen:
+            self.metrics.inc("search_requests_total")
+            return hit[1]
+        status, payload = self.route("POST", "/nornicdb/search", body,
+                                     headers)
+        if status != 200:
+            raise HTTPError(status, "Neo.ClientError.Request.Invalid",
+                            str(payload)[:200])
+        data = json.dumps(payload, default=_json_default).encode()
+        self._search_wire.put(key, (gen, data))
+        return data
+
+    def _graphql_response_bytes(self, body: bytes, headers) -> bytes:
+        """Serve POST /graphql from the response-bytes cache. Only
+        query-kind documents are stored (mutations always execute), and
+        entries are validated against the graph-mutation generation, so
+        a write through ANY surface invalidates."""
+        gen = self._graph_gen.gen
+        key = (headers.get("Authorization", ""), body)
+        hit = self._graphql_wire.get(key)
+        if hit is not None and hit[0] == gen:
+            return hit[1]
+        status, payload = self.route("POST", "/graphql", body, headers)
+        if status != 200:
+            raise HTTPError(status, "Neo.ClientError.Request.Invalid",
+                            str(payload)[:200])
+        data = json.dumps(payload, default=_json_default).encode()
+        try:
+            from nornicdb_tpu.api.graphql import GraphQLAPI
+
+            doc = json.loads(body)
+            kind = GraphQLAPI.operation_kind(
+                doc.get("query", ""), doc.get("operationName"))
+        except Exception:
+            kind = "mutation"  # unparseable: never cache
+        if (kind == "query" and isinstance(payload, dict)
+                and not payload.get("errors")):
+            # gen was read BEFORE execution: a write racing the compute
+            # leaves a stale-gen entry the next get rejects
+            self._graphql_wire.put(key, (gen, data))
+        return data
 
     # -- REST convenience API --------------------------------------------
 

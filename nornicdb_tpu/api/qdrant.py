@@ -723,11 +723,11 @@ class QdrantCompat:
         """Cache-safe copy: _point_dict shares the node's payload dict
         by reference, so a caller mutating hit['payload'] must not
         rewrite the cached entry."""
-        import copy as _copy
+        from nornicdb_tpu.search.service import _copy_tree
 
         c = dict(d)
         if "payload" in c:
-            c["payload"] = _copy.deepcopy(c["payload"])
+            c["payload"] = _copy_tree(c["payload"])
         if "vector" in c:
             c["vector"] = list(c["vector"])
         return c

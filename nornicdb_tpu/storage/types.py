@@ -388,6 +388,10 @@ class MutationListener:
 
     def on_edge_delete(self, edge_id: EdgeID) -> None: ...
 
+    def on_bulk_change(self) -> None:
+        """Coarse invalidation hook for bulk mutations that carry no
+        per-entity events (clear, delete_by_prefix)."""
+
 
 class ListenableEngine(EngineDecorator):
     """Decorator that fans out mutation events to registered listeners."""
@@ -434,3 +438,18 @@ class ListenableEngine(EngineDecorator):
         self.inner.delete_edge(edge_id)
         for l in self._each():
             l.on_edge_delete(edge_id)
+
+    # bulk mutations would otherwise fall through __getattr__ with NO
+    # events — a generation-keyed cache above this engine would then
+    # serve state from before a clear()/prefix-drop forever
+
+    def delete_by_prefix(self, prefix: str):
+        out = self.inner.delete_by_prefix(prefix)
+        for l in self._each():
+            l.on_bulk_change()
+        return out
+
+    def clear(self) -> None:
+        self.inner.clear()
+        for l in self._each():
+            l.on_bulk_change()

@@ -403,3 +403,70 @@ class TestBrowserAdminWorkflows:
 
         src = inspect.getsource(cli.cmd_serve)
         assert "multidb_manager" in src
+
+
+class TestSearchWireCache:
+    """The /nornicdb/search response-bytes cache must be invisible:
+    identical requests serve cached bytes, but any index mutation
+    invalidates (generation guard), and authorization stays per-caller
+    (the key includes the Authorization header)."""
+
+    def test_mutation_invalidates_cached_response(self, server):
+        code, doc = req(server.port, "/nornicdb/search", "POST",
+                        {"query": "alpha fact", "limit": 10})
+        assert code == 200
+        before = {h["id"] for h in doc["results"]}
+        # same request again: served from the wire cache
+        code, doc2 = req(server.port, "/nornicdb/search", "POST",
+                        {"query": "alpha fact", "limit": 10})
+        assert {h["id"] for h in doc2["results"]} == before
+        # mutate the index through the REST store route
+        code, stored = req(server.port, "/nornicdb/store", "POST",
+                           {"content": "alpha fact about caching",
+                            "properties": {"content":
+                                           "alpha fact about caching"}})
+        assert code in (200, 201)
+        server.db.flush()
+        code, doc3 = req(server.port, "/nornicdb/search", "POST",
+                        {"query": "alpha fact", "limit": 10})
+        assert code == 200
+        ids3 = {h["id"] for h in doc3["results"]}
+        assert ids3 - before, "stale cached response served after mutation"
+
+
+class TestGraphQLWireCache:
+    """/graphql response-bytes cache: query documents are cached and any
+    graph mutation — through ANY surface, including bulk ops with no
+    per-entity events — invalidates; mutation documents never cache."""
+
+    def test_write_through_other_surface_invalidates(self, server):
+        gql = lambda q: req(server.port, "/graphql", "POST", {"query": q})
+        code, d1 = gql("{ nodeCount }")
+        assert code == 200
+        n1 = d1["data"]["nodeCount"]
+        # warm the cache
+        assert gql("{ nodeCount }")[1]["data"]["nodeCount"] == n1
+        # write through the Cypher tx surface, not graphql
+        req(server.port, "/db/neo4j/tx/commit", "POST",
+            {"statements": [{"statement": "CREATE (:WireCacheProbe)"}]})
+        code, d2 = gql("{ nodeCount }")
+        assert d2["data"]["nodeCount"] == n1 + 1
+
+    def test_bulk_clear_invalidates(self, server):
+        gql = lambda q, kind="query": req(
+            server.port, "/graphql", "POST", {"query": q})
+        base = gql("{ nodeCount }")[1]["data"]["nodeCount"]
+        req(server.port, "/db/neo4j/tx/commit", "POST",
+            {"statements": [{"statement": "CREATE (:ToClear)"}]})
+        assert gql("{ nodeCount }")[1]["data"]["nodeCount"] == base + 1
+        # bulk path with no per-entity events
+        server.db.storage.clear()
+        assert gql("{ nodeCount }")[1]["data"]["nodeCount"] == 0
+
+    def test_mutations_never_served_from_cache(self, server):
+        m = 'mutation { createNode(labels: ["M1"]) { id } }'
+        code, d1 = req(server.port, "/graphql", "POST", {"query": m})
+        code, d2 = req(server.port, "/graphql", "POST", {"query": m})
+        id1 = d1["data"]["createNode"]["id"]
+        id2 = d2["data"]["createNode"]["id"]
+        assert id1 != id2, "second mutation served cached response"
