@@ -244,8 +244,10 @@ class TestFiveSurfaceParity:
     FLOORS = {
         "bolt": 1200.0 * FLOOR_SCALE,
         "neo4j_http": 900.0 * FLOOR_SCALE,
-        "graphql": 500.0 * FLOOR_SCALE,
-        "rest_search": 1000.0 * FLOOR_SCALE,
+        # r5 wire caches lifted the idle numbers to 10k+; floors stay
+        # ~8x under idle so a loaded CI box can't flake the gate
+        "graphql": 1200.0 * FLOOR_SCALE,
+        "rest_search": 1500.0 * FLOOR_SCALE,
         "qdrant_grpc": 1000.0 * FLOOR_SCALE,
     }
 
