@@ -111,8 +111,27 @@ class MicroBatcher:
             self.batches += 1
             self.batched_queries += len(batch)
             self._last_batch = len(batch)
-            k_max = max(r.k for r in batch)
+            # k is usually a static jit arg too: bucket it alongside B
+            k_req = max(r.k for r in batch)
+            k_max = 1
+            while k_max < k_req:
+                k_max <<= 1
             queries = np.stack([r.vec for r in batch])
+            # pad the batch dim to a power-of-two bucket: every distinct
+            # B is a fresh XLA compile on an accelerator backend (~secs
+            # each over a tunnel), and arrival-rate batches take nearly
+            # every size — observed on silicon as 24 q/s instead of
+            # 100k+. Buckets cap the compile universe at log2(max_batch)
+            # shapes; the pad rows repeat row 0 (no NaN paths) and their
+            # results are dropped.
+            b = len(batch)
+            bucket = 1
+            while bucket < b:
+                bucket <<= 1
+            if bucket != b:
+                pad = np.broadcast_to(
+                    queries[0], (bucket - b,) + queries.shape[1:])
+                queries = np.concatenate([queries, pad], axis=0)
             results = self._search_batch(queries, k_max)
             for r, res in zip(batch, results):
                 r.result = res[: r.k] if r.k < k_max else res
