@@ -1,0 +1,302 @@
+"""Adversarial interleaving tests, batch 3: the Qdrant compat plane and
+search-index persistence (VERDICT r4 #7 — corpus depth).
+
+Covered interleaving classes:
+- alias rename/flips racing point searches and upserts through the
+  alias (never a 404 for a continuously-valid alias; upserts land in
+  exactly one of the flip targets)
+- collection create/delete churn racing searches on a stable sibling
+- wire cache generation vs concurrent upserts (search results through
+  the gRPC-cached layer never go backwards after an acked upsert)
+- index save (debounced snapshot writer) racing mutations: the
+  persisted snapshot always loads and re-serves a consistent index
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.api.qdrant import QdrantCompat, QdrantError
+from nornicdb_tpu.storage import MemoryEngine
+
+
+def _vec(i, dims=16):
+    rng = np.random.default_rng(i)
+    v = rng.standard_normal(dims)
+    return (v / np.linalg.norm(v)).tolist()
+
+
+def _mk(dims=16):
+    q = QdrantCompat(MemoryEngine())
+    q.create_collection("stable", {"size": dims, "distance": "Cosine"})
+    q.upsert_points("stable", [
+        {"id": i, "vector": _vec(i)} for i in range(50)
+    ])
+    return q
+
+
+class TestAliasFlipRaces:
+    def test_alias_flip_storm_searches_never_404(self):
+        """An alias continuously flipped between two live collections:
+        searches THROUGH the alias must always succeed and return
+        points belonging to one of the two targets — never a 404, never
+        a mixture."""
+        q = _mk()
+        q.create_collection("blue", {"size": 16, "distance": "Cosine"})
+        q.create_collection("green", {"size": 16, "distance": "Cosine"})
+        q.upsert_points("blue", [
+            {"id": 100 + i, "vector": _vec(100 + i)} for i in range(20)])
+        q.upsert_points("green", [
+            {"id": 200 + i, "vector": _vec(200 + i)} for i in range(20)])
+        q.update_aliases([{"create": {"alias": "live", "collection": "blue"}}])
+        errors = []
+        stop = threading.Event()
+
+        def flipper():
+            targets = ["green", "blue"]
+            for i in range(200):
+                q.update_aliases([
+                    {"delete": {"alias": "live"}},
+                    {"create": {"alias": "live",
+                                "collection": targets[i % 2]}},
+                ])
+
+        def searcher():
+            while not stop.is_set():
+                try:
+                    hits = q.search_points("live", _vec(1), limit=5)
+                except QdrantError as e:
+                    errors.append(("search", str(e)))
+                    return
+                ids = {h["id"] for h in hits}
+                if ids and not (
+                    all(100 <= i < 120 for i in ids)
+                    or all(200 <= i < 220 for i in ids)
+                ):
+                    errors.append(("mixed", ids))
+                    return
+
+        st = [threading.Thread(target=searcher) for _ in range(2)]
+        ft = threading.Thread(target=flipper)
+        for t in st:
+            t.start()
+        ft.start()
+        ft.join()
+        stop.set()
+        for t in st:
+            t.join()
+        assert errors == []
+
+    def test_upserts_through_flipping_alias_land_exactly_once(self):
+        """Writers upsert through the alias while it flips; every acked
+        point must exist in exactly one of the two targets."""
+        q = _mk()
+        q.create_collection("blue", {"size": 16, "distance": "Cosine"})
+        q.create_collection("green", {"size": 16, "distance": "Cosine"})
+        q.update_aliases([{"create": {"alias": "w", "collection": "blue"}}])
+        acked = []
+        lock = threading.Lock()
+
+        def flipper():
+            targets = ["green", "blue"]
+            for i in range(100):
+                q.update_aliases([
+                    {"delete": {"alias": "w"}},
+                    {"create": {"alias": "w",
+                                "collection": targets[i % 2]}},
+                ])
+
+        def writer(t):
+            for i in range(50):
+                pid = 1000 + t * 100 + i
+                q.upsert_points("w", [{"id": pid, "vector": _vec(pid)}])
+                with lock:
+                    acked.append(pid)
+
+        ft = threading.Thread(target=flipper)
+        wts = [threading.Thread(target=writer, args=(t,)) for t in range(2)]
+        ft.start()
+        for t in wts:
+            t.start()
+        ft.join()
+        for t in wts:
+            t.join()
+        blue = {p["id"] for p in q.scroll_points(
+            "blue", limit=10_000)["points"]}
+        green = {p["id"] for p in q.scroll_points(
+            "green", limit=10_000)["points"]}
+        for pid in acked:
+            in_blue = pid in blue
+            in_green = pid in green
+            assert in_blue or in_green, f"acked point {pid} vanished"
+            assert not (in_blue and in_green), f"point {pid} duplicated"
+
+
+class TestCollectionChurnVsSearch:
+    def test_create_delete_churn_isolated(self):
+        q = _mk()
+        errors = []
+        stop = threading.Event()
+
+        def churner(t):
+            for i in range(40):
+                name = f"tmp{t}"
+                try:
+                    q.create_collection(name, {"size": 16,
+                                               "distance": "Cosine"})
+                    q.upsert_points(name, [{"id": 1, "vector": _vec(1)}])
+                    q.delete_collection(name)
+                except QdrantError as e:
+                    if "already exists" not in str(e) \
+                            and "not found" not in str(e):
+                        errors.append(str(e))
+
+        def searcher():
+            while not stop.is_set():
+                try:
+                    hits = q.search_points("stable", _vec(3), limit=5)
+                    if len(hits) == 0:
+                        errors.append("stable search went empty")
+                        return
+                except QdrantError as e:  # pragma: no cover
+                    errors.append(str(e))
+                    return
+
+        st = threading.Thread(target=searcher)
+        cts = [threading.Thread(target=churner, args=(t,))
+               for t in range(3)]
+        st.start()
+        for t in cts:
+            t.start()
+        for t in cts:
+            t.join()
+        stop.set()
+        st.join()
+        assert errors == []
+        assert len(q.search_points("stable", _vec(3), limit=5)) == 5
+
+
+class TestWireCacheVsUpserts:
+    def test_search_results_never_regress_after_acked_upsert(self):
+        """Readers repeat one query while a writer adds points ever
+        closer to the query vector. Once a reader has seen point N in
+        the top-1, no later read may revert to an older point — a
+        cached entry surviving its generation bump would do exactly
+        that."""
+        q = _mk()
+        target = np.asarray(_vec(999))
+        acked = [0]  # highest point index whose upsert has RETURNED
+        errors = []
+        saw_new = [0]
+        stop = threading.Event()
+
+        # orthonormal complement of the target: point i sits at angle
+        # theta_i, strictly decreasing in i, so similarity to the
+        # target is strictly increasing — monotone by construction
+        u = np.asarray(_vec(555))
+        u = u - target * float(target @ u)
+        u = u / np.linalg.norm(u)
+
+        def writer():
+            for i in range(1, 40):
+                theta = 1.0 / (i + 1.0)
+                v = (np.cos(theta) * target + np.sin(theta) * u).tolist()
+                q.upsert_points("stable", [{"id": 5000 + i, "vector": v}])
+                acked[0] = i  # publish AFTER the ack returned
+                time.sleep(0.002)
+
+        def reader():
+            # the contract under test: a request that STARTS after
+            # upsert i acked must observe at least point i — a cached
+            # entry surviving its generation bump would serve older
+            while not stop.is_set():
+                floor = acked[0]
+                hits = q.search_points("stable", target.tolist(), limit=1)
+                if not hits:
+                    continue
+                top = hits[0]["id"]
+                n = top - 5000 if top >= 5000 else 0
+                if n < floor:
+                    errors.append((floor, n))
+                    return
+                if n:
+                    saw_new[0] = max(saw_new[0], n)
+
+        wt = threading.Thread(target=writer)
+        rts = [threading.Thread(target=reader) for _ in range(2)]
+        wt.start()
+        for t in rts:
+            t.start()
+        wt.join()
+        stop.set()
+        for t in rts:
+            t.join()
+        assert errors == [], f"stale cached result after ack: {errors}"
+        assert saw_new[0] > 0  # the race actually exercised the path
+
+
+class TestIndexPersistenceRaces:
+    def test_save_racing_mutations_always_loads_consistent(self, tmp_path):
+        """SearchService snapshot writers race indexers/removers; every
+        snapshot written must load into a service that answers searches
+        consistently with SOME prefix of the mutation stream."""
+        from nornicdb_tpu.embed.embedder import HashEmbedder
+        from nornicdb_tpu.search.service import SearchService
+        from nornicdb_tpu.storage.types import Node
+
+        store = MemoryEngine()
+        svc = SearchService(storage=store, embedder=HashEmbedder(dims=16),
+                            persist_dir=str(tmp_path), save_debounce_s=0.0)
+        ids = []
+        for i in range(100):
+            node = Node(id=f"d{i}", labels=["Doc"],
+                        properties={"text": f"document {i} topic {i % 5}"})
+            store.create_node(node)
+            svc.index_node(node)
+            ids.append(node.id)
+        stop = threading.Event()
+        save_errors = []
+
+        def saver():
+            while not stop.is_set():
+                try:
+                    svc.save_indexes()
+                except Exception as exc:  # pragma: no cover
+                    save_errors.append(repr(exc))
+
+        def mutator(t):
+            for i in range(50):
+                nid = f"m{t}_{i}"
+                node = Node(id=nid, labels=["Doc"],
+                            properties={"text": f"mutant {t} {i}"})
+                store.create_node(node)
+                svc.index_node(node)
+                if i % 3 == 0:
+                    svc.remove_node(nid)
+                    store.delete_node(nid)
+
+        st = threading.Thread(target=saver)
+        mts = [threading.Thread(target=mutator, args=(t,))
+               for t in range(3)]
+        st.start()
+        for t in mts:
+            t.start()
+        for t in mts:
+            t.join()
+        stop.set()
+        st.join()
+        assert save_errors == []
+        svc.save_indexes()
+        svc.close()
+
+        # a fresh service must load the snapshot and serve
+        svc2 = SearchService(storage=store, embedder=HashEmbedder(dims=16),
+                             persist_dir=str(tmp_path))
+        assert svc2.load_indexes()
+        hits = svc2.search("document topic", limit=10, mode="text")
+        assert hits
+        for h in hits:
+            assert store.has_node(h["id"])
+        svc2.close()

@@ -166,7 +166,7 @@ class TestIndexBuildVsMutation:
         # must not be findable and survivors must be
         svc.build_indexes()
         hits = svc.search("document number 1001", limit=10,
-                          mode="fulltext")
+                          mode="text")
         ids = {h["id"] for h in hits}
         for nid in ids:
             assert store.has_node(nid), f"search surfaced deleted {nid}"
