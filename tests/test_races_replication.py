@@ -264,7 +264,9 @@ class TestRaftUnderWrites:
             cfg = ReplicationConfig(
                 mode="raft", node_id=f"rr{i}",
                 peers=[a for j, a in enumerate(addrs) if j != i],
-                heartbeat_interval=0.05, election_timeout=(0.2, 0.4),
+                # generous timing: a loaded CI box must not livelock
+                # the election into a spurious failure
+                heartbeat_interval=0.08, election_timeout=(0.3, 0.7),
             )
             eng = engines[i]
 
@@ -292,7 +294,7 @@ class TestRaftUnderWrites:
             old = leader
             old_i = nodes.index(old)
             old.close()
-            deadline = time.monotonic() + 8.0
+            deadline = time.monotonic() + 20.0
             new_leader = None
             while time.monotonic() < deadline and new_leader is None:
                 cands = [n for n in nodes
