@@ -998,7 +998,9 @@ def _bench_knn():
 
     mb = MicroBatcher(search_batch, max_batch=64)
     host_qs = [np.asarray(q[0]) for q in qs]
-    n_threads = 32
+    # enough offered load to fill 64-wide batches (32 clients cap the
+    # mean coalesced batch at ~22, leaving device throughput unreached)
+    n_threads = 64
     stop = threading.Event()
     counts = [0] * n_threads
 
