@@ -1287,8 +1287,11 @@ def _as_float(col: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         f = col.astype(np.float64, copy=False)
         return f, np.ones(len(col), dtype=bool)
     # all-numeric columns (the ORDER BY hot path) convert in one C pass;
-    # astype raises on None/str/dict and silently accepts bools, so a
-    # cheap type-scan preserves the bool-is-not-a-number contract
+    # astype raises on str/dict — but silently accepts bools AND maps
+    # None to nan, so a type-scan preserves the bool-is-not-a-number
+    # contract and the nan slots are audited back to a null mask
+    # (caught by the differential fuzzer: avg() over a column with
+    # nulls summed the nans into nan)
     try:
         f = col.astype(np.float64)
     except (TypeError, ValueError):
@@ -1297,7 +1300,17 @@ def _as_float(col: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         types = set(map(type, col.tolist()))  # one C pass, no py frames
         if bool in types or np.bool_ in types:
             return None
-        return f, np.ones(len(col), dtype=bool)
+        mask = ~np.isnan(f)
+        if not mask.all() and type(None) not in types:
+            # genuine float('nan') values, not nulls: they count
+            mask[:] = True
+        elif not mask.all():
+            # mixed: nan slots are null UNLESS the object is a float
+            lst = col.tolist()
+            for i in np.flatnonzero(~mask).tolist():
+                if lst[i] is not None:
+                    mask[i] = True
+        return f, mask
     vals = np.empty(len(col), dtype=np.float64)
     mask = np.zeros(len(col), dtype=bool)
     for i, x in enumerate(col.tolist()):
@@ -2191,9 +2204,14 @@ def _order_key(expr, ret, cols, out_cols, b, catalog, ctx) -> np.ndarray:
     for i, item in enumerate(ret.items):
         if item.expr == expr:
             return out_cols[i]
-    # 3. non-agg queries: any vectorizable expression over bindings
+    # 3. non-agg queries: any vectorizable expression over bindings.
+    # Not under DISTINCT: the projection was already reduced to first
+    # occurrences, while bindings still hold every row — the key column
+    # would be the wrong length (and the wrong rows). General path owns
+    # order-by-unprojected-expression + DISTINCT semantics.
     from nornicdb_tpu.query.executor import _contains_agg
 
-    if not any(_contains_agg(i.expr) for i in ret.items):
+    if not ret.distinct and not any(
+            _contains_agg(i.expr) for i in ret.items):
         return _vec_col(expr, b, catalog, ctx)
     _bail()
