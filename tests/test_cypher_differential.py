@@ -142,6 +142,34 @@ def _gen_query(rng):
     return f"MATCH {pattern}{where} {ret}{order}{tail}"
 
 
+def _gen_advanced(rng):
+    """Clause-family shapes beyond the single-MATCH core."""
+    label = rng.choice(LABELS)
+    l2 = rng.choice(LABELS)
+    t = rng.choice(REL_TYPES)
+    kind = rng.random()
+    if kind < 0.25:
+        return (f"MATCH (n:{label}) OPTIONAL MATCH (n)-[:{t}]->(m:{l2}) "
+                f"RETURN n.id, count(m) ORDER BY n.id")
+    if kind < 0.45:
+        lo = 1
+        hi = rng.randrange(1, 3)
+        return (f"MATCH (n:{label})-[:{t}*{lo}..{max(lo, hi)}]->(m) "
+                f"RETURN n.id, count(m)")
+    if kind < 0.65:
+        p1, _ = rng.choice(PROPS[label])
+        p2, _ = rng.choice(PROPS[l2])
+        return (f"MATCH (n:{label}) RETURN n.{p1} AS v "
+                f"UNION MATCH (m:{l2}) RETURN m.{p2} AS v")
+    if kind < 0.85:
+        return (f"MATCH (n:{label})-[:{t}]->(m) WITH n, count(m) AS deg "
+                f"WHERE deg >= {rng.randrange(1, 3)} "
+                f"RETURN n.id, deg ORDER BY deg DESC, n.id "
+                f"LIMIT {rng.randrange(1, 10)}")
+    return (f"MATCH (n:{label}) WHERE (n)-[:{t}]->() "
+            f"RETURN count(n)")
+
+
 def _canon(result):
     def one(v):
         if isinstance(v, float):
@@ -158,8 +186,8 @@ def test_differential_fuzz(seed):
     slow.enable_fastpaths = False
     slow.enable_query_cache = False
     _build_graph(rng, [fast, slow])
-    for qi in range(40):
-        q = _gen_query(rng)
+    for qi in range(52):
+        q = _gen_query(rng) if qi % 4 else _gen_advanced(rng)
         rf = fast.execute(q)
         rs = slow.execute(q)
         assert _canon(rf) == _canon(rs), (
