@@ -1,0 +1,188 @@
+"""Adversarial interleaving tests, batch 4: the AI-native plane
+(VERDICT r4 #7 — decay, inference, temporal tracking under concurrent
+writers; these subsystems had no concurrency coverage at all).
+
+Covered interleaving classes:
+- decay sweep racing access recording and node deletion (the sweep
+  must never delete a node whose access was recorded before the sweep
+  read it, and must survive nodes vanishing mid-sweep)
+- inference on_store racing deletes of the stored/suggested nodes
+  (suggestion creation must not resurrect or crash on vanished ends)
+- temporal tracker fed from many threads: session/velocity invariants
+"""
+
+import threading
+import time
+
+import pytest
+
+from nornicdb_tpu.storage import MemoryEngine
+from nornicdb_tpu.storage.types import Node
+
+
+def _node(i, **extra):
+    props = {"content": f"memory {i} about topic {i % 5}"}
+    props.update(extra)
+    return Node(id=f"n{i}", labels=["Memory"], properties=props)
+
+
+class TestDecayVsWrites:
+    def test_sweep_racing_access_and_delete(self):
+        from nornicdb_tpu.decay import DecayManager
+
+        store = MemoryEngine()
+        for i in range(120):
+            store.create_node(_node(i))
+        mgr = DecayManager(store)
+        errors = []
+        stop = threading.Event()
+
+        def sweeper():
+            while not stop.is_set():
+                try:
+                    mgr.sweep()
+                except Exception as exc:  # pragma: no cover
+                    errors.append(("sweep", repr(exc)))
+                    return
+
+        def accessor(t):
+            for i in range(300):
+                try:
+                    mgr.record_access(f"n{(t * 37 + i) % 120}")
+                except Exception as exc:  # pragma: no cover
+                    errors.append(("access", repr(exc)))
+                    return
+
+        def deleter():
+            for i in range(0, 120, 7):
+                try:
+                    store.delete_node(f"n{i}")
+                except KeyError:
+                    pass
+                time.sleep(0)
+
+        threads = ([threading.Thread(target=sweeper)]
+                   + [threading.Thread(target=accessor, args=(t,))
+                      for t in range(3)]
+                   + [threading.Thread(target=deleter)])
+        for t in threads:
+            t.start()
+        for t in threads[1:]:
+            t.join()
+        stop.set()
+        threads[0].join()
+        mgr.stop()
+        assert errors == []
+        # deleted nodes stay deleted; survivors still scoreable
+        for i in range(0, 120, 7):
+            assert not store.has_node(f"n{i}")
+        scores = mgr.scores()
+        for s in scores:
+            assert store.has_node(s.node_id)
+
+    def test_tier_promotion_monotone_under_concurrent_access(self):
+        """Concurrent record_access on ONE node: the tier must only
+        ever move toward longer retention, never regress mid-storm."""
+        from nornicdb_tpu.decay import DecayManager
+
+        store = MemoryEngine()
+        store.create_node(_node(1))
+        mgr = DecayManager(store)
+        order = {"short": 0, "medium": 1, "long": 2, "permanent": 3}
+        seen = []
+        seen_lock = threading.Lock()
+        errors = []
+
+        def hammer():
+            for _ in range(200):
+                mgr.record_access("n1")
+                tier = mgr.tier_of("n1")
+                with seen_lock:
+                    seen.append(tier)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mgr.stop()
+        assert errors == []
+        ranks = [order.get(t, 0) for t in seen]
+        # global monotonicity can interleave; the FINAL state must be
+        # the highest tier ever observed
+        assert order.get(mgr.tier_of("n1"), 0) == max(ranks)
+
+
+class TestInferenceVsDeletes:
+    def test_on_store_racing_delete_of_candidates(self):
+        """on_store computes similarity suggestions and may create
+        edges; candidate nodes vanish concurrently. No crash, and no
+        edge may reference a node that was already deleted when the
+        edge landed."""
+        from nornicdb_tpu.inference import InferenceEngine
+
+        store = MemoryEngine()
+        for i in range(80):
+            n = _node(i)
+            n.embedding = [float((i * 7 + j) % 10) for j in range(8)]
+            store.create_node(n)
+        eng = InferenceEngine(store, similarity_threshold=0.0,
+                              cooldown_s=0.0)
+        errors = []
+
+        def storer(t):
+            for i in range(25):
+                nid = 1000 + t * 100 + i
+                n = _node(nid)
+                n.embedding = [float((nid + j) % 10) for j in range(8)]
+                store.create_node(n)
+                try:
+                    eng.on_store(n)
+                except Exception as exc:  # pragma: no cover
+                    errors.append(repr(exc))
+                    return
+
+        def deleter():
+            for i in range(0, 80, 3):
+                try:
+                    store.delete_node(f"n{i}")
+                except KeyError:
+                    pass
+                time.sleep(0)
+
+        threads = ([threading.Thread(target=storer, args=(t,))
+                    for t in range(2)]
+                   + [threading.Thread(target=deleter)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        for e in store.all_edges():
+            assert store.has_node(e.start_node), f"dangling edge {e.id}"
+            assert store.has_node(e.end_node), f"dangling edge {e.id}"
+
+
+class TestTemporalTrackerConcurrency:
+    def test_accesses_from_many_threads_consistent_totals(self):
+        from nornicdb_tpu.temporal import TemporalTracker
+
+        store = MemoryEngine()
+        for i in range(10):
+            store.create_node(_node(i))
+        tr = TemporalTracker()
+        n_threads, per = 6, 150
+
+        def worker(t):
+            for i in range(per):
+                tr.record_access(f"n{i % 10}",
+                                 at=1_700_000_000.0 + (t * per + i))
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = sum(st.count for st in tr._stats.values())
+        assert total == n_threads * per
