@@ -250,3 +250,80 @@ class TestFuzzFoundRegressions:
             ex.execute("CREATE (:P {id: 3, age: 1})")
         q = "MATCH (n:P) RETURN n.id ORDER BY n.age"
         assert fast.execute(q).rows == slow.execute(q).rows == [[3], [1], [2]]
+
+
+def _gen_write(rng, next_id):
+    """One random write statement; next_id is a mutable counter so
+    created ids never collide."""
+    kind = rng.random()
+    label = rng.choice(LABELS)
+    if kind < 0.35:
+        i = next_id[0]
+        next_id[0] += 1
+        pname, ptype = rng.choice(PROPS[label])
+        val = (rng.randrange(20) if ptype == "int"
+               else f"'{pname}{rng.randrange(8)}'" if ptype == "str"
+               else rng.choice(["true", "false"]))
+        return f"CREATE (:{label} {{id: {i}, {pname}: {val}}})"
+    if kind < 0.55:
+        t = rng.choice(REL_TYPES)
+        return (f"MATCH (a {{id: {rng.randrange(40)}}}), "
+                f"(b {{id: {rng.randrange(40)}}}) "
+                f"CREATE (a)-[:{t}]->(b)")
+    if kind < 0.75:
+        pname, ptype = rng.choice(PROPS[label])
+        val = (rng.randrange(20) if ptype == "int"
+               else f"'{pname}{rng.randrange(8)}'" if ptype == "str"
+               else rng.choice(["true", "false"]))
+        return (f"MATCH (n:{label} {{id: {rng.randrange(40)}}}) "
+                f"SET n.{pname} = {val}")
+    if kind < 0.88:
+        return (f"MATCH (n {{id: {rng.randrange(40)}}}) "
+                f"DETACH DELETE n")
+    t = rng.choice(REL_TYPES)
+    return (f"MATCH (a {{id: {rng.randrange(40)}}})-[r:{t}]->() "
+            f"DELETE r")
+
+
+def _state_digest(ex):
+    rows = []
+    rows += _canon(ex.execute(
+        "MATCH (n) RETURN labels(n), n.id, n.age, n.name, n.score, "
+        "n.size, n.title, n.active"))
+    rows += _canon(ex.execute(
+        "MATCH (a)-[r]->(b) RETURN type(r), a.id, b.id"))
+    return rows
+
+
+@pytest.mark.parametrize("seed", list(range(8)))
+def test_differential_write_fuzz(seed):
+    """Randomized mixed write/read sessions: both engines must agree on
+    every statement's rows AND the resulting graph state."""
+    rng = random.Random(5000 + seed)
+    fast = CypherExecutor(NamespacedEngine(MemoryEngine(), "dw"))
+    slow = CypherExecutor(NamespacedEngine(MemoryEngine(), "dw"))
+    slow.enable_fastpaths = False
+    slow.enable_query_cache = False
+    _build_graph(rng, [fast, slow])
+    next_id = [10_000]
+    for qi in range(120):
+        if rng.random() < 0.55:
+            q = _gen_write(rng, next_id)
+        else:
+            q = _gen_query(rng)
+        rf = fast.execute(q)
+        rs = slow.execute(q)
+        assert _canon(rf) == _canon(rs), (
+            f"seed={seed} stmt #{qi} rows diverged:\n  {q}")
+        sf, ss = rf.stats, rs.stats
+        assert (sf.nodes_created, sf.nodes_deleted,
+                sf.relationships_created, sf.relationships_deleted,
+                sf.properties_set) == \
+               (ss.nodes_created, ss.nodes_deleted,
+                ss.relationships_created, ss.relationships_deleted,
+                ss.properties_set), (
+            f"seed={seed} stmt #{qi} stats diverged:\n  {q}")
+        if qi % 30 == 29:
+            assert _state_digest(fast) == _state_digest(slow), (
+                f"seed={seed} state diverged by stmt #{qi} after {q}")
+    assert _state_digest(fast) == _state_digest(slow)
