@@ -663,6 +663,10 @@ class HNSWIndex:
             # the beam proportionally or k alive survivors may not remain
             if self._tombstones:
                 ef = int(ef * (1.0 + 2.0 * self.tombstone_ratio)) + 1
+            native = self._native_query(q[None, :], ef)
+            if native is not None:
+                wd, ws = native
+                return self._collect_alive(wd[0, 0], ws[0, 0], k)
             ep = [(float(1.0 - self._vectors[self._entry] @ q), self._entry)]
             for lv in range(self._max_level, 0, -1):
                 ep = self._search_layer(q, ep, 1, lv)
@@ -675,6 +679,39 @@ class HNSWIndex:
                 if len(out) >= k:
                     break
             return out
+
+    def _native_query(self, Q: np.ndarray, ef: int):
+        """Query-time use of the native wave kernel: query_levels=0, so
+        the beam is collected at level 0 only after a greedy descent —
+        classic HNSW search, same distance evaluations as the Python
+        heap path without its interpreter overhead. Caller holds the
+        lock. Returns (dists, slots) or None when the kernel is absent."""
+        from nornicdb_tpu.search.hnsw_native import get_lib, wave_search
+
+        lib = get_lib()
+        if lib is None or not hasattr(lib, "hnsw_wave_search"):
+            return None
+        n_levels = min(len(self._nbrL), self._max_level + 1)
+        if n_levels <= 0:
+            return None
+        return wave_search(
+            lib, self._vectors, self._nbrL[:n_levels],
+            self._cntL[:n_levels],
+            np.ascontiguousarray(Q, np.float32),
+            np.zeros(len(Q), np.int64), self._entry, ef,
+            self._capacity)
+
+    def _collect_alive(self, dists, slots, k: int):
+        out = []
+        for d, slot in zip(dists.tolist(), slots.tolist()):
+            if slot < 0:
+                break
+            if not self._alive[slot]:
+                continue
+            out.append((self._ext_ids[slot], 1.0 - d))
+            if len(out) >= k:
+                break
+        return out
 
     def search_batch(
         self,
@@ -700,6 +737,11 @@ class HNSWIndex:
             ef = max(ef or self.ef_search, k)
             if self._tombstones:
                 ef = int(ef * (1.0 + 2.0 * self.tombstone_ratio)) + 1
+            native = self._native_query(Q, ef)
+            if native is not None:
+                wd, ws = native
+                return [self._collect_alive(wd[j, 0], ws[j, 0], k)
+                        for j in range(B)]
             visited, gen = self._visit_scratch(B)
             d0 = 1.0 - Q @ self._vectors[self._entry]
             bd = np.full((B, ef), np.inf, dtype=np.float32)

@@ -238,9 +238,12 @@ class TestHNSWConcurrency:
 
         def remover():
             for i in range(0, 300, 2):
+                idx.remove(f"v{i}")
+                # record AFTER the removal completes: the contract is
+                # "removed BEFORE the search began", and publishing the
+                # id early flags legitimately-concurrent results
                 with removed_lock:
                     removed.add(f"v{i}")
-                idx.remove(f"v{i}")
 
         def searcher():
             rng = np.random.default_rng(7)
