@@ -327,3 +327,27 @@ def test_differential_write_fuzz(seed):
             assert _state_digest(fast) == _state_digest(slow), (
                 f"seed={seed} state diverged by stmt #{qi} after {q}")
     assert _state_digest(fast) == _state_digest(slow)
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("NORNICDB_FUZZ_EXTENDED"),
+    reason="extended sweep: set NORNICDB_FUZZ_EXTENDED=1 (~60s)")
+@pytest.mark.parametrize("block", [0, 1, 2, 3])
+def test_differential_fuzz_extended(block):
+    """Opt-in wide sweep (60+ seeds across blocks) mixing reads, writes
+    and advanced clauses — run before releases / after engine changes."""
+    for seed in range(200 + block * 15, 215 + block * 15):
+        rng = random.Random(seed)
+        fast = CypherExecutor(NamespacedEngine(MemoryEngine(), "xx"))
+        slow = CypherExecutor(NamespacedEngine(MemoryEngine(), "xx"))
+        slow.enable_fastpaths = False
+        slow.enable_query_cache = False
+        _build_graph(rng, [fast, slow])
+        next_id = [10_000]
+        for qi in range(60):
+            r = rng.random()
+            q = (_gen_write(rng, next_id) if r < 0.3
+                 else _gen_advanced(rng) if r < 0.5 else _gen_query(rng))
+            assert _canon(fast.execute(q)) == _canon(slow.execute(q)), (
+                f"seed={seed} #{qi}: {q}")
+        assert _state_digest(fast) == _state_digest(slow), f"seed={seed}"
