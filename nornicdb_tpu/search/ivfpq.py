@@ -114,6 +114,10 @@ class IVFPQIndex:
         self._id_pos: Dict[str, int] = {}
         self._alive: Optional[np.ndarray] = None  # [N] bool
         self._lock = threading.Lock()
+        # search-snapshot cache: (mut_gen, codes, assign, alive); any
+        # mutation bumps _mut_gen, invalidating it
+        self._mut_gen = 0
+        self._snap = None
 
     # -- training --------------------------------------------------------
 
@@ -185,6 +189,7 @@ class IVFPQIndex:
                                      dtype=np.float32))
         assign, codes = self._encode(vecs)
         with self._lock:
+            self._mut_gen += 1
             existing = 0 if self._codes is None else len(self._codes)
             new_rows: List[int] = []
             staged: Dict[str, int] = {}  # ext_id -> index into new_rows
@@ -233,6 +238,7 @@ class IVFPQIndex:
             pos = self._id_pos.get(ext_id)
             if pos is None or not self._alive[pos]:
                 return False
+            self._mut_gen += 1
             self._alive[pos] = False
             return True
 
@@ -261,10 +267,13 @@ class IVFPQIndex:
         out_pos: List[np.ndarray] = []
         with self._lock:
             # snapshot by value: add_batch/remove mutate rows in place,
-            # so reference-only snapshots could read torn code rows
-            codes = self._codes.copy()
-            assign = self._assign.copy()
-            alive = self._alive.copy()
+            # so reference-only snapshots could read torn code rows.
+            # The copy is generation-cached — copying 50k x 32 codes per
+            # QUERY was the ADC path's single biggest cost
+            if self._snap is None or self._snap[0] != self._mut_gen:
+                self._snap = (self._mut_gen, self._codes.copy(),
+                              self._assign.copy(), self._alive.copy())
+            _g, codes, assign, alive = self._snap
             has_refine = self._vecs is not None
         for c in probe:
             mask = (assign == c) & alive
