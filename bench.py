@@ -155,11 +155,26 @@ def run_stage(stage: str) -> int:
     return 0
 
 
-def main():
+def main(dry_run: bool = False):
     # Cypher first: it needs no accelerator, so a TPU-tunnel outage can
     # never cost the headline number.
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    cypher = _bench_cypher()
+    if dry_run:
+        # schema-faithful fast pass (same stages, toy sizes, CPU-pinned):
+        # validates the whole artifact chain — including the new
+        # framework_floor calibration — in well under a minute, so a
+        # malformed artifact can never land silently (the default test
+        # suite runs this; tests/test_bench_output.py)
+        os.environ["NORNICDB_BENCH_FORCE_CPU"] = "1"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("NORNICDB_E2E_CONCURRENCY", "4")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        cypher = _bench_cypher(n_people=2_000, n_msgs=4_000, knows_per=8,
+                               measure_s=0.25)
+    else:
+        cypher = _bench_cypher()
     result = {
         # The reference's headline benchmarks are the LDBC-SNB/Northwind
         # Cypher rates (BASELINE.md rows 1-7); the geomean across that
@@ -170,6 +185,24 @@ def main():
         "vs_baseline": cypher["ldbc_geomean_vs_baseline"],
         "cypher": cypher,
     }
+    if dry_run:
+        result["dry_run"] = True
+        try:
+            result["knn"] = _bench_knn(tiny=True)
+        except Exception as exc:
+            result["knn"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+        result["northstar"] = {"skipped": "dry-run"}
+        try:
+            result["surfaces"] = _bench_surfaces(n_people=80, secs=0.3,
+                                                 warmup_s=0.1)
+        except Exception as exc:
+            result["surfaces"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:400]}
+        result["tpu_proof"] = {"skipped": "dry-run"}
+        print(json.dumps(result))
+        sys.stdout.flush()
+        print(json.dumps(_compact_summary(result)))
+        return
     # device-touching stages run subprocess-isolated under deadlines (a
     # mid-run tunnel drop blocks forever otherwise); the accelerator
     # half must never cost the already-computed Cypher headline
@@ -224,6 +257,7 @@ def _compact_summary(result):
         for name in _SURFACE_BASELINES
         if isinstance(g(result, "surfaces", name), dict)
     }
+    qfloor = g(result, "surfaces", "qdrant_grpc", "framework_floor")
     tpu = result.get("tpu_proof")
     if isinstance(tpu, dict):
         tpu_brief = (tpu.get("skipped") and "skipped") or (
@@ -265,7 +299,12 @@ def _compact_summary(result):
                                        "pagerank_device",
                                        "speedup_vs_numpy"),
         "surfaces": surfaces,
+        # what grpc-python can physically do on this box with this
+        # harness, and how close the real surface got (the perf gate)
+        "qdrant_floor": [qfloor,
+                         g(result, "surfaces", "qdrant_grpc", "vs_floor")],
         "tpu_proof": tpu_brief,
+        **({"dry_run": True} if result.get("dry_run") else {}),
     }
 
 
@@ -436,6 +475,44 @@ _SURFACE_BASELINES = {
     "rest_search": 10296.0,
     "qdrant_grpc": 29331.0,
 }
+
+
+def _echo_floor_server(payload: bytes):
+    """Same-box grpc-python calibration server: a grpc.aio server whose
+    single raw-bytes handler returns ``payload`` unconditionally — the
+    physical ceiling of what ANY python gRPC server can serve with this
+    harness on this box. Returns (port, stop_fn)."""
+    import asyncio
+    import threading
+
+    import grpc
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True,
+                     name="bench-echo-floor").start()
+
+    async def build():
+        server = grpc.aio.server()
+
+        async def echo(data, context):
+            return payload
+
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "bench.Floor",
+                {"Echo": grpc.unary_unary_rpc_method_handler(echo)}),
+        ))
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        return server, port
+
+    server, port = asyncio.run_coroutine_threadsafe(build(), loop).result(30)
+
+    def stop():
+        asyncio.run_coroutine_threadsafe(server.stop(0.1), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+
+    return port, stop
 
 
 class _LeanHttpClient:
@@ -636,6 +713,10 @@ def _bench_surfaces(n_people: int = 1000, secs: float = 2.0,
                             vector=list(target.embedding), limit=5)
 
         sr_bytes = sr.SerializeToString()
+        # canned response for the echo-floor calibration: the REAL
+        # serialized Search response, so the floor moves the same bytes
+        resp_payload = grpc_call("/qdrant.Points/Search", sr,
+                                 q.SearchResponse).SerializeToString()
 
         def grpc_worker():
             # per-worker channel: one shared channel would multiplex all
@@ -657,12 +738,34 @@ def _bench_surfaces(n_people: int = 1000, secs: float = 2.0,
             return (lambda: stub(sr_bytes)), wch.close
 
         out["qdrant_grpc"] = sustain(grpc_worker)
+
+        # -- framework-floor calibration (same harness, same box) -----
+        # An echo handler serving the identical response bytes bounds
+        # what grpc-python can physically do here; the artifact carries
+        # it so "within 0.95x of the framework" is a driver-verifiable
+        # claim instead of PERF.md prose. Measured AFTER the real
+        # surface with identical concurrency/windows, so box load
+        # cancels out of the ratio as much as one run allows.
+        floor_port, stop_floor = _echo_floor_server(resp_payload)
+        try:
+            def floor_worker():
+                wch = grpc.insecure_channel(f"127.0.0.1:{floor_port}")
+                stub = wch.unary_unary(
+                    "/bench.Floor/Echo",
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b)
+                return (lambda: stub(sr_bytes)), wch.close
+
+            out["qdrant_grpc_floor"] = sustain(floor_worker)
+        finally:
+            stop_floor()
     finally:
         ch.close()
         grpc_srv.stop()
         bolt.stop()
         http.stop()
         db.close()
+    floor = out.pop("qdrant_grpc_floor", None)
     result = {
         name: {
             "ops_per_s": ops,
@@ -670,6 +773,10 @@ def _bench_surfaces(n_people: int = 1000, secs: float = 2.0,
         }
         for name, ops in out.items()
     }
+    if floor and "qdrant_grpc" in result:
+        result["qdrant_grpc"]["framework_floor"] = floor
+        result["qdrant_grpc"]["vs_floor"] = round(
+            result["qdrant_grpc"]["ops_per_s"] / floor, 3)
     result["config"] = {
         "cpus": cpus, "concurrency": conc,
         "baseline_note": "reference numbers from a 16-core M3 Max "
@@ -924,24 +1031,32 @@ def _bench_northstar():
     return out
 
 
-def _bench_knn():
-    platform = _probe_backend()
-    fallback = platform is None
-    if fallback:
-        # TPU never came up: force the CPU PJRT backend. sitecustomize pins
-        # jax_platforms="axon,cpu" at import time, so fix it post-import too.
+def _bench_knn(tiny: bool = False):
+    if os.environ.get("NORNICDB_BENCH_FORCE_CPU"):
+        # dry-run / stage retry: pinned to CPU, skip the (slow) probe
+        fallback = False
+        force_cpu = True
         os.environ["JAX_PLATFORMS"] = "cpu"
+    else:
+        platform = _probe_backend()
+        fallback = platform is None
+        force_cpu = fallback
+        if fallback:
+            # TPU never came up: force the CPU PJRT backend. sitecustomize
+            # pins jax_platforms="axon,cpu" at import, so fix it
+            # post-import too.
+            os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
 
-    if fallback:
+    if force_cpu:
         jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
 
     from nornicdb_tpu.ops import cosine_topk, l2_normalize, pad_dim
 
-    n, d, k = 10_000, 1024, 10
+    n, d, k = (2_000, 64, 10) if tiny else (10_000, 1024, 10)
     rng = np.random.default_rng(0)
     cap = pad_dim(n)
     m = np.zeros((cap, d), np.float32)
@@ -966,7 +1081,7 @@ def _bench_knn():
     s, i = cosine_topk(qs[0], mj, vj, k)
     s.block_until_ready()
 
-    iters = 2000
+    iters = 300 if tiny else 2000
     t0 = time.perf_counter()
     for it in range(iters):
         s, i = cosine_topk(qs[it % 64], mj, vj, k)
@@ -975,7 +1090,7 @@ def _bench_knn():
     qps = iters / dt
 
     # batched throughput at b=64 (the shape the MXU actually wants)
-    b_iters = 100
+    b_iters = 20 if tiny else 100
     s, _ = cosine_topk(queries, mj, vj, k)
     s.block_until_ready()
     t0 = time.perf_counter()
@@ -1000,7 +1115,7 @@ def _bench_knn():
     host_qs = [np.asarray(q[0]) for q in qs]
     # enough offered load to fill 64-wide batches (32 clients cap the
     # mean coalesced batch at ~22, leaving device throughput unreached)
-    n_threads = 64
+    n_threads = 16 if tiny else 64
     stop = threading.Event()
     counts = [0] * n_threads
 
@@ -1028,7 +1143,7 @@ def _bench_knn():
     t0 = time.perf_counter()
     for t in threads:
         t.start()
-    time.sleep(2.0)
+    time.sleep(0.5 if tiny else 2.0)
     stop.set()
     for t in threads:
         t.join(timeout=30)
@@ -1060,14 +1175,16 @@ _LDBC_BASELINES = {
 }
 
 
-def _bench_cypher():
+def _bench_cypher(n_people: int = 50_000, n_msgs: int = 100_000,
+                  knows_per: int = 20, measure_s: float = 2.0):
     """Sustained single-stream ops/s for the four LDBC-shaped queries in
     BASELINE.md, on a 50k-person / ~1.35M-edge social graph (the 10-100x
     scale-up VERDICT r02 item 2 demands: 50k persons x 20 KNOWS = 1M
     KNOWS edges, 100k messages). The query-result cache is disabled so
     this measures real execution — the columnar fast paths over
     incrementally-maintained materialized aggregate views — not cache
-    hits; lookup params rotate across iterations."""
+    hits; lookup params rotate across iterations. Dry-run shrinks the
+    graph and the windows (same code path, same artifact schema)."""
     import random
 
     from nornicdb_tpu.query.executor import CypherExecutor
@@ -1091,7 +1208,6 @@ def _bench_cypher():
 
     city_ids = [add_node(["City"], {"name": c}) for c in cities]
     tag_ids = [add_node(["Tag"], {"name": t}) for t in tags]
-    n_people = 50_000
     people = [
         add_node(["Person"], {"id": i, "name": f"p{i}", "age": 18 + (i * 7) % 50})
         for i in range(n_people)
@@ -1099,11 +1215,10 @@ def _bench_cypher():
     n_knows = 0
     for i, pid in enumerate(people):
         add_edge("IS_LOCATED_IN", pid, city_ids[i % len(cities)])
-        for j in rng.sample(range(n_people), 20):
+        for j in rng.sample(range(n_people), knows_per):
             if j != i:
                 add_edge("KNOWS", pid, people[j])
                 n_knows += 1
-    n_msgs = 100_000
     for m in range(n_msgs):
         mid = add_node(
             ["Message"],
@@ -1154,7 +1269,7 @@ def _bench_cypher():
                 _ = ex.execute(q, mk_params(n_done + it)).n_rows
             n_done += iters
             dt = time.perf_counter() - t0
-            if dt > 2.0 or n_done >= 20000:
+            if dt > measure_s or n_done >= 20000:
                 break
         return n_done / dt
 
@@ -1205,7 +1320,7 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
         sys.exit(run_stage(sys.argv[2]))
     try:
-        main()
+        main(dry_run="--dry-run" in sys.argv[1:])
     except Exception as exc:  # last-resort: a parseable line beats a traceback
         err = {
             "metric": "ldbc_snb_cypher_geomean",

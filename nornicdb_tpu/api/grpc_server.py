@@ -6,6 +6,28 @@ points_service.go, collections_service.go — collection/point ops
 translated onto storage+search; highest-throughput surface in the
 reference's e2e bench at 29k ops/s).
 
+Serving path: one ``grpc.aio`` server on a dedicated event-loop thread.
+The previous ``grpc.server`` handed every RPC to a ThreadPoolExecutor
+worker — at qdrant-search payload sizes that per-RPC thread handoff
+(enqueue, wake, GIL churn, response marshal back) dominated the profile
+and left the surface an order of magnitude under the reference. Now:
+
+- every handler is a coroutine registered RAW (no deserializer/
+  serializer), so the server moves request/response bytes;
+- hot reads ride one shared :class:`~nornicdb_tpu.cache.WireCache`
+  validated against the owning data plane's write generation (the
+  QdrantCompat search-cache generation for qdrant methods, the
+  SearchService result-cache generation for native search) — both fed
+  by the same storage mutation listeners wired in db.py, so a write on
+  ANY surface invalidates cached response bytes;
+- misses and point ops run on a small executor where concurrent
+  requests coalesce through the compat layer's MicroBatcher (search)
+  and BatchCoalescer (upsert convoys) with power-of-two bucketing.
+
+The public lifecycle is unchanged and synchronous (``GrpcServer(db,
+port=0).start()`` / ``.stop()``): db.py/cli.py and every test drive it
+exactly as before; the event loop is an implementation detail.
+
 Servicers are registered with ``grpc.method_handlers_generic_handler``
 so no grpc_tools codegen is needed — messages come from the protoc-
 generated ``nornic_pb2`` and handlers are plain methods.
@@ -13,44 +35,40 @@ generated ``nornic_pb2`` and handlers are plain methods.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
+import threading
 import time
 from typing import Optional
 
+import grpc
 import numpy as np
 
 from nornicdb_tpu.api.proto import nornic_pb2 as pb
+from nornicdb_tpu.api.qdrant import QdrantError
 
 
-def _abort_qdrant(context, e) -> None:
-    """Map QdrantError to a gRPC status — a missing collection or a
-    validation failure must not masquerade as an empty result."""
-    import grpc
+def _unary_raw(fn, req_cls, method, wire=None, gen=None, executor=None,
+               resp_cls=None):
+    from nornicdb_tpu.api.qdrant_official_grpc import aio_unary_raw
 
-    code = (grpc.StatusCode.NOT_FOUND
-            if getattr(e, "status", 400) == 404
-            else grpc.StatusCode.INVALID_ARGUMENT)
-    context.abort(code, str(e))
-
-
-def _unary(fn, req_cls):
-    import grpc
-
-    return grpc.unary_unary_rpc_method_handler(
-        fn,
-        request_deserializer=req_cls.FromString,
-        response_serializer=lambda m: m.SerializeToString(),
-    )
+    return aio_unary_raw(
+        lambda data: fn(req_cls.FromString(data)), method=method,
+        wire=wire, gen=gen, executor=executor, resp_cls=resp_cls)
 
 
 class SearchServicer:
-    """nornic.v1.SearchService — raw vector + hybrid search."""
+    """nornic.v1.SearchService — raw vector + hybrid search.
+
+    Concurrent Search RPCs funnel (via the executor) into the search
+    service's MicroBatcher: many b=1 queries, one batched device
+    dispatch (search/service.py vector_search_candidates)."""
 
     def __init__(self, db):
         self.db = db
 
-    def Search(self, request, context):
+    def Search(self, request):
         t0 = time.time()
         hits = self.db.search.vector_search_candidates(
             np.asarray(list(request.vector), dtype=np.float32),
@@ -61,7 +79,7 @@ class SearchServicer:
             took_ms=(time.time() - t0) * 1e3,
         )
 
-    def Hybrid(self, request, context):
+    def Hybrid(self, request):
         t0 = time.time()
         results = self.db.search.search(
             query=request.query,
@@ -90,16 +108,23 @@ class SearchServicer:
         return pb.Hit(node_id=node_id, score=float(score),
                       payload_json=payload)
 
-    def handlers(self):
-        import grpc
+    def handlers(self, wire=None, executor=None):
+        svc = "nornic.v1.SearchService"
+        # cached response bytes validate against the search service's
+        # result-cache generation: any index mutation bumps it
+        gen = lambda: self.db.search.generation  # noqa: E731
+        return grpc.method_handlers_generic_handler(svc, {
+            "Search": _unary_raw(self.Search, pb.SearchRequest,
+                                 f"/{svc}/Search", wire, gen, executor,
+                                 resp_cls=pb.SearchResponse),
+            "Hybrid": _unary_raw(self.Hybrid, pb.HybridRequest,
+                                 f"/{svc}/Hybrid", wire, gen, executor,
+                                 resp_cls=pb.SearchResponse),
+        })
 
-        return grpc.method_handlers_generic_handler(
-            "nornic.v1.SearchService",
-            {
-                "Search": _unary(self.Search, pb.SearchRequest),
-                "Hybrid": _unary(self.Hybrid, pb.HybridRequest),
-            },
-        )
+
+# the whole ok-ack is constant bytes — no message build, no serialize
+_ACK_OK = pb.AckResponse(ok=True).SerializeToString()
 
 
 class QdrantServicer:
@@ -109,31 +134,27 @@ class QdrantServicer:
         self.compat = compat
 
     def _ack(self, fn):
-        from nornicdb_tpu.api.qdrant import QdrantError
-
         try:
             fn()
-            return pb.AckResponse(ok=True)
+            return _ACK_OK
         except QdrantError as e:
             return pb.AckResponse(ok=False, error=str(e))
 
-    def CreateCollection(self, request, context):
+    def CreateCollection(self, request):
         vectors = {"size": int(request.vector_size),
                    "distance": request.distance or "Cosine"}
         return self._ack(lambda: self.compat.create_collection(
             request.collection, vectors))
 
-    def DeleteCollection(self, request, context):
+    def DeleteCollection(self, request):
         return self._ack(lambda: self.compat.delete_collection(
             request.collection))
 
-    def ListCollections(self, request, context):
+    def ListCollections(self, request):
         return pb.ListCollectionsResponse(
             collections=self.compat.list_collections())
 
-    def GetCollection(self, request, context):
-        from nornicdb_tpu.api.qdrant import QdrantError
-
+    def GetCollection(self, request):
         try:
             info = self.compat.get_collection(request.collection)
         except QdrantError:
@@ -146,7 +167,7 @@ class QdrantServicer:
             distance=str(vec.get("distance", "Cosine")),
         )
 
-    def Upsert(self, request, context):
+    def Upsert(self, request):
         points = [
             {
                 "id": p.id,
@@ -155,31 +176,27 @@ class QdrantServicer:
             }
             for p in request.points
         ]
-        return self._ack(lambda: self.compat.upsert_points(
+        # convoy-coalesced: concurrent Upserts merge into one apply
+        return self._ack(lambda: self.compat.upsert_points_coalesced(
             request.collection, points))
 
-    def SearchPoints(self, request, context):
-        from nornicdb_tpu.api.qdrant import QdrantError
-
+    def SearchPoints(self, request):
         t0 = time.time()
-        try:
-            hits = self.compat.search_points(
-                request.collection,
-                list(request.vector),
-                limit=int(request.limit) or 10,
-                with_payload=request.with_payload,
-                with_vector=request.with_vector,
-                score_threshold=(
-                    float(request.score_threshold)
-                    if request.has_score_threshold else None
-                ),
-                query_filter=(
-                    json.loads(request.filter_json)
-                    if request.filter_json else None
-                ),
-            )
-        except QdrantError as e:
-            _abort_qdrant(context, e)
+        hits = self.compat.search_points(
+            request.collection,
+            list(request.vector),
+            limit=int(request.limit) or 10,
+            with_payload=request.with_payload,
+            with_vector=request.with_vector,
+            score_threshold=(
+                float(request.score_threshold)
+                if request.has_score_threshold else None
+            ),
+            query_filter=(
+                json.loads(request.filter_json)
+                if request.filter_json else None
+            ),
+        )
         return pb.SearchPointsResponse(
             points=[
                 pb.ScoredPoint(
@@ -193,63 +210,67 @@ class QdrantServicer:
             took_ms=(time.time() - t0) * 1e3,
         )
 
-    def DeletePoints(self, request, context):
+    def DeletePoints(self, request):
         return self._ack(lambda: self.compat.delete_points(
             request.collection, list(request.ids)))
 
-    def CountPoints(self, request, context):
-        from nornicdb_tpu.api.qdrant import QdrantError
+    def CountPoints(self, request):
+        return pb.CountResponse(count=self.compat.count_points(
+            request.collection))
 
-        try:
-            return pb.CountResponse(count=self.compat.count_points(
-                request.collection))
-        except QdrantError as e:
-            _abort_qdrant(context, e)
+    def handlers(self, wire=None, executor=None):
+        svc = "nornic.v1.QdrantService"
+        gen = lambda: self.compat.cache_gen  # noqa: E731
 
-    def handlers(self):
-        import grpc
+        def unary(name, fn, req_cls, resp_cls=None):
+            return _unary_raw(fn, req_cls, f"/{svc}/{name}",
+                              wire if resp_cls is not None else None,
+                              gen, executor, resp_cls=resp_cls)
 
-        return grpc.method_handlers_generic_handler(
-            "nornic.v1.QdrantService",
-            {
-                "CreateCollection": _unary(
-                    self.CreateCollection, pb.CreateCollectionRequest),
-                "DeleteCollection": _unary(
-                    self.DeleteCollection, pb.CollectionRequest),
-                "ListCollections": _unary(self.ListCollections, pb.Empty),
-                "GetCollection": _unary(
-                    self.GetCollection, pb.CollectionRequest),
-                "Upsert": _unary(self.Upsert, pb.UpsertRequest),
-                "SearchPoints": _unary(
-                    self.SearchPoints, pb.SearchPointsRequest),
-                "DeletePoints": _unary(
-                    self.DeletePoints, pb.DeletePointsRequest),
-                "CountPoints": _unary(self.CountPoints, pb.CollectionRequest),
-            },
-        )
+        return grpc.method_handlers_generic_handler(svc, {
+            "CreateCollection": unary(
+                "CreateCollection", self.CreateCollection,
+                pb.CreateCollectionRequest),
+            "DeleteCollection": unary(
+                "DeleteCollection", self.DeleteCollection,
+                pb.CollectionRequest),
+            "ListCollections": unary(
+                "ListCollections", self.ListCollections, pb.Empty,
+                pb.ListCollectionsResponse),
+            "GetCollection": unary(
+                "GetCollection", self.GetCollection, pb.CollectionRequest,
+                pb.CollectionInfoResponse),
+            "Upsert": unary("Upsert", self.Upsert, pb.UpsertRequest),
+            "SearchPoints": unary(
+                "SearchPoints", self.SearchPoints, pb.SearchPointsRequest,
+                pb.SearchPointsResponse),
+            "DeletePoints": unary(
+                "DeletePoints", self.DeletePoints, pb.DeletePointsRequest),
+            "CountPoints": unary(
+                "CountPoints", self.CountPoints, pb.CollectionRequest,
+                pb.CountResponse),
+        })
 
 
-def _token_interceptor(token: str):
+def _aio_token_interceptor(token: str):
     """Bearer-token auth interceptor: gRPC writes must not be weaker
     than the REST surface's WRITE authorization."""
-    import grpc
+    import hmac
 
-    class _Interceptor(grpc.ServerInterceptor):
+    class _Interceptor(grpc.aio.ServerInterceptor):
         def __init__(self):
-            def abort(request, context):
-                context.abort(grpc.StatusCode.UNAUTHENTICATED,
-                              "invalid or missing bearer token")
+            async def abort(request, context):
+                await context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                                    "invalid or missing bearer token")
 
             self._abort = grpc.unary_unary_rpc_method_handler(abort)
 
-        def intercept_service(self, continuation, details):
-            import hmac
-
-            md = dict(details.invocation_metadata)
+        async def intercept_service(self, continuation, details):
+            md = dict(details.invocation_metadata or ())
             if hmac.compare_digest(
                 md.get("authorization", ""), f"Bearer {token}"
             ):
-                return continuation(details)
+                return await continuation(details)
             return self._abort
 
     return _Interceptor()
@@ -258,13 +279,19 @@ def _token_interceptor(token: str):
 class GrpcServer:
     """Hosts both services on one port (reference: server.go:328 Start).
     Shares the DB's QdrantCompat with the REST surface so the
-    per-collection index caches stay coherent across surfaces."""
+    per-collection index caches stay coherent across surfaces.
+
+    Implementation: a ``grpc.aio`` server living on its own event-loop
+    thread. Construction binds the port (so ``.address`` is valid before
+    ``start()``, as callers expect); ``start()``/``stop()`` submit the
+    aio server's lifecycle onto the loop and block until done."""
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
                  max_workers: int = 8, auth_token: Optional[str] = None,
                  snapshot_dir: Optional[str] = None):
-        import grpc
         from concurrent import futures
+
+        from nornicdb_tpu.cache import WireCache
 
         self.db = db
         if snapshot_dir is None:
@@ -278,12 +305,15 @@ class GrpcServer:
                 else os.path.join(tempfile.gettempdir(),
                                   "nornicdb-qdrant-snapshots"))
         self.snapshot_dir = snapshot_dir
-        interceptors = (
-            [_token_interceptor(auth_token)] if auth_token else []
-        )
-        self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers),
-            interceptors=interceptors)
+        self._auth_token = auth_token
+        # one shared response-bytes cache across ALL services/methods of
+        # this server — both gRPC surfaces serve hot reads from it
+        self.wire_cache = WireCache()
+        # miss/mutation work runs here, NOT on the event loop: a storage
+        # scan must never stall cache hits, and concurrent point ops
+        # coalesce across these threads via the compat layer's batchers
+        self._executor = futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="grpc-work")
         self.search_servicer = SearchServicer(db)
         self.qdrant_servicer = QdrantServicer(db.qdrant_compat)
         # official qdrant wire contract (qdrant.Collections / qdrant.Points)
@@ -299,22 +329,64 @@ class GrpcServer:
         self.official_points = OfficialPointsServicer(db.qdrant_compat)
         self.official_snapshots = OfficialSnapshotsServicer(
             db.qdrant_compat, self.snapshot_dir)
-        self._server.add_generic_rpc_handlers((
-            self.search_servicer.handlers(),
-            self.qdrant_servicer.handlers(),
-            self.official_collections.handlers(),
-            self.official_points.handlers(),
-            self.official_snapshots.handlers(),
-        ))
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._run_loop, daemon=True, name="grpc-aio-loop")
+        self._loop_thread.start()
+        self._started = False
+        self._stopped = False
         self.host = host
+        self.port = self._submit(self._build(host, port)).result(30)
+
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_forever()
+        finally:
+            try:
+                self._loop.close()
+            except RuntimeError:
+                pass
+
+    def _submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _build(self, host: str, port: int) -> int:
+        interceptors = (
+            [_aio_token_interceptor(self._auth_token)]
+            if self._auth_token else []
+        )
+        server = grpc.aio.server(interceptors=interceptors)
+        wire, ex = self.wire_cache, self._executor
+        server.add_generic_rpc_handlers((
+            self.search_servicer.handlers(wire=wire, executor=ex),
+            self.qdrant_servicer.handlers(wire=wire, executor=ex),
+            self.official_collections.handlers(wire=wire, executor=ex),
+            self.official_points.handlers(wire=wire, executor=ex),
+            self.official_snapshots.handlers(executor=ex),
+        ))
+        self._server = server
+        return server.add_insecure_port(f"{host}:{port}")
 
     def start(self) -> "GrpcServer":
-        self._server.start()
+        self._submit(self._server.start()).result(30)
+        self._started = True
         return self
 
     def stop(self, grace: Optional[float] = 0.5) -> None:
-        self._server.stop(grace)
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            # unconditional: __init__ already bound the port via
+            # _build(), so even a never-started server holds the
+            # listening socket until stopped
+            self._submit(self._server.stop(grace)).result(30)
+        except Exception:
+            pass  # a dying loop must not block process shutdown
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._loop_thread.join(timeout=10)
+        self._executor.shutdown(wait=False)
 
     @property
     def address(self) -> str:

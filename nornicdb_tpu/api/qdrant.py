@@ -65,6 +65,18 @@ class QdrantCompat:
         # or collection mutation. The generation is also how the gRPC
         # raw-bytes wire cache validates its entries.
         self._search_cache: ResultCache = ResultCache(self._copy_hit)
+        # per-collection micro-batching: concurrent single-vector
+        # searches (gRPC executor threads, REST worker threads) coalesce
+        # into ONE batched index dispatch with power-of-two bucketed
+        # shapes — the same leader-election window the native search
+        # service rides (search/microbatch.py; SURVEY §7)
+        self._microbatchers: Dict[str, Any] = {}
+        # concurrent point upserts merge into one apply per collection:
+        # one lock acquisition + one generation bump per convoy
+        from nornicdb_tpu.search.microbatch import BatchCoalescer
+
+        self._upsert_coalescer = BatchCoalescer(
+            self._apply_upsert_batch, self._apply_upsert_single)
         self._lock = threading.Lock()
         # depth of in-progress writes by THIS layer (thread-local): its
         # own storage writes already maintain the indexes incrementally,
@@ -148,6 +160,10 @@ class QdrantCompat:
             ))
         with self._lock:
             self._space(name).ensure_index()
+        # collection-list / collection-info responses are wire-cached by
+        # the gRPC surfaces against this generation — a create must show
+        # up in the next List/Get, same as every other mutation
+        self._clear_search_cache()
         return True
 
     def delete_collection(self, name: str) -> bool:
@@ -162,6 +178,9 @@ class QdrantCompat:
         with self._lock:
             self.vector_registry.drop(self._space_key(name))
             self._raw.pop(name, None)
+            # drop the coalescer too: a recreated namesake may change
+            # dims, and the batcher's dispatch must bind the new index
+            self._microbatchers.pop(name, None)
             # upstream qdrant drops aliases with the collection; keeping
             # them would leave resolve() routing point ops at a missing
             # collection and block alias-name reuse
@@ -532,6 +551,44 @@ class QdrantCompat:
             self._invalidate_raw(name)
         return n
 
+    # -- microbatched point ops (gRPC serving path) ----------------------
+
+    def upsert_points_coalesced(
+        self, name: str, points: Sequence[Dict[str, Any]]
+    ) -> int:
+        """Upsert through the convoy coalescer: concurrent callers are
+        merged into one ``upsert_points`` apply per collection (one
+        validation pass, one index touch, ONE cache-generation bump for
+        the whole convoy). Semantics match upsert_points — on a merged
+        batch the caller's ack still covers exactly its own points."""
+        return self._upsert_coalescer.submit((name, list(points)))
+
+    def _apply_upsert_batch(self, items):
+        """Coalescer batch apply: merge per collection, ack per item.
+        A raise falls back to _apply_upsert_single per item (upserts are
+        idempotent node writes, so a partial merged apply followed by
+        the single-item replay cannot double-count)."""
+        groups: Dict[str, List[Any]] = {}
+        order: List[str] = []
+        for idx, (name, points) in enumerate(items):
+            if name not in groups:
+                groups[name] = []
+                order.append(name)
+            groups[name].append((idx, points))
+        results = [0] * len(items)
+        for name in order:
+            merged: List[Dict[str, Any]] = []
+            for _idx, pts in groups[name]:
+                merged.extend(pts)
+            self.upsert_points(name, merged)
+            for idx, pts in groups[name]:
+                results[idx] = len(pts)
+        return results
+
+    def _apply_upsert_single(self, item):
+        name, points = item
+        return self.upsert_points(name, points)
+
     def retrieve_points(
         self,
         name: str,
@@ -640,6 +697,15 @@ class QdrantCompat:
             return cached
         gen_at_miss = self._search_cache.generation
         meta = self._meta(name)
+        # reject wrong-sized vectors HERE, with a 400-class error, before
+        # the query can reach the shared microbatcher (a dim mismatch
+        # inside a coalesced np.stack would fail the whole convoy with a
+        # bare ValueError) or the raw-matrix broadcast
+        want = meta.properties.get("config", {}).get("size", 0)
+        if want and len(vector) != want:
+            raise QdrantError(
+                f"search vector size {len(vector)} != collection "
+                f"size {want}")
         distance = meta.properties.get("config", {}).get("distance", "Cosine")
         if distance == "Cosine":
             ranked = self._ranked_cosine(name, vector)
@@ -671,21 +737,54 @@ class QdrantCompat:
         return self._search_cache.put_guarded(cache_key, out,
                                               gen_at_miss)
 
+    def _collection_microbatch(self, name: str):
+        """Per-collection MicroBatcher over the index's batched search.
+        The dispatch closure re-resolves the index per batch, so an
+        invalidation/rebuild between batches binds the fresh index."""
+        from nornicdb_tpu.search.microbatch import MicroBatcher
+
+        with self._lock:
+            mb = self._microbatchers.get(name)
+            if mb is None:
+                mb = MicroBatcher(
+                    lambda queries, k, _n=name:
+                        self._index(_n).search_batch(queries, k))
+                self._microbatchers[name] = mb
+            return mb
+
     def _ranked_cosine(self, name: str, vector: Sequence[float]):
         """Yield (node_id, cosine) best-first, progressively widening the
         kNN so selective filters still fill `limit` (a fixed 4x
-        oversample starves on rare payloads)."""
+        oversample starves on rare payloads).
+
+        The first (and almost always only) round routes through the
+        collection's MicroBatcher: concurrent single-vector searches
+        from any surface coalesce into one power-of-two-bucketed batch
+        dispatch. Widening rounds (selective filters) are rare and go
+        direct — their k varies too much to bucket usefully."""
         idx = self._index(name)
         total = len(idx)
         k = 40
-        seen = 0
+        first = True
+        # dedupe by id, not by list position: the batched round-1 call
+        # (GEMM over a padded batch) and the direct widening calls can
+        # order float near-ties differently, so positional continuation
+        # could re-yield or drop a boundary point
+        yielded = set()
         q = np.asarray(vector, dtype=np.float32)
         while True:
-            hits = idx.search(q, k=min(k, total) if total else k)
-            for nid, score in hits[seen:]:
+            k_req = min(k, total) if total else k
+            if first:
+                hits = self._collection_microbatch(name).search(q, k_req)
+                first = False
+            else:
+                hits = idx.search(q, k=k_req)
+            for nid, score in hits:
+                if nid in yielded:
+                    continue
+                yielded.add(nid)
                 yield nid, score
-            seen = len(hits)
-            if seen >= total or len(hits) < k:
+            if len(yielded) >= total or len(hits) < k:
                 return
             k *= 4
 

@@ -106,6 +106,47 @@ class GenerationalCache(LRUCache[V]):
             return self._generation
 
 
+class WireCache:
+    """Shared serialized-response cache for the protocol surfaces.
+
+    Keyed (method, request bytes) -> (generation, response bytes). A hit
+    is valid only when the caller's CURRENT generation for that method
+    family matches the generation recorded at put time — the same
+    write-generation discipline as ``GenerationalCache``, except the
+    generation counters live with the data planes (QdrantCompat's search
+    cache, SearchService's result cache), each fed by the storage
+    mutation listeners wired in db.py. One instance serves every wire
+    method of a server, so the hot handlers do ZERO protobuf/JSON work
+    on a hit: request bytes in, response bytes out.
+
+    Entries are immutable bytes — no copy-on-return hook is needed
+    (unlike ResultCache, whose hits share nested dicts with live nodes).
+    """
+
+    def __init__(self, max_size: int = 2048, ttl_seconds: float = 300.0):
+        self._lru: LRUCache = LRUCache(max_size=max_size,
+                                       ttl_seconds=ttl_seconds)
+
+    def get(self, method: str, data: bytes, gen: int) -> Optional[bytes]:
+        hit = self._lru.get((method, data))
+        if hit is not None and hit[0] == gen:
+            return hit[1]
+        return None
+
+    def put(self, method: str, data: bytes, gen: int,
+            payload: bytes) -> None:
+        # gen was sampled BEFORE the compute; a write that raced the
+        # compute bumped the live generation, so the stale entry can
+        # never validate on get() — no put-side guard needed.
+        self._lru.put((method, data), (gen, payload))
+
+    def stats(self) -> dict:
+        return self._lru.stats()
+
+    def clear(self) -> None:
+        self._lru.clear()
+
+
 class ResultCache(GenerationalCache[list]):
     """Search-result cache with the reference searchResultCache
     semantics (search.go:88-92: LRU 1000, 5-min TTL, invalidated on any

@@ -31,6 +31,10 @@ def _build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--bolt-port", type=int, default=7687)
     serve.add_argument("--grpc-port", type=int, default=0,
                        help="gRPC port (0 = disabled)")
+    serve.add_argument("--grpc-auth-token", default=None,
+                       help="require this bearer token on every gRPC "
+                            "call (aio interceptor; parity with the "
+                            "REST surface's write authorization)")
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--database", default="neo4j")
     serve.add_argument("--plugins-dir", default=None)
@@ -93,8 +97,8 @@ def cmd_serve(args) -> int:
     if args.grpc_port:
         from nornicdb_tpu.api.grpc_server import GrpcServer
 
-        grpc_srv = GrpcServer(db, host=args.host,
-                              port=args.grpc_port).start()
+        grpc_srv = GrpcServer(db, host=args.host, port=args.grpc_port,
+                              auth_token=args.grpc_auth_token).start()
     if args.plugins_dir:
         from nornicdb_tpu.plugins import install_plugins
 
@@ -106,7 +110,8 @@ def cmd_serve(args) -> int:
     print(f"  http  : http://{args.host}:{http.port}")
     print(f"  bolt  : bolt://{args.host}:{bolt.port}")
     if grpc_srv is not None:
-        print(f"  grpc  : {grpc_srv.address}")
+        auth = " (bearer auth)" if args.grpc_auth_token else ""
+        print(f"  grpc  : {grpc_srv.address} (aio){auth}")
     print(f"  data  : {args.data_dir or '(in-memory)'}")
     stop = threading.Event()
 

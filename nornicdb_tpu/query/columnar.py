@@ -157,6 +157,33 @@ class _StripView:
         self.nnz = nnz
 
 
+class _SortedAdjacency:
+    """Materialized segment-sorted adjacency strip.
+
+    CSR-like layout over ALL global node rows: the far ends of one edge
+    type's edges, grouped by the near-side node, each segment pre-sorted
+    by a NUMERIC property of the far node — descending, with nulls
+    first (Cypher DESC null semantics, mirroring fastpaths's
+    _order_from_keys null -> +inf convention).
+
+    This answers the "recent messages of friends" family in O(friends *
+    k): per-friend top-k is a head slice of the friend's segment, and
+    the global top-k is a merge of those heads — no per-query expansion
+    over every message, no per-query sort of the full candidate set.
+    The strip is dropped (lazy rebuild) on any create of its edge type:
+    inserting into sorted segments in place would cost O(E) per create,
+    which is the wrong trade for a read-hot view.
+    """
+
+    __slots__ = ("indptr", "nbr", "keys")
+
+    def __init__(self, indptr: np.ndarray, nbr: np.ndarray,
+                 keys: np.ndarray):
+        self.indptr = indptr  # int64[n_nodes+1]
+        self.nbr = nbr        # int32[n_usable_edges] far rows, seg-sorted
+        self.keys = keys      # float64 sort keys aligned with nbr
+
+
 class _GramView:
     """Materialized co-occurrence Gram matrix for (a)<-[:T]-(mid)-[:T]->(b).
 
@@ -247,6 +274,9 @@ class ColumnarCatalog:
         # materialized aggregate views (see module docstring)
         self._strip_views: Dict[Tuple, _StripView] = {}
         self._gram_views: Dict[Tuple, Optional[_GramView]] = {}
+        # segment-sorted adjacency strips (per-friend top-k family);
+        # a cached None records "order prop not numeric here"
+        self._sorted_adj: Dict[Tuple, Optional[_SortedAdjacency]] = {}
         # (prop, id(cands)) -> (cands ref, verdict): is prop injective,
         # non-null and scalar over the candidate rows? The ref pins the
         # id; property writes invalidate() the whole catalog, and any
@@ -290,6 +320,11 @@ class ColumnarCatalog:
                 sv.deg = np.append(sv.deg, np.int64(0))
                 sv.sum_deg = np.append(sv.sum_deg, np.int64(0))
                 sv.nnz = np.append(sv.nnz, np.int64(0))
+            # an edgeless new node extends each strip's indptr with a
+            # repeat of the last offset (same treatment as cached CSRs)
+            for sa in self._sorted_adj.values():
+                if sa is not None:
+                    sa.indptr = np.append(sa.indptr, sa.indptr[-1])
             for key, gv in list(self._gram_views.items()):
                 if gv is None:
                     continue  # over budget; creates only grow the graph
@@ -345,6 +380,10 @@ class ColumnarCatalog:
                 self._mid_axis.pop(key)
             for key in [k for k in self._incidence if k[0] == et]:
                 self._incidence.pop(key)
+            # sorted strips rebuild lazily: a sorted-segment insert
+            # would be O(E) in place, the rebuild is one lexsort on read
+            for key in [k for k in self._sorted_adj if k[0] == et]:
+                self._sorted_adj.pop(key)
 
             tbl = self._edge_tables.get(et)
             s = d = None
@@ -386,6 +425,8 @@ class ColumnarCatalog:
     def _drop_etype_aggregates_locked(self, et: str) -> None:
         for key in [k for k in self._filtered_deg if k[0] == et]:
             self._filtered_deg.pop(key)
+        for key in [k for k in self._sorted_adj if k[0] == et]:
+            self._sorted_adj.pop(key)
         for key in [k for k in self._strip_views
                     if k[0] == et or k[3] == et]:
             self._strip_views.pop(key)
@@ -866,6 +907,84 @@ class ColumnarCatalog:
             if self._version == v0:
                 self._strip_views[key] = sv
         return sv
+
+    def sorted_adjacency(
+        self,
+        etype: str,
+        group_side: str,
+        order_prop: str,
+        far_label: Optional[str],
+    ) -> Optional[_SortedAdjacency]:
+        """Materialized segment-sorted adjacency (see _SortedAdjacency).
+
+        ``group_side`` is the NEAR node's side of ``etype`` edges
+        ('src'|'dst'); segments hold the far rows (optionally filtered
+        by ``far_label``) sorted by the far node's ``order_prop``
+        descending, nulls first. Returns None — and caches the verdict —
+        when any non-null value of the order prop is non-numeric (the
+        general comparator lane must order those), or transiently when a
+        concurrent write tore the build."""
+        key = (etype, group_side, order_prop, far_label)
+        with self._lock:
+            if key in self._sorted_adj:
+                return self._sorted_adj[key]
+            v0 = self._version
+        # snapshot src/dst under the lock (no torn pair); masks/prop
+        # columns are fetched after and are extended on node create, so
+        # they always cover every row the snapshot references
+        tbl = self.edge_table(etype)
+        with self._lock:
+            grp = tbl.src if group_side == "src" else tbl.dst
+            far = tbl.dst if group_side == "src" else tbl.src
+        n = self.n_nodes()
+        result: Optional[_SortedAdjacency] = None
+        try:
+            if far_label is not None:
+                fmask = self.label_mask(far_label)[far]
+                grp = grp[fmask]
+                far = far[fmask]
+            vals = self.node_prop_col(order_prop)[far]
+            # one C-pass conversion (the _as_float recipe): astype maps
+            # None -> nan and raises on strings; the type scan rejects
+            # bools (Cypher orders them as a TYPE, not numerically) and
+            # the nan audit distinguishes nulls (-> +inf, Cypher DESC
+            # null-first) from genuine float('nan') values
+            numeric = True
+            keys = None
+            try:
+                keys = vals.astype(np.float64)
+            except (TypeError, ValueError):
+                numeric = False
+            if numeric:
+                types = set(map(type, vals.tolist()))
+                if bool in types or np.bool_ in types:
+                    numeric = False
+                elif type(None) in types:
+                    nanpos = np.isnan(keys)
+                    if nanpos.any():
+                        tl = vals.tolist()
+                        for i in np.flatnonzero(nanpos).tolist():
+                            if tl[i] is None:
+                                keys[i] = np.inf
+            if numeric:
+                # stable grouped desc sort: group is the primary key,
+                # negated value secondary; equal keys keep edge-table
+                # order — exactly the general path's tie order
+                perm = np.lexsort((-keys, grp))
+                counts = np.bincount(grp, minlength=n)
+                indptr = np.zeros(n + 1, dtype=np.int64)
+                np.cumsum(counts, out=indptr[1:])
+                result = _SortedAdjacency(
+                    indptr,
+                    far[perm].astype(np.int32, copy=False),
+                    keys[perm],
+                )
+        except (IndexError, ValueError):
+            return None  # torn build under a concurrent write
+        with self._lock:
+            if self._version == v0:
+                self._sorted_adj[key] = result
+        return result
 
     def cooc_gram(
         self,

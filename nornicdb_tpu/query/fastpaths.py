@@ -257,6 +257,14 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
         if r is not None:
             return r
 
+    tk = plan.get("topk")
+    if tk is not None:
+        r = _exec_topk(catalog, tk, plan, ctx, CypherResult)
+        if r is not None:
+            return r
+        # runtime-unsupported (non-numeric order prop, torn build):
+        # fall through to the generic chain machinery below
+
     strip, cooc = plan["strip"], plan["cooc"]
     if strip is not None:
         b = _exec_strip(catalog, strip, ctx, plan)
@@ -337,6 +345,7 @@ def _analyze_vectorized(q: A.Query) -> Optional[Dict[str, Any]]:
         "strip": strip,
         "cooc": cooc,
         "point": _analyze_point(path, m, ret) if not has_agg else None,
+        "topk": _analyze_topk(path, m, ret) if not has_agg else None,
         "cols": cols,
         "agg_flags": agg_flags,
         "has_agg": has_agg,
@@ -407,6 +416,183 @@ def _exec_point(catalog, point: Dict[str, Any], plan: Dict[str, Any],
         else:
             cols_out.append(
                 [nodes[i].properties.get(prop) for i in rows_idx])
+    return CypherResult(columns=plan["cols"], col_data=cols_out)
+
+
+def _analyze_topk(path: A.PatternPath, m: A.MatchClause,
+                  ret: A.ReturnClause) -> Optional[Dict[str, Any]]:
+    """Per-friend top-k analysis: MATCH (a:L {key: $p})-[:T1]-(f)-[:T2]-
+    (t) RETURN <props of a/f/t> ORDER BY t.<prop> DESC LIMIT k — the
+    LDBC "recent messages of friends" family (BASELINE.md row 2).
+
+    Executes over the catalog's segment-sorted adjacency strip: the
+    global DESC/LIMIT-k answer is a merge of each friend's pre-sorted
+    top-k head, so per-query work is O(#friends * k) gathers + one
+    argsort over ≤ #friends*k candidates — no join expansion over every
+    terminal node, no full-candidate sort. AST-only; cached on the
+    parsed query."""
+    if len(path.nodes) != 3 or len(path.rels) != 2:
+        return None
+    if m.where is not None:
+        return None
+    anchor, mid, term = path.nodes
+    r1, r2 = path.rels
+    if r1.var is not None or r2.var is not None:
+        return None
+    if r1.types[0] == r2.types[0]:
+        return None  # relationship uniqueness needs edge identity
+    # anchor: single-label single-prop equality (the indexed entry)
+    if not anchor.var or len(anchor.labels) != 1 or anchor.props is None:
+        return None
+    items = list(anchor.props.items)
+    if len(items) != 1 or not isinstance(items[0][1], (A.Literal, A.Param)):
+        return None
+    for pn in (mid, term):
+        if not pn.var or pn.props is not None or len(pn.labels) > 1:
+            return None
+    # RETURN/ORDER/LIMIT shape
+    if ret.distinct or ret.limit is None:
+        return None
+    if not ret.order_by or len(ret.order_by) != 1:
+        return None
+    oexpr, desc = ret.order_by[0]
+    if not desc:
+        return None  # strips are sorted DESC; ASC takes the general path
+    if not (isinstance(oexpr, A.Prop) and isinstance(oexpr.target, A.Var)
+            and oexpr.target.name == term.var):
+        return None
+    known = {anchor.var, mid.var, term.var}
+    projections = []  # (var, prop-or-None) per RETURN item
+    for item in ret.items:
+        e = item.expr
+        if isinstance(e, A.Var) and e.name in known:
+            projections.append((e.name, None))
+        elif (isinstance(e, A.Prop) and isinstance(e.target, A.Var)
+                and e.target.name in known):
+            projections.append((e.target.name, e.name))
+        else:
+            return None
+    return {
+        "anchor_label": anchor.labels[0],
+        "anchor_key": items[0][0],
+        "anchor_vexpr": items[0][1],
+        "anchor_var": anchor.var,
+        "etype1": r1.types[0],
+        "dir1": r1.direction,
+        "mid_var": mid.var,
+        "mid_label": mid.labels[0] if mid.labels else None,
+        "etype2": r2.types[0],
+        # the mid node's side of T2 edges: (f)<-[:T2]-(t) means edges
+        # run t -> f, so f is 'dst'
+        "mid_side": "src" if r2.direction == "out" else "dst",
+        "term_var": term.var,
+        "term_label": term.labels[0] if term.labels else None,
+        "order_prop": oexpr.name,
+        "projections": projections,
+    }
+
+
+def _exec_topk(catalog, tk: Dict[str, Any], plan: Dict[str, Any],
+               ctx, CypherResult):
+    ret = plan["ret"]
+    limit = int(_const_value(ret.limit, ctx))
+    skip = int(_const_value(ret.skip, ctx)) if ret.skip is not None else 0
+    if limit < 0 or skip < 0:
+        return None  # general path raises the proper error
+    vexpr = tk["anchor_vexpr"]
+    if isinstance(vexpr, A.Param):
+        if vexpr.name not in ctx.params:
+            return None  # let the general path raise the proper error
+        value = ctx.params[vexpr.name]
+    else:
+        value = vexpr.value
+    if isinstance(value, (list, dict)):
+        return None  # unhashable key: general path semantics
+    sa = catalog.sorted_adjacency(
+        tk["etype2"], tk["mid_side"], tk["order_prop"], tk["term_label"])
+    if sa is None:
+        return None  # non-numeric order prop / torn build
+    hit = catalog.prop_index(tk["anchor_label"], tk["anchor_key"]).get(value)
+    nodes = catalog.nodes()
+    if hit is None:
+        return CypherResult(columns=plan["cols"], rows=[])
+    rows_idx = hit
+    if isinstance(value, bool) or value in (0, 1):
+        rows_idx = np.asarray(
+            _rows_matching_bool_type(nodes, hit.tolist(),
+                                     tk["anchor_key"], value),
+            dtype=np.int32,
+        )
+    if len(rows_idx) == 0:
+        return CypherResult(columns=plan["cols"], rows=[])
+
+    tbl1 = catalog.edge_table(tk["etype1"])
+    n = catalog.n_nodes()
+    if len(rows_idx) == 1:
+        # single indexed anchor (the overwhelmingly common call): one
+        # CSR slice replaces the general repeat/cumsum hop expansion
+        indptr1, order1 = tbl1.csr(tk["dir1"], n)
+        a = int(rows_idx[0])
+        erows = order1[indptr1[a]:indptr1[a + 1]]
+        friends = (tbl1.dst if tk["dir1"] == "out" else tbl1.src)[erows]
+        a_rep = None  # anchor column is the constant row `a`
+    else:
+        from nornicdb_tpu.query.columnar import expand_hop
+
+        a_rep, _edges, friends = expand_hop(
+            tbl1, np.asarray(rows_idx, dtype=np.int32), tk["dir1"], n)
+    if tk["mid_label"] is not None and len(friends):
+        fmask = catalog.label_mask(tk["mid_label"])[friends]
+        friends = friends[fmask]
+        if a_rep is not None:
+            a_rep = a_rep[fmask]
+    if len(friends) == 0:
+        return CypherResult(columns=plan["cols"], rows=[])
+
+    # per-friend heads: positions of each friend's top (skip+limit)
+    # strip entries — candidates beyond that depth cannot reach the
+    # global top-k because segments are sorted by the same key
+    try:
+        k_head = skip + limit
+        ip = sa.indptr
+        starts = ip[friends]
+        counts = np.minimum(ip[friends + 1] - starts, k_head)
+        cum = np.cumsum(counts)
+        total = int(cum[-1])
+        if total == 0:
+            return CypherResult(columns=plan["cols"], rows=[])
+        f_rep = np.repeat(np.arange(len(friends), dtype=np.int64), counts)
+        # pos[j] walks each friend's segment head: segment start rebased
+        # by the candidate's offset within the concatenated head list
+        pos = np.arange(total, dtype=np.int64) + np.repeat(
+            starts - (cum - counts), counts)
+        keyv = sa.keys[pos]
+
+        # global DESC merge, stable so tie order matches the general
+        # path's (anchor, friend-CSR, segment) candidate order
+        order = np.argsort(-keyv, kind="stable")[skip:skip + limit]
+        sel_f = friends[f_rep[order]]
+        sel_t = sa.nbr[pos[order]]
+        if a_rep is None:
+            sel_a = np.full(len(order), int(rows_idx[0]), dtype=np.int32)
+        else:
+            sel_a = np.asarray(
+                rows_idx, dtype=np.int32)[a_rep[f_rep[order]]]
+    except (IndexError, ValueError):
+        # the strip raced a concurrent node+edge create (its indptr can
+        # lag the CSR the friends came from); fall back to the general
+        # chain machinery like every other torn-build path
+        return None
+
+    row_of = {tk["anchor_var"]: sel_a, tk["mid_var"]: sel_f,
+              tk["term_var"]: sel_t}
+    cols_out: List[List[Any]] = []
+    for var, prop in tk["projections"]:
+        rows = row_of[var]
+        if prop is None:
+            cols_out.append([nodes[int(i)] for i in rows.tolist()])
+        else:
+            cols_out.append(catalog.node_prop_col(prop)[rows].tolist())
     return CypherResult(columns=plan["cols"], col_data=cols_out)
 
 

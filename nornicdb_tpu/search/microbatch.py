@@ -24,6 +24,102 @@ from typing import Any, Callable, List, Sequence, Tuple
 import numpy as np
 
 
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n. Shape bucketing for device dispatch:
+    every distinct (B, k) is its own XLA compile, so batch and k are
+    padded to buckets to cap the compile universe at log2 shapes."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class BatchCoalescer:
+    """Leader-elected coalescer for arbitrary batchable operations.
+
+    The generalization of MicroBatcher's search-specific protocol to any
+    op where N concurrent requests are cheaper served as one merged
+    apply (gRPC point upserts: one merged ``upsert_points`` per
+    collection means one lock acquisition, one index touch and ONE cache
+    generation bump for the whole convoy instead of one per RPC).
+
+    ``apply_batch(items) -> results`` must return one result per item;
+    raising fails every waiter in the batch unless ``apply_single`` is
+    given, in which case the coalescer falls back to per-item
+    application so one poisoned item cannot fail its convoy-mates.
+    """
+
+    def __init__(self, apply_batch, apply_single=None, max_batch: int = 64):
+        self._apply_batch = apply_batch
+        self._apply_single = apply_single
+        self._max_batch = max_batch
+        self._cond = threading.Condition()
+        self._pending: List["_Item"] = []
+        self._busy = False
+        self.batches = 0
+        self.batched_items = 0
+
+    def submit(self, value: Any) -> Any:
+        item = _Item(value)
+        with self._cond:
+            self._pending.append(item)
+        while True:
+            batch: List[_Item] = []
+            with self._cond:
+                while not item.done and self._busy:
+                    self._cond.wait(timeout=30.0)
+                if item.done:
+                    break
+                batch = self._pending[: self._max_batch]
+                del self._pending[: len(batch)]
+                if not batch:
+                    continue  # taken by another leader but not done yet
+                self._busy = True
+            try:
+                self._run(batch)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+            if item.done:
+                break
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _run(self, batch: List["_Item"]) -> None:
+        self.batches += 1
+        self.batched_items += len(batch)
+        try:
+            results = self._apply_batch([i.value for i in batch])
+            for item, res in zip(batch, results):
+                item.result = res
+        except Exception as exc:  # noqa: BLE001 — delivered per-request
+            if self._apply_single is None or len(batch) == 1:
+                for item in batch:
+                    item.error = exc
+            else:
+                # isolate the poison: apply per item so only the bad
+                # request(s) observe the error
+                for item in batch:
+                    try:
+                        item.result = self._apply_single(item.value)
+                    except Exception as single_exc:  # noqa: BLE001
+                        item.error = single_exc
+        for item in batch:
+            item.done = True
+
+
+class _Item:
+    __slots__ = ("value", "done", "result", "error")
+
+    def __init__(self, value: Any):
+        self.value = value
+        self.done = False
+        self.result: Any = None
+        self.error: Any = None
+
+
 class _Req:
     __slots__ = ("vec", "k", "done", "result", "error")
 
@@ -112,10 +208,7 @@ class MicroBatcher:
             self.batched_queries += len(batch)
             self._last_batch = len(batch)
             # k is usually a static jit arg too: bucket it alongside B
-            k_req = max(r.k for r in batch)
-            k_max = 1
-            while k_max < k_req:
-                k_max <<= 1
+            k_max = pow2_bucket(max(r.k for r in batch))
             queries = np.stack([r.vec for r in batch])
             # pad the batch dim to a power-of-two bucket: every distinct
             # B is a fresh XLA compile on an accelerator backend (~secs
@@ -125,9 +218,7 @@ class MicroBatcher:
             # shapes; the pad rows repeat row 0 (no NaN paths) and their
             # results are dropped.
             b = len(batch)
-            bucket = 1
-            while bucket < b:
-                bucket <<= 1
+            bucket = pow2_bucket(b)
             if bucket != b:
                 pad = np.broadcast_to(
                     queries[0], (bucket - b,) + queries.shape[1:])
@@ -135,8 +226,18 @@ class MicroBatcher:
             results = self._search_batch(queries, k_max)
             for r, res in zip(batch, results):
                 r.result = res[: r.k] if r.k < k_max else res
-        except Exception as exc:  # noqa: BLE001 — delivered per-request
+        except Exception:  # noqa: BLE001
+            # isolate the poison: one malformed request (wrong dims in
+            # np.stack, bad k) must not fail its convoy-mates — replay
+            # each request as its own single-row batch and deliver
+            # errors only to the requests that actually own them
             for r in batch:
-                r.error = exc
+                try:
+                    kb = pow2_bucket(max(r.k, 1))
+                    res = self._search_batch(
+                        np.asarray(r.vec, np.float32)[None, :], kb)[0]
+                    r.result = res[: r.k] if r.k < kb else res
+                except Exception as exc:  # noqa: BLE001 — per-request
+                    r.error = exc
         for r in batch:
             r.done = True

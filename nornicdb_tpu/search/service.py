@@ -164,6 +164,25 @@ class SearchService:
     def _clear_result_cache(self) -> None:
         self._result_cache.bump_generation()
 
+    @property
+    def generation(self) -> int:
+        """Write generation of the result cache — bumped on every index
+        mutation. The gRPC wire cache (api/grpc_server.py) validates its
+        cached response BYTES against this, so native-search responses
+        served from raw bytes stay exactly as fresh as the result cache
+        itself."""
+        return self._result_cache.generation
+
+    def microbatch_stats(self) -> Dict[str, float]:
+        """Coalescing effectiveness of the vector micro-batcher (how
+        many concurrent b=1 queries rode one device dispatch)."""
+        mb = self._microbatch
+        return {
+            "batches": mb.batches,
+            "batched_queries": mb.batched_queries,
+            "mean_batch": mb.batched_queries / max(mb.batches, 1),
+        }
+
     # -- indexing ---------------------------------------------------------
 
     def index_node(self, node: Node) -> None:

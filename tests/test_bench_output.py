@@ -115,6 +115,61 @@ class TestCompactSummary:
         assert lines[-1] in tail
 
 
+class TestBenchDryRunArtifactSchema:
+    """A fast ``bench.py --dry-run`` runs every in-process stage on toy
+    sizes and must emit a schema-complete artifact — including the
+    framework_floor calibration and the concurrent-kNN field — so a
+    malformed bench artifact can never land silently (it would fail the
+    default suite here first)."""
+
+    REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
+                    "knn", "northstar", "surfaces", "tpu_proof")
+
+    def test_dry_run_artifact_schema(self):
+        import os
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("NORNICDB_TPU_EMBEDDER", "hash")
+        out = subprocess.run(
+            [sys.executable, bench.__file__, "--dry-run"],
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        lines = [ln for ln in out.stdout.strip().splitlines() if ln]
+        assert len(lines) >= 2
+        full = json.loads(lines[0])
+        summary = json.loads(lines[-1])
+
+        for key in self.REQUIRED_TOP:
+            assert key in full, f"artifact missing {key!r}"
+        assert full["dry_run"] is True
+        assert full["metric"] == "ldbc_snb_cypher_geomean"
+        assert full["value"] > 0
+        for shape in bench._LDBC_BASELINES:
+            assert full["cypher"][shape]["value"] > 0, shape
+
+        # the concurrent-kNN serving figure must always be present
+        knn = full["knn"]
+        assert knn["b1_concurrent_qps"] > 0
+        assert knn["value"] > 0  # headline b=1 qps
+
+        # every surface measured, and the new framework-floor fields
+        surf = full["surfaces"]
+        for name in bench._SURFACE_BASELINES:
+            assert surf[name]["ops_per_s"] > 0, name
+        qg = surf["qdrant_grpc"]
+        assert qg["framework_floor"] > 0
+        assert qg["vs_floor"] > 0
+
+        # compact summary carries the floor too (driver tail window)
+        assert summary["summary"] is True
+        assert summary["dry_run"] is True
+        assert summary["qdrant_floor"][0] > 0
+        assert summary["knn"]["b1_concurrent_qps"] > 0
+        assert len(lines[-1]) < 2000
+
+
 class TestTpuProofDryRun:
     """VERDICT r4 #6: _bench_tpu_proof had never executed anywhere.
     Run the whole proof path on CPU (interpret-mode Pallas, tiny

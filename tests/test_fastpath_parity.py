@@ -72,6 +72,16 @@ def graph():
                 "CREATE (m)-[:HAS_TAG]->(t)",
                 {"mid": 1000 + m, "t": t},
             )
+    # three undated messages: ORDER BY m.creationDate DESC must put the
+    # null keys FIRST on both paths (Cypher null-greatest semantics)
+    for m in range(3):
+        ex.execute(
+            "MATCH (p:Person {id: $pid}) "
+            "CREATE (msg:Message {id: $mid, content: $content})"
+            "-[:HAS_CREATOR]->(p)",
+            {"pid": m * 7 % n_people, "mid": 2000 + m,
+             "content": f"undated {m}"},
+        )
     # Northwind-ish
     for s in range(6):
         ex.execute("CREATE (:Supplier {id: $i, companyName: $n})",
@@ -111,11 +121,30 @@ CORPUS = [
     ("MATCH (m:Message {id: $mid}) RETURN m.content", {"mid": 1042}, False),
     ("MATCH (m:Message {id: $mid}) RETURN m.content, m.creationDate",
      {"mid": 1007}, False),
-    # LDBC recent messages of friends (BASELINE row 2)
+    # LDBC recent messages of friends (BASELINE row 2) — served by the
+    # segment-sorted adjacency strip (fastpaths._exec_topk)
     ("MATCH (p:Person {id: $pid})-[:KNOWS]->(f:Person)"
      "<-[:HAS_CREATOR]-(m:Message) "
      "RETURN f.name, m.content, m.creationDate "
      "ORDER BY m.creationDate DESC LIMIT 10", {"pid": 3}, True),
+    # topk variants: SKIP paging, whole-node projection, limit larger
+    # than the result set, absent anchor, DESC null keys first (three
+    # fixture messages carry no creationDate)
+    ("MATCH (p:Person {id: $pid})-[:KNOWS]->(f:Person)"
+     "<-[:HAS_CREATOR]-(m:Message) "
+     "RETURN f.name, m.content ORDER BY m.creationDate DESC "
+     "SKIP 3 LIMIT 5", {"pid": 3}, True),
+    ("MATCH (p:Person {id: $pid})-[:KNOWS]->(f:Person)"
+     "<-[:HAS_CREATOR]-(m:Message) "
+     "RETURN f, m ORDER BY m.creationDate DESC LIMIT 4", {"pid": 7}, True),
+    ("MATCH (p:Person {id: $pid})-[:KNOWS]->(f:Person)"
+     "<-[:HAS_CREATOR]-(m:Message) "
+     "RETURN p.name, f.name, m.creationDate "
+     "ORDER BY m.creationDate DESC LIMIT 5000", {"pid": 11}, True),
+    ("MATCH (p:Person {id: $pid})-[:KNOWS]->(f:Person)"
+     "<-[:HAS_CREATOR]-(m:Message) "
+     "RETURN f.name, m.content ORDER BY m.creationDate DESC LIMIT 3",
+     {"pid": 999_999}, True),
     # LDBC avg friends per city (BASELINE row 3)
     ("MATCH (c:City)<-[:IS_LOCATED_IN]-(p:Person)-[:KNOWS]->(f:Person) "
      "RETURN c.name, count(f), count(DISTINCT p)", {}, False),

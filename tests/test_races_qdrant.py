@@ -10,6 +10,11 @@ Covered interleaving classes:
   the gRPC-cached layer never go backwards after an acked upsert)
 - index save (debounced snapshot writer) racing mutations: the
   persisted snapshot always loads and re-serves a consistent index
+- the grpc.aio wire itself: concurrent Upsert convoys (BatchCoalescer),
+  Search bytes riding the shared WireCache, and alias flips — all
+  through a real server and channel; plus the named regression that
+  EVERY write surface (point ops, Cypher writes, bulk clears)
+  invalidates cached Search response bytes
 """
 
 import threading
@@ -300,3 +305,365 @@ class TestIndexPersistenceRaces:
         for h in hits:
             assert store.has_node(h["id"])
         svc2.close()
+
+
+# -- grpc.aio wire-level races (the serving path itself) -----------------
+
+
+class _AioStack:
+    """One DB + aio GrpcServer + raw channel helpers, torn down fully."""
+
+    def __init__(self, dims=16):
+        import grpc
+
+        import nornicdb_tpu
+        from nornicdb_tpu.api.grpc_server import GrpcServer
+        from nornicdb_tpu.api.proto import qdrant_pb2 as q
+
+        self.q = q
+        self.dims = dims
+        self.db = nornicdb_tpu.open(auto_embed=False)
+        self.srv = GrpcServer(self.db, port=0).start()
+        self.channel = grpc.insecure_channel(self.srv.address)
+        self.grpc = grpc
+
+    def call(self, method, request, response_cls):
+        return self.channel.unary_unary(
+            method,
+            request_serializer=lambda r: r.SerializeToString(),
+            response_deserializer=response_cls.FromString,
+        )(request)
+
+    def create(self, name):
+        req = self.q.CreateCollection(collection_name=name)
+        req.vectors_config.params.size = self.dims
+        req.vectors_config.params.distance = self.q.Cosine
+        self.call("/qdrant.Collections/Create", req,
+                  self.q.CollectionOperationResponse)
+
+    def upsert(self, name, pid, vec, channel=None):
+        up = self.q.UpsertPoints(collection_name=name)
+        p = up.points.add()
+        p.id.num = pid
+        p.vectors.vector.data.extend(vec)
+        ch = channel or self.channel
+        return ch.unary_unary(
+            "/qdrant.Points/Upsert",
+            request_serializer=lambda r: r.SerializeToString(),
+            response_deserializer=self.q.PointsOperationResponse.FromString,
+        )(up)
+
+    def close(self):
+        self.channel.close()
+        self.srv.stop()
+        self.db.close()
+
+
+class TestAioWireRaces:
+    def test_concurrent_aio_upserts_land_exactly_once(self):
+        """N client threads push disjoint point ranges through the aio
+        Upsert path concurrently — the convoy coalescer merges them into
+        batched applies, but every acked point must exist exactly once
+        and the final count must be exact."""
+        s = _AioStack()
+        try:
+            s.create("conc")
+            n_threads, per = 8, 40
+            errors = []
+
+            def writer(t):
+                import grpc as _grpc
+
+                ch = _grpc.insecure_channel(s.srv.address)
+                try:
+                    for i in range(per):
+                        pid = t * 1000 + i
+                        resp = s.upsert("conc", pid, _vec(pid), channel=ch)
+                        if resp.result.status != s.q.Completed:
+                            errors.append(("status", pid))
+                except Exception as exc:  # pragma: no cover
+                    errors.append(repr(exc))
+                finally:
+                    ch.close()
+
+            ts = [threading.Thread(target=writer, args=(t,))
+                  for t in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert errors == []
+            resp = s.call("/qdrant.Points/Count",
+                          s.q.CountPoints(collection_name="conc"),
+                          s.q.CountResponse)
+            assert resp.result.count == n_threads * per
+            # convoys actually formed (not a degenerate 1-by-1 path)
+            co = s.db.qdrant_compat._upsert_coalescer
+            assert co.batched_items == n_threads * per
+            # spot-check retrievability of a few acked points
+            get = s.q.GetPoints(collection_name="conc")
+            for pid in (0, 3039, 7039):
+                get.ids.add().num = pid
+            resp = s.call("/qdrant.Points/Get", get, s.q.GetResponse)
+            assert len(resp.result) == 3
+        finally:
+            s.close()
+
+    def test_wrong_dim_search_rejected_not_convoy_poisoning(self):
+        """A wrong-dimension Search must come back INVALID_ARGUMENT (the
+        compat layer validates before the shared microbatcher) and must
+        not fail concurrent well-formed searches coalesced with it."""
+        s = _AioStack()
+        try:
+            s.create("dims")
+            for i in range(10):
+                s.upsert("dims", i, _vec(i))
+            good = s.q.SearchPoints(collection_name="dims",
+                                    vector=_vec(1), limit=3)
+            bad = s.q.SearchPoints(collection_name="dims",
+                                   vector=[1.0, 0.0], limit=3)  # 2 != 16
+            errors = []
+
+            def good_reader():
+                for _ in range(60):
+                    resp = s.call("/qdrant.Points/Search", good,
+                                  s.q.SearchResponse)
+                    if len(resp.result) != 3:
+                        errors.append("good search degraded")
+                        return
+
+            def bad_reader():
+                import grpc as _grpc
+
+                for _ in range(60):
+                    try:
+                        s.call("/qdrant.Points/Search", bad,
+                               s.q.SearchResponse)
+                        errors.append("bad search accepted")
+                        return
+                    except _grpc.RpcError as e:
+                        if e.code() != _grpc.StatusCode.INVALID_ARGUMENT:
+                            errors.append(("code", str(e.code())))
+                            return
+
+            ts = [threading.Thread(target=good_reader),
+                  threading.Thread(target=bad_reader)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert errors == []
+        finally:
+            s.close()
+
+    def test_wire_search_never_regresses_after_acked_upsert(self):
+        """Readers repeat ONE request-bytes Search through the aio wire
+        cache while a writer upserts ever-closer points through the same
+        server. Once upsert i has acked, no later wire read may serve a
+        pre-i cached response — the wire cache's generation validation
+        under real RPC concurrency."""
+        s = _AioStack()
+        try:
+            s.create("stable")
+            for i in range(20):
+                s.upsert("stable", i, _vec(i))
+            target = np.asarray(_vec(999))
+            u = np.asarray(_vec(555))
+            u = u - target * float(target @ u)
+            u = u / np.linalg.norm(u)
+            sr = s.q.SearchPoints(collection_name="stable",
+                                  vector=target.tolist(), limit=1)
+            sr_bytes = sr.SerializeToString()
+            acked = [0]
+            errors = []
+            saw_new = [0]
+            stop = threading.Event()
+
+            def writer():
+                for i in range(1, 40):
+                    theta = 1.0 / (i + 1.0)
+                    v = (np.cos(theta) * target + np.sin(theta) * u).tolist()
+                    s.upsert("stable", 5000 + i, v)
+                    acked[0] = i  # publish AFTER the RPC returned
+                    time.sleep(0.002)
+
+            def reader():
+                import grpc as _grpc
+
+                ch = _grpc.insecure_channel(s.srv.address)
+                stub = ch.unary_unary(
+                    "/qdrant.Points/Search",
+                    request_serializer=lambda b: b,
+                    response_deserializer=s.q.SearchResponse.FromString)
+                try:
+                    while not stop.is_set():
+                        floor = acked[0]
+                        resp = stub(sr_bytes)
+                        if not resp.result:
+                            continue
+                        top = int(resp.result[0].id.num)
+                        n = top - 5000 if top >= 5000 else 0
+                        if n < floor:
+                            errors.append((floor, n))
+                            return
+                        if n:
+                            saw_new[0] = max(saw_new[0], n)
+                finally:
+                    ch.close()
+
+            wt = threading.Thread(target=writer)
+            rts = [threading.Thread(target=reader) for _ in range(3)]
+            wt.start()
+            for t in rts:
+                t.start()
+            wt.join()
+            stop.set()
+            for t in rts:
+                t.join()
+            assert errors == [], f"stale wire-cached result: {errors}"
+            assert saw_new[0] > 0  # the race actually exercised the path
+        finally:
+            s.close()
+
+    def test_alias_flip_storm_through_aio_wire(self):
+        """UpdateAliases RPCs continuously flip an alias between two
+        collections while readers Search through the alias with ONE
+        fixed request-bytes payload: responses must always parse, never
+        404, and never mix the two targets — an alias flip must
+        invalidate cached response bytes (blue/green swap contract)."""
+        s = _AioStack()
+        try:
+            s.create("blue")
+            s.create("green")
+            for i in range(20):
+                s.upsert("blue", 100 + i, _vec(100 + i))
+                s.upsert("green", 200 + i, _vec(200 + i))
+
+            def set_alias(target, drop_first=False):
+                # one atomic ChangeAliases batch: delete+create apply
+                # under a single lock acquisition server-side, so the
+                # alias never has a "does not exist" window
+                req = s.q.ChangeAliases()
+                if drop_first:
+                    a = req.actions.add()
+                    a.delete_alias.alias_name = "live"
+                a = req.actions.add()
+                a.create_alias.alias_name = "live"
+                a.create_alias.collection_name = target
+                s.call("/qdrant.Collections/UpdateAliases", req,
+                       s.q.CollectionOperationResponse)
+
+            set_alias("blue")
+            sr = s.q.SearchPoints(collection_name="live",
+                                  vector=_vec(1), limit=5)
+            sr_bytes = sr.SerializeToString()
+            errors = []
+            stop = threading.Event()
+
+            def flipper():
+                targets = ["green", "blue"]
+                for i in range(150):
+                    set_alias(targets[i % 2], drop_first=True)
+
+            def searcher():
+                import grpc as _grpc
+
+                ch = _grpc.insecure_channel(s.srv.address)
+                stub = ch.unary_unary(
+                    "/qdrant.Points/Search",
+                    request_serializer=lambda b: b,
+                    response_deserializer=s.q.SearchResponse.FromString)
+                try:
+                    while not stop.is_set():
+                        try:
+                            resp = stub(sr_bytes)
+                        except _grpc.RpcError as e:
+                            errors.append(("rpc", str(e)))
+                            return
+                        ids = {int(p.id.num) for p in resp.result}
+                        if ids and not (
+                            all(100 <= i < 120 for i in ids)
+                            or all(200 <= i < 220 for i in ids)
+                        ):
+                            errors.append(("mixed", ids))
+                            return
+                finally:
+                    ch.close()
+
+            sts = [threading.Thread(target=searcher) for _ in range(2)]
+            ft = threading.Thread(target=flipper)
+            for t in sts:
+                t.start()
+            ft.start()
+            ft.join()
+            stop.set()
+            for t in sts:
+                t.join()
+            assert errors == []
+        finally:
+            s.close()
+
+
+class TestEveryWriteSurfaceInvalidatesWireCache:
+    """Named regression: a Search response cached at the WIRE level
+    (raw response bytes keyed by request bytes) must be invalidated by
+    every write surface — gRPC point ops, Cypher writes arriving over
+    any other surface, and bulk clears. A miss on any of these serves
+    stale bytes for the whole TTL."""
+
+    def test_cached_search_invalidated_by_every_write_surface(self):
+        s = _AioStack(dims=4)
+        try:
+            s.create("inv")
+            s.upsert("inv", 1, [1.0, 0.0, 0.0, 0.0])
+            sr = s.q.SearchPoints(collection_name="inv",
+                                  vector=[1.0, 0.0, 0.0, 0.0], limit=1)
+
+            def top():
+                resp = s.call("/qdrant.Points/Search", sr,
+                              s.q.SearchResponse)
+                return [int(p.id.num) for p in resp.result]
+
+            # prime + verify the bytes really are cached
+            assert top() == [1]
+            hits_before = s.srv.wire_cache.stats()["hits"]
+            assert top() == [1]
+            assert s.srv.wire_cache.stats()["hits"] == hits_before + 1
+
+            # (1) gRPC point op: a closer point must surface immediately
+            s.upsert("inv", 2, [1.0, 0.0, 0.0, 0.0])
+            s.upsert("inv", 3, [0.9, 0.1, 0.0, 0.0])
+            # exact-match tie: id 1 or 2 acceptable, but the response
+            # must have been recomputed (id 3 exists in top-3)
+            sr3 = s.q.SearchPoints(collection_name="inv",
+                                   vector=[1.0, 0.0, 0.0, 0.0], limit=3)
+            resp = s.call("/qdrant.Points/Search", sr3, s.q.SearchResponse)
+            assert {int(p.id.num) for p in resp.result} == {1, 2, 3}
+
+            # (2) point delete via gRPC
+            dp = s.q.DeletePoints(collection_name="inv")
+            dp.points.points.ids.add().num = 1
+            s.call("/qdrant.Points/Delete", dp, s.q.PointsOperationResponse)
+            assert 1 not in set(top())
+
+            # (3) Cypher write over another surface: retarget point 2's
+            # vector away from the query — the mutation listener must
+            # invalidate the wire cache through the same generation
+            assert top() == [2]
+            s.db.cypher("MATCH (n) WHERE n._point_id = 2 "
+                        "SET n._vector = [0.0, 0.0, 0.0, 1.0]")
+            assert top() == [3]
+
+            # (4) Cypher DETACH DELETE (GDPR-style erase)
+            s.db.cypher("MATCH (n) WHERE n._point_id = 3 DETACH DELETE n")
+            assert 3 not in set(top())
+
+            # (5) bulk clear: drop + recreate the collection
+            s.call("/qdrant.Collections/Delete",
+                   s.q.DeleteCollection(collection_name="inv"),
+                   s.q.CollectionOperationResponse)
+            s.create("inv")
+            resp = s.call("/qdrant.Points/Search", sr, s.q.SearchResponse)
+            assert list(resp.result) == []
+        finally:
+            s.close()

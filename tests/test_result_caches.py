@@ -137,27 +137,46 @@ class TestQdrantSearchCache:
         assert c.search_points("a", [1.0, 0.0], limit=1)[0]["id"] == 1
 
     def test_grpc_wire_cache_generation(self):
-        """The raw-bytes gRPC Search cache validates against the compat
-        generation counter."""
+        """The shared raw-bytes wire cache (the aio gRPC hot path probes
+        it before ANY protobuf work) validates serialized responses
+        against the compat generation counter — this mirrors exactly the
+        get/serve/put sequence of api.qdrant_official_grpc.aio_unary_raw."""
         from nornicdb_tpu.api.proto import qdrant_pb2 as q
         from nornicdb_tpu.api.qdrant_official_grpc import (
             OfficialPointsServicer,
         )
+        from nornicdb_tpu.cache import WireCache
 
         c = self._compat()
         svc = OfficialPointsServicer(c)
+        wire = WireCache()
+        method = "/qdrant.Points/Search"
         sr = q.SearchPoints(collection_name="a", vector=[1.0, 0.0],
                             limit=1)
         data = sr.SerializeToString()
-        r1 = q.SearchResponse.FromString(svc._search_wire(data, None))
-        assert r1.result[0].id.num == 1
-        # cache hit returns identical bytes
-        assert svc._search_wire(data, None) == r1.SerializeToString()
-        # mutation bumps the generation; same bytes recompute
+
+        def serve(data):
+            gen = c.cache_gen
+            hit = wire.get(method, data, gen)
+            if hit is not None:
+                return hit, True
+            out = svc.Search(
+                q.SearchPoints.FromString(data)).SerializeToString()
+            wire.put(method, data, gen, out)
+            return out, False
+
+        b1, was_hit = serve(data)
+        assert not was_hit
+        assert q.SearchResponse.FromString(b1).result[0].id.num == 1
+        # cache hit returns identical bytes, zero recompute
+        b2, was_hit = serve(data)
+        assert was_hit and b2 == b1
+        # mutation bumps the generation; same bytes recompute fresh
         c.upsert_points("a", [{"id": 7, "vector": [1.0, 0.0],
                                "payload": {}}])
-        r2 = q.SearchResponse.FromString(svc._search_wire(data, None))
-        assert len(r2.result) == 1  # limit 1, but recomputed fresh
+        b3, was_hit = serve(data)
+        assert not was_hit
+        assert len(q.SearchResponse.FromString(b3).result) == 1
 
 
 class TestNestedMutationSafety:
