@@ -71,6 +71,11 @@ class QdrantCompat:
         # shapes — the same leader-election window the native search
         # service rides (search/microbatch.py; SURVEY §7)
         self._microbatchers: Dict[str, Any] = {}
+        # per-collection device graph ANN (profile cagra): wraps the
+        # collection's brute index so the coalesced batches walk the
+        # graph instead of scanning the matrix once N crosses the
+        # profile threshold (search/cagra.py)
+        self._cagra: Dict[str, Any] = {}
         # concurrent point upserts merge into one apply per collection:
         # one lock acquisition + one generation bump per convoy
         from nornicdb_tpu.search.microbatch import BatchCoalescer
@@ -120,6 +125,7 @@ class QdrantCompat:
                 if space is not None:
                     space.index = None
                 self._raw.pop(name, None)
+                self._cagra.pop(name, None)
         self._clear_search_cache()
 
     def _space_key(self, name: str):
@@ -178,6 +184,7 @@ class QdrantCompat:
         with self._lock:
             self.vector_registry.drop(self._space_key(name))
             self._raw.pop(name, None)
+            self._cagra.pop(name, None)
             # drop the coalescer too: a recreated namesake may change
             # dims, and the batcher's dispatch must bind the new index
             self._microbatchers.pop(name, None)
@@ -734,6 +741,13 @@ class QdrantCompat:
             out.append(d)
             if len(out) >= limit:
                 break
+        if distance == "Cosine":
+            # the ANN first round can under-fill (stale-graph filtering
+            # or walk misses) and the exact widening rounds then append
+            # higher-scored hits AFTER it — re-sort so the response
+            # honors the score-desc contract. Exact-only paths are
+            # already ordered, so this is a no-op for them.
+            out.sort(key=lambda d: -d["score"])
         return self._search_cache.put_guarded(cache_key, out,
                                               gen_at_miss)
 
@@ -748,9 +762,46 @@ class QdrantCompat:
             if mb is None:
                 mb = MicroBatcher(
                     lambda queries, k, _n=name:
-                        self._index(_n).search_batch(queries, k))
+                        self._ann_search_index(_n).search_batch(queries, k))
                 self._microbatchers[name] = mb
             return mb
+
+    def _ann_search_index(self, name: str):
+        """The index the coalesced batches dispatch to: the collection's
+        brute index, wrapped by the device graph ANN when the profile
+        selects cagra and the collection has crossed its threshold. The
+        wrapper shares the brute index (zero vector copies) and rebuilds
+        its graph off the brute mutation counter; a collection-index
+        invalidation (external mutation, lazy rebuild) is caught by the
+        identity check and re-wraps the fresh index."""
+        idx = self._index(name)
+        from nornicdb_tpu.search.ann_quality import current_profile
+
+        p = current_profile()
+        if p.index_kind != "cagra" or len(idx) < p.cagra_min_n:
+            # drop any retired wrapper: a collection that shrank below
+            # the threshold (or a profile switch) must not pin the old
+            # graph's device arrays in memory until collection delete
+            with self._lock:
+                self._cagra.pop(name, None)
+            return idx
+        from nornicdb_tpu.search.ann_quality import cagra_shards_from_env
+        from nornicdb_tpu.search.cagra import CagraIndex
+
+        with self._lock:
+            wrap = self._cagra.get(name)
+            if wrap is None or wrap._brute is not idx:
+                # build_inline=False: the first graph build happens in
+                # background too — a search convoy crossing the size
+                # threshold serves the exact brute kernel instead of
+                # stalling its MicroBatcher leader for the device kNN
+                wrap = CagraIndex(
+                    brute=idx, degree=p.cagra_degree, itopk=p.cagra_itopk,
+                    search_width=p.cagra_width, min_n=p.cagra_min_n,
+                    n_shards=cagra_shards_from_env(p.cagra_shards),
+                    build_inline=False)
+                self._cagra[name] = wrap
+            return wrap
 
     def _ranked_cosine(self, name: str, vector: Sequence[float]):
         """Yield (node_id, cosine) best-first, progressively widening the
@@ -777,14 +828,23 @@ class QdrantCompat:
             if first:
                 hits = self._collection_microbatch(name).search(q, k_req)
                 first = False
+                # a short FIRST round is not exhaustion: the ANN wrapper
+                # (cagra) live-filters rows deleted since its build, so
+                # it can return < k while thousands of live rows remain.
+                # Widening rounds query the brute index directly and ARE
+                # authoritative.
+                ann_round = True
             else:
                 hits = idx.search(q, k=k_req)
+                ann_round = False
             for nid, score in hits:
                 if nid in yielded:
                     continue
                 yielded.add(nid)
                 yield nid, score
-            if len(yielded) >= total or len(hits) < k:
+            if len(yielded) >= total:
+                return
+            if len(hits) < k and not ann_round:
                 return
             k *= 4
 

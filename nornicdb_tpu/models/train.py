@@ -145,7 +145,9 @@ def make_sharded_train_step(
     def run(st, anchor_ids, positive_ids):
         # activation sharding constraints use raw PartitionSpecs, which
         # need the mesh in context at trace time
-        with jax.set_mesh(mesh):
+        from nornicdb_tpu.parallel.mesh import mesh_context
+
+        with mesh_context(mesh):
             return jitted(st, anchor_ids, positive_ids)
 
     return state, run

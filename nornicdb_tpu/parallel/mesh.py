@@ -72,6 +72,30 @@ def data_mesh(n: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devices), axis_names=("data",))
 
 
+def compat_shard_map(f, mesh, in_specs, out_specs):
+    """shard_map across jax versions: ``jax.shard_map`` (with
+    ``check_vma``) on new releases, ``jax.experimental.shard_map`` (with
+    ``check_rep``) on 0.4.x — both replication checks disabled, since
+    the local top-k bodies intentionally mix replicated queries with
+    sharded rows."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def mesh_context(mesh: Mesh):
+    """Trace-time mesh scope across jax versions: ``jax.set_mesh`` on
+    new releases; on 0.4.x a Mesh is its own context manager (both make
+    raw-PartitionSpec sharding constraints resolvable inside jit)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
 @functools.partial(jax.jit, static_argnames=("k", "mesh_holder"))
 def _sharded_topk_impl(queries, matrix, valid, k, mesh_holder):
     mesh = mesh_holder.mesh
@@ -80,8 +104,6 @@ def _sharded_topk_impl(queries, matrix, valid, k, mesh_holder):
     # every member of the global top-k is within the top-min(k, rows) of its
     # own shard, so gathering local_k per shard merges to the EXACT top-k
     local_k = min(k, shard_rows)
-
-    shard_map = jax.shard_map
 
     def local_topk(q, m, v):
         # q: [B, D] replicated; m: [rows/n, D]; v: [rows/n]
@@ -98,12 +120,11 @@ def _sharded_topk_impl(queries, matrix, valid, k, mesh_holder):
         top_i = jnp.take_along_axis(all_i, pos, axis=1)
         return top_s, top_i
 
-    return shard_map(
+    return compat_shard_map(
         local_topk,
         mesh=mesh,
         in_specs=(P(), P("data", None), P("data")),
         out_specs=(P(), P()),
-        check_vma=False,
     )(queries, matrix, valid)
 
 

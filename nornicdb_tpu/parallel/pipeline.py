@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from nornicdb_tpu.parallel.mesh import compat_shard_map
 
 
 # -- pipeline parallelism -------------------------------------------------
@@ -123,12 +123,11 @@ def pipeline_apply(
         return jax.lax.psum(outputs, "pp")
 
     data_spec = P(None, batch_axis) if batch_axis else P()
-    out = shard_map(
+    out = compat_shard_map(
         staged,
         mesh=mesh,
         in_specs=(P("pp"), data_spec),
         out_specs=data_spec,
-        check_vma=False,
     )(params, xs)
     return out.reshape(batch, width)
 
@@ -217,7 +216,7 @@ def moe_apply(
         out = jnp.einsum("bec,ecd->bd", dispatch, y) * gate[:, None]
         return out, jax.lax.pmean(aux, "ep")
 
-    return shard_map(
+    return compat_shard_map(
         local,
         mesh=mesh,
         in_specs=(
@@ -225,7 +224,6 @@ def moe_apply(
             P("ep"),
         ),
         out_specs=(P("ep"), P()),
-        check_vma=False,
     )(params, x)
 
 

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nornicdb_tpu.parallel.mesh import compat_shard_map
+
 
 def _ring_attention_local(q, k, v, mask, axis_name: str):
     """Per-device body under shard_map.
@@ -102,12 +104,11 @@ def ring_attention(
         return _dense_attention(q, k, v, mask)
 
     qkv_spec = P(batch_axis, axis_name, head_axis, None)
-    fn = jax.shard_map(
+    fn = compat_shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch_axis, axis_name)),
         out_specs=qkv_spec,
-        check_vma=False,
     )
     return fn(q, k, v, mask)
 

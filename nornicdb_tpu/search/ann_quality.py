@@ -14,7 +14,7 @@ from dataclasses import dataclass
 @dataclass(frozen=True)
 class ANNProfile:
     name: str
-    index_kind: str  # brute | hnsw | ivf_hnsw | ivfpq
+    index_kind: str  # brute | hnsw | ivf_hnsw | ivfpq | cagra
     hnsw_m: int = 16
     hnsw_ef_construction: int = 100
     hnsw_ef_search: int = 64
@@ -26,6 +26,14 @@ class ANNProfile:
     # NORNICDB_VECTOR_PQ_REFINE=0 to opt out when the memory budget
     # really is codes-only.
     pq_refine: bool = True
+    # cagra tier: fixed out-degree device graph (search/cagra.py).
+    # Below cagra_min_n live vectors the index serves from the brute
+    # device kernel — at small N one matmul beats any walk.
+    cagra_degree: int = 32
+    cagra_itopk: int = 64
+    cagra_width: int = 1
+    cagra_min_n: int = 4096
+    cagra_shards: int = 1
 
 
 PROFILES = {
@@ -41,7 +49,24 @@ PROFILES = {
     "compressed": ANNProfile(
         name="compressed", index_kind="ivfpq",
         nprobe=8, pq_subspaces=16),
+    # device-resident graph ANN: the accelerator-native sub-linear tier
+    # (CAGRA-style batched walk; docs/ann_architecture.md). Shard count
+    # defaults to the env knob so multi-chip deployments row-shard the
+    # corpus without a code change.
+    "cagra": ANNProfile(
+        name="cagra", index_kind="cagra",
+        cagra_degree=32, cagra_itopk=64, cagra_width=1,
+        cagra_min_n=4096),
 }
+
+
+def cagra_shards_from_env(default: int = 1) -> int:
+    """NORNICDB_CAGRA_SHARDS: row-shard count for the cagra tier. When
+    fewer devices than shards are live, CagraIndex serves the sharded
+    layout through its single-device reference merge instead."""
+    from nornicdb_tpu.config import env_int
+
+    return max(1, env_int("CAGRA_SHARDS", default))
 
 ENV_VAR = "NORNICDB_VECTOR_ANN_QUALITY"
 

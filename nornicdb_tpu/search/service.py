@@ -90,6 +90,7 @@ class SearchStats:
     searches: int = 0
     cache_hits: int = 0
     hnsw_builds: int = 0
+    cagra_builds: int = 0
     # per-stage timings of the most recent search, populated when
     # NORNICDB_TPU_SEARCH_DIAG is set (reference:
     # NORNICDB_SEARCH_DIAG_TIMINGS)
@@ -133,6 +134,10 @@ class SearchService:
         )
         self.vectors = self._doc_space.ensure_index()
         self.hnsw: Optional[HNSWIndex] = None
+        # device-resident graph ANN (profile cagra): wraps self.vectors
+        # as its vector store, so index mutations propagate and the
+        # graph rebuilds itself from the shared brute snapshot
+        self.cagra = None
         self._hnsw_m = hnsw_m
         self._hnsw_ef = hnsw_ef_search
         self.stats = SearchStats()
@@ -158,8 +163,18 @@ class SearchService:
         # only wins at batch/scale")
         from nornicdb_tpu.search.microbatch import MicroBatcher
 
-        self._microbatch = MicroBatcher(
-            lambda queries, k: self.vectors.search_batch(queries, k))
+        # dispatch resolves the ACTIVE ANN index per batch (cagra once
+        # built, else brute), so the coalescing window feeds whichever
+        # device index the strategy machine currently owns
+        self._microbatch = MicroBatcher(self._ann_search_batch)
+
+    def _ann_search_batch(self, queries, k):
+        """Batched device dispatch for the micro-batcher: the CAGRA
+        graph walk when built, else the brute matmul+top-k."""
+        cagra = self.cagra
+        if cagra is not None:
+            return cagra.search_batch(queries, k)
+        return self.vectors.search_batch(queries, k)
 
     def _clear_result_cache(self) -> None:
         self._result_cache.bump_generation()
@@ -351,11 +366,20 @@ class SearchService:
             self._doc_space.index = vectors
             self.vectors = vectors
             self.hnsw = hnsw
+            # any prior graph wraps the REPLACED brute index — drop it
+            # or searches would keep serving the discarded corpus
+            self.cagra = None
             self._saved_at_ms = int(meta.get("saved_at_ms", 0))
             self.stats.indexed_docs = len(self.bm25)
             self.stats.indexed_vectors = len(self.vectors)
+            self.stats.strategy = "brute"
             if hnsw is not None:
                 self.stats.strategy = "hnsw"
+            elif meta.get("strategy") == "cagra":
+                # the graph is derived state (not persisted): rebuild it
+                # from the restored vectors now so a read-only workload
+                # after restart doesn't silently serve brute-force
+                self._maybe_switch_strategy()
         return True
 
     def _schedule_save(self) -> None:
@@ -396,8 +420,56 @@ class SearchService:
     # -- strategy state machine -------------------------------------------
 
     def _maybe_switch_strategy(self) -> None:
-        if self.hnsw is None and len(self.vectors) >= self.hnsw_threshold:
+        if len(self.vectors) < self.hnsw_threshold:
+            return
+        from nornicdb_tpu.search.ann_quality import current_profile
+
+        if current_profile().index_kind == "cagra":
+            # device-graph tier: the CagraIndex manages its own rebuild
+            # cadence after the first build (mutation-churn threshold)
+            if self.cagra is None:
+                self._rebuild_cagra()
+            return
+        if self.hnsw is None:
             self._rebuild_hnsw()
+
+    def _rebuild_cagra(self) -> None:
+        """Build the device-resident graph over the live brute index.
+        Config-gated (NORNICDB_VECTOR_ANN_QUALITY=cagra); the service
+        threshold is the build gate, so min_n only keeps the index
+        honest if the corpus later shrinks."""
+        from nornicdb_tpu.search.ann_quality import (
+            cagra_shards_from_env,
+            current_profile,
+        )
+        from nornicdb_tpu.search.cagra import CagraIndex
+
+        p = current_profile()
+        # build_inline=False: the first build happens right here (the
+        # explicit build() below, on the write path); any LATER
+        # graph-from-scratch transition (corpus shrank below min_n and
+        # regrew) must not stall a search convoy — brute serves while
+        # the background build runs
+        idx = CagraIndex(
+            brute=self.vectors,
+            degree=p.cagra_degree, itopk=p.cagra_itopk,
+            search_width=p.cagra_width,
+            min_n=min(p.cagra_min_n, self.hnsw_threshold),
+            n_shards=cagra_shards_from_env(p.cagra_shards),
+            build_inline=False,
+        )
+        if not idx.build():
+            return
+        self.cagra = idx
+        # surface the graph index as its own vector space, mirroring the
+        # hnsw tier (reference: backend kinds, registry.go:1-60)
+        cagra_space = self.vector_registry.get_or_create(
+            database=self.database, entity_type="node",
+            vector_name="embedding_cagra", backend="cagra",
+        )
+        cagra_space.index = idx
+        self.stats.cagra_builds += 1
+        self.stats.strategy = "cagra"
 
     def _rebuild_hnsw(self) -> None:
         """(Re)build HNSW from the brute index, BM25 seeds first."""
@@ -468,12 +540,25 @@ class SearchService:
         (reference: hybrid_cluster_routing.go:248-256)."""
         with self._lock:
             hnsw = self.hnsw
-        if hnsw is not None and not exact:
-            return hnsw.search(query_vec, k)
+            cagra = self.cagra
+        if not exact:
+            if cagra is not None:
+                # device graph walk, micro-batched: concurrent b=1
+                # queries coalesce into one pow2-bucketed walk dispatch
+                return self._microbatch.search(query_vec, k)
+            if hnsw is not None:
+                return hnsw.search(query_vec, k)
         if lexical_doc_ids and hasattr(self.vectors, "route"):
             return self.vectors.search(query_vec, k,
                                        lexical_doc_ids=lexical_doc_ids)
         if hasattr(self.vectors, "search_batch"):
+            if exact:
+                # exact requests never ride the micro-batcher: its
+                # dispatch re-reads self.cagra, so a concurrent graph
+                # build could answer an exact request approximately.
+                # Direct brute call (rare path: eval + exact=True).
+                return self.vectors.search_batch(
+                    np.asarray([query_vec], dtype=np.float32), k)[0]
             # micro-batched: concurrent singles ride one device call
             return self._microbatch.search(query_vec, k)
         return self.vectors.search(query_vec, k)  # IVF backends

@@ -39,9 +39,21 @@ def _use_pallas() -> bool:
 class BruteForceIndex:
     """Exact cosine kNN over (id -> vector). Thread-safe."""
 
-    def __init__(self, dims: Optional[int] = None, use_device: bool = True):
+    def __init__(
+        self,
+        dims: Optional[int] = None,
+        use_device: bool = True,
+        compact_min_dead: int = 1024,
+        compact_dead_frac: float = 0.5,
+    ):
         self.dims = dims
         self.use_device = use_device
+        # compaction policy: once dead (tombstoned) slots exceed BOTH
+        # the absolute floor and the fraction of used slots, live rows
+        # are re-packed and capacity re-padded — long-lived collections
+        # with churn stop scanning (and shipping to HBM) garbage rows
+        self.compact_min_dead = compact_min_dead
+        self.compact_dead_frac = compact_dead_frac
         self._lock = threading.RLock()
         self._capacity = 0
         self._count = 0  # high-water mark of used slots
@@ -51,6 +63,16 @@ class BruteForceIndex:
         self._slot_of: Dict[str, int] = {}
         self._free: List[int] = []  # recycled slots (deletes)
         self._n_alive = 0
+        # write-generation counter: bumped on every add/remove/compact.
+        # Derived indexes (search/cagra.py graphs) key their staleness
+        # off it instead of subscribing to individual mutations.
+        self.mutations = 0
+        self.compactions = 0
+        # changelog of (mutation seq, ext_id) for adds/updates — derived
+        # indexes exact-score these between rebuilds (read-your-writes).
+        # Length-capped; _changelog_floor marks how far back it reaches.
+        self._changelog: List[Tuple[int, str]] = []
+        self._changelog_floor = 0
         # device cache
         self._dev_matrix = None
         self._dev_valid = None
@@ -96,6 +118,8 @@ class BruteForceIndex:
                 slot = self._slot_of[ext_id]
                 self._matrix[slot] = self._normalize(v)
                 self._dirty = True
+                self.mutations += 1
+                self._log_change_locked(ext_id)
                 return
             self._ensure_capacity(self._count + (0 if self._free else 1), v.shape[0])
             if self._free:
@@ -109,6 +133,35 @@ class BruteForceIndex:
             self._slot_of[ext_id] = slot
             self._n_alive += 1
             self._dirty = True
+            self.mutations += 1
+            self._log_change_locked(ext_id)
+
+    def _log_change_locked(self, ext_id: str) -> None:
+        self._changelog.append((self.mutations, ext_id))
+        # cap well above any derived index's rebuild threshold (10% of
+        # corpus churn) so changed_since() can always reach a live
+        # build marker; beyond the cap the floor advances and consumers
+        # fall back to a full rebuild/exact path
+        limit = max(4096, self._capacity // 4)
+        if len(self._changelog) > limit:
+            cut = len(self._changelog) - limit
+            self._changelog_floor = self._changelog[cut - 1][0]
+            del self._changelog[:cut]
+
+    def changed_since(self, seq: int) -> Optional[List[str]]:
+        """ext_ids added or UPDATED after mutation ``seq`` (latest first,
+        deduped). Deletes are not reported — consumers live-filter those.
+        Returns None when the changelog has been trimmed past ``seq``
+        (consumer should rebuild or take an exact path instead)."""
+        with self._lock:
+            if seq < self._changelog_floor:
+                return None
+            out: List[str] = []
+            for s, eid in reversed(self._changelog):
+                if s <= seq:
+                    break
+                out.append(eid)
+        return list(dict.fromkeys(out))
 
     def add_batch(self, items: Sequence[Tuple[str, Sequence[float]]]) -> None:
         with self._lock:
@@ -125,7 +178,59 @@ class BruteForceIndex:
             self._free.append(slot)
             self._n_alive -= 1
             self._dirty = True
+            self.mutations += 1
+            self._maybe_compact_locked()
             return True
+
+    def _maybe_compact_locked(self) -> None:
+        dead = self._count - self._n_alive
+        if (dead < self.compact_min_dead
+                or dead < self.compact_dead_frac * max(self._count, 1)):
+            return
+        self._compact_locked()
+
+    def compact(self) -> bool:
+        """Re-pack live rows and re-pad capacity. Normally triggered by
+        the remove-path policy; public for tests and admin tooling."""
+        with self._lock:
+            if self._count == self._n_alive:
+                return False
+            self._compact_locked()
+            return True
+
+    def _compact_locked(self) -> None:
+        """Drop tombstoned rows: live rows move to the front (insertion
+        order preserved) and capacity shrinks to pad_dim(n_alive), so
+        search matmuls — and the HBM mirror — stop paying for deletes.
+        Slot ids are remapped; _slot_of is the only consumer."""
+        if self._n_alive == 0:
+            self._capacity = 0
+            self._count = 0
+            self._matrix = None
+            self._valid = None
+            self._ext_ids = []
+            self._slot_of = {}
+            self._free = []
+        else:
+            rows = [i for i, e in enumerate(self._ext_ids)
+                    if e is not None and self._valid[i]]
+            new_cap = pad_dim(len(rows))
+            new_m = np.zeros((new_cap, self.dims), dtype=np.float32)
+            new_m[: len(rows)] = self._matrix[rows]
+            new_v = np.zeros((new_cap,), dtype=bool)
+            new_v[: len(rows)] = True
+            self._ext_ids = ([self._ext_ids[i] for i in rows]
+                             + [None] * (new_cap - len(rows)))
+            self._slot_of = {e: s for s, e in enumerate(self._ext_ids)
+                             if e is not None}
+            self._matrix = new_m
+            self._valid = new_v
+            self._capacity = new_cap
+            self._count = len(rows)
+            self._free = []
+        self._dirty = True
+        self.mutations += 1
+        self.compactions += 1
 
     def get(self, ext_id: str) -> Optional[np.ndarray]:
         with self._lock:
@@ -214,8 +319,13 @@ class BruteForceIndex:
     # -- bulk access (for HNSW/kmeans builds) ------------------------------
 
     def snapshot(self) -> Tuple[np.ndarray, np.ndarray, List[Optional[str]]]:
-        """(matrix[cap,D], valid[cap], ext_ids) — normalized, host-side."""
+        """(matrix[cap,D], valid[cap], ext_ids) — normalized, host-side.
+        An empty index (never populated, or compacted to empty) yields
+        zero-row arrays rather than crashing its graph/HNSW builders."""
         with self._lock:
+            if self._matrix is None:
+                return (np.zeros((0, self.dims or 0), np.float32),
+                        np.zeros((0,), bool), [])
             return self._matrix.copy(), self._valid.copy(), list(self._ext_ids)
 
     def ids(self) -> List[str]:

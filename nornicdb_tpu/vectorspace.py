@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 CHUNK_VECTOR_NAME = "chunks"
 DEFAULT_VECTOR_NAME = "embedding"
 
-_BACKENDS = ("auto", "brute", "hnsw", "ivf_hnsw", "ivfpq")
+_BACKENDS = ("auto", "brute", "hnsw", "ivf_hnsw", "ivfpq", "cagra")
 
 
 @dataclass(frozen=True)
@@ -78,6 +78,16 @@ class VectorSpace:
             self.index = IVFPQIndex(n_subspaces=p.pq_subspaces,
                                     nprobe=p.nprobe,
                                     keep_vectors=refine)
+        elif kind == "cagra":
+            from nornicdb_tpu.search.ann_quality import cagra_shards_from_env
+            from nornicdb_tpu.search.cagra import CagraIndex
+
+            p = current_profile()
+            self.index = CagraIndex(
+                dims=self.key.dims or None,
+                degree=p.cagra_degree, itopk=p.cagra_itopk,
+                search_width=p.cagra_width, min_n=p.cagra_min_n,
+                n_shards=cagra_shards_from_env(p.cagra_shards))
         else:
             raise ValueError(f"unknown backend {kind!r}")
         return self.index

@@ -129,10 +129,27 @@ class TestIVFHNSW:
 class TestANNQuality:
     def test_profiles_exist(self):
         assert set(PROFILES) == {"fast", "balanced", "accurate",
-                                 "compressed"}
+                                 "compressed", "cagra"}
         assert PROFILES["compressed"].index_kind == "ivfpq"
+        assert PROFILES["cagra"].index_kind == "cagra"
         assert (PROFILES["accurate"].hnsw_ef_search
                 > PROFILES["fast"].hnsw_ef_search)
+
+    def test_cagra_profile_params(self):
+        p = PROFILES["cagra"]
+        assert p.cagra_itopk & (p.cagra_itopk - 1) == 0  # pow2
+        assert p.cagra_degree >= 16
+        assert p.cagra_min_n > 0
+
+    def test_cagra_shards_env(self, monkeypatch):
+        from nornicdb_tpu.search.ann_quality import cagra_shards_from_env
+
+        monkeypatch.delenv("NORNICDB_CAGRA_SHARDS", raising=False)
+        assert cagra_shards_from_env() == 1
+        monkeypatch.setenv("NORNICDB_CAGRA_SHARDS", "4")
+        assert cagra_shards_from_env() == 4
+        monkeypatch.setenv("NORNICDB_CAGRA_SHARDS", "junk")
+        assert cagra_shards_from_env() == 1
 
     def test_env_selection(self, monkeypatch):
         monkeypatch.setenv("NORNICDB_VECTOR_ANN_QUALITY", "accurate")
@@ -142,6 +159,42 @@ class TestANNQuality:
 
     def test_explicit_name_wins(self):
         assert current_profile("fast").name == "fast"
+
+
+class TestCagraProfileRecall:
+    """ISSUE 2 satellite: ANN recall regression gate for the cagra
+    profile on the standard clustered corpus — recall@10 >= 0.95."""
+
+    def test_recall_at_10_on_clustered_corpus(self):
+        from nornicdb_tpu.search.cagra import CagraIndex
+
+        items = _clustered_vectors(n_per=500, n_clusters=4, dims=32)
+        vecs = np.asarray([v for _, v in items], dtype=np.float32)
+        idx = CagraIndex(min_n=256)
+        idx.add_batch(items)
+        assert idx.build()
+
+        rng = np.random.default_rng(8)
+        nq = 50
+        qrows = rng.choice(len(items), nq, replace=False)
+        qs = vecs[qrows] + 0.1 * rng.standard_normal(
+            (nq, vecs.shape[1])).astype(np.float32)
+        vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+        qn = qs / np.linalg.norm(qs, axis=1, keepdims=True)
+        gt = np.argsort(-(qn @ vn.T), axis=1)[:, :10]
+        gt_sets = [{items[j][0] for j in row} for row in gt]
+        res = idx.search_batch(qs, 10)
+        hit = sum(len({h for h, _ in res[qi]} & gt_sets[qi])
+                  for qi in range(nq))
+        assert hit / (nq * 10) >= 0.95
+
+    def test_registry_cagra_backend(self, monkeypatch):
+        from nornicdb_tpu.search.cagra import CagraIndex as CI
+
+        reg = VectorSpaceRegistry()
+        sp = reg.get_or_create(database="x", vector_name="g",
+                               backend="cagra")
+        assert isinstance(sp.ensure_index(), CI)
 
 
 class TestVectorSpaceRegistry:

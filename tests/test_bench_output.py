@@ -37,6 +37,11 @@ def _fake_result():
                 "ivf_hnsw": 500.0, "ivfpq": 317.0}},
             "pagerank_device": {"speedup_vs_numpy": 1.2},
         },
+        "ann": {"cagra": {"qps_at_recall95": 4100.0,
+                          "recall_at_10": 0.99,
+                          "speedup_vs_brute": 2.0,
+                          "brute_qps": 2050.0,
+                          "backend": "cpu"}},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "tpu_proof": {"skipped": "backend is 'cpu'"},
@@ -59,6 +64,10 @@ class TestCompactSummary:
         assert s["hnsw_build"]["seeded_speedup"] == 1.6
         assert s["hnsw_build"]["vs_baseline"] == 1.02
         assert s["qps_at_recall95"]["ivfpq"] == 317.0
+        assert s["cagra"] == {"qps_at_recall95": 4100.0,
+                              "recall_at_10": 0.99,
+                              "speedup_vs_brute": 2.0,
+                              "backend": "cpu"}
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
 
@@ -69,6 +78,7 @@ class TestCompactSummary:
         assert s["surfaces"] == {}
         assert s["hnsw_build"]["inserts_per_s"] is None
         assert s["knn"]["b1_qps"] is None
+        assert s["cagra"]["qps_at_recall95"] is None
         assert s["tpu_proof"] is None
 
     def test_error_result_still_summarizes(self):
@@ -123,7 +133,7 @@ class TestBenchDryRunArtifactSchema:
     default suite here first)."""
 
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
-                    "knn", "northstar", "surfaces", "tpu_proof")
+                    "knn", "northstar", "ann", "surfaces", "tpu_proof")
 
     def test_dry_run_artifact_schema(self):
         import os
@@ -153,6 +163,16 @@ class TestBenchDryRunArtifactSchema:
         knn = full["knn"]
         assert knn["b1_concurrent_qps"] > 0
         assert knn["value"] > 0  # headline b=1 qps
+
+        # the device graph-ANN stage: schema-complete even at toy sizes
+        # (graph built, recall measured, both qps sides present)
+        cagra = full["ann"]["cagra"]
+        assert cagra["graph_built"] is True
+        assert cagra["recall_at_10"] > 0
+        assert cagra["qps"] > 0 and cagra["brute_qps"] > 0
+        assert len(cagra["sweep"]) == 3
+        assert "qps_at_recall95" in cagra and "speedup_vs_brute" in cagra
+        assert full["ann"]["cagra"]["backend"] == "cpu"
 
         # every surface measured, and the new framework-floor fields
         surf = full["surfaces"]

@@ -196,6 +196,55 @@ class TestOpsReviewRegressions:
         np.testing.assert_allclose(np.asarray(s), np.asarray(s_ref), rtol=1e-5)
         np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
 
+    def test_sharded_topk_uneven_n_padding_never_surfaces(self):
+        """ISSUE 2 satellite: shard-uneven N — the last shard is mostly
+        (or entirely) padding; padding rows must never appear in the
+        merged top-k even when k forces every shard to contribute."""
+        rng = np.random.default_rng(21)
+        cap = 1024  # 128 rows/shard on 8 devices
+        n = 30  # shard 0 partially filled; shards 1..7 are ALL padding
+        m = np.zeros((cap, 16), dtype=np.float32)
+        m[:n] = rng.standard_normal((n, 16))
+        valid = np.zeros((cap,), dtype=bool)
+        valid[:n] = True
+        mj = l2_normalize(jnp.asarray(m))
+        q = l2_normalize(jnp.asarray(
+            rng.standard_normal((3, 16)).astype(np.float32)))
+        vj = jnp.asarray(valid)
+        k = 64
+        s, i = sharded_cosine_topk(q, mj, vj, k, mesh=data_mesh())
+        s, i = np.asarray(s), np.asarray(i)
+        finite = s > -1e29
+        # every finite hit indexes a REAL row; every padding slot is
+        # masked to the sentinel; each query fills exactly min(k, n)
+        assert (i[finite] < n).all()
+        assert finite.sum(axis=1).tolist() == [min(k, n)] * 3
+        s_ref, i_ref = cosine_topk(q, mj, vj, k)
+        np.testing.assert_array_equal(i[finite],
+                                      np.asarray(i_ref)[finite])
+
+    def test_sharded_topk_k_exceeds_shard_rows_with_padding(self):
+        """k > rows-per-shard AND padding rows present: the local_k
+        merge must stay exact and padding must stay masked."""
+        rng = np.random.default_rng(22)
+        cap = 256  # 32 rows/shard on 8 devices
+        n = 200
+        m = np.zeros((cap, 16), dtype=np.float32)
+        m[:n] = rng.standard_normal((n, 16))
+        valid = np.zeros((cap,), dtype=bool)
+        valid[:n] = True
+        mj = l2_normalize(jnp.asarray(m))
+        q = l2_normalize(jnp.asarray(
+            rng.standard_normal((2, 16)).astype(np.float32)))
+        vj = jnp.asarray(valid)
+        k = 50  # > 32 per shard
+        s, i = sharded_cosine_topk(q, mj, vj, k, mesh=data_mesh())
+        s_ref, i_ref = cosine_topk(q, mj, vj, k)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(s_ref),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+        assert (np.asarray(i)[np.asarray(s) > -1e29] < n).all()
+
     def test_chunked_odd_capacity_falls_back_dense(self):
         rng = np.random.default_rng(8)
         m = l2_normalize(jnp.asarray(rng.standard_normal((1001, 8)).astype(np.float32)))

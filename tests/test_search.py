@@ -152,6 +152,82 @@ class TestBruteForceIndex:
         assert res[0][0][0] == "x" and res[1][0][0] == "y"
 
 
+class TestBruteForceCompaction:
+    """ISSUE 2 satellite: remove() only tombstoned and capacity never
+    shrank, so long-lived collections scanned garbage rows forever.
+    Compaction re-packs live rows once the dead fraction crosses the
+    policy thresholds."""
+
+    def _churned(self, n=300, dead=200, **kw):
+        kw.setdefault("compact_min_dead", 64)
+        kw.setdefault("compact_dead_frac", 0.5)
+        idx = BruteForceIndex(**kw)
+        rng = np.random.default_rng(0)
+        vecs = rng.standard_normal((n, 16)).astype(np.float32)
+        for i in range(n):
+            idx.add(f"n{i}", vecs[i])
+        for i in range(dead):
+            idx.remove(f"n{i}")
+        return idx, vecs
+
+    def test_capacity_shrinks_and_results_survive(self):
+        idx, vecs = self._churned()
+        assert idx.compactions >= 1
+        assert idx._capacity == 256  # pad_dim(100), down from 512
+        # residual tombstones stay under the re-trigger floor
+        assert idx._count - len(idx) < idx.compact_min_dead
+        hits = idx.search(vecs[250], k=3)
+        assert hits[0][0] == "n250"
+        assert all(int(h[0][1:]) >= 200 for h in hits)
+
+    def test_below_threshold_never_compacts(self):
+        idx, _ = self._churned(n=300, dead=40)  # < compact_min_dead
+        assert idx.compactions == 0
+        idx2, _ = self._churned(n=300, dead=80,
+                                compact_dead_frac=0.9)  # < frac
+        assert idx2.compactions == 0
+
+    def test_compact_to_empty(self):
+        idx, _ = self._churned(n=100, dead=100, compact_min_dead=32)
+        assert len(idx) == 0
+        assert idx.search_batch(np.ones((1, 16), np.float32), 3) == [[]]
+        # snapshot of the fully-compacted empty state stays well-formed
+        # for graph/HNSW builders instead of crashing
+        idx.compact()
+        m, v, ids = idx.snapshot()
+        assert m.shape[0] == 0 and v.shape[0] == 0 and ids == []
+        # index stays usable after the full drain
+        idx.add("back", np.ones(16, np.float32))
+        assert idx.search(np.ones(16), k=1)[0][0] == "back"
+
+    def test_readd_after_compaction(self):
+        idx, vecs = self._churned()
+        idx.add("n5", vecs[5])  # removed id returns post-compaction
+        assert idx.search(vecs[5], k=1)[0][0] == "n5"
+        assert len(idx) == 101
+
+    def test_mutation_counter_monotonic(self):
+        idx = BruteForceIndex(compact_min_dead=8, compact_dead_frac=0.5)
+        seen = [idx.mutations]
+        for i in range(20):
+            idx.add(f"n{i}", np.eye(16)[i % 16])
+            seen.append(idx.mutations)
+        for i in range(15):
+            idx.remove(f"n{i}")
+            seen.append(idx.mutations)
+        assert all(b > a for a, b in zip(seen, seen[1:]))
+
+    def test_explicit_compact_api(self):
+        idx = BruteForceIndex()  # default thresholds: no auto-compact
+        for i in range(10):
+            idx.add(f"n{i}", np.eye(16)[i])
+        idx.remove("n0")
+        assert idx.compactions == 0
+        assert idx.compact() is True
+        assert idx.compactions == 1
+        assert idx.compact() is False  # nothing dead
+
+
 class TestHNSW:
     def test_recall_vs_brute(self):
         rng = np.random.default_rng(2)
