@@ -204,6 +204,7 @@ def main(dry_run: bool = False):
         except Exception as exc:
             result["surfaces"] = {
                 "error": f"{type(exc).__name__}: {exc}"[:400]}
+        result["telemetry"] = _bench_telemetry()
         result["tpu_proof"] = {"skipped": "dry-run"}
         print(json.dumps(result))
         sys.stdout.flush()
@@ -229,6 +230,10 @@ def main(dry_run: bool = False):
         result["surfaces"] = _bench_surfaces()
     except Exception as exc:
         result["surfaces"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # latency distributions of the surface run just measured, read from
+    # the in-process telemetry registry (ISSUE 3): the artifact carries
+    # p50/p95/p99 per surface, not just throughput means
+    result["telemetry"] = _bench_telemetry()
     # one-shot TPU proof (VERDICT r3 task 3): the first session where
     # the tunnel is up must capture EVERYTHING the TPU claim rests on —
     # compiled (non-interpret) Pallas kernels, batched device kNN, and
@@ -241,6 +246,35 @@ def main(dry_run: bool = False):
     print(json.dumps(result))
     sys.stdout.flush()
     print(json.dumps(_compact_summary(result)))
+
+
+# the telemetry series whose p50/p95/p99 ride the compact summary (one
+# per serving surface family); keys are registry series names
+_TELEMETRY_HEADLINES = {
+    "qdrant_grpc_search":
+        'nornicdb_grpc_request_seconds{method="/qdrant.Points/Search"}',
+    "rest_search": 'nornicdb_http_request_seconds{route="nornicdb"}',
+    "neo4j_http": 'nornicdb_http_request_seconds{route="tx"}',
+    "bolt_run": 'nornicdb_bolt_request_seconds{msg="run"}',
+    "device_dispatch":
+        'nornicdb_device_dispatch_seconds{kind="microbatch"}',
+}
+
+
+def _bench_telemetry():
+    """Read the in-process telemetry registry populated by the surfaces
+    stage: per-series latency percentiles plus the device compile
+    universe actually paid for during the run. Defensive — a failed
+    surfaces stage just yields empty summaries, never an exception."""
+    try:
+        from nornicdb_tpu import obs
+
+        return {
+            "latency": obs.latency_summary(),
+            "compile_universe": obs.compile_universe(),
+        }
+    except Exception as exc:  # noqa: BLE001 — artifact must always emit
+        return {"error": f"{type(exc).__name__}: {exc}"[:400]}
 
 
 def _compact_summary(result):
@@ -322,6 +356,14 @@ def _compact_summary(result):
         # harness, and how close the real surface got (the perf gate)
         "qdrant_floor": [qfloor,
                          g(result, "surfaces", "qdrant_grpc", "vs_floor")],
+        # serving-latency headline: [p50, p95, p99] ms per surface from
+        # the telemetry registry (null until that surface has traffic)
+        "latency_ms": {
+            short: [g(result, "telemetry", "latency", series, q)
+                    for q in ("p50_ms", "p95_ms", "p99_ms")]
+            for short, series in _TELEMETRY_HEADLINES.items()
+            if isinstance(g(result, "telemetry", "latency", series), dict)
+        },
         "tpu_proof": tpu_brief,
         **({"dry_run": True} if result.get("dry_run") else {}),
     }

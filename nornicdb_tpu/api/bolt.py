@@ -13,11 +13,17 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from nornicdb_tpu import obs
 from nornicdb_tpu.api.packstream import Packer, Structure, Unpacker, to_packable
 from nornicdb_tpu.storage.txn import TransactionOverlay
+
+_BOLT_H = obs.REGISTRY.histogram(
+    "nornicdb_bolt_request_seconds",
+    "Bolt message handling latency by message type", labels=("msg",))
 
 BOLT_MAGIC = 0x6060B017
 SUPPORTED_VERSIONS = [(4, 4), (4, 3), (4, 2), (4, 1), (4, 0)]
@@ -72,8 +78,32 @@ class BoltSession:
 
     # -- message handlers ------------------------------------------------
 
+    _MSG_NAMES = {
+        MSG_HELLO: "hello", MSG_GOODBYE: "goodbye", MSG_RESET: "reset",
+        MSG_RUN: "run", MSG_BEGIN: "begin", MSG_COMMIT: "commit",
+        MSG_ROLLBACK: "rollback", MSG_DISCARD: "discard",
+        MSG_PULL: "pull",
+    }
+
     def handle(self, sig: int, fields: List[Any]) -> List[Tuple[int, List[Any]]]:
         """Returns a list of (signature, fields) response messages."""
+        t0 = time.perf_counter()
+        try:
+            if sig == MSG_RUN:
+                # RUN carries the query execution — the latency that
+                # matters; a root span makes bolt queries show up in
+                # the slow-request ring like every other surface
+                with obs.trace("wire", method="RUN", transport="bolt"):
+                    return self._handle_inner(sig, fields)
+            return self._handle_inner(sig, fields)
+        finally:
+            _BOLT_H.labels(
+                self._MSG_NAMES.get(sig, "other")).observe(
+                time.perf_counter() - t0)
+
+    def _handle_inner(
+        self, sig: int, fields: List[Any]
+    ) -> List[Tuple[int, List[Any]]]:
         if self.failed and sig not in (MSG_RESET, MSG_GOODBYE):
             return [(MSG_IGNORED, [{}])]
         try:

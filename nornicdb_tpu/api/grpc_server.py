@@ -308,7 +308,7 @@ class GrpcServer:
         self._auth_token = auth_token
         # one shared response-bytes cache across ALL services/methods of
         # this server — both gRPC surfaces serve hot reads from it
-        self.wire_cache = WireCache()
+        self.wire_cache = WireCache(name="grpc")
         # miss/mutation work runs here, NOT on the event loop: a storage
         # scan must never stall cache hits, and concurrent point ops
         # coalesce across these threads via the compat layer's batchers

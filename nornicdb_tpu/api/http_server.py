@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from nornicdb_tpu import obs
 from nornicdb_tpu.audit import ADMIN_ACTION, AUTH, DATA_WRITE, GDPR, AuditLog
 from nornicdb_tpu.auth import ADMIN, READ, WRITE, AuthError, PermissionDenied
 from nornicdb_tpu.storage.txn import TransactionManager
@@ -29,40 +30,73 @@ from nornicdb_tpu.storage.txn import TransactionManager
 SERVER_NAME = "nornicdb-tpu"
 API_VERSION = "1.0"
 
+_HTTP_H = obs.REGISTRY.histogram(
+    "nornicdb_http_request_seconds",
+    "HTTP request latency by route family", labels=("route",))
+
+
+def _route_family(path: str) -> str:
+    """Coarse route label — first path segment, special-casing the tx
+    API — so metric cardinality stays bounded no matter what clients
+    request (raw paths carry ids/collection names)."""
+    segments = [s for s in path.split("/") if s]
+    if not segments:
+        return "root"
+    head = segments[0]
+    if head == "db":
+        return "tx"
+    if head in ("nornicdb", "collections", "graphql", "admin", "heimdall",
+                "mcp", "metrics", "health", "status", "auth", "browser",
+                "v1", "debug"):
+        return head
+    return "other"
+
 
 class _Metrics:
-    """Hand-rolled Prometheus text exposition
-    (reference: server_public.go:195-216)."""
+    """Server counters, now backed by the process-wide telemetry
+    registry (nornicdb_tpu/obs) so /metrics serves REAL Prometheus
+    types — ``counter`` lines for these, ``histogram`` exposition with
+    _bucket/_sum/_count for the latency families — instead of the old
+    everything-is-a-gauge text. The inc(name) call-site contract is
+    unchanged."""
 
     def __init__(self) -> None:
+        from nornicdb_tpu.obs import REGISTRY
+
+        self._registry = REGISTRY
+        self._fams: Dict[str, Any] = {}
         self._lock = threading.Lock()
-        self.counters: Dict[str, float] = {}
         self.started_at = time.time()
 
     def inc(self, name: str, value: float = 1.0) -> None:
-        with self._lock:
-            self.counters[name] = self.counters.get(name, 0.0) + value
+        fam = self._fams.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._fams.get(name)
+                if fam is None:
+                    fam = self._registry.counter(
+                        f"nornicdb_{name}", f"server counter {name}")
+                    self._fams[name] = fam
+        fam.inc(value)
 
     def render(self, extra: Dict[str, float]) -> str:
-        lines = []
-        with self._lock:
-            counters = dict(self.counters)
-        counters["uptime_seconds"] = time.time() - self.started_at
-        counters.update(extra)
-        for name, value in sorted(counters.items()):
-            metric = f"nornicdb_{name}"
-            lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {value}")
-        return "\n".join(lines) + "\n"
+        gauges = {f"nornicdb_{k}": v for k, v in extra.items()}
+        gauges["nornicdb_uptime_seconds"] = time.time() - self.started_at
+        return self._registry.render(gauges)
 
 
 class _RateLimiter:
     """Fixed-window per-client limiter (reference: rate limiting in
-    pkg/server)."""
+    pkg/server). One dict per CURRENT window: when the minute rolls
+    over, every recorded count belongs to a dead window, so the whole
+    map is dropped — a long-lived server no longer leaks one entry per
+    client ever seen (the old map kept stale (window, count) tuples
+    forever)."""
 
     def __init__(self, per_minute: int):
         self.per_minute = per_minute
-        self._windows: Dict[str, Tuple[int, int]] = {}
+        self._window = -1
+        self._counts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def allow(self, client: str) -> bool:
@@ -70,14 +104,18 @@ class _RateLimiter:
             return True
         window = int(time.time() // 60)
         with self._lock:
-            w, n = self._windows.get(client, (window, 0))
-            if w != window:
-                w, n = window, 0
+            if window != self._window:
+                self._window = window
+                self._counts.clear()
+            n = self._counts.get(client, 0)
             if n >= self.per_minute:
-                self._windows[client] = (w, n)
                 return False
-            self._windows[client] = (w, n + 1)
+            self._counts[client] = n + 1
             return True
+
+    def tracked_clients(self) -> int:
+        with self._lock:
+            return len(self._counts)
 
 
 class HTTPError(Exception):
@@ -250,12 +288,26 @@ class HttpServer:
                 if not outer.rate_limiter.allow(client):
                     self._reply(429, {"error": "rate limit exceeded"})
                     return
-                if (method == "GET"
-                        and self.path.split("?")[0] == "/bifrost/events"):
+                path = self.path.split("?")[0]
+                if method == "GET" and path == "/bifrost/events":
                     # SSE push channel (reference: heimdall Bifrost,
                     # bifrost.go:15,42) — streamed, bypasses JSON reply
+                    # AND the latency histogram (stream lifetime is not
+                    # request latency)
                     outer._stream_bifrost(self)
                     return
+                t0 = time.perf_counter()
+                try:
+                    with obs.trace("wire", method=f"{method} {path}",
+                                   transport="http"):
+                        self._handle(method)
+                finally:
+                    # finally: a handler that raises (client hung up
+                    # mid-write) is exactly the request p99 wants
+                    _HTTP_H.labels(_route_family(path)).observe(
+                        time.perf_counter() - t0)
+
+            def _handle(self, method: str) -> None:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 if method == "POST" and self.path in ("/nornicdb/search",
@@ -1043,6 +1095,31 @@ class HttpServer:
                       username: Optional[str]) -> Tuple[int, Any]:
         self.authorize(username, "system", ADMIN)
         action = segments[1] if len(segments) > 1 else ""
+
+        if action == "traces" and method == "GET":
+            # slow-request ring buffer: full span trees of the most
+            # recent requests over NORNICDB_OBS_SLOW_MS (default 0 =
+            # every request, ring-bounded). /admin/traces/slowest ranks
+            # by duration instead of recency.
+            if len(segments) > 2 and segments[2] == "slowest":
+                return 200, {"slow_ms": obs.TRACES.slow_ms,
+                             "recorded": obs.TRACES.recorded,
+                             "traces": obs.TRACES.slowest(limit=10)}
+            return 200, {"slow_ms": obs.TRACES.slow_ms,
+                         "recorded": obs.TRACES.recorded,
+                         "traces": obs.TRACES.snapshot(limit=50)}
+
+        if action == "telemetry" and method == "GET":
+            doc: Dict[str, Any] = {
+                "latency": obs.latency_summary(),
+                "compile_universe": obs.compile_universe(),
+                "rate_limiter_clients":
+                    self.rate_limiter.tracked_clients(),
+            }
+            svc = self.db._search  # no index build from a telemetry read
+            if svc is not None:
+                doc["microbatch"] = svc.microbatch_stats()
+            return 200, doc
 
         if action == "databases":
             if self.database_manager is None:

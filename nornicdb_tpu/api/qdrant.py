@@ -23,6 +23,8 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from nornicdb_tpu.errors import NotFoundError
+from nornicdb_tpu.obs import annotate as _obs_annotate
+from nornicdb_tpu.obs import attach_span as _obs_attach_span
 from nornicdb_tpu.search.vector_index import BruteForceIndex
 from nornicdb_tpu.storage.types import Node, now_ms
 
@@ -701,6 +703,7 @@ class QdrantCompat:
         )
         cached = self._search_cache.get_hits(cache_key)
         if cached is not None:
+            _obs_annotate(result_cache="hit")
             return cached
         gen_at_miss = self._search_cache.generation
         meta = self._meta(name)
@@ -718,6 +721,10 @@ class QdrantCompat:
             ranked = self._ranked_cosine(name, vector)
         else:
             ranked = self._ranked_raw(name, vector, distance)
+        # the rank generator runs lazily inside the loop below, so this
+        # stamp-and-graft interval covers the real device work; the
+        # MicroBatcher's coalesce-wait/dispatch spans land as siblings
+        t_rank = time.time()
         out = []
         for nid, score in ranked:
             if score_threshold is not None:
@@ -748,6 +755,8 @@ class QdrantCompat:
             # honors the score-desc contract. Exact-only paths are
             # already ordered, so this is a no-op for them.
             out.sort(key=lambda d: -d["score"])
+        _obs_attach_span("qdrant.rank", t_rank, time.time(),
+                         collection=name, distance=distance)
         return self._search_cache.put_guarded(cache_key, out,
                                               gen_at_miss)
 

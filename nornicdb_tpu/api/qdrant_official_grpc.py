@@ -30,14 +30,23 @@ event loop — no per-RPC thread handoff — and registered raw
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import struct
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 import grpc
 
+from nornicdb_tpu import obs
 from nornicdb_tpu.api.proto import qdrant_pb2 as q
 from nornicdb_tpu.api.qdrant import QdrantError, _match_filter
+
+# per-surface, per-method request latency (tentpole: real histograms,
+# not gauges). Method label cardinality is bounded by the proto surface.
+_GRPC_H = obs.REGISTRY.histogram(
+    "nornicdb_grpc_request_seconds",
+    "gRPC request latency by method (both aio surfaces)",
+    labels=("method",))
 
 
 def _iter_matching_points(compat, name: str, flt: Optional[Dict[str, Any]],
@@ -238,28 +247,43 @@ def aio_unary_raw(
         out = fn(data)
         return out if isinstance(out, bytes) else out.SerializeToString()
 
+    latency = _GRPC_H.labels(method or "unknown")
+
     async def handler(data: bytes, context):
         g = 0
-        if wire is not None:
-            t0 = time.time()
-            g = gen()
-            hit = wire.get(method, data, g)
-            if hit is not None:
-                if time_tag is not None:
-                    return (hit + time_tag + struct.pack(
-                        "<d", (time.time() - t0) * scale))
-                return hit
-        try:
-            if executor is not None:
-                out = await asyncio.get_running_loop().run_in_executor(
-                    executor, serve, data)
-            else:
-                out = serve(data)
-        except error_cls as e:
-            await context.abort(grpc_status_of(e), str(e))
-        if wire is not None:
-            wire.put(method, data, g, out)
-        return out
+        t0 = time.time()
+        # root span per RPC: grpc.aio runs each handler in its own
+        # asyncio task (own contextvar context), so concurrent RPCs
+        # never share a current-span slot
+        with obs.trace("wire", method=method, transport="grpc") as root:
+            if wire is not None:
+                g = gen()
+                hit = wire.get(method, data, g)
+                if hit is not None:
+                    root.annotate(cache="hit")
+                    latency.observe(time.time() - t0)
+                    if time_tag is not None:
+                        return (hit + time_tag + struct.pack(
+                            "<d", (time.time() - t0) * scale))
+                    return hit
+            try:
+                if executor is not None:
+                    # copy_context carries the root span into the
+                    # executor thread, so spans opened by the compute
+                    # (coalesce wait, device dispatch) land in THIS
+                    # request's trace
+                    ctx = contextvars.copy_context()
+                    out = await asyncio.get_running_loop(
+                        ).run_in_executor(executor, ctx.run, serve, data)
+                else:
+                    out = serve(data)
+            except error_cls as e:
+                latency.observe(time.time() - t0)
+                await context.abort(grpc_status_of(e), str(e))
+            if wire is not None:
+                wire.put(method, data, g, out)
+            latency.observe(time.time() - t0)
+            return out
 
     # no request_deserializer / response_serializer: the server hands us
     # the wire bytes and sends back exactly the bytes we return

@@ -1,0 +1,65 @@
+"""Unified telemetry for the serving stack (ISSUE 3).
+
+Dependency-free counters/gauges/histograms with real Prometheus
+exposition, contextvar span tracing with a slow-request ring buffer,
+and the device-dispatch compile-universe instrument. Every hot layer
+records into the process-wide ``REGISTRY``/``TRACES``; the HTTP server
+renders them at ``/metrics`` and ``/admin/traces``.
+
+Overhead discipline: a record call is a branch + dict probe + striped
+add (counters) or bisect + locked bucket increment (histograms); spans
+allocate one small object each. ``set_enabled(False)`` no-ops the whole
+layer — tests/test_observability.py pins the instrumented:bare ratio.
+"""
+
+from nornicdb_tpu.obs.dispatch import (
+    compile_universe,
+    record_dispatch,
+)
+from nornicdb_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    REGISTRY,
+    SIZE_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    enabled,
+    get_registry,
+    latency_summary,
+    set_enabled,
+)
+from nornicdb_tpu.obs.tracing import (
+    TRACES,
+    Span,
+    TraceBuffer,
+    annotate,
+    attach_span,
+    current_span,
+    span,
+    trace,
+)
+
+__all__ = [
+    "LATENCY_BUCKETS",
+    "REGISTRY",
+    "SIZE_BUCKETS",
+    "TRACES",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Span",
+    "TraceBuffer",
+    "annotate",
+    "attach_span",
+    "compile_universe",
+    "current_span",
+    "enabled",
+    "get_registry",
+    "latency_summary",
+    "record_dispatch",
+    "set_enabled",
+    "span",
+    "trace",
+]

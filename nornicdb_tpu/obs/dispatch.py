@@ -1,0 +1,84 @@
+"""Device-dispatch instrumentation: the XLA compile universe, observed.
+
+PR 1/2 bounded the compile universe by padding every device call to
+power-of-two (B, k) buckets (microbatch.pow2_bucket) — but nothing
+showed whether the bound held in production. This module records every
+batched device dispatch by (kind, B, k): the FIRST call at a shape is
+its compile (JAX compiles on first trace; its wall time includes the
+compile), later calls are steady-state dispatches. ``/metrics`` then
+exposes the real compile universe as labeled series, and bucket churn
+(new shapes appearing at serve time) is visible as compile-counter
+growth instead of mystery latency spikes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Tuple
+
+from nornicdb_tpu.obs import metrics as _m
+from nornicdb_tpu.obs.metrics import REGISTRY
+
+_lock = threading.Lock()
+# (kind, b, k) -> {"dispatches": int, "first_call_s": float,
+#                  "total_s": float}
+_shapes: Dict[Tuple[str, int, int], Dict[str, Any]] = {}
+
+_DISPATCH_C = REGISTRY.counter(
+    "nornicdb_device_dispatch_total",
+    "Batched device dispatches by compile bucket",
+    labels=("kind", "b", "k"))
+_COMPILE_C = REGISTRY.counter(
+    "nornicdb_device_compile_total",
+    "First-touch compiles by dispatch kind", labels=("kind",))
+_LATENCY_H = REGISTRY.histogram(
+    "nornicdb_device_dispatch_seconds",
+    "Device dispatch wall time (first call includes compile)",
+    labels=("kind",))
+_FIRST_G = REGISTRY.gauge(
+    "nornicdb_device_first_call_seconds",
+    "Wall time of the first (compiling) call per bucket",
+    labels=("kind", "b", "k"))
+
+
+def record_dispatch(kind: str, b: int, k: int, seconds: float) -> None:
+    """Record one batched device call at pow2-bucketed shape (b, k)."""
+    if not _m.enabled():
+        return
+    key = (kind, int(b), int(k))
+    first = False
+    with _lock:
+        entry = _shapes.get(key)
+        if entry is None:
+            first = True
+            entry = {"dispatches": 0, "first_call_s": seconds,
+                     "total_s": 0.0}
+            _shapes[key] = entry
+        entry["dispatches"] += 1
+        entry["total_s"] += seconds
+    _DISPATCH_C.labels(kind, b, k).inc()
+    _LATENCY_H.labels(kind).observe(seconds)
+    if first:
+        _COMPILE_C.labels(kind).inc()
+        _FIRST_G.labels(kind, b, k).set(seconds)
+
+
+def compile_universe() -> List[Dict[str, Any]]:
+    """Every (kind, B, k) shape seen since process start — the admin
+    view of how many distinct XLA programs serving has paid for."""
+    with _lock:
+        items = sorted(_shapes.items())
+    return [
+        {"kind": kind, "b": b, "k": k,
+         "dispatches": e["dispatches"],
+         "first_call_ms": round(e["first_call_s"] * 1e3, 3),
+         "mean_ms": round(e["total_s"] / max(e["dispatches"], 1) * 1e3, 4)}
+        for (kind, b, k), e in items
+    ]
+
+
+def reset() -> None:
+    """Test helper: forget the shape universe (registry counters keep
+    their monotone totals)."""
+    with _lock:
+        _shapes.clear()

@@ -1,0 +1,360 @@
+"""Low-overhead metric primitives + Prometheus text exposition.
+
+Dependency-free (stdlib only) so every layer — storage, search, wire —
+can record without import cycles. Three metric kinds:
+
+- :class:`Counter` — monotone float, lock-striped by thread id so N
+  handler threads incrementing one hot counter don't serialize on a
+  single lock (the reference surfaces run 8-16 worker threads).
+- :class:`Gauge` — last-write-wins scalar, or callback-backed for
+  values that are cheaper to read on scrape than to maintain (node
+  counts, cache sizes).
+- :class:`Histogram` — fixed upper-bound buckets with the full
+  Prometheus exposition contract (``_bucket`` with ``le`` labels
+  including ``+Inf``, ``_sum``, ``_count``) and bucket-interpolated
+  quantile estimation for the bench/admin summaries.
+
+Metrics are registered in a :class:`Registry`; label sets materialize
+child series on first use (``labels(...)``) keyed by the label-value
+tuple, so the hot path after the first request is one dict probe + one
+striped add. ``set_enabled(False)`` turns every record call into a
+no-op branch — the overhead-guard test measures the delta.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_STRIPES = 8
+
+_enabled = True
+
+
+def set_enabled(value: bool) -> None:
+    """Process-wide kill switch. Record calls become a single branch;
+    already-registered metrics keep their accumulated values."""
+    global _enabled
+    _enabled = value
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+# request-latency buckets (seconds): 50us floor (cache-hit wire replies
+# land there) to 10s ceiling, roughly x2-x2.5 steps — 17 buckets
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    50e-6, 100e-6, 250e-6, 500e-6,
+    1e-3, 2.5e-3, 5e-3, 10e-3, 25e-3, 50e-3, 100e-3, 250e-3, 500e-3,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+# batch/queue-size buckets: powers of two, matching the pow2 compile
+# bucketing of the device dispatch path
+SIZE_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(names: Sequence[str], values: Sequence[str],
+                extra: Optional[Tuple[str, str]] = None) -> str:
+    parts = [f'{n}="{_escape_label(str(v))}"'
+             for n, v in zip(names, values)]
+    if extra is not None:
+        parts.append(f'{extra[0]}="{extra[1]}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    """Monotone counter, lock-striped across threads."""
+
+    __slots__ = ("_locks", "_values")
+
+    def __init__(self) -> None:
+        self._locks = [threading.Lock() for _ in range(_STRIPES)]
+        self._values = [0.0] * _STRIPES
+
+    def inc(self, value: float = 1.0) -> None:
+        if not _enabled:
+            return
+        s = threading.get_ident() % _STRIPES
+        with self._locks[s]:
+            self._values[s] += value
+
+    @property
+    def value(self) -> float:
+        return sum(self._values)
+
+
+class Gauge:
+    """Last-write-wins scalar, or callback-backed (read on scrape)."""
+
+    __slots__ = ("_lock", "_value", "_fn")
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn = fn
+
+    def set(self, value: float) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, value: float = 1.0) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # noqa: BLE001 — scrape must never fail
+                return 0.0
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram. ``observe`` is a bisect + one locked
+    bucket increment; cumulative counts are computed at render time."""
+
+    __slots__ = ("_bounds", "_lock", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS) -> None:
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket")
+        self._bounds = bounds
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        if not _enabled:
+            return
+        i = bisect_left(self._bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counts = list(self._counts)
+            return {"buckets": list(self._bounds), "counts": counts,
+                    "sum": self._sum, "count": self._count}
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (Prometheus
+        histogram_quantile semantics); None when empty."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return None
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= rank:
+                if i >= len(self._bounds):  # +Inf bucket: clamp to top
+                    return self._bounds[-1]
+                lo = self._bounds[i - 1] if i > 0 else 0.0
+                hi = self._bounds[i]
+                if c == 0:
+                    return hi
+                return lo + (hi - lo) * (rank - prev_cum) / c
+        return self._bounds[-1]
+
+
+class _Family:
+    """One metric name with 0+ label dimensions; children materialize
+    per label-value tuple."""
+
+    def __init__(self, name: str, kind: str, help_text: str,
+                 label_names: Tuple[str, ...],
+                 make: Callable[[], object]) -> None:
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.label_names = label_names
+        self._make = make
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not label_names:
+            self._children[()] = make()
+
+    def labels(self, *values: object):
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {key}")
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._make())
+        return child
+
+    def child(self):
+        """The unlabeled child (only valid for label-less families)."""
+        return self._children[()]
+
+    # convenience passthroughs for label-less families
+    def inc(self, value: float = 1.0) -> None:
+        self.child().inc(value)
+
+    def set(self, value: float) -> None:
+        self.child().set(value)
+
+    def observe(self, value: float) -> None:
+        self.child().observe(value)
+
+    @property
+    def value(self) -> float:
+        return self.child().value
+
+    def quantile(self, q: float):
+        return self.child().quantile(q)
+
+    def snapshot(self):
+        return self.child().snapshot()
+
+    def children(self) -> Dict[Tuple[str, ...], object]:
+        with self._lock:
+            return dict(self._children)
+
+    def render(self, out: List[str]) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        for key, child in sorted(self.children().items()):
+            if self.kind == "histogram":
+                snap = child.snapshot()
+                cum = 0
+                for bound, c in zip(snap["buckets"], snap["counts"]):
+                    cum += c
+                    lbl = _fmt_labels(self.label_names, key,
+                                      ("le", _fmt_float(bound)))
+                    out.append(f"{self.name}_bucket{lbl} {cum}")
+                cum += snap["counts"][-1]
+                lbl = _fmt_labels(self.label_names, key, ("le", "+Inf"))
+                out.append(f"{self.name}_bucket{lbl} {cum}")
+                base = _fmt_labels(self.label_names, key)
+                out.append(f"{self.name}_sum{base} {_fmt_float(snap['sum'])}")
+                out.append(f"{self.name}_count{base} {snap['count']}")
+            else:
+                lbl = _fmt_labels(self.label_names, key)
+                out.append(f"{self.name}{lbl} {_fmt_float(child.value)}")
+
+
+def _fmt_float(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Registry:
+    """Named metric families; ``render()`` emits the Prometheus text
+    exposition. get-or-create is idempotent so call sites can resolve
+    their metrics lazily without coordinating registration order."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        self.started_at = time.time()
+
+    def _get_or_create(self, name: str, kind: str, help_text: str,
+                       label_names: Tuple[str, ...],
+                       make: Callable[[], object]) -> _Family:
+        fam = self._families.get(name)
+        if fam is not None:
+            if fam.kind != kind:
+                raise ValueError(
+                    f"metric {name} already registered as {fam.kind}")
+            return fam
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, kind, help_text, label_names, make)
+                self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_text: str = "",
+                labels: Sequence[str] = ()) -> _Family:
+        return self._get_or_create(name, "counter", help_text,
+                                   tuple(labels), Counter)
+
+    def gauge(self, name: str, help_text: str = "",
+              labels: Sequence[str] = (),
+              fn: Optional[Callable[[], float]] = None) -> _Family:
+        return self._get_or_create(name, "gauge", help_text,
+                                   tuple(labels), lambda: Gauge(fn))
+
+    def histogram(self, name: str, help_text: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = LATENCY_BUCKETS) -> _Family:
+        return self._get_or_create(name, "histogram", help_text,
+                                   tuple(labels),
+                                   lambda: Histogram(buckets))
+
+    def get(self, name: str) -> Optional[_Family]:
+        return self._families.get(name)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    def render(self, extra_gauges: Optional[Dict[str, float]] = None) -> str:
+        out: List[str] = []
+        for fam in sorted(self.families(), key=lambda f: f.name):
+            fam.render(out)
+        for name, value in sorted((extra_gauges or {}).items()):
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {_fmt_float(value)}")
+        return "\n".join(out) + "\n"
+
+
+# the process-wide registry every layer records into; tests that need
+# isolation construct private Registry instances instead
+REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    return REGISTRY
+
+
+def latency_summary(registry: Optional[Registry] = None,
+                    quantiles: Sequence[float] = (0.5, 0.95, 0.99),
+                    ) -> Dict[str, Dict[str, float]]:
+    """p50/p95/p99 (ms) + count for every ``*_seconds`` histogram
+    series — one flat dict keyed ``name{label=value,...}``. Shared by
+    the /admin/telemetry endpoint and bench.py's percentile stage."""
+    out: Dict[str, Dict[str, float]] = {}
+    reg = registry if registry is not None else REGISTRY
+    for fam in reg.families():
+        if fam.kind != "histogram" or not fam.name.endswith("_seconds"):
+            continue
+        for key, child in sorted(fam.children().items()):
+            snap = child.snapshot()
+            if not snap["count"]:
+                continue
+            series = fam.name + _fmt_labels(fam.label_names, key)
+            entry: Dict[str, float] = {"count": snap["count"]}
+            for qv in quantiles:
+                est = child.quantile(qv)
+                entry[f"p{int(qv * 100)}_ms"] = (
+                    None if est is None else round(est * 1e3, 3))
+            out[series] = entry
+    return out

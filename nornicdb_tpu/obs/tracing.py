@@ -1,0 +1,212 @@
+"""Contextvar span tracing + slow-request ring buffer.
+
+One request = one root :class:`Span`; layers underneath open child
+spans (``span("coalesce.wait")``) or graft already-timed intervals
+(``attach_span`` — the MicroBatcher leader times the device dispatch
+once and every rider of that batch grafts the same interval into its
+own trace). The current span rides a ``contextvars.ContextVar``, so it
+crosses the grpc.aio event-loop -> executor-thread boundary whenever
+the caller runs the work under ``contextvars.copy_context()`` (the aio
+wire layer does).
+
+Completed root spans land in the process-wide :class:`TraceBuffer` — a
+bounded ring holding the most recent requests slower than
+``NORNICDB_OBS_SLOW_MS`` (default 0: every request qualifies, the ring
+bound keeps memory flat). The HTTP admin surface exposes it at
+``/admin/traces``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from nornicdb_tpu.obs import metrics as _m
+
+
+class Span:
+    __slots__ = ("name", "t0", "t1", "attrs", "children")
+
+    def __init__(self, name: str, t0: Optional[float] = None,
+                 **attrs: Any) -> None:
+        self.name = name
+        self.t0 = time.time() if t0 is None else t0
+        self.t1: Optional[float] = None
+        self.attrs: Dict[str, Any] = attrs
+        self.children: List["Span"] = []
+
+    def finish(self, t1: Optional[float] = None) -> None:
+        self.t1 = time.time() if t1 is None else t1
+
+    @property
+    def duration_ms(self) -> float:
+        end = self.t1 if self.t1 is not None else time.time()
+        return (end - self.t0) * 1e3
+
+    def annotate(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "start_ms": round(self.t0 * 1e3, 3),
+            "duration_ms": round(self.duration_ms, 3),
+            "attrs": dict(self.attrs),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def span_names(self) -> List[str]:
+        """Flattened names, depth-first — test/diagnostic helper."""
+        out = [self.name]
+        for c in self.children:
+            out.extend(c.span_names())
+        return out
+
+
+_current: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
+    "nornicdb_obs_span", default=None)
+
+
+class TraceBuffer:
+    """Bounded ring of completed root spans, slowest-aware snapshot."""
+
+    def __init__(self, capacity: int = 256,
+                 slow_ms: Optional[float] = None) -> None:
+        if slow_ms is None:
+            try:
+                slow_ms = float(os.environ.get("NORNICDB_OBS_SLOW_MS", "0"))
+            except ValueError:
+                slow_ms = 0.0
+        self.capacity = capacity
+        self.slow_ms = slow_ms
+        self._lock = threading.Lock()
+        self._ring: List[Span] = []
+        self._pos = 0
+        self.recorded = 0
+
+    def record(self, root: Span) -> None:
+        if root.duration_ms < self.slow_ms:
+            return
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                self._ring.append(root)
+            else:
+                self._ring[self._pos] = root
+                self._pos = (self._pos + 1) % self.capacity
+            self.recorded += 1
+
+    def snapshot(self, limit: int = 50,
+                 name: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Most recent first (ties to the ring write order), converted
+        to plain dicts outside the lock."""
+        with self._lock:
+            spans = list(self._ring)
+        if name is not None:
+            spans = [s for s in spans if s.name == name
+                     or s.attrs.get("method") == name]
+        spans.sort(key=lambda s: s.t0, reverse=True)
+        return [s.to_dict() for s in spans[:limit]]
+
+    def slowest(self, limit: int = 10) -> List[Dict[str, Any]]:
+        with self._lock:
+            spans = list(self._ring)
+        spans.sort(key=lambda s: s.duration_ms, reverse=True)
+        return [s.to_dict() for s in spans[:limit]]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._pos = 0
+
+
+TRACES = TraceBuffer()
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+class _ActiveSpan:
+    """Context manager binding a span as the contextvar current."""
+
+    __slots__ = ("span", "_token", "_root")
+
+    def __init__(self, span: Span, root: bool) -> None:
+        self.span = span
+        self._root = root
+        self._token = None
+
+    def __enter__(self) -> Span:
+        self._token = _current.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.span.finish()
+        if exc_type is not None:
+            self.span.attrs.setdefault("error", f"{exc_type.__name__}")
+        _current.reset(self._token)
+        if self._root:
+            TRACES.record(self.span)
+
+
+class _NullSpan:
+    """No-op stand-in when tracing is disabled or there is no active
+    trace to attach a child to."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def annotate(self, **attrs: Any) -> None:
+        pass
+
+
+_NULL = _NullSpan()
+
+
+def trace(name: str, **attrs: Any):
+    """Open a ROOT span (one per request). On exit it is recorded into
+    the slow-request ring."""
+    if not _m.enabled():
+        return _NULL
+    return _ActiveSpan(Span(name, **attrs), root=True)
+
+
+def span(name: str, **attrs: Any):
+    """Open a child of the current span; no-op when no trace is active
+    (layers stay instrumented without requiring a surface above them)."""
+    if not _m.enabled():
+        return _NULL
+    parent = _current.get()
+    if parent is None:
+        return _NULL
+    child = Span(name, **attrs)
+    parent.children.append(child)
+    return _ActiveSpan(child, root=False)
+
+
+def attach_span(name: str, t0: float, t1: float, **attrs: Any) -> None:
+    """Graft an already-timed interval into the current trace — used
+    when the timing was captured by another thread (the batch leader's
+    device dispatch) but belongs in this request's story."""
+    if not _m.enabled():
+        return
+    parent = _current.get()
+    if parent is None:
+        return
+    child = Span(name, t0=t0, **attrs)
+    child.t1 = t1
+    parent.children.append(child)
+
+
+def annotate(**attrs: Any) -> None:
+    cur = _current.get()
+    if cur is not None:
+        cur.attrs.update(attrs)

@@ -450,16 +450,32 @@ class CypherExecutor:
     ) -> CypherResult:
         """PROFILE: execute through a db-hit-counting storage proxy and
         attach actuals to the plan (reference: executeProfile,
-        explain.go:110)."""
+        explain.go:110). The actuals also land in the telemetry
+        registry (ISSUE 3 satellite): /metrics exposes the db-hit and
+        wall-time distributions of profiled queries, so query-layer
+        cost is observable fleet-wide, not just per response."""
+        import time as _time
+
+        from nornicdb_tpu.obs import REGISTRY
         from nornicdb_tpu.query.explain import CountingEngine, build_plan
 
         uq = self._parse_cached(query)
         plan = build_plan(self.storage, uq)
         counting = CountingEngine(self.storage)
+        t0 = _time.perf_counter()
         result = self._execute_parsed(uq, params, storage=counting)
+        elapsed = _time.perf_counter() - t0
         root = plan.children[0] if plan.children else plan
         root.db_hits = counting.hits
         plan.actual_rows = root.actual_rows = len(result.rows)
+        REGISTRY.histogram(
+            "nornicdb_profile_db_hits",
+            "Storage hits per PROFILEd query",
+            buckets=(1, 10, 100, 1_000, 10_000, 100_000, 1_000_000),
+        ).observe(counting.hits)
+        REGISTRY.histogram(
+            "nornicdb_profile_query_seconds",
+            "Wall time per PROFILEd query").observe(elapsed)
         # Neo4j semantics: PROFILE returns the query's records; the
         # profiled plan rides on the result (summary-equivalent).
         result.plan = plan.to_dict()

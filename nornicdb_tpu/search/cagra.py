@@ -43,12 +43,14 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nornicdb_tpu.obs import REGISTRY, record_dispatch
 from nornicdb_tpu.ops.similarity import (
     NEG_INF,
     cosine_topk_auto,
@@ -59,6 +61,14 @@ from nornicdb_tpu.search.microbatch import pow2_bucket
 from nornicdb_tpu.search.vector_index import BruteForceIndex, _use_pallas
 
 _HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative hash
+
+# freshness machinery events: graph (re)builds, delta side-scans merged
+# into walk results, and the exact-fallback reasons — the counters that
+# make strategy-machine decisions observable (ISSUE 3)
+_CAGRA_C = REGISTRY.counter(
+    "nornicdb_cagra_events_total",
+    "CAGRA index lifecycle and per-search freshness decisions",
+    labels=("event",))
 
 
 # ---------------------------------------------------------------------------
@@ -540,6 +550,7 @@ class CagraIndex:
                                                    PartitionSpec("data")))
         self._graph = graph
         self.builds += 1
+        _CAGRA_C.labels("build").inc()
         return True
 
     def _ensure_graph(self) -> Optional[Dict[str, Any]]:
@@ -574,6 +585,7 @@ class CagraIndex:
             if self._rebuilding:
                 return
             self._rebuilding = True
+        _CAGRA_C.labels("background_rebuild").inc()
 
         def run():
             try:
@@ -636,12 +648,14 @@ class CagraIndex:
             # the pool can only ever hold itopk candidates — a deeper
             # request silently truncated would differ from the brute and
             # hnsw strategies, so serve it exactly instead
+            _CAGRA_C.labels("exact_fallback_itopk").inc()
             return self._brute.search_batch(queries, k)
         delta_ids, delta_vecs = self._delta_block(g)
         if delta_ids is None:
             # churn outran the brute changelog (only possible while a
             # background rebuild is in flight): serve exactly until the
             # fresh graph swaps in
+            _CAGRA_C.labels("exact_fallback_changelog").inc()
             return self._brute.search_batch(queries, k)
         n_iters = iters if iters is not None else g["iters"]
         w = width or self.search_width
@@ -657,9 +671,15 @@ class CagraIndex:
                  np.broadcast_to(queries[:1], (bb - b,) + queries.shape[1:])],
                 axis=0)
         qn = l2_normalize(jnp.asarray(queries))
+        t0 = time.time()
         s, i = self._walk(g, qn, kb, n_iters, w, p)
-        out = self._resolve(g, np.asarray(s)[:b], np.asarray(i)[:b], k_eff)
+        # force to host INSIDE the timed window: jax dispatch is async,
+        # so timing the call alone would record enqueue, not the walk
+        s_host, i_host = np.asarray(s), np.asarray(i)
+        record_dispatch("cagra_walk", bb, kb, time.time() - t0)
+        out = self._resolve(g, s_host[:b], i_host[:b], k_eff)
         if delta_ids:
+            _CAGRA_C.labels("delta_merge").inc()
             out = self._merge_delta(out, delta_ids, delta_vecs,
                                     np.asarray(qn)[:b], k_eff)
         # a stale graph's live-filter can under-fill a row even though
@@ -669,6 +689,7 @@ class CagraIndex:
         # callers like hybrid RRF assume k hits when the corpus has them
         want = min(k_eff, len(self._brute))
         if any(len(hits) < want for hits in out):
+            _CAGRA_C.labels("exact_fallback_underfill").inc()
             return self._brute.search_batch(queries[:b], k)
         return out
 

@@ -19,9 +19,31 @@ rate; there is no artificial sleep to tune.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, List, Sequence, Tuple
 
 import numpy as np
+
+from nornicdb_tpu.obs import (
+    REGISTRY,
+    SIZE_BUCKETS,
+    attach_span,
+    record_dispatch,
+)
+
+# one metric family set shared by every batcher instance (per-collection
+# MicroBatchers, the search service's, the upsert coalescer): the
+# registry is process-global and get-or-create is idempotent
+_BATCH_H = REGISTRY.histogram(
+    "nornicdb_microbatch_batch_size",
+    "Coalesced queries per device dispatch", buckets=SIZE_BUCKETS)
+_QUEUE_H = REGISTRY.histogram(
+    "nornicdb_microbatch_queue_depth",
+    "Requests still pending when a batch sealed", buckets=SIZE_BUCKETS)
+_CONVOY_H = REGISTRY.histogram(
+    "nornicdb_convoy_batch_size",
+    "Coalesced items per merged apply (write convoys)",
+    buckets=SIZE_BUCKETS)
 
 
 def pow2_bucket(n: int) -> int:
@@ -90,6 +112,7 @@ class BatchCoalescer:
     def _run(self, batch: List["_Item"]) -> None:
         self.batches += 1
         self.batched_items += len(batch)
+        _CONVOY_H.observe(len(batch))
         try:
             results = self._apply_batch([i.value for i in batch])
             for item, res in zip(batch, results):
@@ -121,7 +144,8 @@ class _Item:
 
 
 class _Req:
-    __slots__ = ("vec", "k", "done", "result", "error")
+    __slots__ = ("vec", "k", "done", "result", "error",
+                 "dispatch_t0", "dispatch_t1", "batch_size")
 
     def __init__(self, vec: np.ndarray, k: int):
         self.vec = vec
@@ -129,6 +153,11 @@ class _Req:
         self.done = False
         self.result: Any = None
         self.error: Any = None
+        # stamped by the batch LEADER so every rider can graft the one
+        # shared device-dispatch interval into its own trace
+        self.dispatch_t0 = 0.0
+        self.dispatch_t1 = 0.0
+        self.batch_size = 0
 
 
 class MicroBatcher:
@@ -160,6 +189,7 @@ class MicroBatcher:
         self.batched_queries = 0
 
     def search(self, vec: Sequence[float], k: int) -> List[Tuple[str, float]]:
+        t_enq = time.time()
         req = _Req(np.asarray(vec, np.float32), k)
         with self._cond:
             self._pending.append(req)
@@ -188,6 +218,7 @@ class MicroBatcher:
                 if not batch:
                     # taken by another leader but not done yet — loop
                     continue
+                _QUEUE_H.observe(len(self._pending))
                 self._busy = True
             try:
                 self._run(batch)
@@ -199,14 +230,31 @@ class MicroBatcher:
                 break
             # our request was queued behind this batch — go again
         if req.error is not None:
+            self._trace_req(req, t_enq)
             raise req.error
+        self._trace_req(req, t_enq)
         return req.result
+
+    @staticmethod
+    def _trace_req(req: "_Req", t_enq: float) -> None:
+        """Graft this request's coalescing story into the active trace:
+        the wait from enqueue to the (leader-stamped) device dispatch,
+        the shared dispatch interval, and the post-dispatch merge. No-op
+        when no trace is active or the request errored before dispatch."""
+        if not req.dispatch_t1:
+            return
+        attach_span("coalesce.wait", t_enq, req.dispatch_t0,
+                    batch=req.batch_size)
+        attach_span("device.dispatch", req.dispatch_t0, req.dispatch_t1,
+                    batch=req.batch_size, k=req.k)
+        attach_span("merge", req.dispatch_t1, time.time())
 
     def _run(self, batch: List[_Req]) -> None:
         try:
             self.batches += 1
             self.batched_queries += len(batch)
             self._last_batch = len(batch)
+            _BATCH_H.observe(len(batch))
             # k is usually a static jit arg too: bucket it alongside B
             k_max = pow2_bucket(max(r.k for r in batch))
             queries = np.stack([r.vec for r in batch])
@@ -223,8 +271,13 @@ class MicroBatcher:
                 pad = np.broadcast_to(
                     queries[0], (bucket - b,) + queries.shape[1:])
                 queries = np.concatenate([queries, pad], axis=0)
+            t0 = time.time()
             results = self._search_batch(queries, k_max)
+            t1 = time.time()
+            record_dispatch("microbatch", bucket, k_max, t1 - t0)
             for r, res in zip(batch, results):
+                r.dispatch_t0, r.dispatch_t1 = t0, t1
+                r.batch_size = b
                 r.result = res[: r.k] if r.k < k_max else res
         except Exception:  # noqa: BLE001
             # isolate the poison: one malformed request (wrong dims in
@@ -234,8 +287,13 @@ class MicroBatcher:
             for r in batch:
                 try:
                     kb = pow2_bucket(max(r.k, 1))
+                    r.dispatch_t0 = time.time()
                     res = self._search_batch(
                         np.asarray(r.vec, np.float32)[None, :], kb)[0]
+                    r.dispatch_t1 = time.time()
+                    r.batch_size = 1
+                    record_dispatch("microbatch", 1, kb,
+                                    r.dispatch_t1 - r.dispatch_t0)
                     r.result = res[: r.k] if r.k < kb else res
                 except Exception as exc:  # noqa: BLE001 — per-request
                     r.error = exc

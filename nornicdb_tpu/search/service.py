@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from nornicdb_tpu.obs import REGISTRY
 from nornicdb_tpu.search.bm25 import BM25Index
 from nornicdb_tpu.search.hnsw import HNSWIndex
 from nornicdb_tpu.search.rrf import rrf_fuse
@@ -25,6 +26,12 @@ from nornicdb_tpu.search.vector_index import BruteForceIndex
 from nornicdb_tpu.storage.types import Engine, Node
 
 TEXT_PROPERTIES = ("content", "title", "name", "description", "text", "summary")
+
+# which index the strategy machine actually routed each vector search
+# to — the brute/cagra/hnsw split the ROADMAP tuning loop reads
+_STRATEGY_C = REGISTRY.counter(
+    "nornicdb_search_strategy_total",
+    "Vector search dispatches by chosen strategy", labels=("strategy",))
 
 
 def _copy_tree(v):
@@ -545,10 +552,13 @@ class SearchService:
             if cagra is not None:
                 # device graph walk, micro-batched: concurrent b=1
                 # queries coalesce into one pow2-bucketed walk dispatch
+                _STRATEGY_C.labels("cagra").inc()
                 return self._microbatch.search(query_vec, k)
             if hnsw is not None:
+                _STRATEGY_C.labels("hnsw").inc()
                 return hnsw.search(query_vec, k)
         if lexical_doc_ids and hasattr(self.vectors, "route"):
+            _STRATEGY_C.labels("ivf_route").inc()
             return self.vectors.search(query_vec, k,
                                        lexical_doc_ids=lexical_doc_ids)
         if hasattr(self.vectors, "search_batch"):
@@ -557,10 +567,13 @@ class SearchService:
                 # dispatch re-reads self.cagra, so a concurrent graph
                 # build could answer an exact request approximately.
                 # Direct brute call (rare path: eval + exact=True).
+                _STRATEGY_C.labels("exact").inc()
                 return self.vectors.search_batch(
                     np.asarray([query_vec], dtype=np.float32), k)[0]
             # micro-batched: concurrent singles ride one device call
+            _STRATEGY_C.labels("brute").inc()
             return self._microbatch.search(query_vec, k)
+        _STRATEGY_C.labels("backend").inc()
         return self.vectors.search(query_vec, k)  # IVF backends
 
     def search(

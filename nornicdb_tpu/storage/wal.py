@@ -16,9 +16,19 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nornicdb_tpu.obs import REGISTRY
+
+_APPEND_H = REGISTRY.histogram(
+    "nornicdb_wal_append_seconds",
+    "WAL record append latency (encode + write [+ fsync when "
+    "sync_every_write])")
+_FSYNC_H = REGISTRY.histogram(
+    "nornicdb_wal_fsync_seconds", "WAL fsync latency")
 
 def _typed_default(v):
     # temporal/duration/point property values serialize as tagged maps
@@ -176,6 +186,7 @@ class WAL:
 
     def append(self, op: str, data: Dict[str, Any]) -> int:
         """Append one record; returns its sequence number."""
+        t0 = time.perf_counter()
         with self._lock:
             self._seq += 1
             rec = {"seq": self._seq, "op": op, "data": data}
@@ -186,8 +197,12 @@ class WAL:
             self._fh_size += len(frame)
             if self.sync_every_write:
                 self._fh.flush()
+                ts = time.perf_counter()
                 os.fsync(self._fh.fileno())
-            return self._seq
+                _FSYNC_H.observe(time.perf_counter() - ts)
+            seq = self._seq
+        _APPEND_H.observe(time.perf_counter() - t0)
+        return seq
 
     def _ensure_segment(self, incoming: int) -> None:
         if self._fh is not None and self._fh_size + incoming <= self.max_segment_bytes:
@@ -205,7 +220,9 @@ class WAL:
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()
+                ts = time.perf_counter()
                 os.fsync(self._fh.fileno())
+                _FSYNC_H.observe(time.perf_counter() - ts)
 
     def close(self) -> None:
         with self._lock:

@@ -44,6 +44,16 @@ def _fake_result():
                           "backend": "cpu"}},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
+        "telemetry": {
+            "latency": {
+                series: {"count": 100, "p50_ms": 0.4, "p95_ms": 1.1,
+                         "p99_ms": 2.2}
+                for series in bench._TELEMETRY_HEADLINES.values()
+            },
+            "compile_universe": [
+                {"kind": "microbatch", "b": 1, "k": 16, "dispatches": 9,
+                 "first_call_ms": 11.0, "mean_ms": 1.5}],
+        },
         "tpu_proof": {"skipped": "backend is 'cpu'"},
     }
 
@@ -70,6 +80,9 @@ class TestCompactSummary:
                               "backend": "cpu"}
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
+        # latency percentiles ride the summary per headline surface
+        assert set(s["latency_ms"]) == set(bench._TELEMETRY_HEADLINES)
+        assert s["latency_ms"]["qdrant_grpc_search"] == [0.4, 1.1, 2.2]
 
     def test_missing_subresults_never_raise(self):
         s = bench._compact_summary({"metric": "x"})
@@ -79,6 +92,7 @@ class TestCompactSummary:
         assert s["hnsw_build"]["inserts_per_s"] is None
         assert s["knn"]["b1_qps"] is None
         assert s["cagra"]["qps_at_recall95"] is None
+        assert s["latency_ms"] == {}
         assert s["tpu_proof"] is None
 
     def test_error_result_still_summarizes(self):
@@ -133,7 +147,8 @@ class TestBenchDryRunArtifactSchema:
     default suite here first)."""
 
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
-                    "knn", "northstar", "ann", "surfaces", "tpu_proof")
+                    "knn", "northstar", "ann", "surfaces", "telemetry",
+                    "tpu_proof")
 
     def test_dry_run_artifact_schema(self):
         import os
@@ -182,11 +197,33 @@ class TestBenchDryRunArtifactSchema:
         assert qg["framework_floor"] > 0
         assert qg["vs_floor"] > 0
 
+        # the telemetry stage: every headline series the surfaces run
+        # drives must carry count + p50/p95/p99 (ISSUE 3 satellite)
+        lat = full["telemetry"]["latency"]
+        for short, series in bench._TELEMETRY_HEADLINES.items():
+            assert series in lat, f"telemetry missing {short} ({series})"
+            entry = lat[series]
+            assert entry["count"] > 0, series
+            for q in ("p50_ms", "p95_ms", "p99_ms"):
+                assert entry[q] is not None and entry[q] >= 0, (series, q)
+            assert entry["p50_ms"] <= entry["p95_ms"] <= entry["p99_ms"], (
+                series)
+        # the pow2 compile-bucket discipline is observable: every shape
+        # the run compiled is in the universe, with b and k powers of 2
+        universe = full["telemetry"]["compile_universe"]
+        assert universe, "no device dispatches recorded"
+        for entry in universe:
+            assert entry["b"] & (entry["b"] - 1) == 0, entry
+            assert entry["dispatches"] >= 1
+
         # compact summary carries the floor too (driver tail window)
         assert summary["summary"] is True
         assert summary["dry_run"] is True
         assert summary["qdrant_floor"][0] > 0
         assert summary["knn"]["b1_concurrent_qps"] > 0
+        # and the latency trio for the hottest surface
+        p = summary["latency_ms"]["qdrant_grpc_search"]
+        assert len(p) == 3 and all(x is not None for x in p)
         assert len(lines[-1]) < 2000
 
 

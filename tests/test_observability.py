@@ -1,0 +1,632 @@
+"""Unified telemetry layer (ISSUE 3): metric primitives, span tracing,
+the device-dispatch instrument, and the serving-stack wiring.
+
+The acceptance contract pinned here: /metrics serves REAL Prometheus
+histograms (_bucket/_sum/_count with # TYPE histogram) for the HTTP,
+gRPC, microbatch, WAL-fsync and device-dispatch families; one qdrant
+Search over the official gRPC surface produces a trace with wire,
+coalesce and dispatch spans retrievable from /admin/traces; the
+concurrency-sensitive counters (WireCache under racing writes, the
+MicroBatcher batch-size histogram under a convoy) account exactly; and
+the instrumentation stays within a fixed overhead budget of the
+uninstrumented path.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu import obs
+from nornicdb_tpu.cache import WireCache
+from nornicdb_tpu.obs.metrics import Histogram, Registry
+from nornicdb_tpu.search.microbatch import MicroBatcher
+from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+
+# ---------------------------------------------------------------------------
+# metric primitives
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsPrimitives:
+    def test_counter_exact_under_contention(self):
+        r = Registry()
+        c = r.counter("nornicdb_t_total", "t")
+        n_threads, per = 8, 5_000
+        barrier = threading.Barrier(n_threads)
+
+        def worker():
+            barrier.wait()
+            for _ in range(per):
+                c.inc()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # lock-striped adds lose nothing (unlike a bare `x += 1`)
+        assert c.value == n_threads * per
+
+    def test_histogram_exposition_contract(self):
+        r = Registry()
+        h = r.histogram("nornicdb_t_seconds", "t",
+                        buckets=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.0005, 0.005, 0.05, 5.0):
+            h.observe(v)
+        text = r.render()
+        assert "# TYPE nornicdb_t_seconds histogram" in text
+        # buckets are CUMULATIVE, le is inclusive, +Inf catches the tail
+        assert 'nornicdb_t_seconds_bucket{le="0.001"} 2' in text
+        assert 'nornicdb_t_seconds_bucket{le="0.01"} 3' in text
+        assert 'nornicdb_t_seconds_bucket{le="0.1"} 4' in text
+        assert 'nornicdb_t_seconds_bucket{le="+Inf"} 5' in text
+        assert "nornicdb_t_seconds_count 5" in text
+        snap = h.snapshot()
+        assert snap["count"] == 5
+        assert abs(snap["sum"] - 5.056) < 1e-9
+
+    def test_histogram_le_boundary_inclusive(self):
+        h = Histogram(buckets=(1.0, 2.0))
+        h.observe(1.0)  # exactly on a bound: le="1.0" bucket
+        assert h.snapshot()["counts"] == [1, 0, 0]
+
+    def test_quantiles_interpolate(self):
+        h = Histogram(buckets=(0.01, 0.1, 1.0))
+        for _ in range(90):
+            h.observe(0.005)
+        for _ in range(10):
+            h.observe(0.5)
+        assert h.quantile(0.5) <= 0.01
+        assert 0.1 < h.quantile(0.99) <= 1.0
+        assert Histogram(buckets=(1,)).quantile(0.5) is None
+
+    def test_labeled_families_and_types(self):
+        r = Registry()
+        c = r.counter("nornicdb_req_total", "t", labels=("surface",))
+        c.labels("http").inc(3)
+        c.labels("grpc").inc()
+        g = r.gauge("nornicdb_up", "t")
+        g.set(2)
+        text = r.render()
+        assert '# TYPE nornicdb_req_total counter' in text
+        assert 'nornicdb_req_total{surface="http"} 3' in text
+        assert 'nornicdb_req_total{surface="grpc"} 1' in text
+        assert "# TYPE nornicdb_up gauge" in text
+        # get-or-create is idempotent; kind conflicts are errors
+        assert r.counter("nornicdb_req_total") is c
+        with pytest.raises(ValueError):
+            r.gauge("nornicdb_req_total")
+
+    def test_callback_gauge_reads_on_scrape(self):
+        r = Registry()
+        box = {"v": 1.0}
+        r.gauge("nornicdb_cb", "t", fn=lambda: box["v"])
+        assert "nornicdb_cb 1" in r.render()
+        box["v"] = 7.0
+        assert "nornicdb_cb 7" in r.render()
+
+    def test_latency_summary_selects_seconds_histograms(self):
+        r = Registry()
+        h = r.histogram("nornicdb_x_seconds", "t", labels=("m",))
+        h.labels("a").observe(0.002)
+        h.labels("a").observe(0.004)
+        r.histogram("nornicdb_sizes", "t", buckets=(1, 2)).observe(1)
+        summary = obs.latency_summary(r)
+        assert list(summary) == ['nornicdb_x_seconds{m="a"}']
+        entry = summary['nornicdb_x_seconds{m="a"}']
+        assert entry["count"] == 2
+        assert entry["p50_ms"] > 0 and entry["p99_ms"] >= entry["p50_ms"]
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+class TestTracing:
+    def test_root_child_nesting_and_ring(self):
+        buf = obs.TraceBuffer(capacity=4, slow_ms=0.0)
+        with obs.trace("wire", method="/x") as root:
+            with obs.span("inner"):
+                with obs.span("leaf"):
+                    pass
+            obs.attach_span("grafted", root.t0, root.t0 + 0.001, batch=3)
+        # the process buffer got it; verify the tree shape from the span
+        assert root.span_names() == ["wire", "inner", "leaf", "grafted"]
+        assert root.children[0].children[0].name == "leaf"
+        assert root.children[1].attrs["batch"] == 3
+        buf.record(root)
+        snap = buf.snapshot()
+        assert snap[0]["name"] == "wire"
+        assert snap[0]["attrs"]["method"] == "/x"
+
+    def test_span_without_trace_is_noop(self):
+        assert obs.current_span() is None
+        with obs.span("orphan"):
+            # no active root: nothing to attach to, nothing recorded
+            assert obs.current_span() is None
+
+    def test_ring_capacity_bounded(self):
+        buf = obs.TraceBuffer(capacity=3, slow_ms=0.0)
+        for i in range(10):
+            s = obs.Span("wire", t0=float(i))
+            s.finish(t1=float(i) + 0.001)
+            buf.record(s)
+        assert len(buf.snapshot(limit=100)) == 3
+        assert buf.recorded == 10
+
+    def test_slow_threshold_filters(self):
+        buf = obs.TraceBuffer(capacity=8, slow_ms=50.0)
+        fast = obs.Span("wire")
+        fast.finish(t1=fast.t0 + 0.001)
+        slow = obs.Span("wire")
+        slow.finish(t1=slow.t0 + 0.2)
+        buf.record(fast)
+        buf.record(slow)
+        snap = buf.snapshot()
+        assert len(snap) == 1 and snap[0]["duration_ms"] >= 50.0
+
+    def test_traces_isolated_across_threads(self):
+        seen = {}
+
+        def worker(name):
+            with obs.trace("wire", method=name) as root:
+                time.sleep(0.01)
+                with obs.span(f"child-{name}"):
+                    pass
+            seen[name] = root.span_names()
+
+        threads = [threading.Thread(target=worker, args=(f"t{i}",))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for name, names in seen.items():
+            assert names == ["wire", f"child-{name}"]
+
+
+# ---------------------------------------------------------------------------
+# device-dispatch instrument
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchInstrument:
+    def test_first_call_is_the_compile(self):
+        from nornicdb_tpu.obs import dispatch as d
+
+        compile_c = obs.REGISTRY.counter(
+            "nornicdb_device_compile_total", labels=("kind",))
+        kind = f"test-{time.time_ns()}"  # fresh label => fresh counters
+        before = compile_c.labels(kind).value
+        obs.record_dispatch(kind, 8, 16, 0.120)
+        obs.record_dispatch(kind, 8, 16, 0.002)
+        obs.record_dispatch(kind, 16, 16, 0.100)
+        assert compile_c.labels(kind).value == before + 2  # two shapes
+        shapes = {(e["b"], e["k"]): e for e in obs.compile_universe()
+                  if e["kind"] == kind}
+        assert shapes[(8, 16)]["dispatches"] == 2
+        assert shapes[(8, 16)]["first_call_ms"] == 120.0
+        assert shapes[(16, 16)]["dispatches"] == 1
+        assert d is not None
+
+    def test_microbatch_records_pow2_shapes(self):
+        idx = BruteForceIndex()
+        rng = np.random.default_rng(3)
+        vecs = rng.standard_normal((64, 16)).astype(np.float32)
+        idx.add_batch([(f"v{i}", vecs[i]) for i in range(64)])
+        mb = MicroBatcher(idx.search_batch)
+        mb.search(vecs[0], 10)  # b=1 bucket, k pow2-bucketed to 16
+        shapes = {(e["b"], e["k"]) for e in obs.compile_universe()
+                  if e["kind"] == "microbatch"}
+        assert (1, 16) in shapes
+
+
+# ---------------------------------------------------------------------------
+# WireCache counters under racing writes (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestWireCacheCountersRacing:
+    def test_hit_miss_invalidation_accounting(self):
+        # unique cache name => this test owns its labeled counters
+        wc = WireCache(name=f"race-{time.time_ns()}")
+        gen = [0]
+        probes_per_thread, n_readers = 400, 6
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                gen[0] += 1  # simulates index mutations bumping the gen
+                time.sleep(0.0002)
+
+        def reader(t):
+            for i in range(probes_per_thread):
+                g = gen[0]
+                key = f"req-{i % 20}".encode()
+                hit = wc.get("/m", key, g)
+                if hit is None:
+                    wc.put("/m", key, g, b"payload")
+
+        w = threading.Thread(target=writer)
+        readers = [threading.Thread(target=reader, args=(t,))
+                   for t in range(n_readers)]
+        w.start()
+        for r in readers:
+            r.start()
+        for r in readers:
+            r.join()
+        stop.set()
+        w.join()
+        stats = wc.stats()
+        probes = n_readers * probes_per_thread
+        # every probe is exactly one hit or one miss — the striped
+        # counters lose nothing under the race
+        assert stats["wire_hits"] + stats["wire_misses"] == probes
+        # the generation churn must be visible as invalidations, and an
+        # invalidation is a kind of miss (never double-counted as hit)
+        assert stats["wire_invalidations"] > 0
+        assert stats["wire_invalidations"] <= stats["wire_misses"]
+
+    def test_stale_generation_counts_invalidation(self):
+        wc = WireCache(name=f"stale-{time.time_ns()}")
+        wc.put("/m", b"k", 1, b"v1")
+        assert wc.get("/m", b"k", 1) == b"v1"
+        assert wc.get("/m", b"k", 2) is None  # outdated by a write
+        s = wc.stats()
+        assert s["wire_hits"] == 1
+        assert s["wire_invalidations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher batch-size histogram under a convoy (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestMicroBatchHistogram:
+    def test_convoy_histogram_accounts_every_query(self):
+        fam = obs.REGISTRY.histogram("nornicdb_microbatch_batch_size")
+        before = fam.snapshot()
+        idx = BruteForceIndex()
+        rng = np.random.default_rng(5)
+        vecs = rng.standard_normal((256, 24)).astype(np.float32)
+        idx.add_batch([(f"v{i}", vecs[i]) for i in range(256)])
+        mb = MicroBatcher(idx.search_batch)
+        n_threads, per = 12, 8
+        barrier = threading.Barrier(n_threads)
+
+        def worker(t):
+            barrier.wait()
+            for j in range(per):
+                mb.search(vecs[(t * per + j) % 256], 5)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        after = fam.snapshot()
+        new_batches = after["count"] - before["count"]
+        new_queries = after["sum"] - before["sum"]
+        # every dispatched batch was observed once, with its size as the
+        # observed value: counts delta == batches, sum delta == queries
+        assert new_batches == mb.batches
+        assert new_queries == mb.batched_queries == n_threads * per
+        # under a convoy, coalescing must actually happen
+        assert mb.batches < n_threads * per
+
+
+# ---------------------------------------------------------------------------
+# PROFILE actuals flow into telemetry (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestProfileTelemetry:
+    def test_profile_records_db_hits_and_latency(self):
+        from nornicdb_tpu.query.executor import CypherExecutor
+        from nornicdb_tpu.storage import MemoryEngine
+
+        hits_fam = obs.REGISTRY.histogram(
+            "nornicdb_profile_db_hits",
+            buckets=(1, 10, 100, 1_000, 10_000, 100_000, 1_000_000))
+        secs_fam = obs.REGISTRY.histogram(
+            "nornicdb_profile_query_seconds")
+        h_before = hits_fam.snapshot()
+        s_before = secs_fam.snapshot()
+
+        eng = MemoryEngine()
+        ex = CypherExecutor(eng)
+        for i in range(20):
+            ex.execute("CREATE (:P {i: $i})", {"i": i})
+        result = ex.execute("PROFILE MATCH (p:P) RETURN count(p)")
+        assert result.plan is not None
+        profiled_hits = result.plan["children"][0]["db_hits"]
+        assert profiled_hits > 0
+
+        h_after = hits_fam.snapshot()
+        s_after = secs_fam.snapshot()
+        assert h_after["count"] == h_before["count"] + 1
+        # the histogram observed exactly the db_hits PROFILE reported
+        assert h_after["sum"] - h_before["sum"] == profiled_hits
+        assert s_after["count"] == s_before["count"] + 1
+        assert s_after["sum"] > s_before["sum"]
+
+    def test_profile_metrics_reach_metrics_endpoint(self):
+        import nornicdb_tpu
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        db = nornicdb_tpu.open()
+        srv = HttpServer(db, port=0).start()
+        try:
+            body = json.dumps({"statements": [
+                {"statement": "PROFILE RETURN 1"}]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/db/neo4j/tx/commit",
+                data=body, headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics",
+                    timeout=5) as resp:
+                text = resp.read().decode()
+            assert "# TYPE nornicdb_profile_query_seconds histogram" in text
+            assert "nornicdb_profile_query_seconds_bucket" in text
+            assert "nornicdb_profile_db_hits_sum" in text
+        finally:
+            srv.stop()
+            db.close()
+
+
+# ---------------------------------------------------------------------------
+# serving-stack wiring: metrics endpoint + trace acceptance
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serving():
+    import grpc
+
+    import nornicdb_tpu
+    from nornicdb_tpu.api.grpc_server import GrpcServer
+    from nornicdb_tpu.api.http_server import HttpServer
+    from nornicdb_tpu.api.proto import qdrant_pb2 as q
+
+    db = nornicdb_tpu.open(auto_embed=False)
+    grpc_srv = GrpcServer(db, port=0).start()
+    http = HttpServer(db, port=0).start()
+    ch = grpc.insecure_channel(grpc_srv.address)
+
+    def call(method, request, resp_cls):
+        return ch.unary_unary(
+            method,
+            request_serializer=lambda r: r.SerializeToString(),
+            response_deserializer=resp_cls.FromString)(request)
+
+    req = q.CreateCollection(collection_name="obs")
+    req.vectors_config.params.size = 8
+    req.vectors_config.params.distance = q.Cosine
+    call("/qdrant.Collections/Create", req, q.CollectionOperationResponse)
+    up = q.UpsertPoints(collection_name="obs")
+    for i in range(32):
+        p = up.points.add()
+        p.id.num = i
+        p.vectors.vector.data.extend(
+            [float((i >> j) & 1) for j in range(8)])
+    call("/qdrant.Points/Upsert", up, q.PointsOperationResponse)
+    yield {"db": db, "http": http, "call": call, "q": q}
+    ch.close()
+    grpc_srv.stop()
+    http.stop()
+    db.close()
+
+
+def _http_get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        raw = resp.read()
+        if "json" in resp.headers.get("Content-Type", ""):
+            return json.loads(raw)
+        return raw.decode()
+
+
+class TestServingTelemetry:
+    def test_qdrant_search_trace_reaches_admin_endpoint(self, serving):
+        q = serving["q"]
+        sr = q.SearchPoints(collection_name="obs",
+                            vector=[1.0] * 8, limit=5)
+        resp = serving["call"]("/qdrant.Points/Search", sr,
+                               q.SearchResponse)
+        assert len(resp.result) == 5
+        doc = _http_get(serving["http"].port, "/admin/traces")
+        assert doc["recorded"] >= 1
+        search_traces = [
+            t for t in doc["traces"]
+            if t["attrs"].get("method") == "/qdrant.Points/Search"
+        ]
+        assert search_traces, "Search produced no trace"
+
+        def names(t):
+            out = [t["name"]]
+            for c in t["children"]:
+                out.extend(names(c))
+            return out
+
+        flat = names(search_traces[0])
+        # acceptance: wire, coalesce and dispatch spans in ONE coherent
+        # trace — wire (grpc), coalesce wait + device dispatch (the
+        # MicroBatcher), merge, and the qdrant rank interval
+        assert flat[0] == "wire"
+        assert "coalesce.wait" in flat
+        assert "device.dispatch" in flat
+        assert "merge" in flat
+        assert search_traces[0]["attrs"]["transport"] == "grpc"
+        # the grafted dispatch span carries the coalesced batch size
+        dispatch = next(c for c in search_traces[0]["children"]
+                        if c["name"] == "device.dispatch")
+        assert dispatch["attrs"]["batch"] >= 1
+
+    def test_metrics_serves_required_histograms(self, serving):
+        # labeled families materialize series on first observation, and
+        # a scrape observes ITSELF only after rendering — serve one
+        # request first so the http family has a series regardless of
+        # test ordering
+        _http_get(serving["http"].port, "/health")
+        text = _http_get(serving["http"].port, "/metrics")
+        for fam in ("nornicdb_http_request_seconds",
+                    "nornicdb_grpc_request_seconds",
+                    "nornicdb_microbatch_batch_size",
+                    "nornicdb_wal_fsync_seconds",
+                    "nornicdb_device_dispatch_seconds"):
+            assert f"# TYPE {fam} histogram" in text, fam
+            assert f"{fam}_bucket" in text, fam
+            assert f"{fam}_sum" in text, fam
+            assert f"{fam}_count" in text, fam
+        # real counter types replaced the old everything-is-a-gauge text
+        assert "# TYPE nornicdb_http_requests_total counter" in text
+        assert "# TYPE nornicdb_wire_cache_hits_total counter" in text
+        assert "nornicdb_device_dispatch_total" in text
+        assert "nornicdb_uptime_seconds" in text
+
+    def test_wire_cache_hit_annotated_and_counted(self, serving):
+        q = serving["q"]
+        hits_c = obs.REGISTRY.counter(
+            "nornicdb_wire_cache_hits_total",
+            labels=("cache",)).labels("grpc")
+        sr = q.SearchPoints(collection_name="obs",
+                            vector=[0.0] * 7 + [1.0], limit=3)
+        serving["call"]("/qdrant.Points/Search", sr, q.SearchResponse)
+        before = hits_c.value
+        serving["call"]("/qdrant.Points/Search", sr, q.SearchResponse)
+        assert hits_c.value == before + 1
+        doc = _http_get(serving["http"].port, "/admin/traces")
+        hit_traces = [
+            t for t in doc["traces"]
+            if t["attrs"].get("method") == "/qdrant.Points/Search"
+            and t["attrs"].get("cache") == "hit"
+        ]
+        assert hit_traces and not hit_traces[0]["children"]
+
+    def test_telemetry_endpoint_summarizes(self, serving):
+        doc = _http_get(serving["http"].port, "/admin/telemetry")
+        assert any(k.startswith("nornicdb_grpc_request_seconds")
+                   for k in doc["latency"])
+        assert isinstance(doc["compile_universe"], list)
+        assert "rate_limiter_clients" in doc
+
+    def test_strategy_counter_ticks(self, serving):
+        strat = obs.REGISTRY.counter(
+            "nornicdb_search_strategy_total", labels=("strategy",))
+        db = serving["db"]
+        db.store("telemetry strategy probe", node_id="obs-probe",
+                 embedding=[0.5] * 8)
+        before = strat.labels("brute").value
+        db.search.vector_search_candidates(np.asarray([0.5] * 8,
+                                                      np.float32), k=1)
+        assert strat.labels("brute").value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# rate limiter eviction (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRateLimiterEviction:
+    def test_windows_do_not_accumulate_forever(self, monkeypatch):
+        from nornicdb_tpu.api.http_server import _RateLimiter
+
+        now = [1000.0]
+        monkeypatch.setattr(time, "time", lambda: now[0])
+        rl = _RateLimiter(per_minute=5)
+        for i in range(500):
+            assert rl.allow(f"client-{i}")
+        assert rl.tracked_clients() == 500
+        now[0] += 61  # next minute: all recorded windows are dead
+        assert rl.allow("fresh")
+        assert rl.tracked_clients() == 1
+
+    def test_limit_still_enforced_within_window(self, monkeypatch):
+        from nornicdb_tpu.api.http_server import _RateLimiter
+
+        now = [2000.0]
+        monkeypatch.setattr(time, "time", lambda: now[0])
+        rl = _RateLimiter(per_minute=3)
+        assert [rl.allow("c") for _ in range(5)] == [
+            True, True, True, False, False]
+        now[0] += 60
+        assert rl.allow("c")  # new window resets the count
+
+
+# ---------------------------------------------------------------------------
+# overhead guard (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestOverheadGuard:
+    def test_primitive_cost_bounds(self):
+        r = Registry()
+        c = r.counter("nornicdb_ov_total", "t")
+        h = r.histogram("nornicdb_ov_seconds", "t")
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.inc()
+        counter_us = (time.perf_counter() - t0) / n * 1e6
+        t0 = time.perf_counter()
+        for _ in range(n):
+            h.observe(0.001)
+        observe_us = (time.perf_counter() - t0) / n * 1e6
+        n = 2_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs.trace("wire", method="/ov"):
+                with obs.span("child"):
+                    pass
+        trace_us = (time.perf_counter() - t0) / n * 1e6
+        # generous CI budgets — the real costs are ~1-3us; regressing
+        # past these means something accidentally heavy landed on the
+        # record path (string formatting, rendering, locks in series)
+        assert counter_us < 50, f"counter inc {counter_us:.1f}us/op"
+        assert observe_us < 50, f"histogram observe {observe_us:.1f}us/op"
+        assert trace_us < 500, f"trace+span {trace_us:.1f}us/req"
+
+    def test_instrumented_search_path_within_budget(self):
+        """The full instrumented serving path (MicroBatcher: histogram,
+        queue depth, dispatch record, span grafting) vs the same path
+        with telemetry disabled. Budget: the instrumented path stays
+        within 2x + 1ms/op of the uninstrumented one — a huge margin
+        over the measured ~5us/op, small enough to catch an accidental
+        O(requests) render or lock pileup."""
+        idx = BruteForceIndex()
+        rng = np.random.default_rng(11)
+        vecs = rng.standard_normal((512, 32)).astype(np.float32)
+        idx.add_batch([(f"v{i}", vecs[i]) for i in range(512)])
+        mb = MicroBatcher(idx.search_batch)
+        n = 300
+
+        def measure():
+            for i in range(30):  # warm
+                mb.search(vecs[i], 10)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for i in range(n):
+                    mb.search(vecs[i % 512], 10)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t_on = measure()
+        obs.set_enabled(False)
+        try:
+            t_off = measure()
+        finally:
+            obs.set_enabled(True)
+        assert t_on <= t_off * 2.0 + n * 1e-3, (
+            f"instrumented {t_on:.4f}s vs bare {t_off:.4f}s")
