@@ -118,6 +118,7 @@ _DEVICE_STAGES = {
     "knn": (lambda: _bench_knn(), 900.0),
     "northstar": (lambda: _bench_northstar(), 1800.0),
     "ann_cagra": (lambda: {"cagra": _bench_ann_cagra()}, 900.0),
+    "hybrid": (lambda: _bench_hybrid(), 900.0),
     "tpu_proof": (lambda: _run_tpu_proof_stage(), 900.0),
 }
 
@@ -199,6 +200,11 @@ def main(dry_run: bool = False):
             result["ann"] = {
                 "cagra": {"error": f"{type(exc).__name__}: {exc}"[:400]}}
         try:
+            result["hybrid"] = _bench_hybrid(tiny=True)
+        except Exception as exc:
+            result["hybrid"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:400]}
+        try:
             result["surfaces"] = _bench_surfaces(n_people=80, secs=0.3,
                                                  warmup_s=0.1)
         except Exception as exc:
@@ -222,6 +228,10 @@ def main(dry_run: bool = False):
     # the artifact's proof that sub-linear search now runs on-device
     result["ann"] = _stage_subprocess(
         "ann_cagra", _DEVICE_STAGES["ann_cagra"][1])
+    # fused hybrid (ISSUE 4): BM25+vector+RRF in one compiled pipeline
+    # vs the host hybrid path, at serving batch shapes, rank-identical
+    result["hybrid"] = _stage_subprocess(
+        "hybrid", _DEVICE_STAGES["hybrid"][1])
     # five-surface e2e throughput (reference: testing/e2e/README.md —
     # bolt 2,489 / neo4j-http 4,082 / graphql 3,200 / REST search
     # 10,296 / qdrant-grpc 29,331 ops/s on a 16-way dev box). Pure
@@ -347,6 +357,15 @@ def _compact_summary(result):
             "speedup_vs_brute": g(result, "ann", "cagra",
                                   "speedup_vs_brute"),
             "backend": g(result, "ann", "cagra", "backend"),
+        },
+        # fused hybrid (hybrid stage): the headline trio — device-fused
+        # qps at the serving batch, speedup over the host hybrid path,
+        # and the rank-identity fraction that makes the speedup honest
+        "hybrid": {
+            "fused_qps_b16": g(result, "hybrid", "fused_qps", "16"),
+            "speedup_vs_host": g(result, "hybrid",
+                                 "speedup_vs_host_b16"),
+            "rank_parity": g(result, "hybrid", "rank_parity"),
         },
         "pagerank_speedup_vs_numpy": g(result, "northstar",
                                        "pagerank_device",
@@ -1177,6 +1196,125 @@ def _bench_ann_cagra(tiny: bool = False):
         "qps_at_recall95": qps95,
         "speedup_vs_brute": (round(qps95 / brute_qps, 2)
                              if qps95 and brute_qps else None),
+    }
+
+
+def _bench_hybrid(tiny: bool = False):
+    """Fused hybrid stage (ISSUE 4): the one-program BM25+vector+RRF
+    pipeline vs the host hybrid path (BM25Index.search -> brute
+    search_batch -> rrf_fuse) at the same corpus and ranking quality.
+    Quality gate first: the fused top-10 must be rank-identical to the
+    host reference on every probe query; then qps at serving batch
+    shapes 1/16/64 through the same public search_batch surface."""
+    import jax
+
+    from nornicdb_tpu.search.bm25 import BM25Index, tokenize
+    from nornicdb_tpu.search.hybrid_fused import FusedHybrid
+    from nornicdb_tpu.search.microbatch import pow2_bucket
+    from nornicdb_tpu.search.rrf import rrf_fuse
+    from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+    n, d, n_vocab = (1_000, 32, 200) if tiny else (20_000, 128, 2_000)
+    nq = 32 if tiny else 128
+    secs = 0.2 if tiny else 1.2
+    limit, overfetch = 10, 30
+    rng = np.random.default_rng(7)
+    vocab = np.asarray([f"w{i}" for i in range(n_vocab)])
+    # zipf-ish term popularity: realistic posting-length skew
+    weights = 1.0 / np.arange(1, n_vocab + 1) ** 0.9
+    weights /= weights.sum()
+
+    bm25 = BM25Index()
+    brute = BruteForceIndex()
+    for i in range(n):
+        terms = rng.choice(vocab, size=int(rng.integers(8, 24)),
+                           p=weights)
+        bm25.index(f"d{i}", " ".join(terms))
+        brute.add(f"d{i}", rng.standard_normal(d).astype(np.float32))
+
+    fh = FusedHybrid(bm25, brute, min_n=1)
+    t0 = time.perf_counter()
+    built = fh.build()
+    build_s = time.perf_counter() - t0
+
+    q_texts = [" ".join(rng.choice(vocab, size=int(rng.integers(2, 5)),
+                                   p=weights)) for _ in range(nq)]
+    q_embs = rng.standard_normal((nq, d)).astype(np.float32)
+    kq = pow2_bucket(overfetch)
+    extras = [{"tokens": tokenize(q), "n_cand": overfetch,
+               "w": (1.0, 1.0)} for q in q_texts]
+
+    def host_one(qi):
+        lex = bm25.search(q_texts[qi], overfetch)
+        vec = brute.search_batch(q_embs[qi:qi + 1], overfetch)[0]
+        if lex and vec:
+            return rrf_fuse([lex, vec], limit=overfetch)[:limit]
+        return (lex or vec)[:limit]
+
+    # quality gate: rank-identical top-10 on every probe query
+    rows = fh.search_batch(q_embs, kq, extras)
+    same = 0
+    for qi in range(nq):
+        host_ids = [e for e, _ in host_one(qi)]
+        if rows[qi] is None:
+            continue
+        lex, vec = rows[qi]["lex"], rows[qi]["vec"]
+        fused = (rows[qi]["fused"] if lex and vec
+                 else (lex or vec))[:limit]
+        if [e for e, _ in fused] == host_ids:
+            same += 1
+    rank_parity = same / nq
+
+    # host-path qps (single stream — the pre-fused serving shape: every
+    # query serializes through the BM25 lock)
+    for qi in range(min(4, nq)):
+        host_one(qi)
+    t0 = time.perf_counter()
+    m = 0
+    while True:
+        host_one(m % nq)
+        m += 1
+        if time.perf_counter() - t0 > secs:
+            break
+    host_qps = m / (time.perf_counter() - t0)
+
+    fused_qps = {}
+    for batch in (1, 16, 64):
+        bq = min(batch, nq)
+        ex = extras[:bq]
+        emb = q_embs[:bq]
+        fh.search_batch(emb, kq, ex)  # warm the (B, k) compile
+        t0 = time.perf_counter()
+        m = 0
+        while True:
+            fh.search_batch(emb, kq, ex)
+            m += bq
+            if time.perf_counter() - t0 > secs:
+                break
+        fused_qps[str(batch)] = round(m / (time.perf_counter() - t0), 1)
+
+    from nornicdb_tpu.obs.dispatch import compile_universe
+
+    hybrid_shapes = [e for e in compile_universe()
+                     if e["kind"] == "hybrid_fused"]
+    sp16 = (round(fused_qps["16"] / host_qps, 2)
+            if host_qps and fused_qps.get("16") else None)
+    return {
+        "n": n, "dims": d, "vocab": n_vocab, "k": limit,
+        "overfetch": overfetch,
+        "backend": jax.devices()[0].platform,
+        "built": built,
+        "build_s": round(build_s, 2),
+        "rank_parity": round(rank_parity, 4),
+        "host_qps": round(host_qps, 1),
+        "fused_qps": fused_qps,
+        "speedup_vs_host_b16": sp16,
+        "speedup_vs_host_b64": (
+            round(fused_qps["64"] / host_qps, 2)
+            if host_qps and fused_qps.get("64") else None),
+        # bounded compile universe: distinct (B, k) buckets the fused
+        # pipeline compiled during this stage
+        "compile_buckets": len(hybrid_shapes),
     }
 
 

@@ -771,7 +771,28 @@ class HttpServer:
             self.metrics.inc("search_requests_total")
             q = payload.get("query", "")
             limit = int(payload.get("limit", 10))
-            results = self.db.search.search(q, limit=limit)
+            kw: Dict[str, Any] = {}
+            if payload.get("mode"):
+                mode = str(payload["mode"])
+                if mode not in ("hybrid", "text", "vector"):
+                    # the openapi enum is the contract: a typo'd mode
+                    # must be a 400, not a silently empty result set
+                    raise HTTPError(
+                        400, "Neo.ClientError.Request.InvalidFormat",
+                        "mode must be one of hybrid, text, vector")
+                kw["mode"] = mode
+            # weighted RRF (reference: Service.Search weighted fusion):
+            # [lexical, vector] source weights, validated here so a bad
+            # body is a 400, not a device-path error
+            w = payload.get("weights")
+            if w is not None:
+                if (not isinstance(w, (list, tuple)) or len(w) != 2
+                        or not all(isinstance(x, (int, float)) for x in w)):
+                    raise HTTPError(
+                        400, "Neo.ClientError.Request.InvalidFormat",
+                        "weights must be [lexical_weight, vector_weight]")
+                kw["weights"] = (float(w[0]), float(w[1]))
+            results = self.db.search.search(q, limit=limit, **kw)
             # raw results: _reply's json default converts lazily
             return 200, {"results": results}
 

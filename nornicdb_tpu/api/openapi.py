@@ -75,10 +75,17 @@ def openapi_spec() -> Dict[str, Any]:
                 request=stmt_req, params=[("database", "path",
                                            "string")])},
             "/nornicdb/search": {"post": op(
-                "Hybrid search (BM25 + vector + RRF)", "search",
+                "Hybrid search (BM25 + vector + weighted RRF; "
+                "device-fused pipeline on large corpora)", "search",
                 request={"type": "object", "properties": {
                     "query": {"type": "string"},
-                    "limit": {"type": "integer"}}},
+                    "limit": {"type": "integer"},
+                    "mode": {"type": "string",
+                             "enum": ["hybrid", "text", "vector"]},
+                    "weights": {
+                        "type": "array", "minItems": 2, "maxItems": 2,
+                        "items": {"type": "number"},
+                        "description": "[lexical, vector] RRF weights"}}},
                 response=obj)},
             "/nornicdb/store": {"post": op(
                 "Store content (auto-embeds via the queue)", "search",

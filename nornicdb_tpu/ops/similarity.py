@@ -16,7 +16,7 @@ All functions are pure and jit-cached per (shape, k) signature.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -143,6 +143,25 @@ def cosine_topk_chunked(
     if c % chunk != 0:
         return _cosine_topk_impl(queries, matrix, valid, k)
     return _cosine_topk_chunked_impl(queries, matrix, valid, k, chunk)
+
+
+def concat_topk(
+    scores_parts: Sequence[jnp.ndarray],
+    ids_parts: Sequence[jnp.ndarray],
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge per-shard top-k blocks: concatenate [B, k_i] score/id parts
+    in shard order and take one global top-k. This is the single-device
+    reference of the ``all_gather + top_k`` collective merge — the
+    shard-major concat layout is identical to a tiled all-gather, so the
+    merged ranking (including tie order, which lax.top_k resolves by
+    lower concatenated position) is bit-identical to the sharded path.
+    Shared by the CAGRA walk, the device BM25 scorer and the fused
+    hybrid pipeline."""
+    all_s = jnp.concatenate(list(scores_parts), axis=1)
+    all_i = jnp.concatenate(list(ids_parts), axis=1)
+    top_s, pos = jax.lax.top_k(all_s, k)
+    return top_s, jnp.take_along_axis(all_i, pos, axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

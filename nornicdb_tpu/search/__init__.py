@@ -9,6 +9,8 @@ CPU; all bulk distance math runs on device via nornicdb_tpu.ops.
 from nornicdb_tpu.search.bm25 import BM25Index, tokenize  # noqa: F401
 from nornicdb_tpu.search.vector_index import BruteForceIndex  # noqa: F401
 from nornicdb_tpu.search.cagra import CagraIndex  # noqa: F401
+from nornicdb_tpu.search.device_bm25 import DeviceBM25  # noqa: F401
+from nornicdb_tpu.search.hybrid_fused import FusedHybrid  # noqa: F401
 from nornicdb_tpu.search.hnsw import HNSWIndex  # noqa: F401
 from nornicdb_tpu.search.ivf_hnsw import IVFHNSWIndex  # noqa: F401
 from nornicdb_tpu.search.ivfpq import IVFPQIndex  # noqa: F401

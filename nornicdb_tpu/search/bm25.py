@@ -78,11 +78,30 @@ class BM25Index:
         self._alive: List[bool] = []
         self._total_len = 0
         self._n_alive = 0
+        # per-term LIVE document frequency, maintained incrementally on
+        # add/remove/tombstone — scoring and seed selection read it in
+        # O(1) instead of re-counting live postings per query (the old
+        # seed_doc_ids did an O(terms * postings) Python sum)
+        self._df: Dict[str, int] = {}
+        # slot -> unique terms of that doc, so a tombstone can decrement
+        # the live df counters without re-tokenizing
+        self._doc_terms: List[Optional[Tuple[str, ...]]] = []
         # cached numpy doc_len/alive, invalidated by generation counter
         self._mut_gen = 0
         self._np_gen = -1
         self._np_doc_len: Optional[np.ndarray] = None
         self._np_alive: Optional[np.ndarray] = None
+        # changelog of (mutation gen, ext_id) for adds/updates — the
+        # device snapshot (device_bm25.py) exact-scores these between
+        # rebuilds (read-your-writes), mirroring BruteForceIndex's
+        # changelog discipline. Length-capped; _changelog_floor marks
+        # how far back it reaches. Compaction remaps slots, so it
+        # advances the floor past every outstanding marker.
+        self._changelog: List[Tuple[int, str]] = []
+        self._changelog_floor = 0
+        # compaction counter: slot ids are only meaningful between
+        # compactions, so snapshot consumers pin reads on it
+        self.compactions = 0
 
     def _np_state(self) -> Tuple[np.ndarray, np.ndarray]:
         if self._np_gen != self._mut_gen:
@@ -116,6 +135,33 @@ class BM25Index:
                     p = self._postings[t] = _Posting()
                 p.doc_ids.append(idx)
                 p.tfs.append(c)
+                self._df[t] = self._df.get(t, 0) + 1
+            self._doc_terms.append(tuple(counts))
+            self._log_change_locked(doc_id)
+
+    def _log_change_locked(self, doc_id: str) -> None:
+        self._changelog.append((self._mut_gen, doc_id))
+        limit = max(4096, len(self._ext_ids) // 4)
+        if len(self._changelog) > limit:
+            cut = len(self._changelog) - limit
+            self._changelog_floor = self._changelog[cut - 1][0]
+            del self._changelog[:cut]
+
+    def changed_since(self, seq: int) -> Optional[List[str]]:
+        """ext_ids added or UPDATED after mutation ``seq`` (latest first,
+        deduped). Deletes are not reported — consumers live-filter those.
+        Returns None when the changelog was trimmed (or slots remapped
+        by compaction) past ``seq``: the consumer must rebuild or take
+        the host-exact path instead."""
+        with self._lock:
+            if seq < self._changelog_floor:
+                return None
+            out: List[str] = []
+            for s, eid in reversed(self._changelog):
+                if s <= seq:
+                    break
+                out.append(eid)
+        return list(dict.fromkeys(out))
 
     def index_batch(self, docs: Sequence[Tuple[str, str]]) -> None:
         """Reference: IndexBatch (fulltext_index_v2.go:114)."""
@@ -130,6 +176,13 @@ class BM25Index:
         self._alive[idx] = False
         self._total_len -= self._doc_len[idx]
         self._n_alive -= 1
+        for t in self._doc_terms[idx] or ():
+            left = self._df.get(t, 0) - 1
+            if left > 0:
+                self._df[t] = left
+            else:
+                self._df.pop(t, None)
+        self._doc_terms[idx] = None  # release the tombstone's term list
 
     def remove(self, doc_id: str) -> None:
         with self._lock:
@@ -145,12 +198,15 @@ class BM25Index:
         remap: Dict[int, int] = {}
         new_ext: List[str] = []
         new_len: List[int] = []
+        new_terms: List[Optional[Tuple[str, ...]]] = []
         for old_idx, ext in enumerate(self._ext_ids):
             if self._alive[old_idx]:
                 remap[old_idx] = len(new_ext)
                 new_ext.append(ext)
                 new_len.append(self._doc_len[old_idx])
+                new_terms.append(self._doc_terms[old_idx])
         new_postings: Dict[str, _Posting] = {}
+        new_df: Dict[str, int] = {}
         for t, p in self._postings.items():
             np_post = _Posting()
             for did, tf in zip(p.doc_ids, p.tfs):
@@ -160,12 +216,20 @@ class BM25Index:
                     np_post.tfs.append(tf)
             if np_post.doc_ids:
                 new_postings[t] = np_post
+                new_df[t] = len(np_post.doc_ids)
         self._ext_ids = new_ext
         self._doc_len = new_len
         self._alive = [True] * len(new_ext)
         self._int_of = {e: i for i, e in enumerate(new_ext)}
         self._postings = new_postings
+        self._df = new_df
+        self._doc_terms = new_terms
         self._mut_gen += 1
+        self.compactions += 1
+        # slots were remapped: every outstanding snapshot marker is now
+        # meaningless, so invalidate the whole changelog window
+        self._changelog.clear()
+        self._changelog_floor = self._mut_gen
 
     def __contains__(self, doc_id: str) -> bool:
         with self._lock:
@@ -186,42 +250,180 @@ class BM25Index:
         n = max(self._n_alive, 1)
         return math.log(1.0 + (n - df + 0.5) / (df + 0.5))
 
+    @property
+    def mut_gen(self) -> int:
+        """Mutation generation — bumped on every add/update/remove/
+        compaction. Derived device snapshots key freshness off it."""
+        return self._mut_gen
+
+    def term_stats(self, terms: Sequence[str]) -> Tuple[Dict[str, int], int, float]:
+        """(live df per term, n_alive, avgdl) in one lock acquisition —
+        the host-side idf inputs the device scorer shares with this
+        index, read from the incremental counters."""
+        with self._lock:
+            avgdl = max(self._total_len / max(self._n_alive, 1), 1.0)
+            return ({t: self._df.get(t, 0) for t in terms},
+                    self._n_alive, avgdl)
+
     def search(self, query: str, k: int = 10) -> List[Tuple[str, float]]:
         """Top-k (doc_id, bm25_score). Accumulates scores over the query
         terms' postings with NumPy (vectorized tf normalization)."""
         with self._lock:
-            toks = set(tokenize(query))
-            if not toks or self._n_alive == 0:
-                return []
-            n_docs = len(self._ext_ids)
+            return self._search_locked(tokenize(query), k)
+
+    def search_batch(
+        self, queries: Sequence[str], k: int = 10
+    ) -> List[List[Tuple[str, float]]]:
+        """Batched host search: one lock acquisition for the whole batch,
+        one result list per query. The host fallback of the device path
+        (device_bm25.DeviceBM25.search_batch) shares this contract, so
+        callers swap between them without reshaping results."""
+        with self._lock:
+            return [self._search_locked(tokenize(q), k) for q in queries]
+
+    def _search_locked(self, toks_seq: Sequence[str],
+                       k: int) -> List[Tuple[str, float]]:
+        # terms iterate in SORTED order and idf is cast to float32:
+        # per-doc accumulation then happens in the same order and
+        # precision as the device scorer's flattened-entry segment sum,
+        # keeping host and device rankings aligned
+        toks = sorted(set(toks_seq))
+        if not toks or self._n_alive == 0:
+            return []
+        n_docs = len(self._ext_ids)
+        avgdl = max(self._total_len / max(self._n_alive, 1), 1.0)
+        scores = np.zeros(n_docs, dtype=np.float32)
+        doc_len, alive = self._np_state()
+        touched = np.zeros(n_docs, dtype=bool)
+        for t in toks:
+            p = self._postings.get(t)
+            if p is None:
+                continue
+            ids, tfs = p.arrays()
+            # scoring runs over LIVE postings only: a tombstoned slot
+            # (re-index leaves one) must not surface — and the df the
+            # idf sees is the incremental live counter, which equals
+            # the live-posting count by construction
+            live = alive[ids]
+            ids, tfs = ids[live], tfs[live]
+            df = self._df.get(t, 0)
+            if df == 0 or ids.size == 0:
+                continue
+            idf = np.float32(self._idf(df))
+            dl = doc_len[ids]
+            tf_norm = tfs * (K1 + 1.0) / (tfs + K1 * (1.0 - B + B * dl / avgdl))
+            scores[ids] += idf * tf_norm
+            touched[ids] = True
+        mask = touched & alive
+        cand = np.nonzero(mask)[0]
+        if cand.size == 0:
+            return []
+        order = cand[np.argsort(-scores[cand], kind="stable")][:k]
+        return [(self._ext_ids[i], float(scores[i])) for i in order]
+
+    def score_docs(
+        self, tokens: Sequence[str], doc_ids: Sequence[str]
+    ) -> Dict[str, float]:
+        """Exact BM25 scores of specific live docs for a tokenized query
+        (only docs matching >= 1 term appear). The device snapshot's
+        read-your-writes delta side-scan: docs indexed after the
+        snapshot are scored here, host-exact, and merged into the
+        device top-k."""
+        with self._lock:
+            toks = sorted(set(tokens))
+            want: Dict[int, str] = {}
+            for eid in doc_ids:
+                idx = self._int_of.get(eid)
+                if idx is not None and self._alive[idx]:
+                    want[idx] = eid
+            if not toks or not want:
+                return {}
             avgdl = max(self._total_len / max(self._n_alive, 1), 1.0)
-            scores = np.zeros(n_docs, dtype=np.float32)
-            doc_len, alive = self._np_state()
-            touched = np.zeros(n_docs, dtype=bool)
+            out: Dict[str, float] = {}
             for t in toks:
                 p = self._postings.get(t)
-                if p is None:
+                df = self._df.get(t, 0)
+                if p is None or df == 0:
                     continue
+                idf = np.float32(self._idf(df))
                 ids, tfs = p.arrays()
-                # df over LIVE postings only: a tombstoned slot (re-index
-                # leaves one) must not inflate df — with few docs that
-                # flips idf negative and hits get min_score-filtered
+                # postings append in strictly increasing slot order, so
+                # membership is a binary search, not a scan
+                want_idx = sorted(want)
+                pos = np.searchsorted(ids, want_idx)
+                for idx, j in zip(want_idx, pos):
+                    if j >= ids.size or int(ids[j]) != idx:
+                        continue
+                    eid = want[idx]
+                    tf = np.float32(tfs[j])
+                    dl = np.float32(self._doc_len[idx])
+                    tf_norm = tf * np.float32(K1 + 1.0) / (
+                        tf + np.float32(K1) * np.float32(1.0 - B + B * dl / avgdl))
+                    out[eid] = float(np.float32(out.get(eid, 0.0))
+                                     + idf * tf_norm)
+            return out
+
+    def csr_snapshot(self) -> Dict[str, object]:
+        """Flatten the live postings into CSR arrays for the device
+        scorer (device_bm25.py): sorted terms, per-term offset ranges
+        over (doc_row, tf) columns in live-row space, plus doc lengths
+        and row ext ids. Tombstoned slots are dropped and slot ids are
+        remapped to a dense 0..n_live row space."""
+        with self._lock:
+            doc_len, alive = self._np_state()
+            rows = np.nonzero(alive)[0] if len(self._ext_ids) else \
+                np.zeros((0,), dtype=np.int64)
+            n_slots = len(self._ext_ids)
+            remap = np.full(n_slots, -1, dtype=np.int32)
+            remap[rows] = np.arange(len(rows), dtype=np.int32)
+            terms = sorted(self._postings)
+            doc_parts: List[np.ndarray] = []
+            tf_parts: List[np.ndarray] = []
+            offsets = np.zeros(len(terms) + 1, dtype=np.int64)
+            total = 0
+            for ti, t in enumerate(terms):
+                ids, tfs = self._postings[t].arrays()
                 live = alive[ids]
-                ids, tfs = ids[live], tfs[live]
-                df = int(ids.size)
-                if df == 0:
-                    continue
-                idf = self._idf(df)
-                dl = doc_len[ids]
-                tf_norm = tfs * (K1 + 1.0) / (tfs + K1 * (1.0 - B + B * dl / avgdl))
-                scores[ids] += idf * tf_norm
-                touched[ids] = True
-            mask = touched & alive
-            cand = np.nonzero(mask)[0]
-            if cand.size == 0:
-                return []
-            order = cand[np.argsort(-scores[cand], kind="stable")][:k]
-            return [(self._ext_ids[i], float(scores[i])) for i in order]
+                doc_parts.append(remap[ids[live]])
+                tf_parts.append(tfs[live])
+                total += int(live.sum())
+                offsets[ti + 1] = total
+            return {
+                "gen": self._mut_gen,
+                "compactions": self.compactions,
+                "terms": terms,
+                "vocab": {t: i for i, t in enumerate(terms)},
+                "offsets": offsets,
+                "post_doc": (np.concatenate(doc_parts)
+                             if doc_parts else np.zeros(0, np.int32)),
+                "post_tf": (np.concatenate(tf_parts).astype(np.float32)
+                            if tf_parts else np.zeros(0, np.float32)),
+                "doc_len": doc_len[rows].astype(np.float32),
+                "row_ids": [self._ext_ids[int(s)] for s in rows],
+                # original slot per row: consumers live-filter by SLOT
+                # (an update tombstones the old slot while the ext id
+                # stays live at a new one)
+                "slots": rows.astype(np.int64),
+            }
+
+    def alive_slots(
+        self, slots: Sequence[int],
+        expect_compactions: Optional[int] = None,
+    ) -> Optional[np.ndarray]:
+        """Bool per slot id: still live? Slot ids are only meaningful in
+        the slot space they were snapshotted from, so the read and the
+        compaction check happen under ONE lock hold: when
+        ``expect_compactions`` no longer matches (a compaction remapped
+        slots since the snapshot), returns None and the caller must
+        fall back rather than trust resurrected slot ids."""
+        with self._lock:
+            if expect_compactions is not None \
+                    and self.compactions != expect_compactions:
+                return None
+            n = len(self._alive)
+            return np.asarray(
+                [0 <= s < n and self._alive[int(s)] for s in slots],
+                dtype=bool)
 
     # -- seed selection (BM25-seeded builds) ------------------------------
 
@@ -236,8 +438,10 @@ class BM25Index:
             if self._n_alive == 0:
                 return []
             ranked_terms = []
-            for t, p in self._postings.items():
-                df = sum(1 for i in p.doc_ids if self._alive[i])
+            for t in self._postings:
+                # incremental live-df counter: O(1) per term instead of
+                # the old O(postings) alive-scan per term per call
+                df = self._df.get(t, 0)
                 if df < 2:  # hapax terms don't discriminate clusters
                     continue
                 ranked_terms.append((self._idf(df), t))
@@ -278,11 +482,23 @@ class BM25Index:
         idx._int_of = {
             e: i for i, e in enumerate(idx._ext_ids) if idx._alive[i]
         }
+        terms_per_doc: List[List[str]] = [[] for _ in idx._ext_ids]
         for t, p in d["postings"].items():
             post = _Posting()
             post.doc_ids = list(p["ids"])
             post.tfs = list(p["tfs"])
             idx._postings[t] = post
+            df = 0
+            for did in post.doc_ids:
+                if idx._alive[did]:
+                    df += 1
+                    terms_per_doc[did].append(t)
+            if df:
+                idx._df[t] = df
+        idx._doc_terms = [
+            tuple(ts) if idx._alive[i] else None
+            for i, ts in enumerate(terms_per_doc)
+        ]
         idx._total_len = sum(
             l for l, a in zip(idx._doc_len, idx._alive) if a
         )

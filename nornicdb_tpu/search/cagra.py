@@ -53,6 +53,7 @@ import numpy as np
 from nornicdb_tpu.obs import REGISTRY, record_dispatch
 from nornicdb_tpu.ops.similarity import (
     NEG_INF,
+    concat_topk,
     cosine_topk_auto,
     l2_normalize,
     pad_dim,
@@ -764,10 +765,7 @@ class CagraIndex:
                 hash_bits=self.hash_bits, n_seeds=self.n_seeds)
             parts_s.append(s)
             parts_i.append(i + sh * r)
-        all_s = jnp.concatenate(parts_s, axis=1)
-        all_i = jnp.concatenate(parts_i, axis=1)
-        top_s, pos = jax.lax.top_k(all_s, kb)
-        return top_s, jnp.take_along_axis(all_i, pos, axis=1)
+        return concat_topk(parts_s, parts_i, kb)
 
     def _resolve(self, g, s, i, k_eff):
         """Map walk row ids to ext ids, dropping never-filled slots and

@@ -1,0 +1,515 @@
+"""Fused hybrid search: BM25 + vector + RRF in one compiled pipeline.
+
+Reference: pkg/search Service.Search (search.go:2841) fuses BM25 and
+vector candidate lists with (weighted) RRF — in this repo that fusion,
+and the whole lexical half, ran as host Python under the BM25 lock.
+This module executes the complete hybrid read path on device: one
+jitted program takes a query batch's embeddings and planned lexical
+entries and emits the RRF-fused top-k, with the per-source candidate
+lists along for the ride (the service's min_score gates and result
+payloads need the raw scores).
+
+Pipeline (single compile per pow2 ``(B, k)`` bucket):
+
+1. **lexical** — ``device_bm25.bm25_dense_scores`` over the CSR
+   snapshot -> top-k rows;
+2. **vector** — one MXU matmul over the brute index's device matrix
+   (the same lazily-synced arrays ``BruteForceIndex.search_batch``
+   dispatches against, so the vector side is always write-fresh) ->
+   top-k slots;
+3. **fuse** — the two candidate lists join on a device-resident
+   ``lexical row -> vector slot`` map (docs in both sources must merge
+   into ONE fused candidate), reciprocal-rank weights accumulate in
+   float32 in source-major order — bit-identical to the host
+   ``rrf.rrf_fuse`` — and one final top-k emits the fused ranking.
+   Ties resolve by concatenated position = (source, rank), exactly the
+   host fuse's deterministic ordering.
+
+Sharding row-shards BOTH corpora over the ``data`` mesh axis: each
+shard scores its lexical rows and vector slots locally, one all-gather
++ top-k per source merges shard winners, and the fuse then runs
+replicated — bit-identical to the single-device shard-loop reference
+(same collective pattern as cagra and ``mesh.sharded_cosine_topk``).
+
+Freshness composes the PR 2 ladder: the lexical snapshot rebuilds in
+the background on churn with tombstones alive-filtered (df corrected)
+and adds/updates exact-scored by the host delta side-scan; the vector
+side needs no snapshot (the brute matrix is the live index); the
+row->slot join map re-derives whenever the brute index mutates, so
+compactions can never mis-join. Any freshness gap degrades to the
+host path — never to a wrong answer.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nornicdb_tpu.obs import REGISTRY, record_dispatch
+from nornicdb_tpu.ops.similarity import NEG_INF, l2_normalize
+from nornicdb_tpu.search.bm25 import BM25Index
+from nornicdb_tpu.search.device_bm25 import (
+    DeviceBM25,
+    PlanOverflow,
+    SnapshotStale,
+    bm25_dense_scores,
+)
+from nornicdb_tpu.search.microbatch import pow2_bucket
+from nornicdb_tpu.search.rrf import DEFAULT_RRF_K, rrf_fuse
+from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+_HYB_C = REGISTRY.counter(
+    "nornicdb_hybrid_fused_events_total",
+    "Fused hybrid pipeline dispatches and freshness decisions",
+    labels=("event",))
+
+
+# ---------------------------------------------------------------------------
+# pure device fusion
+# ---------------------------------------------------------------------------
+
+
+def _pad_cols(x: jnp.ndarray, k: int, fill) -> jnp.ndarray:
+    if x.shape[1] >= k:
+        return x
+    pad = jnp.full((x.shape[0], k - x.shape[1]), fill, dtype=x.dtype)
+    return jnp.concatenate([x, pad], axis=1)
+
+
+def rrf_fuse_device(
+    ls: jnp.ndarray,  # [B, kq] lexical scores, NEG_INF padded
+    lid: jnp.ndarray,  # [B, kq] vector slot per lexical hit (-1 = none)
+    lgrow: jnp.ndarray,  # [B, kq] global lexical row ids
+    vs: jnp.ndarray,  # [B, kq] vector scores
+    vi: jnp.ndarray,  # [B, kq] vector slots
+    n_cand: jnp.ndarray,  # [B] per-request candidate depth (overfetch)
+    w_lex: jnp.ndarray,  # [B]
+    w_vec: jnp.ndarray,  # [B]
+    rrf_k: int,
+    c_vec: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted RRF over the concatenated candidate lists. Docs present
+    in both sources join via ``lid`` and keep their FIRST (lexical)
+    position; per-candidate sums accumulate float32 source-major, so the
+    result is bit-identical to host ``rrf_fuse`` on the same lists.
+    Returns (fused scores [B, 2kq], concat positions [B, 2kq])."""
+    b, kq = ls.shape
+    r = jnp.arange(kq)
+    in_cand = r[None, :] < n_cand[:, None]
+    lval = (ls > 0.5 * NEG_INF) & in_cand
+    vval = (vs > 0.5 * NEG_INF) & in_cand
+    # shared candidate id space: vector slot when the lexical doc has a
+    # vector, else a unique id past the vector capacity
+    cid = jnp.concatenate(
+        [jnp.where(lid >= 0, lid, c_vec + lgrow), vi], axis=1)
+    val = jnp.concatenate([lval, vval], axis=1)
+    inv = (rrf_k + 1.0 + r).astype(jnp.float32)
+    w = jnp.concatenate(
+        [w_lex[:, None] / inv[None, :], w_vec[:, None] / inv[None, :]],
+        axis=1)
+    w = jnp.where(val, w, 0.0)
+    match = (cid[:, :, None] == cid[:, None, :]) \
+        & val[:, :, None] & val[:, None, :]
+    # each row of `match` has at most two hits (one per source), so the
+    # einsum sum is a plain two-term float32 add — no reassociation
+    fused = jnp.einsum("bij,bj->bi", match.astype(jnp.float32), w)
+    m2 = jnp.arange(2 * kq)
+    dup = jnp.any(match & (m2[None, None, :] < m2[None, :, None]), axis=2)
+    fused = jnp.where(val & ~dup, fused, NEG_INF)
+    return jax.lax.top_k(fused, 2 * kq)
+
+
+@functools.partial(jax.jit, static_argnames=("kq", "rrf_k"))
+def _fused_single(ptr, urow, sel, post_doc, post_tf, doc_len, alive_f,
+                  l2v, avgdl, qn, vmatrix, vvalid, n_cand, w_lex, w_vec,
+                  kq, rrf_k):
+    c_lex = doc_len.shape[0]
+    c_vec = vmatrix.shape[0]
+    dense = bm25_dense_scores(ptr, urow, sel, post_doc, post_tf,
+                              doc_len, alive_f, avgdl)
+    ls, li = jax.lax.top_k(dense, min(kq, c_lex))
+    vsc = qn @ vmatrix.T
+    vsc = jnp.where(vvalid[None, :], vsc, NEG_INF)
+    vs, vi = jax.lax.top_k(vsc, min(kq, c_vec))
+    ls = _pad_cols(ls, kq, NEG_INF)
+    li = _pad_cols(li, kq, 0)
+    vs = _pad_cols(vs, kq, NEG_INF)
+    vi = _pad_cols(vi, kq, 0)
+    fs, fpos = rrf_fuse_device(ls, l2v[li], li, vs, vi, n_cand,
+                               w_lex, w_vec, rrf_k, c_vec)
+    return ls, li, vs, vi, fs, fpos
+
+
+def _local_parts_impl(ptr, urow, sel, post_doc, post_tf, doc_len,
+                      alive_f, l2v, avgdl, qn, vmatrix, vvalid, lex_off,
+                      vec_off, kq):
+    """One shard's per-source top-k with globalized ids — the building
+    block of both the single-device reference loop and the mesh path."""
+    c_lex = doc_len.shape[0]
+    c_vec = vmatrix.shape[0]
+    dense = bm25_dense_scores(ptr, urow, sel, post_doc, post_tf,
+                              doc_len, alive_f, avgdl)
+    ls, li = jax.lax.top_k(dense, min(kq, c_lex))
+    vsc = qn @ vmatrix.T
+    vsc = jnp.where(vvalid[None, :], vsc, NEG_INF)
+    vs, vi = jax.lax.top_k(vsc, min(kq, c_vec))
+    return ls, l2v[li], li + lex_off, vs, vi + vec_off
+
+
+_local_parts = functools.partial(
+    jax.jit, static_argnames=("kq",))(_local_parts_impl)
+
+
+def _merge_parts(parts, kq):
+    """Concat per-shard (scores, aux...) blocks in shard order and take
+    one top-k, gathering every aux column by the winning positions —
+    the all-gather-equivalent merge layout."""
+    all_s = jnp.concatenate([p[0] for p in parts], axis=1)
+    auxes = [jnp.concatenate([p[j] for p in parts], axis=1)
+             for j in range(1, len(parts[0]))]
+    k = min(kq, all_s.shape[1])
+    top_s, pos = jax.lax.top_k(all_s, k)
+    out = [_pad_cols(top_s, kq, NEG_INF)]
+    for a in auxes:
+        out.append(_pad_cols(jnp.take_along_axis(a, pos, axis=1), kq, 0))
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kq", "rrf_k", "c_vec_total"))
+def _fuse_merged(ls, lid, lgrow, vs, vi, n_cand, w_lex, w_vec, kq,
+                 rrf_k, c_vec_total):
+    return rrf_fuse_device(ls, lid, lgrow, vs, vi, n_cand, w_lex, w_vec,
+                           rrf_k, c_vec_total)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kq", "rrf_k", "mesh_holder"))
+def _fused_sharded_impl(ptr, urow, sel, post_doc, post_tf, doc_len,
+                        alive_f, l2v, avgdl, qn, vmatrix, vvalid,
+                        n_cand, w_lex, w_vec, kq, rrf_k, mesh_holder):
+    from jax.sharding import PartitionSpec as P
+
+    from nornicdb_tpu.parallel.mesh import compat_shard_map
+
+    mesh = mesh_holder.mesh
+    s_n = mesh.shape["data"]
+    c_lex_local = doc_len.shape[0] // s_n
+    c_vec_local = vmatrix.shape[0] // s_n
+    c_vec_total = vmatrix.shape[0]
+
+    def local_fn(ptr_s, urow_s, sel_r, pd_s, pt_s, dl_s, al_s, l2v_s,
+                 avg_r, qn_r, vm_s, vv_s, nc_r, wl_r, wv_r):
+        sh = jax.lax.axis_index("data")
+        ls, lid, lgrow, vs, gvi = _local_parts_impl(
+            ptr_s, urow_s, sel_r, pd_s, pt_s, dl_s, al_s, l2v_s, avg_r,
+            qn_r, vm_s, vv_s, sh * c_lex_local, sh * c_vec_local,
+            kq=kq)
+
+        def gat(x):
+            return jax.lax.all_gather(x, "data", axis=1, tiled=True)
+
+        ls2, lid2, lgrow2 = _merge_parts(
+            [(gat(ls), gat(lid), gat(lgrow))], kq)
+        vs2, vi2 = _merge_parts([(gat(vs), gat(gvi))], kq)
+        fs, fpos = rrf_fuse_device(ls2, lid2, lgrow2, vs2, vi2, nc_r,
+                                   wl_r, wv_r, rrf_k, c_vec_total)
+        return ls2, lgrow2, vs2, vi2, fs, fpos
+
+    return compat_shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P(), P("data"), P("data"),
+                  P("data"), P("data"), P("data"), P(), P(),
+                  P("data", None), P("data"), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P()),
+    )(ptr, urow, sel, post_doc, post_tf, doc_len, alive_f, l2v,
+      avgdl, qn, vmatrix, vvalid, n_cand, w_lex, w_vec)
+
+
+# ---------------------------------------------------------------------------
+# the pipeline object
+# ---------------------------------------------------------------------------
+
+
+class FusedHybrid:
+    """Device-fused hybrid search over a (BM25Index, BruteForceIndex)
+    pair. Stateless beyond the lexical snapshot (owned by
+    :class:`DeviceBM25`) and the lexical-row -> vector-slot join map;
+    both re-derive from the live host indexes, which remain the
+    mutable sources of truth."""
+
+    def __init__(
+        self,
+        bm25: BM25Index,
+        brute: BruteForceIndex,
+        n_shards: int = 1,
+        min_n: int = 256,
+        rebuild_stale_frac: float = 0.1,
+        build_inline: bool = True,
+        rrf_k: int = DEFAULT_RRF_K,
+    ):
+        self.bm25 = bm25
+        self.brute = brute
+        self.rrf_k = rrf_k
+        self.n_shards = max(1, n_shards)
+        self.lex = DeviceBM25(
+            bm25, n_shards=self.n_shards, min_n=min_n,
+            rebuild_stale_frac=rebuild_stale_frac,
+            build_inline=build_inline)
+        self._map_lock = threading.Lock()
+        # sharded placement cache for the brute device arrays, keyed on
+        # the array object identity (BruteForceIndex recreates it on
+        # mutation) — a persistent serving index never re-ships the
+        # corpus across devices per batch
+        self._vec_placed: Optional[Tuple] = None
+
+    def build(self) -> bool:
+        return self.lex.build()
+
+    @property
+    def ready(self) -> bool:
+        return self.lex.snapshot_built
+
+    def ensure(self) -> bool:
+        """Have (or start building) a lexical snapshot; False while the
+        host path must serve."""
+        return self.lex.ensure_snapshot() is not None
+
+    # -- freshness helpers ------------------------------------------------
+
+    def _ensure_map(self, snap: Dict[str, Any], mutations: int):
+        """Device lex-row -> vector-slot map consistent with the brute
+        matrix at generation ``mutations``, or None when a concurrent
+        write/compaction moved the matrix on from the captured view —
+        slots_of pins the read to the expected generation under the
+        brute lock, so a remap can never mis-join silently."""
+        with self._map_lock:
+            if snap.get("l2v_mut") == mutations and "l2v" in snap:
+                return snap["l2v"]
+            ids = ["" if e is None else e for e in snap["row_ids"]]
+            raw = self.brute.slots_of(ids, expect_mutations=mutations)
+            if raw is None:
+                return None
+            slots = np.asarray(raw, dtype=np.int32)
+            dev = jnp.asarray(slots)
+            if "mesh" in snap:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                dev = jax.device_put(
+                    dev, NamedSharding(snap["mesh"],
+                                       PartitionSpec("data")))
+            snap["l2v"] = dev
+            snap["l2v_mut"] = mutations
+            return dev
+
+    def _vec_arrays(self, m, valid, snap):
+        if snap["shards"] == 1 or "mesh" not in snap:
+            return m, valid
+        if m.shape[0] % snap["shards"] != 0:
+            return None, None  # capacity not shardable; caller falls back
+        cached = self._vec_placed
+        if cached is not None and cached[0] is m:
+            return cached[1], cached[2]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = snap["mesh"]
+        mp = jax.device_put(m, NamedSharding(mesh, P("data", None)))
+        vp = jax.device_put(valid, NamedSharding(mesh, P("data")))
+        self._vec_placed = (m, mp, vp)
+        return mp, vp
+
+    # -- search -----------------------------------------------------------
+
+    def search_batch(
+        self,
+        queries_emb: np.ndarray,
+        kq: int,
+        extras: Sequence[Dict[str, Any]],
+    ) -> List[Optional[Dict[str, Any]]]:
+        """One fused dispatch for a coalesced hybrid batch.
+
+        ``extras[i]`` carries the non-stackable half of request i:
+        ``tokens`` (tokenized query), ``n_cand`` (its overfetch depth)
+        and ``w`` ((w_lex, w_vec) fusion weights). Returns one row per
+        query: dict with ``lex``/``vec``/``fused`` ranked lists and the
+        shared stage ``times``, or None when the device path must not
+        serve this batch (caller falls back to the host path)."""
+        b = len(queries_emb)
+        none_rows: List[Optional[Dict[str, Any]]] = [None] * b
+        snap = self.lex.ensure_snapshot()
+        if snap is None:
+            return none_rows
+        delta = self.lex.delta_block(snap)
+        if delta is None:
+            _HYB_C.labels("host_fallback_changelog").inc()
+            self.lex._kick_background_rebuild()
+            return none_rows
+        view = self.brute.device_view()
+        if view is None:
+            return none_rows
+        t_plan0 = time.time()
+        m, valid, vec_ext, mutations, _compactions = view
+        try:
+            l2v = self._ensure_map(snap, mutations)
+            if l2v is None:
+                # a write/compaction moved the brute matrix between the
+                # view capture and the map read — retry next batch
+                _HYB_C.labels("host_fallback_vec_race").inc()
+                return none_rows
+            self.lex.refresh_alive(snap)
+            token_rows = [e["tokens"] for e in extras]
+            ptr, urow, sel, avgdl = self.lex.plan(snap, token_rows, b)
+        except SnapshotStale:
+            _HYB_C.labels("host_fallback_compaction").inc()
+            self.lex._kick_background_rebuild()
+            return none_rows
+        except PlanOverflow:
+            _HYB_C.labels("host_fallback_overflow").inc()
+            return none_rows
+        n_cand = np.asarray(
+            [int(e["n_cand"]) for e in extras], dtype=np.int32)
+        w_lex = np.asarray([e["w"][0] for e in extras], dtype=np.float32)
+        w_vec = np.asarray([e["w"][1] for e in extras], dtype=np.float32)
+        qn = l2_normalize(jnp.asarray(queries_emb, dtype=jnp.float32))
+        args = (jnp.asarray(ptr), jnp.asarray(urow), jnp.asarray(sel),
+                snap["post_doc"], snap["post_tf"], snap["doc_len"],
+                snap["alive"], l2v, jnp.float32(avgdl), qn)
+        tail = (jnp.asarray(n_cand), jnp.asarray(w_lex),
+                jnp.asarray(w_vec))
+        t0 = time.time()
+        if snap["shards"] == 1:
+            ls, li, vs, vi, fs, fpos = _fused_single(
+                *args, jnp.asarray(m), jnp.asarray(valid), *tail,
+                kq=kq, rrf_k=self.rrf_k)
+            lgrow = li
+        elif "mesh" in snap and len(jax.devices()) >= snap["shards"]:
+            mp, vp = self._vec_arrays(m, valid, snap)
+            if mp is None:
+                _HYB_C.labels("host_fallback_unshardable").inc()
+                return none_rows
+            ls, lgrow, vs, vi, fs, fpos = _fused_sharded_impl(
+                *args, mp, vp, *tail, kq=kq, rrf_k=self.rrf_k,
+                mesh_holder=_holder(snap["mesh"]))
+        else:
+            ls, lgrow, vs, vi, fs, fpos = self._shard_loop(
+                snap, args, m, valid, tail, kq)
+        # force to host inside the timed window (async dispatch)
+        ls, lgrow = np.asarray(ls), np.asarray(lgrow)
+        vs, vi = np.asarray(vs), np.asarray(vi)
+        fs, fpos = np.asarray(fs), np.asarray(fpos)
+        t1 = time.time()
+        record_dispatch("hybrid_fused", pow2_bucket(b), kq, t1 - t0)
+        _HYB_C.labels("dispatch").inc()
+        out = self._decode(snap, vec_ext, delta, token_rows, extras,
+                           ls, lgrow, vs, vi, fs, fpos, kq)
+        times = {"plan_s": t0 - t_plan0, "device_t0": t0,
+                 "device_t1": t1, "decode_s": time.time() - t1}
+        for row in out:
+            if row is not None:
+                row["times"] = times
+        return out
+
+    def _shard_loop(self, snap, args, m, valid, tail, kq):
+        """Single-device reference for the sharded layout: run every
+        shard's local parts, merge in shard order (the all-gather
+        layout), fuse once. The mesh path must match this bit-for-bit."""
+        ptr, urow, sel, pd, pt, dl, al, l2v, avgdl, qn = args
+        n_cand, w_lex, w_vec = tail
+        s_n = snap["shards"]
+        c_local = snap["c_local"]
+        p_b = ptr.shape[0] // s_n
+        p_cap = pd.shape[0] // s_n
+        mj, vj = jnp.asarray(m), jnp.asarray(valid)
+        c_vec_local = mj.shape[0] // s_n
+        lex_parts, vec_parts = [], []
+        for sh in range(s_n):
+            ls, lid, lgrow, vvs, gvi = _local_parts(
+                ptr[sh * p_b:(sh + 1) * p_b],
+                urow[sh * p_b:(sh + 1) * p_b],
+                sel,
+                pd[sh * p_cap:(sh + 1) * p_cap],
+                pt[sh * p_cap:(sh + 1) * p_cap],
+                dl[sh * c_local:(sh + 1) * c_local],
+                al[sh * c_local:(sh + 1) * c_local],
+                l2v[sh * c_local:(sh + 1) * c_local],
+                avgdl, qn,
+                mj[sh * c_vec_local:(sh + 1) * c_vec_local],
+                vj[sh * c_vec_local:(sh + 1) * c_vec_local],
+                jnp.int32(sh * c_local), jnp.int32(sh * c_vec_local),
+                kq=kq)
+            lex_parts.append((ls, lid, lgrow))
+            vec_parts.append((vvs, gvi))
+        ls2, lid2, lgrow2 = _merge_parts(lex_parts, kq)
+        vs2, vi2 = _merge_parts(vec_parts, kq)
+        fs, fpos = _fuse_merged(ls2, lid2, lgrow2, vs2, vi2, n_cand,
+                                w_lex, w_vec, kq=kq, rrf_k=self.rrf_k,
+                                c_vec_total=int(mj.shape[0]))
+        return ls2, lgrow2, vs2, vi2, fs, fpos
+
+    def _decode(self, snap, vec_ext, delta, token_rows, extras,
+                ls, lgrow, vs, vi, fs, fpos, kq):
+        row_ids = snap["row_ids"]
+        out: List[Optional[Dict[str, Any]]] = []
+        for r in range(len(extras)):
+            n_cand = int(extras[r]["n_cand"])
+            lex_hits: List[Tuple[str, float]] = []
+            lex_by_pos: Dict[int, str] = {}
+            for c in range(min(kq, ls.shape[1])):
+                if ls[r, c] < 0.5 * NEG_INF or len(lex_hits) >= n_cand:
+                    break
+                eid = row_ids[int(lgrow[r, c])]
+                if eid is None:
+                    continue
+                lex_by_pos[c] = eid
+                lex_hits.append((eid, float(ls[r, c])))
+            vec_hits: List[Tuple[str, float]] = []
+            vec_by_pos: Dict[int, str] = {}
+            for c in range(min(kq, vs.shape[1])):
+                if vs[r, c] < 0.5 * NEG_INF or len(vec_hits) >= n_cand:
+                    break
+                eid = vec_ext[int(vi[r, c])]
+                if eid is None:
+                    continue
+                vec_by_pos[c] = eid
+                vec_hits.append((eid, float(vs[r, c])))
+            if delta:
+                # read-your-writes: exact host scores for post-snapshot
+                # docs, then the (bit-compatible) host fuse over the
+                # merged lists
+                _HYB_C.labels("delta_merge").inc()
+                dset = set(delta)
+                fresh = self.bm25.score_docs(token_rows[r], delta)
+                merged = [(e, s) for e, s in lex_hits if e not in dset]
+                merged.extend(sorted(fresh.items()))
+                merged.sort(key=lambda kv: -kv[1])
+                lex_hits = merged[:n_cand]
+                fused = rrf_fuse([lex_hits, vec_hits],
+                                 weights=list(extras[r]["w"]),
+                                 k=self.rrf_k, limit=n_cand)
+            else:
+                fused = []
+                for c in range(fs.shape[1]):
+                    if fs[r, c] < 0.5 * NEG_INF or len(fused) >= n_cand:
+                        break
+                    pos = int(fpos[r, c])
+                    eid = (lex_by_pos.get(pos) if pos < kq
+                           else vec_by_pos.get(pos - kq))
+                    if eid is None:
+                        continue
+                    fused.append((eid, float(fs[r, c])))
+            out.append({"lex": lex_hits, "vec": vec_hits,
+                        "fused": fused})
+        return out
+
+
+def _holder(mesh):
+    from nornicdb_tpu.parallel.mesh import _MeshHolder
+
+    return _MeshHolder(mesh)

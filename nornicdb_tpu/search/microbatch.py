@@ -144,12 +144,13 @@ class _Item:
 
 
 class _Req:
-    __slots__ = ("vec", "k", "done", "result", "error",
+    __slots__ = ("vec", "k", "extra", "done", "result", "error",
                  "dispatch_t0", "dispatch_t1", "batch_size")
 
-    def __init__(self, vec: np.ndarray, k: int):
+    def __init__(self, vec: np.ndarray, k: int, extra: Any = None):
         self.vec = vec
         self.k = k
+        self.extra = extra
         self.done = False
         self.result: Any = None
         self.error: Any = None
@@ -172,9 +173,19 @@ class MicroBatcher:
         search_batch: Callable[[np.ndarray, int], List[List[Tuple[str, float]]]],
         max_batch: int = 64,
         gather_window_s: float = 0.0005,
+        pass_extras: bool = False,
+        truncate: bool = True,
     ):
         self._search_batch = search_batch
         self._max_batch = max_batch
+        # pass_extras: dispatch as search_batch(queries, k, extras) with
+        # one opaque per-request item (the hybrid path rides tokenized
+        # query terms and per-request fusion options alongside the
+        # stackable embedding rows). truncate=False leaves per-request
+        # result shaping to the dispatch fn (hybrid rows are structured
+        # triples, not plain hit lists).
+        self._pass_extras = pass_extras
+        self._truncate = truncate
         # when the PREVIOUS batch was concurrent, the next leader waits
         # up to this long for stragglers that are mid-return from that
         # batch — without it, mean batch size collapses to ~half the
@@ -188,9 +199,10 @@ class MicroBatcher:
         self.batches = 0
         self.batched_queries = 0
 
-    def search(self, vec: Sequence[float], k: int) -> List[Tuple[str, float]]:
+    def search(self, vec: Sequence[float], k: int,
+               extra: Any = None) -> List[Tuple[str, float]]:
         t_enq = time.time()
-        req = _Req(np.asarray(vec, np.float32), k)
+        req = _Req(np.asarray(vec, np.float32), k, extra)
         with self._cond:
             self._pending.append(req)
         while True:
@@ -272,13 +284,22 @@ class MicroBatcher:
                     queries[0], (bucket - b,) + queries.shape[1:])
                 queries = np.concatenate([queries, pad], axis=0)
             t0 = time.time()
-            results = self._search_batch(queries, k_max)
+            if self._pass_extras:
+                # pad extras like the query rows: repeat request 0's
+                extras = [r.extra for r in batch]
+                extras += [batch[0].extra] * (bucket - b)
+                results = self._search_batch(queries, k_max, extras)
+            else:
+                results = self._search_batch(queries, k_max)
             t1 = time.time()
             record_dispatch("microbatch", bucket, k_max, t1 - t0)
             for r, res in zip(batch, results):
                 r.dispatch_t0, r.dispatch_t1 = t0, t1
                 r.batch_size = b
-                r.result = res[: r.k] if r.k < k_max else res
+                if self._truncate:
+                    r.result = res[: r.k] if r.k < k_max else res
+                else:
+                    r.result = res
         except Exception:  # noqa: BLE001
             # isolate the poison: one malformed request (wrong dims in
             # np.stack, bad k) must not fail its convoy-mates — replay
@@ -288,13 +309,19 @@ class MicroBatcher:
                 try:
                     kb = pow2_bucket(max(r.k, 1))
                     r.dispatch_t0 = time.time()
-                    res = self._search_batch(
-                        np.asarray(r.vec, np.float32)[None, :], kb)[0]
+                    q1 = np.asarray(r.vec, np.float32)[None, :]
+                    if self._pass_extras:
+                        res = self._search_batch(q1, kb, [r.extra])[0]
+                    else:
+                        res = self._search_batch(q1, kb)[0]
                     r.dispatch_t1 = time.time()
                     r.batch_size = 1
                     record_dispatch("microbatch", 1, kb,
                                     r.dispatch_t1 - r.dispatch_t0)
-                    r.result = res[: r.k] if r.k < kb else res
+                    if self._truncate:
+                        r.result = res[: r.k] if r.k < kb else res
+                    else:
+                        r.result = res
                 except Exception as exc:  # noqa: BLE001 — per-request
                     r.error = exc
         for r in batch:

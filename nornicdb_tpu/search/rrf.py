@@ -1,6 +1,13 @@
 """Reciprocal Rank Fusion of BM25 and vector result lists.
 
-Reference: pkg/search RRF fusion inside Service.Search (search.go:2841).
+Reference: pkg/search RRF fusion inside Service.Search (search.go:2841),
+including the weighted variant Service.Search exposes per source.
+
+Tie-breaking is DETERMINISTIC and matches the device fusion kernel
+(search/hybrid_fused.py): candidates with equal fused scores order by
+their first occurrence across (source index, rank within source), then
+id — exactly the concat layout the device top-k resolves ties by, so
+host and device fusion agree rank-for-rank.
 """
 
 from __future__ import annotations
@@ -19,12 +26,29 @@ def rrf_fuse(
     """Fuse ranked lists of (id, score) by reciprocal rank.
 
     score(id) = sum_i w_i / (k + rank_i(id)); ids absent from a list
-    contribute nothing for it. Returns top ``limit`` by fused score."""
+    contribute nothing for it. ``weights`` defaults to 1.0 per source
+    (reference: weighted fusion in Service.Search). Returns top
+    ``limit`` by fused score, ties broken by first occurrence
+    (source order, then rank, then id)."""
+    import numpy as np
+
     if not weights:
         weights = [1.0] * len(result_lists)
-    fused: Dict[str, float] = {}
-    for w, results in zip(weights, result_lists):
+    # float32 accumulation, source-major: the exact arithmetic (and
+    # addition order) of the device fusion kernel, so the two paths
+    # produce bitwise-identical fused scores on identical input lists
+    fused: Dict[str, np.float32] = {}
+    first_seen: Dict[str, Tuple[int, int]] = {}
+    for src, (w, results) in enumerate(zip(weights, result_lists)):
+        w32 = np.float32(w)
         for rank, (doc_id, _score) in enumerate(results):
-            fused[doc_id] = fused.get(doc_id, 0.0) + w / (k + rank + 1)
-    ranked = sorted(fused.items(), key=lambda kv: (-kv[1], kv[0]))
-    return ranked[:limit]
+            contrib = w32 / np.float32(k + rank + 1)
+            fused[doc_id] = np.float32(
+                fused.get(doc_id, np.float32(0.0)) + contrib)
+            if doc_id not in first_seen:
+                first_seen[doc_id] = (src, rank)
+    ranked = sorted(
+        fused.items(),
+        key=lambda kv: (-kv[1], first_seen[kv[0]], kv[0]),
+    )
+    return [(doc_id, float(s)) for doc_id, s in ranked[:limit]]

@@ -18,8 +18,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from nornicdb_tpu.obs import REGISTRY
-from nornicdb_tpu.search.bm25 import BM25Index
+from nornicdb_tpu.obs import REGISTRY, attach_span
+from nornicdb_tpu.search.bm25 import BM25Index, tokenize
 from nornicdb_tpu.search.hnsw import HNSWIndex
 from nornicdb_tpu.search.rrf import rrf_fuse
 from nornicdb_tpu.search.vector_index import BruteForceIndex
@@ -174,6 +174,15 @@ class SearchService:
         # built, else brute), so the coalescing window feeds whichever
         # device index the strategy machine currently owns
         self._microbatch = MicroBatcher(self._ann_search_batch)
+        # fused hybrid pipeline (hybrid_fused.py): concurrent hybrid
+        # searches coalesce here into ONE device dispatch that scores
+        # BM25 + cosine + RRF end-to-end, instead of convoying on the
+        # BM25 lock. Tokens/fusion options ride as extras; rows come
+        # back pre-shaped, so the batcher neither stacks nor truncates
+        # them (pass_extras/truncate flags).
+        self._fused = None
+        self._hybrid_batch = MicroBatcher(
+            self._fused_hybrid_dispatch, pass_extras=True, truncate=False)
 
     def _ann_search_batch(self, queries, k):
         """Batched device dispatch for the micro-batcher: the CAGRA
@@ -182,6 +191,80 @@ class SearchService:
         if cagra is not None:
             return cagra.search_batch(queries, k)
         return self.vectors.search_batch(queries, k)
+
+    def _fused_hybrid_dispatch(self, queries, k_max, extras):
+        """Batched device dispatch of the hybrid batcher: one compiled
+        BM25+vector+RRF program per pow2 (B, k) bucket. None rows tell
+        riders to fall back to the host hybrid path."""
+        fused = self._fused
+        if fused is None:
+            return [None] * len(queries)
+        return fused.search_batch(queries, k_max, extras)
+
+    def _ensure_fused(self):
+        """Resolve (building if needed) the fused hybrid pipeline, or
+        None while the host path must serve. Env-gated like the ANN
+        profiles: NORNICDB_HYBRID_FUSED (default on),
+        NORNICDB_HYBRID_MIN_N corpus floor, NORNICDB_HYBRID_SHARDS mesh
+        row-sharding, NORNICDB_HYBRID_INLINE_BUILD for deterministic
+        (blocking) first builds in tests/benches."""
+        from nornicdb_tpu.config import env_bool, env_int
+
+        if not env_bool("HYBRID_FUSED", True):
+            self._fused = None
+            return None
+        min_n = env_int("HYBRID_MIN_N", 4096)
+        if len(self.bm25) < min_n or len(self.vectors) == 0:
+            self._fused = None
+            return None
+        f = self._fused
+        if f is None or f.bm25 is not self.bm25 \
+                or f.brute is not self.vectors:
+            # index reload swapped the underlying objects: re-wrap so
+            # the pipeline can never serve a discarded corpus
+            from nornicdb_tpu.search.hybrid_fused import FusedHybrid
+
+            f = FusedHybrid(
+                self.bm25, self.vectors,
+                n_shards=max(1, env_int("HYBRID_SHARDS", 1)),
+                min_n=min_n,
+                build_inline=env_bool("HYBRID_INLINE_BUILD", False))
+            self._fused = f
+        if not f.ensure():
+            return None  # first build runs in background; host serves
+        return f
+
+    def _fused_hybrid_trio(self, query, qv, overfetch, weights):
+        """One coalesced fused-hybrid ride: (lex, vec, fused) candidate
+        lists for this query, or None when the host path must serve.
+        Fail-open — any device-path error degrades to host, never to a
+        failed search."""
+        f = self._ensure_fused()
+        if f is None:
+            return None
+        w = tuple(weights) if weights else (1.0, 1.0)
+        if len(w) != 2:
+            return None  # host rrf_fuse handles exotic weight shapes
+        try:
+            trio = self._hybrid_batch.search(
+                qv, overfetch,
+                extra={"tokens": tuple(tokenize(query)),
+                       "n_cand": overfetch, "w": w})
+        except Exception:
+            return None
+        if trio is None:
+            return None
+        _STRATEGY_C.labels("hybrid_fused").inc()
+        t = trio.get("times")
+        if t:
+            # the whole lexical+vector scoring ran inside one device
+            # dispatch; split the trace at the decode boundary so
+            # /admin/traces shows the hybrid ladder per request
+            attach_span("lexical.score", t["device_t0"] - t["plan_s"],
+                        t["device_t1"])
+            attach_span("fuse", t["device_t1"],
+                        t["device_t1"] + t["decode_s"])
+        return trio
 
     def _clear_result_cache(self) -> None:
         self._result_cache.bump_generation()
@@ -376,6 +459,7 @@ class SearchService:
             # any prior graph wraps the REPLACED brute index — drop it
             # or searches would keep serving the discarded corpus
             self.cagra = None
+            self._fused = None  # same: the fused pipeline re-wraps lazily
             self._saved_at_ms = int(meta.get("saved_at_ms", 0))
             self.stats.indexed_docs = len(self.bm25)
             self.stats.indexed_vectors = len(self.vectors)
@@ -585,11 +669,19 @@ class SearchService:
         min_score: float = 0.0,
         enrich: bool = True,
         labels: Optional[Sequence[str]] = None,
+        weights: Optional[Sequence[float]] = None,
     ) -> List[Dict[str, Any]]:
         """Hybrid search (reference: Service.Search search.go:2841):
-        BM25 + vector candidate lists fused with RRF, enriched from storage.
-        Results are cached by query+options (reference: search.go:2853-2856
-        cacheKey Get/Put) and invalidated on any index mutation."""
+        BM25 + vector candidate lists fused with (optionally weighted)
+        RRF, enriched from storage. On large corpora the whole hybrid
+        candidate stage — lexical scoring, vector scoring and the RRF
+        fuse — runs as ONE compiled device program per coalesced batch
+        (hybrid_fused.py); the host path below is the exact fallback
+        and the small-corpus fast path. Results are cached by
+        query+options (reference: search.go:2853-2856 cacheKey Get/Put)
+        and invalidated on any index mutation. ``weights`` is the
+        per-source (lexical, vector) RRF weighting of the reference's
+        weighted fusion; None means (1.0, 1.0)."""
         self.stats.searches += 1
         # opt-in per-stage timing diagnostics (reference:
         # NORNICDB_SEARCH_DIAG_TIMINGS, server_nornicdb.go:282-286);
@@ -607,7 +699,8 @@ class SearchService:
         cache_key = None
         if query_embedding is None and self.reranker is None:
             cache_key = (query, limit, mode, min_score, enrich,
-                         tuple(labels) if labels else None)
+                         tuple(labels) if labels else None,
+                         tuple(weights) if weights else None)
             cached = self._result_cache.get_hits(cache_key)
             if cached is not None:
                 self.stats.cache_hits += 1
@@ -618,11 +711,6 @@ class SearchService:
         overfetch = max(limit * 3, 30)
         bm25_hits: List[Tuple[str, float]] = []
         vec_hits: List[Tuple[str, float]] = []
-        if mode in ("hybrid", "text") and query:
-            bm25_hits = self.bm25.search(query, overfetch)
-        if diag:
-            timings["bm25_ms"] = (time.perf_counter() - t0) * 1e3
-            t0 = time.perf_counter()
         qv = None
         if mode in ("hybrid", "vector"):
             qv = (
@@ -632,6 +720,26 @@ class SearchService:
             )
             if diag:
                 timings["embed_ms"] = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+        trio = None
+        if mode == "hybrid" and query and qv is not None \
+                and len(self.vectors) > 0:
+            # fused device path: concurrent hybrid searches coalesce
+            # into one compiled BM25+vector+RRF dispatch. None = the
+            # pipeline isn't (yet/any longer) eligible — host serves.
+            trio = self._fused_hybrid_trio(query, qv, overfetch, weights)
+        if trio is not None:
+            bm25_hits, vec_hits = trio["lex"], trio["vec"]
+            if diag:
+                timings["fused_ms"] = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+        else:
+            if mode in ("hybrid", "text") and query:
+                t_lex = time.time()
+                bm25_hits = self.bm25.search(query, overfetch)
+                attach_span("lexical.score", t_lex, time.time())
+            if diag:
+                timings["bm25_ms"] = (time.perf_counter() - t0) * 1e3
                 t0 = time.perf_counter()
             if qv is not None and len(self.vectors) > 0:
                 vec_hits = self.vector_search_candidates(
@@ -643,7 +751,16 @@ class SearchService:
                 t0 = time.perf_counter()
 
         if bm25_hits and vec_hits:
-            fused = rrf_fuse([bm25_hits, vec_hits], limit=overfetch)
+            # the fused trio already carries the device-fused ranking;
+            # the host fuse is bit-compatible with it (rrf.py)
+            if trio is not None:
+                fused = trio["fused"]
+            else:
+                t_fuse = time.time()
+                fused = rrf_fuse([bm25_hits, vec_hits],
+                                 weights=list(weights) if weights else (),
+                                 limit=overfetch)
+                attach_span("fuse", t_fuse, time.time())
         elif bm25_hits:
             fused = bm25_hits[:overfetch]
         else:
@@ -652,6 +769,7 @@ class SearchService:
             timings["fuse_ms"] = (time.perf_counter() - t0) * 1e3
             t0 = time.perf_counter()
 
+        t_rerank = time.time()
         bm = dict(bm25_hits)
         vs = dict(vec_hits)
         out: List[Dict[str, Any]] = []
@@ -692,6 +810,8 @@ class SearchService:
                                            query_embedding=qv)
             except Exception:
                 out = out[:limit]  # fail-open (reference: llm_rerank.go)
+        attach_span("rerank", t_rerank, time.time(),
+                    reranker=self.reranker is not None)
         if diag:
             timings["enrich_rerank_ms"] = (time.perf_counter() - t0) * 1e3
             self.stats.last_timings = timings

@@ -77,6 +77,8 @@ class BruteForceIndex:
         self._dev_matrix = None
         self._dev_valid = None
         self._dirty = True
+        # (mutations, ext_ids copy) memo for device_view consumers
+        self._view_ids_cache = None
 
     def __len__(self) -> int:
         return self._n_alive
@@ -239,6 +241,22 @@ class BruteForceIndex:
                 return None
             return self._matrix[slot].copy()
 
+    def slots_of(
+        self, ext_ids: Sequence[str],
+        expect_mutations: Optional[int] = None,
+    ) -> Optional[List[int]]:
+        """Current matrix slot per ext id (-1 when absent). Slot ids
+        only mean anything relative to a specific matrix state, so the
+        read and the staleness check share one lock hold: when
+        ``expect_mutations`` no longer matches (a write or compaction
+        landed since the caller captured its device view), returns None
+        — joining fresh slots against an older matrix would mis-join."""
+        with self._lock:
+            if expect_mutations is not None \
+                    and self.mutations != expect_mutations:
+                return None
+            return [self._slot_of.get(e, -1) for e in ext_ids]
+
     # -- search -----------------------------------------------------------
 
     def _device_arrays(self):
@@ -247,6 +265,25 @@ class BruteForceIndex:
             self._dev_valid = jnp.asarray(self._valid)
             self._dirty = False
         return self._dev_matrix, self._dev_valid
+
+    def device_view(self):
+        """Consistent device-side view for external batched kernels (the
+        fused hybrid pipeline): (matrix[C,D], valid[C], ext_ids,
+        mutations, compactions) captured atomically, or None while the
+        index is empty. The matrix/valid arrays are the same lazily
+        synced device cache ``search_batch`` dispatches against; the
+        ext_ids copy is memoized per mutation generation so a steady
+        read stream doesn't re-copy a capacity-sized list per batch."""
+        with self._lock:
+            if self._n_alive == 0 or self._matrix is None:
+                return None
+            m, valid = self._device_arrays()
+            cached = self._view_ids_cache
+            if cached is None or cached[0] != self.mutations:
+                cached = (self.mutations, list(self._ext_ids))
+                self._view_ids_cache = cached
+            return m, valid, cached[1], self.mutations, \
+                self.compactions
 
     def search(
         self, query: Sequence[float], k: int = 10
@@ -262,7 +299,10 @@ class BruteForceIndex:
         out: List[List[Tuple[str, float]]] = []
         for row in range(scores.shape[0]):
             top = np.argpartition(-scores[row], k_eff - 1)[:k_eff]
-            top = top[np.argsort(-scores[row][top])]
+            # exact-tie order is lower-slot-first, matching lax.top_k on
+            # the device path (hybrid parity relies on it); lexsort's
+            # primary key is the last one
+            top = top[np.lexsort((top, -scores[row][top]))]
             hits = []
             for idx in top:
                 if not np.isfinite(scores[row, idx]):

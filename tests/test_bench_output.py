@@ -42,6 +42,12 @@ def _fake_result():
                           "speedup_vs_brute": 2.0,
                           "brute_qps": 2050.0,
                           "backend": "cpu"}},
+        "hybrid": {"rank_parity": 1.0, "host_qps": 350.0,
+                   "fused_qps": {"1": 280.0, "16": 1250.0,
+                                 "64": 1380.0},
+                   "speedup_vs_host_b16": 3.5,
+                   "speedup_vs_host_b64": 3.9,
+                   "compile_buckets": 4},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -78,6 +84,11 @@ class TestCompactSummary:
                               "recall_at_10": 0.99,
                               "speedup_vs_brute": 2.0,
                               "backend": "cpu"}
+        # fused hybrid trio (ISSUE 4): qps at serving batch, honest
+        # speedup, and the rank-identity fraction behind it
+        assert s["hybrid"] == {"fused_qps_b16": 1250.0,
+                               "speedup_vs_host": 3.5,
+                               "rank_parity": 1.0}
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -92,6 +103,7 @@ class TestCompactSummary:
         assert s["hnsw_build"]["inserts_per_s"] is None
         assert s["knn"]["b1_qps"] is None
         assert s["cagra"]["qps_at_recall95"] is None
+        assert s["hybrid"]["fused_qps_b16"] is None
         assert s["latency_ms"] == {}
         assert s["tpu_proof"] is None
 
@@ -147,8 +159,8 @@ class TestBenchDryRunArtifactSchema:
     default suite here first)."""
 
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
-                    "knn", "northstar", "ann", "surfaces", "telemetry",
-                    "tpu_proof")
+                    "knn", "northstar", "ann", "hybrid", "surfaces",
+                    "telemetry", "tpu_proof")
 
     def test_dry_run_artifact_schema(self):
         import os
@@ -188,6 +200,19 @@ class TestBenchDryRunArtifactSchema:
         assert len(cagra["sweep"]) == 3
         assert "qps_at_recall95" in cagra and "speedup_vs_brute" in cagra
         assert full["ann"]["cagra"]["backend"] == "cpu"
+
+        # the fused hybrid stage: schema-complete at toy sizes, with
+        # the quality gate (rank parity vs the host reference) and all
+        # three serving batch shapes measured
+        hyb = full["hybrid"]
+        assert hyb["built"] is True
+        assert hyb["rank_parity"] == 1.0
+        assert hyb["host_qps"] > 0
+        for b in ("1", "16", "64"):
+            assert hyb["fused_qps"][b] > 0, b
+        assert "speedup_vs_host_b16" in hyb
+        assert hyb["compile_buckets"] >= 1
+        assert hyb["backend"] == "cpu"
 
         # every surface measured, and the new framework-floor fields
         surf = full["surfaces"]
