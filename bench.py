@@ -273,15 +273,19 @@ _TELEMETRY_HEADLINES = {
 
 def _bench_telemetry():
     """Read the in-process telemetry registry populated by the surfaces
-    stage: per-series latency percentiles plus the device compile
-    universe actually paid for during the run. Defensive — a failed
-    surfaces stage just yields empty summaries, never an exception."""
+    stage: per-series latency percentiles, the device compile universe
+    actually paid for during the run, and the resource-accounting
+    snapshot (per-index device memory + freshness lag — the artifact
+    records what the run's structures cost in HBM, not just how fast
+    they were). Defensive — a failed surfaces stage just yields empty
+    summaries, never an exception."""
     try:
         from nornicdb_tpu import obs
 
         return {
             "latency": obs.latency_summary(),
             "compile_universe": obs.compile_universe(),
+            "resources": obs.resource_snapshot(),
         }
     except Exception as exc:  # noqa: BLE001 — artifact must always emit
         return {"error": f"{type(exc).__name__}: {exc}"[:400]}

@@ -420,6 +420,11 @@ class HttpServer:
         # public endpoints (no auth)
         if parsed.path == "/health":
             return 200, {"status": "ok"}
+        if parsed.path == "/readyz":
+            # readiness (distinct from liveness): a live node that is
+            # mid-rebuild, near changelog overrun or queue-saturated
+            # should be rotated out of traffic, not restarted
+            return self._readyz()
         if parsed.path == "/metrics":
             return 200, self.metrics.render(self._metric_snapshot())
         if parsed.path == "/" and method == "GET":
@@ -529,6 +534,55 @@ class HttpServer:
         except Exception:
             pass
         return out
+
+    def _readyz(self) -> Tuple[int, Any]:
+        """Readiness verdict from the resource-accounting snapshot:
+        degraded (503) while any registered index has a background
+        rebuild in flight, any changelog is near overrun (the device
+        paths are about to fall back to host-exact serving), or any
+        MicroBatcher queue is saturated past its drain rate. Thresholds:
+        ``NORNICDB_READY_CHANGELOG_FRAC`` (default 0.9) and
+        ``NORNICDB_READY_QUEUE_FACTOR`` (default 1.0 x max_batch)."""
+        from nornicdb_tpu.config import env_float
+
+        changelog_frac = env_float("READY_CHANGELOG_FRAC", 0.9)
+        queue_factor = env_float("READY_QUEUE_FACTOR", 1.0)
+        reasons: List[str] = []
+        checks = {"indexes": 0, "queues": 0, "rebuilds_pending": 0,
+                  "changelogs_near_overrun": 0, "queues_saturated": 0}
+        for entry in obs.resource_snapshot():
+            name = f"{entry['family']}/{entry['index']}"
+            if "queue_depth" in entry and "rows" not in entry:
+                checks["queues"] += 1
+                limit = max(1, int((entry.get("max_batch") or 64)
+                                   * queue_factor))
+                if entry["queue_depth"] >= limit:
+                    checks["queues_saturated"] += 1
+                    reasons.append(
+                        f"queue_saturated:{entry['index']}"
+                        f"({entry['queue_depth']}/{limit})")
+                continue
+            checks["indexes"] += 1
+            if entry.get("rebuild_in_flight"):
+                checks["rebuilds_pending"] += 1
+                reasons.append(f"index_rebuild:{name}")
+            depth = entry.get("changelog_depth")
+            cap = entry.get("changelog_cap")
+            if depth is not None and cap and depth >= changelog_frac * cap:
+                checks["changelogs_near_overrun"] += 1
+                reasons.append(
+                    f"changelog_near_overrun:{name}({depth}/{cap})")
+        # keep the SLO sample ring warm from the probe cadence (the
+        # engine is scrape-driven; kubelet-style periodic readiness
+        # probes give it a steady clock even with /metrics unscraped)
+        try:
+            obs.get_slo_engine().tick()
+        except Exception:
+            pass
+        if reasons:
+            return 503, {"status": "degraded", "reasons": sorted(reasons),
+                         "checks": checks}
+        return 200, {"status": "ready", "checks": checks}
 
     def _debug_profile(self, payload: Dict[str, Any]) -> Tuple[int, Any]:
         """Run one Cypher statement under cProfile; return wall time and
@@ -1131,9 +1185,13 @@ class HttpServer:
                          "traces": obs.TRACES.snapshot(limit=50)}
 
         if action == "telemetry" and method == "GET":
+            # include_empty: brand-new/idle histogram series report
+            # count 0 with null percentiles — never a raise, never a
+            # silent hole in the series list
             doc: Dict[str, Any] = {
-                "latency": obs.latency_summary(),
+                "latency": obs.latency_summary(include_empty=True),
                 "compile_universe": obs.compile_universe(),
+                "resources": obs.resource_snapshot(),
                 "rate_limiter_clients":
                     self.rate_limiter.tracked_clients(),
             }
@@ -1141,6 +1199,20 @@ class HttpServer:
             if svc is not None:
                 doc["microbatch"] = svc.microbatch_stats()
             return 200, doc
+
+        if action == "slo":
+            engine = obs.get_slo_engine()
+            if method == "GET":
+                engine.tick()
+                return 200, engine.status()
+            if method == "POST" and len(segments) > 2 \
+                    and segments[2] == "dump":
+                # manual flight-recorder capture (same artifact a
+                # breach writes automatically)
+                path = engine.dump(reason="manual")
+                self.audit.record(ADMIN_ACTION, "slo_dump",
+                                  actor=username or "", target=path)
+                return 200, {"path": path}
 
         if action == "databases":
             if self.database_manager is None:

@@ -49,8 +49,20 @@ def openapi_spec() -> Dict[str, Any]:
                                 "MCP, and ops endpoints."},
         "paths": {
             "/health": {"get": op("Liveness probe", "ops")},
+            "/readyz": {"get": op(
+                "Readiness probe: 503 while index rebuilds are pending, "
+                "changelogs near overrun, or batching queues saturated",
+                "ops", response={"type": "object", "properties": {
+                    "status": {"type": "string",
+                               "enum": ["ready", "degraded"]},
+                    "reasons": {"type": "array",
+                                "items": {"type": "string"}},
+                    "checks": {"type": "object"}}})},
             "/status": {"get": op("Server status + search stats", "ops")},
             "/metrics": {"get": op("Prometheus metrics", "ops")},
+            "/admin/slo": {"get": op(
+                "SLO budgets + multi-window burn rates per surface "
+                "(admin)", "ops", response={"type": "object"})},
             "/openapi.json": {"get": op("This document", "ops")},
             "/debug/profile": {"post": op(
                 "Profile one Cypher statement (admin)", "ops",

@@ -167,7 +167,13 @@ class QdrantCompat:
                             "created_at": now_ms()},
             ))
         with self._lock:
-            self._space(name).ensure_index()
+            idx = self._space(name).ensure_index()
+        from nornicdb_tpu.obs import register_resource
+
+        # device-memory/freshness gauges from birth; the lazy-rebuild
+        # path (_index after restart/invalidation) re-registers the
+        # replacement index under the same key
+        register_resource("brute", f"qdrant:{name}", idx)
         # collection-list / collection-info responses are wire-cached by
         # the gRPC surfaces against this generation — a create must show
         # up in the next List/Get, same as every other mutation
@@ -496,6 +502,12 @@ class QdrantCompat:
             space = self._space(name)
             if space.index is None:
                 space.index = idx
+            from nornicdb_tpu.obs import register_resource
+
+            # per-collection device-memory/freshness gauges; the metric
+            # family's cardinality cap folds pathological collection
+            # churn into __other__ instead of unbounded series
+            register_resource("brute", f"qdrant:{name}", space.index)
             return space.index
 
     # -- points ----------------------------------------------------------
@@ -773,6 +785,9 @@ class QdrantCompat:
                     lambda queries, k, _n=name:
                         self._ann_search_index(_n).search_batch(queries, k))
                 self._microbatchers[name] = mb
+                from nornicdb_tpu.obs import register_resource
+
+                register_resource("queue", f"qdrant:{name}", mb)
             return mb
 
     def _ann_search_index(self, name: str):
@@ -810,6 +825,9 @@ class QdrantCompat:
                     n_shards=cagra_shards_from_env(p.cagra_shards),
                     build_inline=False)
                 self._cagra[name] = wrap
+                from nornicdb_tpu.obs import register_resource
+
+                register_resource("cagra", f"qdrant:{name}", wrap)
             return wrap
 
     def _ranked_cosine(self, name: str, vector: Sequence[float]):

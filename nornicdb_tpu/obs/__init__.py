@@ -1,4 +1,4 @@
-"""Unified telemetry for the serving stack (ISSUE 3).
+"""Unified telemetry for the serving stack (ISSUE 3 + ISSUE 5).
 
 Dependency-free counters/gauges/histograms with real Prometheus
 exposition, contextvar span tracing with a slow-request ring buffer,
@@ -6,10 +6,17 @@ and the device-dispatch compile-universe instrument. Every hot layer
 records into the process-wide ``REGISTRY``/``TRACES``; the HTTP server
 renders them at ``/metrics`` and ``/admin/traces``.
 
+The operability layer on top (ISSUE 5): ``obs/resources.py`` derives
+per-index device-memory and freshness-lag gauges on scrape from weakly
+registered index/queue objects (the same snapshot gates ``/readyz``),
+and ``obs/slo.py`` computes multi-window SLO burn rates over the
+latency histograms with a breach-triggered JSONL flight recorder.
+
 Overhead discipline: a record call is a branch + dict probe + striped
 add (counters) or bisect + locked bucket increment (histograms); spans
-allocate one small object each. ``set_enabled(False)`` no-ops the whole
-layer — tests/test_observability.py pins the instrumented:bare ratio.
+allocate one small object each; resource/SLO work happens only at
+scrape time. ``set_enabled(False)`` no-ops the whole layer —
+tests/test_observability.py pins the instrumented:bare ratio.
 """
 
 from nornicdb_tpu.obs.dispatch import (
@@ -29,6 +36,12 @@ from nornicdb_tpu.obs.metrics import (
     latency_summary,
     set_enabled,
 )
+from nornicdb_tpu.obs import resources  # noqa: F401 — registers collector
+from nornicdb_tpu.obs import slo  # noqa: F401 — registers collector
+from nornicdb_tpu.obs.resources import register as register_resource
+from nornicdb_tpu.obs.resources import snapshot as resource_snapshot
+from nornicdb_tpu.obs.slo import SloEngine
+from nornicdb_tpu.obs.slo import get_engine as get_slo_engine
 from nornicdb_tpu.obs.tracing import (
     TRACES,
     Span,
@@ -49,6 +62,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "Registry",
+    "SloEngine",
     "Span",
     "TraceBuffer",
     "annotate",
@@ -57,9 +71,14 @@ __all__ = [
     "current_span",
     "enabled",
     "get_registry",
+    "get_slo_engine",
     "latency_summary",
     "record_dispatch",
+    "register_resource",
+    "resource_snapshot",
+    "resources",
     "set_enabled",
+    "slo",
     "span",
     "trace",
 ]

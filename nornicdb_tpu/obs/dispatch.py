@@ -77,6 +77,18 @@ def compile_universe() -> List[Dict[str, Any]]:
     ]
 
 
+def bucket_counts() -> Dict[str, int]:
+    """Distinct compiled (B, k) buckets per dispatch kind — the size of
+    each compile cache. The resource accounting layer exposes this as
+    ``nornicdb_compile_cache_entries{kind=...}``; growth at serve time
+    is the bucket-churn signal the sentinel gates on."""
+    out: Dict[str, int] = {}
+    with _lock:
+        for (kind, _b, _k) in _shapes:
+            out[kind] = out.get(kind, 0) + 1
+    return out
+
+
 def reset() -> None:
     """Test helper: forget the shape universe (registry counters keep
     their monotone totals)."""

@@ -23,6 +23,7 @@ no-op branch — the overhead-guard test measures the delta.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from bisect import bisect_left
@@ -31,6 +32,20 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 _STRIPES = 8
 
 _enabled = True
+
+# label-cardinality cap per metric family: past this many materialized
+# children, new label-value tuples fold into one "__other__" series and
+# tick the dropped-labels counter — per-collection labels (multidb
+# churn, qdrant collections) can then never blow up the exposition
+_DEFAULT_MAX_LABEL_CHILDREN = 256
+
+
+def default_max_label_children() -> int:
+    try:
+        return int(os.environ.get("NORNICDB_OBS_MAX_LABELS",
+                                  _DEFAULT_MAX_LABEL_CHILDREN))
+    except ValueError:
+        return _DEFAULT_MAX_LABEL_CHILDREN
 
 
 def set_enabled(value: bool) -> None:
@@ -180,20 +195,31 @@ class Histogram:
 
 class _Family:
     """One metric name with 0+ label dimensions; children materialize
-    per label-value tuple."""
+    per label-value tuple, capped at ``max_children`` distinct tuples —
+    overflow folds into one ``__other__`` series (and ticks the
+    registry's dropped-labels counter) so client-driven label values
+    can never grow the exposition without bound."""
 
     def __init__(self, name: str, kind: str, help_text: str,
                  label_names: Tuple[str, ...],
-                 make: Callable[[], object]) -> None:
+                 make: Callable[[], object],
+                 max_children: Optional[int] = None,
+                 on_drop: Optional[Callable[[str], None]] = None) -> None:
         self.name = name
         self.kind = kind
         self.help = help_text
         self.label_names = label_names
         self._make = make
+        self._max_children = max_children
+        self._on_drop = on_drop
         self._lock = threading.Lock()
         self._children: Dict[Tuple[str, ...], object] = {}
         if not label_names:
             self._children[()] = make()
+
+    @property
+    def _overflow_key(self) -> Tuple[str, ...]:
+        return ("__other__",) * len(self.label_names)
 
     def labels(self, *values: object):
         key = tuple(str(v) for v in values)
@@ -203,13 +229,40 @@ class _Family:
                 f"got {key}")
         child = self._children.get(key)
         if child is None:
+            dropped = False
             with self._lock:
-                child = self._children.setdefault(key, self._make())
+                child = self._children.get(key)
+                if child is None:
+                    cap = self._max_children
+                    if (cap is not None and key != self._overflow_key
+                            and len(self._children) >= cap):
+                        # fold: the overflow child is exempt from the
+                        # cap so it can always materialize
+                        key = self._overflow_key
+                        child = self._children.get(key)
+                        if child is None:
+                            child = self._children[key] = self._make()
+                        dropped = True
+                    else:
+                        child = self._children[key] = self._make()
+                        dropped = False
+            if dropped and self._on_drop is not None:
+                self._on_drop(self.name)
         return child
+
+    def remove(self, key: Tuple[str, ...]) -> None:
+        """Drop one child series (used by gauge collectors whose label
+        source — an index, a queue — has been garbage-collected, so the
+        exposition doesn't carry dead series forever)."""
+        with self._lock:
+            self._children.pop(tuple(str(v) for v in key), None)
 
     def child(self):
         """The unlabeled child (only valid for label-less families)."""
         return self._children[()]
+
+    def _maybe_child(self):
+        return self._children.get(())
 
     # convenience passthroughs for label-less families
     def inc(self, value: float = 1.0) -> None:
@@ -226,10 +279,17 @@ class _Family:
         return self.child().value
 
     def quantile(self, q: float):
-        return self.child().quantile(q)
+        """None (not a raise) on a labeled family with no unlabeled
+        child or an empty histogram — percentile math over new/idle
+        series must degrade to nulls, never to a 500."""
+        child = self._maybe_child()
+        return None if child is None else child.quantile(q)
 
     def snapshot(self):
-        return self.child().snapshot()
+        child = self._maybe_child()
+        if child is None:
+            return {"buckets": [], "counts": [], "sum": 0.0, "count": 0}
+        return child.snapshot()
 
     def children(self) -> Dict[Tuple[str, ...], object]:
         with self._lock:
@@ -268,12 +328,34 @@ def _fmt_float(v: float) -> str:
 class Registry:
     """Named metric families; ``render()`` emits the Prometheus text
     exposition. get-or-create is idempotent so call sites can resolve
-    their metrics lazily without coordinating registration order."""
+    their metrics lazily without coordinating registration order.
 
-    def __init__(self) -> None:
+    ``max_label_children`` caps the per-family label cardinality
+    (default from ``NORNICDB_OBS_MAX_LABELS``); overflow folds into an
+    ``__other__`` series counted by
+    ``nornicdb_metric_labels_dropped_total{metric=...}``.
+
+    Collectors (``add_collector``) run at the start of every
+    ``render()`` — callback hooks for gauge families whose values are
+    derived on scrape (index memory/freshness accounting, SLO burn
+    rates) rather than maintained on the hot path."""
+
+    def __init__(self, max_label_children: Optional[int] = None) -> None:
         self._lock = threading.Lock()
         self._families: Dict[str, _Family] = {}
+        self._collectors: List[Callable[[], None]] = []
+        self.max_label_children = (
+            default_max_label_children() if max_label_children is None
+            else max_label_children)
         self.started_at = time.time()
+
+    def _note_dropped(self, metric_name: str) -> None:
+        # bounded by the number of families, so this family itself can
+        # never meaningfully overflow its own cap
+        self.counter(
+            "nornicdb_metric_labels_dropped_total",
+            "Label tuples folded into __other__ by the cardinality cap",
+            labels=("metric",)).labels(metric_name).inc()
 
     def _get_or_create(self, name: str, kind: str, help_text: str,
                        label_names: Tuple[str, ...],
@@ -287,7 +369,9 @@ class Registry:
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
-                fam = _Family(name, kind, help_text, label_names, make)
+                fam = _Family(name, kind, help_text, label_names, make,
+                              max_children=self.max_label_children,
+                              on_drop=self._note_dropped)
                 self._families[name] = fam
             return fam
 
@@ -316,7 +400,22 @@ class Registry:
         with self._lock:
             return list(self._families.values())
 
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a scrape must never fail
+                pass
+
     def render(self, extra_gauges: Optional[Dict[str, float]] = None) -> str:
+        self.run_collectors()
         out: List[str] = []
         for fam in sorted(self.families(), key=lambda f: f.name):
             fam.render(out)
@@ -337,23 +436,34 @@ def get_registry() -> Registry:
 
 def latency_summary(registry: Optional[Registry] = None,
                     quantiles: Sequence[float] = (0.5, 0.95, 0.99),
+                    include_empty: bool = False,
                     ) -> Dict[str, Dict[str, float]]:
     """p50/p95/p99 (ms) + count for every ``*_seconds`` histogram
     series — one flat dict keyed ``name{label=value,...}``. Shared by
-    the /admin/telemetry endpoint and bench.py's percentile stage."""
+    the /admin/telemetry endpoint and bench.py's percentile stage.
+
+    ``include_empty=True`` also lists series with zero observations
+    (count 0, null percentiles) — brand-new histograms must read as
+    nulls on the admin surface, never raise or silently vanish."""
     out: Dict[str, Dict[str, float]] = {}
     reg = registry if registry is not None else REGISTRY
     for fam in reg.families():
         if fam.kind != "histogram" or not fam.name.endswith("_seconds"):
             continue
-        for key, child in sorted(fam.children().items()):
+        children = sorted(fam.children().items())
+        if not children and include_empty:
+            out[fam.name] = {"count": 0}
+            for qv in quantiles:
+                out[fam.name][f"p{int(qv * 100)}_ms"] = None
+            continue
+        for key, child in children:
             snap = child.snapshot()
-            if not snap["count"]:
+            if not snap["count"] and not include_empty:
                 continue
             series = fam.name + _fmt_labels(fam.label_names, key)
             entry: Dict[str, float] = {"count": snap["count"]}
             for qv in quantiles:
-                est = child.quantile(qv)
+                est = child.quantile(qv) if snap["count"] else None
                 entry[f"p{int(qv * 100)}_ms"] = (
                     None if est is None else round(est * 1e3, 3))
             out[series] = entry

@@ -1,0 +1,338 @@
+"""SLO engine: multi-window burn rates over the latency histograms.
+
+PR 3 gave every surface a latency histogram; this layer turns those
+cumulative histograms into operable SLO state. Each *objective* is a
+(histogram family, latency threshold, target fraction): "99% of HTTP
+requests complete within 250ms". Good/total counts are read straight
+from the existing bucket counts (the threshold snaps to the nearest
+bucket bound at or below it, so no new instrumentation rides the hot
+path), sampled into a small in-memory ring on every ``tick()`` —
+scrape-driven, no background thread — and differenced over rolling
+windows (default 5m fast / 1h slow).
+
+The **burn rate** of a window is ``bad_fraction / (1 - target)``: 1.0
+burns exactly the whole error budget over the SLO period, 14.4 on the
+fast window is the classic page-now threshold. A breach (fast-window
+burn >= ``breach_fast`` with enough traffic, or slow-window burn >=
+``breach_slow``) triggers the **flight recorder**: one JSONL file with
+the metrics snapshot, latency summary, resource accounting and the
+slow-trace ring — the forensic state that is gone by the time a human
+reads the alert — rate-limited to one dump per ``dump_interval_s``.
+
+Configuration (env):
+
+- ``NORNICDB_SLO_HTTP`` / ``_GRPC`` / ``_BOLT``: ``"<threshold_ms>:
+  <target>"`` (e.g. ``"100:0.999"``), or ``"off"`` to disable one
+  objective.
+- ``NORNICDB_SLO_WINDOWS``: comma-separated window seconds
+  (default ``"300,3600"``).
+- ``NORNICDB_OBS_DUMP_DIR``: flight-recorder directory (default
+  ``<tmp>/nornicdb-flightrec``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from nornicdb_tpu.obs import metrics as _m
+from nornicdb_tpu.obs.metrics import REGISTRY, Registry
+
+
+@dataclass(frozen=True)
+class Objective:
+    name: str           # short surface name ("http", "grpc", ...)
+    family: str         # latency histogram family in the registry
+    threshold_s: float  # a request at or under this latency is "good"
+    target: float       # fraction of requests that must be good
+
+    @property
+    def budget(self) -> float:
+        return max(1.0 - self.target, 1e-9)
+
+
+_DEFAULT_OBJECTIVES: Tuple[Tuple[str, str, float, float], ...] = (
+    ("http", "nornicdb_http_request_seconds", 0.25, 0.99),
+    ("grpc", "nornicdb_grpc_request_seconds", 0.1, 0.99),
+    ("bolt", "nornicdb_bolt_request_seconds", 0.25, 0.99),
+)
+
+
+def _objectives_from_env() -> List[Objective]:
+    out: List[Objective] = []
+    for name, family, thr, target in _DEFAULT_OBJECTIVES:
+        spec = os.environ.get(f"NORNICDB_SLO_{name.upper()}", "")
+        if spec.strip().lower() == "off":
+            continue
+        if spec:
+            try:
+                thr_ms, _, tgt = spec.partition(":")
+                # parse BOTH fields before applying either — a spec
+                # with a valid threshold but junk target must keep the
+                # whole default objective, not half of it
+                new_thr = float(thr_ms) / 1e3
+                new_target = float(tgt) if tgt else target
+                thr, target = new_thr, new_target
+            except ValueError:
+                pass  # malformed spec: keep the default objective
+        out.append(Objective(name, family, thr, target))
+    return out
+
+
+def _windows_from_env() -> Tuple[float, ...]:
+    spec = os.environ.get("NORNICDB_SLO_WINDOWS", "")
+    if spec:
+        try:
+            ws = tuple(sorted(float(x) for x in spec.split(",") if x))
+            if ws:
+                return ws
+        except ValueError:
+            pass
+    return (300.0, 3600.0)
+
+
+def default_dump_dir() -> str:
+    return os.environ.get(
+        "NORNICDB_OBS_DUMP_DIR",
+        os.path.join(tempfile.gettempdir(), "nornicdb-flightrec"))
+
+
+class SloEngine:
+    """Rolling burn-rate computation + breach-triggered flight dumps.
+
+    Thread-safe; all work happens in ``tick()``/``status()`` (called
+    from the scrape/admin/readyz paths), never on a request path."""
+
+    def __init__(
+        self,
+        registry: Optional[Registry] = None,
+        objectives: Optional[List[Objective]] = None,
+        windows: Optional[Tuple[float, ...]] = None,
+        breach_fast: float = 14.4,
+        breach_slow: float = 6.0,
+        min_requests: int = 30,
+        dump_dir: Optional[str] = None,
+        dump_interval_s: float = 300.0,
+        sample_min_interval_s: float = 1.0,
+    ):
+        self.registry = registry if registry is not None else REGISTRY
+        self.objectives = (objectives if objectives is not None
+                           else _objectives_from_env())
+        self.windows = windows if windows is not None else _windows_from_env()
+        self.breach_fast = breach_fast
+        self.breach_slow = breach_slow
+        self.min_requests = min_requests
+        self.dump_dir = dump_dir or default_dump_dir()
+        self.dump_interval_s = dump_interval_s
+        self._sample_min_interval_s = sample_min_interval_s
+        self._lock = threading.Lock()
+        # objective name -> deque of (t, total, good)
+        self._samples: Dict[str, Deque[Tuple[float, int, int]]] = {
+            o.name: deque() for o in self.objectives}
+        self._last_sample_t = 0.0
+        self._last_dump_t = 0.0
+        self.dumps: List[str] = []
+
+    # -- counting ---------------------------------------------------------
+
+    def _counts(self, obj: Objective) -> Tuple[int, int]:
+        """(total, good) across every child of the objective's family.
+        Good = observations in buckets whose bound <= threshold (the le
+        contract: observe() lands a value in the first bound >= it)."""
+        fam = self.registry.get(obj.family)
+        if fam is None or fam.kind != "histogram":
+            return 0, 0
+        total = good = 0
+        for _key, child in fam.children().items():
+            snap = child.snapshot()
+            total += snap["count"]
+            for bound, c in zip(snap["buckets"], snap["counts"]):
+                if bound <= obj.threshold_s:
+                    good += c
+                else:
+                    break
+        return total, good
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Append one (t, total, good) sample per objective; prune past
+        the longest window. Rate-limited so a scrape storm can't bloat
+        the rings. Runs the breach check afterwards."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if now - self._last_sample_t < self._sample_min_interval_s:
+                return
+            self._last_sample_t = now
+            horizon = max(self.windows) * 1.25
+            for obj in self.objectives:
+                total, good = self._counts(obj)
+                ring = self._samples[obj.name]
+                ring.append((now, total, good))
+                while ring and ring[0][0] < now - horizon:
+                    ring.popleft()
+        self.maybe_dump(now=now)
+
+    # -- burn rates -------------------------------------------------------
+
+    def _window_stats(self, obj: Objective, window: float,
+                      now: float) -> Dict[str, Any]:
+        ring = self._samples[obj.name]
+        if not ring:
+            return {"window_s": window, "total": 0, "bad": 0,
+                    "bad_fraction": None, "burn_rate": None,
+                    "complete": False}
+        t_now, tot_now, good_now = ring[-1]
+        start = None
+        for t, tot, good in ring:
+            if t >= now - window:
+                break
+            start = (t, tot, good)
+        if start is None:
+            start = ring[0]
+        t0, tot0, good0 = start
+        total = tot_now - tot0
+        bad = total - (good_now - good0)
+        if total <= 0:
+            return {"window_s": window, "total": 0, "bad": 0,
+                    "bad_fraction": None, "burn_rate": None,
+                    "complete": (t_now - t0) >= window * 0.9}
+        frac = bad / total
+        return {
+            "window_s": window,
+            "total": total,
+            "bad": bad,
+            "bad_fraction": round(frac, 6),
+            "burn_rate": round(frac / obj.budget, 3),
+            # a window is complete once the ring actually spans it —
+            # early-life burn rates are reported but flagged partial
+            "complete": (t_now - t0) >= window * 0.9,
+        }
+
+    def status(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Budgets + per-window burn rates per objective, and the
+        breach verdict — the /admin/slo payload."""
+        now = time.time() if now is None else now
+        out: Dict[str, Any] = {"objectives": {}, "breached": []}
+        with self._lock:
+            for obj in self.objectives:
+                ring = self._samples[obj.name]
+                tot_now, good_now = (ring[-1][1], ring[-1][2]) if ring \
+                    else (0, 0)
+                win = [self._window_stats(obj, w, now)
+                       for w in self.windows]
+                breach = self._breached(win)
+                doc = {
+                    "family": obj.family,
+                    "threshold_ms": round(obj.threshold_s * 1e3, 3),
+                    "target": obj.target,
+                    "error_budget": round(obj.budget, 6),
+                    "total": tot_now,
+                    "bad_total": tot_now - good_now,
+                    "windows": win,
+                    "breached": breach,
+                }
+                out["objectives"][obj.name] = doc
+                if breach:
+                    out["breached"].append(obj.name)
+        out["dump_dir"] = self.dump_dir
+        out["dumps"] = list(self.dumps[-5:])
+        return out
+
+    def _breached(self, window_stats: List[Dict[str, Any]]) -> bool:
+        if not window_stats:
+            return False
+        fast = window_stats[0]
+        if (fast["burn_rate"] is not None
+                and fast["total"] >= self.min_requests
+                and fast["burn_rate"] >= self.breach_fast):
+            return True
+        for slow in window_stats[1:]:
+            if (slow["burn_rate"] is not None
+                    and slow["total"] >= self.min_requests
+                    and slow["complete"]
+                    and slow["burn_rate"] >= self.breach_slow):
+                return True
+        return False
+
+    def breached(self, now: Optional[float] = None) -> List[str]:
+        return self.status(now=now)["breached"]
+
+    # -- flight recorder --------------------------------------------------
+
+    def maybe_dump(self, now: Optional[float] = None) -> Optional[str]:
+        """Write a flight-recorder dump when any objective is breached,
+        at most once per ``dump_interval_s``. Returns the path written
+        (or None)."""
+        now = time.time() if now is None else now
+        status = self.status(now=now)
+        breached = status["breached"]
+        if not breached:
+            return None
+        with self._lock:
+            if now - self._last_dump_t < self.dump_interval_s:
+                return None
+            self._last_dump_t = now
+        # pass the already-computed status through — this path runs on
+        # every tick while degraded, so don't walk the histograms twice
+        return self.dump(reason=f"slo_breach:{','.join(breached)}",
+                         now=now, status=status)
+
+    def dump(self, reason: str = "manual",
+             now: Optional[float] = None,
+             status: Optional[Dict[str, Any]] = None) -> str:
+        """One JSONL flight record: meta, SLO status, metrics/latency/
+        resource snapshots, and the slow-trace ring — everything needed
+        to reconstruct the breach after the fact."""
+        from nornicdb_tpu.obs import resources as _resources
+        from nornicdb_tpu.obs.dispatch import compile_universe
+        from nornicdb_tpu.obs.tracing import TRACES
+
+        now = time.time() if now is None else now
+        os.makedirs(self.dump_dir, exist_ok=True)
+        path = os.path.join(self.dump_dir,
+                            f"flightrec-{int(now * 1e3)}.jsonl")
+        lines: List[Dict[str, Any]] = [
+            {"kind": "meta", "ts": now, "reason": reason},
+            {"kind": "slo", "status": (status if status is not None
+                                       else self.status(now=now))},
+            {"kind": "latency",
+             "summary": _m.latency_summary(self.registry,
+                                           include_empty=True)},
+            {"kind": "resources", "snapshot": _resources.snapshot()},
+            {"kind": "compile_universe", "shapes": compile_universe()},
+        ]
+        for trace in TRACES.slowest(limit=20):
+            lines.append({"kind": "trace", "trace": trace})
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for line in lines:
+                f.write(json.dumps(line, default=str) + "\n")
+        os.replace(tmp, path)
+        self.dumps.append(path)
+        return path
+
+
+_engine: Optional[SloEngine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> SloEngine:
+    """The process-wide engine over the shared REGISTRY, created lazily
+    (env read at first use). Tests build private SloEngine instances."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = SloEngine()
+        return _engine
+
+
+def _collect() -> None:
+    get_engine().tick()
+
+
+REGISTRY.add_collector(_collect)

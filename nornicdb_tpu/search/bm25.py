@@ -102,6 +102,11 @@ class BM25Index:
         # compaction counter: slot ids are only meaningful between
         # compactions, so snapshot consumers pin reads on it
         self.compactions = 0
+        # total posting entries across all terms, maintained
+        # incrementally so the resource-accounting scrape never walks
+        # the vocabulary (tombstones keep their postings until
+        # compaction, which recounts)
+        self._n_postings = 0
 
     def _np_state(self) -> Tuple[np.ndarray, np.ndarray]:
         if self._np_gen != self._mut_gen:
@@ -136,12 +141,41 @@ class BM25Index:
                 p.doc_ids.append(idx)
                 p.tfs.append(c)
                 self._df[t] = self._df.get(t, 0) + 1
+            self._n_postings += len(counts)
             self._doc_terms.append(tuple(counts))
             self._log_change_locked(doc_id)
 
+    def changelog_cap(self) -> int:
+        """Current changelog length cap (mirrors _log_change_locked's
+        trim) — reported next to depth by the accounting layer."""
+        return max(4096, len(self._ext_ids) // 4)
+
+    def resource_stats(self) -> Dict[str, float]:
+        """Memory + freshness accounting for obs/resources.py: postings
+        footprint (incremental entry count — never an O(vocab) walk),
+        tombstone pressure, and changelog depth vs cap."""
+        with self._lock:
+            n_slots = len(self._ext_ids)
+            # per posting entry: one int in doc_ids + one in tfs (list
+            # slots + boxed ints ~= 16B each conservatively as arrays)
+            postings_b = self._n_postings * 16
+            return {
+                "rows": self._n_alive,
+                "capacity": n_slots,
+                "device_bytes": 0,  # host index; the CSR snapshot owns HBM
+                "host_bytes": postings_b + n_slots * 24,
+                "dead_fraction": round(
+                    (n_slots - self._n_alive) / max(n_slots, 1), 6),
+                "changelog_depth": len(self._changelog),
+                "changelog_cap": self.changelog_cap(),
+                "mutations": self._mut_gen,
+                "postings": self._n_postings,
+                "terms": len(self._postings),
+            }
+
     def _log_change_locked(self, doc_id: str) -> None:
         self._changelog.append((self._mut_gen, doc_id))
-        limit = max(4096, len(self._ext_ids) // 4)
+        limit = self.changelog_cap()
         if len(self._changelog) > limit:
             cut = len(self._changelog) - limit
             self._changelog_floor = self._changelog[cut - 1][0]
@@ -224,6 +258,8 @@ class BM25Index:
         self._postings = new_postings
         self._df = new_df
         self._doc_terms = new_terms
+        self._n_postings = sum(
+            len(p.doc_ids) for p in new_postings.values())
         self._mut_gen += 1
         self.compactions += 1
         # slots were remapped: every outstanding snapshot marker is now
@@ -503,4 +539,6 @@ class BM25Index:
             l for l, a in zip(idx._doc_len, idx._alive) if a
         )
         idx._n_alive = sum(1 for a in idx._alive if a)
+        idx._n_postings = sum(
+            len(p.doc_ids) for p in idx._postings.values())
         return idx

@@ -412,6 +412,7 @@ class CagraIndex:
         self._graph: Optional[Dict[str, Any]] = None
         self._build_lock = threading.Lock()
         self._rebuilding = False
+        self._rebuild_started = 0.0  # backlog age for /readyz + gauges
         self._rebuild_flag_lock = threading.Lock()
         # (brute.mutations, built_mutations, ids, vectors) — the delta
         # block is identical between searches until a mutation lands, so
@@ -586,6 +587,7 @@ class CagraIndex:
             if self._rebuilding:
                 return
             self._rebuilding = True
+            self._rebuild_started = time.time()
         _CAGRA_C.labels("background_rebuild").inc()
 
         def run():
@@ -593,6 +595,7 @@ class CagraIndex:
                 self.build()  # _build_locked no-ops if already fresh
             finally:
                 self._rebuilding = False
+                self._rebuild_started = 0.0
 
         t = threading.Thread(target=run, name="cagra-rebuild", daemon=True)
         t.start()
@@ -611,6 +614,36 @@ class CagraIndex:
             "degree": self.degree,
             "itopk": self.itopk,
             "iters": g["iters"] if g else None,
+            "builds": self.builds,
+        }
+
+    def resource_stats(self) -> Dict[str, Any]:
+        """Memory + freshness accounting for obs/resources.py: device
+        bytes of the graph arrays (base matrix + fixed-degree adjacency
+        + validity — the reorder maps live in ``adj``), the mutation
+        gap between the live brute index and the built graph, and the
+        background-rebuild backlog state."""
+        g = self._graph
+        dev_b = 0
+        graph_rows = 0
+        if g is not None:
+            for key in ("matrix", "adj", "validf"):
+                dev_b += int(getattr(g[key], "nbytes", 0) or 0)
+            graph_rows = g["n"]
+        mutations = getattr(self._brute, "mutations", 0)
+        gap = (mutations - g["built_mutations"]) if g is not None else 0
+        started = self._rebuild_started
+        return {
+            "rows": graph_rows,
+            "capacity": (g["shards"] * g["rows_per_shard"]) if g else 0,
+            "device_bytes": dev_b,
+            # row_ids table (pointer-sized slots)
+            "host_bytes": 8 * len(g["row_ids"]) if g else 0,
+            "mutation_gap": gap,
+            "rebuild_in_flight": 1.0 if self._rebuilding else 0.0,
+            "rebuild_backlog_s": (
+                round(time.time() - started, 3)
+                if self._rebuilding and started else 0.0),
             "builds": self.builds,
         }
 

@@ -203,6 +203,7 @@ class DeviceBM25:
         self._snap: Optional[Dict[str, Any]] = None
         self._build_lock = threading.Lock()
         self._rebuilding = False
+        self._rebuild_started = 0.0  # backlog age for /readyz + gauges
         self._rebuild_flag_lock = threading.Lock()
         self._alive_lock = threading.Lock()
         self._delta_cache: Optional[Tuple] = None
@@ -326,6 +327,7 @@ class DeviceBM25:
             if self._rebuilding:
                 return
             self._rebuilding = True
+            self._rebuild_started = time.time()
         _LEX_C.labels("background_rebuild").inc()
 
         def run():
@@ -333,6 +335,7 @@ class DeviceBM25:
                 self.build()
             finally:
                 self._rebuilding = False
+                self._rebuild_started = 0.0
 
         t = threading.Thread(target=run, name="device-bm25-rebuild",
                              daemon=True)
@@ -367,6 +370,39 @@ class DeviceBM25:
             "snapshot_built": snap is not None,
             "snapshot_n": snap["n"] if snap else 0,
             "shards": snap["shards"] if snap else 0,
+            "builds": self.builds,
+        }
+
+    def resource_stats(self) -> Dict[str, Any]:
+        """Memory + freshness accounting for obs/resources.py: device
+        bytes of the CSR columns (postings doc/tf + doc-len/alive
+        vectors), the mutation-generation gap between the live host
+        index and the snapshot, and the rebuild backlog state."""
+        snap = self._snap
+        dev_b = 0
+        rows = 0
+        capacity = 0
+        if snap is not None:
+            for key in ("post_doc", "post_tf", "doc_len", "alive"):
+                dev_b += int(getattr(snap[key], "nbytes", 0) or 0)
+            rows = snap["n"]
+            capacity = snap["shards"] * snap["c_local"]
+        gen = self.bm25.mut_gen
+        gap = (gen - snap["built_gen"]) if snap is not None else 0
+        started = self._rebuild_started
+        return {
+            "rows": rows,
+            "capacity": capacity,
+            "device_bytes": dev_b,
+            # host-side offset table + row-id/slot columns
+            "host_bytes": (
+                (snap["off_sh"].nbytes + snap["slots"].nbytes
+                 + 8 * len(snap["row_ids"])) if snap is not None else 0),
+            "mutation_gap": gap,
+            "rebuild_in_flight": 1.0 if self._rebuilding else 0.0,
+            "rebuild_backlog_s": (
+                round(time.time() - started, 3)
+                if self._rebuilding and started else 0.0),
             "builds": self.builds,
         }
 

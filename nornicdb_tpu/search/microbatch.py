@@ -199,6 +199,14 @@ class MicroBatcher:
         self.batches = 0
         self.batched_queries = 0
 
+    def queue_depth(self) -> int:
+        """Live pending requests (not yet claimed by a batch leader) —
+        the saturation signal /readyz and the resource gauges read
+        (the threshold itself lives with its env knob in
+        http_server._readyz: depth >= READY_QUEUE_FACTOR x max_batch)."""
+        with self._cond:
+            return len(self._pending)
+
     def search(self, vec: Sequence[float], k: int,
                extra: Any = None) -> List[Tuple[str, float]]:
         t_enq = time.time()

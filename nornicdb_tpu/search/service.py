@@ -183,6 +183,19 @@ class SearchService:
         self._fused = None
         self._hybrid_batch = MicroBatcher(
             self._fused_hybrid_dispatch, pass_extras=True, truncate=False)
+        # resource & freshness accounting (obs/resources.py): register
+        # the index structures and coalescing queues so /metrics carries
+        # their device-memory/staleness gauges and /readyz can gate on
+        # rebuild/backlog/queue state. Weak registration — a dropped
+        # service's series disappear with it.
+        from nornicdb_tpu.obs import register_resource
+
+        register_resource("bm25", f"service:{database}", self.bm25)
+        register_resource("brute", f"service:{database}", self.vectors)
+        register_resource("queue", f"service:{database}:vector",
+                          self._microbatch)
+        register_resource("queue", f"service:{database}:hybrid",
+                          self._hybrid_batch)
 
     def _ann_search_batch(self, queries, k):
         """Batched device dispatch for the micro-batcher: the CAGRA
@@ -230,6 +243,10 @@ class SearchService:
                 min_n=min_n,
                 build_inline=env_bool("HYBRID_INLINE_BUILD", False))
             self._fused = f
+            from nornicdb_tpu.obs import register_resource
+
+            register_resource("device_bm25",
+                              f"service:{self.database}", f.lex)
         if not f.ensure():
             return None  # first build runs in background; host serves
         return f
@@ -455,6 +472,12 @@ class SearchService:
             # space's index IS still the live service index
             self._doc_space.index = vectors
             self.vectors = vectors
+            # re-point the resource gauges at the restored structures
+            from nornicdb_tpu.obs import register_resource
+
+            register_resource("bm25", f"service:{self.database}", bm25)
+            register_resource("brute", f"service:{self.database}",
+                              vectors)
             self.hnsw = hnsw
             # any prior graph wraps the REPLACED brute index — drop it
             # or searches would keep serving the discarded corpus
@@ -552,6 +575,9 @@ class SearchService:
         if not idx.build():
             return
         self.cagra = idx
+        from nornicdb_tpu.obs import register_resource
+
+        register_resource("cagra", f"service:{self.database}", idx)
         # surface the graph index as its own vector space, mirroring the
         # hnsw tier (reference: backend kinds, registry.go:1-60)
         cagra_space = self.vector_registry.get_or_create(

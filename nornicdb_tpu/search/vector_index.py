@@ -144,11 +144,45 @@ class BruteForceIndex:
         # corpus churn) so changed_since() can always reach a live
         # build marker; beyond the cap the floor advances and consumers
         # fall back to a full rebuild/exact path
-        limit = max(4096, self._capacity // 4)
+        limit = self.changelog_cap()
         if len(self._changelog) > limit:
             cut = len(self._changelog) - limit
             self._changelog_floor = self._changelog[cut - 1][0]
             del self._changelog[:cut]
+
+    def changelog_cap(self) -> int:
+        """Current changelog length cap (same formula as the trim in
+        _log_change_locked) — the accounting layer reports depth vs cap
+        so near-overrun is visible before the device paths degrade."""
+        return max(4096, self._capacity // 4)
+
+    def resource_stats(self) -> Dict[str, float]:
+        """Memory + freshness accounting for obs/resources.py: the
+        device/host footprint of the matrix and its mirrors, tombstone
+        pressure, and changelog depth vs cap. One short lock hold."""
+        with self._lock:
+            dims = self.dims or 0
+            matrix_b = self._capacity * dims * 4  # float32
+            valid_b = self._capacity  # bool
+            dev = self._dev_matrix
+            dev_b = 0
+            if dev is not None:
+                dev_b = int(getattr(dev, "nbytes", 0)) + int(
+                    getattr(self._dev_valid, "nbytes", 0) or 0)
+            used = max(self._count, 1)
+            return {
+                "rows": self._n_alive,
+                "capacity": self._capacity,
+                "device_bytes": dev_b,
+                # host mirror + the ext-id slot table (pointer-sized
+                # slots; string payloads are shared with callers)
+                "host_bytes": matrix_b + valid_b + 8 * len(self._ext_ids),
+                "dead_fraction": round(
+                    (self._count - self._n_alive) / used, 6),
+                "changelog_depth": len(self._changelog),
+                "changelog_cap": self.changelog_cap(),
+                "mutations": self.mutations,
+            }
 
     def changed_since(self, seq: int) -> Optional[List[str]]:
         """ext_ids added or UPDATED after mutation ``seq`` (latest first,

@@ -1,0 +1,324 @@
+"""Bench regression sentinel: gate a fresh bench artifact on the
+trajectory.
+
+Perf claims so far lived in prose (PERF.md) and a stack of
+``BENCH_r0*.json`` driver artifacts nobody machine-compared. This tool
+turns the trajectory into a gate: it extracts a canonical metric set
+from a fresh bench run (full result line and/or compact summary line —
+both shapes are understood, as are the driver's ``{n, cmd, rc, tail,
+parsed}`` wrappers), builds a per-metric baseline (median over the
+trajectory, or an explicit ``--baseline`` file), and applies
+**per-stage tolerances**:
+
+- *qps floors* — flag when fresh < tolerance x baseline. Tolerances
+  are per metric: tight for single-process device stages (cypher
+  geomean, kNN), loose for the surface benches whose absolute numbers
+  swing with box contention (the r5/r6 spread is ~7x on bolt);
+- *quality floors* — CAGRA recall@10 and fused-hybrid rank parity have
+  absolute floors plus a max allowed drop vs baseline (a qps win paid
+  for with ranking quality is a regression, not a win);
+- *compile-universe growth* — the fused pipeline's distinct (B, k)
+  bucket count may not grow past baseline + allowance (bucket churn =
+  unbounded XLA compiles at serve time).
+
+Output: one JSON verdict line (exit 1 on regression); with
+``--emit-summary`` the artifact's compact summary is re-emitted as the
+last line with a ``sentinel`` verdict block merged in, so the driver's
+2000-char tail window carries the gate result. ``--save-baseline``
+writes the extracted metrics for synthetic-baseline CI cases
+(tests/test_bench_output.py runs ``bench.py --dry-run`` through this
+tool twice: once self-consistent, once against a 2x-inflated baseline
+that must be flagged).
+
+Usage:
+    python bench.py --dry-run | python scripts/bench_sentinel.py \
+        --baseline baseline.json --emit-summary
+    python scripts/bench_sentinel.py --artifact fresh.json \
+        --trajectory 'BENCH_r0*.json'
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# metric -> ("qps", floor_tolerance) | ("quality", abs_floor, max_drop)
+#         | ("growth", allowance)
+CHECKS: Dict[str, Tuple] = {
+    "cypher_geomean": ("qps", 0.6),
+    "knn_b1_qps": ("qps", 0.6),
+    "knn_concurrent_qps": ("qps", 0.5),
+    "knn_b64_qps": ("qps", 0.5),
+    "cagra_qps95": ("qps", 0.5),
+    "hybrid_fused_qps_b16": ("qps", 0.5),
+    "pagerank_speedup": ("qps", 0.4),
+    # surface benches ride a contended box: r5 vs r6 differ up to ~7x
+    # on identical code, so the floor only catches collapse, not noise
+    "surface_bolt_qps": ("qps", 0.2),
+    "surface_neo4j_http_qps": ("qps", 0.2),
+    "surface_graphql_qps": ("qps", 0.2),
+    "surface_rest_search_qps": ("qps", 0.2),
+    "surface_qdrant_grpc_qps": ("qps", 0.2),
+    "cagra_recall10": ("quality", 0.90, 0.05),
+    "hybrid_rank_parity": ("quality", 0.98, 0.02),
+    "hybrid_compile_buckets": ("growth", 2),
+}
+
+
+def _g(d: Any, *path):
+    for p in path:
+        if not isinstance(d, dict) or p not in d:
+            return None
+        d = d[p]
+    return d
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
+    """Canonical metric set from either artifact shape (the compact
+    summary or the full result line). Missing stages simply yield
+    missing metrics — the comparison skips them."""
+    out: Dict[str, Optional[float]] = {}
+    is_summary = bool(doc.get("summary"))
+    out["cypher_geomean"] = _num(doc.get("value"))
+    knn = doc.get("knn") or {}
+    out["knn_b1_qps"] = _num(knn.get("b1_qps") if is_summary
+                             else knn.get("value"))
+    out["knn_concurrent_qps"] = _num(knn.get("b1_concurrent_qps"))
+    out["knn_b64_qps"] = _num(knn.get("b64_qps"))
+    cagra = (doc.get("cagra") if is_summary
+             else _g(doc, "ann", "cagra")) or {}
+    out["cagra_qps95"] = _num(cagra.get("qps_at_recall95"))
+    out["cagra_recall10"] = _num(cagra.get("recall_at_10"))
+    hyb = doc.get("hybrid") or {}
+    out["hybrid_fused_qps_b16"] = _num(
+        hyb.get("fused_qps_b16") if is_summary
+        else _g(hyb, "fused_qps", "16"))
+    out["hybrid_rank_parity"] = _num(hyb.get("rank_parity"))
+    out["hybrid_compile_buckets"] = _num(hyb.get("compile_buckets"))
+    out["pagerank_speedup"] = _num(
+        doc.get("pagerank_speedup_vs_numpy") if is_summary
+        else _g(doc, "northstar", "pagerank_device", "speedup_vs_numpy"))
+    surfaces = doc.get("surfaces") or {}
+    for name in ("bolt", "neo4j_http", "graphql", "rest_search",
+                 "qdrant_grpc"):
+        entry = surfaces.get(name)
+        if isinstance(entry, list) and entry:
+            out[f"surface_{name}_qps"] = _num(entry[0])
+        elif isinstance(entry, dict):
+            out[f"surface_{name}_qps"] = _num(entry.get("ops_per_s"))
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def _json_docs(text: str) -> List[Dict[str, Any]]:
+    docs: List[Dict[str, Any]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict):
+            docs.append(doc)
+    if not docs:
+        try:
+            doc = json.loads(text)
+            if isinstance(doc, dict):
+                docs.append(doc)
+        except json.JSONDecodeError:
+            pass
+    return docs
+
+
+def docs_from_file(path: str) -> List[Dict[str, Any]]:
+    """Bench-result docs from any artifact file: raw bench output
+    (JSONL), a single JSON doc, or the driver wrapper whose ``parsed``/
+    ``tail`` carry the real lines (the trajectory's BENCH_r0*.json)."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    docs = _json_docs(text)
+    out: List[Dict[str, Any]] = []
+    for doc in docs:
+        if "tail" in doc and "cmd" in doc:  # driver wrapper
+            parsed = doc.get("parsed")
+            if isinstance(parsed, dict):
+                out.append(parsed)
+            out.extend(_json_docs(doc.get("tail") or ""))
+        else:
+            out.append(doc)
+    return out
+
+
+def merge_metrics(docs: List[Dict[str, Any]]) -> Dict[str, float]:
+    """One metric set from a run's doc(s): the full result and the
+    compact summary of the same run fill each other's gaps."""
+    merged: Dict[str, float] = {}
+    for doc in docs:
+        if doc.get("sentinel_baseline"):
+            merged.update({k: v for k, v in doc.get("metrics", {}).items()
+                           if _num(v) is not None})
+            continue
+        for k, v in extract_metrics(doc).items():
+            merged.setdefault(k, v)
+    return merged
+
+
+def baseline_from_runs(runs: List[Dict[str, float]]) -> Dict[str, float]:
+    """Per-metric median across trajectory runs — robust to one loaded
+    or one lucky round."""
+    keys = {k for run in runs for k in run}
+    return {k: statistics.median([run[k] for run in runs if k in run])
+            for k in keys
+            if any(k in run for run in runs)}
+
+
+def compare(fresh: Dict[str, float], baseline: Dict[str, float],
+            overrides: Optional[Dict[str, float]] = None
+            ) -> Dict[str, Any]:
+    """Apply every per-stage check where both sides carry the metric."""
+    overrides = overrides or {}
+    flagged: List[Dict[str, Any]] = []
+    passed: List[str] = []
+    skipped: List[str] = []
+    for metric, spec in CHECKS.items():
+        f = fresh.get(metric)
+        b = baseline.get(metric)
+        if f is None or b is None:
+            skipped.append(metric)
+            continue
+        kind = spec[0]
+        if kind == "qps":
+            tol = overrides.get(metric, spec[1])
+            if b > 0 and f < tol * b:
+                flagged.append({
+                    "metric": metric, "kind": "qps_floor",
+                    "fresh": f, "baseline": b,
+                    "ratio": round(f / b, 3), "tolerance": tol})
+            else:
+                passed.append(metric)
+        elif kind == "quality":
+            abs_floor, max_drop = spec[1], spec[2]
+            floor = max(abs_floor, b - max_drop)
+            if f < floor:
+                flagged.append({
+                    "metric": metric, "kind": "quality_floor",
+                    "fresh": f, "baseline": b, "floor": round(floor, 4)})
+            else:
+                passed.append(metric)
+        elif kind == "growth":
+            allowance = overrides.get(metric, spec[1])
+            if f > b + allowance:
+                flagged.append({
+                    "metric": metric, "kind": "growth_cap",
+                    "fresh": f, "baseline": b,
+                    "cap": b + allowance})
+            else:
+                passed.append(metric)
+    return {
+        "sentinel": True,
+        "verdict": "regression" if flagged else "pass",
+        "checked": len(passed) + len(flagged),
+        "passed": sorted(passed),
+        "flagged": flagged,
+        "skipped": sorted(skipped),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--artifact", default="-",
+                    help="fresh bench output (file or - for stdin)")
+    ap.add_argument("--baseline",
+                    help="explicit baseline file (sentinel_baseline or "
+                         "any artifact shape)")
+    ap.add_argument("--trajectory", nargs="*", default=[],
+                    help="globs of trajectory artifacts "
+                         "(e.g. 'BENCH_r0*.json'); per-metric median "
+                         "becomes the baseline")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="METRIC=FLOAT",
+                    help="override a metric's qps/growth tolerance")
+    ap.add_argument("--save-baseline", metavar="OUT",
+                    help="write the fresh run's metrics as a baseline "
+                         "file and exit")
+    ap.add_argument("--emit-summary", action="store_true",
+                    help="re-emit the artifact's compact summary with "
+                         "the sentinel verdict block merged, as the "
+                         "last line")
+    args = ap.parse_args(argv)
+
+    if args.artifact == "-":
+        fresh_docs = _json_docs(sys.stdin.read())
+    else:
+        fresh_docs = docs_from_file(args.artifact)
+    if not fresh_docs:
+        print(json.dumps({"sentinel": True, "verdict": "error",
+                          "error": "no parseable JSON in artifact"}))
+        return 2
+    fresh = merge_metrics(fresh_docs)
+
+    if args.save_baseline:
+        with open(args.save_baseline, "w", encoding="utf-8") as f:
+            json.dump({"sentinel_baseline": True, "metrics": fresh}, f,
+                      indent=2)
+        print(json.dumps({"sentinel": True, "saved": args.save_baseline,
+                          "metrics": len(fresh)}))
+        return 0
+
+    baseline_runs: List[Dict[str, float]] = []
+    if args.baseline:
+        baseline_runs.append(merge_metrics(docs_from_file(args.baseline)))
+    for pattern in args.trajectory:
+        for path in sorted(glob.glob(pattern)):
+            if args.artifact != "-" and path == args.artifact:
+                continue  # never self-compare inside a glob
+            try:
+                run = merge_metrics(docs_from_file(path))
+            except OSError:
+                continue
+            if run:
+                baseline_runs.append(run)
+    baseline_runs = [r for r in baseline_runs if r]
+    if not baseline_runs:
+        print(json.dumps({"sentinel": True, "verdict": "error",
+                          "error": "no usable baseline metrics"}))
+        return 2
+    baseline = baseline_from_runs(baseline_runs)
+
+    overrides: Dict[str, float] = {}
+    for spec in args.tolerance:
+        name, _, val = spec.partition("=")
+        try:
+            overrides[name] = float(val)
+        except ValueError:
+            pass
+
+    verdict = compare(fresh, baseline, overrides)
+    verdict["baseline_runs"] = len(baseline_runs)
+    if args.emit_summary:
+        summary = next(
+            (d for d in fresh_docs if d.get("summary")), None)
+        print(json.dumps(verdict))
+        if summary is not None:
+            print(json.dumps({**summary, "sentinel": {
+                "verdict": verdict["verdict"],
+                "checked": verdict["checked"],
+                "flagged": [f["metric"] for f in verdict["flagged"]],
+            }}))
+    else:
+        print(json.dumps(verdict))
+    return 1 if verdict["verdict"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,10 +9,34 @@ Pallas, so the first real TPU session can't be burned on a harness bug.
 """
 
 import json
+import os
 import subprocess
 import sys
 
+import pytest
+
 import bench
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SENTINEL = os.path.join(_REPO, "scripts", "bench_sentinel.py")
+
+
+@pytest.fixture(scope="module")
+def dry_run_lines():
+    """One shared ``bench.py --dry-run`` subprocess for every test that
+    needs a real artifact (the schema contract AND the sentinel gate) —
+    the dry run is the expensive part, so it runs once per module."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("NORNICDB_TPU_EMBEDDER", "hash")
+    out = subprocess.run(
+        [sys.executable, bench.__file__, "--dry-run"],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln]
+    assert len(lines) >= 2
+    return lines
 
 
 def _fake_result():
@@ -162,19 +186,8 @@ class TestBenchDryRunArtifactSchema:
                     "knn", "northstar", "ann", "hybrid", "surfaces",
                     "telemetry", "tpu_proof")
 
-    def test_dry_run_artifact_schema(self):
-        import os
-
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env.setdefault("NORNICDB_TPU_EMBEDDER", "hash")
-        out = subprocess.run(
-            [sys.executable, bench.__file__, "--dry-run"],
-            capture_output=True, text=True, timeout=420, env=env,
-        )
-        assert out.returncode == 0, out.stderr[-2000:]
-        lines = [ln for ln in out.stdout.strip().splitlines() if ln]
-        assert len(lines) >= 2
+    def test_dry_run_artifact_schema(self, dry_run_lines):
+        lines = dry_run_lines
         full = json.loads(lines[0])
         summary = json.loads(lines[-1])
 
@@ -241,6 +254,16 @@ class TestBenchDryRunArtifactSchema:
             assert entry["b"] & (entry["b"] - 1) == 0, entry
             assert entry["dispatches"] >= 1
 
+        # the resource-accounting snapshot rides the artifact (ISSUE 5):
+        # the surfaces run stood up real indexes, so at least the
+        # service structures must report their footprint
+        res = full["telemetry"]["resources"]
+        assert isinstance(res, list) and res
+        families = {e["family"] for e in res}
+        assert "brute" in families and "bm25" in families
+        for e in res:
+            assert "error" not in e, e
+
         # compact summary carries the floor too (driver tail window)
         assert summary["summary"] is True
         assert summary["dry_run"] is True
@@ -289,3 +312,99 @@ class TestTpuProofDryRun:
         s = bench._compact_summary(res)
         assert s["tpu_proof"] == {"platform": "axon",
                                   "topk_matches_xla": True, "mfu": 0.41}
+
+
+class TestBenchSentinelGate:
+    """ISSUE 5 CI satellite: the default suite pipes a real
+    ``bench.py --dry-run`` artifact through ``scripts/
+    bench_sentinel.py`` — one self-consistent case that must pass, one
+    injected 2x regression that must be flagged. A silent sentinel
+    schema drift fails here before it can miss a real regression."""
+
+    def _run_sentinel(self, artifact_text, args):
+        out = subprocess.run(
+            [sys.executable, _SENTINEL, *args],
+            input=artifact_text, capture_output=True, text=True,
+            timeout=60,
+        )
+        lines = [ln for ln in out.stdout.strip().splitlines() if ln]
+        return out.returncode, [json.loads(ln) for ln in lines]
+
+    def test_dry_run_passes_against_own_baseline(self, dry_run_lines,
+                                                 tmp_path):
+        artifact = "\n".join(dry_run_lines)
+        base = tmp_path / "baseline.json"
+        rc, docs = self._run_sentinel(
+            artifact, ["--save-baseline", str(base)])
+        assert rc == 0 and docs[-1]["saved"] == str(base)
+        saved = json.loads(base.read_text())
+        assert saved["sentinel_baseline"] is True
+        # the dry run carries the full qps + quality metric set
+        for metric in ("cypher_geomean", "knn_b1_qps", "cagra_qps95",
+                       "cagra_recall10", "hybrid_fused_qps_b16",
+                       "hybrid_rank_parity", "hybrid_compile_buckets",
+                       "surface_qdrant_grpc_qps"):
+            assert metric in saved["metrics"], metric
+        rc, docs = self._run_sentinel(
+            artifact, ["--baseline", str(base), "--emit-summary"])
+        assert rc == 0
+        verdict = docs[0]
+        assert verdict["sentinel"] is True
+        assert verdict["verdict"] == "pass"
+        assert verdict["checked"] >= 8
+        assert verdict["flagged"] == []
+        # the verdict block rides the compact summary as the last line
+        summary = docs[-1]
+        assert summary["summary"] is True
+        assert summary["sentinel"]["verdict"] == "pass"
+
+    def test_injected_2x_regression_is_flagged(self, dry_run_lines,
+                                               tmp_path):
+        artifact = "\n".join(dry_run_lines)
+        base = tmp_path / "baseline.json"
+        rc, _docs = self._run_sentinel(
+            artifact, ["--save-baseline", str(base)])
+        assert rc == 0
+        saved = json.loads(base.read_text())
+        # inject: the baseline claims 2x the throughput the fresh run
+        # achieved — exactly the regression shape the gate must catch
+        inflated = {
+            k: (v * 2 if (k.endswith("_qps")
+                          or k == "cypher_geomean") else v)
+            for k, v in saved["metrics"].items()
+        }
+        base.write_text(json.dumps(
+            {"sentinel_baseline": True, "metrics": inflated}))
+        rc, docs = self._run_sentinel(
+            artifact, ["--baseline", str(base), "--emit-summary"])
+        assert rc == 1
+        verdict = docs[0]
+        assert verdict["verdict"] == "regression"
+        flagged = {f["metric"] for f in verdict["flagged"]}
+        assert "cypher_geomean" in flagged or "knn_b1_qps" in flagged
+        # quality metrics were NOT inflated, so they still pass —
+        # per-stage tolerances, not one global knob
+        assert "hybrid_rank_parity" not in flagged
+        assert "cagra_recall10" not in flagged
+        summary = docs[-1]
+        assert summary["sentinel"]["verdict"] == "regression"
+        assert summary["sentinel"]["flagged"]
+
+    def test_sentinel_passes_real_trajectory_files(self):
+        """The checked-in BENCH_r0*.json trajectory gates cleanly: the
+        newest driver artifact vs the earlier rounds."""
+        import glob
+
+        paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_r0?.json")))
+        assert len(paths) >= 2
+        out = subprocess.run(
+            [sys.executable, _SENTINEL,
+             "--artifact", paths[-1],
+             "--trajectory", os.path.join(_REPO, "BENCH_r0?.json")],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        verdict = json.loads(out.stdout.strip().splitlines()[-1])
+        assert verdict["verdict"] == "pass"
+        assert verdict["checked"] >= 1
+        assert verdict["baseline_runs"] >= 1
