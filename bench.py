@@ -370,6 +370,13 @@ def _compact_summary(result):
             "speedup_vs_host": g(result, "hybrid",
                                  "speedup_vs_host_b16"),
             "rank_parity": g(result, "hybrid", "rank_parity"),
+            # walk tier (ISSUE 6): sub-linear vector half at the
+            # largest swept N, the recall that keeps it honest, and
+            # the measured brute<->walk crossover corpus size
+            "walk_qps_b16": g(result, "hybrid", "walk", "walk_qps_b16"),
+            "walk_recall10": g(result, "hybrid", "walk",
+                               "walk_recall10"),
+            "crossover_n": g(result, "hybrid", "walk", "crossover_n"),
         },
         "pagerank_speedup_vs_numpy": g(result, "northstar",
                                        "pagerank_device",
@@ -1303,6 +1310,10 @@ def _bench_hybrid(tiny: bool = False):
                      if e["kind"] == "hybrid_fused"]
     sp16 = (round(fused_qps["16"] / host_qps, 2)
             if host_qps and fused_qps.get("16") else None)
+    try:
+        walk = _bench_hybrid_walk_sweep(tiny=tiny)
+    except Exception as exc:  # noqa: BLE001 — stage must always emit
+        walk = {"error": f"{type(exc).__name__}: {exc}"[:400]}
     return {
         "n": n, "dims": d, "vocab": n_vocab, "k": limit,
         "overfetch": overfetch,
@@ -1319,6 +1330,132 @@ def _bench_hybrid(tiny: bool = False):
         # bounded compile universe: distinct (B, k) buckets the fused
         # pipeline compiled during this stage
         "compile_buckets": len(hybrid_shapes),
+        # walk tier (ISSUE 6): the corpus-size sweep that locates the
+        # brute-fused <-> walk-fused crossover
+        "walk": walk,
+    }
+
+
+def _bench_hybrid_walk_sweep(tiny: bool = False):
+    """Walk-tier corpus-size sweep (ISSUE 6): at each N, the SAME
+    fused pipeline (one lexical snapshot, one graph) measured twice —
+    walk tier forced on, then off (exact matmul) — plus walk-parity
+    recall@10 of the walk-fused ranking vs the host hybrid reference.
+    The headline pair is at the largest N: walk qps over brute qps
+    (the sub-linear win) and the recall that keeps it honest; the
+    crossover N is the smallest swept corpus where the walk tier
+    outruns the matmul tier."""
+    import jax
+
+    from nornicdb_tpu.search.bm25 import BM25Index, tokenize
+    from nornicdb_tpu.search.hybrid_fused import FusedHybrid
+    from nornicdb_tpu.search.microbatch import pow2_bucket
+    from nornicdb_tpu.search.rrf import rrf_fuse
+    from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+    # clustered corpora (the regime graph ANN serves — same generator
+    # shape as the cagra stage); d below the brute-stage 128 keeps the
+    # 100k graph build inside the stage deadline on CPU
+    sizes = [400, 1_000] if tiny else [20_000, 100_000]
+    d = 32 if tiny else 64
+    n_vocab = 300 if tiny else 4_000
+    nq = 32 if tiny else 64
+    secs = 0.15 if tiny else 1.2
+    limit, overfetch, batch = 10, 30, 16
+    sweep = []
+    for n in sizes:
+        rng = np.random.default_rng(11)
+        vocab = np.asarray([f"w{i}" for i in range(n_vocab)])
+        weights = 1.0 / np.arange(1, n_vocab + 1) ** 0.9
+        weights /= weights.sum()
+        centers = max(8, n // 400)
+        cent = (rng.standard_normal((centers, d)) * 2.0).astype(
+            np.float32)
+        vecs = (cent[rng.integers(0, centers, n)]
+                + rng.standard_normal((n, d)).astype(np.float32))
+        lens = rng.integers(8, 24, n)
+        terms = rng.choice(vocab, size=(n, 24), p=weights)
+        bm25 = BM25Index()
+        brute = BruteForceIndex()
+        for i in range(n):
+            bm25.index(f"d{i}", " ".join(terms[i, :lens[i]]))
+        brute.add_batch([(f"d{i}", vecs[i]) for i in range(n)])
+
+        fh = FusedHybrid(bm25, brute, min_n=1, walk_min_n=1)
+        fh.build()
+        fh.cagra.min_n = 1
+        t0 = time.perf_counter()
+        fh.cagra.build()
+        graph_build_s = time.perf_counter() - t0
+
+        q_texts = [" ".join(rng.choice(vocab,
+                                       size=int(rng.integers(2, 5)),
+                                       p=weights)) for _ in range(nq)]
+        q_embs = (cent[rng.integers(0, centers, nq)]
+                  + rng.standard_normal((nq, d)).astype(np.float32))
+        kq = pow2_bucket(overfetch)
+        extras = [{"tokens": tokenize(q), "n_cand": overfetch,
+                   "w": (1.0, 1.0)} for q in q_texts]
+
+        # walk-parity recall@10: fused walk ranking vs host hybrid.
+        # The gate is only honest if the WALK tier actually served —
+        # a silent veto (underfill, pending build) would measure the
+        # brute tier's trivial parity, so a non-walk tier zeroes the
+        # recall and the sentinel's 0.95 absolute floor flags it.
+        rows = fh.search_batch(q_embs, kq, extras)
+        tier = next((r["tier"] for r in rows if r is not None), None)
+        lex_ref = bm25.search_batch(q_texts, overfetch)
+        vec_ref = brute.search_batch(q_embs, overfetch)
+        hit = 0
+        for qi in range(nq):
+            if lex_ref[qi] and vec_ref[qi]:
+                host = rrf_fuse([lex_ref[qi], vec_ref[qi]],
+                                limit=overfetch)
+            else:
+                host = lex_ref[qi] or vec_ref[qi]
+            host_ids = {e for e, _ in host[:limit]}
+            row = rows[qi]
+            got = ({e for e, _ in row["fused"][:limit]}
+                   if row is not None else set())
+            hit += len(host_ids & got) / max(len(host_ids), 1)
+        recall10 = (hit / nq) if tier == "walk" else 0.0
+
+        def qps(tier_fh):
+            ex = extras[:batch]
+            emb = q_embs[:batch]
+            tier_fh.search_batch(emb, kq, ex)  # warm the compile
+            t0 = time.perf_counter()
+            m = 0
+            while True:
+                tier_fh.search_batch(emb, kq, ex)
+                m += batch
+                if time.perf_counter() - t0 > secs:
+                    break
+            return m / (time.perf_counter() - t0)
+
+        walk_qps = qps(fh)
+        fh.walk_min_n = None  # SAME pipeline, exact matmul tier
+        brute_qps = qps(fh)
+        fh.walk_min_n = 1
+        sweep.append({
+            "n": n, "walk_qps_b16": round(walk_qps, 1),
+            "brute_qps_b16": round(brute_qps, 1),
+            "speedup_walk_vs_brute": (round(walk_qps / brute_qps, 2)
+                                      if brute_qps else None),
+            "walk_recall10": round(recall10, 4),
+            "graph_build_s": round(graph_build_s, 2),
+            "tier": tier,
+        })
+    crossover = next((p["n"] for p in sweep
+                      if p["walk_qps_b16"] > p["brute_qps_b16"]), None)
+    last = sweep[-1]
+    return {
+        "dims": d, "k": limit, "overfetch": overfetch, "batch": batch,
+        "backend": jax.devices()[0].platform,
+        "sweep": sweep,
+        "crossover_n": crossover,
+        "walk_qps_b16": last["walk_qps_b16"],
+        "walk_recall10": last["walk_recall10"],
     }
 
 

@@ -21,6 +21,7 @@ tests/test_observability.py pins the instrumented:bare ratio.
 
 from nornicdb_tpu.obs.dispatch import (
     compile_universe,
+    declare_kind,
     record_dispatch,
 )
 from nornicdb_tpu.obs.metrics import (

@@ -23,6 +23,10 @@ _lock = threading.Lock()
 # (kind, b, k) -> {"dispatches": int, "first_call_s": float,
 #                  "total_s": float}
 _shapes: Dict[Tuple[str, int, int], Dict[str, Any]] = {}
+# kinds announced by their owning module at import — the compile-cache
+# accounting carries these series from process start (a dashboard can
+# tell "tier exists, zero traffic" from "tier doesn't exist")
+_declared: set = set()
 
 _DISPATCH_C = REGISTRY.counter(
     "nornicdb_device_dispatch_total",
@@ -39,6 +43,15 @@ _FIRST_G = REGISTRY.gauge(
     "nornicdb_device_first_call_seconds",
     "Wall time of the first (compiling) call per bucket",
     labels=("kind", "b", "k"))
+
+
+def declare_kind(kind: str) -> None:
+    """Pre-register a dispatch kind in the compile universe. The shape
+    table still fills lazily on first dispatch; declaring only seeds
+    ``bucket_counts`` (-> ``nornicdb_compile_cache_entries{kind=...}``)
+    with a zero entry so the series exists before first traffic."""
+    with _lock:
+        _declared.add(kind)
 
 
 def record_dispatch(kind: str, b: int, k: int, seconds: float) -> None:
@@ -82,8 +95,8 @@ def bucket_counts() -> Dict[str, int]:
     each compile cache. The resource accounting layer exposes this as
     ``nornicdb_compile_cache_entries{kind=...}``; growth at serve time
     is the bucket-churn signal the sentinel gates on."""
-    out: Dict[str, int] = {}
     with _lock:
+        out: Dict[str, int] = {kind: 0 for kind in sorted(_declared)}
         for (kind, _b, _k) in _shapes:
             out[kind] = out.get(kind, 0) + 1
     return out

@@ -42,6 +42,7 @@ Design:
 from __future__ import annotations
 
 import functools
+import itertools
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -50,7 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nornicdb_tpu.obs import REGISTRY, record_dispatch
+from nornicdb_tpu.obs import REGISTRY, declare_kind, record_dispatch
 from nornicdb_tpu.ops.similarity import (
     NEG_INF,
     concat_topk,
@@ -62,6 +63,11 @@ from nornicdb_tpu.search.microbatch import pow2_bucket
 from nornicdb_tpu.search.vector_index import BruteForceIndex, _use_pallas
 
 _HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative hash
+# globally unique graph build sequence (GIL-atomic): consumers cache
+# derived state per graph keyed on this, and a PER-INDEX counter would
+# collide across indexes (two first builds both numbered 1) when a
+# consumer rebinds from one index to another over the same corpus
+_BUILD_SEQ = itertools.count(1)
 
 # freshness machinery events: graph (re)builds, delta side-scans merged
 # into walk results, and the exact-fallback reasons — the counters that
@@ -70,6 +76,8 @@ _CAGRA_C = REGISTRY.counter(
     "nornicdb_cagra_events_total",
     "CAGRA index lifecycle and per-search freshness decisions",
     labels=("event",))
+
+declare_kind("cagra_walk")
 
 
 # ---------------------------------------------------------------------------
@@ -270,6 +278,23 @@ def sharded_cagra_walk(
         queries, matrix, adj, validf, k, iters, width, itopk, hash_bits,
         n_seeds, _MeshHolder(mesh),
     )
+
+
+def merge_delta_hits(
+    hits: Sequence[Tuple[str, float]],
+    delta_ids: Sequence[str],
+    delta_scores,
+    k: int,
+) -> List[Tuple[str, float]]:
+    """One ranked hit list with exact delta scores merged in: an
+    updated id's stale entry is REPLACED (its graph/snapshot score came
+    from the pre-update vector), the list re-sorts score-desc and
+    truncates to ``k``. The single read-your-writes merge semantic
+    shared by the walk index and the walk-fused hybrid tier."""
+    merged = dict(hits)
+    for j, eid in enumerate(delta_ids):
+        merged[eid] = float(delta_scores[j])
+    return sorted(merged.items(), key=lambda kv: -kv[1])[:k]
 
 
 # ---------------------------------------------------------------------------
@@ -525,6 +550,11 @@ class CagraIndex:
             "iters": (self.iters if self.iters is not None
                       else self._auto_iters(n)),
             "built_mutations": mutations,
+            # globally unique build sequence: consumers that cache
+            # derived state per graph (the walk-fused join map) key on
+            # this instead of object identity, which can alias across
+            # a gc'd dict or collide across index instances
+            "build_seq": next(_BUILD_SEQ),
         }
         if s > 1:
             # pre-slice once for the single-device reference merge (a
@@ -603,6 +633,22 @@ class CagraIndex:
     @property
     def graph_built(self) -> bool:
         return self._graph is not None
+
+    # -- external consumers (the walk-fused hybrid tier) ------------------
+
+    def ensure_graph(self) -> Optional[Dict[str, Any]]:
+        """Current graph dict under the index's own rebuild policy
+        (churn kicks a background rebuild; the stale graph keeps
+        serving), or None while callers must use an exact tier."""
+        return self._ensure_graph()
+
+    def delta_block(self, g) -> Tuple[Optional[List[str]],
+                                      Optional[np.ndarray]]:
+        """Public delta accessor for fused pipelines composing their
+        own freshness ladder on this graph: (ids, vectors) added or
+        updated since ``g`` was built, or (None, None) on changelog
+        overrun (callers degrade to an exact tier)."""
+        return self._delta_block(g)
 
     def stats(self) -> Dict[str, Any]:
         g = self._graph
@@ -761,15 +807,8 @@ class CagraIndex:
         walk's entry for an updated id is replaced — its graph score was
         computed from the pre-update vector."""
         ds = qn @ dvecs.T  # rows are stored normalized; exact cosine
-        dset = set(ids)
-        out: List[List[Tuple[str, float]]] = []
-        for r, hits in enumerate(hits_rows):
-            merged = {eid: sc for eid, sc in hits if eid not in dset}
-            for j, eid in enumerate(ids):
-                merged[eid] = float(ds[r, j])
-            top = sorted(merged.items(), key=lambda kv: -kv[1])[:k_eff]
-            out.append(top)
-        return out
+        return [merge_delta_hits(hits, ids, ds[r], k_eff)
+                for r, hits in enumerate(hits_rows)]
 
     def _walk(self, g, qn, kb, n_iters, w, p):
         if g["shards"] == 1:

@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nornicdb_tpu.obs import REGISTRY, record_dispatch
+from nornicdb_tpu.obs import REGISTRY, declare_kind, record_dispatch
 from nornicdb_tpu.ops.similarity import NEG_INF, concat_topk, pad_dim
 from nornicdb_tpu.search.bm25 import B, K1, BM25Index, tokenize
 from nornicdb_tpu.search.microbatch import pow2_bucket
@@ -60,6 +60,8 @@ _LEX_C = REGISTRY.counter(
     "nornicdb_device_bm25_events_total",
     "Device BM25 snapshot lifecycle and per-search freshness decisions",
     labels=("event",))
+
+declare_kind("bm25_score")
 
 
 class PlanOverflow(Exception):
@@ -206,6 +208,7 @@ class DeviceBM25:
         self._rebuild_started = 0.0  # backlog age for /readyz + gauges
         self._rebuild_flag_lock = threading.Lock()
         self._alive_lock = threading.Lock()
+        self._map_lock = threading.Lock()
         self._delta_cache: Optional[Tuple] = None
         self.builds = 0
 
@@ -405,6 +408,59 @@ class DeviceBM25:
                 if self._rebuilding and started else 0.0),
             "builds": self.builds,
         }
+
+    # -- shared snapshot plumbing -----------------------------------------
+
+    def row_map(self, snap: Dict[str, Any], name: str, token: Any,
+                derive) -> Optional[jnp.ndarray]:
+        """Memoized ``snapshot lex row -> foreign row`` device map.
+
+        The fused hybrid tiers join lexical candidates to another
+        index's row space — the brute slot space (``l2v``, matmul tier)
+        or the CAGRA graph row space (``l2g``, walk tier). Both maps
+        live ON the snapshot dict under one lock, keyed by ``token``
+        (the foreign index's generation: brute mutation counter, graph
+        build sequence — MONOTONE integers, which is what lets the
+        publish step below refuse cross-generation overwrites), so a
+        snapshot rebuild drops every map with it and a foreign rebuild
+        rebinds on the next batch instead of surviving stale.
+        ``derive()`` returns the int32 host column or None when the
+        foreign index moved mid-derivation (the caller retries next
+        batch — a stale map can never mis-join silently).
+        """
+        with self._map_lock:
+            maps = snap.setdefault("row_maps", {})
+            cur = maps.get(name)
+            if cur is not None and cur[0] == token:
+                return cur[1]
+        # derive OUTSIDE the lock: the l2g derivation is O(corpus)
+        # host work + a device transfer, and holding the lock for it
+        # would convoy every concurrent batch that only needs to READ
+        # an already-cached map. Racing derivers duplicate rare work;
+        # the double-check below keeps one winner.
+        raw = derive()
+        if raw is None:
+            return None
+        dev = jnp.asarray(np.asarray(raw, dtype=np.int32))
+        if "mesh" in snap:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            dev = jax.device_put(
+                dev, NamedSharding(snap["mesh"],
+                                   PartitionSpec("data")))
+        with self._map_lock:
+            maps = snap.setdefault("row_maps", {})
+            cur = maps.get(name)
+            if cur is not None and cur[0] == token:
+                return cur[1]  # raced another deriver; theirs serves
+            if cur is not None and cur[0] > token:
+                # a newer-generation map was published while we
+                # derived: OUR batch still needs the map matching its
+                # captured view, but storing it would evict the newer
+                # one and force the next batch to re-derive
+                return dev
+            maps[name] = (token, dev)
+            return dev
 
     # -- freshness --------------------------------------------------------
 

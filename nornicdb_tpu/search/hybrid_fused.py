@@ -13,17 +13,32 @@ Pipeline (single compile per pow2 ``(B, k)`` bucket):
 
 1. **lexical** — ``device_bm25.bm25_dense_scores`` over the CSR
    snapshot -> top-k rows;
-2. **vector** — one MXU matmul over the brute index's device matrix
-   (the same lazily-synced arrays ``BruteForceIndex.search_batch``
-   dispatches against, so the vector side is always write-fresh) ->
-   top-k slots;
+2. **vector** — one of two tiers. The **brute tier**: one MXU matmul
+   over the brute index's device matrix (the same lazily-synced arrays
+   ``BruteForceIndex.search_batch`` dispatches against, so the vector
+   side is always write-fresh) -> top-k slots. The **walk tier**
+   (above ``walk_min_n`` live vectors): the jitted CAGRA greedy walk
+   (``cagra._walk_body`` — fixed iterations, fixed ``itopk`` pool)
+   over the device graph — sub-linear per query, which is what moves
+   the corpus ceiling at which fusion wins (arXiv:2308.15136; the
+   fused lexical+graph-ANN+fusion pipeline is the open frontier named
+   by arXiv:2602.16719 §research-directions);
 3. **fuse** — the two candidate lists join on a device-resident
-   ``lexical row -> vector slot`` map (docs in both sources must merge
-   into ONE fused candidate), reciprocal-rank weights accumulate in
-   float32 in source-major order — bit-identical to the host
-   ``rrf.rrf_fuse`` — and one final top-k emits the fused ranking.
-   Ties resolve by concatenated position = (source, rank), exactly the
-   host fuse's deterministic ordering.
+   ``lexical row -> vector row`` map (brute slots for the matmul tier,
+   graph rows for the walk tier; docs in both sources must merge into
+   ONE fused candidate), reciprocal-rank weights accumulate in float32
+   in source-major order — bit-identical to the host ``rrf.rrf_fuse``
+   — and one final top-k emits the fused ranking. Ties resolve by
+   concatenated position = (source, rank), exactly the host fuse's
+   deterministic ordering.
+
+Parity contract per tier: the brute tier is **rank-identical** to the
+host hybrid path (the PR 4 parity corpus). The walk tier is
+approximate by construction, so its contract is **walk-parity**: the
+fused top-k must stay within recall@k tolerance of the host hybrid
+ranking (bench + sentinel gate recall@10 >= 0.95 absolute), and every
+freshness gap degrades DOWN the ladder — walk-fused -> brute-fused ->
+host — never to a wrong answer.
 
 Sharding row-shards BOTH corpora over the ``data`` mesh axis: each
 shard scores its lexical rows and vector slots locally, one all-gather
@@ -43,7 +58,6 @@ host path — never to a wrong answer.
 from __future__ import annotations
 
 import functools
-import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -51,9 +65,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nornicdb_tpu.obs import REGISTRY, record_dispatch
+from nornicdb_tpu.obs import REGISTRY, declare_kind, record_dispatch
 from nornicdb_tpu.ops.similarity import NEG_INF, l2_normalize
 from nornicdb_tpu.search.bm25 import BM25Index
+from nornicdb_tpu.search.cagra import (
+    CagraIndex,
+    _cagra_walk,
+    _walk_body,
+    merge_delta_hits,
+)
 from nornicdb_tpu.search.device_bm25 import (
     DeviceBM25,
     PlanOverflow,
@@ -68,6 +88,9 @@ _HYB_C = REGISTRY.counter(
     "nornicdb_hybrid_fused_events_total",
     "Fused hybrid pipeline dispatches and freshness decisions",
     labels=("event",))
+
+declare_kind("hybrid_fused")
+declare_kind("hybrid_walk_fused")
 
 
 # ---------------------------------------------------------------------------
@@ -129,21 +152,34 @@ def rrf_fuse_device(
 def _fused_single(ptr, urow, sel, post_doc, post_tf, doc_len, alive_f,
                   l2v, avgdl, qn, vmatrix, vvalid, n_cand, w_lex, w_vec,
                   kq, rrf_k):
-    c_lex = doc_len.shape[0]
     c_vec = vmatrix.shape[0]
+    ls, lid, lgrow, vs, vi = _local_parts_impl(
+        ptr, urow, sel, post_doc, post_tf, doc_len, alive_f, l2v,
+        avgdl, qn, vmatrix, vvalid, jnp.int32(0), jnp.int32(0), kq=kq)
+    ls = _pad_cols(ls, kq, NEG_INF)
+    lid = _pad_cols(lid, kq, 0)
+    lgrow = _pad_cols(lgrow, kq, 0)
+    vs = _pad_cols(vs, kq, NEG_INF)
+    vi = _pad_cols(vi, kq, 0)
+    fs, fpos = rrf_fuse_device(ls, lid, lgrow, vs, vi, n_cand,
+                               w_lex, w_vec, rrf_k, c_vec)
+    return ls, lgrow, vs, vi, fs, fpos
+
+
+def _lex_parts_impl(ptr, urow, sel, post_doc, post_tf, doc_len,
+                    alive_f, l2map, avgdl, lex_off, kq):
+    """One shard's lexical top-k with globalized row ids plus the
+    joined foreign-row column (brute slot for the matmul tier, graph
+    row for the walk tier) — the lexical half of every shard path."""
+    c_lex = doc_len.shape[0]
     dense = bm25_dense_scores(ptr, urow, sel, post_doc, post_tf,
                               doc_len, alive_f, avgdl)
     ls, li = jax.lax.top_k(dense, min(kq, c_lex))
-    vsc = qn @ vmatrix.T
-    vsc = jnp.where(vvalid[None, :], vsc, NEG_INF)
-    vs, vi = jax.lax.top_k(vsc, min(kq, c_vec))
-    ls = _pad_cols(ls, kq, NEG_INF)
-    li = _pad_cols(li, kq, 0)
-    vs = _pad_cols(vs, kq, NEG_INF)
-    vi = _pad_cols(vi, kq, 0)
-    fs, fpos = rrf_fuse_device(ls, l2v[li], li, vs, vi, n_cand,
-                               w_lex, w_vec, rrf_k, c_vec)
-    return ls, li, vs, vi, fs, fpos
+    return ls, l2map[li], li + lex_off
+
+
+_lex_parts = functools.partial(
+    jax.jit, static_argnames=("kq",))(_lex_parts_impl)
 
 
 def _local_parts_impl(ptr, urow, sel, post_doc, post_tf, doc_len,
@@ -151,15 +187,14 @@ def _local_parts_impl(ptr, urow, sel, post_doc, post_tf, doc_len,
                       vec_off, kq):
     """One shard's per-source top-k with globalized ids — the building
     block of both the single-device reference loop and the mesh path."""
-    c_lex = doc_len.shape[0]
     c_vec = vmatrix.shape[0]
-    dense = bm25_dense_scores(ptr, urow, sel, post_doc, post_tf,
-                              doc_len, alive_f, avgdl)
-    ls, li = jax.lax.top_k(dense, min(kq, c_lex))
+    ls, lid, lgrow = _lex_parts_impl(ptr, urow, sel, post_doc, post_tf,
+                                     doc_len, alive_f, l2v, avgdl,
+                                     lex_off, kq)
     vsc = qn @ vmatrix.T
     vsc = jnp.where(vvalid[None, :], vsc, NEG_INF)
     vs, vi = jax.lax.top_k(vsc, min(kq, c_vec))
-    return ls, l2v[li], li + lex_off, vs, vi + vec_off
+    return ls, lid, lgrow, vs, vi + vec_off
 
 
 _local_parts = functools.partial(
@@ -234,6 +269,95 @@ def _fused_sharded_impl(ptr, urow, sel, post_doc, post_tf, doc_len,
 
 
 # ---------------------------------------------------------------------------
+# the walk tier: CAGRA greedy walk instead of the brute matmul
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "kq", "rrf_k", "iters", "width", "itopk", "hash_bits", "n_seeds"))
+def _walk_fused_single(ptr, urow, sel, post_doc, post_tf, doc_len,
+                       alive_f, l2g, avgdl, qn, gmatrix, gadj, gvalidf,
+                       n_cand, w_lex, w_vec, kq, rrf_k, iters, width,
+                       itopk, hash_bits, n_seeds):
+    """One compiled program for the walk tier: CSR lexical scoring,
+    the fixed-iteration CAGRA greedy walk over the device graph, and
+    device RRF joining on the ``lexical row -> graph row`` map. Same
+    pow2 ``(B, kq)`` compile-bucket discipline as the brute tier —
+    the walk's own statics (iters/width/itopk) are per-graph-build
+    constants, not per-request knobs."""
+    c_g = gmatrix.shape[0]
+    ls, lid, lgrow = _lex_parts_impl(ptr, urow, sel, post_doc, post_tf,
+                                     doc_len, alive_f, l2g, avgdl,
+                                     jnp.int32(0), kq=kq)
+    vs, vi = _walk_body(qn, gmatrix, gadj, gvalidf, min(kq, itopk),
+                        iters, width, itopk, hash_bits, n_seeds)
+    ls = _pad_cols(ls, kq, NEG_INF)
+    lid = _pad_cols(lid, kq, 0)
+    lgrow = _pad_cols(lgrow, kq, 0)
+    vs = _pad_cols(vs, kq, NEG_INF)
+    vi = _pad_cols(vi, kq, 0)
+    fs, fpos = rrf_fuse_device(ls, lid, lgrow, vs, vi, n_cand,
+                               w_lex, w_vec, rrf_k, c_g)
+    return ls, lgrow, vs, vi, fs, fpos
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "kq", "rrf_k", "iters", "width", "itopk", "hash_bits", "n_seeds",
+    "mesh_holder"))
+def _walk_fused_sharded_impl(ptr, urow, sel, post_doc, post_tf,
+                             doc_len, alive_f, l2g, avgdl, qn, gmatrix,
+                             gadj, gvalidf, n_cand, w_lex, w_vec, kq,
+                             rrf_k, iters, width, itopk, hash_bits,
+                             n_seeds, mesh_holder):
+    """Mesh walk tier: both corpora row-sharded over ``data``; each
+    shard scores its lexical rows and walks its local subgraph, one
+    all-gather + top-k per source merges shard winners, and the fuse
+    runs replicated — the same collective pattern as the brute-fused
+    mesh path and ``cagra.sharded_cagra_walk``."""
+    from jax.sharding import PartitionSpec as P
+
+    from nornicdb_tpu.parallel.mesh import compat_shard_map
+
+    mesh = mesh_holder.mesh
+    s_n = mesh.shape["data"]
+    c_lex_local = doc_len.shape[0] // s_n
+    g_local = gmatrix.shape[0] // s_n
+    c_g_total = gmatrix.shape[0]
+    kw = min(kq, itopk)
+
+    def local_fn(ptr_s, urow_s, sel_r, pd_s, pt_s, dl_s, al_s, l2g_s,
+                 avg_r, qn_r, gm_s, ga_s, gv_s, nc_r, wl_r, wv_r):
+        sh = jax.lax.axis_index("data")
+        ls, lid, lgrow = _lex_parts_impl(
+            ptr_s, urow_s, sel_r, pd_s, pt_s, dl_s, al_s, l2g_s,
+            avg_r, sh * c_lex_local, kq=kq)
+        ws, wi = _walk_body(qn_r, gm_s, ga_s, gv_s, kw, iters, width,
+                            itopk, hash_bits, n_seeds)
+        gwi = wi + sh * g_local
+
+        def gat(x):
+            return jax.lax.all_gather(x, "data", axis=1, tiled=True)
+
+        ls2, lid2, lgrow2 = _merge_parts(
+            [(gat(ls), gat(lid), gat(lgrow))], kq)
+        vs2, vi2 = _merge_parts([(gat(ws), gat(gwi))], kq)
+        fs, fpos = rrf_fuse_device(ls2, lid2, lgrow2, vs2, vi2, nc_r,
+                                   wl_r, wv_r, rrf_k, c_g_total)
+        return ls2, lgrow2, vs2, vi2, fs, fpos
+
+    return compat_shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P(), P("data"), P("data"),
+                  P("data"), P("data"), P("data"), P(), P(),
+                  P("data", None), P("data", None), P("data"), P(),
+                  P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P()),
+    )(ptr, urow, sel, post_doc, post_tf, doc_len, alive_f, l2g,
+      avgdl, qn, gmatrix, gadj, gvalidf, n_cand, w_lex, w_vec)
+
+
+# ---------------------------------------------------------------------------
 # the pipeline object
 # ---------------------------------------------------------------------------
 
@@ -254,6 +378,8 @@ class FusedHybrid:
         rebuild_stale_frac: float = 0.1,
         build_inline: bool = True,
         rrf_k: int = DEFAULT_RRF_K,
+        walk_min_n: Optional[int] = None,
+        cagra: Optional[CagraIndex] = None,
     ):
         self.bm25 = bm25
         self.brute = brute
@@ -263,7 +389,30 @@ class FusedHybrid:
             bm25, n_shards=self.n_shards, min_n=min_n,
             rebuild_stale_frac=rebuild_stale_frac,
             build_inline=build_inline)
-        self._map_lock = threading.Lock()
+        # walk tier: above `walk_min_n` live vectors the vector half
+        # runs the CAGRA greedy walk instead of the exact matmul
+        # (None = tier disabled, matmul always). A caller that already
+        # owns a graph over the SAME brute index (the service's cagra
+        # strategy tier) shares it here — one graph, one rebuild
+        # cadence; otherwise the pipeline wraps its own.
+        self.walk_min_n = walk_min_n
+        if cagra is not None and cagra._brute is not brute:
+            # a graph over some OTHER brute index (e.g. captured by a
+            # background build that raced an index reload) must never
+            # serve: its row ids and freshness counters belong to a
+            # discarded corpus
+            cagra = None
+        if cagra is None and walk_min_n is not None:
+            from nornicdb_tpu.search.ann_quality import current_profile
+
+            p = current_profile()
+            cagra = CagraIndex(
+                brute=brute, degree=p.cagra_degree,
+                itopk=p.cagra_itopk, search_width=p.cagra_width,
+                min_n=walk_min_n, n_shards=self.n_shards,
+                build_inline=build_inline)
+        self.cagra = cagra
+        self._grow_cache: Optional[Tuple] = None
         # sharded placement cache for the brute device arrays, keyed on
         # the array object identity (BruteForceIndex recreates it on
         # mutation) — a persistent serving index never re-ships the
@@ -290,24 +439,51 @@ class FusedHybrid:
         write/compaction moved the matrix on from the captured view —
         slots_of pins the read to the expected generation under the
         brute lock, so a remap can never mis-join silently."""
-        with self._map_lock:
-            if snap.get("l2v_mut") == mutations and "l2v" in snap:
-                return snap["l2v"]
+
+        def derive():
             ids = ["" if e is None else e for e in snap["row_ids"]]
             raw = self.brute.slots_of(ids, expect_mutations=mutations)
-            if raw is None:
-                return None
-            slots = np.asarray(raw, dtype=np.int32)
-            dev = jnp.asarray(slots)
-            if "mesh" in snap:
-                from jax.sharding import NamedSharding, PartitionSpec
+            return None if raw is None else np.asarray(raw, np.int32)
 
-                dev = jax.device_put(
-                    dev, NamedSharding(snap["mesh"],
-                                       PartitionSpec("data")))
-            snap["l2v"] = dev
-            snap["l2v_mut"] = mutations
-            return dev
+        return self.lex.row_map(snap, "l2v", mutations, derive)
+
+    def _ensure_walk_map(self, snap: Dict[str, Any], g: Dict[str, Any]):
+        """Device lex-row -> graph-row map for the walk tier, keyed on
+        the graph's build sequence so a background rebuild (new row
+        space) rebinds the join on the very next batch instead of
+        serving a stale map."""
+
+        def derive():
+            grow = self._graph_rows(g)
+            return np.asarray(
+                [-1 if e is None else grow.get(e, -1)
+                 for e in snap["row_ids"]], dtype=np.int32)
+
+        return self.lex.row_map(snap, "l2g", g["build_seq"], derive)
+
+    def _graph_rows(self, g: Dict[str, Any]) -> Dict[str, int]:
+        # keyed on build_seq, NOT the dict: holding g here would pin a
+        # replaced graph's device arrays until the next walk dispatch
+        cached = self._grow_cache
+        if cached is not None and cached[0] == g["build_seq"]:
+            return cached[1]
+        grow = {e: i for i, e in enumerate(g["row_ids"])
+                if e is not None}
+        self._grow_cache = (g["build_seq"], grow)
+        return grow
+
+    def rebind_cagra(self, cagra: CagraIndex) -> bool:
+        """Swap the walk tier's graph index in place (the strategy
+        machine built its own over the same brute index). Keeps the
+        lexical snapshot serving — the graph-derived state (l2g map,
+        row cache) rebinds lazily via the new graph's build_seq.
+        False when the graph wraps a DIFFERENT brute index (caller
+        must re-wrap the whole pipeline instead)."""
+        if cagra is not None and cagra._brute is not self.brute:
+            return False
+        self.cagra = cagra
+        self._grow_cache = None
+        return True
 
     def _vec_arrays(self, m, valid, snap):
         if snap["shards"] == 1 or "mesh" not in snap:
@@ -351,18 +527,10 @@ class FusedHybrid:
             _HYB_C.labels("host_fallback_changelog").inc()
             self.lex._kick_background_rebuild()
             return none_rows
-        view = self.brute.device_view()
-        if view is None:
-            return none_rows
+        if self.brute.view_meta() is None:
+            return none_rows  # vector index empty
         t_plan0 = time.time()
-        m, valid, vec_ext, mutations, _compactions = view
         try:
-            l2v = self._ensure_map(snap, mutations)
-            if l2v is None:
-                # a write/compaction moved the brute matrix between the
-                # view capture and the map read — retry next batch
-                _HYB_C.labels("host_fallback_vec_race").inc()
-                return none_rows
             self.lex.refresh_alive(snap)
             token_rows = [e["tokens"] for e in extras]
             ptr, urow, sel, avgdl = self.lex.plan(snap, token_rows, b)
@@ -378,11 +546,43 @@ class FusedHybrid:
         w_lex = np.asarray([e["w"][0] for e in extras], dtype=np.float32)
         w_vec = np.asarray([e["w"][1] for e in extras], dtype=np.float32)
         qn = l2_normalize(jnp.asarray(queries_emb, dtype=jnp.float32))
-        args = (jnp.asarray(ptr), jnp.asarray(urow), jnp.asarray(sel),
-                snap["post_doc"], snap["post_tf"], snap["doc_len"],
-                snap["alive"], l2v, jnp.float32(avgdl), qn)
+        lex_base = (jnp.asarray(ptr), jnp.asarray(urow),
+                    jnp.asarray(sel), snap["post_doc"],
+                    snap["post_tf"], snap["doc_len"], snap["alive"])
         tail = (jnp.asarray(n_cand), jnp.asarray(w_lex),
                 jnp.asarray(w_vec))
+        # tier selection: walk above walk_min_n (sub-linear vector
+        # half), else the exact matmul; a vetoed walk batch falls
+        # through to the matmul tier, never to the host
+        wctx = self._walk_context(snap, kq)
+        walk_discarded_s = 0.0
+        if wctx is not None:
+            t_w0 = time.time()
+            out = self._dispatch_walk(snap, wctx, lex_base, avgdl, qn,
+                                      tail, kq, b, delta, token_rows,
+                                      extras, t_plan0)
+            if out is not None:
+                return out
+            # vetoed: account the discarded walk explicitly and reset
+            # the plan clock, or the brute tier's plan_s (and the
+            # lexical.score trace span) would silently absorb the
+            # whole walk dispatch + decode
+            walk_discarded_s = time.time() - t_w0
+            t_plan0 = time.time()
+        # the exact tier's view capture happens only here — the walk
+        # dispatch above never touches the brute matrix, so a served
+        # walk batch skips the post-write device re-ship entirely
+        view = self.brute.device_view()
+        if view is None:
+            return none_rows
+        m, valid, vec_ext, mutations, _compactions = view
+        l2v = self._ensure_map(snap, mutations)
+        if l2v is None:
+            # a write/compaction moved the brute matrix between the
+            # view capture and the map read — retry next batch
+            _HYB_C.labels("host_fallback_vec_race").inc()
+            return none_rows
+        args = (*lex_base, l2v, jnp.float32(avgdl), qn)
         t0 = time.time()
         if snap["shards"] == 1:
             ls, li, vs, vi, fs, fpos = _fused_single(
@@ -409,12 +609,180 @@ class FusedHybrid:
         _HYB_C.labels("dispatch").inc()
         out = self._decode(snap, vec_ext, delta, token_rows, extras,
                            ls, lgrow, vs, vi, fs, fpos, kq)
+        if delta:
+            _HYB_C.labels("delta_merge").inc(len(extras))
         times = {"plan_s": t0 - t_plan0, "device_t0": t0,
-                 "device_t1": t1, "decode_s": time.time() - t1}
+                 "device_t1": t1, "decode_s": time.time() - t1,
+                 "tier": "brute"}
+        if walk_discarded_s:
+            times["walk_discarded_s"] = round(walk_discarded_s, 6)
         for row in out:
             if row is not None:
                 row["times"] = times
+                row["tier"] = "brute"
         return out
+
+    # -- walk tier --------------------------------------------------------
+
+    def _walk_context(self, snap, kq: int) -> Optional[Dict[str, Any]]:
+        """Eligibility + freshness gate for the walk tier. None means
+        the brute-fused tier serves this batch — every ineligibility
+        degrades DOWN the ladder (walk -> brute-fused -> host), never
+        sideways into a wrong answer."""
+        cagra = self.cagra
+        if cagra is None or self.walk_min_n is None:
+            return None
+        if len(self.brute) < self.walk_min_n:
+            return None
+        g = cagra.ensure_graph()
+        if g is None:
+            # first build (or a rebuild after shrinking below min_n)
+            # still running in the background: exact tier serves
+            _HYB_C.labels("walk_pending_build").inc()
+            return None
+        if kq > cagra.itopk:
+            # the walk pool only ever holds itopk candidates; a deeper
+            # overfetch must come from the exact matmul tier
+            _HYB_C.labels("walk_fallback_itopk").inc()
+            return None
+        if g["shards"] != snap["shards"]:
+            # lexical snapshot and graph must agree on the mesh layout
+            # to run inside one shard_map program
+            _HYB_C.labels("walk_fallback_shards").inc()
+            return None
+        delta_ids, delta_vecs = cagra.delta_block(g)
+        if delta_ids is None:
+            # churn outran the brute changelog (rebuild in flight):
+            # brute-fused serves exactly until the fresh graph lands
+            _HYB_C.labels("walk_fallback_changelog").inc()
+            return None
+        # staleness from the LIVE counter, read only after delta_block
+        # drained the changelog (the same order as CagraIndex._resolve):
+        # a delete landing after an earlier capture would bump the
+        # counter delta_block sees while the old value still compared
+        # clean — skipping the live-filter and serving a tombstone
+        return {"g": g, "l2g": self._ensure_walk_map(snap, g),
+                "delta_ids": delta_ids, "delta_vecs": delta_vecs,
+                "stale": self.brute.mutations != g["built_mutations"],
+                "iters": g["iters"], "width": cagra.search_width,
+                "itopk": cagra.itopk, "hash_bits": cagra.hash_bits,
+                "n_seeds": cagra.n_seeds}
+
+    def _dispatch_walk(self, snap, wctx, lex_base, avgdl, qn, tail,
+                       kq, b, delta, token_rows, extras, t_plan0):
+        """One walk-tier dispatch. Returns the decoded rows, or None
+        when the walk output under-filled a row's candidate list (the
+        caller re-dispatches the batch through the exact tier)."""
+        g = wctx["g"]
+        # the program runs at per-source width itopk, not kq: the fuse
+        # masks candidate depth by the traced n_cand anyway, and the
+        # extra columns are what give the live-filter slack — a few
+        # tombstones in the walk's top-n_cand must not force the exact
+        # tier. One compiled width per graph config, so the (B, k)
+        # compile universe stays one bucket per batch size.
+        kp = wctx["itopk"]
+        statics = dict(kq=kp, rrf_k=self.rrf_k, iters=wctx["iters"],
+                       width=wctx["width"], itopk=wctx["itopk"],
+                       hash_bits=wctx["hash_bits"],
+                       n_seeds=wctx["n_seeds"])
+        args = (*lex_base, wctx["l2g"], jnp.float32(avgdl), qn,
+                g["matrix"], g["adj"], g["validf"], *tail)
+        t0 = time.time()
+        if snap["shards"] == 1:
+            ls, li, vs, vi, fs, fpos = _walk_fused_single(
+                *args, **statics)
+            lgrow = li
+        elif "mesh" in snap and "mesh" in g \
+                and len(jax.devices()) >= snap["shards"]:
+            ls, lgrow, vs, vi, fs, fpos = _walk_fused_sharded_impl(
+                *args, **statics, mesh_holder=_holder(snap["mesh"]))
+        else:
+            ls, lgrow, vs, vi, fs, fpos = self._walk_shard_loop(
+                snap, g, lex_base, wctx["l2g"], avgdl, qn, tail, kp,
+                wctx)
+        # force to host inside the timed window (async dispatch)
+        ls, lgrow = np.asarray(ls), np.asarray(lgrow)
+        vs, vi = np.asarray(vs), np.asarray(vi)
+        fs, fpos = np.asarray(fs), np.asarray(fpos)
+        t1 = time.time()
+        record_dispatch("hybrid_walk_fused", pow2_bucket(b), kp,
+                        t1 - t0)
+        _HYB_C.labels("walk_dispatch").inc()
+        out = self._decode(
+            snap, g["row_ids"], delta, token_rows, extras,
+            ls, lgrow, vs, vi, fs, fpos, kp,
+            vec_delta=(wctx["delta_ids"], wctx["delta_vecs"]),
+            vec_stale=wctx["stale"], qn=np.asarray(qn))
+        # under-fill veto: a stale graph's live-filter (or a walk miss)
+        # can leave a row short of candidates the corpus does have —
+        # those batches re-dispatch through the exact tier, the same
+        # never-under-serve contract as CagraIndex.search_batch
+        alive_n = len(self.brute)
+        for row, e in zip(out, extras):
+            if row is None:
+                continue
+            if len(row["vec"]) < min(int(e["n_cand"]), kp, alive_n):
+                _HYB_C.labels("walk_underfill_brute").inc()
+                return None
+        # freshness/merge accounting only once the batch actually
+        # serves from the walk tier — a vetoed batch re-dispatches
+        # through the exact tier and must not count twice
+        if wctx["delta_ids"]:
+            _HYB_C.labels("walk_delta_merge").inc()
+        elif wctx["stale"]:
+            _HYB_C.labels("walk_live_filter").inc()
+        if delta:
+            _HYB_C.labels("delta_merge").inc(len(extras))
+        times = {"plan_s": t0 - t_plan0, "device_t0": t0,
+                 "device_t1": t1, "decode_s": time.time() - t1,
+                 "tier": "walk", "walk_iters": wctx["iters"],
+                 "walk_itopk": wctx["itopk"]}
+        for row in out:
+            if row is not None:
+                row["times"] = times
+                row["tier"] = "walk"
+        return out
+
+    def _walk_shard_loop(self, snap, g, lex_base, l2g, avgdl, qn,
+                         tail, kq, wctx):
+        """Single-device reference for the sharded walk tier: each
+        shard's lexical parts + local-subgraph walk, merged in shard
+        order (the all-gather layout), fused once. The mesh path must
+        match this bit-for-bit."""
+        ptr, urow, sel, pd, pt, dl, al = lex_base
+        n_cand, w_lex, w_vec = tail
+        s_n = snap["shards"]
+        c_local = snap["c_local"]
+        p_b = ptr.shape[0] // s_n
+        p_cap = pd.shape[0] // s_n
+        r = g["rows_per_shard"]
+        kw = min(kq, wctx["itopk"])
+        avgdl_j = jnp.float32(avgdl)
+        lex_parts, vec_parts = [], []
+        for sh in range(s_n):
+            ls, lid, lgrow = _lex_parts(
+                ptr[sh * p_b:(sh + 1) * p_b],
+                urow[sh * p_b:(sh + 1) * p_b],
+                sel,
+                pd[sh * p_cap:(sh + 1) * p_cap],
+                pt[sh * p_cap:(sh + 1) * p_cap],
+                dl[sh * c_local:(sh + 1) * c_local],
+                al[sh * c_local:(sh + 1) * c_local],
+                l2g[sh * c_local:(sh + 1) * c_local],
+                avgdl_j, jnp.int32(sh * c_local), kq=kq)
+            lex_parts.append((ls, lid, lgrow))
+        for sh, (m_sh, a_sh, v_sh) in enumerate(g["shard_slices"]):
+            ws, wi = _cagra_walk(
+                qn, m_sh, a_sh, v_sh, k=kw, iters=wctx["iters"],
+                width=wctx["width"], itopk=wctx["itopk"],
+                hash_bits=wctx["hash_bits"], n_seeds=wctx["n_seeds"])
+            vec_parts.append((ws, wi + sh * r))
+        ls2, lid2, lgrow2 = _merge_parts(lex_parts, kq)
+        vs2, vi2 = _merge_parts(vec_parts, kq)
+        fs, fpos = _fuse_merged(ls2, lid2, lgrow2, vs2, vi2, n_cand,
+                                w_lex, w_vec, kq=kq, rrf_k=self.rrf_k,
+                                c_vec_total=int(g["shards"] * r))
+        return ls2, lgrow2, vs2, vi2, fs, fpos
 
     def _shard_loop(self, snap, args, m, valid, tail, kq):
         """Single-device reference for the sharded layout: run every
@@ -453,9 +821,31 @@ class FusedHybrid:
                                 c_vec_total=int(mj.shape[0]))
         return ls2, lgrow2, vs2, vi2, fs, fpos
 
-    def _decode(self, snap, vec_ext, delta, token_rows, extras,
-                ls, lgrow, vs, vi, fs, fpos, kq):
+    def _decode(self, snap, vec_ids, delta, token_rows, extras,
+                ls, lgrow, vs, vi, fs, fpos, kq,
+                vec_delta=None, vec_stale=False, qn=None):
+        """Decode one dispatch's device candidates into per-request
+        ranked lists. ``vec_ids`` maps vector candidate ids to ext ids
+        (the brute ext-id table for the matmul tier, graph ``row_ids``
+        for the walk tier). The walk tier's vector-side freshness rides
+        ``vec_delta``/``vec_stale``: tombstoned docs are live-filtered
+        out of the walk output, post-build adds/updates are
+        exact-scored (``qn @ delta_vecs``) and merged in, and any
+        vector-side correction reroutes fusion through the
+        bit-compatible host ``rrf_fuse`` — read-your-writes without a
+        graph rebuild."""
         row_ids = snap["row_ids"]
+        d_ids, d_vecs = vec_delta if vec_delta is not None else ([], None)
+        d_set = set(d_ids)
+        d_scores = qn @ d_vecs.T if d_ids else None  # exact cosines
+        live: Optional[set] = None
+        if vec_stale:
+            # ONE locked membership pass over every distinct walk
+            # candidate — a per-id `in brute` inside the loop would
+            # take the index lock up to B*itopk times per batch
+            cand = {vec_ids[i] for i in np.unique(vi)}
+            cand.discard(None)
+            live = self.brute.contains_many(cand)
         out: List[Optional[Dict[str, Any]]] = []
         for r in range(len(extras)):
             n_cand = int(extras[r]["n_cand"])
@@ -471,25 +861,44 @@ class FusedHybrid:
                 lex_hits.append((eid, float(ls[r, c])))
             vec_hits: List[Tuple[str, float]] = []
             vec_by_pos: Dict[int, str] = {}
+            vec_fixed = False  # this row's list diverged from the
+            #   device-fused one: re-fuse on host. A merely-stale graph
+            #   whose top-itopk held no tombstone keeps the device fuse.
             for c in range(min(kq, vs.shape[1])):
                 if vs[r, c] < 0.5 * NEG_INF or len(vec_hits) >= n_cand:
                     break
-                eid = vec_ext[int(vi[r, c])]
+                eid = vec_ids[int(vi[r, c])]
                 if eid is None:
                     continue
+                if eid in d_set:
+                    continue  # walk scored the pre-update vector
+                if live is not None and eid not in live:
+                    vec_fixed = True
+                    continue  # tombstoned since the graph build
                 vec_by_pos[c] = eid
                 vec_hits.append((eid, float(vs[r, c])))
+            if d_ids:
+                vec_hits = merge_delta_hits(vec_hits, d_ids,
+                                            d_scores[r], n_cand)
+                vec_fixed = True
             if delta:
                 # read-your-writes: exact host scores for post-snapshot
                 # docs, then the (bit-compatible) host fuse over the
-                # merged lists
-                _HYB_C.labels("delta_merge").inc()
+                # merged lists (the caller counts delta_merge once the
+                # batch actually serves — a vetoed walk decode must not
+                # double-count against the brute re-dispatch)
                 dset = set(delta)
                 fresh = self.bm25.score_docs(token_rows[r], delta)
                 merged = [(e, s) for e, s in lex_hits if e not in dset]
                 merged.extend(sorted(fresh.items()))
                 merged.sort(key=lambda kv: -kv[1])
                 lex_hits = merged[:n_cand]
+                fused = rrf_fuse([lex_hits, vec_hits],
+                                 weights=list(extras[r]["w"]),
+                                 k=self.rrf_k, limit=n_cand)
+            elif vec_fixed:
+                # the device fuse saw the pre-correction vector list;
+                # re-fuse on host (bit-compatible) over the fixed lists
                 fused = rrf_fuse([lex_hits, vec_hits],
                                  weights=list(extras[r]["w"]),
                                  k=self.rrf_k, limit=n_cand)

@@ -220,7 +220,23 @@ class SearchService:
         profiles: NORNICDB_HYBRID_FUSED (default on),
         NORNICDB_HYBRID_MIN_N corpus floor, NORNICDB_HYBRID_SHARDS mesh
         row-sharding, NORNICDB_HYBRID_INLINE_BUILD for deterministic
-        (blocking) first builds in tests/benches."""
+        (blocking) first builds in tests/benches. The walk tier
+        (NORNICDB_HYBRID_WALK, default on) replaces the pipeline's
+        exact vector matmul with the CAGRA greedy walk above
+        NORNICDB_HYBRID_WALK_MIN_N live vectors (default 100k — below
+        it the O(N) matmul is cheap enough that exact rank parity
+        wins), sharing the strategy machine's graph when one exists.
+
+        Lifecycle: the wrapper is evicted and re-wrapped when the
+        underlying index OBJECTS move — an index reload
+        (:meth:`load_indexes` clears ``_fused``) — and rebound IN PLACE
+        (:meth:`FusedHybrid.rebind_cagra`, below) when the strategy
+        machine builds a new CAGRA graph over the same brute index, so
+        a stale pipeline can never keep serving a discarded corpus or
+        keep walking a replaced graph while its row->slot maps silently
+        mis-age. Anything snapshot-coupled to the graph must live on
+        the per-graph snapshot (keyed by ``build_seq``), not on the
+        wrapper: a graph swap does NOT rebuild the wrapper."""
         from nornicdb_tpu.config import env_bool, env_int
 
         if not env_bool("HYBRID_FUSED", True):
@@ -231,22 +247,57 @@ class SearchService:
             self._fused = None
             return None
         f = self._fused
+        if f is not None and f.bm25 is self.bm25 \
+                and f.brute is self.vectors \
+                and self.cagra is not None \
+                and f.cagra is not self.cagra:
+            # the strategy machine built its own graph over the same
+            # brute index: rebind it in place — one graph, one rebuild
+            # cadence, and the lexical snapshot keeps serving (a full
+            # re-wrap would drop hybrid to the host path until the CSR
+            # snapshot rebuilt)
+            if not f.rebind_cagra(self.cagra):
+                # the candidate graph wraps a brute other than the live
+                # one (a racy background build finished after an index
+                # reload): the wrapper itself is sound, so keep serving
+                # it — rewrapping here would rebuild the pipeline on
+                # EVERY search while the stale graph lingered — and
+                # drop the graph, which would serve the discarded
+                # corpus from any path that walked it
+                self.cagra = None
         if f is None or f.bm25 is not self.bm25 \
                 or f.brute is not self.vectors:
             # index reload swapped the underlying objects: re-wrap so
             # the pipeline can never serve a discarded corpus
             from nornicdb_tpu.search.hybrid_fused import FusedHybrid
 
+            walk_min_n = None
+            if env_bool("HYBRID_WALK", True):
+                walk_min_n = env_int("HYBRID_WALK_MIN_N", 100_000)
+            cagra = self.cagra
+            if cagra is not None and cagra._brute is not self.vectors:
+                # a racy background build captured a pre-reload brute:
+                # its graph indexes a discarded corpus (FusedHybrid
+                # re-checks this too; None = wrap a fresh one)
+                cagra = None
             f = FusedHybrid(
                 self.bm25, self.vectors,
                 n_shards=max(1, env_int("HYBRID_SHARDS", 1)),
                 min_n=min_n,
-                build_inline=env_bool("HYBRID_INLINE_BUILD", False))
+                build_inline=env_bool("HYBRID_INLINE_BUILD", False),
+                walk_min_n=walk_min_n,
+                cagra=cagra)
             self._fused = f
             from nornicdb_tpu.obs import register_resource
 
             register_resource("device_bm25",
                               f"service:{self.database}", f.lex)
+            if f.cagra is not None and f.cagra is not self.cagra:
+                # pipeline-owned graph (walk tier without the cagra
+                # strategy profile): account for its device arrays too
+                register_resource(
+                    "cagra", f"service:{self.database}:hybrid_walk",
+                    f.cagra)
         if not f.ensure():
             return None  # first build runs in background; host serves
         return f
@@ -271,7 +322,9 @@ class SearchService:
             return None
         if trio is None:
             return None
-        _STRATEGY_C.labels("hybrid_fused").inc()
+        tier = trio.get("tier", "brute")
+        _STRATEGY_C.labels("hybrid_walk_fused" if tier == "walk"
+                           else "hybrid_fused").inc()
         t = trio.get("times")
         if t:
             # the whole lexical+vector scoring ran inside one device
@@ -279,6 +332,12 @@ class SearchService:
             # /admin/traces shows the hybrid ladder per request
             attach_span("lexical.score", t["device_t0"] - t["plan_s"],
                         t["device_t1"])
+            if tier == "walk":
+                # the vector half was the graph walk: surface its
+                # fixed-iteration/pool config on the request's trace
+                attach_span("vector.walk", t["device_t0"],
+                            t["device_t1"], iters=t.get("walk_iters"),
+                            itopk=t.get("walk_itopk"))
             attach_span("fuse", t["device_t1"],
                         t["device_t1"] + t["decode_s"])
         return trio
@@ -574,7 +633,12 @@ class SearchService:
         )
         if not idx.build():
             return
+        if idx._brute is not self.vectors:
+            return  # an index reload swapped the corpus mid-build
         self.cagra = idx
+        # any fused wrapper built before this graph existed rebinds to
+        # it on the next search (_ensure_fused's in-place rebind) —
+        # one graph, one rebuild cadence, no second copy in HBM
         from nornicdb_tpu.obs import register_resource
 
         register_resource("cagra", f"service:{self.database}", idx)

@@ -87,6 +87,13 @@ class BruteForceIndex:
         with self._lock:
             return ext_id in self._slot_of
 
+    def contains_many(self, ext_ids) -> set:
+        """Live members of ``ext_ids`` under ONE lock hold — bulk
+        membership for decode-path filters (per-id ``in`` would take
+        the lock once per candidate and convoy with writers)."""
+        with self._lock:
+            return {e for e in ext_ids if e in self._slot_of}
+
     @staticmethod
     def _normalize(v: np.ndarray) -> np.ndarray:
         n = np.linalg.norm(v)
@@ -299,6 +306,18 @@ class BruteForceIndex:
             self._dev_valid = jnp.asarray(self._valid)
             self._dirty = False
         return self._dev_matrix, self._dev_valid
+
+    def view_meta(self):
+        """(mutations, compactions) — or None while the index is empty
+        — WITHOUT forcing the device arrays current. The walk tier
+        only needs the mutation counter for its freshness gate; after
+        a write burst, :meth:`device_view` would re-ship the whole
+        matrix to device and re-copy the capacity-sized ext-id list,
+        a per-write tax the walk dispatch never uses."""
+        with self._lock:
+            if self._n_alive == 0 or self._matrix is None:
+                return None
+            return self.mutations, self.compactions
 
     def device_view(self):
         """Consistent device-side view for external batched kernels (the

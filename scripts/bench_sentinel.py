@@ -55,6 +55,10 @@ CHECKS: Dict[str, Tuple] = {
     "knn_b64_qps": ("qps", 0.5),
     "cagra_qps95": ("qps", 0.5),
     "hybrid_fused_qps_b16": ("qps", 0.5),
+    # walk tier (round r06+): qps floor once a walk-carrying baseline
+    # exists in the trajectory; recall gates ABSOLUTELY from the first
+    # round it appears (quality checks need no baseline — see compare)
+    "hybrid_walk_qps_b16": ("qps", 0.5),
     "pagerank_speedup": ("qps", 0.4),
     # surface benches ride a contended box: r5 vs r6 differ up to ~7x
     # on identical code, so the floor only catches collapse, not noise
@@ -65,6 +69,7 @@ CHECKS: Dict[str, Tuple] = {
     "surface_qdrant_grpc_qps": ("qps", 0.2),
     "cagra_recall10": ("quality", 0.90, 0.05),
     "hybrid_rank_parity": ("quality", 0.98, 0.02),
+    "hybrid_walk_recall10": ("quality", 0.95, 0.02),
     "hybrid_compile_buckets": ("growth", 2),
 }
 
@@ -104,6 +109,12 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         else _g(hyb, "fused_qps", "16"))
     out["hybrid_rank_parity"] = _num(hyb.get("rank_parity"))
     out["hybrid_compile_buckets"] = _num(hyb.get("compile_buckets"))
+    out["hybrid_walk_qps_b16"] = _num(
+        hyb.get("walk_qps_b16") if is_summary
+        else _g(hyb, "walk", "walk_qps_b16"))
+    out["hybrid_walk_recall10"] = _num(
+        hyb.get("walk_recall10") if is_summary
+        else _g(hyb, "walk", "walk_recall10"))
     out["pagerank_speedup"] = _num(
         doc.get("pagerank_speedup_vs_numpy") if is_summary
         else _g(doc, "northstar", "pagerank_device", "speedup_vs_numpy"))
@@ -190,13 +201,22 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
     flagged: List[Dict[str, Any]] = []
     passed: List[str] = []
     skipped: List[str] = []
+    # metrics the baseline carries that VANISHED from the fresh run —
+    # partial artifacts (single-stage runs, skipped tpu_proof) make
+    # this legitimate, so it does not fail the gate, but a crashed
+    # stage must at least be visible in the verdict, not silent
+    missing = sorted(m for m in CHECKS
+                     if m in baseline and fresh.get(m) is None)
     for metric, spec in CHECKS.items():
         f = fresh.get(metric)
         b = baseline.get(metric)
-        if f is None or b is None:
+        kind = spec[0]
+        # quality floors are ABSOLUTE: they gate from the first round
+        # the metric exists, even before any trajectory run carries it
+        # (qps/growth checks are relative and need both sides)
+        if f is None or (b is None and kind != "quality"):
             skipped.append(metric)
             continue
-        kind = spec[0]
         if kind == "qps":
             tol = overrides.get(metric, spec[1])
             if b > 0 and f < tol * b:
@@ -208,7 +228,8 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
                 passed.append(metric)
         elif kind == "quality":
             abs_floor, max_drop = spec[1], spec[2]
-            floor = max(abs_floor, b - max_drop)
+            floor = abs_floor if b is None else max(abs_floor,
+                                                    b - max_drop)
             if f < floor:
                 flagged.append({
                     "metric": metric, "kind": "quality_floor",
@@ -231,6 +252,7 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
         "passed": sorted(passed),
         "flagged": flagged,
         "skipped": sorted(skipped),
+        "missing_vs_baseline": missing,
     }
 
 
@@ -310,11 +332,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             (d for d in fresh_docs if d.get("summary")), None)
         print(json.dumps(verdict))
         if summary is not None:
-            print(json.dumps({**summary, "sentinel": {
+            block = {
                 "verdict": verdict["verdict"],
                 "checked": verdict["checked"],
                 "flagged": [f["metric"] for f in verdict["flagged"]],
-            }}))
+            }
+            if verdict["missing_vs_baseline"]:
+                block["missing"] = verdict["missing_vs_baseline"]
+            print(json.dumps({**summary, "sentinel": block}))
     else:
         print(json.dumps(verdict))
     return 1 if verdict["verdict"] == "regression" else 0

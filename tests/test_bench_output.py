@@ -71,7 +71,17 @@ def _fake_result():
                                  "64": 1380.0},
                    "speedup_vs_host_b16": 3.5,
                    "speedup_vs_host_b64": 3.9,
-                   "compile_buckets": 4},
+                   "compile_buckets": 4,
+                   "walk": {"sweep": [
+                       {"n": 20_000, "walk_qps_b16": 1010.0,
+                        "brute_qps_b16": 1340.0,
+                        "walk_recall10": 0.97},
+                       {"n": 100_000, "walk_qps_b16": 250.0,
+                        "brute_qps_b16": 215.0,
+                        "walk_recall10": 0.96}],
+                       "crossover_n": 100_000,
+                       "walk_qps_b16": 250.0,
+                       "walk_recall10": 0.96}},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -108,11 +118,15 @@ class TestCompactSummary:
                               "recall_at_10": 0.99,
                               "speedup_vs_brute": 2.0,
                               "backend": "cpu"}
-        # fused hybrid trio (ISSUE 4): qps at serving batch, honest
-        # speedup, and the rank-identity fraction behind it
+        # fused hybrid (ISSUE 4 trio + ISSUE 6 walk tier): qps at
+        # serving batch, honest speedup, the rank-identity fraction
+        # behind it, and the walk tier's headline pair + crossover
         assert s["hybrid"] == {"fused_qps_b16": 1250.0,
                                "speedup_vs_host": 3.5,
-                               "rank_parity": 1.0}
+                               "rank_parity": 1.0,
+                               "walk_qps_b16": 250.0,
+                               "walk_recall10": 0.96,
+                               "crossover_n": 100_000}
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -226,6 +240,20 @@ class TestBenchDryRunArtifactSchema:
         assert "speedup_vs_host_b16" in hyb
         assert hyb["compile_buckets"] >= 1
         assert hyb["backend"] == "cpu"
+        # the walk tier's corpus-size sweep (ISSUE 6): both tiers
+        # measured at every point, walk-parity recall present, and the
+        # crossover key emitted (null at toy sizes — the walk only
+        # wins at scale)
+        walk = hyb["walk"]
+        assert len(walk["sweep"]) == 2
+        for point in walk["sweep"]:
+            assert point["walk_qps_b16"] > 0
+            assert point["brute_qps_b16"] > 0
+            assert point["tier"] == "walk"
+            assert point["walk_recall10"] >= 0.95
+        assert "crossover_n" in walk
+        assert walk["walk_qps_b16"] > 0
+        assert walk["walk_recall10"] >= 0.95
 
         # every surface measured, and the new framework-floor fields
         surf = full["surfaces"]
@@ -343,6 +371,7 @@ class TestBenchSentinelGate:
         for metric in ("cypher_geomean", "knn_b1_qps", "cagra_qps95",
                        "cagra_recall10", "hybrid_fused_qps_b16",
                        "hybrid_rank_parity", "hybrid_compile_buckets",
+                       "hybrid_walk_qps_b16", "hybrid_walk_recall10",
                        "surface_qdrant_grpc_qps"):
             assert metric in saved["metrics"], metric
         rc, docs = self._run_sentinel(
@@ -389,6 +418,33 @@ class TestBenchSentinelGate:
         summary = docs[-1]
         assert summary["sentinel"]["verdict"] == "regression"
         assert summary["sentinel"]["flagged"]
+
+    def test_walk_recall_gates_absolutely_without_baseline(
+            self, tmp_path):
+        """The walk tier lands in round r06: its recall floor is
+        ABSOLUTE, so it must gate even against a trajectory that
+        predates the metric (qps floors stay relative and skip)."""
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps({
+            "sentinel_baseline": True,
+            "metrics": {"cypher_geomean": 100.0}}))
+        fresh = json.dumps({
+            "summary": True, "value": 100.0,
+            "hybrid": {"walk_qps_b16": 500.0, "walk_recall10": 0.90}})
+        rc, docs = self._run_sentinel(
+            fresh, ["--baseline", str(base)])
+        assert rc == 1
+        flagged = {f["metric"] for f in docs[0]["flagged"]}
+        assert "hybrid_walk_recall10" in flagged
+        assert "hybrid_walk_qps_b16" in docs[0]["skipped"]
+        # at/above the absolute floor the same shape passes
+        fresh_ok = json.dumps({
+            "summary": True, "value": 100.0,
+            "hybrid": {"walk_qps_b16": 500.0, "walk_recall10": 0.96}})
+        rc, docs = self._run_sentinel(
+            fresh_ok, ["--baseline", str(base)])
+        assert rc == 0
+        assert "hybrid_walk_recall10" in docs[0]["passed"]
 
     def test_sentinel_passes_real_trajectory_files(self):
         """The checked-in BENCH_r0*.json trajectory gates cleanly: the
