@@ -36,6 +36,7 @@ generated ``nornic_pb2`` and handlers are plain methods.
 from __future__ import annotations
 
 import asyncio
+import errno
 import json
 import os
 import threading
@@ -51,10 +52,12 @@ from nornicdb_tpu.api.qdrant import QdrantError
 
 def _unary_raw(fn, req_cls, method, wire=None, gen=None, executor=None,
                resp_cls=None):
-    from nornicdb_tpu.api.qdrant_official_grpc import aio_unary_raw
+    from nornicdb_tpu.api.qdrant_official_grpc import _parse, aio_unary_raw
 
+    # _parse times the FromString as the request's "parse" stage, same
+    # as the official-proto surface
     return aio_unary_raw(
-        lambda data: fn(req_cls.FromString(data)), method=method,
+        _parse(fn, req_cls), method=method,
         wire=wire, gen=gen, executor=executor, resp_cls=resp_cls)
 
 
@@ -338,8 +341,24 @@ class GrpcServer:
         self.host = host
         self.port = self._submit(self._build(host, port)).result(30)
 
+    @staticmethod
+    def _quiet_poller_eagain(loop, context) -> None:
+        # grpcio's aio completion-queue poller is process-global and
+        # binds to the first aio loop; when a SECOND aio loop exists in
+        # the process (an in-process grpc.aio client — the open-loop
+        # bench harness, tests), its cross-loop wakeups surface here as
+        # harmless EAGAIN callbacks that would spam stderr per request.
+        # Only those are swallowed: any other BlockingIOError errno or
+        # exception type still reaches the default handler.
+        exc = context.get("exception")
+        if (isinstance(exc, BlockingIOError)
+                and exc.errno in (errno.EAGAIN, errno.EWOULDBLOCK)):
+            return
+        loop.default_exception_handler(context)
+
     def _run_loop(self) -> None:
         asyncio.set_event_loop(self._loop)
+        self._loop.set_exception_handler(self._quiet_poller_eagain)
         try:
             self._loop.run_forever()
         finally:

@@ -52,6 +52,46 @@ def _route_family(path: str) -> str:
     return "other"
 
 
+def _accepts_openmetrics(accept: str) -> bool:
+    """True when the Accept header prefers the OpenMetrics exposition.
+
+    Honors q-values (RFC 9110 §12.4.2): ``q=0`` means "not acceptable",
+    and OpenMetrics is only served when its q is at least that of any
+    classic-text range (``text/plain``, ``text/*``, ``*/*``) — a
+    scraper sending ``text/plain;q=1.0, application/openmetrics-text;
+    q=0.1`` prefers (and gets) classic Prometheus text."""
+    om_q = 0.0
+    classic_q = 0.0
+    saw_om = False
+    for part in accept.split(","):
+        fields = part.strip().split(";")
+        mtype = fields[0].strip().lower()
+        if not mtype:
+            continue
+        q = 1.0
+        for param in fields[1:]:
+            key, _, value = param.strip().partition("=")
+            if key.strip().lower() == "q":
+                try:
+                    q = float(value)
+                except ValueError:
+                    pass
+        if mtype == "application/openmetrics-text":
+            saw_om = True
+            om_q = max(om_q, q)
+        elif mtype in ("text/plain", "text/*", "*/*"):
+            classic_q = max(classic_q, q)
+    return saw_om and om_q > 0.0 and om_q >= classic_q
+
+
+class _NegotiatedText(str):
+    """A pre-rendered text body carrying its own content type (used by
+    the OpenMetrics exposition, whose media type the default
+    str-payload sniffing in ``_reply`` cannot infer)."""
+
+    content_type: str = "text/plain; charset=utf-8"
+
+
 class _Metrics:
     """Server counters, now backed by the process-wide telemetry
     registry (nornicdb_tpu/obs) so /metrics serves REAL Prometheus
@@ -79,10 +119,19 @@ class _Metrics:
                     self._fams[name] = fam
         fam.inc(value)
 
-    def render(self, extra: Dict[str, float]) -> str:
+    def _extra_gauges(self, extra: Dict[str, float]) -> Dict[str, float]:
         gauges = {f"nornicdb_{k}": v for k, v in extra.items()}
         gauges["nornicdb_uptime_seconds"] = time.time() - self.started_at
-        return self._registry.render(gauges)
+        return gauges
+
+    def render(self, extra: Dict[str, float]) -> str:
+        return self._registry.render(self._extra_gauges(extra))
+
+    def render_openmetrics(self, extra: Dict[str, float]) -> _NegotiatedText:
+        body = _NegotiatedText(
+            self._registry.render_openmetrics(self._extra_gauges(extra)))
+        body.content_type = self._registry.OPENMETRICS_CONTENT_TYPE
+        return body
 
 
 class _RateLimiter:
@@ -357,7 +406,10 @@ class HttpServer:
                 self._reply(status, payload)
 
             def _reply(self, status: int, payload: Dict[str, Any]) -> None:
-                if isinstance(payload, str):
+                if isinstance(payload, _NegotiatedText):
+                    ctype = payload.content_type
+                    data = payload.encode()
+                elif isinstance(payload, str):
                     # pre-rendered text bodies: playground HTML, or the
                     # Prometheus exposition format (/metrics)
                     ctype = ("text/html; charset=utf-8"
@@ -369,8 +421,11 @@ class HttpServer:
                     # _json_default converts Node/Edge/numpy lazily — an
                     # eager _jsonable() walk over every response value
                     # cost ~0.1ms/request on the search surface
+                    t_ser = time.perf_counter()
                     data = json.dumps(payload,
                                       default=_json_default).encode()
+                    obs.record_stage("http", "serialize",
+                                     time.perf_counter() - t_ser)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
@@ -411,11 +466,14 @@ class HttpServer:
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         payload: Dict[str, Any] = {}
         if body:
+            t_parse = time.perf_counter()
             try:
                 payload = json.loads(body)
             except json.JSONDecodeError:
                 raise HTTPError(400, "Neo.ClientError.Request.InvalidFormat",
                                 "request body must be JSON")
+            obs.record_stage("http", "parse",
+                             time.perf_counter() - t_parse)
 
         # public endpoints (no auth)
         if parsed.path == "/health":
@@ -426,6 +484,13 @@ class HttpServer:
             # should be rotated out of traffic, not restarted
             return self._readyz()
         if parsed.path == "/metrics":
+            # content negotiation: OpenMetrics (exemplars, # EOF) when
+            # asked for, classic Prometheus text — byte-compatible with
+            # what every prior round served — otherwise
+            accept = str(headers.get("Accept", "") or "") if headers else ""
+            if _accepts_openmetrics(accept):
+                return 200, self.metrics.render_openmetrics(
+                    self._metric_snapshot())
             return 200, self.metrics.render(self._metric_snapshot())
         if parsed.path == "/" and method == "GET":
             return 200, {"server": SERVER_NAME, "version": API_VERSION,
@@ -1192,6 +1257,13 @@ class HttpServer:
                 "latency": obs.latency_summary(include_empty=True),
                 "compile_universe": obs.compile_universe(),
                 "resources": obs.resource_snapshot(),
+                # stage decomposition + queueing fraction per surface:
+                # "slow because queued" vs "slow because compute" is
+                # one query here, not a histogram-math exercise
+                "stages": obs.stage_summary(),
+                # per-query device cost: flops/bytes per (kind, index),
+                # the pricing admission control / routing will consume
+                "cost": obs.cost_summary(),
                 "rate_limiter_clients":
                     self.rate_limiter.tracked_clients(),
             }

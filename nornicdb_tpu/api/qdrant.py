@@ -15,6 +15,7 @@ device indexes are rebuilt lazily on first search.
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -42,6 +43,10 @@ class QdrantError(ValueError):
 
 def _point_node_id(collection: str, point_id: Any) -> str:
     return f"{_POINT_PREFIX}{collection}/{point_id}"
+
+
+# per-instance ordinal for the upsert-convoy resource registration
+_CONVOY_SEQ = itertools.count(1)
 
 
 class QdrantCompat:
@@ -80,10 +85,25 @@ class QdrantCompat:
         self._cagra: Dict[str, Any] = {}
         # concurrent point upserts merge into one apply per collection:
         # one lock acquisition + one generation bump per convoy
+        from nornicdb_tpu.obs import register_resource
         from nornicdb_tpu.search.microbatch import BatchCoalescer
 
         self._upsert_coalescer = BatchCoalescer(
-            self._apply_upsert_batch, self._apply_upsert_single)
+            self._apply_upsert_batch, self._apply_upsert_single,
+            surface="qdrant:upsert_convoy")
+        # write convoys get the same queue-depth gauge + /readyz
+        # saturation check the search MicroBatchers got in PR 5. The
+        # registration name is per-INSTANCE (the resource registry keys
+        # by (family, name) and replaces on collision, so two live
+        # compat layers in one process must not shadow each other's
+        # gauge); the stage-histogram surface label above stays fixed
+        # to keep metric cardinality bounded.
+        seq = next(_CONVOY_SEQ)
+        self._convoy_resource_name = (
+            "qdrant:upsert_convoy" if seq == 1
+            else f"qdrant:upsert_convoy:{seq}")
+        register_resource("queue", self._convoy_resource_name,
+                          self._upsert_coalescer)
         self._lock = threading.Lock()
         # depth of in-progress writes by THIS layer (thread-local): its
         # own storage writes already maintain the indexes incrementally,
@@ -783,7 +803,11 @@ class QdrantCompat:
             if mb is None:
                 mb = MicroBatcher(
                     lambda queries, k, _n=name:
-                        self._ann_search_index(_n).search_batch(queries, k))
+                        self._ann_search_index(_n).search_batch(queries, k),
+                    # one bounded stage label for ALL collections — the
+                    # per-collection split lives in the resource gauges,
+                    # not in histogram label cardinality
+                    surface="qdrant")
                 self._microbatchers[name] = mb
                 from nornicdb_tpu.obs import register_resource
 

@@ -245,7 +245,16 @@ def aio_unary_raw(
 
     def serve(data: bytes) -> bytes:
         out = fn(data)
-        return out if isinstance(out, bytes) else out.SerializeToString()
+        if isinstance(out, bytes):
+            return out
+        # serialize stage: message -> wire bytes (the parse stage is
+        # timed symmetrically in _parse); pre-serialized ack templates
+        # and cache hits return bytes above and skip both
+        t0 = time.perf_counter()
+        data_out = out.SerializeToString()
+        obs.record_stage("grpc", "serialize",
+                         time.perf_counter() - t0)
+        return data_out
 
     latency = _GRPC_H.labels(method or "unknown")
 
@@ -291,7 +300,14 @@ def aio_unary_raw(
 
 
 def _parse(fn: Callable[[Any], Any], req_cls) -> Callable[[bytes], Any]:
-    return lambda data: fn(req_cls.FromString(data))
+    def parse_then(data: bytes):
+        # wire/parse stage of the request's latency decomposition
+        t0 = time.perf_counter()
+        req = req_cls.FromString(data)
+        obs.record_stage("grpc", "parse", time.perf_counter() - t0)
+        return fn(req)
+
+    return parse_then
 
 
 class _AckTemplate:

@@ -12,6 +12,13 @@ registered index/queue objects (the same snapshot gates ``/readyz``),
 and ``obs/slo.py`` computes multi-window SLO burn rates over the
 latency histograms with a breach-triggered JSONL flight recorder.
 
+The load-truth layer (ISSUE 7): ``obs/stages.py`` attributes each
+request's latency to serving stages (queue wait vs device compute),
+``obs/cost.py`` prices every device dispatch in FLOPs/bytes per query,
+and the histograms optionally tag bucket observations with the current
+trace id — exposed as OpenMetrics exemplars under content negotiation
+at ``/metrics``.
+
 Overhead discipline: a record call is a branch + dict probe + striped
 add (counters) or bisect + locked bucket increment (histograms); spans
 allocate one small object each; resource/SLO work happens only at
@@ -33,16 +40,22 @@ from nornicdb_tpu.obs.metrics import (
     Histogram,
     Registry,
     enabled,
+    exemplars_enabled,
     get_registry,
     latency_summary,
     set_enabled,
+    set_exemplars_enabled,
 )
+from nornicdb_tpu.obs import cost  # noqa: F401 — registers cost counters
 from nornicdb_tpu.obs import resources  # noqa: F401 — registers collector
 from nornicdb_tpu.obs import slo  # noqa: F401 — registers collector
+from nornicdb_tpu.obs import stages  # noqa: F401 — registers stage family
+from nornicdb_tpu.obs.cost import cost_summary, record_query_cost
 from nornicdb_tpu.obs.resources import register as register_resource
 from nornicdb_tpu.obs.resources import snapshot as resource_snapshot
 from nornicdb_tpu.obs.slo import SloEngine
 from nornicdb_tpu.obs.slo import get_engine as get_slo_engine
+from nornicdb_tpu.obs.stages import record_stage, stage_summary
 from nornicdb_tpu.obs.tracing import (
     TRACES,
     Span,
@@ -50,6 +63,7 @@ from nornicdb_tpu.obs.tracing import (
     annotate,
     attach_span,
     current_span,
+    current_trace_id,
     span,
     trace,
 )
@@ -69,17 +83,26 @@ __all__ = [
     "annotate",
     "attach_span",
     "compile_universe",
+    "cost",
+    "cost_summary",
     "current_span",
+    "current_trace_id",
     "enabled",
+    "exemplars_enabled",
     "get_registry",
     "get_slo_engine",
     "latency_summary",
     "record_dispatch",
+    "record_query_cost",
+    "record_stage",
     "register_resource",
     "resource_snapshot",
     "resources",
     "set_enabled",
+    "set_exemplars_enabled",
     "slo",
     "span",
+    "stage_summary",
+    "stages",
     "trace",
 ]

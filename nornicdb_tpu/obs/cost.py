@@ -1,0 +1,146 @@
+"""Per-query device cost accounting: what does one search *cost*?
+
+The roadmap's admission control (item 4) and replica routing (item 3)
+both need to reason about load in resource units, not just QPS — a
+brute matmul over a 10M-row matrix and a 16-iteration graph walk are
+wildly different answers to "one query". This module prices every
+batched device dispatch from its KNOWN shapes at dispatch time:
+
+- brute cosine top-k: a ``[B, D] x [D, C]`` matmul — ``2*B*C*D`` FLOPs,
+  the matrix + queries + scores moved once;
+- CAGRA walk: the wide seed round plus ``iters`` frontier expansions of
+  ``width * degree`` candidate distance evaluations per query;
+- device BM25: the CSR gather/segment-sum over the batch's unique-term
+  postings (nnz) plus the ``[B, U] x [U, C]`` idf-weighted matmul;
+- fused hybrid: lexical + vector tier + the RRF fuse, composed from
+  the pieces above.
+
+Costs land in three counters labeled ``{kind, index}`` (index = the
+structure's resource-registration name, so aggregation follows the
+same identity as the memory/freshness gauges — per service database or
+per qdrant collection):
+
+- ``nornicdb_query_cost_flops_total``
+- ``nornicdb_query_cost_bytes_total`` (device bytes touched)
+- ``nornicdb_query_cost_queries_total`` (REAL queries served, pre-pad)
+
+FLOPs/bytes are priced at the PADDED shapes (the device executes the
+pow2 bucket, not the request) while queries count the real batch —
+``cost_summary()``'s flops-per-query therefore includes padding waste,
+which is exactly what a router deciding where to send one more query
+needs to see. Estimates are arithmetic-only (no memory-hierarchy
+model): stable units for relative pricing, not a roofline claim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from nornicdb_tpu.obs import metrics as _m
+from nornicdb_tpu.obs.metrics import REGISTRY, Registry
+
+_F32 = 4  # bytes
+
+_FLOPS_C = REGISTRY.counter(
+    "nornicdb_query_cost_flops_total",
+    "Estimated device FLOPs spent, by dispatch kind and index",
+    labels=("kind", "index"))
+_BYTES_C = REGISTRY.counter(
+    "nornicdb_query_cost_bytes_total",
+    "Estimated device bytes touched, by dispatch kind and index",
+    labels=("kind", "index"))
+_QUERIES_C = REGISTRY.counter(
+    "nornicdb_query_cost_queries_total",
+    "Real (pre-padding) queries priced, by dispatch kind and index",
+    labels=("kind", "index"))
+
+
+def pricing_enabled() -> bool:
+    """Gate for call sites: skip the pricing arithmetic (unique-term
+    sets, stats lookups) entirely when telemetry is off, not just the
+    counter increments — the zero-overhead discipline obs documents."""
+    return _m.enabled()
+
+
+# -- pricing functions (pure) ------------------------------------------------
+
+
+def price_brute(b: int, rows: int, d: int) -> Tuple[float, float]:
+    """(flops, bytes) of one brute cosine top-k dispatch: [b,d]x[d,rows]
+    matmul over the capacity-padded matrix."""
+    flops = 2.0 * b * rows * d
+    bytes_ = _F32 * (rows * d + b * d + b * rows)
+    return flops, bytes_
+
+
+def price_walk(b: int, d: int, iters: int, width: int, degree: int,
+               itopk: int, n_seeds: int = 1024) -> Tuple[float, float]:
+    """(flops, bytes) of one batched CAGRA greedy walk: the wide seed
+    round then ``iters`` expansions of ``width*degree`` distance evals,
+    each a d-dim dot product, plus the itopk pool maintenance."""
+    evals = float(n_seeds + iters * width * degree)
+    flops = b * (evals * 2.0 * d + iters * itopk * 2.0)
+    bytes_ = _F32 * b * (evals * d + iters * degree * width)
+    return flops, bytes_
+
+
+def price_bm25(b: int, nnz: int, unique_terms: int,
+               rows: int) -> Tuple[float, float]:
+    """(flops, bytes) of one device-BM25 scoring dispatch: tf/idf math +
+    segment-sum over the batch's unique-term postings (nnz), then the
+    [b, U] x [U, rows] idf-weighted score matmul."""
+    flops = 8.0 * nnz + 2.0 * b * max(unique_terms, 1) * rows
+    bytes_ = _F32 * (2 * nnz + b * max(unique_terms, 1) + b * rows)
+    return flops, bytes_
+
+
+# -- recording ---------------------------------------------------------------
+
+
+def cost_name(obj: Any) -> str:
+    """The structure's resource-accounting identity (stamped by
+    ``obs.resources.register``), or 'unregistered'."""
+    return getattr(obj, "_obs_resource_name", None) or "unregistered"
+
+
+def record_query_cost(kind: str, index: str, queries: int,
+                      flops: float, bytes_: float) -> None:
+    """Record one priced dispatch. ``queries`` is the REAL batch size
+    (pre-padding); flops/bytes are the padded program's."""
+    if not _m.enabled():
+        return
+    _FLOPS_C.labels(kind, index).inc(flops)
+    _BYTES_C.labels(kind, index).inc(bytes_)
+    _QUERIES_C.labels(kind, index).inc(queries)
+
+
+def cost_summary(registry: Optional[Registry] = None
+                 ) -> List[Dict[str, Any]]:
+    """Aggregated cost-per-query per (kind, index): the telemetry that
+    admission control / replica routing consume. Scrape-time only."""
+    reg = registry if registry is not None else REGISTRY
+    fams = {
+        "flops": reg.get("nornicdb_query_cost_flops_total"),
+        "bytes": reg.get("nornicdb_query_cost_bytes_total"),
+        "queries": reg.get("nornicdb_query_cost_queries_total"),
+    }
+    if any(f is None for f in fams.values()):
+        return []
+    children = {name: fam.children() for name, fam in fams.items()}
+    out: List[Dict[str, Any]] = []
+    for key in sorted(children["queries"]):
+        kind, index = key
+        queries = children["queries"][key].value
+        if queries <= 0:
+            continue
+        flops = (children["flops"].get(key).value
+                 if key in children["flops"] else 0.0)
+        byts = (children["bytes"].get(key).value
+                if key in children["bytes"] else 0.0)
+        out.append({
+            "kind": kind, "index": index, "queries": int(queries),
+            "flops_total": flops, "bytes_total": byts,
+            "flops_per_query": round(flops / queries, 1),
+            "bytes_per_query": round(byts / queries, 1),
+        })
+    return out

@@ -59,6 +59,43 @@ def enabled() -> bool:
     return _enabled
 
 
+# -- exemplars ---------------------------------------------------------------
+#
+# Histograms optionally remember, per bucket, the trace id of the most
+# recent observation that landed there — so a p99 spike on a dashboard
+# links to a concrete trace in /admin/traces. The trace id comes from a
+# provider callback (registered by obs/tracing at import; metrics stays
+# importable standalone). Exemplars surface ONLY in the OpenMetrics
+# exposition (content-negotiated at /metrics); the classic Prometheus
+# text stays byte-identical with tagging on or off.
+
+_exemplar_provider: Optional[Callable[[], Optional[str]]] = None
+
+
+def _env_exemplars_default() -> bool:
+    return os.environ.get("NORNICDB_OBS_EXEMPLARS", "1").lower() \
+        not in ("0", "false", "off")
+
+
+_exemplars_enabled = _env_exemplars_default()
+
+
+def set_exemplar_provider(fn: Optional[Callable[[], Optional[str]]]) -> None:
+    global _exemplar_provider
+    _exemplar_provider = fn
+
+
+def set_exemplars_enabled(value: bool) -> None:
+    """Runtime toggle (initial state from NORNICDB_OBS_EXEMPLARS,
+    default on). Off = observe() skips the provider call entirely."""
+    global _exemplars_enabled
+    _exemplars_enabled = bool(value)
+
+
+def exemplars_enabled() -> bool:
+    return _exemplars_enabled
+
+
 # request-latency buckets (seconds): 50us floor (cache-hit wire replies
 # land there) to 10s ceiling, roughly x2-x2.5 steps — 17 buckets
 LATENCY_BUCKETS: Tuple[float, ...] = (
@@ -142,7 +179,8 @@ class Histogram:
     """Fixed-bucket histogram. ``observe`` is a bisect + one locked
     bucket increment; cumulative counts are computed at render time."""
 
-    __slots__ = ("_bounds", "_lock", "_counts", "_sum", "_count")
+    __slots__ = ("_bounds", "_lock", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS) -> None:
         bounds = tuple(sorted(float(b) for b in buckets))
@@ -153,21 +191,41 @@ class Histogram:
         self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf
         self._sum = 0.0
         self._count = 0
+        # per-bucket (trace_id, value, ts) of the latest traced
+        # observation; allocated lazily on the first tagged observe so
+        # untraced histograms pay nothing
+        self._exemplars: Optional[List[Optional[Tuple[str, float, float]]]] \
+            = None
 
     def observe(self, value: float) -> None:
         if not _enabled:
             return
         i = bisect_left(self._bounds, value)
+        tid = None
+        if _exemplars_enabled and _exemplar_provider is not None:
+            tid = _exemplar_provider()
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if tid is not None:
+                if self._exemplars is None:
+                    self._exemplars = [None] * len(self._counts)
+                self._exemplars[i] = (tid, value, time.time())
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             counts = list(self._counts)
             return {"buckets": list(self._bounds), "counts": counts,
                     "sum": self._sum, "count": self._count}
+
+    def exemplars(self) -> List[Optional[Tuple[str, float, float]]]:
+        """Per-bucket (trace_id, value, ts) or None — same slot order as
+        ``snapshot()['counts']`` (+Inf last)."""
+        with self._lock:
+            if self._exemplars is None:
+                return [None] * len(self._counts)
+            return list(self._exemplars)
 
     def quantile(self, q: float) -> Optional[float]:
         """Bucket-interpolated quantile estimate (Prometheus
@@ -317,6 +375,51 @@ class _Family:
                 lbl = _fmt_labels(self.label_names, key)
                 out.append(f"{self.name}{lbl} {_fmt_float(child.value)}")
 
+    def render_openmetrics(self, out: List[str]) -> None:
+        """OpenMetrics exposition of this family. Differences from the
+        classic text: counter families are named WITHOUT the ``_total``
+        suffix in TYPE/HELP (samples keep it, per the OM spec), bucket
+        ``le`` values are canonical floats, and histogram bucket lines
+        carry ``# {trace_id=...} value ts`` exemplars when tagged."""
+        name = self.name
+        if self.kind == "counter":
+            base = name[:-6] if name.endswith("_total") else name
+            out.append(f"# TYPE {base} counter")
+            if self.help:
+                out.append(f"# HELP {base} {self.help}")
+            sample = base + "_total" if name.endswith("_total") else name
+            for key, child in sorted(self.children().items()):
+                lbl = _fmt_labels(self.label_names, key)
+                out.append(f"{sample}{lbl} {_fmt_float(child.value)}")
+            return
+        out.append(f"# TYPE {name} {self.kind}")
+        if self.help:
+            out.append(f"# HELP {name} {self.help}")
+        for key, child in sorted(self.children().items()):
+            if self.kind == "histogram":
+                snap = child.snapshot()
+                exemplars = child.exemplars()
+                cum = 0
+                bounds = list(snap["buckets"]) + [None]  # None = +Inf
+                for i, bound in enumerate(bounds):
+                    cum += snap["counts"][i]
+                    le = "+Inf" if bound is None else repr(float(bound))
+                    lbl = _fmt_labels(self.label_names, key, ("le", le))
+                    line = f"{name}_bucket{lbl} {cum}"
+                    ex = exemplars[i]
+                    if ex is not None:
+                        tid, val, ts = ex
+                        line += (f' # {{trace_id="{_escape_label(tid)}"}}'
+                                 f" {_fmt_float(val)} {ts:.3f}")
+                    out.append(line)
+                base_l = _fmt_labels(self.label_names, key)
+                out.append(
+                    f"{name}_sum{base_l} {_fmt_float(snap['sum'])}")
+                out.append(f"{name}_count{base_l} {snap['count']}")
+            else:
+                lbl = _fmt_labels(self.label_names, key)
+                out.append(f"{name}{lbl} {_fmt_float(child.value)}")
+
 
 def _fmt_float(v: float) -> str:
     f = float(v)
@@ -422,6 +525,24 @@ class Registry:
         for name, value in sorted((extra_gauges or {}).items()):
             out.append(f"# TYPE {name} gauge")
             out.append(f"{name} {_fmt_float(value)}")
+        return "\n".join(out) + "\n"
+
+    OPENMETRICS_CONTENT_TYPE = (
+        "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
+    def render_openmetrics(
+            self, extra_gauges: Optional[Dict[str, float]] = None) -> str:
+        """OpenMetrics 1.0 exposition (exemplars included, ``# EOF``
+        terminated). Served at /metrics under content negotiation; the
+        classic ``render()`` text is untouched by exemplar tagging."""
+        self.run_collectors()
+        out: List[str] = []
+        for fam in sorted(self.families(), key=lambda f: f.name):
+            fam.render_openmetrics(out)
+        for name, value in sorted((extra_gauges or {}).items()):
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {_fmt_float(value)}")
+        out.append("# EOF")
         return "\n".join(out) + "\n"
 
 

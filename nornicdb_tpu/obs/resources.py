@@ -90,6 +90,14 @@ def register(family: str, name: str, obj: Any) -> None:
     must expose ``resource_stats() -> dict`` (indexes) or
     ``queue_depth() -> int`` (queues). Registration replaces any prior
     object under the same (family, name) — index reloads re-register."""
+    try:
+        # stamp the registration identity so the cost accounting
+        # (obs/cost.py) labels per-dispatch prices with the same name
+        # as the memory/freshness gauges; best-effort (slotted or
+        # foreign objects simply price as 'unregistered')
+        obj._obs_resource_name = str(name)
+    except Exception:  # noqa: BLE001
+        pass
     with _lock:
         _objects[(str(family), str(name))] = weakref.ref(obj)
 

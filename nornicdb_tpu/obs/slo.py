@@ -289,6 +289,7 @@ class SloEngine:
         resource snapshots, and the slow-trace ring — everything needed
         to reconstruct the breach after the fact."""
         from nornicdb_tpu.obs import resources as _resources
+        from nornicdb_tpu.obs import stages as _stages
         from nornicdb_tpu.obs.dispatch import compile_universe
         from nornicdb_tpu.obs.tracing import TRACES
 
@@ -303,6 +304,10 @@ class SloEngine:
             {"kind": "latency",
              "summary": _m.latency_summary(self.registry,
                                            include_empty=True)},
+            # stage decomposition + queueing fraction: a breach record
+            # must answer "queued or compute?" without a live node
+            {"kind": "stages",
+             "summary": _stages.stage_summary(self.registry)},
             {"kind": "resources", "snapshot": _resources.snapshot()},
             {"kind": "compile_universe", "shapes": compile_universe()},
         ]

@@ -19,6 +19,7 @@ bound keeps memory flat). The HTTP admin surface exposes it at
 from __future__ import annotations
 
 import contextvars
+import itertools
 import os
 import threading
 import time
@@ -26,9 +27,20 @@ from typing import Any, Dict, List, Optional
 
 from nornicdb_tpu.obs import metrics as _m
 
+# trace-id generation: a per-process random prefix + monotone counter.
+# Cheaper than uuid4 on the hot path (every request mints one) and
+# unique across processes with overwhelming probability — the id only
+# needs to join a /metrics exemplar to a ring entry on the same node.
+_TRACE_PREFIX = os.urandom(4).hex()
+_trace_seq = itertools.count(1)
+
+
+def _new_trace_id() -> str:
+    return f"{_TRACE_PREFIX}{next(_trace_seq):08x}"
+
 
 class Span:
-    __slots__ = ("name", "t0", "t1", "attrs", "children")
+    __slots__ = ("name", "t0", "t1", "attrs", "children", "trace_id")
 
     def __init__(self, name: str, t0: Optional[float] = None,
                  **attrs: Any) -> None:
@@ -37,6 +49,8 @@ class Span:
         self.t1: Optional[float] = None
         self.attrs: Dict[str, Any] = attrs
         self.children: List["Span"] = []
+        # set on ROOT spans only (trace()); None on children
+        self.trace_id: Optional[str] = None
 
     def finish(self, t1: Optional[float] = None) -> None:
         self.t1 = time.time() if t1 is None else t1
@@ -50,13 +64,16 @@ class Span:
         self.attrs.update(attrs)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        doc = {
             "name": self.name,
             "start_ms": round(self.t0 * 1e3, 3),
             "duration_ms": round(self.duration_ms, 3),
             "attrs": dict(self.attrs),
             "children": [c.to_dict() for c in self.children],
         }
+        if self.trace_id is not None:
+            doc["trace_id"] = self.trace_id
+        return doc
 
     def span_names(self) -> List[str]:
         """Flattened names, depth-first — test/diagnostic helper."""
@@ -68,6 +85,16 @@ class Span:
 
 _current: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
     "nornicdb_obs_span", default=None)
+# the ROOT span's trace id, visible to every layer under it (exemplar
+# tagging reads this on histogram observes without walking the tree)
+_current_tid: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "nornicdb_obs_trace_id", default=None)
+
+
+def current_trace_id() -> Optional[str]:
+    """Trace id of the active request, or None outside any trace — the
+    exemplar provider the metrics layer reads on histogram observes."""
+    return _current_tid.get()
 
 
 class TraceBuffer:
@@ -132,15 +159,19 @@ def current_span() -> Optional[Span]:
 class _ActiveSpan:
     """Context manager binding a span as the contextvar current."""
 
-    __slots__ = ("span", "_token", "_root")
+    __slots__ = ("span", "_token", "_root", "_tid_token")
 
     def __init__(self, span: Span, root: bool) -> None:
         self.span = span
         self._root = root
         self._token = None
+        self._tid_token = None
 
     def __enter__(self) -> Span:
         self._token = _current.set(self.span)
+        if self._root:
+            self.span.trace_id = _new_trace_id()
+            self._tid_token = _current_tid.set(self.span.trace_id)
         return self.span
 
     def __exit__(self, exc_type, exc, tb) -> None:
@@ -149,6 +180,7 @@ class _ActiveSpan:
             self.span.attrs.setdefault("error", f"{exc_type.__name__}")
         _current.reset(self._token)
         if self._root:
+            _current_tid.reset(self._tid_token)
             TRACES.record(self.span)
 
 
@@ -210,3 +242,9 @@ def annotate(**attrs: Any) -> None:
     cur = _current.get()
     if cur is not None:
         cur.attrs.update(attrs)
+
+
+# exemplar wiring: histograms ask "what trace is observing right now?"
+# via this provider. Registered here (not in metrics.py) because
+# metrics must stay importable without tracing.
+_m.set_exemplar_provider(current_trace_id)

@@ -757,6 +757,16 @@ class CagraIndex:
         # so timing the call alone would record enqueue, not the walk
         s_host, i_host = np.asarray(s), np.asarray(i)
         record_dispatch("cagra_walk", bb, kb, time.time() - t0)
+        # per-query cost: seed round + iters x width x degree distance
+        # evals at the padded batch; real (pre-pad) queries counted
+        from nornicdb_tpu.obs import cost as _cost
+
+        if _cost.pricing_enabled():
+            flops, byts = _cost.price_walk(
+                bb, int(queries.shape[1]), n_iters, w, self.degree, p,
+                n_seeds=self.n_seeds)
+            _cost.record_query_cost("cagra_walk", _cost.cost_name(self),
+                                    b, flops, byts)
         out = self._resolve(g, s_host[:b], i_host[:b], k_eff)
         if delta_ids:
             _CAGRA_C.labels("delta_merge").inc()

@@ -210,6 +210,10 @@ class DeviceBM25:
         self._alive_lock = threading.Lock()
         self._map_lock = threading.Lock()
         self._delta_cache: Optional[Tuple] = None
+        # per-thread (nnz, unique_terms) from the latest plan() on this
+        # thread — cost pricing reads it instead of re-deriving the
+        # unique-term set and df stats on the hot path
+        self._plan_cost = threading.local()
         self.builds = 0
 
     # -- build ------------------------------------------------------------
@@ -534,6 +538,8 @@ class DeviceBM25:
         s_n = snap["shards"]
         uniq_all = sorted({t for row in token_rows for t in row})
         dfs, n_alive, avgdl = self.bm25.term_stats(uniq_all)
+        self._plan_cost.stats = (float(sum(dfs.values())),
+                                 len(uniq_all))
         n = max(n_alive, 1)
         # unique scoring terms, in sorted order (the host accumulation
         # order); their idf rides the selection matrix
@@ -680,6 +686,21 @@ class DeviceBM25:
             _LEX_C.labels("host_fallback_overflow").inc()
             return self.bm25.search_batch(queries, k)
         record_dispatch("bm25_score", bb, kb, time.time() - t0)
+        # per-query cost: the CSR nnz actually gathered is the batch's
+        # unique-term posting mass (the scatter runs once per unique
+        # term), plus the [B, U] x [U, C] idf-weighted score matmul.
+        # Best-effort and gated — pricing must never fail or slow a
+        # search with telemetry off
+        from nornicdb_tpu.obs import cost as _cost
+
+        if _cost.pricing_enabled():
+            try:
+                nnz, u = self._plan_cost.stats  # stashed by plan()
+                flops, byts = _cost.price_bm25(bb, nnz, u, c_total)
+                _cost.record_query_cost(
+                    "bm25_score", _cost.cost_name(self), b, flops, byts)
+            except Exception:  # noqa: BLE001
+                pass
         out = self._resolve(snap, s[:b], i[:b], min(k, kb))
         if delta:
             _LEX_C.labels("delta_merge").inc()

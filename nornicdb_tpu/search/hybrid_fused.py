@@ -66,6 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nornicdb_tpu.obs import REGISTRY, declare_kind, record_dispatch
+from nornicdb_tpu.obs import cost as _cost
 from nornicdb_tpu.ops.similarity import NEG_INF, l2_normalize
 from nornicdb_tpu.search.bm25 import BM25Index
 from nornicdb_tpu.search.cagra import (
@@ -501,6 +502,31 @@ class FusedHybrid:
         self._vec_placed = (m, mp, vp)
         return mp, vp
 
+    def _record_cost(self, kind: str, b: int, snap: Dict[str, Any],
+                     vec_flops_bytes: Tuple[float, float]) -> None:
+        """Per-query cost accounting for one fused dispatch: the vector
+        tier's price (matmul or walk, passed in) plus the lexical CSR
+        price from the (nnz, unique-terms) the lexical plan() just
+        stashed on this thread. The lexical matmul is priced at the
+        snapshot's PADDED doc width (shards * c_local — the shape the
+        program executes, same as DeviceBM25's standalone pricing), not
+        the live row count. Best-effort — pricing must never fail a
+        search, and with telemetry off the arithmetic is skipped
+        entirely."""
+        if not _cost.pricing_enabled():
+            return
+        try:
+            nnz, u = self.lex._plan_cost.stats
+            lex_f, lex_b = _cost.price_bm25(
+                pow2_bucket(max(b, 1)), nnz, u,
+                int(snap["shards"]) * int(snap["c_local"]))
+            vec_f, vec_b = vec_flops_bytes
+            _cost.record_query_cost(
+                kind, _cost.cost_name(self.lex), b,
+                lex_f + vec_f, lex_b + vec_b)
+        except Exception:  # noqa: BLE001
+            pass
+
     # -- search -----------------------------------------------------------
 
     def search_batch(
@@ -607,6 +633,10 @@ class FusedHybrid:
         t1 = time.time()
         record_dispatch("hybrid_fused", pow2_bucket(b), kq, t1 - t0)
         _HYB_C.labels("dispatch").inc()
+        self._record_cost("hybrid_fused", b, snap,
+                          vec_flops_bytes=_cost.price_brute(
+                              pow2_bucket(b), int(m.shape[0]),
+                              int(m.shape[1])))
         out = self._decode(snap, vec_ext, delta, token_rows, extras,
                            ls, lgrow, vs, vi, fs, fpos, kq)
         if delta:
@@ -708,6 +738,12 @@ class FusedHybrid:
         record_dispatch("hybrid_walk_fused", pow2_bucket(b), kp,
                         t1 - t0)
         _HYB_C.labels("walk_dispatch").inc()
+        self._record_cost("hybrid_walk_fused", b, snap,
+                          vec_flops_bytes=_cost.price_walk(
+                              pow2_bucket(b), int(g["matrix"].shape[1]),
+                              wctx["iters"], wctx["width"],
+                              int(g["adj"].shape[1]), wctx["itopk"],
+                              n_seeds=wctx["n_seeds"]))
         out = self._decode(
             snap, g["row_ids"], delta, token_rows, extras,
             ls, lgrow, vs, vi, fs, fpos, kp,

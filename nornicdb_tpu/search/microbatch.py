@@ -29,6 +29,7 @@ from nornicdb_tpu.obs import (
     SIZE_BUCKETS,
     attach_span,
     record_dispatch,
+    record_stage,
 )
 
 # one metric family set shared by every batcher instance (per-collection
@@ -71,17 +72,31 @@ class BatchCoalescer:
     application so one poisoned item cannot fail its convoy-mates.
     """
 
-    def __init__(self, apply_batch, apply_single=None, max_batch: int = 64):
+    def __init__(self, apply_batch, apply_single=None, max_batch: int = 64,
+                 surface: str = "convoy"):
         self._apply_batch = apply_batch
         self._apply_single = apply_single
         self._max_batch = max_batch
+        # bounded stage-attribution label for
+        # nornicdb_request_stage_seconds{surface,...} — code-chosen, one
+        # value per coalescer role (never client-derived)
+        self._surface = surface
         self._cond = threading.Condition()
         self._pending: List["_Item"] = []
         self._busy = False
         self.batches = 0
         self.batched_items = 0
 
+    def queue_depth(self) -> int:
+        """Live pending items (not yet claimed by a convoy leader) —
+        same contract as MicroBatcher.queue_depth, so write convoys get
+        the nornicdb_queue_depth gauge and the /readyz saturation check
+        when registered with obs/resources."""
+        with self._cond:
+            return len(self._pending)
+
     def submit(self, value: Any) -> Any:
+        t_enq = time.time()
         item = _Item(value)
         with self._cond:
             self._pending.append(item)
@@ -105,6 +120,18 @@ class BatchCoalescer:
                     self._cond.notify_all()
             if item.done:
                 break
+        if item.apply_t1:
+            # queue-delay attribution + trace spans: the wait from
+            # enqueue to the leader sealing our convoy, and the shared
+            # merged apply every convoy-mate experienced
+            record_stage(self._surface, "coalesce_wait",
+                         item.apply_t0 - t_enq)
+            record_stage(self._surface, "apply",
+                         item.apply_t1 - item.apply_t0)
+            attach_span("coalesce.wait", t_enq, item.apply_t0,
+                        surface=self._surface, batch=item.batch_size)
+            attach_span("apply", item.apply_t0, item.apply_t1,
+                        surface=self._surface, batch=item.batch_size)
         if item.error is not None:
             raise item.error
         return item.result
@@ -113,6 +140,10 @@ class BatchCoalescer:
         self.batches += 1
         self.batched_items += len(batch)
         _CONVOY_H.observe(len(batch))
+        t0 = time.time()
+        for item in batch:
+            item.apply_t0 = t0
+            item.batch_size = len(batch)
         try:
             results = self._apply_batch([i.value for i in batch])
             for item, res in zip(batch, results):
@@ -129,18 +160,25 @@ class BatchCoalescer:
                         item.result = self._apply_single(item.value)
                     except Exception as single_exc:  # noqa: BLE001
                         item.error = single_exc
+        t1 = time.time()
         for item in batch:
+            item.apply_t1 = t1
             item.done = True
 
 
 class _Item:
-    __slots__ = ("value", "done", "result", "error")
+    __slots__ = ("value", "done", "result", "error", "apply_t0",
+                 "apply_t1", "batch_size")
 
     def __init__(self, value: Any):
         self.value = value
         self.done = False
         self.result: Any = None
         self.error: Any = None
+        # stamped by the convoy leader: the shared merged-apply interval
+        self.apply_t0 = 0.0
+        self.apply_t1 = 0.0
+        self.batch_size = 0
 
 
 class _Req:
@@ -175,9 +213,14 @@ class MicroBatcher:
         gather_window_s: float = 0.0005,
         pass_extras: bool = False,
         truncate: bool = True,
+        surface: str = "search",
     ):
         self._search_batch = search_batch
         self._max_batch = max_batch
+        # bounded stage-attribution label (code-chosen per batcher role:
+        # "service:vector", "service:hybrid", "qdrant", ...) for the
+        # nornicdb_request_stage_seconds{surface,stage} histograms
+        self._surface = surface
         # pass_extras: dispatch as search_batch(queries, k, extras) with
         # one opaque per-request item (the hybrid path rides tokenized
         # query terms and per-request fusion options alongside the
@@ -255,19 +298,27 @@ class MicroBatcher:
         self._trace_req(req, t_enq)
         return req.result
 
-    @staticmethod
-    def _trace_req(req: "_Req", t_enq: float) -> None:
-        """Graft this request's coalescing story into the active trace:
-        the wait from enqueue to the (leader-stamped) device dispatch,
-        the shared dispatch interval, and the post-dispatch merge. No-op
-        when no trace is active or the request errored before dispatch."""
+    def _trace_req(self, req: "_Req", t_enq: float) -> None:
+        """Graft this request's coalescing story into the active trace
+        AND the per-stage latency histograms: the wait from enqueue to
+        the (leader-stamped) device dispatch, the shared dispatch
+        interval, and the post-dispatch merge. The histogram half runs
+        even without an active trace — fleet-wide queue-delay
+        attribution must not depend on tracing. No-op when the request
+        errored before dispatch."""
         if not req.dispatch_t1:
             return
+        t_done = time.time()
+        record_stage(self._surface, "coalesce_wait",
+                     req.dispatch_t0 - t_enq)
+        record_stage(self._surface, "device_dispatch",
+                     req.dispatch_t1 - req.dispatch_t0)
+        record_stage(self._surface, "merge", t_done - req.dispatch_t1)
         attach_span("coalesce.wait", t_enq, req.dispatch_t0,
-                    batch=req.batch_size)
+                    surface=self._surface, batch=req.batch_size)
         attach_span("device.dispatch", req.dispatch_t0, req.dispatch_t1,
-                    batch=req.batch_size, k=req.k)
-        attach_span("merge", req.dispatch_t1, time.time())
+                    surface=self._surface, batch=req.batch_size, k=req.k)
+        attach_span("merge", req.dispatch_t1, t_done)
 
     def _run(self, batch: List[_Req]) -> None:
         try:

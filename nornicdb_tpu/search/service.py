@@ -173,7 +173,8 @@ class SearchService:
         # dispatch resolves the ACTIVE ANN index per batch (cagra once
         # built, else brute), so the coalescing window feeds whichever
         # device index the strategy machine currently owns
-        self._microbatch = MicroBatcher(self._ann_search_batch)
+        self._microbatch = MicroBatcher(self._ann_search_batch,
+                                        surface="service:vector")
         # fused hybrid pipeline (hybrid_fused.py): concurrent hybrid
         # searches coalesce here into ONE device dispatch that scores
         # BM25 + cosine + RRF end-to-end, instead of convoying on the
@@ -182,7 +183,8 @@ class SearchService:
         # them (pass_extras/truncate flags).
         self._fused = None
         self._hybrid_batch = MicroBatcher(
-            self._fused_hybrid_dispatch, pass_extras=True, truncate=False)
+            self._fused_hybrid_dispatch, pass_extras=True, truncate=False,
+            surface="service:hybrid")
         # resource & freshness accounting (obs/resources.py): register
         # the index structures and coalescing queues so /metrics carries
         # their device-memory/staleness gauges and /readyz can gate on

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from nornicdb_tpu.obs import cost as _cost
 from nornicdb_tpu.ops.similarity import (
     CHUNKED_THRESHOLD,
     cosine_topk,
@@ -380,6 +381,14 @@ class BruteForceIndex:
             if self._n_alive == 0:
                 return [[] for _ in range(len(queries))]
             k_eff = min(k, self._n_alive)
+            # per-query cost accounting: the brute scan's price is its
+            # known shapes — B queries against the capacity-padded
+            # [C, D] matrix (host or device, the arithmetic is the same)
+            if _cost.pricing_enabled():
+                flops, byts = _cost.price_brute(
+                    len(queries), self._capacity, self.dims or 1)
+                _cost.record_query_cost("brute", _cost.cost_name(self),
+                                        len(queries), flops, byts)
             if self._capacity * (self.dims or 1) <= self._SMALL_HOST:
                 # no defensive copies: the whole host search runs under
                 # the lock and only reads the matrix/valid/ext_ids

@@ -19,7 +19,11 @@ trajectory, or an explicit ``--baseline`` file), and applies
   for with ranking quality is a regression, not a win);
 - *compile-universe growth* — the fused pipeline's distinct (B, k)
   bucket count may not grow past baseline + allowance (bucket churn =
-  unbounded XLA compiles at serve time).
+  unbounded XLA compiles at serve time);
+- *latency ceilings* — the open-loop harness's ``p99_at_load`` may not
+  balloon past tolerance x baseline (lower is better: a throughput win
+  paid for with tail latency under load is how queueing collapse hides
+  from closed-loop gates).
 
 Output: one JSON verdict line (exit 1 on regression); with
 ``--emit-summary`` the artifact's compact summary is re-emitted as the
@@ -47,7 +51,7 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 # metric -> ("qps", floor_tolerance) | ("quality", abs_floor, max_drop)
-#         | ("growth", allowance)
+#         | ("growth", allowance) | ("latency", ceiling_tolerance)
 CHECKS: Dict[str, Tuple] = {
     "cypher_geomean": ("qps", 0.6),
     "knn_b1_qps": ("qps", 0.6),
@@ -67,6 +71,13 @@ CHECKS: Dict[str, Tuple] = {
     "surface_graphql_qps": ("qps", 0.2),
     "surface_rest_search_qps": ("qps", 0.2),
     "surface_qdrant_grpc_qps": ("qps", 0.2),
+    # open-loop load harness (round r07+): the saturation knee rides
+    # the same contended-box caveat as the surface benches; the
+    # p99-at-load LATENCY gate is the tail-latency-under-load floor
+    # future batching/admission PRs are held to — lower is better, so
+    # it flags when fresh > tolerance x baseline
+    "load_knee_qps": ("qps", 0.2),
+    "load_p99_at_load_ms": ("latency", 5.0),
     "cagra_recall10": ("quality", 0.90, 0.05),
     "hybrid_rank_parity": ("quality", 0.98, 0.02),
     "hybrid_walk_recall10": ("quality", 0.95, 0.02),
@@ -118,6 +129,14 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     out["pagerank_speedup"] = _num(
         doc.get("pagerank_speedup_vs_numpy") if is_summary
         else _g(doc, "northstar", "pagerank_device", "speedup_vs_numpy"))
+    load = doc.get("load") or {}
+    out["load_knee_qps"] = _num(
+        load.get("knee_qps") if is_summary
+        else _g(load, "surfaces", "qdrant_grpc_search", "knee_qps"))
+    out["load_p99_at_load_ms"] = _num(
+        load.get("p99_at_load_ms") if is_summary
+        else _g(load, "surfaces", "qdrant_grpc_search",
+                "p99_at_load_ms"))
     surfaces = doc.get("surfaces") or {}
     for name in ("bolt", "neo4j_http", "graphql", "rest_search",
                  "qdrant_grpc"):
@@ -243,6 +262,17 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
                     "metric": metric, "kind": "growth_cap",
                     "fresh": f, "baseline": b,
                     "cap": b + allowance})
+            else:
+                passed.append(metric)
+        elif kind == "latency":
+            # CEILING check (lower is better): tail latency under load
+            # may not balloon past tolerance x the trajectory baseline
+            tol = overrides.get(metric, spec[1])
+            if b > 0 and f > tol * b:
+                flagged.append({
+                    "metric": metric, "kind": "latency_ceiling",
+                    "fresh": f, "baseline": b,
+                    "ratio": round(f / b, 3), "tolerance": tol})
             else:
                 passed.append(metric)
     return {

@@ -1,0 +1,122 @@
+"""Metric-catalog drift lint: every import-time metric family must be
+documented.
+
+``docs/observability.md`` is the operator-facing catalog of the
+``nornicdb_*`` metric families; nothing enforced it, so a new family
+could ship undocumented (two did, before this lint). This tool imports
+every module that registers metric families at import time, then fails
+when a family in the process registry has no mention in the catalog.
+
+Scope is deliberately import-time registration: lazily-created families
+(per-request server counters, WireCache instances) only exist under
+traffic, so the lint covers exactly the set a fresh process exposes at
+first scrape. Doc references may use brace shorthand —
+``wire_cache_{hits,misses}_total`` — which is expanded before matching.
+
+Usage:
+    python scripts/check_metrics_catalog.py          # exit 1 on drift
+    python scripts/check_metrics_catalog.py --list   # dump the families
+
+Wired into the default test suite (tests/test_load_truth.py), so a PR
+adding an undocumented metric family fails CI here first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import re
+import sys
+
+# modules that register metric families at import time (module-level
+# REGISTRY.counter/histogram/gauge calls). Keep in sync by grepping:
+#   grep -rn "REGISTRY\.\(counter\|histogram\|gauge\)(" nornicdb_tpu
+IMPORT_TIME_MODULES = (
+    "nornicdb_tpu.obs",            # dispatch, stages, cost families
+    "nornicdb_tpu.search.microbatch",
+    "nornicdb_tpu.search.service",
+    "nornicdb_tpu.search.cagra",
+    "nornicdb_tpu.search.device_bm25",
+    "nornicdb_tpu.search.hybrid_fused",
+    "nornicdb_tpu.storage.wal",
+    "nornicdb_tpu.api.bolt",
+    "nornicdb_tpu.api.http_server",
+    "nornicdb_tpu.api.qdrant_official_grpc",
+)
+
+_PREFIX = "nornicdb_"
+
+
+def _expand_braces(text: str) -> str:
+    """Expand one level of ``name_{a,b,c}_suffix`` doc shorthand into
+    the literal metric names so the substring match sees them."""
+    pattern = re.compile(r"(\w*)\{([\w,]+)\}(\w*)")
+    out = [text]
+    for m in pattern.finditer(text):
+        head, alts, tail = m.group(1), m.group(2), m.group(3)
+        for alt in alts.split(","):
+            out.append(f"{head}{alt}{tail}")
+    return "\n".join(out)
+
+
+def registered_families():
+    from nornicdb_tpu.obs import REGISTRY
+
+    for mod in IMPORT_TIME_MODULES:
+        importlib.import_module(mod)
+    return sorted(f.name for f in REGISTRY.families())
+
+
+def missing_from_catalog(doc_text: str, families) -> list:
+    expanded = _expand_braces(doc_text)
+
+    def documented(name: str) -> bool:
+        # word-boundary match: a plain substring test would let e.g. a
+        # new nornicdb_stage_seconds family ride inside the documented
+        # nornicdb_request_stage_seconds — the exact drift class this
+        # lint exists to catch (underscores are word chars, so \b only
+        # matches at the full-name edges)
+        return re.search(rf"\b{re.escape(name)}\b", expanded) is not None
+
+    missing = []
+    for name in families:
+        short = name[len(_PREFIX):] if name.startswith(_PREFIX) else name
+        if not documented(short) and not documented(name):
+            missing.append(name)
+    return missing
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--doc", default=None,
+                    help="catalog path (default: docs/observability.md "
+                         "next to this repo)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the import-time families and exit")
+    args = ap.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    families = registered_families()
+    if args.list:
+        print(json.dumps(families, indent=1))
+        return 0
+    doc_path = args.doc or os.path.join(repo, "docs", "observability.md")
+    with open(doc_path, encoding="utf-8") as f:
+        doc_text = f.read()
+    missing = missing_from_catalog(doc_text, families)
+    verdict = {
+        "catalog_lint": True,
+        "doc": os.path.relpath(doc_path, repo),
+        "families": len(families),
+        "missing": missing,
+        "verdict": "drift" if missing else "pass",
+    }
+    print(json.dumps(verdict))
+    return 1 if missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

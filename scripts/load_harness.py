@@ -1,0 +1,86 @@
+"""Open-loop load driver: Poisson arrivals against the serving stack.
+
+Standalone CLI over bench.py's open-loop harness (ISSUE 7). Stands up
+an in-process node (HTTP + gRPC surfaces over a synthetic corpus) and
+sweeps Poisson arrival rates against the real wire paths via async
+clients — arrivals never wait for completions, so queueing collapse is
+measured instead of hidden. Emits one JSON document per run:
+offered-vs-achieved QPS, p50/p95/p99-at-load per swept rate, the
+saturation-knee estimate and a queue-collapse verdict per surface.
+
+Usage:
+    # default sweep (0.3/0.6/0.9/1.2 x a closed-loop calibration)
+    python scripts/load_harness.py
+
+    # explicit arrival rates (QPS), longer windows, bigger corpus
+    python scripts/load_harness.py --rates 200 500 1000 2000 \
+        --duration 3.0 --n-people 2000
+
+    # fast schema-shaped pass (the same tiny mode the default test
+    # suite pins via bench.py --dry-run)
+    python scripts/load_harness.py --tiny
+
+Gate the output with the sentinel:
+    python scripts/load_harness.py | python scripts/bench_sentinel.py \
+        --baseline baseline.json
+(the sentinel reads ``load.surfaces.qdrant_grpc_search.knee_qps`` /
+``p99_at_load_ms`` from full artifacts that carry a ``load`` block).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rates", nargs="*", type=float, default=None,
+                    help="explicit arrival rates (QPS); default sweeps "
+                         "multiples of a closed-loop calibration")
+    ap.add_argument("--multipliers", nargs="*", type=float, default=None,
+                    help="rate multipliers over the closed-loop "
+                         "calibration (ignored with --rates; default "
+                         "0.3/0.6/0.9/1.2, or 0.5/1.5 with --tiny)")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="seconds per measurement point (default 1.5, "
+                         "or 0.25 with --tiny)")
+    ap.add_argument("--n-people", type=int, default=None,
+                    help="synthetic corpus size (default 400, or 60 "
+                         "with --tiny)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="dry-run shape: toy corpus, 2-point sweep")
+    args = ap.parse_args(argv)
+
+    # the harness lives in bench.py (one implementation for the bench
+    # artifact, this driver and the tests); repo root on sys.path
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    doc = {"load": bench._bench_load(
+        tiny=args.tiny,
+        n_people=args.n_people,
+        duration_s=args.duration,
+        explicit_rates=args.rates,
+        multipliers=(tuple(args.multipliers)
+                     if args.multipliers is not None else None),
+    )}
+    print(json.dumps(doc))
+    load = doc["load"]
+    if "error" in load:
+        return 1
+    # human-scannable last lines: one verdict per surface
+    for name, sweep in load.get("surfaces", {}).items():
+        sys.stderr.write(
+            f"{name}: closed-loop {sweep['closed_loop_qps']} qps, "
+            f"knee {sweep['knee_qps']} qps, "
+            f"p99@load {sweep['p99_at_load_ms']} ms, "
+            f"collapse={sweep['queue_collapse_detected']}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

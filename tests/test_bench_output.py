@@ -198,7 +198,7 @@ class TestBenchDryRunArtifactSchema:
 
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
                     "knn", "northstar", "ann", "hybrid", "surfaces",
-                    "telemetry", "tpu_proof")
+                    "telemetry", "load", "tpu_proof")
 
     def test_dry_run_artifact_schema(self, dry_run_lines):
         lines = dry_run_lines
@@ -292,6 +292,29 @@ class TestBenchDryRunArtifactSchema:
         for e in res:
             assert "error" not in e, e
 
+        # the open-loop load stage (ISSUE 7): Poisson arrivals against
+        # the real wire surfaces — tiny 2-point sweep in dry-run, but
+        # the schema (offered vs achieved, p99-at-load, knee estimate,
+        # collapse verdict) must be complete per surface
+        load = full["load"]
+        assert load["open_loop"] is True
+        assert load["arrival"] == "poisson"
+        for name in ("qdrant_grpc_search", "rest_search"):
+            sweep = load["surfaces"][name]
+            assert "error" not in sweep, sweep
+            assert sweep["closed_loop_qps"] > 0, name
+            assert len(sweep["points"]) == 2, name
+            for pt in sweep["points"]:
+                assert pt["offered"] > 0 and pt["offered_qps"] > 0
+                assert pt["achieved_qps"] >= 0
+                assert "collapsed" in pt
+                if pt["completed"]:
+                    assert pt["p99_ms"] is not None
+                    assert pt["p50_ms"] <= pt["p99_ms"]
+            assert sweep["knee_qps"] is not None and sweep["knee_qps"] > 0
+            assert sweep["p99_at_load_ms"] is not None
+            assert isinstance(sweep["queue_collapse_detected"], bool)
+
         # compact summary carries the floor too (driver tail window)
         assert summary["summary"] is True
         assert summary["dry_run"] is True
@@ -300,7 +323,11 @@ class TestBenchDryRunArtifactSchema:
         # and the latency trio for the hottest surface
         p = summary["latency_ms"]["qdrant_grpc_search"]
         assert len(p) == 3 and all(x is not None for x in p)
-        assert len(lines[-1]) < 2000
+        # and the open-loop load trio the sentinel gates
+        assert summary["load"]["knee_qps"] > 0
+        assert summary["load"]["p99_at_load_ms"] is not None
+        assert isinstance(summary["load"]["collapse"], bool)
+        assert len(lines[-1]) < 2200
 
 
 class TestTpuProofDryRun:
@@ -372,7 +399,8 @@ class TestBenchSentinelGate:
                        "cagra_recall10", "hybrid_fused_qps_b16",
                        "hybrid_rank_parity", "hybrid_compile_buckets",
                        "hybrid_walk_qps_b16", "hybrid_walk_recall10",
-                       "surface_qdrant_grpc_qps"):
+                       "surface_qdrant_grpc_qps", "load_knee_qps",
+                       "load_p99_at_load_ms"):
             assert metric in saved["metrics"], metric
         rc, docs = self._run_sentinel(
             artifact, ["--baseline", str(base), "--emit-summary"])
@@ -418,6 +446,40 @@ class TestBenchSentinelGate:
         summary = docs[-1]
         assert summary["sentinel"]["verdict"] == "regression"
         assert summary["sentinel"]["flagged"]
+
+    def test_p99_at_load_ceiling_flags_tail_balloon(self,
+                                                    dry_run_lines,
+                                                    tmp_path):
+        """ISSUE 7: the open-loop p99-at-load gate is a CEILING (lower
+        is better) — a fresh run whose tail latency under load balloons
+        past tolerance x baseline is a regression even when every
+        throughput floor passes."""
+        artifact = "\n".join(dry_run_lines)
+        base = tmp_path / "baseline.json"
+        rc, _docs = self._run_sentinel(
+            artifact, ["--save-baseline", str(base)])
+        assert rc == 0
+        saved = json.loads(base.read_text())
+        assert saved["metrics"]["load_p99_at_load_ms"] > 0
+        # baseline claims a 20x lower p99-at-load than the fresh run:
+        # past the 5x ceiling -> flagged; throughput floors untouched
+        deflated = dict(saved["metrics"])
+        deflated["load_p99_at_load_ms"] /= 20.0
+        base.write_text(json.dumps(
+            {"sentinel_baseline": True, "metrics": deflated}))
+        rc, docs = self._run_sentinel(
+            artifact, ["--baseline", str(base)])
+        assert rc == 1
+        flags = {f["metric"]: f for f in docs[0]["flagged"]}
+        assert set(flags) == {"load_p99_at_load_ms"}
+        assert flags["load_p99_at_load_ms"]["kind"] == "latency_ceiling"
+        # within the ceiling (same artifact vs its own baseline) passes
+        base.write_text(json.dumps(
+            {"sentinel_baseline": True, "metrics": saved["metrics"]}))
+        rc, docs = self._run_sentinel(
+            artifact, ["--baseline", str(base)])
+        assert rc == 0
+        assert "load_p99_at_load_ms" in docs[0]["passed"]
 
     def test_walk_recall_gates_absolutely_without_baseline(
             self, tmp_path):

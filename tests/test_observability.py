@@ -472,6 +472,16 @@ class TestServingTelemetry:
         dispatch = next(c for c in search_traces[0]["children"]
                         if c["name"] == "device.dispatch")
         assert dispatch["attrs"]["batch"] >= 1
+        # ISSUE 7 acceptance: the root carries the exemplar-joinable
+        # trace id, the grafted spans name their surface, and the same
+        # stage split landed in the fleet-wide stage histograms
+        assert search_traces[0].get("trace_id")
+        assert dispatch["attrs"].get("surface") == "qdrant"
+        stage_fam = obs.REGISTRY.get("nornicdb_request_stage_seconds")
+        stage_children = stage_fam.children()
+        for stage in ("coalesce_wait", "device_dispatch", "merge"):
+            assert ("qdrant", stage) in stage_children, stage
+        assert ("grpc", "parse") in stage_children
 
     def test_metrics_serves_required_histograms(self, serving):
         # labeled families materialize series on first observation, and
@@ -599,16 +609,18 @@ class TestOverheadGuard:
 
     def test_instrumented_search_path_within_budget(self):
         """The full instrumented serving path (MicroBatcher: histogram,
-        queue depth, dispatch record, span grafting) vs the same path
-        with telemetry disabled. Budget: the instrumented path stays
-        within 2x + 1ms/op of the uninstrumented one — a huge margin
-        over the measured ~5us/op, small enough to catch an accidental
-        O(requests) render or lock pileup."""
+        queue depth, dispatch record, span grafting, and — ISSUE 7 —
+        per-stage histograms + exemplar tagging under an active trace)
+        vs the same path with telemetry disabled. Budget: the
+        instrumented path stays within 2x + 1ms/op of the
+        uninstrumented one — a huge margin over the measured ~5us/op,
+        small enough to catch an accidental O(requests) render or lock
+        pileup."""
         idx = BruteForceIndex()
         rng = np.random.default_rng(11)
         vecs = rng.standard_normal((512, 32)).astype(np.float32)
         idx.add_batch([(f"v{i}", vecs[i]) for i in range(512)])
-        mb = MicroBatcher(idx.search_batch)
+        mb = MicroBatcher(idx.search_batch, surface="t-overhead")
         n = 300
 
         def measure():
@@ -618,11 +630,20 @@ class TestOverheadGuard:
             for _ in range(3):
                 t0 = time.perf_counter()
                 for i in range(n):
-                    mb.search(vecs[i % 512], 10)
+                    # each op under a root trace: exemplar provider
+                    # returns a live trace id, so every stage/latency
+                    # observe pays the tagging path too
+                    with obs.trace("wire", method="/overhead"):
+                        mb.search(vecs[i % 512], 10)
                 best = min(best, time.perf_counter() - t0)
             return best
 
+        assert obs.exemplars_enabled()
         t_on = measure()
+        # the guarded path really exercised the new machinery: stage
+        # series exist for this batcher's surface
+        fam = obs.REGISTRY.get("nornicdb_request_stage_seconds")
+        assert ("t-overhead", "device_dispatch") in fam.children()
         obs.set_enabled(False)
         try:
             t_off = measure()
