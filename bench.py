@@ -119,6 +119,7 @@ _DEVICE_STAGES = {
     "northstar": (lambda: _bench_northstar(), 1800.0),
     "ann_cagra": (lambda: {"cagra": _bench_ann_cagra()}, 900.0),
     "hybrid": (lambda: _bench_hybrid(), 900.0),
+    "quant": (lambda: _bench_quant(), 900.0),
     "tpu_proof": (lambda: _run_tpu_proof_stage(), 900.0),
 }
 
@@ -205,6 +206,11 @@ def main(dry_run: bool = False):
             result["hybrid"] = {
                 "error": f"{type(exc).__name__}: {exc}"[:400]}
         try:
+            result["quant"] = _bench_quant(tiny=True)
+        except Exception as exc:
+            result["quant"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:400]}
+        try:
             result["surfaces"] = _bench_surfaces(n_people=80, secs=0.3,
                                                  warmup_s=0.1)
         except Exception as exc:
@@ -239,6 +245,12 @@ def main(dry_run: bool = False):
     # vs the host hybrid path, at serving batch shapes, rank-identical
     result["hybrid"] = _stage_subprocess(
         "hybrid", _DEVICE_STAGES["hybrid"][1])
+    # quantization ladder (ISSUE 8): the same corpus served through
+    # {off,int8,pq} — recall@10 vs exact float32, qps at the serving
+    # batch, and the compression each rung buys (the per-chip capacity
+    # claim the sentinel holds to an absolute recall floor)
+    result["quant"] = _stage_subprocess(
+        "quant", _DEVICE_STAGES["quant"][1])
     # five-surface e2e throughput (reference: testing/e2e/README.md —
     # bolt 2,489 / neo4j-http 4,082 / graphql 3,200 / REST search
     # 10,296 / qdrant-grpc 29,331 ops/s on a 16-way dev box). Pure
@@ -393,6 +405,17 @@ def _compact_summary(result):
             "walk_recall10": g(result, "hybrid", "walk",
                                "walk_recall10"),
             "crossover_n": g(result, "hybrid", "walk", "crossover_n"),
+        },
+        # quantization ladder (quant stage): int8-rung qps at the
+        # serving batch, the WORST rung's recall@10 (the sentinel's
+        # absolute floor), and PQ's measured compression ratio
+        "quant": {
+            "quant_qps_b16": g(result, "quant", "quant_qps_b16"),
+            "quant_recall10": g(result, "quant", "quant_recall10"),
+            "compression_ratio": g(result, "quant",
+                                   "compression_ratio"),
+            "speedup_int8_vs_f32": g(result, "quant",
+                                     "speedup_int8_vs_f32"),
         },
         "pagerank_speedup_vs_numpy": g(result, "northstar",
                                        "pagerank_device",
@@ -1849,6 +1872,100 @@ def _bench_hybrid_walk_sweep(tiny: bool = False):
         "walk_qps_b16": last["walk_qps_b16"],
         "walk_recall10": last["walk_recall10"],
     }
+
+
+def _bench_quant(tiny: bool = False):
+    """Quantization-ladder sweep (ISSUE 8): the SAME corpus served
+    through NORNICDB_VECTOR_QUANT={off,int8,pq} — recall@10 vs the
+    exact float32 reference, qps at the serving batch, and the
+    device-bytes/compression each rung buys. The headline trio:
+    ``quant_qps_b16`` (int8, the serving-default rung), ``quant_
+    recall10`` (the WORST rung's recall — the floor the sentinel
+    gates at 0.95 absolute), and ``compression_ratio`` (PQ, the
+    capacity claim: >= 4x is what moves per-chip corpus ceilings)."""
+    import jax
+
+    from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+    n, d = (1_200, 32) if tiny else (100_000, 64)
+    nq = 32 if tiny else 64
+    secs = 0.15 if tiny else 1.2
+    k, batch = 10, 16
+    env = {"NORNICDB_VECTOR_QUANT": "off",
+           "NORNICDB_QUANT_MIN_N": "64",
+           "NORNICDB_QUANT_INLINE_BUILD": "1"}
+    saved = {key: os.environ.get(key) for key in env}
+    os.environ.update(env)
+    try:
+        rng = np.random.default_rng(17)
+        centers = max(8, n // 400)
+        cent = (rng.standard_normal((centers, d)) * 2.0).astype(
+            np.float32)
+        vecs = (cent[rng.integers(0, centers, n)]
+                + rng.standard_normal((n, d)).astype(np.float32))
+        idx = BruteForceIndex()
+        idx.add_batch([(f"d{i}", vecs[i]) for i in range(n)])
+        q = (cent[rng.integers(0, centers, nq)]
+             + rng.standard_normal((nq, d))).astype(np.float32)
+        exact = idx.search_batch(q, k, exact=True)
+        exact_ids = [{e for e, _ in hits} for hits in exact]
+
+        def run_mode(mode):
+            os.environ["NORNICDB_VECTOR_QUANT"] = mode
+            t0 = time.perf_counter()
+            if mode != "off":
+                plane = idx.quant_plane()
+                if tiny and mode == "pq":
+                    plane.pq_m, plane.pq_codes = 8, 64
+                plane.build()
+            build_s = time.perf_counter() - t0
+            got = idx.search_batch(q, k)  # warms the serving compile
+            hit = sum(
+                len({e for e, _ in hits} & want) / max(len(want), 1)
+                for hits, want in zip(got, exact_ids))
+            recall10 = hit / nq
+            qb = q[:batch]
+            idx.search_batch(qb, k)
+            t0 = time.perf_counter()
+            m = 0
+            while True:
+                idx.search_batch(qb, k)
+                m += batch
+                if time.perf_counter() - t0 > secs:
+                    break
+            qps = m / (time.perf_counter() - t0)
+            stats = idx.resource_stats()
+            return {
+                "qps_b16": round(qps, 1),
+                "recall10": round(recall10, 4),
+                "build_s": round(build_s, 2),
+                "device_bytes": stats.get("device_bytes"),
+                "quant_device_bytes": stats.get("quant_device_bytes",
+                                                0),
+                "compression_ratio": stats.get("compression_ratio"),
+            }
+
+        modes = {mode: run_mode(mode) for mode in ("off", "int8",
+                                                   "pq")}
+        f32_qps = modes["off"]["qps_b16"]
+        return {
+            "n": n, "dims": d, "k": k, "batch": batch,
+            "backend": jax.devices()[0].platform,
+            "modes": modes,
+            "quant_qps_b16": modes["int8"]["qps_b16"],
+            "quant_recall10": min(modes["int8"]["recall10"],
+                                  modes["pq"]["recall10"]),
+            "compression_ratio": modes["pq"]["compression_ratio"],
+            "speedup_int8_vs_f32": (
+                round(modes["int8"]["qps_b16"] / f32_qps, 2)
+                if f32_qps else None),
+        }
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
 
 
 def _bench_knn(tiny: bool = False):

@@ -84,6 +84,61 @@ def price_walk(b: int, d: int, iters: int, width: int, degree: int,
     return flops, bytes_
 
 
+def price_int8_coarse(b: int, rows: int, d: int) -> Tuple[float, float]:
+    """(flops, bytes) of one int8 coarse top-k dispatch: the same
+    [b,d]x[d,rows] arithmetic as the float32 matmul (codes cast to f32
+    chunk-by-chunk in cache — the cast never lands in HBM), but the
+    matrix moves ONE byte per element (+ the per-row f32 scales) — the
+    4x HBM win the compression exists for shows up on the same axis."""
+    flops = 2.0 * b * rows * d
+    bytes_ = (rows * d  # int8 codes
+              + _F32 * rows  # per-row scales
+              + _F32 * (b * d + b * rows))  # f32 queries + scores
+    return flops, bytes_
+
+
+def price_pq_adc(b: int, rows: int, m: int, n_codes: int,
+                 d_sub: int) -> Tuple[float, float]:
+    """(flops, bytes) of one PQ ADC dispatch: the per-subspace
+    [b, n_codes] table matmuls plus the gather+sum over the uint8 code
+    columns — bytes are dominated by the m*rows code bytes, which is
+    the entire point."""
+    flops = 2.0 * b * m * n_codes * d_sub + 1.0 * b * m * rows
+    bytes_ = (m * rows  # uint8 codes
+              + _F32 * (m * n_codes * d_sub  # codebooks
+                        + b * m * d_sub  # query subvectors
+                        + b * rows))  # scores
+    return flops, bytes_
+
+
+def price_rerank(b: int, pool: int, d: int) -> Tuple[float, float]:
+    """(flops, bytes) of the exact rerank over a gathered candidate
+    pool: one [b,d]x[d,pool] float32 matmul over rows gathered from the
+    host source of truth (counted as bytes moved — the gather IS the
+    cost the overfetch knob trades against recall)."""
+    flops = 2.0 * b * pool * d
+    bytes_ = _F32 * (b * pool * d + b * d + b * pool)
+    return flops, bytes_
+
+
+def price_walk_quant(b: int, d: int, iters: int, width: int,
+                     degree: int, itopk: int, head_dims: int, keep: int,
+                     n_seeds: int = 1024) -> Tuple[float, float]:
+    """(flops, bytes) of one QUANTIZED CAGRA walk: the seed round reads
+    full int8 rows, each iteration gathers ``width*degree`` candidate
+    HEADS (head_dims int8 each — the PCA prefilter) and only ``keep``
+    full int8 rows; the host-side exact rerank of the pool is priced
+    separately (``price_rerank``)."""
+    m = float(width * degree)
+    flops = b * (n_seeds * 2.0 * d
+                 + iters * (m * 2.0 * head_dims + keep * 2.0 * d
+                            + itopk * 2.0))
+    bytes_ = b * (n_seeds * d  # int8 seed rows
+                  + iters * (m * head_dims + keep * d  # int8 gathers
+                             + _F32 * m))  # adjacency/scale columns
+    return flops, bytes_
+
+
 def price_bm25(b: int, nnz: int, unique_terms: int,
                rows: int) -> Tuple[float, float]:
     """(flops, bytes) of one device-BM25 scoring dispatch: tf/idf math +

@@ -54,6 +54,8 @@ _INDEX_GAUGES: Tuple[Tuple[str, str], ...] = (
     ("nornicdb_index_mutation_gap", "mutation_gap"),
     ("nornicdb_index_rebuild_in_flight", "rebuild_in_flight"),
     ("nornicdb_index_rebuild_backlog_seconds", "rebuild_backlog_s"),
+    ("nornicdb_index_quant_device_bytes", "quant_device_bytes"),
+    ("nornicdb_index_compression_ratio", "compression_ratio"),
 )
 
 _HELP = {
@@ -75,6 +77,10 @@ _HELP = {
         "1 while a background snapshot/graph rebuild is running",
     "nornicdb_index_rebuild_backlog_seconds":
         "Age of the open background-rebuild backlog",
+    "nornicdb_index_quant_device_bytes":
+        "Device bytes of the index's quantized (int8/PQ) plane",
+    "nornicdb_index_compression_ratio":
+        "float32 bytes replaced / quantized device bytes",
 }
 
 _lock = threading.Lock()

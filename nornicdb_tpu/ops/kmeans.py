@@ -43,6 +43,84 @@ def optimal_k(n: int) -> int:
     return max(8, min(4096, int(math.sqrt(n / 2))))
 
 
+def euclid_kmeans(
+    x: np.ndarray, k: int, iters: int = 25,
+    seed_ids: Optional[Sequence[int]] = None, seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Euclidean Lloyd with kmeans++ init (optionally seeded rows first).
+
+    The SHARED codebook trainer: host IVF-PQ (search/ivfpq.py) and the
+    device PQ plane (search/device_quant.py) both train through this
+    one implementation, so their codebooks are bit-identical given the
+    same sample/seed. It stays separate from :func:`kmeans_fit`, which
+    normalizes rows (cosine clustering) — that would corrupt PQ
+    subvector codebooks, which need true L2 geometry."""
+    rng = np.random.default_rng(seed)
+    n = len(x)
+    k = max(1, min(k, n))
+    chosen: list = list(dict.fromkeys(
+        int(i) for i in (seed_ids or []) if 0 <= int(i) < n))[:k]
+    if not chosen:
+        chosen = [int(rng.integers(n))]
+    # incremental k-means++: keep the running min-distance-to-chosen
+    # array and update it against ONLY the newest center — O(k*n*d),
+    # not O(k^2*n*d) (the recompute-all version took ~9 min for one
+    # 256-code codebook at n=10k)
+    d2 = np.full(n, np.inf, dtype=np.float64)
+    for i in chosen:
+        d2 = np.minimum(d2, np.sum((x - x[i]) ** 2, axis=1))
+    while len(chosen) < k:
+        total = d2.sum()
+        if total <= 1e-12:
+            # all remaining points coincide with a centroid (duplicate/
+            # constant subvectors): fall back to uniform picks
+            nxt = int(rng.integers(n))
+        else:
+            nxt = int(rng.choice(n, p=d2 / total))
+        chosen.append(nxt)
+        d2 = np.minimum(d2, np.sum((x - x[nxt]) ** 2, axis=1))
+    cent = x[chosen].copy()
+    assign = np.zeros(n, dtype=np.int64)
+    for it in range(iters):
+        dist = (
+            np.sum(x**2, axis=1, keepdims=True)
+            - 2.0 * x @ cent.T
+            + np.sum(cent**2, axis=1)[None, :]
+        )
+        new_assign = np.argmin(dist, axis=1)
+        if it > 0 and np.array_equal(new_assign, assign):
+            break
+        assign = new_assign
+        for j in range(k):
+            members = x[assign == j]
+            if len(members):
+                cent[j] = members.mean(axis=0)
+    return cent.astype(np.float32), assign
+
+
+def train_subspace_codebooks(
+    sample: np.ndarray, m: int, n_codes: int = 256,
+) -> np.ndarray:
+    """Per-subspace PQ codebooks ``[M, n_codes, D/M]`` over (residual or
+    raw) rows — the single training routine behind both the host IVF-PQ
+    codebooks and the device PQ plane. Short codebooks pad by repeating
+    the last entry so the output shape is fixed."""
+    n, d = sample.shape
+    if d % m != 0:
+        raise ValueError(f"dims {d} not divisible by M={m}")
+    sub = sample.reshape(n, m, d // m)
+    codes_k = min(n_codes, n)
+    books = []
+    for j in range(m):
+        cb, _ = euclid_kmeans(
+            np.ascontiguousarray(sub[:, j, :]), codes_k, seed=j + 1)
+        if cb.shape[0] < n_codes:  # pad to fixed shape
+            pad = np.repeat(cb[-1:], n_codes - cb.shape[0], axis=0)
+            cb = np.concatenate([cb, pad], axis=0)
+        books.append(cb)
+    return np.stack(books)  # [M, n_codes, D/M]
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _kmeanspp_seeded_init(
     x: jnp.ndarray,  # [N, D] normalized

@@ -539,11 +539,40 @@ class CagraIndex:
             validf[sh * r: sh * r + (hi - lo)] = 1.0
             all_ids[sh * r: sh * r + (hi - lo)] = row_ids[lo:hi]
 
+        # quantized base (NORNICDB_VECTOR_QUANT != off, single-shard):
+        # HBM holds int8 PCA-projected codes + the head prefilter
+        # column; float32 rows stay HOST-side for the exact pool
+        # rerank, so the device footprint drops ~4x. Sharded graphs
+        # keep float32 (the mesh walk program is float32-only) — a
+        # degrade, never a wrong answer.
+        quant = None
+        from nornicdb_tpu.search.device_quant import quant_mode
+
+        if s == 1 and quant_mode() != "off" and n >= self.min_n:
+            from nornicdb_tpu.config import env_int
+            from nornicdb_tpu.search.device_quant import (
+                quantize_graph_base,
+            )
+
+            quant = quantize_graph_base(mat)
+            quant["rot_dev"] = jnp.asarray(quant["rot"])
+            # keep 3/4 of each expansion past the head prefilter:
+            # measured (8k x 64d clustered, CPU) recall@10 0.93 at 1/2,
+            # 0.98 at 3/4, 1.00 unpruned — 3/4 clears the 0.95 sentinel
+            # floor with margin while still dropping a quarter of the
+            # full-row gathers
+            quant["keep"] = max(8, env_int(
+                "QUANT_WALK_KEEP",
+                (3 * self.search_width * self.degree) // 4))
         graph: Dict[str, Any] = {
             "n": n,
             "shards": s,
             "rows_per_shard": r,
-            "matrix": jnp.asarray(mat),
+            # host float32 under quant (rerank gather source); device
+            # array otherwise — every consumer but the walk reads only
+            # shapes/rows from it
+            "matrix": mat if quant is not None else jnp.asarray(mat),
+            "quant": quant,
             "adj": jnp.asarray(adj),
             "validf": jnp.asarray(validf),
             "row_ids": all_ids,
@@ -672,19 +701,44 @@ class CagraIndex:
         g = self._graph
         dev_b = 0
         graph_rows = 0
+        host_extra = 0
+        quant_b = 0
+        f32_base = 0
         if g is not None:
-            for key in ("matrix", "adj", "validf"):
+            quant = g.get("quant")
+            for key in ("adj", "validf"):
                 dev_b += int(getattr(g[key], "nbytes", 0) or 0)
+            f32_base = int(getattr(g["matrix"], "nbytes", 0) or 0)
+            if quant is None:
+                dev_b += f32_base
+            else:
+                # quantized base: float32 rows live HOST-side (rerank
+                # gather source); HBM holds codes+head+scale+rotation
+                host_extra += f32_base
+                for key in ("codes", "codes_head", "scale", "rot_dev"):
+                    quant_b += int(
+                        getattr(quant[key], "nbytes", 0) or 0)
+                dev_b += quant_b
             graph_rows = g["n"]
         mutations = getattr(self._brute, "mutations", 0)
         gap = (mutations - g["built_mutations"]) if g is not None else 0
         started = self._rebuild_started
+        stats_extra = {}
+        if quant_b:
+            stats_extra = {
+                "quant_device_bytes": quant_b,
+                "compression_ratio": round(f32_base / max(quant_b, 1),
+                                           3),
+            }
         return {
+            **stats_extra,
             "rows": graph_rows,
             "capacity": (g["shards"] * g["rows_per_shard"]) if g else 0,
             "device_bytes": dev_b,
-            # row_ids table (pointer-sized slots)
-            "host_bytes": 8 * len(g["row_ids"]) if g else 0,
+            # row_ids table (pointer-sized slots) + the host-resident
+            # float32 base under quantization
+            "host_bytes": (8 * len(g["row_ids"]) + host_extra)
+            if g else 0,
             "mutation_gap": gap,
             "rebuild_in_flight": 1.0 if self._rebuilding else 0.0,
             "rebuild_backlog_s": (
@@ -762,9 +816,19 @@ class CagraIndex:
         from nornicdb_tpu.obs import cost as _cost
 
         if _cost.pricing_enabled():
-            flops, byts = _cost.price_walk(
-                bb, int(queries.shape[1]), n_iters, w, self.degree, p,
-                n_seeds=self.n_seeds)
+            quant = g.get("quant")
+            if quant is not None:
+                flops, byts = _cost.price_walk_quant(
+                    bb, int(queries.shape[1]), n_iters, w, self.degree,
+                    p, quant["head_dims"], quant["keep"],
+                    n_seeds=self.n_seeds)
+                rf, rb = _cost.price_rerank(bb, p,
+                                            int(queries.shape[1]))
+                flops, byts = flops + rf, byts + rb
+            else:
+                flops, byts = _cost.price_walk(
+                    bb, int(queries.shape[1]), n_iters, w, self.degree,
+                    p, n_seeds=self.n_seeds)
             _cost.record_query_cost("cagra_walk", _cost.cost_name(self),
                                     b, flops, byts)
         out = self._resolve(g, s_host[:b], i_host[:b], k_eff)
@@ -821,6 +885,8 @@ class CagraIndex:
                 for r, hits in enumerate(hits_rows)]
 
     def _walk(self, g, qn, kb, n_iters, w, p):
+        if g.get("quant") is not None:
+            return self._walk_quant(g, qn, kb, n_iters, w, p)
         if g["shards"] == 1:
             return _cagra_walk(
                 qn, g["matrix"], g["adj"], g["validf"],
@@ -832,6 +898,32 @@ class CagraIndex:
                 kb, n_iters, w, p, self.hash_bits, self.n_seeds,
                 mesh=g["mesh"])
         return self._walk_shards_single_device(g, qn, kb, n_iters, w, p)
+
+    def _walk_quant(self, g, qn, kb, n_iters, w, p):
+        """Quantized walk (device_quant): the greedy walk runs over the
+        int8 PCA-projected base with the two-stage frontier scorer
+        (head prefilter -> full int8 dot), then the ENTIRE itopk pool
+        is exactly re-scored against the host float32 rows before the
+        final top-k — approximate scores rank the pool, never an
+        answer. Shapes match the float32 walk's (scores, row ids)."""
+        from nornicdb_tpu.search.device_quant import _quant_walk
+
+        q = g["quant"]
+        qp = qn @ q["rot_dev"]  # orthogonal: norms/dots preserved
+        s, i = _quant_walk(
+            qp, q["codes"], q["codes_head"], q["scale"], g["adj"],
+            g["validf"], k=p, iters=n_iters, width=w, itopk=p,
+            hash_bits=self.hash_bits, n_seeds=self.n_seeds,
+            keep=q["keep"])
+        s_h, i_h = np.asarray(s), np.asarray(i)
+        qh = np.asarray(qn)
+        gathered = g["matrix"][i_h]  # host f32 [B, itopk, D]
+        exact = np.einsum("bpd,bd->bp", gathered, qh)
+        exact = np.where(s_h > 0.5 * NEG_INF, exact,
+                         np.float32(NEG_INF))
+        order = np.argsort(-exact, axis=1, kind="stable")[:, :kb]
+        return (np.take_along_axis(exact, order, axis=1),
+                np.take_along_axis(i_h, order, axis=1))
 
     def _walk_shards_single_device(self, g, qn, kb, n_iters, w, p):
         """Reference merge for the sharded layout on one device: walk

@@ -92,6 +92,8 @@ _HYB_C = REGISTRY.counter(
 
 declare_kind("hybrid_fused")
 declare_kind("hybrid_walk_fused")
+declare_kind("hybrid_fused_quant")
+declare_kind("hybrid_walk_fused_quant")
 
 
 # ---------------------------------------------------------------------------
@@ -267,6 +269,74 @@ def _fused_sharded_impl(ptr, urow, sel, post_doc, post_tf, doc_len,
         out_specs=(P(), P(), P(), P(), P(), P()),
     )(ptr, urow, sel, post_doc, post_tf, doc_len, alive_f, l2v,
       avgdl, qn, vmatrix, vvalid, n_cand, w_lex, w_vec)
+
+
+# ---------------------------------------------------------------------------
+# quantized vector halves (device_quant): int8/PQ coarse scoring inside
+# the same compiled program; the decode exact-reranks the vector
+# candidates on host float32 rows and re-fuses through the
+# bit-compatible host rrf_fuse — compressed scores rank the POOL, never
+# an answer
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("kq", "pool", "mode"))
+def _fused_single_quant(ptr, urow, sel, post_doc, post_tf, doc_len,
+                        alive_f, l2v, avgdl, qn, codes_t, aux,
+                        vvalid, kq, pool, mode):
+    """Lexical CSR scoring + quantized coarse vector top-``pool`` in
+    one compiled program; ``mode`` picks the coarse scorer (``aux`` is
+    the int8 per-row scales or the PQ codebooks). No device fuse: the
+    quant decode exact-reranks the pool and re-fuses through the
+    bit-compatible host rrf_fuse, so a device fuse over COARSE scores
+    would be discarded anyway — and skipping it frees the vector half
+    to overfetch ``pool`` > kq candidates (the rerank's recall slack,
+    same policy as the standalone plane)."""
+    from nornicdb_tpu.search.device_quant import (
+        _int8_scores,
+        _pq_adc_scores,
+    )
+
+    c_vec = codes_t.shape[1]
+    ls, _lid, lgrow = _lex_parts_impl(ptr, urow, sel, post_doc,
+                                      post_tf, doc_len, alive_f, l2v,
+                                      avgdl, jnp.int32(0), kq=kq)
+    if mode == "int8":
+        vsc = _int8_scores(qn, codes_t, aux)
+    else:
+        vsc = _pq_adc_scores(qn, codes_t, aux)
+    vsc = jnp.where(vvalid[None, :], vsc, NEG_INF)
+    vs, vi = jax.lax.top_k(vsc, min(pool, c_vec))
+    ls = _pad_cols(ls, kq, NEG_INF)
+    lgrow = _pad_cols(lgrow, kq, 0)
+    vs = _pad_cols(vs, pool, NEG_INF)
+    vi = _pad_cols(vi, pool, 0)
+    return ls, lgrow, vs, vi
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "kq", "iters", "width", "itopk", "hash_bits", "n_seeds", "keep"))
+def _walk_fused_single_q(ptr, urow, sel, post_doc, post_tf, doc_len,
+                         alive_f, l2g, avgdl, qp, codes, codes_head,
+                         scale, gadj, gvalidf, kq, iters, width, itopk,
+                         hash_bits, n_seeds, keep):
+    """Walk tier over a QUANTIZED graph base: the two-stage
+    (head-prefilter -> full int8 dot) greedy walk replaces the float32
+    walk inside the same compiled program. ``qp`` is the PCA-projected
+    query batch (rotation is orthogonal, so dots are preserved). The
+    walk's whole itopk pool rides out for the exact rerank; the host
+    re-fuse replaces the device fuse (see _fused_single_quant)."""
+    from nornicdb_tpu.search.device_quant import _walk_body_quant
+
+    ls, _lid, lgrow = _lex_parts_impl(ptr, urow, sel, post_doc,
+                                      post_tf, doc_len, alive_f, l2g,
+                                      avgdl, jnp.int32(0), kq=kq)
+    vs, vi = _walk_body_quant(qp, codes, codes_head, scale, gadj,
+                              gvalidf, itopk, iters, width,
+                              itopk, hash_bits, n_seeds, keep)
+    ls = _pad_cols(ls, kq, NEG_INF)
+    lgrow = _pad_cols(lgrow, kq, 0)
+    return ls, lgrow, vs, vi
 
 
 # ---------------------------------------------------------------------------
@@ -595,6 +665,21 @@ class FusedHybrid:
             # whole walk dispatch + decode
             walk_discarded_s = time.time() - t_w0
             t_plan0 = time.time()
+        # quantized brute tier (device_quant): int8/PQ coarse scoring
+        # replaces the float32 matmul inside the same compiled program;
+        # the decode exact-reranks and host-refuses. A veto (freshness
+        # gap, under-fill) falls through to the float32 exact tier —
+        # the ladder is quantized -> float32 -> host
+        qctx = self._quant_context(snap)
+        if qctx is not None:
+            t_q0 = time.time()
+            out = self._dispatch_quant(snap, qctx, lex_base, avgdl, qn,
+                                       tail, kq, b, delta, token_rows,
+                                       extras, t_plan0)
+            if out is not None:
+                return out
+            walk_discarded_s += time.time() - t_q0
+            t_plan0 = time.time()
         # the exact tier's view capture happens only here — the walk
         # dispatch above never touches the brute matrix, so a served
         # walk batch skips the post-write device re-ship entirely
@@ -646,6 +731,147 @@ class FusedHybrid:
                  "tier": "brute"}
         if walk_discarded_s:
             times["walk_discarded_s"] = round(walk_discarded_s, 6)
+        for row in out:
+            if row is not None:
+                row["times"] = times
+                row["tier"] = "brute"
+        return out
+
+    # -- quantized brute tier ---------------------------------------------
+
+    def _quant_context(self, snap) -> Optional[Dict[str, Any]]:
+        """Eligibility + freshness gate for the quantized vector half
+        of the brute tier. None means the float32 exact tier serves —
+        every gap degrades DOWN (quantized -> float32 -> host), never
+        into a wrong answer."""
+        from nornicdb_tpu.search.device_quant import quant_mode
+
+        if quant_mode() == "off" or snap["shards"] != 1:
+            # the quant programs are single-shard; sharded snapshots
+            # keep the float32 mesh path
+            return None
+        brute = self.brute
+        plane = getattr(brute, "quant_plane", lambda: None)()
+        if plane is None:
+            return None
+        qsnap = plane.ensure()
+        if qsnap is None:
+            _HYB_C.labels("quant_pending_build").inc()
+            return None
+        if qsnap["shards"] != 1:
+            return None
+        if qsnap["built_compactions"] != getattr(brute, "compactions",
+                                                 0):
+            _HYB_C.labels("quant_fallback_compaction").inc()
+            plane._kick_background_rebuild()
+            return None
+        vdelta = brute.changed_since(qsnap["built_mutations"])
+        if vdelta is None:
+            _HYB_C.labels("quant_fallback_changelog").inc()
+            plane._kick_background_rebuild()
+            return None
+        ids_view = brute.ids_meta()
+        if ids_view is None:
+            return None
+        ids, mutations, compactions = ids_view
+        if compactions != qsnap["built_compactions"]:
+            return None
+        return {"plane": plane, "qsnap": qsnap, "vdelta": vdelta,
+                "ids": ids, "mutations": mutations}
+
+    def _dispatch_quant(self, snap, qctx, lex_base, avgdl, qn, tail,
+                        kq, b, delta, token_rows, extras, t_plan0):
+        """One quantized brute-tier dispatch. Returns decoded rows, or
+        None when the float32 exact tier must re-serve the batch
+        (join-map race, rerank race, under-fill)."""
+        qsnap = qctx["qsnap"]
+        brute = self.brute
+        l2v = self._ensure_map(snap, qctx["mutations"])
+        if l2v is None:
+            _HYB_C.labels("quant_fallback_vec_race").inc()
+            return None
+        args = (*lex_base, l2v, jnp.float32(avgdl), qn)
+        # the vector half overfetches past kq: coarse ordering is
+        # noisiest exactly where the rerank matters, so the pool takes
+        # the standalone plane's policy (overfetch * kq, floored; PQ
+        # adds the capacity-scaled floor)
+        plane = qctx["plane"]
+        pool = plane.pool_for(kq, qsnap)
+        t0 = time.time()
+        if qsnap["mode"] == "int8":
+            aux = qsnap["scale"]
+            vec_price = _cost.price_int8_coarse(
+                pow2_bucket(b), qsnap["capacity"], qsnap["dims"])
+        else:
+            aux = qsnap["codebooks"]
+            vec_price = _cost.price_pq_adc(
+                pow2_bucket(b), qsnap["capacity"], qsnap["pq_m"],
+                qsnap["pq_codes"], qsnap["dims"] // qsnap["pq_m"])
+        ls, li, vs, vi = _fused_single_quant(
+            *args, qsnap["codes_t"], aux, qsnap["valid"], kq=kq,
+            pool=pool, mode=qsnap["mode"])
+        lgrow = li
+        ls, lgrow = np.asarray(ls), np.asarray(lgrow)
+        vs, vi = np.asarray(vs), np.asarray(vi)
+        # decode never reads the device fuse on quant tiers (it always
+        # re-fuses on host over the exact-reranked lists) — vs/vi stand
+        # in for the unused (fs, fpos) slots
+        fs = fpos = None
+        t1 = time.time()
+        record_dispatch("hybrid_fused_quant", pow2_bucket(b), kq,
+                        t1 - t0)
+        rf, rb = _cost.price_rerank(pow2_bucket(b), vs.shape[1],
+                                    qsnap["dims"])
+        self._record_cost("hybrid_fused_quant", b, snap,
+                          vec_flops_bytes=(vec_price[0] + rf,
+                                           vec_price[1] + rb))
+        # exact rerank: gather the vector candidates' CURRENT float32
+        # rows from the host source of truth (one lock hold) and
+        # re-score — compressed scores rank the pool, never an answer
+        qh = np.asarray(qn)
+        uniq = np.unique(vi)
+        got = brute.rows_for_slots(
+            uniq, expect_compactions=qsnap["built_compactions"])
+        if got is None:
+            _HYB_C.labels("quant_fallback_vec_race").inc()
+            return None
+        rows_u, alive_u, _ids_u = got
+        exact_u = qh @ rows_u.T  # [B, U]
+        inv = np.searchsorted(uniq, vi)
+        vs_e = np.take_along_axis(exact_u, inv, axis=1)
+        ok = (vs > 0.5 * NEG_INF) & alive_u[inv]
+        vs_e = np.where(ok, vs_e, np.float32(NEG_INF)).astype(
+            np.float32)
+        order = np.argsort(-vs_e, axis=1, kind="stable")
+        vs_e = np.take_along_axis(vs_e, order, axis=1)
+        vi = np.take_along_axis(vi, order, axis=1)
+        # vector delta block: exact-float32 side-scan of post-build
+        # adds/updates (the changelog discipline — stale plane codes
+        # for an updated doc never reach an answer; ids removed since
+        # logging are skipped by the shared one-lock gather)
+        d_ids, d_mat = brute.delta_vectors(qctx["vdelta"])
+        vec_delta = (d_ids, d_mat)
+        out = self._decode(snap, qctx["ids"], delta, token_rows,
+                           extras, ls, lgrow, vs_e, vi, fs, fpos, kq,
+                           vec_delta=vec_delta, qn=qh,
+                           force_refuse=True)
+        # under-fill veto: live-filtering can leave a row short of
+        # candidates the corpus does have — the float32 tier re-serves
+        alive_n = len(brute)
+        for row, e in zip(out, extras):
+            if row is None:
+                continue
+            if len(row["vec"]) < min(int(e["n_cand"]), kq, alive_n):
+                _HYB_C.labels("quant_underfill_f32").inc()
+                return None
+        _HYB_C.labels("quant_dispatch").inc()
+        if d_ids:
+            _HYB_C.labels("quant_delta_merge").inc()
+        if delta:
+            _HYB_C.labels("delta_merge").inc(len(extras))
+        times = {"plan_s": t0 - t_plan0, "device_t0": t0,
+                 "device_t1": t1, "decode_s": time.time() - t1,
+                 "tier": "brute", "quant": qsnap["mode"]}
         for row in out:
             if row is not None:
                 row["times"] = times
@@ -715,15 +941,32 @@ class FusedHybrid:
                        width=wctx["width"], itopk=wctx["itopk"],
                        hash_bits=wctx["hash_bits"],
                        n_seeds=wctx["n_seeds"])
-        args = (*lex_base, wctx["l2g"], jnp.float32(avgdl), qn,
-                g["matrix"], g["adj"], g["validf"], *tail)
+        quant = g.get("quant") if snap["shards"] == 1 else None
         t0 = time.time()
-        if snap["shards"] == 1:
+        if quant is not None:
+            # quantized graph base: the two-stage int8 walk runs inside
+            # the same compiled program; the pool is exact-reranked
+            # below from the HOST-resident float32 rows, and the host
+            # re-fuse replaces the device fuse (fs/fpos never read)
+            qp = qn @ quant["rot_dev"]
+            q_statics = dict(statics)
+            del q_statics["rrf_k"]
+            ls, li, vs, vi = _walk_fused_single_q(
+                *lex_base, wctx["l2g"], jnp.float32(avgdl), qp,
+                quant["codes"], quant["codes_head"], quant["scale"],
+                g["adj"], g["validf"], **q_statics,
+                keep=quant["keep"])
+            lgrow = li
+            fs = fpos = None
+        elif snap["shards"] == 1:
             ls, li, vs, vi, fs, fpos = _walk_fused_single(
-                *args, **statics)
+                *lex_base, wctx["l2g"], jnp.float32(avgdl), qn,
+                g["matrix"], g["adj"], g["validf"], *tail, **statics)
             lgrow = li
         elif "mesh" in snap and "mesh" in g \
                 and len(jax.devices()) >= snap["shards"]:
+            args = (*lex_base, wctx["l2g"], jnp.float32(avgdl), qn,
+                    g["matrix"], g["adj"], g["validf"], *tail)
             ls, lgrow, vs, vi, fs, fpos = _walk_fused_sharded_impl(
                 *args, **statics, mesh_holder=_holder(snap["mesh"]))
         else:
@@ -733,22 +976,48 @@ class FusedHybrid:
         # force to host inside the timed window (async dispatch)
         ls, lgrow = np.asarray(ls), np.asarray(lgrow)
         vs, vi = np.asarray(vs), np.asarray(vi)
-        fs, fpos = np.asarray(fs), np.asarray(fpos)
+        if fs is not None:
+            fs, fpos = np.asarray(fs), np.asarray(fpos)
+        if quant is not None:
+            # exact rerank of the walk pool against the host float32
+            # rows (non-delta rows are immutable between builds, so
+            # these ARE current values; delta ids re-score in _decode)
+            gathered = g["matrix"][vi]  # host f32 [B, kp, D]
+            vs_e = np.einsum("bpd,bd->bp", gathered, np.asarray(qn))
+            vs_e = np.where(vs > 0.5 * NEG_INF, vs_e,
+                            np.float32(NEG_INF)).astype(np.float32)
+            order = np.argsort(-vs_e, axis=1, kind="stable")
+            vs = np.take_along_axis(vs_e, order, axis=1)
+            vi = np.take_along_axis(vi, order, axis=1)
         t1 = time.time()
-        record_dispatch("hybrid_walk_fused", pow2_bucket(b), kp,
-                        t1 - t0)
+        kind = ("hybrid_walk_fused_quant" if quant is not None
+                else "hybrid_walk_fused")
+        record_dispatch(kind, pow2_bucket(b), kp, t1 - t0)
         _HYB_C.labels("walk_dispatch").inc()
-        self._record_cost("hybrid_walk_fused", b, snap,
-                          vec_flops_bytes=_cost.price_walk(
-                              pow2_bucket(b), int(g["matrix"].shape[1]),
-                              wctx["iters"], wctx["width"],
-                              int(g["adj"].shape[1]), wctx["itopk"],
-                              n_seeds=wctx["n_seeds"]))
+        if quant is not None:
+            d_model = int(quant["codes"].shape[1])
+            vf, vb = _cost.price_walk_quant(
+                pow2_bucket(b), d_model, wctx["iters"], wctx["width"],
+                int(g["adj"].shape[1]), wctx["itopk"],
+                quant["head_dims"], quant["keep"],
+                n_seeds=wctx["n_seeds"])
+            rf, rb = _cost.price_rerank(pow2_bucket(b), kp, d_model)
+            self._record_cost(kind, b, snap,
+                              vec_flops_bytes=(vf + rf, vb + rb))
+        else:
+            self._record_cost(kind, b, snap,
+                              vec_flops_bytes=_cost.price_walk(
+                                  pow2_bucket(b),
+                                  int(g["matrix"].shape[1]),
+                                  wctx["iters"], wctx["width"],
+                                  int(g["adj"].shape[1]), wctx["itopk"],
+                                  n_seeds=wctx["n_seeds"]))
         out = self._decode(
             snap, g["row_ids"], delta, token_rows, extras,
             ls, lgrow, vs, vi, fs, fpos, kp,
             vec_delta=(wctx["delta_ids"], wctx["delta_vecs"]),
-            vec_stale=wctx["stale"], qn=np.asarray(qn))
+            vec_stale=wctx["stale"], qn=np.asarray(qn),
+            force_refuse=quant is not None)
         # under-fill veto: a stale graph's live-filter (or a walk miss)
         # can leave a row short of candidates the corpus does have —
         # those batches re-dispatch through the exact tier, the same
@@ -772,7 +1041,8 @@ class FusedHybrid:
         times = {"plan_s": t0 - t_plan0, "device_t0": t0,
                  "device_t1": t1, "decode_s": time.time() - t1,
                  "tier": "walk", "walk_iters": wctx["iters"],
-                 "walk_itopk": wctx["itopk"]}
+                 "walk_itopk": wctx["itopk"],
+                 **({"quant": "int8"} if quant is not None else {})}
         for row in out:
             if row is not None:
                 row["times"] = times
@@ -859,7 +1129,8 @@ class FusedHybrid:
 
     def _decode(self, snap, vec_ids, delta, token_rows, extras,
                 ls, lgrow, vs, vi, fs, fpos, kq,
-                vec_delta=None, vec_stale=False, qn=None):
+                vec_delta=None, vec_stale=False, qn=None,
+                force_refuse=False):
         """Decode one dispatch's device candidates into per-request
         ranked lists. ``vec_ids`` maps vector candidate ids to ext ids
         (the brute ext-id table for the matmul tier, graph ``row_ids``
@@ -897,10 +1168,15 @@ class FusedHybrid:
                 lex_hits.append((eid, float(ls[r, c])))
             vec_hits: List[Tuple[str, float]] = []
             vec_by_pos: Dict[int, str] = {}
-            vec_fixed = False  # this row's list diverged from the
-            #   device-fused one: re-fuse on host. A merely-stale graph
-            #   whose top-itopk held no tombstone keeps the device fuse.
-            for c in range(min(kq, vs.shape[1])):
+            vec_fixed = force_refuse  # this row's list diverged from
+            #   the device-fused one: re-fuse on host. A merely-stale
+            #   graph whose top-itopk held no tombstone keeps the
+            #   device fuse. Quantized tiers ALWAYS re-fuse: their
+            #   device fuse ranked coarse scores, the decode reranked
+            #   them exactly.
+            # the quant tiers overfetch vs/vi wider than kq (rerank
+            # pool); the break on n_cand keeps served depth identical
+            for c in range(vs.shape[1]):
                 if vs[r, c] < 0.5 * NEG_INF or len(vec_hits) >= n_cand:
                     break
                 eid = vec_ids[int(vi[r, c])]

@@ -21,58 +21,17 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from nornicdb_tpu.ops.kmeans import optimal_k
+from nornicdb_tpu.ops.kmeans import (
+    euclid_kmeans as _euclid_kmeans,
+    optimal_k,
+    train_subspace_codebooks,
+)
 from nornicdb_tpu.search.util import normalize_rows as _normalize
 
-
-def _euclid_kmeans(
-    x: np.ndarray, k: int, iters: int = 25,
-    seed_ids: Optional[Sequence[int]] = None, seed: int = 0,
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Euclidean Lloyd with kmeans++ init (optionally seeded rows first).
-    ops.kmeans.kmeans_fit normalizes rows (cosine clustering) which
-    would corrupt PQ residual codebooks — PQ needs true L2 geometry."""
-    rng = np.random.default_rng(seed)
-    n = len(x)
-    k = max(1, min(k, n))
-    chosen: List[int] = list(dict.fromkeys(
-        int(i) for i in (seed_ids or []) if 0 <= int(i) < n))[:k]
-    if not chosen:
-        chosen = [int(rng.integers(n))]
-    # incremental k-means++: keep the running min-distance-to-chosen
-    # array and update it against ONLY the newest center — O(k*n*d),
-    # not O(k^2*n*d) (the recompute-all version took ~9 min for one
-    # 256-code codebook at n=10k)
-    d2 = np.full(n, np.inf, dtype=np.float64)
-    for i in chosen:
-        d2 = np.minimum(d2, np.sum((x - x[i]) ** 2, axis=1))
-    while len(chosen) < k:
-        total = d2.sum()
-        if total <= 1e-12:
-            # all remaining points coincide with a centroid (duplicate/
-            # constant subvectors): fall back to uniform picks
-            nxt = int(rng.integers(n))
-        else:
-            nxt = int(rng.choice(n, p=d2 / total))
-        chosen.append(nxt)
-        d2 = np.minimum(d2, np.sum((x - x[nxt]) ** 2, axis=1))
-    cent = x[chosen].copy()
-    assign = np.zeros(n, dtype=np.int64)
-    for it in range(iters):
-        dist = (
-            np.sum(x**2, axis=1, keepdims=True)
-            - 2.0 * x @ cent.T
-            + np.sum(cent**2, axis=1)[None, :]
-        )
-        new_assign = np.argmin(dist, axis=1)
-        if it > 0 and np.array_equal(new_assign, assign):
-            break
-        assign = new_assign
-        for j in range(k):
-            members = x[assign == j]
-            if len(members):
-                cent[j] = members.mean(axis=0)
-    return cent.astype(np.float32), assign
+# _euclid_kmeans moved to ops/kmeans.py (euclid_kmeans): the device PQ
+# plane (search/device_quant.py) trains through the SAME implementation,
+# so host IVF-PQ and device PQ codebooks stay bit-identical given the
+# same sample/seed. The alias keeps this module's call sites intact.
 
 
 class IVFPQIndex:
@@ -142,17 +101,8 @@ class IVFPQIndex:
         k = self.n_clusters or max(1, optimal_k(n))
         self.coarse, assign = _euclid_kmeans(sample, k, seed_ids=seed_ids)
         residuals = sample - self.coarse[assign]
-        sub = residuals.reshape(n, self.m, d // self.m)
-        codebooks = []
-        codes_k = min(self.n_codes, n)
-        for j in range(self.m):
-            cb, _ = _euclid_kmeans(
-                np.ascontiguousarray(sub[:, j, :]), codes_k, seed=j + 1)
-            if cb.shape[0] < self.n_codes:  # pad to fixed shape
-                pad = np.repeat(cb[-1:], self.n_codes - cb.shape[0], axis=0)
-                cb = np.concatenate([cb, pad], axis=0)
-            codebooks.append(cb)
-        self.codebooks = np.stack(codebooks)  # [M, 256, D/M]
+        self.codebooks = train_subspace_codebooks(
+            residuals, self.m, self.n_codes)  # [M, 256, D/M]
 
     # -- encode / add ----------------------------------------------------
 

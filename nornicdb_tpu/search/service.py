@@ -745,7 +745,8 @@ class SearchService:
                 # Direct brute call (rare path: eval + exact=True).
                 _STRATEGY_C.labels("exact").inc()
                 return self.vectors.search_batch(
-                    np.asarray([query_vec], dtype=np.float32), k)[0]
+                    np.asarray([query_vec], dtype=np.float32), k,
+                    exact=True)[0]
             # micro-batched: concurrent singles ride one device call
             _STRATEGY_C.labels("brute").inc()
             return self._microbatch.search(query_vec, k)

@@ -80,6 +80,11 @@ class BruteForceIndex:
         self._dirty = True
         # (mutations, ext_ids copy) memo for device_view consumers
         self._view_ids_cache = None
+        # quantized serving plane (search/device_quant.py), created
+        # lazily when NORNICDB_VECTOR_QUANT != off and the corpus
+        # clears the quant floor — HBM then holds int8/PQ codes while
+        # this host matrix stays the float32 source of truth
+        self._quant = None
 
     def __len__(self) -> int:
         return self._n_alive
@@ -178,7 +183,8 @@ class BruteForceIndex:
                 dev_b = int(getattr(dev, "nbytes", 0)) + int(
                     getattr(self._dev_valid, "nbytes", 0) or 0)
             used = max(self._count, 1)
-            return {
+            quant = self._quant
+            stats = {
                 "rows": self._n_alive,
                 "capacity": self._capacity,
                 "device_bytes": dev_b,
@@ -191,6 +197,11 @@ class BruteForceIndex:
                 "changelog_cap": self.changelog_cap(),
                 "mutations": self.mutations,
             }
+        if quant is not None:
+            # outside the index lock: the plane takes no brute locks in
+            # resource_stats_extra, but keep lock ordering trivial
+            stats.update(quant.resource_stats_extra())
+        return stats
 
     def changed_since(self, seq: int) -> Optional[List[str]]:
         """ext_ids added or UPDATED after mutation ``seq`` (latest first,
@@ -283,6 +294,22 @@ class BruteForceIndex:
                 return None
             return self._matrix[slot].copy()
 
+    def delta_vectors(self, ext_ids):
+        """(ids, rows f32 [n, D] or None) for changelog delta ids under
+        ONE lock hold, skipping ids removed since logging — the
+        exact-float32 side-scan gather every quantized serving path
+        shares (rows are CURRENT matrix values: read-your-writes)."""
+        with self._lock:
+            ids: List[str] = []
+            rows = []
+            for eid in ext_ids:
+                slot = self._slot_of.get(eid)
+                if slot is None:
+                    continue
+                ids.append(eid)
+                rows.append(self._matrix[slot].copy())
+        return ids, (np.stack(rows) if ids else None)
+
     def slots_of(
         self, ext_ids: Sequence[str],
         expect_mutations: Optional[int] = None,
@@ -298,6 +325,28 @@ class BruteForceIndex:
                     and self.mutations != expect_mutations:
                 return None
             return [self._slot_of.get(e, -1) for e in ext_ids]
+
+    def rows_for_slots(
+        self, slots, expect_compactions: Optional[int] = None,
+    ):
+        """(rows f32 [n, D] copy, alive [n] bool, ext_ids [n]) for the
+        given slot ids under ONE lock hold — the exact-rerank gather of
+        the quantized plane. Rows are the CURRENT matrix values, so an
+        in-place update reranks fresh automatically. Returns None when
+        ``expect_compactions`` no longer matches (a compaction remapped
+        the slot space since the caller's plane was built — slot-keyed
+        reads can no longer be trusted) or a slot is out of range."""
+        with self._lock:
+            if expect_compactions is not None \
+                    and self.compactions != expect_compactions:
+                return None
+            if self._matrix is None:
+                return None
+            sl = np.asarray(slots, dtype=np.int64)
+            if sl.size and (sl.min() < 0 or sl.max() >= self._capacity):
+                return None
+            return (self._matrix[sl].copy(), self._valid[sl].copy(),
+                    [self._ext_ids[int(i)] for i in sl])
 
     # -- search -----------------------------------------------------------
 
@@ -319,6 +368,22 @@ class BruteForceIndex:
             if self._n_alive == 0 or self._matrix is None:
                 return None
             return self.mutations, self.compactions
+
+    def ids_meta(self):
+        """(ext_ids copy, mutations, compactions) — or None while
+        empty — WITHOUT forcing the device arrays current. The
+        quantized fused tier joins/decodes against slot ids and must
+        not pay the float32 matrix re-ship that :meth:`device_view`
+        implies after a write burst. Shares device_view's per-
+        generation ids memo."""
+        with self._lock:
+            if self._n_alive == 0 or self._matrix is None:
+                return None
+            cached = self._view_ids_cache
+            if cached is None or cached[0] != self.mutations:
+                cached = (self.mutations, list(self._ext_ids))
+                self._view_ids_cache = cached
+            return cached[1], self.mutations, self.compactions
 
     def device_view(self):
         """Consistent device-side view for external batched kernels (the
@@ -373,10 +438,71 @@ class BruteForceIndex:
     # index life live here
     _SMALL_HOST = 1 << 18
 
+    def quant_plane(self):
+        """The lazily-created quantized serving plane when
+        NORNICDB_VECTOR_QUANT is configured and the corpus clears the
+        quant floor, else None. ONE plane per index — direct kNN
+        serving and the fused hybrid tier share it (one compressed copy
+        in HBM, one rebuild cadence)."""
+        from nornicdb_tpu.search.device_quant import (
+            quant_min_n,
+            quant_mode,
+        )
+
+        if quant_mode() == "off" or self._n_alive < quant_min_n():
+            return None
+        plane = self._quant
+        if plane is None:
+            from nornicdb_tpu.config import env_bool, env_int
+            from nornicdb_tpu.search.device_quant import (
+                QuantizedBrutePlane,
+            )
+
+            with self._lock:
+                plane = self._quant
+                if plane is None:
+                    plane = QuantizedBrutePlane(
+                        self,
+                        n_shards=max(1, env_int("QUANT_SHARDS", 1)),
+                        build_inline=env_bool("QUANT_INLINE_BUILD",
+                                              False),
+                        overfetch=max(1, env_int("QUANT_OVERFETCH", 8)),
+                        min_pool=max(1, env_int("QUANT_MIN_POOL", 128)))
+                    self._quant = plane
+        return plane
+
+    def _quant_search_batch(self, queries, k):
+        """Quantized coarse-then-exact serving (device_quant.py) when
+        NORNICDB_VECTOR_QUANT is set and the corpus clears the quant
+        floor. None = the float32 tier serves this batch — the degrade
+        ladder is quantized -> float32 -> host, never a wrong answer.
+        Fail-open: any plane error degrades, never fails a search."""
+        plane = self.quant_plane()
+        if plane is None:
+            return None
+        try:
+            return plane.search_batch(
+                np.asarray(queries, dtype=np.float32), k)
+        except Exception:  # noqa: BLE001 — degrade, never fail
+            # counted: a persistent plane bug silently eating the
+            # compression win must show up in quant_events_total
+            from nornicdb_tpu.search.device_quant import _QUANT_C
+
+            _QUANT_C.labels("degrade_error").inc()
+            return None
+
     def search_batch(
-        self, queries: np.ndarray, k: int = 10
+        self, queries: np.ndarray, k: int = 10, exact: bool = False
     ) -> List[List[Tuple[str, float]]]:
-        """Batched exact search; returns per-query [(ext_id, cosine)]."""
+        """Batched exact search; returns per-query [(ext_id, cosine)].
+        With ``NORNICDB_VECTOR_QUANT`` set, large corpora serve through
+        the quantized coarse+exact-rerank plane instead (answers remain
+        exact-rescored float32; ``exact=True`` bypasses the plane for
+        callers whose contract is exhaustive recall)."""
+        if not exact:
+            out = self._quant_search_batch(queries, k)
+            if out is not None:
+                return out
         with self._lock:
             if self._n_alive == 0:
                 return [[] for _ in range(len(queries))]

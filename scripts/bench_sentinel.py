@@ -78,9 +78,15 @@ CHECKS: Dict[str, Tuple] = {
     # it flags when fresh > tolerance x baseline
     "load_knee_qps": ("qps", 0.2),
     "load_p99_at_load_ms": ("latency", 5.0),
+    # quantization ladder (round r08+): int8-rung serving qps floor
+    # once a quant-carrying baseline exists; the WORST rung's recall@10
+    # gates ABSOLUTELY from the first round it appears — compression
+    # paid for with ranking quality is a regression, not a win
+    "quant_qps_b16": ("qps", 0.5),
     "cagra_recall10": ("quality", 0.90, 0.05),
     "hybrid_rank_parity": ("quality", 0.98, 0.02),
     "hybrid_walk_recall10": ("quality", 0.95, 0.02),
+    "quant_recall10": ("quality", 0.95, 0.02),
     "hybrid_compile_buckets": ("growth", 2),
 }
 
@@ -126,6 +132,11 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     out["hybrid_walk_recall10"] = _num(
         hyb.get("walk_recall10") if is_summary
         else _g(hyb, "walk", "walk_recall10"))
+    # quant stage keys are identical in both shapes (the summary's
+    # "quant" block carries the full result's headline trio verbatim)
+    quant = doc.get("quant") or {}
+    out["quant_qps_b16"] = _num(quant.get("quant_qps_b16"))
+    out["quant_recall10"] = _num(quant.get("quant_recall10"))
     out["pagerank_speedup"] = _num(
         doc.get("pagerank_speedup_vs_numpy") if is_summary
         else _g(doc, "northstar", "pagerank_device", "speedup_vs_numpy"))

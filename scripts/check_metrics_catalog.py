@@ -39,6 +39,7 @@ IMPORT_TIME_MODULES = (
     "nornicdb_tpu.search.service",
     "nornicdb_tpu.search.cagra",
     "nornicdb_tpu.search.device_bm25",
+    "nornicdb_tpu.search.device_quant",
     "nornicdb_tpu.search.hybrid_fused",
     "nornicdb_tpu.storage.wal",
     "nornicdb_tpu.api.bolt",

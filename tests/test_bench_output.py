@@ -82,6 +82,17 @@ def _fake_result():
                        "crossover_n": 100_000,
                        "walk_qps_b16": 250.0,
                        "walk_recall10": 0.96}},
+        "quant": {"n": 100_000, "dims": 64, "backend": "cpu",
+                  "modes": {
+                      "off": {"qps_b16": 220.0, "recall10": 1.0},
+                      "int8": {"qps_b16": 260.0, "recall10": 1.0,
+                               "compression_ratio": 3.7},
+                      "pq": {"qps_b16": 300.0, "recall10": 0.97,
+                             "compression_ratio": 14.2}},
+                  "quant_qps_b16": 260.0,
+                  "quant_recall10": 0.97,
+                  "compression_ratio": 14.2,
+                  "speedup_int8_vs_f32": 1.18},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -127,6 +138,12 @@ class TestCompactSummary:
                                "walk_qps_b16": 250.0,
                                "walk_recall10": 0.96,
                                "crossover_n": 100_000}
+        # quantization ladder (ISSUE 8 trio): int8-rung qps, worst-rung
+        # recall (the sentinel's 0.95 absolute floor), PQ compression
+        assert s["quant"] == {"quant_qps_b16": 260.0,
+                              "quant_recall10": 0.97,
+                              "compression_ratio": 14.2,
+                              "speedup_int8_vs_f32": 1.18}
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -142,6 +159,7 @@ class TestCompactSummary:
         assert s["knn"]["b1_qps"] is None
         assert s["cagra"]["qps_at_recall95"] is None
         assert s["hybrid"]["fused_qps_b16"] is None
+        assert s["quant"]["quant_recall10"] is None
         assert s["latency_ms"] == {}
         assert s["tpu_proof"] is None
 
@@ -197,8 +215,8 @@ class TestBenchDryRunArtifactSchema:
     default suite here first)."""
 
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
-                    "knn", "northstar", "ann", "hybrid", "surfaces",
-                    "telemetry", "load", "tpu_proof")
+                    "knn", "northstar", "ann", "hybrid", "quant",
+                    "surfaces", "telemetry", "load", "tpu_proof")
 
     def test_dry_run_artifact_schema(self, dry_run_lines):
         lines = dry_run_lines
@@ -254,6 +272,26 @@ class TestBenchDryRunArtifactSchema:
         assert "crossover_n" in walk
         assert walk["walk_qps_b16"] > 0
         assert walk["walk_recall10"] >= 0.95
+
+        # the quantization ladder (ISSUE 8): every rung measured on the
+        # same corpus — int8 must be rank-exact behind the rerank even
+        # at toy sizes, PQ holds the recall floor, and the compressed
+        # rungs report their device bytes + ratio
+        qu = full["quant"]
+        assert set(qu["modes"]) == {"off", "int8", "pq"}
+        for mode, point in qu["modes"].items():
+            assert point["qps_b16"] > 0, mode
+            assert point["recall10"] > 0, mode
+        assert qu["modes"]["off"]["recall10"] == 1.0
+        assert qu["modes"]["int8"]["recall10"] == 1.0
+        assert qu["modes"]["pq"]["recall10"] >= 0.95
+        for mode in ("int8", "pq"):
+            assert qu["modes"][mode]["quant_device_bytes"] > 0
+            assert qu["modes"][mode]["compression_ratio"] > 1.0
+        assert qu["quant_qps_b16"] > 0
+        assert qu["quant_recall10"] >= 0.95
+        assert qu["compression_ratio"] >= 4.0
+        assert qu["backend"] == "cpu"
 
         # every surface measured, and the new framework-floor fields
         surf = full["surfaces"]
@@ -399,6 +437,7 @@ class TestBenchSentinelGate:
                        "cagra_recall10", "hybrid_fused_qps_b16",
                        "hybrid_rank_parity", "hybrid_compile_buckets",
                        "hybrid_walk_qps_b16", "hybrid_walk_recall10",
+                       "quant_qps_b16", "quant_recall10",
                        "surface_qdrant_grpc_qps", "load_knee_qps",
                        "load_p99_at_load_ms"):
             assert metric in saved["metrics"], metric
@@ -507,6 +546,33 @@ class TestBenchSentinelGate:
             fresh_ok, ["--baseline", str(base)])
         assert rc == 0
         assert "hybrid_walk_recall10" in docs[0]["passed"]
+
+    def test_quant_recall_gates_absolutely_without_baseline(
+            self, tmp_path):
+        """ISSUE 8: the quantization ladder lands in round r08 — its
+        recall floor is ABSOLUTE (0.95) and must gate even against a
+        trajectory that predates the metric, while the quant qps floor
+        stays relative and skips without a baseline."""
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps({
+            "sentinel_baseline": True,
+            "metrics": {"cypher_geomean": 100.0}}))
+        fresh = json.dumps({
+            "summary": True, "value": 100.0,
+            "quant": {"quant_qps_b16": 400.0, "quant_recall10": 0.91}})
+        rc, docs = self._run_sentinel(
+            fresh, ["--baseline", str(base)])
+        assert rc == 1
+        flagged = {f["metric"] for f in docs[0]["flagged"]}
+        assert "quant_recall10" in flagged
+        assert "quant_qps_b16" in docs[0]["skipped"]
+        fresh_ok = json.dumps({
+            "summary": True, "value": 100.0,
+            "quant": {"quant_qps_b16": 400.0, "quant_recall10": 0.97}})
+        rc, docs = self._run_sentinel(
+            fresh_ok, ["--baseline", str(base)])
+        assert rc == 0
+        assert "quant_recall10" in docs[0]["passed"]
 
     def test_sentinel_passes_real_trajectory_files(self):
         """The checked-in BENCH_r0*.json trajectory gates cleanly: the

@@ -1,0 +1,859 @@
+"""Quantized device indexes (ISSUE 8): int8/PQ coarse scoring + exact
+rerank across the brute, walk, and fused-hybrid tiers.
+
+The acceptance gates, per the issue's satellite list:
+
+- **parity corpus**: with the rerank pool covering the corpus tail,
+  int8 coarse+exact-rerank is RANK-IDENTICAL to the float32 path at
+  small N; PQ is gated on a recall@10 floor instead (its codes lose
+  rank information the rerank buys back only inside the pool).
+- **freshness ladder**: tombstones live-filter at the rerank gather,
+  post-build adds/updates ride the changelog into an exact-float32
+  side-scan, and every gap — compaction remap, changelog overrun,
+  under-filled pool, plane exception — degrades quantized -> float32
+  -> host, never to a wrong answer.
+- **mesh bit-identity**: the shard_map int8 score+merge matches the
+  single-device reference merge bit for bit on 2/4-shard CPU meshes.
+- **strategy-machine wiring**: NORNICDB_VECTOR_QUANT gates the plane
+  behind the live SearchService; exact=True always bypasses.
+- **one trainer**: host IVF-PQ and the device PQ plane train their
+  codebooks through the same seeded-Euclidean k-means — pinned
+  bit-identical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nornicdb_tpu.obs import REGISTRY
+from nornicdb_tpu.search.device_quant import (
+    QuantizedBrutePlane,
+    encode_pq,
+    fit_rotation,
+    int8_encode,
+    quant_mode,
+    train_pq,
+)
+from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+D = 32
+
+
+def _counter(name, event):
+    text = REGISTRY.render()
+    needle = f'{name}{{event="{event}"}} '
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.split()[-1])
+    return 0.0
+
+
+def _quant_counter(event):
+    return _counter("nornicdb_quant_events_total", event)
+
+
+def _index(n=500, d=D, seed=0, clustered=False):
+    rng = np.random.default_rng(seed)
+    if clustered:
+        centers = rng.standard_normal((16, d)).astype(np.float32) * 3
+        vecs = (centers[rng.integers(0, 16, n)]
+                + rng.standard_normal((n, d)).astype(np.float32))
+    else:
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+    idx = BruteForceIndex(dims=d)
+    for i in range(n):
+        idx.add(f"e{i}", vecs[i])
+    return idx, vecs, rng
+
+
+def _ids(hits):
+    return [h for h, _ in hits]
+
+
+def _recall(got, want, k):
+    return np.mean([
+        len(set(_ids(a)[:k]) & set(_ids(b)[:k])) / max(min(k, len(b)), 1)
+        for a, b in zip(got, want)])
+
+
+def _plane(idx, **kw):
+    kw.setdefault("build_inline", True)
+    kw.setdefault("rebuild_stale_frac", 1e9)  # tests drive rebuilds
+    return QuantizedBrutePlane(idx, **kw)
+
+
+# ---------------------------------------------------------------------------
+# one trainer: host IVF-PQ and the device plane share euclid_kmeans
+# ---------------------------------------------------------------------------
+
+
+class TestKmeansReuse:
+    def test_ivfpq_alias_is_the_shared_impl(self):
+        from nornicdb_tpu.ops.kmeans import euclid_kmeans
+        from nornicdb_tpu.search import ivfpq
+
+        assert ivfpq._euclid_kmeans is euclid_kmeans
+
+    def test_euclid_kmeans_deterministic(self):
+        from nornicdb_tpu.ops.kmeans import euclid_kmeans
+
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((300, 8)).astype(np.float32)
+        c1, a1 = euclid_kmeans(x, 16, seed=3)
+        c2, a2 = euclid_kmeans(x, 16, seed=3)
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_array_equal(a1, a2)
+
+    def test_host_ivfpq_codebooks_pinned_to_shared_trainer(self):
+        """IVFPQIndex.train and train_subspace_codebooks produce
+        bit-identical codebooks from the same residual sample — the
+        reuse fix's contract: ONE implementation, two consumers."""
+        from nornicdb_tpu.ops.kmeans import (
+            euclid_kmeans,
+            train_subspace_codebooks,
+        )
+        from nornicdb_tpu.search.ivfpq import IVFPQIndex
+        from nornicdb_tpu.search.util import normalize_rows
+
+        rng = np.random.default_rng(11)
+        sample = rng.standard_normal((400, 32)).astype(np.float32)
+        ivf = IVFPQIndex(n_subspaces=4, n_codes=32, n_clusters=8)
+        ivf.train(sample)
+        normed = normalize_rows(sample.astype(np.float32))
+        coarse, assign = euclid_kmeans(normed, 8, seed_ids=None)
+        np.testing.assert_array_equal(ivf.coarse, coarse)
+        residuals = normed - coarse[assign]
+        books = train_subspace_codebooks(residuals, 4, 32)
+        np.testing.assert_array_equal(ivf.codebooks, books)
+
+    def test_subspace_codebooks_pad_to_fixed_shape(self):
+        from nornicdb_tpu.ops.kmeans import train_subspace_codebooks
+
+        rng = np.random.default_rng(2)
+        sample = rng.standard_normal((10, 8)).astype(np.float32)
+        books = train_subspace_codebooks(sample, 2, 16)
+        assert books.shape == (2, 16, 4)  # padded past n=10 rows
+
+    def test_device_pq_trains_through_shared_trainer(self):
+        """train_pq below the sampling threshold IS
+        train_subspace_codebooks on the full matrix — bit-identical."""
+        from nornicdb_tpu.ops.kmeans import train_subspace_codebooks
+
+        rng = np.random.default_rng(7)
+        mat = rng.standard_normal((200, 16)).astype(np.float32)
+        np.testing.assert_array_equal(
+            train_pq(mat, 4, 32, sample_n=1024),
+            train_subspace_codebooks(mat, 4, 32))
+
+
+# ---------------------------------------------------------------------------
+# int8 plane: parity corpus — rank-identical behind the exact rerank
+# ---------------------------------------------------------------------------
+
+
+class TestInt8Parity:
+    def test_rank_identical_across_batches_and_ks(self):
+        idx, vecs, rng = _index(600, seed=1)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        for b, k in ((1, 5), (3, 10), (8, 25), (5, 64)):
+            q = rng.standard_normal((b, D)).astype(np.float32)
+            got = plane.search_batch(q, k)
+            want = idx.search_batch(q, k, exact=True)
+            assert got is not None
+            for g, w in zip(got, want):
+                assert _ids(g) == _ids(w)
+                np.testing.assert_allclose(
+                    [s for _, s in g], [s for _, s in w], rtol=1e-5)
+
+    def test_clustered_corpus_rank_identical(self):
+        idx, vecs, rng = _index(800, seed=2, clustered=True)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        q = vecs[rng.integers(0, 800, 6)] \
+            + 0.1 * rng.standard_normal((6, D)).astype(np.float32)
+        got = plane.search_batch(q.astype(np.float32), 10)
+        want = idx.search_batch(q.astype(np.float32), 10, exact=True)
+        for g, w in zip(got, want):
+            assert _ids(g) == _ids(w)
+
+    def test_zero_and_duplicate_rows_safe(self):
+        idx = BruteForceIndex(dims=8)
+        idx.add("z", np.zeros(8, np.float32))
+        for i in range(64):
+            idx.add(f"d{i}", np.ones(8, np.float32))
+        plane = _plane(idx, mode="int8", min_pool=8)
+        assert plane.build()
+        out = plane.search_batch(np.ones((1, 8), np.float32), 5)
+        assert out is not None and len(out[0]) == 5
+        assert all(np.isfinite(s) for _, s in out[0])
+
+    def test_int8_encode_roundtrip_error_bounded(self):
+        rng = np.random.default_rng(3)
+        rows = rng.standard_normal((50, 16)).astype(np.float32)
+        codes, scale = int8_encode(rows)
+        assert codes.dtype == np.int8
+        deq = codes.astype(np.float32) * scale[:, None]
+        amax = np.abs(rows).max(axis=1, keepdims=True)
+        assert np.max(np.abs(deq - rows) / amax) <= (0.5 / 127) + 1e-6
+
+    def test_compression_reported(self):
+        idx, _, _ = _index(400, d=64, seed=4)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        extra = plane.resource_stats_extra()
+        assert extra["quant_device_bytes"] > 0
+        assert extra["compression_ratio"] >= 3.5  # ~3.7 at d=64
+        stats = idx.resource_stats()  # merged through the index
+        # plane is external here (idx._quant unset) — wire it
+        idx._quant = plane
+        stats = idx.resource_stats()
+        assert stats["compression_ratio"] == extra["compression_ratio"]
+        assert stats["quant_mode_int8"] == 1
+
+
+# ---------------------------------------------------------------------------
+# PQ plane: recall floor + density-aware training
+# ---------------------------------------------------------------------------
+
+
+class TestPQPlane:
+    def test_recall_floor_with_rerank(self):
+        idx, vecs, rng = _index(1200, d=D, seed=5, clustered=True)
+        plane = _plane(idx, mode="pq", pq_m=8, pq_codes=64)
+        assert plane.build()
+        q = vecs[rng.integers(0, 1200, 8)] \
+            + 0.1 * rng.standard_normal((8, D)).astype(np.float32)
+        got = plane.search_batch(q.astype(np.float32), 10)
+        want = idx.search_batch(q.astype(np.float32), 10, exact=True)
+        assert got is not None
+        assert _recall(got, want, 10) >= 0.95
+        # answered scores are EXACT cosines, not ADC estimates
+        for g, w in zip(got, want):
+            exact = dict(w)
+            for eid, s in g:
+                if eid in exact:
+                    assert abs(s - exact[eid]) < 1e-5
+
+    def test_density_aware_sampling_path(self):
+        """n > sample_n routes training through the kmeans_fit quota
+        sampler; codebooks stay usable (encode + recall sane)."""
+        rng = np.random.default_rng(6)
+        # one dense blob + a sparse far cluster
+        dense = rng.standard_normal((900, 16)).astype(np.float32)
+        sparse = rng.standard_normal((60, 16)).astype(np.float32) + 8.0
+        mat = np.concatenate([dense, sparse])
+        books = train_pq(mat, 4, 32, sample_n=256, seed=1)
+        assert books.shape == (4, 32, 4)
+        codes = encode_pq(mat, books, chunk=256)
+        assert codes.shape == (960, 4) and codes.dtype == np.uint8
+        # sparse cluster must not collapse to one code per subspace
+        sparse_codes = codes[900:]
+        assert all(len(np.unique(sparse_codes[:, j])) > 1
+                   for j in range(4))
+
+    def test_pq_compression_ratio_over_4x(self):
+        idx, _, _ = _index(600, d=64, seed=7)
+        plane = _plane(idx, mode="pq", pq_m=8, pq_codes=64)
+        assert plane.build()
+        assert plane.resource_stats_extra()["compression_ratio"] >= 4.0
+
+    def test_pool_floor_scales_with_codebook_coarseness(self):
+        """Coarser codebooks mean noisier ADC ranks: the rerank-pool
+        floor must widen with fewer codes, not stay pinned to the
+        256-code calibration."""
+        plane = _plane(BruteForceIndex(dims=D), mode="pq")
+        cap = 1 << 16
+        fine = plane.pool_for(10, {"mode": "pq", "capacity": cap,
+                                   "pq_codes": 256})
+        coarse = plane.pool_for(10, {"mode": "pq", "capacity": cap,
+                                     "pq_codes": 64})
+        assert fine >= cap // 256
+        assert coarse >= cap // 64
+        assert coarse > fine
+
+
+# ---------------------------------------------------------------------------
+# freshness ladder: quantized -> float32 -> host, never wrong answers
+# ---------------------------------------------------------------------------
+
+
+class TestFreshnessLadder:
+    def test_mode_off_no_plane(self, monkeypatch):
+        monkeypatch.delenv("NORNICDB_VECTOR_QUANT", raising=False)
+        assert quant_mode() == "off"
+        idx, _, _ = _index(300)
+        assert idx.quant_plane() is None
+
+    def test_unknown_mode_reads_off(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int4")
+        assert quant_mode() == "off"
+
+    def test_below_min_n_no_plane(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int8")
+        monkeypatch.setenv("NORNICDB_QUANT_MIN_N", "1000")
+        idx, _, _ = _index(300)
+        assert idx.quant_plane() is None
+
+    def test_tombstones_live_filtered(self):
+        idx, vecs, rng = _index(500, seed=8)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        q = vecs[7:8] + 0.01
+        top = _ids(plane.search_batch(q.astype(np.float32), 5)[0])
+        assert top[0] == "e7"
+        idx.remove("e7")  # tombstone AFTER the plane build
+        got = plane.search_batch(q.astype(np.float32), 5)
+        want = idx.search_batch(q.astype(np.float32), 5, exact=True)
+        assert got is not None
+        assert "e7" not in _ids(got[0])
+        assert _ids(got[0]) == _ids(want[0])
+
+    def test_delta_side_scan_read_your_writes(self):
+        idx, vecs, rng = _index(500, seed=9)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        q = rng.standard_normal((1, D)).astype(np.float32)
+        # a post-build add that IS the best match must surface exactly
+        target = (q[0] / np.linalg.norm(q[0])).astype(np.float32)
+        before = _quant_counter("delta_merge")
+        idx.add("fresh", target)
+        got = plane.search_batch(q, 5)
+        assert got is not None
+        assert _ids(got[0])[0] == "fresh"
+        assert got[0][0][1] == pytest.approx(1.0, abs=1e-5)
+        assert _quant_counter("delta_merge") == before + 1
+
+    def test_update_supersedes_stale_codes(self):
+        idx, vecs, rng = _index(500, seed=10)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        q = rng.standard_normal((1, D)).astype(np.float32)
+        target = (q[0] / np.linalg.norm(q[0])).astype(np.float32)
+        idx.add("e3", target)  # in-place UPDATE after the build
+        got = plane.search_batch(q, 5)
+        want = idx.search_batch(q, 5, exact=True)
+        assert got is not None
+        assert _ids(got[0])[0] == "e3"
+        assert _ids(got[0]) == _ids(want[0])
+
+    def test_compaction_degrades(self):
+        idx, vecs, _ = _index(500, seed=11)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        for i in range(0, 200):
+            idx.remove(f"e{i}")
+        assert idx.compact()
+        before = _quant_counter("degrade_compaction")
+        q = vecs[300:301].astype(np.float32)
+        assert plane.search_batch(q, 5) is None
+        assert _quant_counter("degrade_compaction") == before + 1
+
+    def test_changelog_overrun_degrades(self):
+        idx, vecs, rng = _index(300, d=8, seed=12)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        cap = idx.changelog_cap()
+        for i in range(cap + 10):  # churn past the changelog floor
+            idx.add(f"e{i % 300}", rng.standard_normal(8))
+        before = _quant_counter("degrade_changelog")
+        assert plane.search_batch(
+            vecs[:1].astype(np.float32), 5) is None
+        assert _quant_counter("degrade_changelog") == before + 1
+
+    def test_underfill_degrades(self):
+        idx, vecs, rng = _index(600, seed=13, clustered=True)
+        plane = _plane(idx, mode="int8", overfetch=1, min_pool=16)
+        assert plane.build()
+        q = vecs[50:51].astype(np.float32)
+        pool_ids = _ids(plane.search_batch(q, 16)[0])
+        for eid in pool_ids:  # tombstone the ENTIRE pool for this query
+            idx.remove(eid)
+        before = _quant_counter("degrade_underfill")
+        assert plane.search_batch(q, 16) is None
+        assert _quant_counter("degrade_underfill") == before + 1
+
+    def test_search_batch_serves_exact_on_degrade(self, monkeypatch):
+        """The index-level ladder: plane errors/vetoes fall through to
+        the float32 tier transparently — callers always get answers."""
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int8")
+        monkeypatch.setenv("NORNICDB_QUANT_MIN_N", "64")
+        monkeypatch.setenv("NORNICDB_QUANT_INLINE_BUILD", "1")
+        idx, vecs, rng = _index(300, seed=14)
+        q = rng.standard_normal((2, D)).astype(np.float32)
+        served = idx.search_batch(q, 5)
+        exact = idx.search_batch(q, 5, exact=True)
+        assert [_ids(r) for r in served] == [_ids(r) for r in exact]
+
+        # plane raising degrades instead of failing the search
+        def boom(*a, **k):
+            raise RuntimeError("injected")
+
+        monkeypatch.setattr(idx._quant, "search_batch", boom)
+        before = _quant_counter("degrade_error")
+        served = idx.search_batch(q, 5)
+        assert [_ids(r) for r in served] == [_ids(r) for r in exact]
+        # the swallowed exception is still visible to operators
+        assert _quant_counter("degrade_error") == before + 1
+
+    def test_background_rebuild_freshens(self):
+        idx, vecs, rng = _index(400, seed=15)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        seq0 = plane._snap["built_mutations"]
+        idx.add("late", rng.standard_normal(D))
+        assert plane.build()  # explicit rebuild picks the add up
+        assert plane._snap["built_mutations"] > seq0
+        assert plane.builds == 2
+
+
+# ---------------------------------------------------------------------------
+# mesh bit-identity: shard_map int8 score+merge == reference merge
+# ---------------------------------------------------------------------------
+
+
+class TestShardedInt8:
+    def setup_method(self):
+        if len(jax.devices()) < 4:
+            pytest.skip("needs the virtual multi-device CPU mesh")
+
+    @pytest.mark.parametrize("n_shards", [2, 4])
+    def test_mesh_bit_identical_to_reference(self, n_shards):
+        from nornicdb_tpu.parallel.mesh import _MeshHolder, data_mesh
+        from nornicdb_tpu.search.device_quant import (
+            _int8_sharded_impl,
+            int8_topk_shard_reference,
+        )
+
+        rng = np.random.default_rng(16)
+        c, d, b, k = 256, 16, 8, 16
+        mat = rng.standard_normal((c, d)).astype(np.float32)
+        codes, scale = int8_encode(mat)
+        codes_t = jnp.asarray(np.ascontiguousarray(codes.T))
+        valid = np.ones(c, dtype=bool)
+        valid[10:30] = False
+        qn = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+        mesh_s, mesh_i = _int8_sharded_impl(
+            qn, codes_t, jnp.asarray(scale),
+            jnp.asarray(valid), k=k,
+            mesh_holder=_MeshHolder(data_mesh(n_shards)))
+        ref_s, ref_i = int8_topk_shard_reference(
+            qn, codes_t, jnp.asarray(scale),
+            jnp.asarray(valid), k, n_shards)
+        np.testing.assert_array_equal(
+            np.asarray(mesh_s).view(np.int32),
+            np.asarray(ref_s).view(np.int32))
+        np.testing.assert_array_equal(np.asarray(mesh_i),
+                                      np.asarray(ref_i))
+
+    def test_sharded_plane_serves_rank_identical(self):
+        idx, vecs, rng = _index(512, seed=17)
+        plane = _plane(idx, mode="int8", n_shards=2)
+        assert plane.build()
+        assert plane._snap["shards"] == 2 and "mesh" in plane._snap
+        q = rng.standard_normal((4, D)).astype(np.float32)
+        got = plane.search_batch(q, 10)
+        want = idx.search_batch(q, 10, exact=True)
+        assert got is not None
+        for g, w in zip(got, want):
+            assert _ids(g) == _ids(w)
+
+
+# ---------------------------------------------------------------------------
+# quantized CAGRA walk: PCA prefilter + int8 base + exact pool rerank
+# ---------------------------------------------------------------------------
+
+
+class TestQuantWalk:
+    def _corpus(self, n=3000, d=D, seed=18):
+        return _index(n, d=d, seed=seed, clustered=True)
+
+    def test_rotation_is_orthogonal(self):
+        rng = np.random.default_rng(19)
+        rows = rng.standard_normal((500, 16)).astype(np.float32)
+        rot = fit_rotation(rows)
+        np.testing.assert_allclose(rot @ rot.T, np.eye(16), atol=1e-4)
+        # dots preserved under the rotation
+        a, b = rows[:10] @ rot, rows[10:20] @ rot
+        np.testing.assert_allclose(
+            a @ b.T, rows[:10] @ rows[10:20].T, atol=1e-3)
+
+    def test_graph_base_quantized_and_reranked(self, monkeypatch):
+        from nornicdb_tpu.search.cagra import CagraIndex
+
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int8")
+        idx, vecs, rng = self._corpus()
+        cag = CagraIndex(brute=idx, min_n=100)
+        assert cag.build()
+        g = cag._graph
+        assert g["quant"] is not None
+        assert g["quant"]["codes"].dtype == jnp.int8
+        assert isinstance(g["matrix"], np.ndarray)  # host-resident f32
+        q = (vecs[rng.integers(0, len(vecs), 6)]
+             + 0.1 * rng.standard_normal((6, D))).astype(np.float32)
+        got = cag.search_batch(q, 10)
+        # rerank contract: answered scores are exact float32 cosines
+        qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+        for r, hits in enumerate(got):
+            assert hits
+            for eid, s in hits:
+                v = idx.get(eid)
+                vn = v / np.linalg.norm(v)
+                assert s == pytest.approx(float(qn[r] @ vn), abs=1e-4)
+
+    def test_quant_walk_recall_matches_float32_walk(self, monkeypatch):
+        from nornicdb_tpu.search.cagra import CagraIndex
+
+        idx, vecs, rng = self._corpus(seed=20)
+        q = (vecs[rng.integers(0, len(vecs), 8)]
+             + 0.1 * rng.standard_normal((8, D))).astype(np.float32)
+        exact = idx.search_batch(q, 10, exact=True)
+
+        monkeypatch.delenv("NORNICDB_VECTOR_QUANT", raising=False)
+        cag_f = CagraIndex(brute=idx, min_n=100)
+        assert cag_f.build()
+        rec_f = _recall(cag_f.search_batch(q, 10), exact, 10)
+
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int8")
+        cag_q = CagraIndex(brute=idx, min_n=100)
+        assert cag_q.build()
+        rec_q = _recall(cag_q.search_batch(q, 10), exact, 10)
+        # the prefilter+int8 base may prune differently but must stay
+        # within noise of the float32 walk (fixed seeds: deterministic)
+        assert rec_q >= rec_f - 0.05
+
+    def test_sharded_graph_keeps_float32(self, monkeypatch):
+        from nornicdb_tpu.search.cagra import CagraIndex
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the virtual multi-device CPU mesh")
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int8")
+        idx, vecs, _ = self._corpus(n=1024, seed=21)
+        cag = CagraIndex(brute=idx, min_n=100, n_shards=2)
+        assert cag.build()
+        assert cag._graph["quant"] is None  # mesh walk stays f32
+        assert cag.search_batch(vecs[:2].astype(np.float32), 5)
+
+    def test_resource_stats_report_compression(self, monkeypatch):
+        from nornicdb_tpu.search.cagra import CagraIndex
+
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int8")
+        idx, _, _ = self._corpus(n=1500, d=64, seed=22)
+        cag = CagraIndex(brute=idx, min_n=100)
+        assert cag.build()
+        st = cag.resource_stats()
+        assert st["quant_device_bytes"] > 0
+        assert st["compression_ratio"] > 2.0
+        # float32 base moved OFF device into host accounting
+        assert st["host_bytes"] > 8 * st["rows"]
+
+
+# ---------------------------------------------------------------------------
+# strategy machine: env-gated serving through the live service
+# ---------------------------------------------------------------------------
+
+
+class TestServiceWiring:
+    def test_env_gated_plane_serves_service_searches(self, monkeypatch):
+        import nornicdb_tpu
+        from nornicdb_tpu.search.service import SearchService
+        from nornicdb_tpu.storage.types import Node
+
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int8")
+        monkeypatch.setenv("NORNICDB_QUANT_MIN_N", "64")
+        monkeypatch.setenv("NORNICDB_QUANT_INLINE_BUILD", "1")
+        db = nornicdb_tpu.open()
+        try:
+            svc = SearchService(db.storage)
+            rng = np.random.default_rng(23)
+            vecs = rng.standard_normal((220, 16)).astype(np.float32)
+            for i in range(len(vecs)):
+                n = Node(id=f"n{i}", labels=["Doc"],
+                         properties={"content": f"doc {i}"},
+                         embedding=[float(x) for x in vecs[i]])
+                db.storage.create_node(n)
+                svc.index_node(n)
+            before = _quant_counter("dispatch")
+            hits = svc.vector_search_candidates(vecs[3], k=5)
+            assert hits[0][0] == "n3"
+            assert _quant_counter("dispatch") == before + 1
+            assert svc.vectors._quant is not None
+            # exact=True bypasses the plane (exhaustive-recall contract)
+            mid = _quant_counter("dispatch")
+            exact = svc.vector_search_candidates(vecs[3], k=5,
+                                                 exact=True)
+            assert exact[0][0] == "n3"
+            assert _quant_counter("dispatch") == mid
+        finally:
+            db.close()
+
+    def test_off_by_default_no_plane(self, monkeypatch):
+        monkeypatch.delenv("NORNICDB_VECTOR_QUANT", raising=False)
+        idx, _, rng = _index(300, seed=24)
+        q = rng.standard_normal((1, D)).astype(np.float32)
+        idx.search_batch(q, 5)
+        assert idx._quant is None
+
+
+# ---------------------------------------------------------------------------
+# fused-hybrid tiers: quantized vector halves inside the same program
+# ---------------------------------------------------------------------------
+
+
+VOCAB = [f"term{i}" for i in range(48)]
+
+HYBRID_QUERIES = [
+    "term1 term2 term3",
+    "term4 term9 term11",
+    "term7 term8",
+    "term0 term40",
+    "term5 term5 term6",
+    "term20",
+    "zzz qqq nothing",  # empty lexical side
+    "term13 term14 term15",
+]
+
+
+def _hybrid_corpus(n=420, d=D, seed=27, clustered=False):
+    from nornicdb_tpu.search.bm25 import BM25Index
+
+    rng = np.random.default_rng(seed)
+    bm25 = BM25Index()
+    brute = BruteForceIndex(dims=d)
+    if clustered:
+        centers = rng.standard_normal((16, d)).astype(np.float32) * 2
+    for i in range(n):
+        words = rng.choice(VOCAB, size=int(rng.integers(3, 10)))
+        bm25.index(f"d{i}", " ".join(words))
+        v = rng.standard_normal(d).astype(np.float32)
+        if clustered:
+            v = centers[i % 16] + v
+        brute.add(f"d{i}", v)
+    return bm25, brute, rng
+
+
+def _host_hybrid(bm25, brute, queries, embs, overfetch, weights):
+    from nornicdb_tpu.search.rrf import rrf_fuse
+
+    lex = bm25.search_batch(queries, overfetch)
+    vec = brute.search_batch(embs, overfetch, exact=True)
+    out = []
+    for li, vi in zip(lex, vec):
+        if li and vi:
+            fused = rrf_fuse([li, vi], weights=list(weights),
+                             limit=overfetch)
+        else:
+            fused = (li or vi)[:overfetch]
+        out.append((li, vi, fused))
+    return out
+
+
+def _fused_rows(fh, queries, embs, overfetch, weights=(1.0, 1.0)):
+    from nornicdb_tpu.search.bm25 import tokenize
+    from nornicdb_tpu.search.microbatch import pow2_bucket
+
+    extras = [{"tokens": tokenize(q), "n_cand": overfetch,
+               "w": tuple(weights)} for q in queries]
+    return fh.search_batch(np.asarray(embs, np.float32),
+                           pow2_bucket(overfetch), extras)
+
+
+class TestFusedQuantTiers:
+    def _env(self, monkeypatch, mode="int8"):
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", mode)
+        monkeypatch.setenv("NORNICDB_QUANT_MIN_N", "64")
+        monkeypatch.setenv("NORNICDB_QUANT_INLINE_BUILD", "1")
+
+    def test_int8_brute_tier_parity(self, monkeypatch):
+        from nornicdb_tpu.search.hybrid_fused import FusedHybrid
+
+        self._env(monkeypatch)
+        bm25, brute, rng = _hybrid_corpus()
+        fh = FusedHybrid(bm25, brute, min_n=1)
+        embs = rng.standard_normal(
+            (len(HYBRID_QUERIES), D)).astype(np.float32)
+        rows = _fused_rows(fh, HYBRID_QUERIES, embs, 10)
+        ref = _host_hybrid(bm25, brute, HYBRID_QUERIES, embs, 10,
+                           (1.0, 1.0))
+        for qi, (row, (li, vi, fused)) in enumerate(zip(rows, ref)):
+            assert row is not None, qi
+            assert row["tier"] == "brute"
+            assert row["times"]["quant"] == "int8"
+            assert _ids(row["vec"]) == _ids(vi), qi
+            if li and vi:
+                assert _ids(row["fused"]) == _ids(fused), qi
+
+    def test_pq_brute_tier_recall(self, monkeypatch):
+        from nornicdb_tpu.search.hybrid_fused import FusedHybrid
+
+        self._env(monkeypatch, "pq")
+        bm25, brute, rng = _hybrid_corpus(n=600, seed=28,
+                                          clustered=True)
+        # small codebooks keep the test fast; the plane the fused tier
+        # shares comes from brute.quant_plane() — pin its PQ params
+        plane = brute.quant_plane()
+        plane.pq_m, plane.pq_codes = 8, 64
+        fh = FusedHybrid(bm25, brute, min_n=1)
+        # data-correlated queries (the serving shape): ADC ordering
+        # noise on pure-noise queries would need a wider pool than the
+        # fused program's kq-deep vector half carries
+        picks = rng.integers(0, 600, len(HYBRID_QUERIES))
+        embs = np.stack([brute.get(f"d{i}") for i in picks]) \
+            + 0.15 * rng.standard_normal(
+                (len(HYBRID_QUERIES), D)).astype(np.float32)
+        embs = embs.astype(np.float32)
+        rows = _fused_rows(fh, HYBRID_QUERIES, embs, 10)
+        ref = _host_hybrid(bm25, brute, HYBRID_QUERIES, embs, 10,
+                           (1.0, 1.0))
+        vec_rec = []
+        for row, (li, vi, fused) in zip(rows, ref):
+            assert row is not None
+            assert row["times"]["quant"] == "pq"
+            vec_rec.append(len(set(_ids(row["vec"]))
+                               & set(_ids(vi))) / max(len(vi), 1))
+        assert np.mean(vec_rec) >= 0.9
+
+    def test_walk_tier_quantized(self, monkeypatch):
+        from nornicdb_tpu.search.cagra import CagraIndex
+        from nornicdb_tpu.search.hybrid_fused import FusedHybrid
+
+        self._env(monkeypatch)
+        bm25, brute, rng = _hybrid_corpus(n=2500, seed=29,
+                                          clustered=True)
+        cag = CagraIndex(brute=brute, min_n=100)
+        assert cag.build()
+        assert cag._graph["quant"] is not None
+        fh = FusedHybrid(bm25, brute, min_n=1, walk_min_n=100,
+                         cagra=cag)
+        embs = rng.standard_normal(
+            (len(HYBRID_QUERIES), D)).astype(np.float32)
+        rows = _fused_rows(fh, HYBRID_QUERIES, embs, 10)
+        qn = embs / np.linalg.norm(embs, axis=1, keepdims=True)
+        for r, row in enumerate(rows):
+            assert row is not None
+            assert row["tier"] == "walk"
+            assert row["times"]["quant"] == "int8"
+            # rerank contract: served vec scores are exact cosines
+            for eid, s in row["vec"]:
+                v = brute.get(eid)
+                vn = v / np.linalg.norm(v)
+                assert s == pytest.approx(float(qn[r] @ vn), abs=1e-4)
+
+    def test_compaction_degrades_to_float32_tier(self, monkeypatch):
+        from nornicdb_tpu.search.hybrid_fused import FusedHybrid
+
+        self._env(monkeypatch)
+        bm25, brute, rng = _hybrid_corpus(n=500, seed=30)
+        fh = FusedHybrid(bm25, brute, min_n=1)
+        embs = rng.standard_normal((2, D)).astype(np.float32)
+        rows = _fused_rows(fh, HYBRID_QUERIES[:2], embs, 10)
+        assert rows[0]["times"].get("quant") == "int8"
+        # pin the plane stale: compaction remaps the slot space
+        plane = brute.quant_plane()
+        plane.rebuild_stale_frac = 1e9
+        for i in range(200):
+            brute.remove(f"d{i}")
+        assert brute.compact()
+        rows = _fused_rows(fh, HYBRID_QUERIES[:2], embs, 10)
+        ref = _host_hybrid(bm25, brute, HYBRID_QUERIES[:2], embs, 10,
+                           (1.0, 1.0))
+        for row, (li, vi, fused) in zip(rows, ref):
+            assert row is not None
+            assert "quant" not in row["times"]  # float32 tier served
+            assert _ids(row["vec"]) == _ids(vi)
+
+    def test_post_build_delta_read_your_writes(self, monkeypatch):
+        from nornicdb_tpu.search.bm25 import tokenize
+        from nornicdb_tpu.search.hybrid_fused import FusedHybrid
+        from nornicdb_tpu.search.microbatch import pow2_bucket
+
+        self._env(monkeypatch)
+        bm25, brute, rng = _hybrid_corpus(n=500, seed=31)
+        fh = FusedHybrid(bm25, brute, min_n=1)
+        embs = rng.standard_normal((1, D)).astype(np.float32)
+        _fused_rows(fh, HYBRID_QUERIES[:1], embs, 10)  # build planes
+        target = (embs[0] / np.linalg.norm(embs[0])).astype(np.float32)
+        bm25.index("fresh", "term1 term2")
+        brute.add("fresh", target)
+        extras = [{"tokens": tokenize("term1 term2"), "n_cand": 10,
+                   "w": (1.0, 1.0)}]
+        rows = fh.search_batch(embs, pow2_bucket(10), extras)
+        assert rows[0] is not None
+        assert _ids(rows[0]["vec"])[0] == "fresh"
+        assert rows[0]["vec"][0][1] == pytest.approx(1.0, abs=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# cost + gauges: compressed dispatch kinds priced on the same axis
+# ---------------------------------------------------------------------------
+
+
+class TestObsAccounting:
+    def test_int8_prices_below_float32(self):
+        from nornicdb_tpu.obs import cost
+
+        b, rows, d = 16, 100_000, 128
+        f32_f, f32_b = cost.price_brute(b, rows, d)
+        q_f, q_b = cost.price_int8_coarse(b, rows, d)
+        assert q_f == f32_f  # same arithmetic
+        # matrix column moves 4x fewer bytes; the f32 score output is
+        # common to both, so the whole-dispatch ratio lands near 3x
+        assert q_b < f32_b / 2.5
+
+    def test_pq_prices_below_int8(self):
+        from nornicdb_tpu.obs import cost
+
+        b, rows, m, k, ds = 16, 100_000, 16, 256, 8
+        _, i8_b = cost.price_int8_coarse(b, rows, m * ds)
+        _, pq_b = cost.price_pq_adc(b, rows, m, k, ds)
+        assert pq_b < i8_b
+
+    def test_rerank_and_quant_walk_prices_positive(self):
+        from nornicdb_tpu.obs import cost
+
+        rf, rb = cost.price_rerank(16, 256, 128)
+        assert rf > 0 and rb > 0
+        wf, wb = cost.price_walk_quant(16, 128, 12, 2, 32, 64, 32, 32)
+        f32_wf, f32_wb = cost.price_walk(16, 128, 12, 2, 32, 64)
+        assert 0 < wf < f32_wf  # prefilter prunes flops
+        assert 0 < wb < f32_wb  # and bytes (int8 gathers)
+
+    def test_served_search_records_cost_and_dispatch(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "int8")
+        monkeypatch.setenv("NORNICDB_QUANT_MIN_N", "64")
+        monkeypatch.setenv("NORNICDB_QUANT_INLINE_BUILD", "1")
+        idx, vecs, rng = _index(300, seed=25)
+        q = rng.standard_normal((2, D)).astype(np.float32)
+        assert idx.search_batch(q, 5)
+        text = REGISTRY.render()
+        assert 'kind="int8_coarse"' in text
+        assert 'kind="quant_rerank"' in text
+
+    def test_quant_dispatch_kinds_declared(self):
+        from nornicdb_tpu.obs import dispatch
+
+        kinds = dispatch.bucket_counts()
+        for kind in ("int8_coarse", "pq_adc", "quant_rerank",
+                     "hybrid_fused_quant", "hybrid_walk_fused_quant"):
+            assert kind in kinds
+
+    def test_quant_gauges_exported(self):
+        from nornicdb_tpu.obs import resources
+
+        idx, _, _ = _index(300, d=64, seed=26)
+        plane = _plane(idx, mode="int8")
+        assert plane.build()
+        idx._quant = plane
+        resources.register("brute", "quanttest", idx)
+        try:
+            text = REGISTRY.render()
+            assert ('nornicdb_index_quant_device_bytes'
+                    '{family="brute",index="quanttest"}') in text
+            assert ('nornicdb_index_compression_ratio'
+                    '{family="brute",index="quanttest"}') in text
+        finally:
+            resources.unregister("brute", "quanttest")
