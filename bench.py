@@ -417,6 +417,22 @@ def _compact_summary(result):
             "speedup_int8_vs_f32": g(result, "quant",
                                      "speedup_int8_vs_f32"),
         },
+        # device graph plane (ISSUE 9): row parity across the device
+        # LDBC fast paths (sentinel absolute floor 1.0), the coalesced
+        # concurrent chain comparison, the fused traverse-rank rate,
+        # and the graph compile-bucket count the growth cap gates
+        "graph": {
+            "device_parity": g(result, "cypher", "device_graph",
+                               "parity"),
+            "chain_conc_device_qps": g(
+                result, "cypher", "device_graph",
+                "recent_messages_friends", "concurrent_device_qps"),
+            "traverse_rank_qps_b16": g(result, "cypher", "device_graph",
+                                       "traverse_rank",
+                                       "device_qps_b16"),
+            "compile_buckets": g(result, "cypher", "device_graph",
+                                 "compile_buckets"),
+        },
         "pagerank_speedup_vs_numpy": g(result, "northstar",
                                        "pagerank_device",
                                        "speedup_vs_numpy"),
@@ -2250,6 +2266,234 @@ def _bench_cypher(n_people: int = 50_000, n_msgs: int = 100_000,
     out["ldbc_geomean_ops"] = (
         round(float(np.exp(np.mean(np.log(rates)))), 1) if rates else 0.0
     )
+    # device graph plane (ISSUE 9): the same LDBC shapes routed through
+    # query/device_graph.py — device-vs-host qps per shape, a row-parity
+    # flag, the coalesced concurrent chain comparison, and cold
+    # view-build latency. Runs AFTER the headline measurements so the
+    # geomean above is untouched by forced-device traffic.
+    try:
+        out["device_graph"] = _bench_cypher_device(
+            eng, queries, n_people, min(measure_s, 1.0))
+    except Exception as exc:  # noqa: BLE001 — never cost the headline
+        out["device_graph"] = {
+            "error": f"{type(exc).__name__}: {exc}"[:400]}
+    return out
+
+
+def _bench_cypher_device(eng, queries, n_people, measure_s):
+    """Device-vs-host for the graph plane on the SAME bench graph.
+
+    - ``recent_messages_friends``: steady-state qps with the plane
+      forced on (every lookup is one b=1 dispatch) vs off, row parity,
+      and a 16-thread concurrent run in all three modes — ``auto`` is
+      the shipped behavior (host until coalescible demand), ``on``
+      shows what a coalesced batch dispatch costs/buys on this backend.
+    - ``avg_friends_per_city`` / ``tag_cooccurrence``: the maintained
+      views make steady-state identical by construction, so the device
+      question is the COLD build — view-build latency host vs device,
+      plus row parity through the full query path.
+    - ``traverse_rank``: the fused graph+vector dispatch (chain
+      expansion -> cosine top-k in one program) at b=1 and b=16 vs the
+      host fallback, id-parity checked.
+    """
+    import concurrent.futures
+    import os
+
+    from nornicdb_tpu import obs
+    from nornicdb_tpu.query.executor import CypherExecutor
+
+    prev = os.environ.get("NORNICDB_GRAPH_DEVICE")
+
+    def set_mode(m):
+        os.environ["NORNICDB_GRAPH_DEVICE"] = m
+        # the plane caches the forced-mode flag (hot-path pre-gate);
+        # measurements toggling modes mid-process must not serve a few
+        # hundred queries under the previous mode's cached verdict
+        ex.device_graph._forced = None
+
+    def timed_qps(fn, warm=2):
+        for _ in range(warm):
+            fn(0)
+        n_done = 0
+        t0 = time.perf_counter()
+        while True:
+            for i in range(20):
+                fn(n_done + i)
+            n_done += 20
+            dt = time.perf_counter() - t0
+            if dt > measure_s or n_done >= 20000:
+                return round(n_done / dt, 1)
+
+    out = {}
+    parity = True
+    try:
+        ex = CypherExecutor(eng)
+        ex.enable_query_cache = False
+        q_chain, mk_chain = queries["recent_messages_friends"]
+
+        def run_chain(i):
+            return ex.execute(q_chain, mk_chain(i)).rows
+
+        set_mode("off")
+        host_rows = [run_chain(i) for i in range(4)]
+        host_qps = timed_qps(run_chain)
+        set_mode("on")
+        dev_rows = [run_chain(i) for i in range(4)]
+        dev_qps = timed_qps(run_chain)
+        chain_parity = dev_rows == host_rows
+        parity &= chain_parity
+        # coalesced concurrency: 16 threads, per-mode qps. GIL-bound
+        # host loops vs ONE shared dispatch per convoy of riders.
+        n_threads = 16
+
+        def concurrent_qps():
+            stop = time.perf_counter() + measure_s
+            counts = [0] * n_threads
+
+            def worker(t):
+                i = t * 1000
+                while time.perf_counter() < stop:
+                    run_chain(i)
+                    i += 1
+                    counts[t] += 1
+
+            with concurrent.futures.ThreadPoolExecutor(n_threads) as p:
+                list(p.map(worker, range(n_threads)))
+            return round(sum(counts) / measure_s, 1)
+
+        # pre-pay the per-(B, k)-bucket compiles the convoy sizes can
+        # touch (coalesced batch sizes float with thread scheduling, so
+        # without this the measure window is mostly XLA compiles)
+        set_mode("on")
+        spec = ("KNOWS", "out", "Person", "HAS_CREATOR", "dst",
+                "creationDate", "Message")
+        a0 = int(ex.columnar.label_rows("Person")[0])
+        for bsz in (1, 2, 4, 8, 16, 32, 64):
+            ex.device_graph._chain_batch(spec, [(a0, 10)] * bsz)
+        conc = {}
+        for mode in ("off", "auto", "on"):
+            set_mode(mode)
+            run_chain(0)  # warm snapshot for this mode
+            conc[mode] = concurrent_qps()
+        out["recent_messages_friends"] = {
+            "host_qps": host_qps, "device_qps_b1": dev_qps,
+            "parity": chain_parity,
+            "concurrent_threads": n_threads,
+            "concurrent_host_qps": conc["off"],
+            "concurrent_auto_qps": conc["auto"],
+            "concurrent_device_qps": conc["on"],
+        }
+
+        # cold view builds: host numpy vs device segment-sum/matmul
+        def cold_build(name, pop_fn, host_fn, dev_fn, q, mk):
+            set_mode("off")
+            rows_h = ex.execute(q, mk(0)).rows
+            host_ms = []
+            dev_ms = []
+            for _ in range(3):
+                pop_fn()
+                t0 = time.perf_counter()
+                host_fn()
+                host_ms.append((time.perf_counter() - t0) * 1e3)
+            set_mode("on")
+            for _ in range(3):
+                pop_fn()
+                t0 = time.perf_counter()
+                built = dev_fn()
+                dev_ms.append((time.perf_counter() - t0) * 1e3)
+            pop_fn()
+            rows_d = ex.execute(q, mk(0)).rows
+            ok = rows_d == rows_h and built is not None
+            return {
+                "host_build_ms": round(min(host_ms), 2),
+                "device_build_ms": round(min(dev_ms), 2),
+                "parity": ok,
+            }
+
+        cat = ex.columnar
+        plane = ex.device_graph
+        strip_key = ("IS_LOCATED_IN", "dst", "Person", "KNOWS", "out",
+                     "Person")
+        q_s, mk_s = queries["avg_friends_per_city"]
+        out["avg_friends_per_city"] = cold_build(
+            "strip",
+            lambda: cat._strip_views.clear(),
+            lambda: cat.strip_view(*strip_key),
+            lambda: plane.build_strip_view(*strip_key),
+            q_s, mk_s)
+        parity &= out["avg_friends_per_city"]["parity"]
+
+        gram_key = ("HAS_TAG", "mid_src", "Message", "Tag", "Tag")
+
+        def pop_gram():
+            cat._gram_views.clear()
+            cat._injective.clear()
+
+        q_c, mk_c = queries["tag_cooccurrence"]
+        out["tag_cooccurrence"] = cold_build(
+            "gram",
+            pop_gram,
+            lambda: cat.cooc_gram(*gram_key),
+            lambda: cat.cooc_gram(*gram_key, device_plane=plane),
+            q_c, mk_c)
+        parity &= out["tag_cooccurrence"]["parity"]
+
+        # fused traverse-then-rank: message embeddings over the bench
+        # graph, ranked from each person's 2-hop message frontier
+        from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+        d = 64
+        rng = np.random.default_rng(17)
+        index = BruteForceIndex(use_device=True)
+        msg_rows = cat.label_rows("Message")
+        nodes = cat.nodes()
+        ids = [nodes[int(r)].id for r in msg_rows]
+        vecs = rng.normal(size=(len(ids), d)).astype(np.float32)
+        index.add_batch(list(zip(ids, vecs)))
+        hops = [("KNOWS", "out"), ("HAS_CREATOR", "in")]
+        person_rows = cat.label_rows("Person")
+        qv = rng.normal(size=(16, d)).astype(np.float32)
+
+        def anchor(i):
+            return int(person_rows[(i * 13) % len(person_rows)])
+
+        host1 = plane.traverse_rank_host(
+            [anchor(0)], hops, qv[:1], 10, index)
+        set_mode("on")
+        dev1 = plane.traverse_rank([anchor(0)], hops, qv[:1], 10, index)
+        tr_parity = (dev1 is not None and
+                     [r for r, _s in dev1[0]] == [r for r, _s in host1[0]])
+        parity &= tr_parity
+        tr_host_qps = timed_qps(lambda i: plane.traverse_rank_host(
+            [anchor(i)], hops, qv[:1], 10, index))
+        tr_dev_qps = timed_qps(lambda i: plane.traverse_rank(
+            [anchor(i)], hops, qv[:1], 10, index))
+        plane.traverse_rank(
+            [anchor(j) for j in range(16)], hops, qv, 10, index)  # warm
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < measure_s:
+            plane.traverse_rank(
+                [anchor(reps * 16 + j) for j in range(16)], hops, qv, 10,
+                index)
+            reps += 1
+        tr_b16 = round(reps * 16 / (time.perf_counter() - t0), 1)
+        out["traverse_rank"] = {
+            "host_qps_b1": tr_host_qps, "device_qps_b1": tr_dev_qps,
+            "device_qps_b16": tr_b16, "parity": tr_parity,
+        }
+
+        out["parity"] = 1.0 if parity else 0.0
+        out["compile_buckets"] = sum(
+            1 for e in obs.compile_universe()
+            if str(e.get("kind", "")).startswith("graph_"))
+        out["min_n_default"] = int(os.environ.get(
+            "NORNICDB_GRAPH_DEVICE_MIN_N", "200000") or 200000)
+    finally:
+        if prev is None:
+            os.environ.pop("NORNICDB_GRAPH_DEVICE", None)
+        else:
+            os.environ["NORNICDB_GRAPH_DEVICE"] = prev
     return out
 
 

@@ -922,6 +922,30 @@ class HttpServer:
             results = self.db.search.similar(node_id, limit=limit)
             return 200, {"results": results}
 
+        if action == "graph_search" and method == "POST":
+            # fused traverse-then-rank (query/device_graph.py): expand
+            # 1-2 hops from the anchor, rank the distinct frontier by
+            # cosine similarity — one device dispatch when gated on
+            self.authorize(username, database, READ)
+            anchor = payload.get("anchor_id", "")
+            vec = payload.get("vector")
+            hops = payload.get("hops")
+            if not anchor or not isinstance(vec, list) or not vec \
+                    or not isinstance(hops, list) or not hops:
+                raise HTTPError(
+                    400, "Neo.ClientError.Request.InvalidFormat",
+                    "graph_search needs anchor_id, hops and vector")
+            limit = int(payload.get("limit", 10))
+            try:
+                hits = self.db.graph_vector_search(
+                    anchor, hops, vec, k=limit)
+            except ValueError as exc:
+                raise HTTPError(
+                    400, "Neo.ClientError.Request.InvalidFormat",
+                    str(exc))
+            return 200, {"results": [
+                {"node_id": nid, "score": score} for nid, score in hits]}
+
         if action == "store" and method == "POST":
             self.authorize(username, database, WRITE)
             node = self.db.store(

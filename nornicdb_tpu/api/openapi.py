@@ -113,6 +113,22 @@ def openapi_spec() -> Dict[str, Any]:
                     "node_id": {"type": "string"},
                     "limit": {"type": "integer"}}},
                 response=obj)},
+            "/nornicdb/graph_search": {"post": op(
+                "Fused graph+vector query: expand 1-2 relationship "
+                "hops from the anchor, rank the distinct frontier by "
+                "cosine similarity (one device dispatch when the graph "
+                "plane is enabled)", "search",
+                request={"type": "object", "properties": {
+                    "anchor_id": {"type": "string"},
+                    "hops": {"type": "array", "minItems": 1,
+                             "maxItems": 2, "items": {},
+                             "description": "relationship types; a "
+                             "string means outgoing, [type, 'in'|'out'] "
+                             "sets direction"},
+                    "vector": {"type": "array",
+                               "items": {"type": "number"}},
+                    "limit": {"type": "integer"}}},
+                response=obj)},
             "/graphql": {"post": op("GraphQL endpoint", "graphql",
                                     request=obj, response=obj)},
             "/mcp": {"post": op("Model Context Protocol endpoint", "mcp",

@@ -11,7 +11,7 @@ from __future__ import annotations
 import os
 import threading
 import uuid
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from nornicdb_tpu.storage import (
     AsyncEngine,
@@ -450,6 +450,19 @@ class DB:
             embedding=embedding,
         )
         self.storage.create_node(node)
+        if embedding is not None and self._search is not None:
+            # explicit-embedding stores bypass the embed queue (its
+            # listener only enqueues un-embedded nodes), so an already
+            # built search service must index them here — otherwise a
+            # node stored after the first recall() is invisible to
+            # every vector surface (recall/similar/graph_vector_search).
+            # Best-effort: the node is durably stored either way, and a
+            # dims-mismatched explicit embedding was never indexable
+            # (it stays recallable by text, exactly as before).
+            try:
+                self._search.index_node(self.storage.get_node(nid))
+            except Exception:  # noqa: BLE001
+                pass
         if auto_link and embedding is not None:
             self.inference.on_store(node)
         return self.storage.get_node(nid)
@@ -509,6 +522,62 @@ class DB:
     ) -> "Any":
         """Execute a Cypher query (reference: db.go:2222 Cypher)."""
         return self.executor.execute(query, params or {})
+
+    def graph_vector_search(
+        self,
+        anchor_id: str,
+        hops: Sequence[Any],
+        query_vector: Sequence[float],
+        k: int = 10,
+    ) -> List[Tuple[str, float]]:
+        """Fused graph+vector query (the scenario-frontier workload of
+        ROADMAP item 5): expand ``hops`` — an (etype, direction)
+        sequence, 1 or 2 stages; a bare string means outgoing — from
+        the anchor node, then rank the DISTINCT frontier nodes by
+        cosine similarity to ``query_vector`` over the search service's
+        vector index. Top-k ``(node_id, score)``, score descending.
+
+        With the device graph plane gated on (``NORNICDB_GRAPH_DEVICE``)
+        the traversal, frontier dedup, vector gather, scoring and top-k
+        run as ONE compiled dispatch; any freshness gap or gate-off
+        serves the identical-contract host fallback instead."""
+        import numpy as np
+
+        ex = self.executor
+        cat = ex.columnar
+        hops_n: List[Tuple[str, str]] = []
+        for h in hops:
+            if isinstance(h, str):
+                hops_n.append((h, "out"))
+            elif isinstance(h, (list, tuple)) and len(h) == 2:
+                etype, direction = h
+                if direction not in ("out", "in"):
+                    raise ValueError(f"bad hop direction {direction!r}")
+                hops_n.append((str(etype), direction))
+            else:
+                raise ValueError(
+                    "each hop must be a relationship type or a "
+                    "[type, 'in'|'out'] pair")
+        if not hops_n or len(hops_n) > 2:
+            raise ValueError("graph_vector_search supports 1 or 2 hops")
+        row = cat.node_row(anchor_id)
+        if row is None:
+            return []
+        q = np.asarray(query_vector, dtype=np.float32)
+        if q.ndim != 1 or q.size == 0:
+            raise ValueError("query_vector must be a flat float vector")
+        index = self.search.vectors
+        dims = getattr(index, "dims", None)
+        if dims and q.shape[0] != dims:
+            raise ValueError(
+                f"query_vector has {q.shape[0]} dims, index has {dims}")
+        q = q[None, :]
+        plane = ex.device_graph
+        hits = plane.traverse_rank([row], hops_n, q, k, index)
+        if hits is None:
+            hits = plane.traverse_rank_host([row], hops_n, q, k, index)
+        nodes = cat.nodes()
+        return [(nodes[r].id, s) for r, s in hits[0]]
 
     def multidb_manager(self, max_databases: int = 64):
         """Lazily-built multi-database manager rooted on the same engine

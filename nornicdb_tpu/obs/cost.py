@@ -139,6 +139,49 @@ def price_walk_quant(b: int, d: int, iters: int, width: int,
     return flops, bytes_
 
 
+def price_chain_topk(b: int, f: int, kp: int) -> Tuple[float, float]:
+    """(flops, bytes) of one device graph chain-top-k dispatch
+    (query/device_graph.py): per anchor, a width-``f`` CSR friend
+    gather, ``f*kp`` strip-head rank gathers, and the top-k merge over
+    the ``f*kp`` composite keys. Gather-dominated: flops are the merge
+    comparisons, bytes the int32 index/rank/neighbor traffic."""
+    width = float(f * kp)
+    flops = b * (2.0 * width + width)  # top-k compares + key composition
+    bytes_ = 4.0 * b * (2 + 2 * f + 3 * width)
+    return flops, bytes_
+
+
+def price_graph_agg(e1: int, e2: int, n: int) -> Tuple[float, float]:
+    """(flops, bytes) of one strip-aggregation build dispatch: the
+    terminal-degree segment-sum over ``e2`` edges, the weighted group
+    segment-sum over ``e1``, and the lexicographic distinct-pair pass
+    (sort ~ e1*log2(e1))."""
+    import math
+
+    lg = math.log2(max(e1, 2))
+    flops = 2.0 * e2 + 3.0 * e1 + e1 * lg
+    bytes_ = 4.0 * (3 * e1 + 2 * e2 + 3 * n)
+    return flops, bytes_
+
+
+def price_cooc_gram(m: int, a: int, bcols: int) -> Tuple[float, float]:
+    """(flops, bytes) of one co-occurrence Gram contraction
+    ``[a, m] x [m, b]`` over the padded incidence matrices."""
+    flops = 2.0 * m * a * bcols
+    bytes_ = _F32 * (m * a + m * bcols + a * bcols)
+    return flops, bytes_
+
+
+def price_traverse_rank(b: int, frontier: int, d: int,
+                        kp: int) -> Tuple[float, float]:
+    """(flops, bytes) of one fused traverse-then-rank dispatch: the
+    frontier expansion gathers, the ``[b, frontier, d]`` vector gather
+    + cosine dot, and the top-k over frontier scores."""
+    flops = b * (frontier * 2.0 * d + 2.0 * frontier + kp * 2.0)
+    bytes_ = _F32 * b * (frontier * d + d + 2 * frontier)
+    return flops, bytes_
+
+
 def price_bm25(b: int, nnz: int, unique_terms: int,
                rows: int) -> Tuple[float, float]:
     """(flops, bytes) of one device-BM25 scoring dispatch: tf/idf math +

@@ -76,8 +76,14 @@ def _pagerank_host(
 
 
 def pagerank_arrays(
-    src: np.ndarray, dst: np.ndarray, n: int, iters: int = 20, damping: float = 0.85
+    src: np.ndarray, dst: np.ndarray, n: int, iters: int = 20,
+    damping: float = 0.85, dev_src=None, dev_dst=None,
 ) -> np.ndarray:
+    """``dev_src``/``dev_dst``: already-device-resident int32 edge
+    arrays (the device graph plane's shared CSR snapshot) — passing
+    them skips the per-call host->device edge-array transfer. Must
+    hold the same values as ``src``/``dst``; results are identical
+    either way (the program is the same, only the copy is saved)."""
     if n == 0:
         return np.zeros((0,), np.float32)
     if len(src) == 0:
@@ -94,8 +100,11 @@ def pagerank_arrays(
             pass
     return np.asarray(
         _pagerank_impl(
-            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32), n, iters,
-            damping,
+            dev_src if dev_src is not None
+            else jnp.asarray(src, jnp.int32),
+            dev_dst if dev_dst is not None
+            else jnp.asarray(dst, jnp.int32),
+            n, iters, damping,
         )
     )
 
@@ -121,11 +130,26 @@ def graph_snapshot(storage: Engine) -> Tuple[np.ndarray, np.ndarray, List[str]]:
 
 
 def pagerank_engine(
-    storage: Engine, iters: int = 20, damping: float = 0.85
+    storage: Engine, iters: int = 20, damping: float = 0.85, plane=None,
 ) -> List[Tuple[str, float]]:
-    """PageRank over the whole stored graph, scores descending."""
-    src, dst, ids = graph_snapshot(storage)
-    scores = pagerank_arrays(src, dst, len(ids), iters, damping)
+    """PageRank over the whole stored graph, scores descending.
+
+    With ``plane`` (a query/device_graph.DeviceGraphPlane over this
+    storage's catalog) the edge snapshot AND its device transfer come
+    from the plane's version-keyed cache: repeat calls stop re-listing
+    the store and re-shipping edge arrays. Results are bit-identical —
+    the snapshot is built by the same ``graph_snapshot`` either way."""
+    snap = None
+    if plane is not None and plane.catalog.storage is storage:
+        snap = plane.pagerank_snapshot()
+    if snap is not None:
+        src, dst, ids = snap["src"], snap["dst"], snap["ids"]
+        scores = pagerank_arrays(src, dst, len(ids), iters, damping,
+                                 dev_src=snap["dev_src"],
+                                 dev_dst=snap["dev_dst"])
+    else:
+        src, dst, ids = graph_snapshot(storage)
+        scores = pagerank_arrays(src, dst, len(ids), iters, damping)
     order = np.argsort(-scores)
     return [(ids[i], float(scores[i])) for i in order]
 

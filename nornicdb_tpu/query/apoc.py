@@ -254,7 +254,13 @@ def run_apoc_procedure(executor, name: str, args: List[Any], ctx) -> Iterator[Di
         # args: [nodes] or nothing — run over whole graph
         from nornicdb_tpu.ops.graph import pagerank_engine
 
-        scores = pagerank_engine(ctx.storage)
+        # the executor's device graph plane caches the edge snapshot +
+        # its device transfer per catalog version (only valid when this
+        # query runs against the executor's own storage view)
+        plane = (getattr(executor, "device_graph", None)
+                 if ctx.storage is getattr(executor, "storage", None)
+                 else None)
+        scores = pagerank_engine(ctx.storage, plane=plane)
         for node_id, score in scores:
             try:
                 node = ctx.storage.get_node(node_id)

@@ -287,6 +287,32 @@ class ColumnarCatalog:
     def version(self) -> int:
         return self._version
 
+    @property
+    def storage(self) -> Engine:
+        return self._storage
+
+    # -- device-plane install hooks (query/device_graph.py) --------------
+    #
+    # The device graph plane builds the SAME materialized views the
+    # host builds (verified-exact integer arrays) and installs them
+    # here, so downstream reads and the incremental maintenance
+    # machinery run unchanged regardless of which backend built them.
+
+    def peek_strip_view(self, key: Tuple) -> Optional[_StripView]:
+        with self._lock:
+            return self._strip_views.get(key)
+
+    def install_strip_view(self, key: Tuple, sv: _StripView,
+                           v0: int) -> bool:
+        """Install a view built at version ``v0``; refused when the
+        catalog has moved (the build raced a write — installing would
+        resurrect a stale snapshot)."""
+        with self._lock:
+            if self._version != v0:
+                return False
+            self._strip_views[key] = sv
+            return True
+
     def invalidate(self) -> None:
         with self._lock:
             self._version += 1
@@ -993,11 +1019,15 @@ class ColumnarCatalog:
         mid_label: Optional[str],
         a_label: Optional[str],
         b_label: Optional[str],
+        device_plane=None,
     ) -> Optional[_GramView]:
         """Materialized co-occurrence Gram matrix (see _GramView).
         Returns None when the incidence matrices are over the dense
         budget (cached: the verdict can only flip via invalidate()) or
-        when a concurrent write tore the build."""
+        when a concurrent write tore the build. With ``device_plane``
+        the exact-range contraction runs on device (query/device_graph
+        — f32 0/1-integer matmuls are exact below 2^24 on both
+        backends, so the integers are equal either way)."""
         key = (etype, orientation, mid_label, a_label, b_label)
         with self._lock:
             if key in self._gram_views:
@@ -1020,7 +1050,13 @@ class ColumnarCatalog:
             ):
                 c = ma.astype(np.float64).T @ mb.astype(np.float64)
             else:
-                c = (ma.T @ mb).astype(np.float64)
+                c = None
+                if device_plane is not None:
+                    c_dev = device_plane.gram_matmul(ma, mb)
+                    if c_dev is not None:
+                        c = c_dev.astype(np.float64)
+                if c is None:
+                    c = (ma.T @ mb).astype(np.float64)
             tbl = self.edge_table(etype)
             with self._lock:
                 if orientation == "mid_src":

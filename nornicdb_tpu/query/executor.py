@@ -201,6 +201,19 @@ class CypherExecutor:
 
         self.columnar = ColumnarCatalog(storage)
         self.enable_fastpaths = True
+        # Device graph plane: the LDBC fast-path shapes compiled onto
+        # device snapshots of the catalog (query/device_graph.py).
+        # Version-keyed — catalog invalidation implicitly stales it;
+        # env-gated NORNICDB_GRAPH_DEVICE, host path otherwise.
+        from nornicdb_tpu.query.device_graph import DeviceGraphPlane
+
+        self.device_graph = DeviceGraphPlane(self.columnar)
+        from nornicdb_tpu import obs as _obs
+
+        _obs.register_resource(
+            "device_graph",
+            getattr(storage, "database", None) or "default",
+            self.device_graph)
         # Read-query result cache with write invalidation (reference:
         # read-cache probe executor.go:634, pkg/cache/query_cache.go).
         from nornicdb_tpu.cache import LRUCache

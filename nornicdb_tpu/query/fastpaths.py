@@ -60,9 +60,28 @@ def try_fast_path(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
 # -- tier 1: engine-counter shapes ---------------------------------------
 
 
-def _try_count_shapes(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
-    from nornicdb_tpu.query.executor import CypherResult
+_NO_COUNT = object()  # AST-pinned "not an engine-counter shape" verdict
 
+
+def _try_count_shapes(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
+    # shape analysis is pure AST work and the parsed AST is cached, so
+    # the verdict is computed once and pinned to the AST object — every
+    # OTHER fast-path query was paying this structural walk per
+    # execution (the point/chain shapes run at 20-60k qps; ~3 us of
+    # re-analysis per call was 5-12% of the whole query)
+    plan = getattr(q, "_count_plan", None)
+    if plan is None:
+        plan = _analyze_count_shape(q) or _NO_COUNT
+        try:
+            q._count_plan = plan
+        except AttributeError:
+            pass
+    if plan is _NO_COUNT:
+        return None
+    return _exec_count_shape(plan, ctx)
+
+
+def _analyze_count_shape(q: A.Query) -> Optional[Dict[str, Any]]:
     clauses = q.clauses
     if len(clauses) != 2:
         return None
@@ -94,15 +113,10 @@ def _try_count_shapes(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
         ):
             return None
         if not pn.labels:
-            # O(1) engine count (reference: count fast path)
-            return CypherResult(columns=[col], rows=[[ctx.storage.count_nodes()]])
+            return {"col": col, "kind": "nodes"}
         if len(pn.labels) == 1:
-            counter = getattr(ctx.storage, "count_nodes_by_label", None)
-            if counter is not None:
-                n = counter(pn.labels[0])
-            else:
-                n = len(ctx.storage.get_nodes_by_label(pn.labels[0]))
-            return CypherResult(columns=[col], rows=[[n]])
+            return {"col": col, "kind": "nodes_label",
+                    "label": pn.labels[0]}
         return None
 
     # MATCH ()-[r[:TYPE]]->() RETURN count(r|*)
@@ -125,11 +139,30 @@ def _try_count_shapes(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
         if not counts_ok:
             return None
         if not pr.types:
-            return CypherResult(columns=[col], rows=[[ctx.storage.count_edges()]])
-        total = sum(len(ctx.storage.get_edges_by_type(t)) for t in pr.types)
-        return CypherResult(columns=[col], rows=[[total]])
+            return {"col": col, "kind": "edges"}
+        return {"col": col, "kind": "edges_types", "types": list(pr.types)}
 
     return None
+
+
+def _exec_count_shape(plan: Dict[str, Any], ctx) -> "CypherResult":
+    from nornicdb_tpu.query.executor import CypherResult
+
+    kind = plan["kind"]
+    if kind == "nodes":
+        n = ctx.storage.count_nodes()  # O(1) engine count
+    elif kind == "nodes_label":
+        counter = getattr(ctx.storage, "count_nodes_by_label", None)
+        if counter is not None:
+            n = counter(plan["label"])
+        else:
+            n = len(ctx.storage.get_nodes_by_label(plan["label"]))
+    elif kind == "edges":
+        n = ctx.storage.count_edges()
+    else:
+        n = sum(len(ctx.storage.get_edges_by_type(t))
+                for t in plan["types"])
+    return CypherResult(columns=[plan["col"]], rows=[[n]])
 
 
 # -- tier 2: vectorized chain family -------------------------------------
@@ -251,6 +284,11 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
     if plan is None:
         return None
 
+    # device graph plane (query/device_graph.py): the same shapes,
+    # compiled onto versioned device snapshots — env-gated, and every
+    # miss/degrade lands back on the host arrays below
+    plane = getattr(executor, "device_graph", None)
+
     point = plan["point"]
     if point is not None:
         r = _exec_point(catalog, point, plan, ctx, CypherResult)
@@ -259,7 +297,7 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
 
     tk = plan.get("topk")
     if tk is not None:
-        r = _exec_topk(catalog, tk, plan, ctx, CypherResult)
+        r = _exec_topk(catalog, tk, plan, ctx, CypherResult, plane)
         if r is not None:
             return r
         # runtime-unsupported (non-numeric order prop, torn build):
@@ -267,9 +305,9 @@ def _try_vectorized(executor, catalog, q: A.Query, ctx) -> Optional["CypherResul
 
     strip, cooc = plan["strip"], plan["cooc"]
     if strip is not None:
-        b = _exec_strip(catalog, strip, ctx, plan)
+        b = _exec_strip(catalog, strip, ctx, plan, plane)
     elif cooc is not None:
-        b = _exec_cooc(catalog, cooc, ctx)
+        b = _exec_cooc(catalog, cooc, ctx, plane)
     else:
         b = _match_chain(catalog, plan["path"], ctx)
     if b is None:
@@ -493,7 +531,22 @@ def _analyze_topk(path: A.PatternPath, m: A.MatchClause,
 
 
 def _exec_topk(catalog, tk: Dict[str, Any], plan: Dict[str, Any],
-               ctx, CypherResult):
+               ctx, CypherResult, plane=None):
+    if plane is None:
+        return _exec_topk_impl(catalog, tk, plan, ctx, CypherResult, None)
+    # in-flight accounting is the device plane's auto-mode demand
+    # signal: overlapping chain reads are coalescible, a lone read
+    # is not worth a b=1 dispatch
+    plane.chain_enter()
+    try:
+        return _exec_topk_impl(catalog, tk, plan, ctx, CypherResult,
+                               plane)
+    finally:
+        plane.chain_exit()
+
+
+def _exec_topk_impl(catalog, tk: Dict[str, Any], plan: Dict[str, Any],
+                    ctx, CypherResult, plane):
     ret = plan["ret"]
     limit = int(_const_value(ret.limit, ctx))
     skip = int(_const_value(ret.skip, ctx)) if ret.skip is not None else 0
@@ -528,6 +581,23 @@ def _exec_topk(catalog, tk: Dict[str, Any], plan: Dict[str, Any],
 
     tbl1 = catalog.edge_table(tk["etype1"])
     n = catalog.n_nodes()
+
+    if plane is not None and len(rows_idx) == 1 and plane.maybe_device():
+        # device route: the whole merge — friend gather, per-friend
+        # strip heads, global top-k — as ONE batched dispatch shared
+        # with every coalesced rider. Row-identical by construction
+        # (tie-sharing rank keys); None means serve on the host arrays.
+        spec = (tk["etype1"], tk["dir1"], tk["mid_label"], tk["etype2"],
+                tk["mid_side"], tk["order_prop"], tk["term_label"])
+        dev = plane.chain_topk(
+            spec, int(rows_idx[0]), skip + limit,
+            len(sa.nbr) + len(tbl1))
+        if dev is not None:
+            sel_f, sel_t = dev[0][skip:skip + limit], dev[1][skip:skip + limit]
+            sel_a = np.full(len(sel_f), int(rows_idx[0]), dtype=np.int32)
+            return _topk_project(catalog, tk, plan, CypherResult,
+                                 sel_a, sel_f, sel_t)
+
     if len(rows_idx) == 1:
         # single indexed anchor (the overwhelmingly common call): one
         # CSR slice replaces the general repeat/cumsum hop expansion
@@ -584,6 +654,15 @@ def _exec_topk(catalog, tk: Dict[str, Any], plan: Dict[str, Any],
         # chain machinery like every other torn-build path
         return None
 
+    return _topk_project(catalog, tk, plan, CypherResult, sel_a, sel_f,
+                         sel_t)
+
+
+def _topk_project(catalog, tk, plan, CypherResult, sel_a, sel_f, sel_t):
+    """Shared projection tail of the per-friend top-k family — the
+    host merge and the device merge both land here with the same
+    (anchor, friend, terminal) row selection."""
+    nodes = catalog.nodes()
     row_of = {tk["anchor_var"]: sel_a, tk["mid_var"]: sel_f,
               tk["term_var"]: sel_t}
     cols_out: List[List[Any]] = []
@@ -1009,11 +1088,12 @@ def _analyze_strip(path: A.PatternPath, m: A.MatchClause,
 
 
 def _exec_strip(catalog, strip: Dict[str, Any], ctx,
-                plan: Optional[Dict[str, Any]] = None) -> Optional[_Bindings]:
+                plan: Optional[Dict[str, Any]] = None,
+                plane=None) -> Optional[_Bindings]:
     if plan is not None:
         spec = _strip_view_spec(plan, strip)
         if spec is not None:
-            b = _exec_strip_view(catalog, strip, spec)
+            b = _exec_strip_view(catalog, strip, spec, plane)
             if b is not None:
                 return b
     b = _match_chain(catalog, strip["tpath"], ctx)
@@ -1134,11 +1214,21 @@ def _view_agg_supported(e: A.Expr, count_vars: set,
 
 
 def _exec_strip_view(catalog, strip: Dict[str, Any],
-                     spec: Dict[str, Any]) -> Optional[_Bindings]:
-    sv = catalog.strip_view(
-        spec["etype1"], spec["g_side"], spec["p_label"],
-        strip["etype"], strip["direction"], strip["label"],
-    )
+                     spec: Dict[str, Any],
+                     plane=None) -> Optional[_Bindings]:
+    sv = None
+    if plane is not None:
+        # device segment-sum build of the SAME view (verified-exact
+        # integer arrays, installed into the catalog); None -> host
+        sv = plane.build_strip_view(
+            spec["etype1"], spec["g_side"], spec["p_label"],
+            strip["etype"], strip["direction"], strip["label"],
+        )
+    if sv is None:
+        sv = catalog.strip_view(
+            spec["etype1"], spec["g_side"], spec["p_label"],
+            strip["etype"], strip["direction"], strip["label"],
+        )
     if sv is None:
         return None
     try:
@@ -1210,15 +1300,17 @@ def _analyze_cooc(path: A.PatternPath, m: A.MatchClause,
     }
 
 
-def _exec_cooc(catalog, cooc: Dict[str, Any], ctx) -> Optional[_Bindings]:
+def _exec_cooc(catalog, cooc: Dict[str, Any], ctx,
+               plane=None) -> Optional[_Bindings]:
     etype = cooc["etype"]
     orientation = cooc["orientation"]
     # materialized Gram matrix: O(nnz(C)) per query, maintained across
-    # creates (columnar.cooc_gram). Falls through to the per-query
+    # creates (columnar.cooc_gram; the build contraction runs on the
+    # device plane when gated on). Falls through to the per-query
     # incidence matmul only on a torn concurrent build.
     gram = catalog.cooc_gram(
         etype, orientation, cooc["mid_label"], cooc["a_label"],
-        cooc["b_label"],
+        cooc["b_label"], device_plane=plane,
     )
     if gram is not None:
         ii, jj, w, a_rows, b_rows = gram.coo()

@@ -83,6 +83,15 @@ CHECKS: Dict[str, Tuple] = {
     # gates ABSOLUTELY from the first round it appears — compression
     # paid for with ranking quality is a regression, not a win
     "quant_qps_b16": ("qps", 0.5),
+    # device graph plane (round r09+): coalesced-chain and fused
+    # traverse-rank qps floors once a graph-carrying baseline exists;
+    # row PARITY gates ABSOLUTELY from the first round it appears —
+    # the device fast paths must stay row-identical to the host
+    # executor, so anything below 1.0 is a wrong answer, not noise
+    "graph_chain_conc_qps": ("qps", 0.5),
+    "graph_traverse_rank_qps": ("qps", 0.5),
+    "graph_compile_buckets": ("growth", 2),
+    "ldbc_device_parity": ("quality", 1.0, 0.0),
     "cagra_recall10": ("quality", 0.90, 0.05),
     "hybrid_rank_parity": ("quality", 0.98, 0.02),
     "hybrid_walk_recall10": ("quality", 0.95, 0.02),
@@ -140,6 +149,20 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     out["pagerank_speedup"] = _num(
         doc.get("pagerank_speedup_vs_numpy") if is_summary
         else _g(doc, "northstar", "pagerank_device", "speedup_vs_numpy"))
+    # device graph plane (round r09+): summary "graph" block vs the
+    # full artifact's cypher.device_graph sub-result
+    graph = (doc.get("graph") if is_summary
+             else _g(doc, "cypher", "device_graph")) or {}
+    out["ldbc_device_parity"] = _num(
+        graph.get("device_parity") if is_summary else graph.get("parity"))
+    out["graph_chain_conc_qps"] = _num(
+        graph.get("chain_conc_device_qps") if is_summary
+        else _g(graph, "recent_messages_friends",
+                "concurrent_device_qps"))
+    out["graph_traverse_rank_qps"] = _num(
+        graph.get("traverse_rank_qps_b16") if is_summary
+        else _g(graph, "traverse_rank", "device_qps_b16"))
+    out["graph_compile_buckets"] = _num(graph.get("compile_buckets"))
     load = doc.get("load") or {}
     out["load_knee_qps"] = _num(
         load.get("knee_qps") if is_summary

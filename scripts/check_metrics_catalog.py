@@ -41,6 +41,7 @@ IMPORT_TIME_MODULES = (
     "nornicdb_tpu.search.device_bm25",
     "nornicdb_tpu.search.device_quant",
     "nornicdb_tpu.search.hybrid_fused",
+    "nornicdb_tpu.query.device_graph",
     "nornicdb_tpu.storage.wal",
     "nornicdb_tpu.api.bolt",
     "nornicdb_tpu.api.http_server",

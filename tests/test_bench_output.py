@@ -47,7 +47,29 @@ def _fake_result():
         "value": 9300.0,
         "unit": "queries/s",
         "vs_baseline": 3.03,
-        "cypher": {name: dict(shape) for name in bench._LDBC_BASELINES},
+        "cypher": {
+            **{name: dict(shape) for name in bench._LDBC_BASELINES},
+            "device_graph": {
+                "recent_messages_friends": {
+                    "host_qps": 17000.0, "device_qps_b1": 1200.0,
+                    "parity": True, "concurrent_threads": 16,
+                    "concurrent_host_qps": 2700.0,
+                    "concurrent_auto_qps": 2800.0,
+                    "concurrent_device_qps": 3100.0},
+                "avg_friends_per_city": {
+                    "host_build_ms": 12.0, "device_build_ms": 9.0,
+                    "parity": True},
+                "tag_cooccurrence": {
+                    "host_build_ms": 2.0, "device_build_ms": 4.0,
+                    "parity": True},
+                "traverse_rank": {
+                    "host_qps_b1": 9000.0, "device_qps_b1": 1100.0,
+                    "device_qps_b16": 13000.0, "parity": True},
+                "parity": 1.0,
+                "compile_buckets": 7,
+                "min_n_default": 200000,
+            },
+        },
         "knn": {"value": 110.0, "vs_baseline": 0.011,
                 "b1_concurrent_qps": 900.0, "b64_qps": 5000.0,
                 "backend": "cpu-fallback"},
@@ -112,7 +134,9 @@ def _fake_result():
 class TestCompactSummary:
     def test_headline_set_complete_and_small(self):
         line = json.dumps(bench._compact_summary(_fake_result()))
-        assert len(line) < 1500, f"summary too long for tail window: {len(line)}"
+        # the driver keeps the LAST 2000 chars; the summary is the last
+        # line, so < 1800 leaves margin for real-run value widths
+        assert len(line) < 1800, f"summary too long for tail window: {len(line)}"
         s = json.loads(line)
         assert s["summary"] is True
         assert s["metric"] == "ldbc_snb_cypher_geomean"
@@ -144,6 +168,13 @@ class TestCompactSummary:
                               "quant_recall10": 0.97,
                               "compression_ratio": 14.2,
                               "speedup_int8_vs_f32": 1.18}
+        # device graph plane (ISSUE 9): parity flag the sentinel holds
+        # to 1.0, the coalesced-chain comparison, traverse-rank rate,
+        # and the graph compile-bucket count behind the growth cap
+        assert s["graph"] == {"device_parity": 1.0,
+                              "chain_conc_device_qps": 3100.0,
+                              "traverse_rank_qps_b16": 13000.0,
+                              "compile_buckets": 7}
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -160,6 +191,7 @@ class TestCompactSummary:
         assert s["cagra"]["qps_at_recall95"] is None
         assert s["hybrid"]["fused_qps_b16"] is None
         assert s["quant"]["quant_recall10"] is None
+        assert s["graph"]["device_parity"] is None
         assert s["latency_ms"] == {}
         assert s["tpu_proof"] is None
 
@@ -230,6 +262,27 @@ class TestBenchDryRunArtifactSchema:
         assert full["value"] > 0
         for shape in bench._LDBC_BASELINES:
             assert full["cypher"][shape]["value"] > 0, shape
+
+        # the device graph plane (ISSUE 9): every shape measured on
+        # both paths at toy sizes with row parity intact, the
+        # coalesced-chain trio present, and the fused traverse-rank
+        # dispatch served
+        dg = full["cypher"]["device_graph"]
+        assert dg["parity"] == 1.0
+        chain = dg["recent_messages_friends"]
+        assert chain["host_qps"] > 0 and chain["device_qps_b1"] > 0
+        assert chain["parity"] is True
+        for key in ("concurrent_host_qps", "concurrent_auto_qps",
+                    "concurrent_device_qps"):
+            assert chain[key] > 0, key
+        for name in ("avg_friends_per_city", "tag_cooccurrence"):
+            assert dg[name]["parity"] is True, name
+            assert dg[name]["host_build_ms"] > 0
+            assert dg[name]["device_build_ms"] > 0
+        tr = dg["traverse_rank"]
+        assert tr["parity"] is True
+        assert tr["device_qps_b1"] > 0 and tr["device_qps_b16"] > 0
+        assert dg["compile_buckets"] >= 3
 
         # the concurrent-kNN serving figure must always be present
         knn = full["knn"]
