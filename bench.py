@@ -448,6 +448,15 @@ def _compact_summary(result):
             "collapse": g(result, "load", "surfaces",
                           "qdrant_grpc_search",
                           "queue_collapse_detected"),
+            # serving-tier truth (ISSUE 10): what actually answered
+            # under load, and the worst shadow parity per contract
+            # class (the sentinel's absolute floors)
+            "served_tiers": g(result, "load", "served_tiers"),
+            "shadow_parity_exact": g(result, "load", "shadow_parity",
+                                     "exact"),
+            "shadow_parity_statistical": g(result, "load",
+                                           "shadow_parity",
+                                           "statistical"),
         },
         "surfaces": surfaces,
         # what grpc-python can physically do on this box with this
@@ -1130,13 +1139,31 @@ def _estimate_knee(points):
     }
 
 
+def _tier_fractions(before, after):
+    """Served-tier mix of one window: fraction of the window's served
+    queries per ``surface:tier`` key (obs.audit.tier_counts deltas)."""
+    deltas = {}
+    for key, v in after.items():
+        d = v - before.get(key, 0.0)
+        if d > 0:
+            deltas[key] = d
+    total = sum(deltas.values())
+    if total <= 0:
+        return {}
+    return {k: round(v / total, 4) for k, v in sorted(deltas.items())}
+
+
 def _open_loop_sweep(factory, multipliers, duration_s: float,
                      calib_s: float, calib_conc: int,
-                     max_arrivals: int, explicit_rates=None):
+                     max_arrivals: int, explicit_rates=None,
+                     point_probe=None):
     """Calibrate a closed-loop baseline, then sweep open-loop arrival
     rates at ``multipliers`` x that baseline (or ``explicit_rates``
     QPS). One event loop per sweep; the async client (channel/pool) is
-    shared across every point, like a real caller fleet."""
+    shared across every point, like a real caller fleet.
+    ``point_probe`` (returns a flat counter snapshot) brackets every
+    swept point so each carries its own served-tier mix — what actually
+    answered at each offered rate, not just how fast (ISSUE 10)."""
     import asyncio
 
     from nornicdb_tpu.api.grpc_server import GrpcServer
@@ -1169,9 +1196,14 @@ def _open_loop_sweep(factory, multipliers, duration_s: float,
                      else [max(base_qps * m, 5.0) for m in multipliers])
             points = []
             for j, rate in enumerate(rates):
-                points.append(await _open_loop_point(
+                tiers0 = point_probe() if point_probe else None
+                pt = await _open_loop_point(
                     send, rate, duration_s, seed=17 + j,
-                    max_arrivals=max_arrivals))
+                    max_arrivals=max_arrivals)
+                if tiers0 is not None:
+                    pt["served_tiers"] = _tier_fractions(
+                        tiers0, point_probe())
+                points.append(pt)
             doc = {
                 "closed_loop_qps": round(base_qps, 1),
                 "points": points,
@@ -1214,10 +1246,18 @@ def _bench_load(tiny: bool = False, n_people: "int | None" = None,
         calib_s, calib_conc, max_arrivals = 0.5, 8, 30_000
 
     os.environ.setdefault("NORNICDB_TPU_EMBEDDER", "hash")
+    from nornicdb_tpu.obs import audit as _audit
+
     db = nornicdb_tpu.open(auto_embed=False)
     out = {"open_loop": True, "arrival": "poisson",
            "duration_s_per_point": duration_s, "surfaces": {}}
     http = grpc_srv = ch = None
+    # shadow-parity auditing rides the load run (ISSUE 10): sample a
+    # fraction of the device-served queries and compare against the
+    # host reference, so the artifact carries parity-under-load, not
+    # just parity-in-tests. Rate restored after the stage.
+    _audit.AUDITOR.set_sample_rate(1.0 / 16.0 if tiny else 1.0 / 64.0)
+    tiers_run0 = _audit.tier_counts()
     try:
         embedder = db._embedder
         for i in range(n_people):
@@ -1278,7 +1318,8 @@ def _bench_load(tiny: bool = False, n_people: "int | None" = None,
 
         out["surfaces"]["qdrant_grpc_search"] = _open_loop_sweep(
             grpc_factory, multipliers, duration_s, calib_s, calib_conc,
-            max_arrivals, explicit_rates)
+            max_arrivals, explicit_rates,
+            point_probe=_audit.tier_counts)
 
         http_req = _LeanHttpClient.build(
             "/nornicdb/search", {"query": "topic1 person", "limit": 5})
@@ -1294,18 +1335,57 @@ def _bench_load(tiny: bool = False, n_people: "int | None" = None,
 
         out["surfaces"]["rest_search"] = _open_loop_sweep(
             http_factory, multipliers, duration_s, calib_s, calib_conc,
-            max_arrivals, explicit_rates)
+            max_arrivals, explicit_rates,
+            point_probe=_audit.tier_counts)
     except Exception as exc:  # noqa: BLE001 — stage must always emit
         out["error"] = f"{type(exc).__name__}: {exc}"[:400]
     finally:
+        # stop traffic first, then DRAIN the audit queue while the
+        # indexes are still alive (a reference replay against a closed
+        # db would read as a drop — or worse, a false mismatch — in
+        # the sentinel-gated verdict), and only then tear the db down
         if ch is not None:
             ch.close()
         if grpc_srv is not None:
             grpc_srv.stop()
         if http is not None:
             http.stop()
+        # whole-run tier mix + the shadow-parity verdict the sentinel
+        # gates: exact tiers must replay the host reference at 1.0,
+        # statistical tiers at their documented floors. Null when no
+        # tier of that class was sampled (the check then skips).
+        try:
+            _audit.AUDITOR.flush(timeout_s=5.0)
+            out["served_tiers"] = _tier_fractions(
+                tiers_run0, _audit.tier_counts())
+            out["shadow_parity"] = _shadow_parity_verdict(_audit)
+        except Exception as exc:  # noqa: BLE001
+            out["shadow_parity"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:200]}
+        _audit.AUDITOR.set_sample_rate(None)
         db.close()
     return out
+
+
+def _shadow_parity_verdict(_audit):
+    """Worst rolling parity per contract class from the auditor's
+    windows: {"exact": min over exact tiers, "statistical": min over
+    statistical tiers, "sampled": N} — nulls when unsampled."""
+    summary = _audit.audit_summary()
+    exact = statistical = None
+    for key, doc in summary["tiers"].items():
+        tier = key.split(":", 1)[1]
+        p = doc.get("parity")
+        if p is None or not doc.get("samples"):
+            continue
+        if tier in _audit.EXACT_TIERS:
+            exact = p if exact is None else min(exact, p)
+        elif tier in _audit.STATISTICAL_FLOORS:
+            statistical = (p if statistical is None
+                           else min(statistical, p))
+    return {"exact": exact, "statistical": statistical,
+            "sampled": summary["sampled"],
+            "mismatches": summary["mismatches"]}
 
 
 def _bench_northstar():

@@ -23,6 +23,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from nornicdb_tpu import obs
+
+# tier-mix truth for search wire-cache hits (ISSUE 10): cached child —
+# the response-bytes hit path must not pay a labels() probe per request
+_SEARCH_CACHED_SERVED = obs.audit.served_counter("hybrid", "cached")
 from nornicdb_tpu.audit import ADMIN_ACTION, AUTH, DATA_WRITE, GDPR, AuditLog
 from nornicdb_tpu.auth import ADMIN, READ, WRITE, AuthError, PermissionDenied
 from nornicdb_tpu.storage.txn import TransactionManager
@@ -614,7 +618,8 @@ class HttpServer:
         queue_factor = env_float("READY_QUEUE_FACTOR", 1.0)
         reasons: List[str] = []
         checks = {"indexes": 0, "queues": 0, "rebuilds_pending": 0,
-                  "changelogs_near_overrun": 0, "queues_saturated": 0}
+                  "changelogs_near_overrun": 0, "queues_saturated": 0,
+                  "parity_breaches": 0}
         for entry in obs.resource_snapshot():
             name = f"{entry['family']}/{entry['index']}"
             if "queue_depth" in entry and "rows" not in entry:
@@ -637,6 +642,17 @@ class HttpServer:
                 checks["changelogs_near_overrun"] += 1
                 reasons.append(
                     f"changelog_near_overrun:{name}({depth}/{cap})")
+        # shadow-parity breaches (ISSUE 10): a tier whose device/host
+        # parity sits below its documented floor must rotate this node
+        # out of traffic — serving fast wrong answers is not ready
+        try:
+            for b in obs.parity_breaches():
+                checks["parity_breaches"] += 1
+                reasons.append(
+                    f"parity_breach:{b['surface']}:{b['tier']}"
+                    f"({b['ratio']}<{b['floor']})")
+        except Exception:
+            pass
         # keep the SLO sample ring warm from the probe cadence (the
         # engine is scrape-driven; kubelet-style periodic readiness
         # probes give it a steady clock even with /metrics unscraped)
@@ -837,6 +853,7 @@ class HttpServer:
         hit = self._search_wire.get(key)
         if hit is not None and hit[0] == gen:
             self.metrics.inc("search_requests_total")
+            _SEARCH_CACHED_SERVED.inc()
             return hit[1]
         status, payload = self.route("POST", "/nornicdb/search", body,
                                      headers)
@@ -1288,12 +1305,27 @@ class HttpServer:
                 # per-query device cost: flops/bytes per (kind, index),
                 # the pricing admission control / routing will consume
                 "cost": obs.cost_summary(),
+                # serving-tier truth (ISSUE 10): which ladder rung
+                # answered (tier mix) and the shadow-parity state
+                "tiers": obs.tier_mix(),
+                "parity": obs.audit_summary(),
                 "rate_limiter_clients":
                     self.rate_limiter.tracked_clients(),
             }
             svc = self.db._search  # no index build from a telemetry read
             if svc is not None:
                 doc["microbatch"] = svc.microbatch_stats()
+            return 200, doc
+
+        if action == "degrades" and method == "GET":
+            # the unified degrade ledger (ISSUE 10): structured
+            # (from_tier, to_tier, reason, versions) records of every
+            # ladder step-down, newest first, plus a reason rollup
+            limit = 100
+            if len(segments) > 2 and segments[2].isdigit():
+                limit = int(segments[2])  # /admin/degrades/<limit>
+            doc = dict(obs.degrade_summary())
+            doc["degrades"] = obs.degrade_snapshot(limit=limit)
             return 200, doc
 
         if action == "slo":

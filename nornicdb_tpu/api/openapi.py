@@ -63,6 +63,16 @@ def openapi_spec() -> Dict[str, Any]:
             "/admin/slo": {"get": op(
                 "SLO budgets + multi-window burn rates per surface "
                 "(admin)", "ops", response={"type": "object"})},
+            "/admin/degrades": {"get": op(
+                "Unified degrade ledger: structured (from_tier, "
+                "to_tier, reason, versions) records of every serving "
+                "ladder step-down, newest first (admin)", "ops",
+                response={"type": "object", "properties": {
+                    "recorded": {"type": "integer"},
+                    "capacity": {"type": "integer"},
+                    "by_reason": {"type": "object"},
+                    "degrades": {"type": "array",
+                                 "items": {"type": "object"}}}})},
             "/openapi.json": {"get": op("This document", "ops")},
             "/debug/profile": {"post": op(
                 "Profile one Cypher statement (admin)", "ops",

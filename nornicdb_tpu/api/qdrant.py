@@ -26,6 +26,7 @@ import numpy as np
 from nornicdb_tpu.errors import NotFoundError
 from nornicdb_tpu.obs import annotate as _obs_annotate
 from nornicdb_tpu.obs import attach_span as _obs_attach_span
+from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.search.vector_index import BruteForceIndex
 from nornicdb_tpu.storage.types import Node, now_ms
 
@@ -807,7 +808,11 @@ class QdrantCompat:
                     # one bounded stage label for ALL collections — the
                     # per-collection split lives in the resource gauges,
                     # not in histogram label cardinality
-                    surface="qdrant")
+                    surface="qdrant",
+                    # rider-level serving-tier attribution (ISSUE 10):
+                    # the dispatch path (brute/cagra/quant plane) notes
+                    # the rung that answered, each rider records it
+                    tier_surface="vector")
                 self._microbatchers[name] = mb
                 from nornicdb_tpu.obs import register_resource
 
@@ -854,6 +859,30 @@ class QdrantCompat:
                 register_resource("cagra", f"qdrant:{name}", wrap)
             return wrap
 
+    def _maybe_shadow_vector(self, idx, q, k: int, hits) -> None:
+        """Offer one coalesced, device-served collection search to the
+        shadow-parity auditor (reference: the exact brute scan of the
+        same index, executed on the audit worker). Best-effort."""
+        if not _audit.sampling_active():
+            return
+        tier = _audit.last_served()
+        if tier is None or tier == "host":
+            return
+        try:
+            qv = np.asarray(q, dtype=np.float32)
+
+            def versions_now():
+                return {"brute_mutations": getattr(idx, "mutations", 0)}
+
+            _audit.maybe_sample(
+                "vector", tier, [i for i, _ in hits], k=min(10, k),
+                ref=lambda: [i for i, _ in idx.search_batch(
+                    qv[None, :], k, exact=True)[0]],
+                versions=versions_now(), versions_now=versions_now,
+                query={"k": k})
+        except Exception:  # noqa: BLE001
+            pass
+
     def _ranked_cosine(self, name: str, vector: Sequence[float]):
         """Yield (node_id, cosine) best-first, progressively widening the
         kNN so selective filters still fill `limit` (a fixed 4x
@@ -878,6 +907,7 @@ class QdrantCompat:
             k_req = min(k, total) if total else k
             if first:
                 hits = self._collection_microbatch(name).search(q, k_req)
+                self._maybe_shadow_vector(idx, q, k_req, hits)
                 first = False
                 # a short FIRST round is not exhaustion: the ANN wrapper
                 # (cagra) live-filters rows deleted since its build, so

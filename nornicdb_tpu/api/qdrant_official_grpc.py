@@ -238,10 +238,23 @@ def aio_unary_raw(
     ``executor`` so a slow compute can't stall the loop. ``error_cls``
     exceptions map to gRPC status via :func:`grpc_status_of`."""
     time_tag = scale = None
+    cached_served = None
     if wire is not None:
         tagged = _fresh_time_tag(resp_cls)
         if tagged is not None:
             time_tag, scale = tagged
+        if "Search" in method or "Query" in method \
+                or method.endswith("/Hybrid"):
+            # serving-tier mix (ISSUE 10): a wire-cache hit on a search
+            # RPC answered from cached bytes — no ladder rung executed.
+            # Surface by RPC semantics: only the nornic Hybrid RPC is
+            # the hybrid surface; every other search-shaped method
+            # (/qdrant.Points/Search, nornic QdrantService points ops,
+            # nornic SearchService/Search) is a vector search. Child
+            # resolved once per handler build; hit path pays one
+            # striped inc, no labels() probe.
+            surf = "hybrid" if method.endswith("/Hybrid") else "vector"
+            cached_served = obs.audit.served_counter(surf, "cached")
 
     def serve(data: bytes) -> bytes:
         out = fn(data)
@@ -270,6 +283,9 @@ def aio_unary_raw(
                 hit = wire.get(method, data, g)
                 if hit is not None:
                     root.annotate(cache="hit")
+                    if cached_served is not None:
+                        root.annotate(served_by="cached")
+                        cached_served.inc()
                     latency.observe(time.time() - t0)
                     if time_tag is not None:
                         return (hit + time_tag + struct.pack(

@@ -573,9 +573,34 @@ class DB:
                 f"query_vector has {q.shape[0]} dims, index has {dims}")
         q = q[None, :]
         plane = ex.device_graph
+        from nornicdb_tpu.obs import audit as _audit
+        import time as _time
+
+        t0 = _time.time()
         hits = plane.traverse_rank([row], hops_n, q, k, index)
         if hits is None:
             hits = plane.traverse_rank_host([row], hops_n, q, k, index)
+            _audit.record_served("graph", "host",
+                                 seconds=_time.time() - t0)
+        else:
+            _audit.record_served("graph", "graph_traverse_rank_device",
+                                 seconds=_time.time() - t0)
+            if _audit.sampling_active():
+                # shadow-parity: replay the identical-contract host
+                # fallback on the audit worker and compare row ids
+
+                def versions_now():
+                    return {"catalog_version": cat.version,
+                            "index_mutations":
+                            getattr(index, "mutations", 0)}
+
+                _audit.maybe_sample(
+                    "graph", "graph_traverse_rank_device",
+                    [r for r, _ in hits[0]], k=min(10, k),
+                    ref=lambda: [r for r, _ in plane.traverse_rank_host(
+                        [row], hops_n, q, k, index)[0]],
+                    versions=versions_now(), versions_now=versions_now,
+                    query={"anchor": anchor_id, "hops": hops_n, "k": k})
         nodes = cat.nodes()
         return [(nodes[r].id, s) for r, s in hits[0]]
 

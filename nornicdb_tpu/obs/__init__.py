@@ -46,10 +46,22 @@ from nornicdb_tpu.obs.metrics import (
     set_enabled,
     set_exemplars_enabled,
 )
+from nornicdb_tpu.obs import audit  # noqa: F401 — registers tier families
 from nornicdb_tpu.obs import cost  # noqa: F401 — registers cost counters
 from nornicdb_tpu.obs import resources  # noqa: F401 — registers collector
 from nornicdb_tpu.obs import slo  # noqa: F401 — registers collector
 from nornicdb_tpu.obs import stages  # noqa: F401 — registers stage family
+from nornicdb_tpu.obs.audit import (
+    audit_summary,
+    degrade_snapshot,
+    degrade_summary,
+    maybe_sample,
+    parity_breaches,
+    record_degrade,
+    record_served,
+    tier_allowed,
+    tier_mix,
+)
 from nornicdb_tpu.obs.cost import cost_summary, record_query_cost
 from nornicdb_tpu.obs.resources import register as register_resource
 from nornicdb_tpu.obs.resources import snapshot as resource_snapshot
@@ -82,18 +94,26 @@ __all__ = [
     "TraceBuffer",
     "annotate",
     "attach_span",
+    "audit",
+    "audit_summary",
     "compile_universe",
     "cost",
     "cost_summary",
     "current_span",
     "current_trace_id",
+    "degrade_snapshot",
+    "degrade_summary",
     "enabled",
     "exemplars_enabled",
     "get_registry",
     "get_slo_engine",
     "latency_summary",
+    "maybe_sample",
+    "parity_breaches",
+    "record_degrade",
     "record_dispatch",
     "record_query_cost",
+    "record_served",
     "record_stage",
     "register_resource",
     "resource_snapshot",
@@ -104,5 +124,7 @@ __all__ = [
     "span",
     "stage_summary",
     "stages",
+    "tier_allowed",
+    "tier_mix",
     "trace",
 ]

@@ -284,10 +284,14 @@ class SloEngine:
 
     def dump(self, reason: str = "manual",
              now: Optional[float] = None,
-             status: Optional[Dict[str, Any]] = None) -> str:
+             status: Optional[Dict[str, Any]] = None,
+             extra: Optional[List[Dict[str, Any]]] = None) -> str:
         """One JSONL flight record: meta, SLO status, metrics/latency/
-        resource snapshots, and the slow-trace ring — everything needed
-        to reconstruct the breach after the fact."""
+        resource snapshots, the degrade ledger + parity state, and the
+        slow-trace ring — everything needed to reconstruct the breach
+        after the fact. ``extra`` appends caller records (the shadow
+        auditor's self-contained parity repro rides here)."""
+        from nornicdb_tpu.obs import audit as _audit
         from nornicdb_tpu.obs import resources as _resources
         from nornicdb_tpu.obs import stages as _stages
         from nornicdb_tpu.obs.dispatch import compile_universe
@@ -310,7 +314,16 @@ class SloEngine:
              "summary": _stages.stage_summary(self.registry)},
             {"kind": "resources", "snapshot": _resources.snapshot()},
             {"kind": "compile_universe", "shapes": compile_universe()},
+            # which ladder rung served, what degraded and why, and the
+            # device/host parity state at breach time (ISSUE 10)
+            {"kind": "tiers", "mix": _audit.tier_mix()},
+            {"kind": "degrades",
+             "summary": _audit.degrade_summary(),
+             "ring": _audit.degrade_snapshot(limit=50)},
+            {"kind": "parity", "summary": _audit.audit_summary()},
         ]
+        for rec in (extra or []):
+            lines.append(rec)
         for trace in TRACES.slowest(limit=20):
             lines.append({"kind": "trace", "trace": trace})
         tmp = path + ".tmp"

@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from nornicdb_tpu.obs import declare_kind, record_dispatch
+from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.obs import cost as _cost
 from nornicdb_tpu.obs.metrics import REGISTRY
 from nornicdb_tpu.search.microbatch import BatchCoalescer, pow2_bucket
@@ -75,6 +76,19 @@ KIND_GRAM = "graph_cooc_gram"
 KIND_RANK = "graph_traverse_rank"
 for _k in (KIND_CHAIN, KIND_AGG, KIND_GRAM, KIND_RANK):
     declare_kind(_k)
+
+# canonical serving-tier names (obs/audit taxonomy) for the plane's
+# query-shaped rungs (strip/gram are builds, not per-query serving)
+TIER_CHAIN = "graph_chain_device"
+TIER_RANK = "graph_traverse_rank_device"
+
+
+def _ledger(from_tier: str, reason: str,
+            versions: "Dict[str, Any] | None" = None) -> None:
+    """Structured degrade record for a device-graph -> host step (the
+    legacy device_graph_events_total labels stay as aliases)."""
+    _audit.record_degrade("graph", from_tier, "host", reason,
+                          index="device_graph", versions=versions)
 
 _I32_MAX = 2 ** 31 - 1
 _EXACT_F32 = float(2 ** 24)  # integer-exactness bound for f32 sums
@@ -470,8 +484,25 @@ class DeviceGraphPlane:
             # dispatch; only coalescible concurrency routes on-device
             if self.inflight <= 1:
                 return None
+        if not _audit.tier_allowed(TIER_CHAIN):
+            # shadow-parity quarantine: the chain rung steps down to
+            # the host executor until the breach clears
+            _event("degrade_quarantine")
+            _ledger(TIER_CHAIN, "quarantine",
+                    {"catalog_version": self.catalog.version})
+            return None
         batcher = self._chain_batcher(spec)
-        return batcher.submit((int(anchor), int(k_head)))
+        import time as _time
+
+        t0 = _time.time()
+        out = batcher.submit((int(anchor), int(k_head)))
+        if out is not None:
+            # rider-accurate attribution: this rider was answered by
+            # the device chain rung (a None falls to the host path,
+            # counted at the fast-path call site)
+            _audit.record_served("graph", TIER_CHAIN,
+                                 seconds=_time.time() - t0)
+        return out
 
     def _chain_batcher(self, spec: Tuple) -> BatchCoalescer:
         key = ("chainb",) + spec
@@ -491,10 +522,13 @@ class DeviceGraphPlane:
             return none_all
         if mode == "auto" and len(items) < graph_device_min_b():
             _event("batch_below_min_b")
+            _ledger(TIER_CHAIN, "min_batch")
             return none_all
         snap = self._chain_snapshot(spec)
         if snap is None:
             _event("degrade_stale")
+            _ledger(TIER_CHAIN, "stale_snapshot",
+                    {"catalog_version": self.catalog.version})
             return none_all
         import time as _time
 
@@ -506,6 +540,8 @@ class DeviceGraphPlane:
         width = f * kp
         if snap["s"] * width >= _I32_MAX or width > 1 << 20:
             _event("degrade_rank_overflow")
+            _ledger(TIER_CHAIN, "rank_overflow",
+                    {"snapshot_version": snap["version"]})
             return none_all
         bsz = pow2_bucket(len(items))
         anchors = np.full(bsz, -1, dtype=np.int32)
@@ -525,6 +561,8 @@ class DeviceGraphPlane:
             sel_valid = np.asarray(sel_valid)
         except Exception:  # noqa: BLE001 — degrade, never fail the read
             _event("degrade_error")
+            _ledger(TIER_CHAIN, "error",
+                    {"snapshot_version": snap["version"]})
             return none_all
         dt = _time.perf_counter() - t0
         record_dispatch(KIND_CHAIN, bsz, f * 100_000 + kp, dt)
@@ -537,6 +575,9 @@ class DeviceGraphPlane:
         # invalidated the snapshot under us — the host path must serve
         if self.catalog.version != snap["version"]:
             _event("degrade_stale")
+            _ledger(TIER_CHAIN, "stale_snapshot",
+                    {"snapshot_version": snap["version"],
+                     "catalog_version": self.catalog.version})
             return none_all
         out = []
         for i, (_a, k) in enumerate(items):
@@ -773,10 +814,17 @@ class DeviceGraphPlane:
             # measured on CPU: the fused dispatch beats the host
             # fallback ~2x at b=16 but loses ~4x at b=1
             return None
+        if not _audit.tier_allowed(TIER_RANK):
+            _event("degrade_quarantine")
+            _ledger(TIER_RANK, "quarantine",
+                    {"catalog_version": self.catalog.version})
+            return None
         hops_t = tuple((str(e), str(d)) for e, d in hops)
         snap = self._rank_snapshot(hops_t, index)
         if snap is None:
             _event("degrade_stale")
+            _ledger(TIER_RANK, "stale_snapshot",
+                    {"catalog_version": self.catalog.version})
             return None
         dv = index.device_view()
         if dv is None:
@@ -784,6 +832,9 @@ class DeviceGraphPlane:
         matrix, valid, _ext_ids, mutations, _comp = dv
         if mutations != snap["mutations"]:
             _event("degrade_stale")
+            _ledger(TIER_RANK, "stale_snapshot",
+                    {"snapshot_mutations": snap["mutations"],
+                     "index_mutations": mutations})
             return None
         import time as _time
 
@@ -795,6 +846,8 @@ class DeviceGraphPlane:
         frontier = f1 * max(f2, 1)
         if frontier > 1 << 18:
             _event("degrade_rank_overflow")
+            _ledger(TIER_RANK, "rank_overflow",
+                    {"snapshot_version": snap["version"]})
             return None
         kp = pow2_bucket(min(k, max(frontier, 1)))
         bsz = pow2_bucket(len(anchors))
@@ -817,6 +870,8 @@ class DeviceGraphPlane:
             sel_rows = np.asarray(sel_rows)
         except Exception:  # noqa: BLE001
             _event("degrade_error")
+            _ledger(TIER_RANK, "error",
+                    {"snapshot_version": snap["version"]})
             return None
         dt = _time.perf_counter() - t0
         record_dispatch(KIND_RANK, bsz, f1 * 100_000 + kp, dt)
@@ -830,6 +885,9 @@ class DeviceGraphPlane:
         if self.catalog.version != snap["version"] \
                 or index.view_meta() != (snap["mutations"], _comp):
             _event("degrade_stale")
+            _ledger(TIER_RANK, "stale_snapshot",
+                    {"snapshot_version": snap["version"],
+                     "catalog_version": self.catalog.version})
             return None
         out: List[List[Tuple[int, float]]] = []
         for i in range(len(anchors)):

@@ -31,10 +31,17 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.query import ast as A
 
 
 _AGG_NAMES = {"count", "sum", "avg", "min", "max", "collect"}
+
+# serving-tier mix for the chain family (ISSUE 10): the host fast path
+# runs ~50us/query, so the labeled child is cached once at import —
+# .inc() is a striped add with no dict probe (the device rung records
+# itself, span included, in device_graph.chain_topk)
+_CHAIN_HOST_SERVED = _audit.served_counter("graph", "host")
 
 
 def try_fast_path(executor, q: A.Query, ctx) -> Optional["CypherResult"]:
@@ -597,6 +604,10 @@ def _exec_topk_impl(catalog, tk: Dict[str, Any], plan: Dict[str, Any],
             sel_a = np.full(len(sel_f), int(rows_idx[0]), dtype=np.int32)
             return _topk_project(catalog, tk, plan, CypherResult,
                                  sel_a, sel_f, sel_t)
+
+    # every chain query from here serves on the host arrays — counted
+    # so the tier mix stays truthful (the device rung counted above)
+    _CHAIN_HOST_SERVED.inc()
 
     if len(rows_idx) == 1:
         # single indexed anchor (the overwhelmingly common call): one

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nornicdb_tpu.obs import REGISTRY, declare_kind, record_dispatch
+from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.ops.similarity import (
     NEG_INF,
     concat_topk,
@@ -777,12 +778,21 @@ class CagraIndex:
         g = self._ensure_graph()
         if g is None:
             return self._brute.search_batch(queries, k)
+        tier = ("vector_walk_quant" if g.get("quant") is not None
+                else "vector_walk_f32")
+        if not _audit.tier_allowed(tier):
+            # shadow-parity quarantine: the walk steps down its ladder
+            # to the exact tier until the breach clears
+            _CAGRA_C.labels("exact_fallback_quarantine").inc()
+            self._degrade(tier, "quarantine", g)
+            return self._brute.search_batch(queries, k)
         p = itopk or self.itopk
         if min(k, g["n"]) > p:
             # the pool can only ever hold itopk candidates — a deeper
             # request silently truncated would differ from the brute and
             # hnsw strategies, so serve it exactly instead
             _CAGRA_C.labels("exact_fallback_itopk").inc()
+            self._degrade(tier, "itopk_exceeded", g)
             return self._brute.search_batch(queries, k)
         delta_ids, delta_vecs = self._delta_block(g)
         if delta_ids is None:
@@ -790,6 +800,7 @@ class CagraIndex:
             # background rebuild is in flight): serve exactly until the
             # fresh graph swaps in
             _CAGRA_C.labels("exact_fallback_changelog").inc()
+            self._degrade(tier, "changelog_overrun", g)
             return self._brute.search_batch(queries, k)
         n_iters = iters if iters is not None else g["iters"]
         w = width or self.search_width
@@ -844,8 +855,22 @@ class CagraIndex:
         want = min(k_eff, len(self._brute))
         if any(len(hits) < want for hits in out):
             _CAGRA_C.labels("exact_fallback_underfill").inc()
+            self._degrade(tier, "underfill", g)
             return self._brute.search_batch(queries[:b], k)
+        _audit.note_batch_tier(tier)
         return out
+
+    def _degrade(self, tier: str, reason: str, g) -> None:
+        """Structured ledger record for a walk -> exact-tier step (the
+        legacy cagra_events_total label stays as the alias)."""
+        from nornicdb_tpu.obs import cost as _cost
+
+        _audit.record_degrade(
+            "vector", tier, "vector_brute_f32", reason,
+            index=_cost.cost_name(self._brute),
+            versions={"build_seq": g.get("build_seq"),
+                      "built_mutations": g.get("built_mutations"),
+                      "mutations": getattr(self._brute, "mutations", 0)})
 
     def _delta_block(self, g):
         """(ids, vectors[m,D]) of rows added/updated since the graph

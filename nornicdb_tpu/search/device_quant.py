@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nornicdb_tpu.obs import REGISTRY, declare_kind, record_dispatch
+from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.obs import cost as _cost
 from nornicdb_tpu.ops.kmeans import kmeans_fit, train_subspace_codebooks
 from nornicdb_tpu.ops.similarity import NEG_INF, concat_topk, l2_normalize
@@ -746,15 +747,24 @@ class QuantizedBrutePlane:
         snap = self.ensure()
         if snap is None:
             return None
+        tier = f"vector_{snap['mode']}"
+        if not _audit.tier_allowed(tier):
+            # shadow-parity quarantine: step down to the float32 tier
+            # until the breach clears (audit.tier_allowed probation)
+            _QUANT_C.labels("degrade_quarantine").inc()
+            self._degrade(tier, "quarantine", snap)
+            return None
         if snap["built_compactions"] != getattr(brute, "compactions", 0):
             # a compaction remapped the slot space: plane slot ids no
             # longer address the live matrix
             _QUANT_C.labels("degrade_compaction").inc()
+            self._degrade(tier, "compaction", snap)
             self._kick_background_rebuild()
             return None
         delta = brute.changed_since(snap["built_mutations"])
         if delta is None:
             _QUANT_C.labels("degrade_changelog").inc()
+            self._degrade(tier, "changelog_overrun", snap)
             self._kick_background_rebuild()
             return None
         n_alive = len(brute)
@@ -783,6 +793,7 @@ class QuantizedBrutePlane:
             uniq, expect_compactions=snap["built_compactions"])
         if got is None:
             _QUANT_C.labels("degrade_rerank_race").inc()
+            self._degrade(tier, "rerank_race", snap)
             return None
         rows_u, alive_u, ids_u = got
         t0 = time.time()
@@ -826,9 +837,21 @@ class QuantizedBrutePlane:
             # clustered deletes can empty a query's pool even though
             # live rows remain — serve those batches exactly
             _QUANT_C.labels("degrade_underfill").inc()
+            self._degrade(tier, "underfill", snap)
             return None
         _QUANT_C.labels("dispatch").inc()
         if d_ids:
             _QUANT_C.labels("delta_merge").inc()
         record_dispatch("quant_rerank", bb, pool, time.time() - t0)
+        _audit.note_batch_tier(tier)
         return out
+
+    def _degrade(self, tier: str, reason: str, snap) -> None:
+        """One structured ledger record for a quantized->float32 step
+        (the legacy quant_events_total label stays as the alias)."""
+        _audit.record_degrade(
+            "vector", tier, "vector_brute_f32", reason,
+            index=_cost.cost_name(self.brute),
+            versions={"built_mutations": snap.get("built_mutations"),
+                      "built_compactions": snap.get("built_compactions"),
+                      "build_seq": snap.get("build_seq")})

@@ -66,6 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nornicdb_tpu.obs import REGISTRY, declare_kind, record_dispatch
+from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.obs import cost as _cost
 from nornicdb_tpu.ops.similarity import NEG_INF, l2_normalize
 from nornicdb_tpu.search.bm25 import BM25Index
@@ -94,6 +95,18 @@ declare_kind("hybrid_fused")
 declare_kind("hybrid_walk_fused")
 declare_kind("hybrid_fused_quant")
 declare_kind("hybrid_walk_fused_quant")
+
+# canonical serving-tier names (obs/audit taxonomy) for the pipeline's
+# rungs; every decoded row carries `served_by` — per ROW, because one
+# rider's freshness correction (host re-fuse) must not relabel its
+# batch-mates (ISSUE 10 rider accuracy)
+TIER_BRUTE_F32 = "hybrid_brute_f32"
+TIER_WALK_F32 = "hybrid_walk_f32"
+TIER_WALK_QUANT = "hybrid_walk_quant"
+
+
+def quant_tier(mode: str) -> str:
+    return f"hybrid_brute_{mode}"
 
 
 # ---------------------------------------------------------------------------
@@ -621,6 +634,8 @@ class FusedHybrid:
         delta = self.lex.delta_block(snap)
         if delta is None:
             _HYB_C.labels("host_fallback_changelog").inc()
+            self._ledger(TIER_BRUTE_F32, "host", "changelog_overrun",
+                         snap)
             self.lex._kick_background_rebuild()
             return none_rows
         if self.brute.view_meta() is None:
@@ -632,10 +647,12 @@ class FusedHybrid:
             ptr, urow, sel, avgdl = self.lex.plan(snap, token_rows, b)
         except SnapshotStale:
             _HYB_C.labels("host_fallback_compaction").inc()
+            self._ledger(TIER_BRUTE_F32, "host", "compaction", snap)
             self.lex._kick_background_rebuild()
             return none_rows
         except PlanOverflow:
             _HYB_C.labels("host_fallback_overflow").inc()
+            self._ledger(TIER_BRUTE_F32, "host", "overflow", snap)
             return none_rows
         n_cand = np.asarray(
             [int(e["n_cand"]) for e in extras], dtype=np.int32)
@@ -692,6 +709,7 @@ class FusedHybrid:
             # a write/compaction moved the brute matrix between the
             # view capture and the map read — retry next batch
             _HYB_C.labels("host_fallback_vec_race").inc()
+            self._ledger(TIER_BRUTE_F32, "host", "vec_race", snap)
             return none_rows
         args = (*lex_base, l2v, jnp.float32(avgdl), qn)
         t0 = time.time()
@@ -704,6 +722,7 @@ class FusedHybrid:
             mp, vp = self._vec_arrays(m, valid, snap)
             if mp is None:
                 _HYB_C.labels("host_fallback_unshardable").inc()
+                self._ledger(TIER_BRUTE_F32, "host", "unshardable", snap)
                 return none_rows
             ls, lgrow, vs, vi, fs, fpos = _fused_sharded_impl(
                 *args, mp, vp, *tail, kq=kq, rrf_k=self.rrf_k,
@@ -723,7 +742,8 @@ class FusedHybrid:
                               pow2_bucket(b), int(m.shape[0]),
                               int(m.shape[1])))
         out = self._decode(snap, vec_ext, delta, token_rows, extras,
-                           ls, lgrow, vs, vi, fs, fpos, kq)
+                           ls, lgrow, vs, vi, fs, fpos, kq,
+                           tier=TIER_BRUTE_F32)
         if delta:
             _HYB_C.labels("delta_merge").inc(len(extras))
         times = {"plan_s": t0 - t_plan0, "device_t0": t0,
@@ -736,6 +756,21 @@ class FusedHybrid:
                 row["times"] = times
                 row["tier"] = "brute"
         return out
+
+    def _ledger(self, from_tier: str, to_tier: str, reason: str,
+                snap=None, g=None) -> None:
+        """Structured degrade record for this pipeline (the legacy
+        hybrid_fused_events_total labels stay as aliases)."""
+        versions = {}
+        if snap is not None:
+            versions["lex_built_mutations"] = snap.get("built_mutations")
+        if g is not None:
+            versions["graph_build_seq"] = g.get("build_seq")
+            versions["graph_built_mutations"] = g.get("built_mutations")
+        versions["brute_mutations"] = getattr(self.brute, "mutations", 0)
+        _audit.record_degrade(
+            "hybrid", from_tier, to_tier, reason,
+            index=_cost.cost_name(self.lex), versions=versions)
 
     # -- quantized brute tier ---------------------------------------------
 
@@ -750,6 +785,13 @@ class FusedHybrid:
             # the quant programs are single-shard; sharded snapshots
             # keep the float32 mesh path
             return None
+        if not _audit.tier_allowed(quant_tier(quant_mode())):
+            # shadow-parity quarantine: the quantized rung steps down
+            # to the float32 tier of the same ladder
+            _HYB_C.labels("quant_quarantined").inc()
+            self._ledger(quant_tier(quant_mode()), TIER_BRUTE_F32,
+                         "quarantine", snap)
+            return None
         brute = self.brute
         plane = getattr(brute, "quant_plane", lambda: None)()
         if plane is None:
@@ -763,11 +805,15 @@ class FusedHybrid:
         if qsnap["built_compactions"] != getattr(brute, "compactions",
                                                  0):
             _HYB_C.labels("quant_fallback_compaction").inc()
+            self._ledger(quant_tier(qsnap["mode"]), TIER_BRUTE_F32,
+                         "compaction", snap)
             plane._kick_background_rebuild()
             return None
         vdelta = brute.changed_since(qsnap["built_mutations"])
         if vdelta is None:
             _HYB_C.labels("quant_fallback_changelog").inc()
+            self._ledger(quant_tier(qsnap["mode"]), TIER_BRUTE_F32,
+                         "changelog_overrun", snap)
             plane._kick_background_rebuild()
             return None
         ids_view = brute.ids_meta()
@@ -785,10 +831,12 @@ class FusedHybrid:
         None when the float32 exact tier must re-serve the batch
         (join-map race, rerank race, under-fill)."""
         qsnap = qctx["qsnap"]
+        tier = quant_tier(qsnap["mode"])
         brute = self.brute
         l2v = self._ensure_map(snap, qctx["mutations"])
         if l2v is None:
             _HYB_C.labels("quant_fallback_vec_race").inc()
+            self._ledger(tier, TIER_BRUTE_F32, "vec_race", snap)
             return None
         args = (*lex_base, l2v, jnp.float32(avgdl), qn)
         # the vector half overfetches past kq: coarse ordering is
@@ -834,6 +882,7 @@ class FusedHybrid:
             uniq, expect_compactions=qsnap["built_compactions"])
         if got is None:
             _HYB_C.labels("quant_fallback_vec_race").inc()
+            self._ledger(tier, TIER_BRUTE_F32, "rerank_race", snap)
             return None
         rows_u, alive_u, _ids_u = got
         exact_u = qh @ rows_u.T  # [B, U]
@@ -854,7 +903,7 @@ class FusedHybrid:
         out = self._decode(snap, qctx["ids"], delta, token_rows,
                            extras, ls, lgrow, vs_e, vi, fs, fpos, kq,
                            vec_delta=vec_delta, qn=qh,
-                           force_refuse=True)
+                           force_refuse=True, tier=tier)
         # under-fill veto: live-filtering can leave a row short of
         # candidates the corpus does have — the float32 tier re-serves
         alive_n = len(brute)
@@ -863,6 +912,7 @@ class FusedHybrid:
                 continue
             if len(row["vec"]) < min(int(e["n_cand"]), kq, alive_n):
                 _HYB_C.labels("quant_underfill_f32").inc()
+                self._ledger(tier, TIER_BRUTE_F32, "underfill", snap)
                 return None
         _HYB_C.labels("quant_dispatch").inc()
         if d_ids:
@@ -896,21 +946,34 @@ class FusedHybrid:
             # still running in the background: exact tier serves
             _HYB_C.labels("walk_pending_build").inc()
             return None
+        tier = (TIER_WALK_QUANT
+                if snap["shards"] == 1 and g.get("quant") is not None
+                else TIER_WALK_F32)
+        if not _audit.tier_allowed(tier):
+            # shadow-parity quarantine: walk steps down its ladder to
+            # the brute-fused tier until the breach clears
+            _HYB_C.labels("walk_quarantined").inc()
+            self._ledger(tier, TIER_BRUTE_F32, "quarantine", snap, g)
+            return None
         if kq > cagra.itopk:
             # the walk pool only ever holds itopk candidates; a deeper
             # overfetch must come from the exact matmul tier
             _HYB_C.labels("walk_fallback_itopk").inc()
+            self._ledger(tier, TIER_BRUTE_F32, "itopk_exceeded", snap, g)
             return None
         if g["shards"] != snap["shards"]:
             # lexical snapshot and graph must agree on the mesh layout
             # to run inside one shard_map program
             _HYB_C.labels("walk_fallback_shards").inc()
+            self._ledger(tier, TIER_BRUTE_F32, "shard_mismatch", snap, g)
             return None
         delta_ids, delta_vecs = cagra.delta_block(g)
         if delta_ids is None:
             # churn outran the brute changelog (rebuild in flight):
             # brute-fused serves exactly until the fresh graph lands
             _HYB_C.labels("walk_fallback_changelog").inc()
+            self._ledger(tier, TIER_BRUTE_F32, "changelog_overrun",
+                         snap, g)
             return None
         # staleness from the LIVE counter, read only after delta_block
         # drained the changelog (the same order as CagraIndex._resolve):
@@ -922,7 +985,7 @@ class FusedHybrid:
                 "stale": self.brute.mutations != g["built_mutations"],
                 "iters": g["iters"], "width": cagra.search_width,
                 "itopk": cagra.itopk, "hash_bits": cagra.hash_bits,
-                "n_seeds": cagra.n_seeds}
+                "n_seeds": cagra.n_seeds, "tier": tier}
 
     def _dispatch_walk(self, snap, wctx, lex_base, avgdl, qn, tail,
                        kq, b, delta, token_rows, extras, t_plan0):
@@ -1017,7 +1080,7 @@ class FusedHybrid:
             ls, lgrow, vs, vi, fs, fpos, kp,
             vec_delta=(wctx["delta_ids"], wctx["delta_vecs"]),
             vec_stale=wctx["stale"], qn=np.asarray(qn),
-            force_refuse=quant is not None)
+            force_refuse=quant is not None, tier=wctx["tier"])
         # under-fill veto: a stale graph's live-filter (or a walk miss)
         # can leave a row short of candidates the corpus does have —
         # those batches re-dispatch through the exact tier, the same
@@ -1028,6 +1091,8 @@ class FusedHybrid:
                 continue
             if len(row["vec"]) < min(int(e["n_cand"]), kp, alive_n):
                 _HYB_C.labels("walk_underfill_brute").inc()
+                self._ledger(wctx["tier"], TIER_BRUTE_F32, "underfill",
+                             snap, g)
                 return None
         # freshness/merge accounting only once the batch actually
         # serves from the walk tier — a vetoed batch re-dispatches
@@ -1130,7 +1195,7 @@ class FusedHybrid:
     def _decode(self, snap, vec_ids, delta, token_rows, extras,
                 ls, lgrow, vs, vi, fs, fpos, kq,
                 vec_delta=None, vec_stale=False, qn=None,
-                force_refuse=False):
+                force_refuse=False, tier=TIER_BRUTE_F32):
         """Decode one dispatch's device candidates into per-request
         ranked lists. ``vec_ids`` maps vector candidate ids to ext ids
         (the brute ext-id table for the matmul tier, graph ``row_ids``
@@ -1140,7 +1205,15 @@ class FusedHybrid:
         exact-scored (``qn @ delta_vecs``) and merged in, and any
         vector-side correction reroutes fusion through the
         bit-compatible host ``rrf_fuse`` — read-your-writes without a
-        graph rebuild."""
+        graph rebuild.
+
+        Every returned row carries ``served_by`` (obs/audit taxonomy):
+        ``tier`` when the device fuse answered, ``host`` for rows whose
+        freshness correction (live-filter drop, delta merge) forced the
+        host re-fuse — PER ROW, so one corrected rider in a coalesced
+        batch never relabels its batch-mates. The quant tiers' by-design
+        host re-fuse (``force_refuse``) keeps the quant tier label: the
+        exact rerank is the tier's contract, not a degrade."""
         row_ids = snap["row_ids"]
         d_ids, d_vecs = vec_delta if vec_delta is not None else ([], None)
         d_set = set(d_ids)
@@ -1154,6 +1227,7 @@ class FusedHybrid:
             cand.discard(None)
             live = self.brute.contains_many(cand)
         out: List[Optional[Dict[str, Any]]] = []
+        live_filtered_rows = 0
         for r in range(len(extras)):
             n_cand = int(extras[r]["n_cand"])
             lex_hits: List[Tuple[str, float]] = []
@@ -1193,6 +1267,7 @@ class FusedHybrid:
                 vec_hits = merge_delta_hits(vec_hits, d_ids,
                                             d_scores[r], n_cand)
                 vec_fixed = True
+            served_by = tier
             if delta:
                 # read-your-writes: exact host scores for post-snapshot
                 # docs, then the (bit-compatible) host fuse over the
@@ -1208,12 +1283,20 @@ class FusedHybrid:
                 fused = rrf_fuse([lex_hits, vec_hits],
                                  weights=list(extras[r]["w"]),
                                  k=self.rrf_k, limit=n_cand)
+                if not force_refuse:
+                    served_by = "host"
             elif vec_fixed:
                 # the device fuse saw the pre-correction vector list;
                 # re-fuse on host (bit-compatible) over the fixed lists
                 fused = rrf_fuse([lex_hits, vec_hits],
                                  weights=list(extras[r]["w"]),
                                  k=self.rrf_k, limit=n_cand)
+                if not force_refuse:
+                    # this rider's live-filter/delta correction routed
+                    # its fusion to the host — ITS tier is host, its
+                    # batch-mates keep the device tier
+                    served_by = "host"
+                    live_filtered_rows += 1
             else:
                 fused = []
                 for c in range(fs.shape[1]):
@@ -1226,7 +1309,12 @@ class FusedHybrid:
                         continue
                     fused.append((eid, float(fs[r, c])))
             out.append({"lex": lex_hits, "vec": vec_hits,
-                        "fused": fused})
+                        "fused": fused, "served_by": served_by})
+        if live_filtered_rows:
+            # one ledger record per batch for the rider-level host
+            # re-fuse (delta merges are routine read-your-writes and
+            # ride the delta_merge counter instead)
+            self._ledger(tier, "host", "live_filter", snap)
         return out
 
 

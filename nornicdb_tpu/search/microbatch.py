@@ -31,6 +31,7 @@ from nornicdb_tpu.obs import (
     record_dispatch,
     record_stage,
 )
+from nornicdb_tpu.obs import audit as _audit
 
 # one metric family set shared by every batcher instance (per-collection
 # MicroBatchers, the search service's, the upsert coalescer): the
@@ -183,7 +184,7 @@ class _Item:
 
 class _Req:
     __slots__ = ("vec", "k", "extra", "done", "result", "error",
-                 "dispatch_t0", "dispatch_t1", "batch_size")
+                 "dispatch_t0", "dispatch_t1", "batch_size", "tier")
 
     def __init__(self, vec: np.ndarray, k: int, extra: Any = None):
         self.vec = vec
@@ -197,6 +198,9 @@ class _Req:
         self.dispatch_t0 = 0.0
         self.dispatch_t1 = 0.0
         self.batch_size = 0
+        # serving-tier verdict of the batch that answered this request
+        # (leader consumes the dispatch path's audit.note_batch_tier)
+        self.tier: Any = None
 
 
 class MicroBatcher:
@@ -214,6 +218,7 @@ class MicroBatcher:
         pass_extras: bool = False,
         truncate: bool = True,
         surface: str = "search",
+        tier_surface: "str | None" = None,
     ):
         self._search_batch = search_batch
         self._max_batch = max_batch
@@ -221,6 +226,12 @@ class MicroBatcher:
         # "service:vector", "service:hybrid", "qdrant", ...) for the
         # nornicdb_request_stage_seconds{surface,stage} histograms
         self._surface = surface
+        # tier-attribution surface ("vector", ...): when set, each rider
+        # records nornicdb_served_tier_total/_seconds for the tier the
+        # dispatch path noted (audit.note_batch_tier) — rider-accurate
+        # counting without the batcher knowing the ladder. None = the
+        # caller above this batcher does its own (per-row) attribution.
+        self._tier_surface = tier_surface
         # pass_extras: dispatch as search_batch(queries, k, extras) with
         # one opaque per-request item (the hybrid path rides tokenized
         # query terms and per-request fusion options alongside the
@@ -319,6 +330,19 @@ class MicroBatcher:
         attach_span("device.dispatch", req.dispatch_t0, req.dispatch_t1,
                     surface=self._surface, batch=req.batch_size, k=req.k)
         attach_span("merge", req.dispatch_t1, t_done)
+        # rider-accurate serving-tier attribution (ISSUE 10): the tier
+        # the leader consumed from the dispatch path stamps THIS
+        # rider's count/latency/span, and the stage split re-records
+        # keyed by tier — which rung was slow, not just which surface
+        if self._tier_surface is not None and req.tier is not None:
+            _audit.record_served(self._tier_surface, req.tier,
+                                 seconds=t_done - t_enq)
+            _audit.record_tier_stages(
+                req.tier, req.dispatch_t0 - t_enq,
+                req.dispatch_t1 - req.dispatch_t0,
+                t_done - req.dispatch_t1)
+        # sampling call sites above the batcher read the verdict here
+        _audit.set_last_served(req.tier)
 
     def _run(self, batch: List[_Req]) -> None:
         try:
@@ -343,6 +367,7 @@ class MicroBatcher:
                     queries[0], (bucket - b,) + queries.shape[1:])
                 queries = np.concatenate([queries, pad], axis=0)
             t0 = time.time()
+            _audit.consume_batch_tier()  # clear any stale leader note
             if self._pass_extras:
                 # pad extras like the query rows: repeat request 0's
                 extras = [r.extra for r in batch]
@@ -351,10 +376,12 @@ class MicroBatcher:
             else:
                 results = self._search_batch(queries, k_max)
             t1 = time.time()
+            tier = _audit.consume_batch_tier()
             record_dispatch("microbatch", bucket, k_max, t1 - t0)
             for r, res in zip(batch, results):
                 r.dispatch_t0, r.dispatch_t1 = t0, t1
                 r.batch_size = b
+                r.tier = tier
                 if self._truncate:
                     r.result = res[: r.k] if r.k < k_max else res
                 else:
@@ -369,10 +396,12 @@ class MicroBatcher:
                     kb = pow2_bucket(max(r.k, 1))
                     r.dispatch_t0 = time.time()
                     q1 = np.asarray(r.vec, np.float32)[None, :]
+                    _audit.consume_batch_tier()
                     if self._pass_extras:
                         res = self._search_batch(q1, kb, [r.extra])[0]
                     else:
                         res = self._search_batch(q1, kb)[0]
+                    r.tier = _audit.consume_batch_tier()
                     r.dispatch_t1 = time.time()
                     r.batch_size = 1
                     record_dispatch("microbatch", 1, kb,

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from nornicdb_tpu.obs import REGISTRY, attach_span
+from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.search.bm25 import BM25Index, tokenize
 from nornicdb_tpu.search.hnsw import HNSWIndex
 from nornicdb_tpu.search.rrf import rrf_fuse
@@ -32,6 +33,10 @@ TEXT_PROPERTIES = ("content", "title", "name", "description", "text", "summary")
 _STRATEGY_C = REGISTRY.counter(
     "nornicdb_search_strategy_total",
     "Vector search dispatches by chosen strategy", labels=("strategy",))
+
+# tier-mix truth for result-cache hits (ISSUE 10): cached child — the
+# hit path must not pay a labels() probe per request
+_HYBRID_CACHED_SERVED = _audit.served_counter("hybrid", "cached")
 
 
 def _copy_tree(v):
@@ -172,9 +177,12 @@ class SearchService:
 
         # dispatch resolves the ACTIVE ANN index per batch (cagra once
         # built, else brute), so the coalescing window feeds whichever
-        # device index the strategy machine currently owns
+        # device index the strategy machine currently owns;
+        # tier_surface="vector" makes every rider record the serving
+        # tier the dispatch path noted (walk/quant/brute — ISSUE 10)
         self._microbatch = MicroBatcher(self._ann_search_batch,
-                                        surface="service:vector")
+                                        surface="service:vector",
+                                        tier_surface="vector")
         # fused hybrid pipeline (hybrid_fused.py): concurrent hybrid
         # searches coalesce here into ONE device dispatch that scores
         # BM25 + cosine + RRF end-to-end, instead of convoying on the
@@ -315,6 +323,7 @@ class SearchService:
         w = tuple(weights) if weights else (1.0, 1.0)
         if len(w) != 2:
             return None  # host rrf_fuse handles exotic weight shapes
+        t_ride = time.time()
         try:
             trio = self._hybrid_batch.search(
                 qv, overfetch,
@@ -327,6 +336,16 @@ class SearchService:
         tier = trio.get("tier", "brute")
         _STRATEGY_C.labels("hybrid_walk_fused" if tier == "walk"
                            else "hybrid_fused").inc()
+        # rider-accurate tier attribution: this ROW's served_by (a
+        # live-filter correction makes one rider "host" while its
+        # batch-mates keep the device tier), counted + latency-observed
+        # + stamped on the trace span (ISSUE 10)
+        served = trio.get("served_by", "hybrid_brute_f32")
+        _audit.record_served("hybrid", served,
+                             seconds=time.time() - t_ride)
+        if served != "host" and _audit.sampling_active():
+            self._maybe_shadow_hybrid(served, trio, query, qv,
+                                      overfetch, w)
         t = trio.get("times")
         if t:
             # the whole lexical+vector scoring ran inside one device
@@ -343,6 +362,42 @@ class SearchService:
             attach_span("fuse", t["device_t1"],
                         t["device_t1"] + t["decode_s"])
         return trio
+
+    def _maybe_shadow_hybrid(self, tier, trio, query, qv, overfetch, w):
+        """Offer one device-served hybrid answer to the shadow-parity
+        auditor. The reference closure re-runs the HOST hybrid path —
+        live BM25 scoring, exact brute vector scan, bit-compatible
+        rrf_fuse — on the audit worker thread, never on the hot path.
+        Best-effort: sampling must never fail a search."""
+        try:
+            device_ids = [i for i, _ in trio["fused"]]
+            bm25, vectors = self.bm25, self.vectors
+            weights = list(w)
+
+            def ref():
+                bm_hits = bm25.search(query, overfetch)
+                vec_hits = vectors.search_batch(
+                    qv[None, :], overfetch, exact=True)[0]
+                fused = rrf_fuse([bm_hits, vec_hits], weights=weights,
+                                 limit=overfetch)
+                return [i for i, _ in fused]
+
+            # the result-cache generation bumps on EVERY index mutation
+            # (text or vector), so it is the one version the replay-time
+            # staleness check needs: a write between sampling and the
+            # host reference run drops the sample instead of scoring a
+            # correct device answer as a mismatch
+            def versions_now():
+                return {"generation": self._result_cache.generation}
+
+            _audit.maybe_sample(
+                "hybrid", tier, device_ids, k=min(10, overfetch),
+                ref=ref, versions=versions_now(),
+                versions_now=versions_now,
+                query={"query": query, "overfetch": overfetch,
+                       "weights": weights})
+        except Exception:  # noqa: BLE001
+            pass
 
     def _clear_result_cache(self) -> None:
         self._result_cache.bump_generation()
@@ -729,12 +784,15 @@ class SearchService:
                 # device graph walk, micro-batched: concurrent b=1
                 # queries coalesce into one pow2-bucketed walk dispatch
                 _STRATEGY_C.labels("cagra").inc()
-                return self._microbatch.search(query_vec, k)
+                return self._vector_ride(query_vec, k)
             if hnsw is not None:
                 _STRATEGY_C.labels("hnsw").inc()
+                # host-resident graph index: the host tier by taxonomy
+                _audit.record_served("vector", "host")
                 return hnsw.search(query_vec, k)
         if lexical_doc_ids and hasattr(self.vectors, "route"):
             _STRATEGY_C.labels("ivf_route").inc()
+            _audit.record_served("vector", "host")
             return self.vectors.search(query_vec, k,
                                        lexical_doc_ids=lexical_doc_ids)
         if hasattr(self.vectors, "search_batch"):
@@ -744,14 +802,45 @@ class SearchService:
                 # build could answer an exact request approximately.
                 # Direct brute call (rare path: eval + exact=True).
                 _STRATEGY_C.labels("exact").inc()
+                _audit.record_served("vector", "vector_brute_f32")
                 return self.vectors.search_batch(
                     np.asarray([query_vec], dtype=np.float32), k,
                     exact=True)[0]
             # micro-batched: concurrent singles ride one device call
             _STRATEGY_C.labels("brute").inc()
-            return self._microbatch.search(query_vec, k)
+            return self._vector_ride(query_vec, k)
         _STRATEGY_C.labels("backend").inc()
+        _audit.record_served("vector", "host")
         return self.vectors.search(query_vec, k)  # IVF backends
+
+    def _vector_ride(self, query_vec, k: int):
+        """One coalesced vector ride. The MicroBatcher stamps the
+        serving tier (leader-consumed from the dispatch path) onto this
+        rider's count/span; on the way out the answer is offered to the
+        shadow-parity auditor with an exact-brute reference closure."""
+        hits = self._microbatch.search(query_vec, k)
+        if _audit.sampling_active():
+            tier = _audit.last_served()
+            if tier is not None and tier != "host":
+                try:
+                    qv = np.asarray(query_vec, dtype=np.float32)
+                    vectors = self.vectors
+
+                    def versions_now():
+                        return {"brute_mutations":
+                                getattr(vectors, "mutations", 0)}
+
+                    _audit.maybe_sample(
+                        "vector", tier, [i for i, _ in hits],
+                        k=min(10, k),
+                        ref=lambda: [i for i, _ in vectors.search_batch(
+                            qv[None, :], k, exact=True)[0]],
+                        versions=versions_now(),
+                        versions_now=versions_now,
+                        query={"k": k})
+                except Exception:  # noqa: BLE001
+                    pass
+        return hits
 
     def search(
         self,
@@ -797,6 +886,7 @@ class SearchService:
             cached = self._result_cache.get_hits(cache_key)
             if cached is not None:
                 self.stats.cache_hits += 1
+                _HYBRID_CACHED_SERVED.inc()
                 return cached
             gen_at_miss = self._result_cache.generation
         timings: Dict[str, float] = {}
@@ -815,12 +905,17 @@ class SearchService:
                 timings["embed_ms"] = (time.perf_counter() - t0) * 1e3
                 t0 = time.perf_counter()
         trio = None
-        if mode == "hybrid" and query and qv is not None \
-                and len(self.vectors) > 0:
+        trio_eligible = (mode == "hybrid" and bool(query)
+                         and qv is not None and len(self.vectors) > 0)
+        if trio_eligible:
             # fused device path: concurrent hybrid searches coalesce
             # into one compiled BM25+vector+RRF dispatch. None = the
             # pipeline isn't (yet/any longer) eligible — host serves.
             trio = self._fused_hybrid_trio(query, qv, overfetch, weights)
+            if trio is None:
+                # a fused-eligible query served by the host hybrid
+                # path: count the host tier so the mix stays truthful
+                _audit.record_served("hybrid", "host")
         if trio is not None:
             bm25_hits, vec_hits = trio["lex"], trio["vec"]
             if diag:
@@ -835,10 +930,20 @@ class SearchService:
                 timings["bm25_ms"] = (time.perf_counter() - t0) * 1e3
                 t0 = time.perf_counter()
             if qv is not None and len(self.vectors) > 0:
-                vec_hits = self.vector_search_candidates(
-                    qv, overfetch,
-                    lexical_doc_ids=[d for d, _ in bm25_hits[:32]],
-                )
+                if trio_eligible:
+                    # this query is already counted (hybrid host tier):
+                    # the nested vector ride is a sub-dispatch, not a
+                    # second served query — one query, one increment
+                    with _audit.suppress_attribution():
+                        vec_hits = self.vector_search_candidates(
+                            qv, overfetch,
+                            lexical_doc_ids=[d for d, _ in
+                                             bm25_hits[:32]])
+                else:
+                    vec_hits = self.vector_search_candidates(
+                        qv, overfetch,
+                        lexical_doc_ids=[d for d, _ in bm25_hits[:32]],
+                    )
             if diag:
                 timings["vector_ms"] = (time.perf_counter() - t0) * 1e3
                 t0 = time.perf_counter()

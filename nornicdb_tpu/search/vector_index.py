@@ -486,9 +486,16 @@ class BruteForceIndex:
         except Exception:  # noqa: BLE001 — degrade, never fail
             # counted: a persistent plane bug silently eating the
             # compression win must show up in quant_events_total
-            from nornicdb_tpu.search.device_quant import _QUANT_C
+            from nornicdb_tpu.obs import audit as _audit
+            from nornicdb_tpu.search.device_quant import (
+                _QUANT_C,
+                quant_mode,
+            )
 
             _QUANT_C.labels("degrade_error").inc()
+            _audit.record_degrade(
+                "vector", f"vector_{quant_mode()}", "vector_brute_f32",
+                "error", index=_cost.cost_name(self))
             return None
 
     def search_batch(
@@ -499,10 +506,17 @@ class BruteForceIndex:
         the quantized coarse+exact-rerank plane instead (answers remain
         exact-rescored float32; ``exact=True`` bypasses the plane for
         callers whose contract is exhaustive recall)."""
+        from nornicdb_tpu.obs import audit as _audit
+
         if not exact:
             out = self._quant_search_batch(queries, k)
             if out is not None:
                 return out
+        # serving-tier note for the batch leader (ISSUE 10): every
+        # return below — small-host numpy, XLA matmul, empty answer —
+        # is the exact float32 brute tier (the quant plane notes its
+        # own tier before returning above)
+        _audit.note_batch_tier("vector_brute_f32")
         with self._lock:
             if self._n_alive == 0:
                 return [[] for _ in range(len(queries))]

@@ -97,6 +97,14 @@ CHECKS: Dict[str, Tuple] = {
     "hybrid_walk_recall10": ("quality", 0.95, 0.02),
     "quant_recall10": ("quality", 0.95, 0.02),
     "hybrid_compile_buckets": ("growth", 2),
+    # shadow-parity auditor (round r10+): the load stage's worst
+    # rolling device/host parity per contract class. Exact tiers must
+    # replay the host reference bit-for-bit — anything below 1.0 is a
+    # wrong answer, not noise — and statistical tiers gate at their
+    # documented 0.95 floors. Quality checks gate ABSOLUTELY even when
+    # the baseline predates the metric (PR 6/8 precedent).
+    "shadow_parity_exact": ("quality", 1.0, 0.0),
+    "shadow_parity_statistical": ("quality", 0.95, 0.02),
 }
 
 
@@ -171,6 +179,14 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         load.get("p99_at_load_ms") if is_summary
         else _g(load, "surfaces", "qdrant_grpc_search",
                 "p99_at_load_ms"))
+    # shadow-parity verdicts (round r10+): worst rolling device/host
+    # parity per contract class from the load stage's sampled audit
+    out["shadow_parity_exact"] = _num(
+        load.get("shadow_parity_exact") if is_summary
+        else _g(load, "shadow_parity", "exact"))
+    out["shadow_parity_statistical"] = _num(
+        load.get("shadow_parity_statistical") if is_summary
+        else _g(load, "shadow_parity", "statistical"))
     surfaces = doc.get("surfaces") or {}
     for name in ("bolt", "neo4j_http", "graphql", "rest_search",
                  "qdrant_grpc"):

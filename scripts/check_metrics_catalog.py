@@ -71,23 +71,47 @@ def registered_families():
     return sorted(f.name for f in REGISTRY.families())
 
 
+def _documented(expanded: str, name: str) -> bool:
+    # word-boundary match: a plain substring test would let e.g. a
+    # new nornicdb_stage_seconds family ride inside the documented
+    # nornicdb_request_stage_seconds — the exact drift class this
+    # lint exists to catch (underscores are word chars, so \b only
+    # matches at the full-name edges)
+    return re.search(rf"\b{re.escape(name)}\b", expanded) is not None
+
+
 def missing_from_catalog(doc_text: str, families) -> list:
     expanded = _expand_braces(doc_text)
-
-    def documented(name: str) -> bool:
-        # word-boundary match: a plain substring test would let e.g. a
-        # new nornicdb_stage_seconds family ride inside the documented
-        # nornicdb_request_stage_seconds — the exact drift class this
-        # lint exists to catch (underscores are word chars, so \b only
-        # matches at the full-name edges)
-        return re.search(rf"\b{re.escape(name)}\b", expanded) is not None
-
     missing = []
     for name in families:
         short = name[len(_PREFIX):] if name.startswith(_PREFIX) else name
-        if not documented(short) and not documented(name):
+        if not _documented(expanded, short) \
+                and not _documented(expanded, name):
             missing.append(name)
     return missing
+
+
+def declared_dispatch_kinds():
+    """Dispatch kinds announced via obs.declare_kind at import time —
+    the compile-cache vocabulary the docs must carry."""
+    from nornicdb_tpu.obs.dispatch import bucket_counts
+
+    return sorted(bucket_counts().keys())
+
+
+def tier_vocabulary():
+    """(canonical tier names, normalized degrade reasons) from the
+    serving-truth taxonomy (obs/audit.py)."""
+    from nornicdb_tpu.obs import audit
+
+    return sorted(audit.ALL_TIERS), sorted(audit.REASONS)
+
+
+def missing_terms(doc_text: str, names) -> list:
+    """Vocabulary values (dispatch kinds, tier labels, degrade
+    reasons) with no word-boundary mention in the catalog."""
+    expanded = _expand_braces(doc_text)
+    return [n for n in names if not _documented(expanded, n)]
 
 
 def main(argv=None) -> int:
@@ -109,15 +133,31 @@ def main(argv=None) -> int:
     with open(doc_path, encoding="utf-8") as f:
         doc_text = f.read()
     missing = missing_from_catalog(doc_text, families)
+    # ISSUE 10: the serving-truth vocabularies are part of the catalog
+    # contract too — every declared dispatch kind, canonical tier
+    # label and normalized degrade reason must be documented
+    kinds = declared_dispatch_kinds()
+    tiers, reasons = tier_vocabulary()
+    missing_kinds = missing_terms(doc_text, kinds)
+    missing_tiers = missing_terms(doc_text, tiers)
+    missing_reasons = missing_terms(doc_text, reasons)
+    drift = bool(missing or missing_kinds or missing_tiers
+                 or missing_reasons)
     verdict = {
         "catalog_lint": True,
         "doc": os.path.relpath(doc_path, repo),
         "families": len(families),
+        "dispatch_kinds": len(kinds),
+        "tiers": len(tiers),
+        "reasons": len(reasons),
         "missing": missing,
-        "verdict": "drift" if missing else "pass",
+        "missing_kinds": missing_kinds,
+        "missing_tiers": missing_tiers,
+        "missing_reasons": missing_reasons,
+        "verdict": "drift" if drift else "pass",
     }
     print(json.dumps(verdict))
-    return 1 if missing else 0
+    return 1 if drift else 0
 
 
 if __name__ == "__main__":

@@ -405,6 +405,27 @@ class TestBenchDryRunArtifactSchema:
             assert sweep["knee_qps"] is not None and sweep["knee_qps"] > 0
             assert sweep["p99_at_load_ms"] is not None
             assert isinstance(sweep["queue_collapse_detected"], bool)
+            # serving-tier truth (ISSUE 10): every swept point carries
+            # its tier mix — fractions over the taxonomy's
+            # surface:tier keys, summing to ~1 when non-empty
+            for pt in sweep["points"]:
+                mix = pt["served_tiers"]
+                assert isinstance(mix, dict)
+                if mix:
+                    assert abs(sum(mix.values()) - 1.0) < 0.01
+                    for key in mix:
+                        assert ":" in key, key
+
+        # run-level tier mix + the shadow-parity verdict the sentinel
+        # gates: the tiny load run samples at 1/16, so the exact class
+        # must have been audited and must replay the host at 1.0
+        assert isinstance(load["served_tiers"], dict) and load["served_tiers"]
+        sp = load["shadow_parity"]
+        assert "error" not in sp, sp
+        assert set(sp) >= {"exact", "statistical", "sampled", "mismatches"}
+        assert sp["sampled"] >= 1
+        assert sp["mismatches"] == 0
+        assert sp["exact"] == 1.0
 
         # compact summary carries the floor too (driver tail window)
         assert summary["summary"] is True
@@ -418,6 +439,11 @@ class TestBenchDryRunArtifactSchema:
         assert summary["load"]["knee_qps"] > 0
         assert summary["load"]["p99_at_load_ms"] is not None
         assert isinstance(summary["load"]["collapse"], bool)
+        # serving-tier truth (ISSUE 10): the summary carries the tier
+        # mix and the shadow-parity verdicts the sentinel gates
+        assert isinstance(summary["load"]["served_tiers"], dict)
+        assert summary["load"]["shadow_parity_exact"] == 1.0
+        assert "shadow_parity_statistical" in summary["load"]
         assert len(lines[-1]) < 2200
 
 
