@@ -448,6 +448,27 @@ def _compact_summary(result):
             "collapse": g(result, "load", "surfaces",
                           "qdrant_grpc_search",
                           "queue_collapse_detected"),
+            # REST-surface knee (ISSUE 11): gated alongside the gRPC
+            # knee so a wire-plane win on one surface can't hide a
+            # collapse on the other
+            "knee_qps_rest": g(result, "load", "surfaces",
+                               "rest_search", "knee_qps"),
+            # multi-worker wire plane: gRPC knee and mean coalesced
+            # batch size per frontend-worker count (the "more
+            # frontends -> wider batches -> higher knee" claim)
+            "wire_mode": g(result, "load", "wire_workers", "mode"),
+            "wire_knee_qps": {
+                c: g(result, "load", "wire_workers", "per_count", c,
+                     "grpc", "knee_qps")
+                for c in ((g(result, "load", "wire_workers",
+                             "per_count") or {}).keys())},
+            # mean coalesced batch per count: the "more frontends ->
+            # wider batches" evidence, one number per count
+            "wire_batch_mean": {
+                c: g(result, "load", "wire_workers", "per_count", c,
+                     "batch_size_dist", "mean")
+                for c in ((g(result, "load", "wire_workers",
+                             "per_count") or {}).keys())},
             # serving-tier truth (ISSUE 10): what actually answered
             # under load, and the worst shadow parity per contract
             # class (the sentinel's absolute floors)
@@ -1216,9 +1237,41 @@ def _open_loop_sweep(factory, multipliers, duration_s: float,
     return asyncio.run(run())
 
 
+def _hist_state(name: str):
+    """Label-less histogram family snapshot (None when unregistered)."""
+    from nornicdb_tpu.obs import REGISTRY
+
+    fam = REGISTRY.get(name)
+    return fam.snapshot() if fam is not None else None
+
+
+def _batch_size_dist(name: str, before):
+    """Per-bucket delta of a batch-size histogram across one sweep —
+    the coalescing-quality evidence of the wire-worker sweep: batch
+    sizes should WIDEN as frontend count grows (ISSUE 11)."""
+    after = _hist_state(name)
+    if not after or before is None:
+        return None
+    counts = [a - b for a, b in zip(after["counts"], before["counts"])]
+    n = after["count"] - before["count"]
+    total = after["sum"] - before["sum"]
+    return {"buckets": [int(b) for b in after["buckets"]],
+            "counts": counts, "n": n,
+            "mean": round(total / n, 2) if n else None}
+
+
+def _sweep_brief(doc):
+    """The per-worker-count subset of a sweep doc the artifact keeps."""
+    if not isinstance(doc, dict):
+        return {"error": "sweep missing"}
+    return {k: doc.get(k) for k in
+            ("closed_loop_qps", "knee_qps", "p99_at_load_ms",
+             "knee_offered_qps", "queue_collapse_detected")}
+
+
 def _bench_load(tiny: bool = False, n_people: "int | None" = None,
                 duration_s: "float | None" = None, explicit_rates=None,
-                multipliers=None):
+                multipliers=None, worker_counts=None, wire_mode=None):
     """Open-loop load stage: Poisson arrivals against the REAL serving
     surfaces (qdrant gRPC Search and REST /nornicdb/search) through
     async clients. Emits offered-vs-achieved QPS, p50/p95/p99-at-load
@@ -1316,27 +1369,113 @@ def _bench_load(tiny: bool = False, n_people: "int | None" = None,
 
             return make()
 
-        out["surfaces"]["qdrant_grpc_search"] = _open_loop_sweep(
-            grpc_factory, multipliers, duration_s, calib_s, calib_conc,
-            max_arrivals, explicit_rates,
-            point_probe=_audit.tier_counts)
+        def grpc_factory_for(address):
+            def factory():
+                async def make():
+                    ach = grpc.aio.insecure_channel(address)
+                    stub = ach.unary_unary(
+                        "/qdrant.Points/Search",
+                        request_serializer=lambda b: b,
+                        response_deserializer=lambda b: b)
+
+                    async def send():
+                        await stub(sr_bytes)
+
+                    async def aclose():
+                        await ach.close()
+
+                    return send, aclose
+
+                return make()
+
+            return factory
 
         http_req = _LeanHttpClient.build(
             "/nornicdb/search", {"query": "topic1 person", "limit": 5})
 
-        def http_factory():
-            async def make():
-                pool = await _AsyncHttpPool(
-                    http.port, http_req,
-                    size=8 if tiny else 32).init()
-                return pool.send, pool.aclose
+        def http_factory_for(port):
+            def factory():
+                async def make():
+                    pool = await _AsyncHttpPool(
+                        port, http_req,
+                        size=8 if tiny else 32).init()
+                    return pool.send, pool.aclose
 
-            return make()
+                return make()
+
+            return factory
+
+        mb0 = _hist_state("nornicdb_microbatch_batch_size")
+        out["surfaces"]["qdrant_grpc_search"] = _open_loop_sweep(
+            grpc_factory_for(grpc_srv.address), multipliers, duration_s,
+            calib_s, calib_conc, max_arrivals, explicit_rates,
+            point_probe=_audit.tier_counts)
 
         out["surfaces"]["rest_search"] = _open_loop_sweep(
-            http_factory, multipliers, duration_s, calib_s, calib_conc,
-            max_arrivals, explicit_rates,
+            http_factory_for(http.port), multipliers, duration_s,
+            calib_s, calib_conc, max_arrivals, explicit_rates,
             point_probe=_audit.tier_counts)
+
+        # multi-worker wire-plane sweep (ISSUE 11): the SAME open-loop
+        # harness against NORNICDB_WIRE_WORKERS ∈ {1, 2, 4} frontends.
+        # Worker count 1 IS the single-process serving just measured —
+        # its numbers are reused, so the sweep adds only the plane
+        # runs. Each count records knee_qps per surface plus the batch
+        # size distribution its coalescer saw (microbatch for 1,
+        # broker for >= 2: coalescing must widen with more frontends).
+        counts = tuple(worker_counts) if worker_counts else (
+            (1, 2) if tiny else (1, 2, 4))
+        mode = wire_mode or os.environ.get(
+            "NORNICDB_WIRE_SWEEP_MODE") or (
+                "thread" if tiny else "process")
+        wire = {"mode": mode, "counts": [int(c) for c in counts],
+                "per_count": {}}
+        out["wire_workers"] = wire
+        for w in counts:
+            if w <= 1:
+                wire["per_count"]["1"] = {
+                    "grpc": _sweep_brief(
+                        out["surfaces"].get("qdrant_grpc_search")),
+                    "rest": _sweep_brief(
+                        out["surfaces"].get("rest_search")),
+                    "batch_size_dist": _batch_size_dist(
+                        "nornicdb_microbatch_batch_size", mb0),
+                }
+                continue
+            from nornicdb_tpu.api.wire_plane import WirePlane
+
+            plane = None
+            try:
+                plane = WirePlane(db, workers=int(w), mode=mode).start()
+                mbw = _hist_state("nornicdb_microbatch_batch_size")
+                br0 = _hist_state("nornicdb_broker_batch_size")
+                g_sweep = _open_loop_sweep(
+                    grpc_factory_for(plane.grpc_address), multipliers,
+                    duration_s, calib_s, calib_conc, max_arrivals,
+                    explicit_rates, point_probe=_audit.tier_counts)
+                r_sweep = _open_loop_sweep(
+                    http_factory_for(plane.http_port), multipliers,
+                    duration_s, calib_s, calib_conc, max_arrivals,
+                    explicit_rates, point_probe=_audit.tier_counts)
+                wire["per_count"][str(int(w))] = {
+                    "grpc": _sweep_brief(g_sweep),
+                    "rest": _sweep_brief(r_sweep),
+                    # device-facing coalescing quality: the shared
+                    # plane's MicroBatcher batch sizes during this
+                    # sweep (wider with more frontends is the claim)
+                    "batch_size_dist": _batch_size_dist(
+                        "nornicdb_microbatch_batch_size", mbw),
+                    # raw-embedding ring groups (OP_VEC), when the
+                    # nornic vector surface took part
+                    "ring_batch_dist": _batch_size_dist(
+                        "nornicdb_broker_batch_size", br0),
+                }
+            except Exception as exc:  # noqa: BLE001 — sweep must emit
+                wire["per_count"][str(int(w))] = {
+                    "error": f"{type(exc).__name__}: {exc}"[:300]}
+            finally:
+                if plane is not None:
+                    plane.stop()
     except Exception as exc:  # noqa: BLE001 — stage must always emit
         out["error"] = f"{type(exc).__name__}: {exc}"[:400]
     finally:

@@ -36,6 +36,7 @@ generated ``nornic_pb2`` and handlers are plain methods.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import errno
 import json
 import os
@@ -111,6 +112,63 @@ class SearchServicer:
         return pb.Hit(node_id=node_id, score=float(score),
                       payload_json=payload)
 
+    def _stream_search(self, executor):
+        """Batched streaming Search (ISSUE 11): one RPC, many queries.
+        A high-fanout client streams SearchRequests and reads
+        SearchResponses in order; the server gathers each arrival
+        burst (one short gather window, MicroBatcher-style) and
+        dispatches the whole burst concurrently on the executor, so
+        the rows coalesce into one device dispatch below — per-query
+        RPC overhead drops to one varint-framed message each way."""
+        max_batch = 64
+        gather_s = 0.0005
+        servicer = self
+
+        def one(data: bytes) -> bytes:
+            return servicer.Search(
+                pb.SearchRequest.FromString(data)).SerializeToString()
+
+        async def handler(request_iterator, context):
+            loop = asyncio.get_running_loop()
+            it = request_iterator.__aiter__()
+            pending = None
+            done = False
+            try:
+                while not done:
+                    if pending is None:
+                        pending = asyncio.ensure_future(it.__anext__())
+                    try:
+                        first = await pending
+                    except StopAsyncIteration:
+                        return
+                    pending = None
+                    batch = [first]
+                    while len(batch) < max_batch:
+                        pending = asyncio.ensure_future(it.__anext__())
+                        try:
+                            nxt = await asyncio.wait_for(
+                                asyncio.shield(pending), gather_s)
+                        except asyncio.TimeoutError:
+                            break  # burst over; keep pending for later
+                        except StopAsyncIteration:
+                            pending = None
+                            done = True
+                            break
+                        pending = None
+                        batch.append(nxt)
+                    outs = await asyncio.gather(*[
+                        loop.run_in_executor(
+                            executor, contextvars.copy_context().run,
+                            one, b)
+                        for b in batch])
+                    for out in outs:
+                        yield out
+            finally:
+                if pending is not None:
+                    pending.cancel()
+
+        return grpc.stream_stream_rpc_method_handler(handler)
+
     def handlers(self, wire=None, executor=None):
         svc = "nornic.v1.SearchService"
         # cached response bytes validate against the search service's
@@ -120,6 +178,7 @@ class SearchServicer:
             "Search": _unary_raw(self.Search, pb.SearchRequest,
                                  f"/{svc}/Search", wire, gen, executor,
                                  resp_cls=pb.SearchResponse),
+            "SearchStream": self._stream_search(executor),
             "Hybrid": _unary_raw(self.Hybrid, pb.HybridRequest,
                                  f"/{svc}/Hybrid", wire, gen, executor,
                                  resp_cls=pb.SearchResponse),
@@ -291,7 +350,8 @@ class GrpcServer:
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
                  max_workers: int = 8, auth_token: Optional[str] = None,
-                 snapshot_dir: Optional[str] = None):
+                 snapshot_dir: Optional[str] = None,
+                 search_servicer_cls=None, points_servicer_cls=None):
         from concurrent import futures
 
         from nornicdb_tpu.cache import WireCache
@@ -317,7 +377,10 @@ class GrpcServer:
         # coalesce across these threads via the compat layer's batchers
         self._executor = futures.ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="grpc-work")
-        self.search_servicer = SearchServicer(db)
+        # servicer classes are injectable so the wire-plane frontend
+        # workers (api/wire_plane.py) can serve the same method surface
+        # over broker-backed proxies with worker-optimized hot paths
+        self.search_servicer = (search_servicer_cls or SearchServicer)(db)
         self.qdrant_servicer = QdrantServicer(db.qdrant_compat)
         # official qdrant wire contract (qdrant.Collections / qdrant.Points)
         # alongside the native services — reference: pkg/qdrantgrpc serves
@@ -329,7 +392,8 @@ class GrpcServer:
         )
 
         self.official_collections = OfficialCollectionsServicer(db.qdrant_compat)
-        self.official_points = OfficialPointsServicer(db.qdrant_compat)
+        self.official_points = (
+            points_servicer_cls or OfficialPointsServicer)(db.qdrant_compat)
         self.official_snapshots = OfficialSnapshotsServicer(
             db.qdrant_compat, self.snapshot_dir)
         self._loop = asyncio.new_event_loop()

@@ -34,6 +34,22 @@ from nornicdb_tpu.storage.txn import TransactionManager
 SERVER_NAME = "nornicdb-tpu"
 API_VERSION = "1.0"
 
+
+class ReuseportThreadingHTTPServer(ThreadingHTTPServer):
+    """SO_REUSEPORT-bound ThreadingHTTPServer: the wire plane's
+    parallel frontend workers (ISSUE 11) share one listening port and
+    let the kernel balance accepted connections. Shared by HttpServer
+    (``reuse_port=True``) and the worker frontends (wire_plane.py)."""
+
+    daemon_threads = True
+
+    def server_bind(self):
+        import socket as _socket
+
+        self.socket.setsockopt(_socket.SOL_SOCKET,
+                               _socket.SO_REUSEPORT, 1)
+        ThreadingHTTPServer.server_bind(self)
+
 _HTTP_H = obs.REGISTRY.histogram(
     "nornicdb_http_request_seconds",
     "HTTP request latency by route family", labels=("route",))
@@ -221,10 +237,15 @@ class HttpServer:
     def __init__(self, db, host: str = "127.0.0.1", port: int = 7474,
                  authenticator=None, database_manager=None,
                  audit: Optional[AuditLog] = None,
-                 rate_limit_per_minute: int = 0):
+                 rate_limit_per_minute: int = 0,
+                 reuse_port: bool = False):
         self.db = db
         self.host = host
         self.port = port
+        # SO_REUSEPORT bind (ISSUE 11): parallel wire-plane frontend
+        # workers share one listening port; the kernel load-balances
+        # accepted connections across their listeners
+        self._reuse_port = reuse_port
         self.authenticator = authenticator
         self.database_manager = database_manager
         self.audit = audit or AuditLog(enabled=False)
@@ -448,7 +469,9 @@ class HttpServer:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        server_cls = (ReuseportThreadingHTTPServer if self._reuse_port
+                      else ThreadingHTTPServer)
+        self._server = server_cls((self.host, self.port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="http-server", daemon=True)

@@ -874,9 +874,12 @@ class QdrantCompat:
             def versions_now():
                 return {"brute_mutations": getattr(idx, "mutations", 0)}
 
+            # (id, score) pairs: exact tiers score tie-aware rank
+            # parity (padded-batch vs b=1 tie permutations are parity)
             _audit.maybe_sample(
-                "vector", tier, [i for i, _ in hits], k=min(10, k),
-                ref=lambda: [i for i, _ in idx.search_batch(
+                "vector", tier, [(i, float(s)) for i, s in hits],
+                k=min(10, k),
+                ref=lambda: [(i, float(s)) for i, s in idx.search_batch(
                     qv[None, :], k, exact=True)[0]],
                 versions=versions_now(), versions_now=versions_now,
                 query={"k": k})

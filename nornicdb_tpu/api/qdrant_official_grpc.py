@@ -31,7 +31,9 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import os
 import struct
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -47,6 +49,44 @@ _GRPC_H = obs.REGISTRY.histogram(
     "nornicdb_grpc_request_seconds",
     "gRPC request latency by method (both aio surfaces)",
     labels=("method",))
+
+# large-response serialization runs on THIS dedicated pool, not the
+# shared compute executor and never the event loop (ISSUE 11): a 10MB
+# Scroll page flattening to bytes must not occupy a coalescing compute
+# thread nor stall the loop's cache hits. Lazily built; responses under
+# the threshold keep serializing inline in their compute hop.
+_ser_pool = None
+_ser_lock = threading.Lock()
+
+
+def _serializer_pool():
+    global _ser_pool
+    if _ser_pool is None:
+        with _ser_lock:
+            if _ser_pool is None:
+                from concurrent import futures
+
+                _ser_pool = futures.ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="grpc-serialize")
+    return _ser_pool
+
+
+def serialize_offload_threshold() -> int:
+    """Responses whose ``ByteSize()`` exceeds this serialize on the
+    dedicated serializer pool (``NORNICDB_WIRE_SERIALIZE_OFFLOAD_BYTES``,
+    default 256KB; 0 offloads everything, -1 disables offload)."""
+    try:
+        return int(os.environ.get(
+            "NORNICDB_WIRE_SERIALIZE_OFFLOAD_BYTES", str(256 * 1024)))
+    except ValueError:
+        return 256 * 1024
+
+
+def _serialize_timed(out) -> bytes:
+    t0 = time.perf_counter()
+    data_out = out.SerializeToString()
+    obs.record_stage("grpc", "serialize", time.perf_counter() - t0)
+    return data_out
 
 
 def _iter_matching_points(compat, name: str, flt: Optional[Dict[str, Any]],
@@ -256,18 +296,23 @@ def aio_unary_raw(
             surf = "hybrid" if method.endswith("/Hybrid") else "vector"
             cached_served = obs.audit.served_counter(surf, "cached")
 
-    def serve(data: bytes) -> bytes:
+    # the offload threshold is resolved ONCE per handler build (server
+    # construction), not per response: a per-query os.environ read on
+    # the hottest surface costs real throughput (the PR 9 maybe_device
+    # pre-gate measured the same pattern at 8-12% of a 50us path)
+    def serve(data: bytes, _threshold=serialize_offload_threshold()):
         out = fn(data)
         if isinstance(out, bytes):
             return out
         # serialize stage: message -> wire bytes (the parse stage is
         # timed symmetrically in _parse); pre-serialized ack templates
-        # and cache hits return bytes above and skip both
-        t0 = time.perf_counter()
-        data_out = out.SerializeToString()
-        obs.record_stage("grpc", "serialize",
-                         time.perf_counter() - t0)
-        return data_out
+        # and cache hits return bytes above and skip both. LARGE
+        # responses return the message unflattened — the handler hops
+        # them to the dedicated serializer pool so neither the event
+        # loop nor a coalescing compute thread pays for the flatten.
+        if _threshold >= 0 and out.ByteSize() > _threshold:
+            return out
+        return _serialize_timed(out)
 
     latency = _GRPC_H.labels(method or "unknown")
 
@@ -302,6 +347,14 @@ def aio_unary_raw(
                         ).run_in_executor(executor, ctx.run, serve, data)
                 else:
                     out = serve(data)
+                if not isinstance(out, bytes):
+                    # over-threshold response: flatten on the
+                    # serializer pool — the loop awaits, it never
+                    # serializes (pinned by the 10MB loop-block test)
+                    ctx = contextvars.copy_context()
+                    out = await asyncio.get_running_loop(
+                        ).run_in_executor(_serializer_pool(), ctx.run,
+                                          _serialize_timed, out)
             except error_cls as e:
                 latency.observe(time.time() - t0)
                 await context.abort(grpc_status_of(e), str(e))

@@ -1,0 +1,150 @@
+"""Zero-object protobuf assembly for the hot qdrant search replies.
+
+The response side of the wire plane (ISSUE 11): a frontend worker that
+just received ranked point dicts from the device plane should not pay
+for building a ``SearchResponse`` object graph (one ``ScoredPoint``,
+one ``PointId``, N ``Value`` messages per hit) only to flatten it
+right back to bytes. This module emits the wire encoding directly —
+varints, tags and raw little-endian floats spliced around the data —
+producing bytes that ``SearchResponse.FromString`` parses identically
+to the protobuf-built message (pinned by test against the message
+classes themselves).
+
+Field numbers mirror ``api/proto/qdrant.proto`` (the upstream qdrant
+package contract): SearchResponse{result=1, time=2},
+ScoredPoint{id=1, payload=2, score=3, version=5, vectors=6},
+PointId{num=1, uuid=2}, Value oneof{null=1, double=2, integer=3,
+string=4, bool=5, struct=6, list=7}, Vectors{vector=1}/Vector{data=1}.
+
+Scalar-heavy payloads (the serving-shaped workload) encode in one pass
+with no intermediate message objects; the cached-template discipline
+of PR 1's ack templates generalizes: a worker holds only bytes.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence
+
+_F32 = struct.Struct("<f")
+_F64 = struct.Struct("<d")
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _varint(n: int) -> bytes:
+    """Unsigned LEB128. Negative ints are 64-bit two's complement (the
+    protobuf int64 contract: always 10 bytes)."""
+    if n < 0:
+        n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _tag(field, _LEN) + _varint(len(payload)) + payload
+
+
+def encode_value(x: Any) -> bytes:
+    """qdrant ``Value`` message bytes for one JSON-shaped payload
+    value (None/bool/int/float/str/dict/list; anything else encodes as
+    its ``str()``, matching ``py_to_value``)."""
+    if x is None:
+        return _tag(1, _VARINT) + b"\x00"            # null_value = 0
+    if isinstance(x, bool):                          # before int!
+        return _tag(5, _VARINT) + (b"\x01" if x else b"\x00")
+    if isinstance(x, int):
+        return _tag(3, _VARINT) + _varint(x)
+    if isinstance(x, float):
+        return _tag(2, _I64) + _F64.pack(x)
+    if isinstance(x, str):
+        raw = x.encode("utf-8")
+        return _len_delim(4, raw)
+    if isinstance(x, dict):
+        if not x:
+            # py_to_value({}) leaves the oneof unset — an empty Value
+            return b""
+        fields = bytearray()
+        for k, v in x.items():
+            entry = (_len_delim(1, str(k).encode("utf-8"))
+                     + _len_delim(2, encode_value(v)))
+            fields += _len_delim(1, entry)           # Struct.fields map
+        return _len_delim(6, bytes(fields))
+    if isinstance(x, (list, tuple)):
+        items = bytearray()
+        for v in x:
+            items += _len_delim(1, encode_value(v))  # ListValue.values
+        return _len_delim(7, bytes(items))
+    raw = str(x).encode("utf-8")
+    return _len_delim(4, raw)
+
+
+def encode_point_id(pid: Any) -> bytes:
+    """PointId bytes: numeric ids round-trip as the ``num`` form the
+    client upserted, everything else as ``uuid`` (py_to_point_id)."""
+    try:
+        return _tag(1, _VARINT) + _varint(int(pid))
+    except (TypeError, ValueError):
+        return _len_delim(2, str(pid).encode("utf-8"))
+
+
+def encode_vector(vec: Sequence[float]) -> bytes:
+    """``Vectors{vector{data=[...]}}`` with the float rows packed as one
+    raw little-endian run (proto3 packed repeated float)."""
+    import numpy as np
+
+    raw = np.asarray(vec, dtype="<f4").tobytes()
+    inner = _tag(1, _LEN) + _varint(len(raw)) + raw  # Vector.data packed
+    return _len_delim(1, inner)                      # Vectors.vector
+
+
+def encode_scored_point(d: Dict[str, Any]) -> bytes:
+    """One ``ScoredPoint`` from a compat point dict
+    (``{"id", "score", "payload", "vector"}``)."""
+    out = bytearray()
+    out += _len_delim(1, encode_point_id(d["id"]))
+    for k, v in (d.get("payload") or {}).items():
+        entry = (_len_delim(1, str(k).encode("utf-8"))
+                 + _len_delim(2, encode_value(v)))
+        out += _len_delim(2, entry)                  # payload map
+    score = float(d.get("score", 0.0))
+    if score != 0.0:
+        out += _tag(3, _I32) + _F32.pack(score)
+    # version=5 stays at its default (0): proto3 omits defaults
+    if d.get("vector") is not None:
+        out += _len_delim(6, encode_vector(d["vector"]))
+    return bytes(out)
+
+
+def encode_search_response(points: List[Dict[str, Any]],
+                           time_s: Optional[float] = None) -> bytes:
+    """``SearchResponse`` bytes straight from point dicts. With
+    ``time_s=None`` the ``time`` field is left for the caller to append
+    (scalar fields are last-wins on the wire — the ack-template /
+    wire-cache freshness trick), so the hot path can cache the prefix
+    and splice only the 9-byte time tail per reply."""
+    out = bytearray()
+    for d in points:
+        out += _len_delim(1, encode_scored_point(d))
+    if time_s is not None:
+        out += _tag(2, _I64) + _F64.pack(time_s)
+    return bytes(out)
+
+
+TIME_TAG = _tag(2, _I64)  # SearchResponse.time: field 2, 64-bit
+
+
+def append_time(prefix: bytes, time_s: float) -> bytes:
+    return prefix + TIME_TAG + _F64.pack(time_s)

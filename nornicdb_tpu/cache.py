@@ -94,11 +94,34 @@ class GenerationalCache(LRUCache[V]):
     def __init__(self, max_size: int = 1024, ttl_seconds: float = 0.0):
         super().__init__(max_size, ttl_seconds)
         self._generation = 0
+        # optional write-through mirror: the multi-worker wire plane
+        # (ISSUE 11) publishes the generation into shared memory so
+        # frontend workers in OTHER processes validate their wire
+        # caches against the live value without a broker round trip
+        self._gen_mirror = None
+
+    def set_generation_mirror(self, fn) -> None:
+        """``fn(generation)`` invoked on every bump (and once at
+        registration with the current value). Pass None to detach."""
+        self._gen_mirror = fn
+        if fn is not None:
+            try:
+                fn(self.generation)
+            except Exception:  # noqa: BLE001 — mirror must not break writes
+                pass
 
     def bump_generation(self) -> None:
         with self._lock:
             self._generation += 1
             self._data.clear()
+            # publish under the SAME lock: two racing bumps must hit
+            # the mirror in generation order, or the shared-memory
+            # value could regress and validate stale worker entries
+            if self._gen_mirror is not None:
+                try:
+                    self._gen_mirror(self._generation)
+                except Exception:  # noqa: BLE001 — never break writes
+                    pass
 
     @property
     def generation(self) -> int:

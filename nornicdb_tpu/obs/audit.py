@@ -149,6 +149,7 @@ REASONS: Tuple[str, ...] = (
     "live_filter",         # tombstone correction forced a host re-fuse
     "error",               # caught exception on the device path
     "quarantine",          # shadow-parity auditor stepped the tier down
+    "broker_timeout",      # shared device plane missed the rider deadline
 )
 
 # legacy event label value -> normalized reason. One table so the old
@@ -426,10 +427,51 @@ def record_degrade(surface: str, from_tier: str, to_tier: str,
     if tid is not None:
         rec["trace_id"] = tid
     LEDGER.record(rec)
+    # a broker op capture in flight on this thread (ISSUE 11): the
+    # record also ships back to the frontend worker that owns the
+    # query, so its /admin/degrades stays truthful across the
+    # process boundary
+    collector = getattr(_tls, "degrade_collector", None)
+    if collector is not None:
+        collector.append(dict(rec))
     # graft into the owning trace: a degraded request's span tree
     # answers "why was this served from a lower rung" on its own
     attach_span("degrade", now, now, surface=surface,
                 from_tier=from_tier, to_tier=to_tier, reason=r)
+
+
+class _DegradeCollector:
+    """Thread-local capture of degrade records produced while a broker
+    op executes on a device-plane pool thread — the records ride the
+    op's response back to the frontend worker (ISSUE 11)."""
+
+    __slots__ = ("_prev", "records")
+
+    def __init__(self) -> None:
+        self.records: List[Dict[str, Any]] = []
+
+    def __enter__(self) -> List[Dict[str, Any]]:
+        self._prev = getattr(_tls, "degrade_collector", None)
+        _tls.degrade_collector = self.records
+        return self.records
+
+    def __exit__(self, *exc) -> None:
+        _tls.degrade_collector = self._prev
+
+
+def collect_degrades() -> _DegradeCollector:
+    return _DegradeCollector()
+
+
+def replay_degrade(rec: Dict[str, Any]) -> None:
+    """Frontend-worker side of the boundary crossing: append a degrade
+    record relayed from the device plane to THIS process's ledger ring
+    (marked ``via: broker``). The counter is NOT re-incremented — the
+    worker's /metrics aggregation already carries the shared plane's
+    ``nornicdb_degrade_total`` exactly once."""
+    if not _m.enabled():
+        return
+    LEDGER.record({**rec, "via": "broker"})
 
 
 def degrade_snapshot(limit: int = 100) -> List[Dict[str, Any]]:
@@ -683,18 +725,51 @@ class ShadowAuditor:
     def parity_of(device_ids: Sequence[Any], host_ids: Sequence[Any],
                   k: int, exact: bool) -> float:
         """Rank-parity (exact tiers) or recall@k (statistical tiers) of
-        a device answer vs the host reference, both ranked id lists."""
+        a device answer vs the host reference, both ranked id lists.
+
+        Entries may be ``(id, score)`` pairs. For EXACT tiers that
+        enables tie-aware rank parity: a position matches when the ids
+        agree OR the scores are identical and the device id belongs to
+        the host's same-score tie group — the device contract is "same
+        scores, same membership at every score level", and a padded-
+        batch dispatch may legitimately permute rows WITHIN an exact
+        tie relative to the b=1 replay (ISSUE 11: surfaced by the
+        wire-plane load run; ids-only exact samples keep the strict
+        positional contract). Statistical tiers always compare ids."""
+
+        def _pair(x):
+            if isinstance(x, (tuple, list)) and len(x) == 2:
+                return x[0], float(x[1])
+            return x, None
+
         kk = min(k, len(host_ids)) if host_ids else 0
         if kk == 0:
             # host found nothing: the device agreeing (also nothing)
             # is parity 1, anything extra is a mismatch
             return 1.0 if not list(device_ids)[:k] else 0.0
-        d = list(device_ids)[:kk]
-        h = list(host_ids)[:kk]
+        d = [_pair(x) for x in list(device_ids)[:kk]]
+        h = [_pair(x) for x in list(host_ids)[:kk]]
         if exact:
-            same = sum(1 for a, b in zip(d, h) if a == b)
+            host_full = [_pair(x) for x in host_ids]
+            tie_groups: Dict[float, set] = {}
+            for hid, hs in host_full:
+                if hs is not None:
+                    tie_groups.setdefault(hs, set()).add(hid)
+            # a tie group the host list was truncated INSIDE (its last
+            # entry carries the group's score) has unobservable
+            # membership beyond the cutoff: score equality is all the
+            # sample can check there
+            tail_score = host_full[-1][1] if host_full else None
+            same = 0
+            for (di, ds), (hi, hs) in zip(d, h):
+                if di == hi:
+                    same += 1
+                elif ds is not None and hs is not None and ds == hs \
+                        and (di in tie_groups.get(ds, ())
+                             or ds == tail_score):
+                    same += 1
             return same / kk
-        return len(set(d) & set(h)) / kk
+        return len({i for i, _ in d} & {i for i, _ in h}) / kk
 
     def _process(self, item: Dict[str, Any]) -> None:
         surface, tier = item["surface"], item["tier"]
@@ -879,6 +954,10 @@ class ShadowAuditor:
 def _jsonable_ids(ids: Sequence[Any]) -> List[Any]:
     out = []
     for i in ids:
+        if isinstance(i, (tuple, list)) and len(i) == 2:
+            # (id, score) pair from a tie-aware exact sample
+            i = [i[0] if isinstance(i[0], (str, int)) else str(i[0]),
+                 float(i[1])]
         try:
             json.dumps(i)
             out.append(i)

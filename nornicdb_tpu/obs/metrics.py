@@ -555,6 +555,104 @@ def get_registry() -> Registry:
     return REGISTRY
 
 
+def dump_state(registry: Optional[Registry] = None) -> List[Dict]:
+    """Picklable snapshot of every family (collectors run first) — the
+    device plane ships this across the broker so each frontend
+    worker's /metrics scrape can include the shared-plane series
+    exactly once (ISSUE 11). Shape per family: ``{"name", "kind",
+    "help", "labels", "children": {label_tuple: float |
+    histogram-snapshot}}``."""
+    reg = registry if registry is not None else REGISTRY
+    reg.run_collectors()
+    out: List[Dict] = []
+    for fam in reg.families():
+        children: Dict[Tuple[str, ...], object] = {}
+        for key, child in fam.children().items():
+            if fam.kind == "histogram":
+                children[key] = child.snapshot()
+            else:
+                children[key] = float(child.value)
+        out.append({"name": fam.name, "kind": fam.kind, "help": fam.help,
+                    "labels": tuple(fam.label_names),
+                    "children": children})
+    return out
+
+
+def render_merged(remote_states: Sequence[List[Dict]],
+                  registry: Optional[Registry] = None,
+                  extra_gauges: Optional[Dict[str, float]] = None) -> str:
+    """Classic Prometheus exposition of the LOCAL registry merged with
+    remote ``dump_state`` snapshots. Merge discipline (the "exactly
+    once" contract of the multi-worker wire plane):
+
+    - counters and histograms SUM per label tuple — a family the
+      worker registered at import but never observed contributes 0, so
+      the shared plane's series appear once with the true value;
+    - gauges: the remote (shared-plane) value wins on a label-tuple
+      conflict — index memory/freshness/compile-universe gauges are
+      owned by the device plane, a worker-local zero must not mask
+      them — and union otherwise.
+    """
+    reg = registry if registry is not None else REGISTRY
+    merged: Dict[str, Dict] = {}
+    for fam_state in dump_state(reg):
+        merged[fam_state["name"]] = {
+            **fam_state, "children": dict(fam_state["children"])}
+    for state in remote_states:
+        for fam in state:
+            mine = merged.get(fam["name"])
+            if mine is None or mine["kind"] != fam["kind"]:
+                merged[fam["name"]] = {
+                    **fam, "children": dict(fam["children"])}
+                continue
+            for key, rv in fam["children"].items():
+                lv = mine["children"].get(key)
+                if lv is None:
+                    mine["children"][key] = rv
+                elif fam["kind"] == "counter":
+                    mine["children"][key] = float(lv) + float(rv)
+                elif fam["kind"] == "gauge":
+                    mine["children"][key] = rv  # shared plane wins
+                else:  # histogram: sum counts when bounds agree
+                    if lv["buckets"] == rv["buckets"]:
+                        mine["children"][key] = {
+                            "buckets": lv["buckets"],
+                            "counts": [a + b for a, b in
+                                       zip(lv["counts"], rv["counts"])],
+                            "sum": lv["sum"] + rv["sum"],
+                            "count": lv["count"] + rv["count"]}
+                    else:
+                        mine["children"][key] = rv
+    out: List[str] = []
+    for name in sorted(merged):
+        fam = merged[name]
+        out.append(f"# HELP {name} {fam['help']}")
+        out.append(f"# TYPE {name} {fam['kind']}")
+        label_names = tuple(fam["labels"])
+        for key in sorted(fam["children"]):
+            val = fam["children"][key]
+            if fam["kind"] == "histogram":
+                cum = 0
+                for bound, c in zip(val["buckets"], val["counts"]):
+                    cum += c
+                    lbl = _fmt_labels(label_names, key,
+                                      ("le", _fmt_float(bound)))
+                    out.append(f"{name}_bucket{lbl} {cum}")
+                cum += val["counts"][-1]
+                lbl = _fmt_labels(label_names, key, ("le", "+Inf"))
+                out.append(f"{name}_bucket{lbl} {cum}")
+                base = _fmt_labels(label_names, key)
+                out.append(f"{name}_sum{base} {_fmt_float(val['sum'])}")
+                out.append(f"{name}_count{base} {val['count']}")
+            else:
+                lbl = _fmt_labels(label_names, key)
+                out.append(f"{name}{lbl} {_fmt_float(val)}")
+    for name, value in sorted((extra_gauges or {}).items()):
+        out.append(f"# TYPE {name} gauge")
+        out.append(f"{name} {_fmt_float(value)}")
+    return "\n".join(out) + "\n"
+
+
 def latency_summary(registry: Optional[Registry] = None,
                     quantiles: Sequence[float] = (0.5, 0.95, 0.99),
                     include_empty: bool = False,

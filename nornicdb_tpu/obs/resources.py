@@ -95,7 +95,14 @@ def register(family: str, name: str, obj: Any) -> None:
     """Track one index/queue object for resource accounting. The object
     must expose ``resource_stats() -> dict`` (indexes) or
     ``queue_depth() -> int`` (queues). Registration replaces any prior
-    object under the same (family, name) — index reloads re-register."""
+    object under the same (family, name) — index reloads re-register —
+    and re-registering the SAME object is a no-op, so a second wire
+    worker booting over shared structures (ISSUE 11) can never churn
+    the weakref or momentarily drop the series from a racing scrape."""
+    with _lock:
+        prior = _objects.get((str(family), str(name)))
+        if prior is not None and prior() is obj:
+            return
     try:
         # stamp the registration identity so the cost accounting
         # (obs/cost.py) labels per-dispatch prices with the same name

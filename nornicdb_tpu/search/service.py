@@ -830,11 +830,17 @@ class SearchService:
                         return {"brute_mutations":
                                 getattr(vectors, "mutations", 0)}
 
+                    # (id, score) pairs: exact tiers score TIE-AWARE
+                    # rank parity (a padded-batch dispatch may permute
+                    # rows within an exact score tie vs the b=1 replay)
                     _audit.maybe_sample(
-                        "vector", tier, [i for i, _ in hits],
+                        "vector", tier,
+                        [(i, float(s)) for i, s in hits],
                         k=min(10, k),
-                        ref=lambda: [i for i, _ in vectors.search_batch(
-                            qv[None, :], k, exact=True)[0]],
+                        ref=lambda: [
+                            (i, float(s)) for i, s in
+                            vectors.search_batch(
+                                qv[None, :], k, exact=True)[0]],
                         versions=versions_now(),
                         versions_now=versions_now,
                         query={"k": k})

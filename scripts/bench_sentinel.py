@@ -77,6 +77,9 @@ CHECKS: Dict[str, Tuple] = {
     # future batching/admission PRs are held to — lower is better, so
     # it flags when fresh > tolerance x baseline
     "load_knee_qps": ("qps", 0.2),
+    # REST-surface knee (round r11+): same contended-box caveat as the
+    # gRPC knee — the wire plane must lift BOTH surfaces, so both gate
+    "load_knee_qps_rest": ("qps", 0.2),
     "load_p99_at_load_ms": ("latency", 5.0),
     # quantization ladder (round r08+): int8-rung serving qps floor
     # once a quant-carrying baseline exists; the WORST rung's recall@10
@@ -179,6 +182,17 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         load.get("p99_at_load_ms") if is_summary
         else _g(load, "surfaces", "qdrant_grpc_search",
                 "p99_at_load_ms"))
+    # REST knee + closed-loop calibrations (round r11+): the closed
+    # loops feed the knee-vs-closed-loop ratio WARNING (open-loop knee
+    # under half the closed-loop rate means the surface still queues
+    # badly — ROADMAP item 3's "within 2x of closed-loop" target)
+    out["load_knee_qps_rest"] = _num(
+        load.get("knee_qps_rest") if is_summary
+        else _g(load, "surfaces", "rest_search", "knee_qps"))
+    out["load_closed_loop_qps"] = _num(
+        _g(load, "surfaces", "qdrant_grpc_search", "closed_loop_qps"))
+    out["load_closed_loop_qps_rest"] = _num(
+        _g(load, "surfaces", "rest_search", "closed_loop_qps"))
     # shadow-parity verdicts (round r10+): worst rolling device/host
     # parity per contract class from the load stage's sampled audit
     out["shadow_parity_exact"] = _num(
@@ -325,6 +339,20 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
                     "ratio": round(f / b, 3), "tolerance": tol})
             else:
                 passed.append(metric)
+    # knee-vs-closed-loop ratio WARNINGS (round r11+): advisory only —
+    # a knee below half the same run's closed-loop rate says the
+    # surface still collapses under open-loop arrivals even if the
+    # absolute floor passed. Never flips the verdict.
+    warnings: List[Dict[str, Any]] = []
+    for surface, knee_key, cl_key in (
+            ("qdrant_grpc", "load_knee_qps", "load_closed_loop_qps"),
+            ("rest", "load_knee_qps_rest", "load_closed_loop_qps_rest")):
+        knee = fresh.get(knee_key)
+        cl = fresh.get(cl_key)
+        if knee is not None and cl and cl > 0 and knee / cl < 0.5:
+            warnings.append({
+                "kind": "knee_vs_closed_loop", "surface": surface,
+                "ratio": round(knee / cl, 3), "warn_below": 0.5})
     return {
         "sentinel": True,
         "verdict": "regression" if flagged else "pass",
@@ -333,6 +361,7 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
         "flagged": flagged,
         "skipped": sorted(skipped),
         "missing_vs_baseline": missing,
+        "warnings": warnings,
     }
 
 

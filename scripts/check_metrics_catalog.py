@@ -36,6 +36,7 @@ import sys
 IMPORT_TIME_MODULES = (
     "nornicdb_tpu.obs",            # dispatch, stages, cost families
     "nornicdb_tpu.search.microbatch",
+    "nornicdb_tpu.search.broker",  # wire-plane broker families (ISSUE 11)
     "nornicdb_tpu.search.service",
     "nornicdb_tpu.search.cagra",
     "nornicdb_tpu.search.device_bm25",

@@ -52,6 +52,14 @@ def main(argv=None) -> int:
                          "with --tiny)")
     ap.add_argument("--tiny", action="store_true",
                     help="dry-run shape: toy corpus, 2-point sweep")
+    ap.add_argument("--workers", nargs="*", type=int, default=None,
+                    help="wire-plane frontend-worker counts to sweep "
+                         "(default 1 2 4, or 1 2 with --tiny); 1 is "
+                         "the single-process baseline")
+    ap.add_argument("--wire-mode", choices=("process", "thread"),
+                    default=None,
+                    help="wire-plane worker mode for counts >= 2 "
+                         "(default: process, thread with --tiny)")
     args = ap.parse_args(argv)
 
     # the harness lives in bench.py (one implementation for the bench
@@ -67,6 +75,8 @@ def main(argv=None) -> int:
         explicit_rates=args.rates,
         multipliers=(tuple(args.multipliers)
                      if args.multipliers is not None else None),
+        worker_counts=args.workers,
+        wire_mode=args.wire_mode,
     )}
     print(json.dumps(doc))
     load = doc["load"]
@@ -79,6 +89,15 @@ def main(argv=None) -> int:
             f"knee {sweep['knee_qps']} qps, "
             f"p99@load {sweep['p99_at_load_ms']} ms, "
             f"collapse={sweep['queue_collapse_detected']}\n")
+    wire = load.get("wire_workers") or {}
+    for c, per in sorted((wire.get("per_count") or {}).items(),
+                         key=lambda kv: int(kv[0])):
+        gk = (per.get("grpc") or {}).get("knee_qps")
+        rk = (per.get("rest") or {}).get("knee_qps")
+        bm = (per.get("batch_size_dist") or {}).get("mean")
+        sys.stderr.write(
+            f"wire workers={c} ({wire.get('mode')}): grpc knee {gk} "
+            f"qps, rest knee {rk} qps, mean batch {bm}\n")
     return 0
 
 

@@ -416,6 +416,25 @@ class TestBenchDryRunArtifactSchema:
                     for key in mix:
                         assert ":" in key, key
 
+        # multi-worker wire-plane sweep (ISSUE 11): tiny mode sweeps
+        # worker counts {1, 2} (thread mode); each count carries both
+        # surfaces' knee brief plus the batch-size distribution
+        wire = load["wire_workers"]
+        assert wire["mode"] in ("thread", "process")
+        assert wire["counts"] == [1, 2]
+        for count in ("1", "2"):
+            per = wire["per_count"][count]
+            assert "error" not in per, per
+            for surf in ("grpc", "rest"):
+                assert per[surf]["knee_qps"] is not None, (count, surf)
+                assert per[surf]["closed_loop_qps"] > 0
+            dist = per["batch_size_dist"]
+            assert dist is not None and dist["n"] >= 0
+            assert len(dist["counts"]) == len(dist["buckets"]) + 1
+        # worker count 1 IS the single-process sweep just measured
+        assert (wire["per_count"]["1"]["grpc"]["knee_qps"]
+                == load["surfaces"]["qdrant_grpc_search"]["knee_qps"])
+
         # run-level tier mix + the shadow-parity verdict the sentinel
         # gates: the tiny load run samples at 1/16, so the exact class
         # must have been audited and must replay the host at 1.0
@@ -444,7 +463,12 @@ class TestBenchDryRunArtifactSchema:
         assert isinstance(summary["load"]["served_tiers"], dict)
         assert summary["load"]["shadow_parity_exact"] == 1.0
         assert "shadow_parity_statistical" in summary["load"]
-        assert len(lines[-1]) < 2200
+        # wire-plane trio (ISSUE 11): REST knee + knee/batch per count
+        assert summary["load"]["knee_qps_rest"] > 0
+        assert set(summary["load"]["wire_knee_qps"]) == {"1", "2"}
+        assert summary["load"]["wire_knee_qps"]["2"] is not None
+        assert "wire_batch_mean" in summary["load"]
+        assert len(lines[-1]) < 2600
 
 
 class TestTpuProofDryRun:
@@ -518,7 +542,7 @@ class TestBenchSentinelGate:
                        "hybrid_walk_qps_b16", "hybrid_walk_recall10",
                        "quant_qps_b16", "quant_recall10",
                        "surface_qdrant_grpc_qps", "load_knee_qps",
-                       "load_p99_at_load_ms"):
+                       "load_knee_qps_rest", "load_p99_at_load_ms"):
             assert metric in saved["metrics"], metric
         rc, docs = self._run_sentinel(
             artifact, ["--baseline", str(base), "--emit-summary"])
@@ -598,6 +622,39 @@ class TestBenchSentinelGate:
             artifact, ["--baseline", str(base)])
         assert rc == 0
         assert "load_p99_at_load_ms" in docs[0]["passed"]
+
+    def test_knee_vs_closed_loop_ratio_warns_never_fails(
+            self, tmp_path):
+        """ISSUE 11: an open-loop knee under half the same run's
+        closed-loop rate is ADVISORY — it lands in the verdict's
+        warnings, the exit code stays 0."""
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps({
+            "sentinel_baseline": True,
+            "metrics": {"load_knee_qps": 400.0,
+                        "load_knee_qps_rest": 3000.0}}))
+        fresh = json.dumps({"load": {"surfaces": {
+            "qdrant_grpc_search": {"knee_qps": 400.0,
+                                   "closed_loop_qps": 1200.0,
+                                   "p99_at_load_ms": 5.0},
+            "rest_search": {"knee_qps": 3000.0,
+                            "closed_loop_qps": 3100.0}}}})
+        rc, docs = self._run_sentinel(fresh, ["--baseline", str(base)])
+        assert rc == 0
+        warns = docs[0]["warnings"]
+        assert [w["surface"] for w in warns] == ["qdrant_grpc"]
+        assert warns[0]["kind"] == "knee_vs_closed_loop"
+        assert warns[0]["ratio"] == pytest.approx(0.333, abs=0.001)
+        # above the 0.5 ratio on both surfaces: no warnings at all
+        fresh_ok = json.dumps({"load": {"surfaces": {
+            "qdrant_grpc_search": {"knee_qps": 900.0,
+                                   "closed_loop_qps": 1200.0},
+            "rest_search": {"knee_qps": 3000.0,
+                            "closed_loop_qps": 3100.0}}}})
+        rc, docs = self._run_sentinel(fresh_ok,
+                                      ["--baseline", str(base)])
+        assert rc == 0
+        assert docs[0]["warnings"] == []
 
     def test_walk_recall_gates_absolutely_without_baseline(
             self, tmp_path):
