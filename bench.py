@@ -224,6 +224,13 @@ def main(dry_run: bool = False):
             result["load"] = _bench_load(tiny=True)
         except Exception as exc:
             result["load"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+        # read fleet (ISSUE 12): tiny 1-primary/2-replica topology —
+        # the schema (scaling/lag/drain/parity) is what's validated
+        try:
+            result["fleet"] = _bench_fleet(tiny=True)
+        except Exception as exc:
+            result["fleet"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:400]}
         result["tpu_proof"] = {"skipped": "dry-run"}
         print(json.dumps(result))
         sys.stdout.flush()
@@ -272,6 +279,14 @@ def main(dry_run: bool = False):
         result["load"] = _bench_load()
     except Exception as exc:
         result["load"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # read fleet (ISSUE 12): in-process 1-primary/2-replica topology —
+    # read scaling through the replica-aware router, replay lag under
+    # a write burst, drain-on-breach, and the parity-gated-admission
+    # verdict the sentinel holds to the exact-contract floor
+    try:
+        result["fleet"] = _bench_fleet()
+    except Exception as exc:
+        result["fleet"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
     # one-shot TPU proof (VERDICT r3 task 3): the first session where
     # the tunnel is up must capture EVERYTHING the TPU claim rests on —
     # compiled (non-interpret) Pallas kernels, batched device kNN, and
@@ -479,6 +494,16 @@ def _compact_summary(result):
                                            "shadow_parity",
                                            "statistical"),
         },
+        # read fleet (ISSUE 12), packed [fleet_read_qps, read_scaling,
+        # replica_parity, drain_on_breach] — the driver tail window is
+        # 2000 chars, so the summary carries the headline quad in the
+        # array form the surfaces/qdrant_floor entries already use
+        "fleet": [
+            g(result, "fleet", "fleet_read_qps"),
+            g(result, "fleet", "read_scaling"),
+            g(result, "fleet", "replica_parity"),
+            g(result, "fleet", "drain", "breached_drained"),
+        ],
         "surfaces": surfaces,
         # what grpc-python can physically do on this box with this
         # harness, and how close the real surface got (the perf gate)
@@ -1267,6 +1292,153 @@ def _sweep_brief(doc):
     return {k: doc.get(k) for k in
             ("closed_loop_qps", "knee_qps", "p99_at_load_ms",
              "knee_offered_qps", "queue_collapse_detected")}
+
+
+def _bench_fleet(tiny: bool = False):
+    """Read-fleet stage (ISSUE 12): an in-process 1-primary/2-replica
+    topology over real loopback WAL streaming. Measures (1) READ
+    SCALING — closed-loop vector-read throughput through the
+    replica-aware router vs the primary alone; (2) REPLAY LAG — peak
+    replica lag (WAL ops) under a write burst and the time the fleet
+    takes to drain it; (3) DRAIN-ON-BREACH — a replica pushed past the
+    lag threshold leaves the read rotation (degrade-ledger
+    ``replica_lag`` record) and rejoins once healed. ``replica_parity``
+    is the parity-gated-admission verdict: probe answers from each
+    replica's device path vs the primary's exact host reference (the
+    sentinel gates it absolutely at the exact-contract floor 1.0)."""
+    import shutil
+    import tempfile
+    import threading as _threading
+
+    from nornicdb_tpu.obs import audit as _fleet_audit
+    from nornicdb_tpu.replication.read_fleet import ReadFleet
+
+    n = 300 if tiny else 4000
+    d = 16 if tiny else 64
+    secs = 0.25 if tiny else 2.0
+    burst = 120 if tiny else 1500
+    n_threads = 4 if tiny else 8
+    k = 10
+    tmp = tempfile.mkdtemp(prefix="nornic-fleet-")
+    out = {"replicas": 2, "n": n, "dims": d}
+    fleet = None
+    try:
+        fleet = ReadFleet(tmp, n_replicas=2, heartbeat_interval=0.05)
+        db = fleet.primary_db
+        rng = np.random.default_rng(12)
+        vecs = rng.normal(size=(n + burst, d)).astype(np.float32)
+        for i in range(n):
+            db.store(f"fleet doc {i}", node_id=f"f{i}",
+                     embedding=[float(x) for x in vecs[i]])
+        out["converged"] = bool(fleet.wait_converged(60.0))
+
+        # parity-gated admission (PR 10 floors: exact 1.0)
+        probe_ids = rng.integers(0, n, size=8)
+        ratios = fleet.admit_all([vecs[i] for i in probe_ids], k=k)
+        out["replica_parity"] = min(ratios.values())
+        out["admitted"] = sum(
+            1 for s in fleet.router.drain_state().values()
+            if s["admitted"])
+
+        # read scaling: the same closed-loop drivers against the
+        # router (reads fan across both replicas) and the primary alone
+        local = fleet.router.primary_db.search
+        qpool = vecs[rng.integers(0, n, size=256)]
+
+        def measure(read_one):
+            counts = [0] * n_threads
+            stop_at = time.time() + secs
+
+            def worker(t):
+                r = np.random.default_rng(t)
+                while time.time() < stop_at:
+                    q = qpool[int(r.integers(0, len(qpool)))]
+                    read_one(q)
+                    counts[t] += 1
+
+            threads = [_threading.Thread(target=worker, args=(t,))
+                       for t in range(n_threads)]
+            t0 = time.time()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            return sum(counts) / max(time.time() - t0, 1e-9)
+
+        def via_router(q):
+            fleet.router.vec_dispatch(
+                "__service__", q[None, :], k,
+                lambda key, qs, kk: local._ann_search_batch(qs, kk))
+
+        def via_primary(q):
+            local._ann_search_batch(q[None, :], k)
+
+        out["single_read_qps"] = round(measure(via_primary), 1)
+        out["fleet_read_qps"] = round(measure(via_router), 1)
+        out["read_scaling"] = round(
+            out["fleet_read_qps"] / max(out["single_read_qps"], 1e-9), 3)
+
+        # replay lag under a write burst: peak replica lag + drain time
+        t_burst = time.time()
+        for i in range(burst):
+            db.store(f"burst doc {i}", node_id=f"b{i}",
+                     embedding=[float(x) for x in vecs[n + i]])
+        peak_lag = max(r.standby.lag_ops() for r in fleet.replicas)
+        drained_at = None
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            lags = [r.standby.lag_ops() for r in fleet.replicas]
+            peak_lag = max(peak_lag, max(lags))
+            if max(lags) == 0 and all(
+                    r.standby.applied_seq >= db._base.wal.last_seq
+                    for r in fleet.replicas):
+                drained_at = time.time()
+                break
+            time.sleep(0.01)
+        out["replay_lag"] = {
+            "burst_ops": burst,
+            "peak_lag_ops": int(peak_lag),
+            "drain_s": (round(drained_at - t_burst, 3)
+                        if drained_at else None),
+        }
+
+        # drain-on-breach: push replica-0 past the lag threshold via an
+        # inflated primary watermark; the router must stop routing to
+        # it (ledger reason replica_lag) and re-admit once healed
+        r0 = fleet.replicas[0]
+
+        def pick_names(tries=8):
+            # None = primary fallback (e.g. the sibling replica is
+            # momentarily catching up) — a routing verdict, not a crash
+            out = set()
+            for _ in range(tries):
+                r = fleet.router.pick_read()
+                out.add(r.name if r is not None else "primary")
+            return out
+
+        with r0.standby._lock:
+            r0.standby.primary_last_seq += 1_000_000
+        time.sleep(fleet.router._check_interval_s * 2)
+        picked = pick_names()
+        out_drain = {"breached_drained": r0.name not in picked}
+        ledger = [rec for rec in _fleet_audit.degrade_snapshot(200)
+                  if rec.get("surface") == "fleet"
+                  and rec.get("index") == r0.name
+                  and rec.get("reason") == "replica_lag"]
+        out_drain["ledger_reason"] = bool(ledger)
+        with r0.standby._lock:
+            r0.standby.primary_last_seq = r0.standby.applied_seq
+        time.sleep(fleet.router._check_interval_s * 2)
+        out_drain["recovered"] = r0.name in pick_names()
+        out["drain"] = out_drain
+        return out
+    except Exception as exc:  # noqa: BLE001 — stage isolation
+        out["error"] = f"{type(exc).__name__}: {exc}"[:400]
+        return out
+    finally:
+        if fleet is not None:
+            fleet.close()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _bench_load(tiny: bool = False, n_people: "int | None" = None,

@@ -1,0 +1,500 @@
+"""Replica-aware read router with parity-gated admission (ISSUE 12).
+
+The serving half of the read fleet (replication/read_fleet.py): reads —
+coalesced vector dispatches, hybrid searches, qdrant point reads —
+round-robin across *admitted, ready* replicas; writes always go to the
+primary. Three gates keep a replica out of rotation:
+
+- **admission parity** — a replica serves nothing until its answers to
+  probe queries match the primary's exact host reference at the PR 10
+  auditor floors (rank parity 1.0 for exact tiers, recall >= 0.95 for
+  statistical tiers, scored by ``ShadowAuditor.parity_of``);
+- **readiness** — the replica's own ``ready_reasons()`` (the same
+  signal its ``/readyz`` serves): behind ``NORNICDB_READY_MAX_LAG_OPS``
+  or mid catch-up drains it, as does an in-flight background index
+  rebuild;
+- **health** — a read that raises drains the replica until the next
+  health re-check window.
+
+Every step-down is explained: the transition (never the steady state)
+writes a degrade-ledger record — ``reason=replica_lag`` for the lag
+threshold, ``reason=replica_drain`` for parity/rebuild/error drains —
+so ``/admin/degrades`` tells the whole routing story. Per-read
+attribution rides ``nornicdb_fleet_reads_total{node,surface}`` and
+``nornicdb_fleet_served_tier_total{node,tier}`` (the per-replica
+served-tier split); ``nornicdb_replica_parity_ratio{node}`` and
+``nornicdb_replica_admitted{node}`` carry the admission state.
+
+Deployment shapes: in-process replicas (ReadReplica handles — tests,
+bench, single-box fleets) and :class:`RemoteReplica` HTTP endpoints
+(``/readyz`` as the health signal, qdrant/REST reads over the wire)
+for multi-host topologies. The PR 11 ``WirePlane`` accepts a router as
+``fleet=`` so every frontend worker's reads fan across the fleet while
+its writes funnel to the one primary.
+
+Read consistency is *bounded staleness*: a replica may trail the
+primary by at most the lag threshold, and drains rather than serve
+staler answers. Read-your-writes callers must read the primary
+(docs/replication.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs.metrics import REGISTRY
+
+_READS_C = REGISTRY.counter(
+    "nornicdb_fleet_reads_total",
+    "Reads the fleet router dispatched, by serving node and surface",
+    labels=("node", "surface"))
+_TIER_C = REGISTRY.counter(
+    "nornicdb_fleet_served_tier_total",
+    "Fleet-routed reads by serving node and ladder tier",
+    labels=("node", "tier"))
+_PARITY_G = REGISTRY.gauge(
+    "nornicdb_replica_parity_ratio",
+    "Admission-probe parity of a replica vs the primary's exact host "
+    "reference", labels=("node",))
+_ADMITTED_G = REGISTRY.gauge(
+    "nornicdb_replica_admitted",
+    "1 while a replica is admitted and in the read rotation",
+    labels=("node",))
+
+# QdrantCompat read surface; writes (upserts, deletes, collection DDL,
+# alias updates, snapshots) always hit the primary
+_READ_COMPAT = frozenset({
+    "search_points", "retrieve_points", "scroll_points", "count_points",
+    "list_collections", "get_collection", "resolve", "list_aliases",
+})
+
+
+class FleetRouter:
+    """Round-robin read routing over admitted+ready replicas, primary
+    fallback, drain bookkeeping, and the promotion pivot."""
+
+    def __init__(self, primary_db, check_interval_s: float = 0.05,
+                 max_lag_ops: Optional[int] = None):
+        self.primary_db = primary_db
+        self._check_interval_s = check_interval_s
+        self._max_lag_ops = max_lag_ops  # None -> env per check
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Any] = {}
+        self._order: List[str] = []
+        # name -> {"admitted", "parity", "drain": reason|None,
+        #          "checked_at", "ready"}
+        self._state: Dict[str, Dict[str, Any]] = {}
+        self._rr = 0
+        # materialized counter children — the read hot path must not
+        # pay a labels() probe per query (audit.py precedent)
+        self._count_cache: Dict[Any, Any] = {}
+
+    # -- membership ------------------------------------------------------
+
+    def add_replica(self, replica) -> None:
+        """Register a replica handle. It serves nothing until
+        :meth:`admit` passes its parity gate (or
+        :meth:`admit_unchecked` explicitly waives it)."""
+        with self._lock:
+            name = replica.name
+            self._replicas[name] = replica
+            if name not in self._order:
+                self._order.append(name)
+            self._state[name] = {"admitted": False, "parity": None,
+                                 "drain": None, "checked_at": 0.0,
+                                 "ready": False}
+        _ADMITTED_G.labels(name).set(0.0)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._state.pop(name, None)
+            if name in self._order:
+                self._order.remove(name)
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+    # -- parity-gated admission ------------------------------------------
+
+    def admit(self, name: str, probes: Sequence[Sequence[float]],
+              k: int = 10) -> float:
+        """Run the admission parity gate: each probe vector is answered
+        by the replica's device dispatch and by the primary's exact
+        host reference; the MINIMUM per-probe parity must clear the
+        served tier's floor (audit.tier_floor — exact 1.0, statistical
+        0.95). Returns the min ratio; a failing replica stays drained
+        with a ``replica_drain`` ledger record."""
+        with self._lock:
+            replica = self._replicas.get(name)
+        if replica is None:
+            raise KeyError(f"unknown replica {name!r}")
+        if not getattr(replica, "supports_vec", True):
+            raise ValueError(
+                f"replica {name!r} has no in-process vector dispatch to "
+                "probe (remote handle); verify parity against its own "
+                "surface out of band and use admit_unchecked()")
+        worst = 1.0
+        # gate at the LOOSEST floor any probe served under: a replica
+        # answering through a statistical tier (walk/quant) owes 0.95
+        # on those probes, while exact-tier probes still demand 1.0 —
+        # each probe is compared against ITS OWN tier's floor and the
+        # verdict is "every probe cleared its floor"
+        ok = True
+        for vec in probes:
+            ratio, probe_floor = self._probe_parity(replica, vec, k)
+            worst = min(worst, ratio)
+            if ratio < probe_floor:
+                ok = False
+        _PARITY_G.labels(name).set(float(worst))
+        with self._lock:
+            st = self._state.get(name)
+            if st is not None:
+                st["admitted"] = bool(ok)
+                st["parity"] = float(worst)
+                st["drain"] = None if ok else "replica_parity"
+        _ADMITTED_G.labels(name).set(1.0 if ok else 0.0)
+        if not ok:
+            _audit.record_degrade("fleet", "replica", "primary",
+                                  "replica_drain", index=name)
+        return worst
+
+    def admit_unchecked(self, name: str) -> None:
+        """Waive the parity gate (tests, trusted rejoin)."""
+        with self._lock:
+            st = self._state.get(name)
+            if st is not None:
+                st["admitted"] = True
+                st["drain"] = None
+        _ADMITTED_G.labels(name).set(1.0)
+
+    def _probe_parity(self, replica, vec, k: int):
+        """(parity ratio, floor) of one probe on one replica."""
+        q = np.asarray(vec, dtype=np.float32)[None, :]
+        _audit.set_last_served(None)
+        dev = replica.vec_dispatch("__service__", q, k)[0]
+        tier = _audit.last_served() or "vector_brute_f32"
+        floor = _audit.tier_floor(tier)
+        exact = floor >= 1.0
+        ref = self.primary_db.search.vector_search_candidates(
+            np.asarray(vec, dtype=np.float32), k=k, exact=True)
+        dev_pairs = [(i, float(s)) for i, s in list(dev)[:k]]
+        ref_pairs = [(i, float(s)) for i, s in list(ref)[:k]]
+        from nornicdb_tpu.obs.audit import ShadowAuditor
+
+        return (ShadowAuditor.parity_of(dev_pairs, ref_pairs, k,
+                                        exact=exact), floor)
+
+    def parity(self, name: str) -> Optional[float]:
+        with self._lock:
+            st = self._state.get(name)
+            return None if st is None else st.get("parity")
+
+    # -- readiness / drain -----------------------------------------------
+
+    def _check_ready(self, name: str, replica,
+                     st: Dict[str, Any]) -> bool:
+        """Cached readiness verdict; drain/undrain transitions record
+        their degrade reason exactly once."""
+        now = time.time()
+        if now - st["checked_at"] < self._check_interval_s:
+            return st["ready"]
+        reason: Optional[str] = None
+        try:
+            reasons = replica.ready_reasons(self._max_lag_ops)
+            if reasons:
+                reason = reasons[0]
+            elif getattr(replica, "rebuild_in_flight", None) \
+                    and replica.rebuild_in_flight():
+                reason = f"index_rebuild:{name}"
+            elif getattr(replica, "is_replica", None) \
+                    and not replica.is_replica():
+                reason = f"promoted:{name}"
+        except Exception as exc:  # noqa: BLE001 — unreachable drains
+            reason = f"unreachable:{name}:{type(exc).__name__}"
+        ready = reason is None
+
+        def _key(r):
+            # stable identity of a drain reason: replica_lag embeds the
+            # LIVE lag value ("replica_lag:r0(517/512)"), so comparing
+            # full strings would re-record a "transition" every check
+            # while the lag drifts — one sustained drain, one record
+            return None if r is None else r.split("(", 1)[0]
+
+        with self._lock:
+            # state transition under the lock so two racing reads can
+            # never double-record the same drain in the ledger
+            prev = st.get("drain")
+            if st["checked_at"] > now:
+                return st["ready"]  # a racer already re-checked
+            transition_down = not ready and _key(prev) != _key(reason)
+            transition_up = ready and prev is not None
+            st["drain"] = reason
+            st["ready"] = ready
+            st["checked_at"] = time.time()
+            admitted = st["admitted"]
+        if transition_down:
+            # record the TRANSITION once, not every routed read
+            ledger_reason = ("replica_lag"
+                            if reason.startswith("replica_lag")
+                            else "replica_drain")
+            _audit.record_degrade("fleet", "replica", "primary",
+                                  ledger_reason, index=name)
+            _ADMITTED_G.labels(name).set(0.0)
+        elif transition_up:
+            _ADMITTED_G.labels(name).set(1.0 if admitted else 0.0)
+        return ready
+
+    def pick_read(self, need_vec: bool = False):
+        """The replica the next read should hit, or None (serve from
+        the primary). Round-robin over admitted+ready replicas;
+        ``need_vec`` skips handles without an in-process raw-embedding
+        dispatch (RemoteReplica) instead of draining them."""
+        with self._lock:
+            order = list(self._order)
+            start = self._rr
+            self._rr += 1
+        n = len(order)
+        for i in range(n):
+            name = order[(start + i) % n]
+            with self._lock:
+                replica = self._replicas.get(name)
+                st = self._state.get(name)
+            if replica is None or st is None or not st["admitted"]:
+                continue
+            if need_vec and not getattr(replica, "supports_vec", True):
+                continue
+            if st.get("drain") == "replica_parity":
+                continue
+            if self._check_ready(name, replica, st):
+                return replica
+        return None
+
+    def drain_state(self) -> Dict[str, Dict[str, Any]]:
+        """Admission/drain snapshot per replica (admin surface, bench)."""
+        with self._lock:
+            return {name: dict(st) for name, st in self._state.items()}
+
+    # -- read dispatch ---------------------------------------------------
+
+    def _note_served(self, name: str, surface: str, n: int = 1) -> None:
+        key = ("r", name, surface)
+        child = self._count_cache.get(key)
+        if child is None:
+            child = self._count_cache[key] = _READS_C.labels(name, surface)
+        child.inc(n)
+        tier = _audit.last_served()
+        if tier:
+            tkey = ("t", name, tier)
+            tchild = self._count_cache.get(tkey)
+            if tchild is None:
+                tchild = self._count_cache[tkey] = _TIER_C.labels(name,
+                                                                  tier)
+            tchild.inc(n)
+
+    def _drain_error(self, name: str) -> None:
+        with self._lock:
+            st = self._state.get(name)
+            if st is not None and st.get("drain") is None:
+                st["drain"] = f"error:{name}"
+                st["ready"] = False
+                st["checked_at"] = time.time()
+                _audit.record_degrade("fleet", "replica", "primary",
+                                      "replica_drain", index=name)
+
+    def vec_dispatch(self, key: str, queries, k: int, local_fn):
+        """Coalesced vector dispatch (the WirePlane/broker OP_VEC
+        contract): serve the batch from a ready replica, fall back to
+        the local (primary) dispatch on drain or error."""
+        replica = self.pick_read(need_vec=True)
+        if replica is None:
+            return local_fn(key, queries, k)
+        try:
+            out = replica.vec_dispatch(key, queries, k)
+        except KeyError:
+            # capability miss (unknown dispatch key / remote handle):
+            # serve locally, never drain a healthy replica over it
+            return local_fn(key, queries, k)
+        except Exception:  # noqa: BLE001 — degrade, never fail the read
+            self._drain_error(replica.name)
+            return local_fn(key, queries, k)
+        self._note_served(replica.name, "vec", n=len(queries))
+        return out
+
+    def routed_search(self):
+        return RoutedSearch(self)
+
+    def routed_compat(self):
+        return RoutedCompat(self)
+
+    # -- failover --------------------------------------------------------
+
+    def on_promote(self, replica) -> None:
+        """A replica was promoted: writes re-point at it, and it leaves
+        the read rotation (it IS the primary now). The old primary's
+        handle, if any, stays registered but drains via its
+        ``promoted``/role check until an operator re-admits it."""
+        self.primary_db = replica.db
+        with self._lock:
+            st = self._state.get(replica.name)
+            if st is not None:
+                st["admitted"] = False
+                st["drain"] = f"promoted:{replica.name}"
+        _ADMITTED_G.labels(replica.name).set(0.0)
+
+
+class RoutedSearch:
+    """SearchService facade: read methods fan across the fleet, every
+    other attribute resolves on the primary's live service (the wire
+    plane reads ``generation`` and mirrors caches through it)."""
+
+    def __init__(self, router: FleetRouter):
+        self._router = router
+
+    def _primary(self):
+        return self._router.primary_db.search
+
+    def search(self, **kwargs):
+        r = self._router.pick_read()
+        if r is not None:
+            try:
+                out = r.db.search.search(**kwargs)
+                self._router._note_served(r.name, "hybrid")
+                return out
+            except Exception:  # noqa: BLE001
+                self._router._drain_error(r.name)
+        return self._primary().search(**kwargs)
+
+    def vector_search_candidates(self, query_vec, k: int = 10,
+                                 exact: bool = False,
+                                 lexical_doc_ids=None):
+        r = self._router.pick_read()
+        if r is not None:
+            try:
+                out = r.db.search.vector_search_candidates(
+                    query_vec, k=k, exact=exact,
+                    lexical_doc_ids=lexical_doc_ids)
+                self._router._note_served(r.name, "vector")
+                return out
+            except Exception:  # noqa: BLE001
+                self._router._drain_error(r.name)
+        return self._primary().vector_search_candidates(
+            query_vec, k=k, exact=exact, lexical_doc_ids=lexical_doc_ids)
+
+    def __getattr__(self, name: str):
+        return getattr(self._router.primary_db.search, name)
+
+
+class RoutedCompat:
+    """QdrantCompat facade: the read surface fans across the fleet
+    (primary retry on any replica failure — the primary's verdict is
+    authoritative, including client errors); writes and attributes
+    resolve on the primary compat."""
+
+    def __init__(self, router: FleetRouter):
+        self._router = router
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            return getattr(self._router.primary_db.qdrant_compat, name)
+        if name not in _READ_COMPAT:
+            # writes and misc attrs re-resolve per access: a promotion
+            # swaps primary_db and must never serve a pinned method
+            return getattr(self._router.primary_db.qdrant_compat, name)
+        router = self._router
+
+        def routed(*args, **kwargs):
+            r = router.pick_read()
+            if r is not None:
+                try:
+                    out = getattr(r.db.qdrant_compat, name)(
+                        *args, **kwargs)
+                    router._note_served(r.name, "qdrant")
+                    return out
+                except Exception:  # noqa: BLE001 — primary decides
+                    pass
+            # resolved INSIDE the call: memoizing the wrapper is safe
+            # across promotion because the primary is looked up live
+            return getattr(router.primary_db.qdrant_compat, name)(
+                *args, **kwargs)
+
+        # memoize on the instance: the broker's OP_CALL path does a
+        # getattr per request, and rebuilding this closure each time is
+        # pure hot-path overhead (__getattr__ only fires on miss)
+        self.__dict__[name] = routed
+        return routed
+
+
+class RemoteReplica:
+    """A replica on another host, addressed over its REST surface:
+    ``/readyz`` is the health signal (the replica's own lag/catch-up/
+    rebuild verdict — exactly what a load balancer would probe), and
+    the qdrant/native read routes serve the reads the router sends.
+    Raw-embedding coalesced dispatch (``vec_dispatch``) is an
+    in-process capability; the router's vec path simply skips remote
+    handles (KeyError -> primary fallback)."""
+
+    # no in-process raw-embedding ring: the router's vec path skips
+    # remote handles (pick_read(need_vec=True)) instead of draining
+    supports_vec = False
+
+    def __init__(self, name: str, base_url: str, timeout_s: float = 2.0,
+                 auth: Optional[str] = None):
+        self.name = str(name)
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.auth = auth
+        self.closed = False
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None):
+        import json as _json
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base_url + path, method=method,
+            data=(None if payload is None
+                  else _json.dumps(payload).encode("utf-8")),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": self.auth} if self.auth
+                        else {})})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return resp.status, _json.loads(resp.read() or b"{}")
+
+    def ready_reasons(self, max_lag_ops: Optional[int] = None
+                      ) -> List[str]:
+        import urllib.error
+
+        try:
+            status, doc = self._request("GET", "/readyz")
+        except urllib.error.HTTPError as e:
+            import json as _json
+
+            try:
+                doc = _json.loads(e.read() or b"{}")
+            except Exception:  # noqa: BLE001
+                doc = {}
+            return list(doc.get("reasons")
+                        or [f"degraded:{self.name}({e.code})"])
+        except Exception as exc:  # noqa: BLE001
+            return [f"unreachable:{self.name}:{type(exc).__name__}"]
+        if status != 200:
+            return list(doc.get("reasons") or [f"degraded:{self.name}"])
+        return []
+
+    def rebuild_in_flight(self) -> bool:
+        return False  # folded into the remote /readyz verdict
+
+    def is_replica(self) -> bool:
+        return not self.closed
+
+    def vec_dispatch(self, key: str, queries, k: int):
+        raise KeyError(
+            f"remote replica {self.name} has no raw-embedding ring; "
+            "route vec dispatches in-process")

@@ -676,6 +676,25 @@ class HttpServer:
                     f"({b['ratio']}<{b['floor']})")
         except Exception:
             pass
+        # read-replica freshness (ISSUE 12): a fleet node behind the
+        # NORNICDB_READY_MAX_LAG_OPS threshold or mid catch-up must
+        # drain — the router (and any load balancer probing this
+        # endpoint) stops sending it reads instead of letting it serve
+        # answers staler than the documented bound
+        fleet = getattr(self.db, "fleet_node", None)
+        if fleet is not None:
+            checks["replica"] = 1
+            checks["replica_not_ready"] = 0
+            try:
+                for r in fleet.ready_reasons():
+                    checks["replica_not_ready"] += 1
+                    reasons.append(r)
+            except Exception:
+                # fail CLOSED: a replica whose freshness verdict cannot
+                # be computed (teardown race, bad env) must drain, not
+                # keep taking reads it can no longer prove fresh
+                checks["replica_not_ready"] += 1
+                reasons.append("replica_state_unknown")
         # keep the SLO sample ring warm from the probe cadence (the
         # engine is scrape-driven; kubelet-style periodic readiness
         # probes give it a steady clock even with /metrics unscraped)

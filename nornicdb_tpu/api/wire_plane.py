@@ -63,6 +63,19 @@ from nornicdb_tpu.search.broker import (
 )
 
 
+def resolve_vec_dispatch(db, key: str, queries, k: int):
+    """The OP_VEC dispatch-key vocabulary resolved against one DB's
+    device indexes — the ONE copy shared by the plane's local dispatch
+    and each read replica's dispatch (replication/read_fleet.py), so a
+    new key can never silently exist on one side only."""
+    if key == "__service__":
+        return db.search._ann_search_batch(queries, k)
+    if key.startswith("qdrant:"):
+        return db.qdrant_compat._ann_search_index(
+            key[len("qdrant:"):]).search_batch(queries, k)
+    raise KeyError(f"unknown vec-dispatch key {key!r}")
+
+
 def wire_workers_from_env(default: int = 1) -> int:
     try:
         return int(os.environ.get("NORNICDB_WIRE_WORKERS", str(default)))
@@ -263,22 +276,122 @@ def _worker_servicers():
         reply ZERO-COPY: ranked point dicts from the plane splice
         straight into wire bytes (api/wire_codec.py) — no protobuf
         object graph in the worker, the only per-reply work after the
-        encode is the 9-byte time splice."""
+        encode is the 9-byte time splice.
+
+        The HOT SHAPE — cosine collection, no filter, no vector echo —
+        rides the ring's coalesced OP_VEC instead of a pickled
+        full-fidelity OP_CALL (the PR 11 named headroom): the raw
+        embedding posts straight onto the ring, coalesces across every
+        worker into one batched device dispatch per collection, and one
+        batched plane op hydrates payloads. Anything the fast path
+        cannot prove sound — non-cosine distance, filters, a hydration
+        under-fill from a racing delete — falls back to the
+        full-fidelity ``search_points`` OP_CALL, never to a wrong or
+        short answer."""
+
+        def __init__(self, compat):
+            super().__init__(compat)
+            # collection eligibility briefs, validated against the
+            # shared qdrant write generation (any write invalidates)
+            self._fast_briefs: Dict[str, Tuple[int, Dict[str, Any]]] = {}
+
+        def _fast_brief(self, name: str) -> Optional[Dict[str, Any]]:
+            gen = self.compat._client.qdrant_gen()
+            cached = self._fast_briefs.get(name)
+            if cached is not None and cached[0] == gen:
+                return cached[1]
+            try:
+                brief = self.compat._client.call(
+                    "plane", "qdrant_fast_brief", name)["result"]
+            except Exception:  # noqa: BLE001 — slow path decides
+                return None
+            if len(self._fast_briefs) > 256:
+                self._fast_briefs.clear()
+            self._fast_briefs[name] = (gen, brief)
+            return brief
+
+        def _fast_search(self, brief, request, limit: int, offset: int,
+                         with_payload: bool, threshold, t0: float):
+            """OP_VEC fast path; None = let the OP_CALL path serve."""
+            vec = np.asarray(list(request.vector), dtype=np.float32)
+            want = int(brief.get("size") or 0)
+            if want and vec.shape[0] != want:
+                from nornicdb_tpu.api.qdrant import QdrantError
+
+                raise QdrantError(
+                    f"search vector size {vec.shape[0]} != collection "
+                    f"size {want}")
+            try:
+                doc = self.compat._client.vec_search(
+                    "qdrant:" + brief["collection"], vec, limit + offset)
+            except BrokerTimeout:
+                from nornicdb_tpu.api.qdrant import QdrantError
+
+                _audit.record_degrade("wire", "broker", "error",
+                                      "broker_timeout",
+                                      index=brief["collection"])
+                raise QdrantError(
+                    "device plane unavailable (broker timeout)",
+                    status=503)
+            except BrokerRemoteError as exc:
+                raise _map_remote(exc) from None
+            hits = doc.get("hits") or []
+            obs.record_stage("broker", "coalesce_wait",
+                             doc["t0"] - doc["t_post"])
+            obs.record_stage("broker", "device_dispatch",
+                             doc["t1"] - doc["t0"])
+            _audit.set_last_served(doc.get("tier"))
+            got = self.compat._client.call(
+                "plane", "qdrant_points_brief", brief["collection"],
+                [nid for nid, _ in hits],
+                with_payload)["result"]
+            by_id = got.get("points") or {}
+            missing = sum(1 for nid, _ in hits if nid not in by_id)
+            points = []
+            for nid, score in hits:
+                d = by_id.get(nid)
+                if d is None:
+                    continue  # deleted between dispatch and hydrate
+                if threshold is not None and float(score) < threshold:
+                    continue
+                d = dict(d)
+                d["score"] = float(score)
+                points.append(d)
+            if missing and len(points) < limit + offset \
+                    and len(points) < int(got.get("total") or 0):
+                # racing deletes displaced candidates the widening
+                # rounds of the full path would have refilled
+                return None
+            return wire_codec.append_time(
+                wire_codec.encode_search_response(points[offset:]),
+                time.time() - t0)
 
         def Search(self, request):
             t0 = time.time()
             offset = (int(request.offset)
                       if request.HasField("offset") else 0)
+            limit = int(request.limit) or 10
+            query_filter = filter_to_dict(request.filter)
+            with_payload = _with_payload(request.with_payload)
+            with_vector = _with_vectors(request)
+            threshold = (request.score_threshold
+                         if request.HasField("score_threshold") else None)
+            if query_filter is None and not with_vector:
+                brief = self._fast_brief(request.collection_name)
+                if brief and brief.get("ok"):
+                    resp = self._fast_search(brief, request, limit,
+                                             offset, with_payload,
+                                             threshold, t0)
+                    if resp is not None:
+                        return resp
             hits = self.compat.search_points(
                 request.collection_name,
                 list(request.vector),
-                limit=(int(request.limit) or 10) + offset,
-                with_payload=_with_payload(request.with_payload),
-                with_vector=_with_vectors(request),
-                score_threshold=(
-                    request.score_threshold
-                    if request.HasField("score_threshold") else None),
-                query_filter=filter_to_dict(request.filter),
+                limit=limit + offset,
+                with_payload=with_payload,
+                with_vector=with_vector,
+                score_threshold=threshold,
+                query_filter=query_filter,
             )
             return wire_codec.append_time(
                 wire_codec.encode_search_response(hits[offset:]),
@@ -619,6 +732,49 @@ class _PlaneOps:
 
         return dump_state()
 
+    # -- qdrant OP_VEC fast path (ISSUE 12 satellite) ------------------
+
+    def qdrant_fast_brief(self, name: str) -> Dict[str, Any]:
+        """Eligibility brief for the worker's OP_VEC qdrant Search fast
+        path: alias-resolved collection name, distance and vector size.
+        Only Cosine collections are eligible (the coalesced device
+        index serves cosine; Dot/Euclid ride the raw-matrix path)."""
+        compat = self._plane.db.qdrant_compat
+        try:
+            resolved = compat.resolve(name)
+            meta = compat._meta(resolved)
+        except Exception:  # noqa: BLE001 — missing collections 404 on
+            # the slow path with the full error mapping
+            return {"ok": False}
+        cfg = meta.properties.get("config", {}) or {}
+        return {
+            "ok": cfg.get("distance", "Cosine") == "Cosine",
+            "collection": resolved,
+            "size": int(cfg.get("size", 0) or 0),
+            "distance": cfg.get("distance", "Cosine"),
+        }
+
+    def qdrant_points_brief(self, name: str, ids: List[str],
+                            with_payload: bool = True) -> Dict[str, Any]:
+        """Batched hydration for OP_VEC-ranked collection hits: point
+        dicts (scoreless — the worker splices its own scores) keyed by
+        node id, plus the live point count so the worker can detect a
+        racing-delete under-fill and fall back."""
+        compat = self._plane.db.qdrant_compat
+        storage = self._plane.db.storage
+        points: Dict[str, Any] = {}
+        for nid in ids:
+            try:
+                node = storage.get_node(nid)
+            except Exception:  # noqa: BLE001 — deleted mid-flight
+                continue
+            points[nid] = compat._point_dict(node, with_payload, False)
+        try:
+            total = len(compat._index(compat.resolve(name)))
+        except Exception:  # noqa: BLE001
+            total = len(points)
+        return {"points": points, "total": total}
+
 
 # -- the plane --------------------------------------------------------------
 
@@ -645,10 +801,16 @@ class WirePlane:
                  http_port: int = 0, mode: Optional[str] = None,
                  slot_bytes: Optional[int] = None,
                  timeout_s: Optional[float] = None,
-                 authenticator=None):
+                 authenticator=None, fleet=None):
         from nornicdb_tpu.api.http_server import HttpServer
 
         self.db = db
+        # replica-aware read routing (ISSUE 12): with a FleetRouter the
+        # plane's coalesced vector dispatches and the workers' generic
+        # search/qdrant READ ops fan across admitted+ready replicas
+        # (writes keep funneling to this db, the primary); None keeps
+        # the single-node plane exactly as before
+        self.fleet = fleet
         self.workers = workers if workers is not None \
             else wire_workers_from_env(2)
         if self.workers < 2:
@@ -663,10 +825,14 @@ class WirePlane:
                                       authenticator=authenticator)
         self._plane_ops = _PlaneOps(self)
         compat = db.qdrant_compat
+        target_compat = fleet.routed_compat() if fleet is not None \
+            else compat
+        target_search = fleet.routed_search() if fleet is not None \
+            else db.search
         self.broker = DispatchBroker(
             self._vec_dispatch,
-            targets={"compat": compat, "search": db.search, "db": db,
-                     "plane": self._plane_ops},
+            targets={"compat": target_compat, "search": target_search,
+                     "db": db, "plane": self._plane_ops},
             n_workers=self.workers, slot_bytes=slot_bytes)
         self._timeout_s = timeout_s
         obs.register_resource("queue", "broker", self.broker)
@@ -684,13 +850,14 @@ class WirePlane:
 
     # -- device-plane dispatch targets ---------------------------------
 
+    def _local_vec_dispatch(self, key: str, queries: np.ndarray, k: int):
+        return resolve_vec_dispatch(self.db, key, queries, k)
+
     def _vec_dispatch(self, key: str, queries: np.ndarray, k: int):
-        if key == "__service__":
-            return self.db.search._ann_search_batch(queries, k)
-        if key.startswith("qdrant:"):
-            return self.db.qdrant_compat._ann_search_index(
-                key[len("qdrant:"):]).search_batch(queries, k)
-        raise KeyError(f"unknown vec-dispatch key {key!r}")
+        if self.fleet is not None:
+            return self.fleet.vec_dispatch(key, queries, k,
+                                           self._local_vec_dispatch)
+        return self._local_vec_dispatch(key, queries, k)
 
     # -- lifecycle -----------------------------------------------------
 

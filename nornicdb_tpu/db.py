@@ -131,6 +131,10 @@ class DB:
         self._inference = None
         if auto_embed:
             self._start_embed_queue()
+        rep = getattr(self, "_deferred_rep_start", None)
+        if rep is not None:
+            self._deferred_rep_start = None
+            rep.start()
 
     def _default_embedder(self):
         """Default local embedder (reference default: local embedding
@@ -290,15 +294,23 @@ class DB:
                     "async_writes cannot be combined with HA replication "
                     "(writes route through the WAL primary directly)"
                 )
+            primary_cls = getattr(cfg, "primary_cls", None) or HAPrimary
+            standby_cls = getattr(cfg, "standby_cls", None) or HAStandby
             if cfg.ha_role == "primary":
-                rep = HAPrimary(self._base, transport, cfg)
+                rep = primary_cls(self._base, transport, cfg)
                 rep.start()
             else:
-                rep = HAStandby(
+                rep = standby_cls(
                     self._base, transport, cfg,
                     primary_addr=cfg.primary_addr,
+                    on_promote=getattr(cfg, "on_promote", None),
                 )
-                rep.start()
+                # monitor start is DEFERRED to the end of __init__: the
+                # failover clock must not tick while this facade is
+                # still loading its embedder/services — a standby that
+                # auto-promotes because its own open was slow fences
+                # the healthy primary (split-brain at boot)
+                self._deferred_rep_start = rep
         elif cfg.mode == "raft":
             def apply_fn(op, data, _chain=chain):
                 getattr(_chain, op)(*decode_op_args(op, data))
@@ -353,6 +365,11 @@ class DB:
                 self.storage, embedder=self._embedder,
                 persist_dir=(_os.path.join(self._data_dir, "search")
                              if self._data_dir else None),
+                # read replicas tag their service (read_fleet.py sets
+                # _search_resource_name before first access) so an
+                # in-process fleet's per-node gauges never collide
+                resource_name=getattr(self, "_search_resource_name",
+                                      None),
             )
             # publish BEFORE backfill so a concurrently-finishing embed
             # lands via _on_embedded instead of being dropped (index_node

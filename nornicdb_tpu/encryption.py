@@ -16,7 +16,15 @@ import os
 import secrets
 from typing import Any, Dict, Iterable, Optional
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:  # optional dependency: only ENCRYPTED stores need the primitive.
+    # Importing this module must not fail on a build without the
+    # ``cryptography`` package — ``make_encryptor(None, ...)`` (every
+    # unencrypted disk store, incl. WAL-shipping read replicas) never
+    # touches AESGCM, so the import is gated and the error surfaces
+    # only when an Encryptor is actually constructed.
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - exercised on slim containers
+    AESGCM = None
 
 PBKDF2_ITERS = 600_000
 SALT_FILE = "encryption.salt"
@@ -60,6 +68,10 @@ class Encryptor:
     def __init__(self, key: bytes):
         if len(key) != 32:
             raise EncryptionError("key must be 32 bytes (AES-256)")
+        if AESGCM is None:
+            raise EncryptionError(
+                "the 'cryptography' package is not available in this "
+                "build; encrypted stores cannot be opened")
         self._aead = AESGCM(key)
 
     @classmethod

@@ -150,6 +150,8 @@ REASONS: Tuple[str, ...] = (
     "error",               # caught exception on the device path
     "quarantine",          # shadow-parity auditor stepped the tier down
     "broker_timeout",      # shared device plane missed the rider deadline
+    "replica_lag",         # read replica behind the lag threshold drained
+    "replica_drain",       # replica drained: parity/rebuild/unreachable
 )
 
 # legacy event label value -> normalized reason. One table so the old

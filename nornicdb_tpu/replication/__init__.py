@@ -23,6 +23,20 @@ from nornicdb_tpu.replication.replicator import (
 from nornicdb_tpu.replication.replicated_engine import ReplicatedEngine
 from nornicdb_tpu.replication.ha_standby import HAPrimary, HAStandby
 from nornicdb_tpu.replication.raft import RaftNode
+
+
+def __getattr__(name):
+    # read-fleet classes resolve lazily: read_fleet imports the DB
+    # facade (and through it the API layers), so an eager import here
+    # would cycle db.py -> replication -> read_fleet -> db.py
+    if name in ("FleetStandby", "ReadFleet", "ReadReplica"):
+        from nornicdb_tpu.replication import read_fleet
+
+        return getattr(read_fleet, name)
+    raise AttributeError(name)
+
+
+
 from nornicdb_tpu.replication.multi_region import (
     MultiRegionNode,
     NotPrimaryRegionError,
@@ -31,8 +45,11 @@ from nornicdb_tpu.replication.multi_region import (
 __all__ = [
     "ClusterMessage",
     "ClusterTransport",
+    "FleetStandby",
     "HAPrimary",
     "HAStandby",
+    "ReadFleet",
+    "ReadReplica",
     "MultiRegionNode",
     "NotPrimaryError",
     "NotPrimaryRegionError",

@@ -178,10 +178,36 @@ class HAPrimary(Replicator):
     def handle_wal_sync(self, msg: ClusterMessage) -> ClusterMessage:
         """Catch-up: a (re)joining standby asks for records after seq N.
         Records ship seq-tagged and in log order so the standby can apply
-        them strictly in order and advance its watermark precisely."""
+        them strictly in order and advance its watermark precisely.
+
+        If auto-compaction has pruned the segments covering the
+        requested range (the standby is behind the newest snapshot's
+        seq), the reply ALSO carries that snapshot: WAL records alone
+        could only rebuild the post-snapshot tail, so a fresh replica
+        joining a long-lived primary would silently open near-empty.
+        The standby applies the snapshot state first (idempotent
+        creates — meant for empty/near-empty joiners; a diverged
+        rejoiner should start from a fresh data dir), pins its
+        watermark at the snapshot seq, then replays the tail."""
         from_seq = int(msg.get("from_seq", 0))
         # drain buffered appends to the segment files, then read from them
         self.engine.wal.flush()
+        snapshot = None
+        snapshot_seq = 0
+        try:
+            if from_seq < self.engine.wal.earliest_retained_seq():
+                # records alone cannot rebuild the requested range —
+                # pruned history must ship as the snapshot. A standby
+                # INSIDE the retention window never takes this branch:
+                # it catches up from the retained records exactly as
+                # before (the snapshot reconcile is strictly for
+                # behind-the-horizon joiners).
+                state, snap_seq = self.engine.wal.load_snapshot()
+                if state is not None and snap_seq > from_seq:
+                    snapshot, snapshot_seq = state, snap_seq
+                    from_seq = snap_seq
+        except Exception:  # noqa: BLE001 — unreadable snapshot: records-only
+            pass
         records = [
             {"seq": rec.get("seq", 0), "op": rec["op"],
              "data": rec.get("data", {})}
@@ -190,12 +216,16 @@ class HAPrimary(Replicator):
         last_seq = records[-1]["seq"] if records else from_seq
         with self._lock:
             epoch = self.epoch
-        return {
+        reply: ClusterMessage = {
             "ok": True,
             "epoch": epoch,
             "records": records,
             "last_seq": last_seq,
         }
+        if snapshot is not None:
+            reply["snapshot"] = snapshot
+            reply["snapshot_seq"] = snapshot_seq
+        return reply
 
     def close(self) -> None:
         """Drain any pending async batch synchronously before shutdown so
@@ -251,6 +281,13 @@ class HAStandby(Replicator):
 
     def start(self, monitor: bool = True) -> None:
         if monitor:
+            with self._lock:
+                # the silence clock starts NOW, not at construction: a
+                # slow open between __init__ and start (embedder/model
+                # loading in the DB facade) must not count as primary
+                # silence — a standby that promotes itself because its
+                # own boot was slow is split-brain at startup
+                self._last_heartbeat = time.monotonic()
             t = threading.Thread(target=self._monitor_loop, daemon=True,
                                  name="ha-monitor")
             t.start()
@@ -267,6 +304,17 @@ class HAStandby(Replicator):
             primary.apply(op, data)
         else:
             getattr(self.engine, op)(*decode_op_args(op, data))
+
+    def _apply_record(self, op: str, data: Dict[str, Any],
+                      seq: int = 0) -> None:
+        """One streamed/caught-up record -> the engine. ``seq`` is the
+        PRIMARY's sequence number for the record (0 = unsequenced).
+        Indirection so subclasses can change apply semantics
+        fleet-wide: read replicas apply AND log under the primary's
+        seq — WALEngine.apply_and_log(seq=...) — keeping their local
+        WAL seq-aligned for promotion/rejoin even when they joined
+        mid-history."""
+        self.engine.apply_record(op, data)
 
     @property
     def role(self) -> Role:
@@ -295,12 +343,12 @@ class HAStandby(Replicator):
             max_seq = max(max_seq, seq)
             with self._lock:
                 if seq <= 0:
-                    self.engine.apply_record(rec["op"], rec["data"])
+                    self._apply_record(rec["op"], rec["data"])
                     continue
                 if seq <= self.applied_seq or seq in self._reorder_buf:
                     continue  # duplicate batch overlap
                 if seq == self.applied_seq + 1:
-                    self.engine.apply_record(rec["op"], rec["data"])
+                    self._apply_record(rec["op"], rec["data"], seq=seq)
                     self.applied_seq = seq
                     self._drain_reorder_buf_locked()
                 else:
@@ -323,7 +371,8 @@ class HAStandby(Replicator):
     def _drain_reorder_buf_locked(self) -> None:
         while self.applied_seq + 1 in self._reorder_buf:
             nxt = self._reorder_buf.pop(self.applied_seq + 1)
-            self.engine.apply_record(nxt["op"], nxt["data"])
+            self._apply_record(nxt["op"], nxt["data"],
+                               seq=self.applied_seq + 1)
             self.applied_seq += 1
 
     def handle_heartbeat(self, msg: ClusterMessage) -> ClusterMessage:
@@ -414,6 +463,48 @@ class HAStandby(Replicator):
         if self.on_promote is not None:
             self.on_promote(self)
 
+    def _apply_snapshot(self, state: Dict[str, Any], snap_seq: int) -> int:
+        """Reconcile against the state shipped by ``handle_wal_sync``
+        when the requested range predates the primary's retention
+        horizon. The snapshot is the primary's FULL state at
+        ``snap_seq``, so it applies authoritatively: present entries
+        UPSERT (a stale local copy is overwritten, never kept) and
+        local entries ABSENT from the snapshot are deleted (a deletion
+        that happened inside the pruned range must not resurrect).
+        Entries bypass the local WAL — their primary seqs are unknown,
+        and logging them under invented numbers would collide with the
+        primary's real seq space (subclasses persist differently:
+        FleetStandby pins the counter and writes a local snapshot).
+        Caller holds the lock. Returns entries touched."""
+        n = 0
+        node_ids = set()
+        edge_ids = set()
+        for nd in state.get("nodes", []) or []:
+            nid = str(nd.get("id", ""))
+            node_ids.add(nid)
+            op = ("update_node" if self.engine.has_node(nid)
+                  else "create_node")
+            self.engine.apply_record(op, nd)
+            n += 1
+        for ed in state.get("edges", []) or []:
+            eid = str(ed.get("id", ""))
+            edge_ids.add(eid)
+            op = ("update_edge" if self.engine.has_edge(eid)
+                  else "create_edge")
+            self.engine.apply_record(op, ed)
+            n += 1
+        # drop local state the snapshot does not carry — edges first so
+        # node-delete cascades never race this scan
+        for edge in list(self.engine.all_edges()):
+            if edge.id not in edge_ids:
+                self.engine.apply_record("delete_edge", {"id": edge.id})
+                n += 1
+        for node in list(self.engine.all_nodes()):
+            if node.id not in node_ids:
+                self.engine.apply_record("delete_node", {"id": node.id})
+                n += 1
+        return n
+
     def catch_up(self, addr: Optional[Tuple[str, int]] = None) -> int:
         """Pull missed records from the primary (rejoin path, and gap
         repair when a streamed batch arrives ahead of the watermark).
@@ -434,11 +525,17 @@ class HAStandby(Replicator):
                 return 0
             n = 0
             with self._lock:
+                snap = resp.get("snapshot")
+                snap_seq = int(resp.get("snapshot_seq", 0) or 0)
+                if snap is not None and snap_seq > self.applied_seq:
+                    n += self._apply_snapshot(snap, snap_seq)
+                    self.applied_seq = max(self.applied_seq, snap_seq)
                 for rec in resp.get("records", []):
                     seq = rec.get("seq", 0)
                     if 0 < seq <= self.applied_seq:
                         continue
-                    self.engine.apply_record(rec["op"], rec["data"])
+                    self._apply_record(rec["op"], rec["data"],
+                                       seq=max(seq, 0))
                     n += 1
                     if seq > 0:
                         self.applied_seq = max(self.applied_seq, seq)

@@ -171,6 +171,18 @@ class RaftNode(Replicator):
                 self._next_index = {
                     tuple(p): len(self.log) + 1 for p in self.config.peers
                 }
+                # no-op barrier (Raft §5.4.2 / the reference's
+                # post-election no-op): _advance_commit may only commit
+                # entries of the CURRENT term, so a fresh leader could
+                # otherwise never commit — or apply — the tail its
+                # predecessor replicated but did not finish committing
+                # (an acked write would sit unapplied on the new leader
+                # until the next client write). The no-op is a
+                # current-term entry whose commit pulls the whole
+                # prior-term tail through; appliers skip the unknown op
+                # (decode_op_args whitelists, _apply_committed isolates)
+                self.log.append({"term": self.term, "op": "noop",
+                                 "data": {}})
         if self.role is Role.PRIMARY:
             self._heartbeat()
 

@@ -1,0 +1,567 @@
+"""Read fleet: WAL-shipping replicas that rebuild their device indexes.
+
+ROADMAP item 1 / ISSUE 12 — the read half of multi-host scale-out. The
+host-side HA port (ha_standby.py) streams WAL batches, heartbeats and
+fencing epochs between engines, but a standby's copy of the data was
+invisible to every serving surface: streamed records land at the BASE
+``WALEngine`` — below the Namespaced/Listenable layers — so the
+mutation listeners that feed the search indexes, the qdrant
+per-collection caches and the executor's columnar snapshots never fire.
+A standby could vote in a quorum but could not answer a query.
+
+This module closes that gap and stands the standby up as a *read
+replica*:
+
+- :class:`FleetStandby` extends ``HAStandby`` with replication-lag
+  truth: the primary's ``last_seq`` (carried by every heartbeat) and
+  the max streamed seq are tracked next to ``applied_seq``, so
+  ``lag_ops()`` is exact in WAL operations, and ``catching_up`` is
+  observable while a gap repair / rejoin sync is in flight;
+- :class:`ReadReplica` owns a full DB facade over the standby engine
+  and installs the ``WALEngine.on_applied`` replay hook: every applied
+  record is translated back to its LOGICAL shape (namespace prefix
+  stripped) and fanned out through the replica's own mutation
+  listeners and ``SearchService.index_node``/``remove_node`` — the
+  exact add/update/delete paths a local write takes, so changelogs,
+  freshness ladders and background device rebuilds (PRs 2/4/6/8) work
+  unchanged on replayed traffic. Bulk ``delete_by_prefix`` records
+  reconcile via ``SearchService.prune_missing``;
+- readiness: ``ready_reasons()`` yields ``replica_lag:<node>`` when
+  ``lag_ops()`` exceeds ``NORNICDB_READY_MAX_LAG_OPS`` and
+  ``catching_up:<node>`` during a sync — surfaced by the replica's own
+  ``/readyz`` (api/http_server.py reads ``db.fleet_node``) and by the
+  fleet router's drain decision (api/fleet_router.py);
+- :class:`ReadFleet` builds the in-process 1-primary/N-replica
+  topology (real loopback ``ClusterTransport`` sockets, directly
+  callable handlers for fencing tests — the ha_standby.py discipline)
+  and wires the router.
+
+Failover: ``FleetStandby.promote`` rides the stock fencing path (epoch
+bump + best-effort fence); the promotion callback re-points the fleet
+router's write target and re-registers the node's observability
+resources exactly once (obs/resources.register is a no-op for the same
+object, ISSUE 11).
+
+Observability (docs/observability.md catalog): scrape-time collector
+gauges ``nornicdb_replica_lag_ops``/``_applied_seq``/``_catching_up``
+per node plus the ``nornicdb_fleet_failover_total`` event counter;
+per-read routing counters live in api/fleet_router.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from nornicdb_tpu.obs.metrics import REGISTRY
+from nornicdb_tpu.replication.ha_standby import HAStandby
+from nornicdb_tpu.replication.replicator import ReplicationConfig
+from nornicdb_tpu.storage.types import Edge, Node
+
+_LAG_G = REGISTRY.gauge(
+    "nornicdb_replica_lag_ops",
+    "WAL operations between the primary's last_seq and this replica's "
+    "applied watermark", labels=("node",))
+_APPLIED_G = REGISTRY.gauge(
+    "nornicdb_replica_applied_seq",
+    "Last WAL seq this replica has applied", labels=("node",))
+_CATCH_G = REGISTRY.gauge(
+    "nornicdb_replica_catching_up",
+    "1 while a catch-up sync (rejoin / gap repair) is in flight",
+    labels=("node",))
+_FAILOVER_C = REGISTRY.counter(
+    "nornicdb_fleet_failover_total",
+    "Fleet failover events (promote, fence_rejected, step_down)",
+    labels=("event",))
+
+# live replicas for the scrape-time gauge collector (weak — a closed
+# fleet's series disappear instead of freezing at their last value)
+_lock = threading.Lock()
+_replicas: Dict[str, "weakref.ref[ReadReplica]"] = {}
+
+
+def _track(replica: "ReadReplica") -> None:
+    with _lock:
+        _replicas[replica.name] = weakref.ref(replica)
+
+
+def update_fleet_gauges(registry=None) -> None:
+    """Collector body: per-node replication gauges derived from the
+    live :class:`ReadReplica` objects on every scrape."""
+    reg = registry if registry is not None else REGISTRY
+    dead: List[str] = []
+    with _lock:
+        items = list(_replicas.items())
+    for name, ref in items:
+        r = ref()
+        if r is None or r.closed:
+            dead.append(name)
+            continue
+        st = r.standby
+        if st is None:
+            continue
+        lag = reg.gauge(_LAG_G.name, _LAG_G.help, labels=("node",))
+        lag.labels(name).set(float(st.lag_ops()))
+        reg.gauge(_APPLIED_G.name, _APPLIED_G.help,
+                  labels=("node",)).labels(name).set(float(st.applied_seq))
+        reg.gauge(_CATCH_G.name, _CATCH_G.help,
+                  labels=("node",)).labels(name).set(
+            1.0 if st.catching_up else 0.0)
+    if dead and reg is REGISTRY:
+        with _lock:
+            for name in dead:
+                _replicas.pop(name, None)
+        for g in (_LAG_G, _APPLIED_G, _CATCH_G):
+            for name in dead:
+                g.remove((name,))
+
+
+REGISTRY.add_collector(update_fleet_gauges)
+
+
+class FleetStandby(HAStandby):
+    """HAStandby + replication-lag truth.
+
+    ``primary_last_seq`` advances from every accepted heartbeat (the
+    primary stamps ``last_seq``) and every accepted WAL batch (max
+    record seq), never from fenced messages — a deposed primary's
+    inflated watermark must not make a healthy replica look behind."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.primary_last_seq = 0
+        self._catching = 0
+        self._catch_lock = threading.Lock()
+
+    def _apply_record(self, op, data, seq: int = 0):
+        # apply AND log UNDER THE PRIMARY'S SEQ (WALEngine.apply_and_log
+        # with seq pinned): the replica's own WAL mirrors the primary's
+        # numbering record-for-record even when this replica joined
+        # mid-history (the primary's pre-snapshot segments are pruned,
+        # so the first shipped record may be seq 50001 — logging it at
+        # local seq 1 would skew the watermark by the whole pruned
+        # prefix). Promotion then CONTINUES the numbering — surviving
+        # peers at watermark N accept the new primary's N+1 instead of
+        # silently dropping a restarted stream — restarts resume from
+        # the true watermark, and this node can serve wal_sync
+        # catch-ups itself once promoted.
+        self.engine.apply_and_log(op, data, seq=seq if seq > 0 else None)
+
+    def _apply_snapshot(self, state, snap_seq: int) -> int:
+        # base impl applies through apply_record, so the replica's
+        # on_applied index fan-out fires per entry; afterwards pin the
+        # local WAL counter at the snapshot seq and persist the
+        # bootstrapped state as a LOCAL snapshot — the streamed tail
+        # then appends under the primary's numbering with no gap and a
+        # restart resumes from the true watermark
+        n = super()._apply_snapshot(state, snap_seq)
+        self.engine.wal.advance_seq(snap_seq)
+        try:
+            self.engine.snapshot()
+        except Exception:  # noqa: BLE001 — bootstrap still succeeded
+            pass
+        return n
+
+    # -- handlers --------------------------------------------------------
+
+    def handle_heartbeat(self, msg):
+        r = super().handle_heartbeat(msg)
+        if r.get("ok"):
+            with self._lock:
+                self.primary_last_seq = max(
+                    self.primary_last_seq, int(msg.get("last_seq", 0) or 0))
+        return r
+
+    def handle_wal_batch(self, msg):
+        r = super().handle_wal_batch(msg)
+        if "error" not in r:
+            seqs = [int(rec.get("seq", 0) or 0)
+                    for rec in msg.get("records", [])]
+            if seqs:
+                with self._lock:
+                    self.primary_last_seq = max(self.primary_last_seq,
+                                                max(seqs))
+        else:
+            _FAILOVER_C.labels("fence_rejected").inc()
+        return r
+
+    # -- lag truth -------------------------------------------------------
+
+    def catch_up(self, addr=None) -> int:
+        with self._catch_lock:
+            self._catching += 1
+        try:
+            n = super().catch_up(addr)
+        finally:
+            with self._catch_lock:
+                self._catching -= 1
+        if n:
+            with self._lock:
+                self.primary_last_seq = max(self.primary_last_seq,
+                                            self.applied_seq)
+        return n
+
+    @property
+    def catching_up(self) -> bool:
+        with self._catch_lock:
+            return self._catching > 0
+
+    def lag_ops(self) -> int:
+        with self._lock:
+            return max(0, self.primary_last_seq - self.applied_seq)
+
+
+class ReadReplica:
+    """One read replica: a DB facade whose base engine applies the
+    primary's WAL stream, with every applied record fanned out into the
+    replica's own listeners and search indexes.
+
+    The DB chain is the standard standby chain (writes raise
+    ``NotPrimaryError`` through the ReplicatedEngine until promotion);
+    reads — vector, hybrid, qdrant, Cypher — serve from local state.
+    ``auto_embed`` stays off: embeddings are computed once, on the
+    primary, and arrive in the replicated ``update_node`` records."""
+
+    def __init__(
+        self,
+        name: str,
+        data_dir: str,
+        database: str = "neo4j",
+        heartbeat_interval: float = 0.25,
+        failover_timeout: float = 30.0,
+        listen: Tuple[str, int] = ("127.0.0.1", 0),
+        on_promote: Optional[Callable[["ReadReplica"], None]] = None,
+    ):
+        from nornicdb_tpu.db import DB
+
+        self.name = str(name)
+        self.database = database
+        self.on_promote = on_promote
+        self.closed = False
+        self._promoted_once = False
+        self._prefix = database + ":"
+        cfg = ReplicationConfig(
+            mode="ha_standby", ha_role="standby", node_id=self.name,
+            listen=listen, heartbeat_interval=heartbeat_interval,
+            failover_timeout=failover_timeout,
+            standby_cls=FleetStandby,
+            on_promote=self._on_promoted,
+        )
+        self.db = DB(data_dir, engine="python", auto_embed=False,
+                     database=database, replication=cfg)
+        # per-node resource identity BEFORE the lazy search service
+        # builds (service:<db>@<node> — in-process fleets share one obs
+        # registry; colliding names would swap each other's gauges)
+        self.db._search_resource_name = f"service:{database}@{self.name}"
+        self.db.fleet_node = self  # /readyz reads ready_reasons()
+        self.standby: FleetStandby = self.db.replicator
+        self.transport = self.db._cluster_transport
+        # resume the watermark from the local WAL: applied records are
+        # logged seq-aligned with the primary (FleetStandby
+        # _apply_record), so after a restart the replica pulls only the
+        # tail instead of replaying full history
+        self.standby.applied_seq = self.db._base.wal.last_seq
+        # replay fan-out: every record the standby applies at the base
+        # WALEngine re-enters the replica's index/listener paths
+        self.db._base.on_applied = self._on_applied
+        # build the search service EAGERLY: a serving replica must not
+        # pay the full index backfill on its first query (and the lazy
+        # publish-before-backfill window would let a racing read see a
+        # half-built index); from here on the replay fan-out keeps the
+        # indexes current incrementally
+        self.db.search
+        _track(self)
+
+    # -- topology --------------------------------------------------------
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return self.transport.addr
+
+    def attach(self, primary_addr: Tuple[str, int],
+               peer_addrs: Sequence[Tuple[str, int]] = ()) -> None:
+        """Point this replica at its primary (and the sibling replicas
+        it would stream to after a promotion), then pull history."""
+        self.standby.primary_addr = tuple(primary_addr)
+        self.standby.config.peers = [tuple(a) for a in peer_addrs]
+        self.catch_up()
+
+    def catch_up(self) -> int:
+        return self.standby.catch_up()
+
+    # -- replay fan-out --------------------------------------------------
+
+    def _logical_node(self, data: Dict[str, Any]) -> Optional[Node]:
+        node = Node.from_dict(data)
+        if not node.id.startswith(self._prefix):
+            return None  # another logical database on the same store
+        node.id = node.id[len(self._prefix):]
+        return node
+
+    def _logical_edge(self, data: Dict[str, Any]) -> Optional[Edge]:
+        edge = Edge.from_dict(data)
+        if not edge.id.startswith(self._prefix):
+            return None
+        edge.id = edge.id[len(self._prefix):]
+        if edge.start_node.startswith(self._prefix):
+            edge.start_node = edge.start_node[len(self._prefix):]
+        if edge.end_node.startswith(self._prefix):
+            edge.end_node = edge.end_node[len(self._prefix):]
+        return edge
+
+    def _on_applied(self, op: str, data: Dict[str, Any]) -> None:
+        """Replay fan-out: one applied WAL record -> the same listener
+        events and index mutations the write produced on the primary.
+        Replicated embeddings ride the node dict, so ``index_node``
+        lands them straight in the device indexes (brute changelog,
+        BM25, CAGRA rebuild triggers — the standard freshness paths)."""
+        listeners = self.db._listenable._each()
+        svc = self.db._search
+        if op in ("create_node", "update_node"):
+            node = self._logical_node(data)
+            if node is None:
+                return
+            for listener in listeners:
+                try:
+                    listener.on_node_upsert(node)
+                except Exception:  # noqa: BLE001 — listener isolation
+                    pass
+            if svc is not None:
+                svc.index_node(node)
+        elif op == "delete_node":
+            nid = str(data.get("id", ""))
+            if not nid.startswith(self._prefix):
+                return
+            nid = nid[len(self._prefix):]
+            for listener in listeners:
+                try:
+                    listener.on_node_delete(nid)
+                except Exception:  # noqa: BLE001
+                    pass
+            if svc is not None:
+                svc.remove_node(nid)
+        elif op in ("create_edge", "update_edge"):
+            edge = self._logical_edge(data)
+            if edge is None:
+                return
+            for listener in listeners:
+                try:
+                    listener.on_edge_upsert(edge)
+                except Exception:  # noqa: BLE001
+                    pass
+        elif op == "delete_edge":
+            eid = str(data.get("id", ""))
+            if not eid.startswith(self._prefix):
+                return
+            eid = eid[len(self._prefix):]
+            for listener in listeners:
+                try:
+                    listener.on_edge_delete(eid)
+                except Exception:  # noqa: BLE001
+                    pass
+        elif op == "delete_by_prefix":
+            # bulk record: no per-node events exist, reconcile instead
+            for listener in listeners:
+                try:
+                    listener.on_bulk_change()
+                except Exception:  # noqa: BLE001
+                    pass
+            if svc is not None:
+                svc.prune_missing()
+
+    # -- readiness -------------------------------------------------------
+
+    def ready_reasons(self, max_lag_ops: Optional[int] = None) -> List[str]:
+        """Reasons this replica must drain instead of serving reads:
+        ``replica_lag:<node>(lag/max)`` past the env-tunable
+        ``NORNICDB_READY_MAX_LAG_OPS`` threshold, ``catching_up:<node>``
+        while a rejoin/gap sync runs. Empty list = ready."""
+        from nornicdb_tpu.config import env_int
+
+        if max_lag_ops is None:
+            max_lag_ops = env_int("READY_MAX_LAG_OPS", 512)
+        reasons: List[str] = []
+        st = self.standby
+        if st is None or self.closed:
+            return [f"replica_closed:{self.name}"]
+        if st.catching_up:
+            reasons.append(f"catching_up:{self.name}")
+        lag = st.lag_ops()
+        if lag > max_lag_ops:
+            reasons.append(f"replica_lag:{self.name}({lag}/{max_lag_ops})")
+        return reasons
+
+    def rebuild_in_flight(self) -> bool:
+        """True while any of this replica's own index structures runs a
+        background rebuild — the router drains a mid-rebuild replica
+        (same signal the node's /readyz index_rebuild reasons carry)."""
+        svc = self.db._search
+        if svc is None:
+            return False
+        for obj in (svc.vectors, svc.bm25, svc.cagra):
+            if obj is None:
+                continue
+            try:
+                if obj.resource_stats().get("rebuild_in_flight"):
+                    return True
+            except Exception:  # noqa: BLE001
+                continue
+        return False
+
+    def is_replica(self) -> bool:
+        from nornicdb_tpu.replication.replicator import Role
+
+        st = self.standby
+        return st is not None and st.role is Role.STANDBY
+
+    # -- read dispatch (router entry points) -----------------------------
+
+    def vec_dispatch(self, key: str, queries, k: int):
+        """The WirePlane vec-dispatch contract served from THIS
+        replica's device indexes — the SAME key vocabulary the plane's
+        local dispatch resolves (api/wire_plane.resolve_vec_dispatch),
+        so plane and replica can never drift apart."""
+        from nornicdb_tpu.api.wire_plane import resolve_vec_dispatch
+
+        return resolve_vec_dispatch(self.db, key, queries, k)
+
+    # -- failover --------------------------------------------------------
+
+    def promote(self) -> None:
+        self.standby.promote()
+
+    def _on_promoted(self, standby) -> None:
+        """Promotion side effects, exactly once: the failover counter
+        ticks on the transition only, and the node's obs resources
+        re-register idempotently (register() is a no-op for the same
+        object — a double promote cannot churn a weakref or drop a
+        series mid-scrape)."""
+        if not self._promoted_once:
+            self._promoted_once = True
+            _FAILOVER_C.labels("promote").inc()
+        self._register_resources()
+        if self.on_promote is not None:
+            try:
+                self.on_promote(self)
+            except Exception:  # noqa: BLE001 — router hook isolation
+                pass
+
+    def _register_resources(self) -> None:
+        from nornicdb_tpu.obs import register_resource
+
+        svc = self.db._search
+        if svc is None:
+            return
+        register_resource("bm25", svc.resource_name, svc.bm25)
+        register_resource("brute", svc.resource_name, svc.vectors)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self.db._base.on_applied = None
+        except Exception:  # noqa: BLE001
+            pass
+        self.db.close()
+
+
+class ReadFleet:
+    """In-process 1-primary/N-replica topology over real loopback
+    transports — the testable fleet (SURVEY §4 "multi-node without a
+    real cluster"; handlers stay directly callable for fencing tests).
+
+    Construction order matters: replicas first (their transport
+    addresses become the primary's peer set), then the primary, then
+    each replica attaches (primary addr + sibling peers) and pulls
+    history. ``router`` is a :class:`~nornicdb_tpu.api.fleet_router.
+    FleetRouter` over the topology; admission stays parity-gated —
+    call ``admit_all`` with probe vectors once the corpus is loaded."""
+
+    def __init__(
+        self,
+        base_dir: str,
+        n_replicas: int = 2,
+        database: str = "neo4j",
+        sync: str = "async",
+        heartbeat_interval: float = 0.1,
+        failover_timeout: float = 30.0,
+        auto_embed: bool = False,
+    ):
+        import os
+
+        from nornicdb_tpu.api.fleet_router import FleetRouter
+        from nornicdb_tpu.db import DB
+
+        self.replicas: List[ReadReplica] = []
+        self.primary_db = None
+        try:
+            for i in range(n_replicas):
+                self.replicas.append(ReadReplica(
+                    f"replica-{i}",
+                    os.path.join(base_dir, f"replica-{i}"),
+                    database=database,
+                    heartbeat_interval=heartbeat_interval,
+                    failover_timeout=failover_timeout,
+                ))
+            cfg = ReplicationConfig(
+                mode="ha_standby", ha_role="primary", node_id="primary",
+                sync=sync, peers=[r.addr for r in self.replicas],
+                heartbeat_interval=heartbeat_interval,
+                failover_timeout=failover_timeout,
+            )
+            self.primary_db = DB(
+                os.path.join(base_dir, "primary"), engine="python",
+                auto_embed=auto_embed, database=database,
+                replication=cfg)
+            primary_addr = self.primary_db._cluster_transport.addr
+            for r in self.replicas:
+                peers = [o.addr for o in self.replicas if o is not r]
+                r.attach(primary_addr, peers)
+            self.router = FleetRouter(self.primary_db)
+            for r in self.replicas:
+                r.on_promote = self._promoted
+                self.router.add_replica(r)
+        except BaseException:
+            self.close()
+            raise
+
+    def _promoted(self, replica: ReadReplica) -> None:
+        self.router.on_promote(replica)
+
+    def wait_converged(self, timeout_s: float = 10.0) -> bool:
+        """Block until every replica's applied watermark reaches the
+        primary's current last_seq (bounded)."""
+        self.primary_db._base.wal.flush()
+        target = self.primary_db._base.wal.last_seq
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if all(r.standby.applied_seq >= target for r in self.replicas):
+                return True
+            for r in self.replicas:
+                if r.standby.applied_seq < target:
+                    r.catch_up()
+            time.sleep(0.02)
+        return False
+
+    def admit_all(self, probes, k: int = 10) -> Dict[str, float]:
+        """Parity-gated admission of every replica (router.admit):
+        probe vectors answered by the replica's device path are scored
+        against the primary's exact host reference at the PR 10 floors
+        (exact 1.0 / statistical 0.95)."""
+        return {r.name: self.router.admit(r.name, probes, k=k)
+                for r in self.replicas}
+
+    def close(self) -> None:
+        for r in self.replicas:
+            try:
+                r.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.primary_db is not None:
+            try:
+                self.primary_db.close()
+            except Exception:  # noqa: BLE001
+                pass

@@ -54,6 +54,14 @@ class ReplicationConfig:
         default_factory=list
     )
     xregion_interval: float = 0.1
+    # read-fleet hooks (replication/read_fleet.py): a subclass to stand
+    # in for HAStandby/HAPrimary (the fleet's standby tracks replication
+    # lag and fans applied records out to the search indexes), and a
+    # promotion callback so the fleet router can re-point writes. None
+    # keeps the stock classes — existing configs are untouched.
+    standby_cls: Optional[Any] = None
+    primary_cls: Optional[Any] = None
+    on_promote: Optional[Any] = None
 
 
 class Replicator:

@@ -125,6 +125,7 @@ class SearchService:
         vector_registry: Optional[Any] = None,
         persist_dir: Optional[str] = None,
         save_debounce_s: float = 5.0,
+        resource_name: Optional[str] = None,
     ):
         self.storage = storage
         self.embedder = embedder
@@ -200,11 +201,15 @@ class SearchService:
         # service's series disappear with it.
         from nornicdb_tpu.obs import register_resource
 
-        register_resource("bm25", f"service:{database}", self.bm25)
-        register_resource("brute", f"service:{database}", self.vectors)
-        register_resource("queue", f"service:{database}:vector",
+        # resource identity: "service:<db>" unless the caller tags this
+        # service (read replicas pass "service:<db>@<node>" so an
+        # in-process fleet's per-replica gauges never collide)
+        self.resource_name = resource_name or f"service:{database}"
+        register_resource("bm25", self.resource_name, self.bm25)
+        register_resource("brute", self.resource_name, self.vectors)
+        register_resource("queue", f"{self.resource_name}:vector",
                           self._microbatch)
-        register_resource("queue", f"service:{database}:hybrid",
+        register_resource("queue", f"{self.resource_name}:hybrid",
                           self._hybrid_batch)
 
     def _ann_search_batch(self, queries, k):
@@ -301,12 +306,12 @@ class SearchService:
             from nornicdb_tpu.obs import register_resource
 
             register_resource("device_bm25",
-                              f"service:{self.database}", f.lex)
+                              self.resource_name, f.lex)
             if f.cagra is not None and f.cagra is not self.cagra:
                 # pipeline-owned graph (walk tier without the cagra
                 # strategy profile): account for its device arrays too
                 register_resource(
-                    "cagra", f"service:{self.database}:hybrid_walk",
+                    "cagra", f"{self.resource_name}:hybrid_walk",
                     f.cagra)
         if not f.ensure():
             return None  # first build runs in background; host serves
@@ -470,6 +475,30 @@ class SearchService:
         self._clear_result_cache()
         self._schedule_save()
 
+    def prune_missing(self) -> int:
+        """Drop every indexed id whose storage node no longer exists.
+        Bulk deletions that bypass per-node mutation events — a
+        ``delete_by_prefix`` WAL record replayed on a read replica, a
+        database drop under a shared store — leave the indexes holding
+        tombstone-less ghosts; this reconciles them through the same
+        ``remove_node`` path a live delete takes (changelogs, rebuild
+        triggers and freshness ladders all see ordinary removals).
+        Returns the number of ids pruned."""
+        if self.storage is None:
+            return 0
+        with self._lock:
+            indexed = set(self.bm25.ids()) | set(self.vectors.ids())
+        pruned = 0
+        for nid in indexed:
+            try:
+                missing = not self.storage.has_node(nid)
+            except Exception:  # noqa: BLE001 — storage races resolve next sweep
+                continue
+            if missing:
+                self.remove_node(nid)
+                pruned += 1
+        return pruned
+
     def build_indexes(self) -> int:
         """Index every node in storage (reference: BuildIndexes :2246).
         Returns count indexed. With a persist_dir, a valid on-disk
@@ -591,8 +620,8 @@ class SearchService:
             # re-point the resource gauges at the restored structures
             from nornicdb_tpu.obs import register_resource
 
-            register_resource("bm25", f"service:{self.database}", bm25)
-            register_resource("brute", f"service:{self.database}",
+            register_resource("bm25", self.resource_name, bm25)
+            register_resource("brute", self.resource_name,
                               vectors)
             self.hnsw = hnsw
             # any prior graph wraps the REPLACED brute index — drop it
@@ -698,7 +727,7 @@ class SearchService:
         # one graph, one rebuild cadence, no second copy in HBM
         from nornicdb_tpu.obs import register_resource
 
-        register_resource("cagra", f"service:{self.database}", idx)
+        register_resource("cagra", self.resource_name, idx)
         # surface the graph index as its own vector space, mirroring the
         # hnsw tier (reference: backend kinds, registry.go:1-60)
         cagra_space = self.vector_registry.get_or_create(

@@ -100,6 +100,13 @@ class BruteForceIndex:
         with self._lock:
             return {e for e in ext_ids if e in self._slot_of}
 
+    def ids(self) -> List[str]:
+        """Live external ids under one lock hold — the maintenance
+        sweep (SearchService.prune_missing, replica bulk-delete replay)
+        reconciles these against storage."""
+        with self._lock:
+            return list(self._slot_of.keys())
+
     @staticmethod
     def _normalize(v: np.ndarray) -> np.ndarray:
         n = np.linalg.norm(v)

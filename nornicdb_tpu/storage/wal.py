@@ -184,11 +184,24 @@ class WAL:
         with self._lock:
             return self._seq
 
-    def append(self, op: str, data: Dict[str, Any]) -> int:
-        """Append one record; returns its sequence number."""
+    def append(self, op: str, data: Dict[str, Any],
+               seq: Optional[int] = None) -> int:
+        """Append one record; returns its sequence number. ``seq``
+        pins the record to an EXTERNAL sequence number instead of the
+        local counter — read replicas (replication/read_fleet.py) log
+        streamed records under the primary's numbering so their WAL
+        stays seq-aligned even when they join mid-history (the
+        primary's pre-snapshot segments are pruned, so the first
+        shipped record may be seq 50001, not 1). The counter jumps
+        forward to the pinned seq; a pinned seq at or below the
+        counter is a replay overlap and appends under the counter as
+        usual."""
         t0 = time.perf_counter()
         with self._lock:
-            self._seq += 1
+            if seq is not None and seq > self._seq:
+                self._seq = seq
+            else:
+                self._seq += 1
             rec = {"seq": self._seq, "op": op, "data": data}
             payload = self._encode(rec)
             frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
@@ -203,6 +216,31 @@ class WAL:
             seq = self._seq
         _APPEND_H.observe(time.perf_counter() - t0)
         return seq
+
+    def earliest_retained_seq(self) -> int:
+        """Lowest watermark the segment files can serve a COMPLETE
+        record stream from: ``iter_records(from_seq=N)`` misses pruned
+        history iff ``N < earliest_retained_seq()``. Snapshot pruning
+        keeps ``retained_segments`` pre-snapshot segments, so a
+        routinely-lagging standby inside that window catches up from
+        records; only a standby behind the retention horizon needs the
+        snapshot."""
+        with self._lock:
+            segs = self._segment_paths()
+            if segs:
+                # a segment named with start seq S holds records > S
+                return self._segment_start_seq(segs[0])
+            return self._seq
+
+    def advance_seq(self, seq: int) -> None:
+        """Jump the sequence counter forward (never backward) without
+        writing a record. A read replica bootstrapping from a shipped
+        primary snapshot uses this so its counter lands on the
+        snapshot's seq — the streamed tail then appends under the
+        primary's numbering with no gap."""
+        with self._lock:
+            if seq > self._seq:
+                self._seq = seq
 
     def _ensure_segment(self, incoming: int) -> None:
         if self._fh is not None and self._fh_size + incoming <= self.max_segment_bytes:

@@ -56,6 +56,16 @@ class WALEngine(EngineDecorator):
         self._since_compact = 0
         self._lock = threading.Lock()
         self._mut = threading.Lock()
+        # replay fan-out hook (replication/read_fleet.py): a read
+        # replica applies streamed WAL records at THIS engine, below the
+        # Namespaced/Listenable layers, so mutation listeners — the
+        # search-index feed, cache invalidation — never fire for
+        # replicated writes. A replica sets ``on_applied(op, data)`` to
+        # route every applied record into its own index/listener fan-out
+        # (same add/update/delete paths a local write takes). None (the
+        # default) keeps replay exactly as before; crash recovery runs
+        # before the hook is installed.
+        self.on_applied = None
 
     # -- replay plumbing -------------------------------------------------
 
@@ -72,7 +82,34 @@ class WALEngine(EngineDecorator):
             # a delete of an already-deleted entity, or a record written by
             # a newer version with an op this build doesn't know —
             # idempotent, forward-compatible replay
-            pass
+            return
+        cb = self.on_applied
+        if cb is not None:
+            try:
+                cb(op, data)
+            except Exception:  # noqa: BLE001 — fan-out must not poison replay
+                pass
+
+    def apply_and_log(self, op: str, data: Dict[str, Any],
+                      seq: Optional[int] = None) -> int:
+        """Idempotent replay apply PLUS a local WAL append, returning
+        the appended seq. Read replicas (replication/read_fleet.py)
+        apply streamed records through this so the replica's own WAL
+        mirrors the primary's seq space record-for-record — ``seq``
+        pins the PRIMARY's number (a replica joining mid-history sees
+        its first record at the primary's post-compaction watermark,
+        not 1): promotion then CONTINUES the numbering (surviving
+        peers at watermark N accept the new primary's N+1 instead of
+        dropping a restarted seq 1 as a duplicate), restarts resume
+        from the true watermark, and a rejoining node can catch up
+        from the promoted replica's log. Never used by crash recovery
+        — ``recover()`` replays via ``apply_record``, which does not
+        append."""
+        self.apply_record(op, data)
+        with self._mut:
+            out = self.wal.append(op, data, seq=seq)
+        self._maybe_compact()
+        return out
 
     def recover(self) -> ReplayResult:
         """Restore snapshot state into inner, then replay the WAL tail.

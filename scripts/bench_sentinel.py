@@ -108,6 +108,15 @@ CHECKS: Dict[str, Tuple] = {
     # the baseline predates the metric (PR 6/8 precedent).
     "shadow_parity_exact": ("quality", 1.0, 0.0),
     "shadow_parity_statistical": ("quality", 0.95, 0.02),
+    # read fleet (round r12+): router read rate over the 2-replica
+    # in-process topology (contended-box caveat applies — the floor
+    # catches collapse), and the parity-gated-admission verdict.
+    # The bench fleet serves through the exact brute tier, so
+    # replica_parity gates ABSOLUTELY at the exact-contract floor 1.0
+    # (PR 10 precedent) from the first round it appears — a replica
+    # admitted on a wrong answer is a correctness bug, not noise.
+    "fleet_read_qps": ("qps", 0.5),
+    "replica_parity": ("quality", 1.0, 0.0),
 }
 
 
@@ -201,6 +210,16 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     out["shadow_parity_statistical"] = _num(
         load.get("shadow_parity_statistical") if is_summary
         else _g(load, "shadow_parity", "statistical"))
+    # read fleet (round r12+): the summary packs [qps, scaling,
+    # parity, drain] (tail-window economy); the full artifact carries
+    # the named keys
+    fl = doc.get("fleet") or {}
+    if isinstance(fl, list):
+        out["fleet_read_qps"] = _num(fl[0]) if len(fl) > 0 else None
+        out["replica_parity"] = _num(fl[2]) if len(fl) > 2 else None
+    else:
+        out["fleet_read_qps"] = _num(fl.get("fleet_read_qps"))
+        out["replica_parity"] = _num(fl.get("replica_parity"))
     surfaces = doc.get("surfaces") or {}
     for name in ("bolt", "neo4j_http", "graphql", "rest_search",
                  "qdrant_grpc"):

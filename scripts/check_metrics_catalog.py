@@ -47,6 +47,8 @@ IMPORT_TIME_MODULES = (
     "nornicdb_tpu.api.bolt",
     "nornicdb_tpu.api.http_server",
     "nornicdb_tpu.api.qdrant_official_grpc",
+    "nornicdb_tpu.api.fleet_router",       # read-fleet router (ISSUE 12)
+    "nornicdb_tpu.replication.read_fleet",  # replica lag/failover gauges
 )
 
 _PREFIX = "nornicdb_"

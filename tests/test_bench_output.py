@@ -115,6 +115,15 @@ def _fake_result():
                   "quant_recall10": 0.97,
                   "compression_ratio": 14.2,
                   "speedup_int8_vs_f32": 1.18},
+        "fleet": {"replicas": 2, "n": 4000, "dims": 64,
+                  "converged": True, "replica_parity": 1.0,
+                  "admitted": 2, "single_read_qps": 5300.0,
+                  "fleet_read_qps": 2600.0, "read_scaling": 0.49,
+                  "replay_lag": {"burst_ops": 1500,
+                                 "peak_lag_ops": 447,
+                                 "drain_s": 1.09},
+                  "drain": {"breached_drained": True,
+                            "ledger_reason": True, "recovered": True}},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -175,6 +184,10 @@ class TestCompactSummary:
                               "chain_conc_device_qps": 3100.0,
                               "traverse_rank_qps_b16": 13000.0,
                               "compile_buckets": 7}
+        # read fleet (ISSUE 12), packed [qps, scaling, parity, drain]:
+        # router read rate, scaling vs single node, the parity-gated-
+        # admission verdict (sentinel absolute floor 1.0), drain flag
+        assert s["fleet"] == [2600.0, 0.49, 1.0, True]
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -248,7 +261,8 @@ class TestBenchDryRunArtifactSchema:
 
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
                     "knn", "northstar", "ann", "hybrid", "quant",
-                    "surfaces", "telemetry", "load", "tpu_proof")
+                    "surfaces", "telemetry", "load", "fleet",
+                    "tpu_proof")
 
     def test_dry_run_artifact_schema(self, dry_run_lines):
         lines = dry_run_lines
@@ -469,6 +483,36 @@ class TestBenchDryRunArtifactSchema:
         assert summary["load"]["wire_knee_qps"]["2"] is not None
         assert "wire_batch_mean" in summary["load"]
         assert len(lines[-1]) < 2600
+
+    def test_fleet_stage_schema(self, dry_run_lines):
+        """Read-fleet stage (ISSUE 12): the tiny 1-primary/2-replica
+        topology must converge, pass parity-gated admission at the
+        exact-contract floor, measure both read rates, and prove the
+        drain-on-breach round trip — in every dry run."""
+        full = json.loads(dry_run_lines[0])
+        summary = json.loads(dry_run_lines[-1])
+        fl = full["fleet"]
+        assert "error" not in fl, fl
+        assert fl["replicas"] == 2
+        assert fl["converged"] is True
+        assert fl["admitted"] == 2
+        assert fl["replica_parity"] == 1.0  # exact-contract floor
+        assert fl["fleet_read_qps"] > 0
+        assert fl["single_read_qps"] > 0
+        assert fl["read_scaling"] > 0
+        lag = fl["replay_lag"]
+        assert lag["burst_ops"] > 0
+        assert lag["peak_lag_ops"] >= 0
+        assert lag["drain_s"] is not None and lag["drain_s"] >= 0
+        drain = fl["drain"]
+        assert drain["breached_drained"] is True
+        assert drain["ledger_reason"] is True
+        assert drain["recovered"] is True
+        # the summary packs [qps, scaling, parity, drain] for the
+        # sentinel (tail-window economy)
+        assert summary["fleet"][0] == fl["fleet_read_qps"]
+        assert summary["fleet"][2] == 1.0
+        assert summary["fleet"][3] is True
 
 
 class TestTpuProofDryRun:

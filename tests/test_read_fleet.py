@@ -1,0 +1,589 @@
+"""Read-fleet tests (ISSUE 12): WAL-shipping replicas that rebuild
+device indexes, replica-aware routing with parity-gated admission,
+/readyz lag/catch-up reasons, and fencing under replay.
+
+Topology per the ha_standby.py discipline: real loopback transports,
+handlers directly callable — multi-node without a real cluster.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs.metrics import REGISTRY
+from nornicdb_tpu.replication.read_fleet import ReadFleet
+from nornicdb_tpu.replication.replicator import (
+    NotPrimaryError,
+    Role,
+)
+
+D = 16
+
+
+@pytest.fixture(autouse=True)
+def _hash_embedder(monkeypatch):
+    # every test stores explicit vectors; the hash embedder keeps the 3
+    # DB opens per fleet cheap. Scoped via monkeypatch — a module-level
+    # environ write would leak into every later-collected test file.
+    monkeypatch.setenv("NORNICDB_TPU_EMBEDDER", "hash")
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    fl = ReadFleet(str(tmp_path), n_replicas=2, heartbeat_interval=0.05)
+    yield fl
+    fl.close()
+
+
+def _load(fl, n=24, seed=0):
+    rng = np.random.default_rng(seed)
+    vecs = rng.normal(size=(n, D)).astype(np.float32)
+    for i in range(n):
+        fl.primary_db.store(
+            f"alpha doc {i} topic{i % 5}", node_id=f"d{i}",
+            embedding=[float(x) for x in vecs[i]])
+    assert fl.wait_converged(20.0)
+    return vecs
+
+
+def _fleet_ledger(name, reason=None):
+    return [r for r in _audit.degrade_snapshot(500)
+            if r.get("surface") == "fleet" and r.get("index") == name
+            and (reason is None or r.get("reason") == reason)]
+
+
+def _counter_children(metric):
+    fam = REGISTRY.get(metric)
+    if fam is None:
+        return {}
+    return {k: c.value for k, c in fam._children.items()}
+
+
+class TestReplicaIndexRebuild:
+    def test_wal_stream_rebuilds_replica_search_indexes(self, fleet):
+        """Replicated create/update records land in the replica's own
+        BM25 + brute indexes via the standard index_node path; vector
+        answers are parity-identical to the primary's exact host
+        reference and hybrid text search matches the primary."""
+        vecs = _load(fleet)
+        for r in fleet.replicas:
+            dev = r.db.search.vector_search_candidates(
+                vecs[3], k=5, exact=True)
+            ref = fleet.primary_db.search.vector_search_candidates(
+                vecs[3], k=5, exact=True)
+            assert _audit.ShadowAuditor.parity_of(
+                [(i, float(s)) for i, s in dev],
+                [(i, float(s)) for i, s in ref], 5, exact=True) == 1.0
+            got = [h["id"] for h in r.db.search.search(
+                query="alpha topic2", limit=5, enrich=False,
+                query_embedding=[float(x) for x in vecs[2]])]
+            want = [h["id"] for h in fleet.primary_db.search.search(
+                query="alpha topic2", limit=5, enrich=False,
+                query_embedding=[float(x) for x in vecs[2]])]
+            assert got == want and got
+
+    def test_update_and_delete_propagate(self, fleet):
+        vecs = _load(fleet)
+        db = fleet.primary_db
+        # re-point d1's embedding at d7's direction: replicas must
+        # re-index through the same update path
+        node = db.storage.get_node("d1")
+        node.embedding = [float(x) for x in vecs[7]]
+        db.storage.update_node(node)
+        db.storage.delete_node("d2")
+        assert fleet.wait_converged(10.0)
+        for r in fleet.replicas:
+            hits = r.db.search.vector_search_candidates(
+                vecs[7], k=3, exact=True)
+            ids = [h[0] for h in hits]
+            assert "d1" in ids  # updated vector serves
+            all_ids = [h[0] for h in r.db.search.vector_search_candidates(
+                vecs[2], k=24, exact=True)]
+            assert "d2" not in all_ids  # delete propagated
+
+    def test_qdrant_collection_replicates(self, fleet):
+        db = fleet.primary_db
+        rng = np.random.default_rng(3)
+        pvecs = rng.normal(size=(12, D)).astype(np.float32)
+        db.qdrant_compat.create_collection(
+            "fleetc", {"size": D, "distance": "Cosine"})
+        db.qdrant_compat.upsert_points("fleetc", [
+            {"id": i, "vector": [float(x) for x in pvecs[i]],
+             "payload": {"i": i}} for i in range(12)])
+        assert fleet.wait_converged(10.0)
+        for r in fleet.replicas:
+            got = r.db.qdrant_compat.search_points(
+                "fleetc", list(pvecs[4]), limit=3)
+            assert got[0]["id"] == 4
+            assert got[0]["payload"]["i"] == 4
+
+    def test_delete_by_prefix_prunes_replica_indexes(self, fleet):
+        _load(fleet, n=8)
+        r0 = fleet.replicas[0]
+        assert len(r0.db.search.vectors) == 8
+        fleet.primary_db.storage.delete_by_prefix("d")
+        assert fleet.wait_converged(10.0)
+        assert len(r0.db.search.vectors) == 0
+        assert len(r0.db.search.bm25) == 0
+
+    def test_replica_rejects_writes(self, fleet):
+        _load(fleet, n=4)
+        with pytest.raises(NotPrimaryError):
+            fleet.replicas[0].db.store("nope", node_id="x1")
+
+    def test_mid_history_join_over_compacted_primary(self, fleet,
+                                                     tmp_path):
+        """A fresh replica joining a primary whose WAL was COMPACTED
+        (pre-snapshot segments pruned) must still bootstrap the full
+        state — the wal_sync reply carries the snapshot — and its WAL
+        must land on the PRIMARY's seq numbering, not a local restart
+        at 1 (the post-failover stream would otherwise be dropped by
+        survivors as duplicate history)."""
+        from nornicdb_tpu.replication.read_fleet import ReadReplica
+
+        from nornicdb_tpu.storage.types import Node
+
+        vecs = _load(fleet, n=10)
+        db = fleet.primary_db
+        # a delete INSIDE the soon-to-be-pruned range: the snapshot
+        # carries no tombstone for it, so only the reconcile semantics
+        # keep it deleted on a bootstrapping joiner
+        db.storage.delete_node("d9")
+        # force REAL pruning: every append rolls a segment and the
+        # retention window keeps none, so the snapshot is the only
+        # surviving copy of seqs 1..12 (the compacted-primary shape)
+        db._base.wal.retained_segments = 0
+        db._base.wal.max_segment_bytes = 1
+        db.store("pre compact tail", node_id="pc0", embedding=[0.6] * D)
+        db._base.snapshot()
+        assert db._base.wal.earliest_retained_seq() > 0  # history pruned
+        db.store("post compact", node_id="pc1", embedding=[0.7] * D)
+        primary_seq = db._base.wal.last_seq
+        late = ReadReplica("late-joiner", str(tmp_path / "late"),
+                           heartbeat_interval=0.05)
+        try:
+            # pre-existing local state the snapshot must overwrite and
+            # prune: a stale copy of d3 and a node the primary never had
+            late.db._base.inner.create_node(Node(
+                id="neo4j:d3", labels=["Stale"],
+                properties={"stale": True}))
+            late.db._base.inner.create_node(Node(
+                id="neo4j:ghost", labels=[], properties={}))
+            late.attach(db._cluster_transport.addr)
+            deadline = time.time() + 10.0
+            while time.time() < deadline and \
+                    late.standby.applied_seq < primary_seq:
+                late.catch_up()
+                time.sleep(0.05)
+            # full pre-compaction state arrived via the snapshot...
+            assert late.db.storage.has_node("d3")
+            assert late.db.storage.has_node("pc1")
+            # ...as an authoritative RECONCILE: the stale local copy
+            # was overwritten, the primary-deleted node did not
+            # resurrect, and the never-existed local node was pruned
+            assert late.db.storage.get_node("d3").labels != ["Stale"]
+            assert not late.db.storage.has_node("d9")
+            assert not late.db.storage.has_node("ghost")
+            # ...was indexed through the replay fan-out...
+            hits = late.db.search.vector_search_candidates(
+                vecs[3], k=1, exact=True)
+            assert hits[0][0] == "d3"
+            # ...and the local WAL mirrors the PRIMARY's numbering
+            assert late.standby.applied_seq == primary_seq
+            assert late.db._base.wal.last_seq == primary_seq
+            # promotion continues the seq space: a from-genesis
+            # replica accepts the late-joiner's stream instead of
+            # dropping it as duplicate history
+            r0 = fleet.replicas[0]
+            late.standby.config.peers = [r0.addr]
+            late.promote()
+            late.standby.apply(
+                "create_node",
+                {"id": "neo4j:from-late", "labels": [],
+                 "properties": {}})
+            deadline = time.time() + 5.0
+            while time.time() < deadline and \
+                    not r0.db.storage.has_node("from-late"):
+                time.sleep(0.05)
+            assert r0.db.storage.has_node("from-late")
+        finally:
+            late.close()
+
+    def test_restart_resumes_from_local_wal(self, fleet, tmp_path):
+        """Applied records are logged seq-aligned (apply_and_log), so a
+        reopened replica resumes its watermark from its own WAL instead
+        of replaying full history."""
+        from nornicdb_tpu.replication.read_fleet import ReadReplica
+
+        _load(fleet, n=6)
+        r0 = fleet.replicas[0]
+        assert r0.db._base.wal.last_seq == r0.standby.applied_seq == 6
+        data_dir = r0.db._data_dir
+        primary_addr = fleet.primary_db._cluster_transport.addr
+        r0.close()
+        reopened = ReadReplica("replica-0b", data_dir,
+                               heartbeat_interval=0.05)
+        try:
+            assert reopened.standby.applied_seq == 6
+            reopened.attach(primary_addr)
+            # a post-restart write still streams through
+            fleet.primary_db.store("late", node_id="late1",
+                                   embedding=[0.5] * D)
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    not reopened.db.storage.has_node("late1"):
+                reopened.catch_up()
+                time.sleep(0.05)
+            assert reopened.db.storage.has_node("late1")
+        finally:
+            reopened.close()
+
+
+class TestReadiness:
+    def test_replica_lag_reason_and_readyz(self, fleet, monkeypatch):
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        _load(fleet, n=4)
+        r0 = fleet.replicas[0]
+        assert r0.ready_reasons() == []
+        with r0.standby._lock:
+            r0.standby.primary_last_seq += 600  # default max 512
+        reasons = r0.ready_reasons()
+        assert any(s.startswith("replica_lag:replica-0") for s in reasons)
+        # the replica's own /readyz carries the reason (503)
+        http = HttpServer(r0.db, port=0)
+        status, payload = http.route("GET", "/readyz", b"", {})
+        assert status == 503
+        assert any(s.startswith("replica_lag:replica-0")
+                   for s in payload["reasons"])
+        # env-tunable threshold: a raised cap makes the same lag ready
+        monkeypatch.setenv("NORNICDB_READY_MAX_LAG_OPS", "100000")
+        assert r0.ready_reasons() == []
+        status, _ = http.route("GET", "/readyz", b"", {})
+        assert status == 200
+
+    def test_catching_up_reason(self, fleet):
+        _load(fleet, n=4)
+        r0 = fleet.replicas[0]
+        real_request = r0.transport.request
+        gate = threading.Event()
+
+        def slow_request(addr, msg, timeout=5.0):
+            if msg.get("type") == "wal_sync":
+                gate.wait(2.0)
+            return real_request(addr, msg, timeout)
+
+        r0.transport.request = slow_request
+        try:
+            t = threading.Thread(target=r0.catch_up)
+            t.start()
+            deadline = time.time() + 2.0
+            seen = False
+            while time.time() < deadline and not seen:
+                seen = any(s.startswith("catching_up:replica-0")
+                           for s in r0.ready_reasons())
+                time.sleep(0.005)
+            gate.set()
+            t.join(timeout=5.0)
+            assert seen
+            assert r0.ready_reasons() == []
+        finally:
+            gate.set()
+            r0.transport.request = real_request
+
+    def test_fleet_gauges_exported(self, fleet):
+        _load(fleet, n=4)
+        text = REGISTRY.render()
+        assert 'nornicdb_replica_lag_ops{node="replica-0"}' in text
+        assert 'nornicdb_replica_applied_seq{node="replica-1"}' in text
+
+
+class TestRouter:
+    def test_parity_gated_admission(self, fleet):
+        vecs = _load(fleet)
+        # nothing admitted yet: reads serve from the primary
+        assert fleet.router.pick_read() is None
+        ratios = fleet.admit_all([vecs[1], vecs[9]], k=5)
+        assert ratios == {"replica-0": 1.0, "replica-1": 1.0}
+        assert fleet.router.pick_read() is not None
+        # poison replica-0's index: d1 now points somewhere else, so
+        # probes near d1 must miss the exact-contract floor
+        r0 = fleet.replicas[0]
+        r0.db.search.vectors.add(
+            "d1", [float(x) for x in -vecs[1]])
+        ratio = fleet.router.admit("replica-0", [vecs[1]], k=5)
+        assert ratio < 1.0
+        st = fleet.router.drain_state()["replica-0"]
+        assert not st["admitted"]
+        picked = {fleet.router.pick_read().name for _ in range(6)}
+        assert picked == {"replica-1"}
+        assert _fleet_ledger("replica-0", "replica_drain")
+
+    def test_round_robin_and_read_attribution(self, fleet):
+        vecs = _load(fleet)
+        fleet.admit_all([vecs[0]], k=5)
+        before = _counter_children("nornicdb_fleet_reads_total")
+        local_calls = []
+
+        def local(key, qs, k):
+            local_calls.append(key)
+            return fleet.primary_db.search._ann_search_batch(qs, k)
+
+        for i in range(6):
+            out = fleet.router.vec_dispatch(
+                "__service__", vecs[i][None, :], 5, local)
+            assert out[0][0][0] == f"d{i}"
+        assert not local_calls
+        after = _counter_children("nornicdb_fleet_reads_total")
+        delta = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in after if after.get(k, 0) != before.get(k, 0)}
+        assert delta.get(("replica-0", "vec"), 0) == 3
+        assert delta.get(("replica-1", "vec"), 0) == 3
+
+    def test_drain_on_lag_breach_and_recovery(self, fleet):
+        vecs = _load(fleet)
+        fleet.admit_all([vecs[0]], k=5)
+        r0 = fleet.replicas[0]
+        n_before = len(_fleet_ledger("replica-0", "replica_lag"))
+        with r0.standby._lock:
+            r0.standby.primary_last_seq += 10_000
+        time.sleep(fleet.router._check_interval_s * 2)
+        picked = {fleet.router.pick_read().name for _ in range(8)}
+        assert "replica-0" not in picked
+        # the transition recorded exactly one ledger entry
+        assert len(_fleet_ledger("replica-0", "replica_lag")) \
+            == n_before + 1
+        # a sustained drain whose lag VALUE keeps drifting (the reason
+        # string embeds it) is still one transition, one record
+        with r0.standby._lock:
+            r0.standby.primary_last_seq += 137
+        time.sleep(fleet.router._check_interval_s * 2)
+        fleet.router.pick_read()
+        assert len(_fleet_ledger("replica-0", "replica_lag")) \
+            == n_before + 1
+        # heal: the replica rejoins the rotation
+        with r0.standby._lock:
+            r0.standby.primary_last_seq = r0.standby.applied_seq
+        time.sleep(fleet.router._check_interval_s * 2)
+        picked = {fleet.router.pick_read().name for _ in range(8)}
+        assert "replica-0" in picked
+        # steady-state drain did not spam the ledger
+        assert len(_fleet_ledger("replica-0", "replica_lag")) \
+            == n_before + 1
+
+    def test_fallback_to_primary_when_all_drained(self, fleet):
+        vecs = _load(fleet)
+        fleet.admit_all([vecs[0]], k=5)
+        for r in fleet.replicas:
+            with r.standby._lock:
+                r.standby.primary_last_seq += 10_000
+        time.sleep(fleet.router._check_interval_s * 2)
+        assert fleet.router.pick_read() is None
+        local_calls = []
+
+        def local(key, qs, k):
+            local_calls.append(key)
+            return fleet.primary_db.search._ann_search_batch(qs, k)
+
+        out = fleet.router.vec_dispatch("__service__",
+                                        vecs[2][None, :], 5, local)
+        assert local_calls == ["__service__"]
+        assert out[0][0][0] == "d2"
+
+    def test_routed_compat_reads_replica_writes_primary(self, fleet):
+        rng = np.random.default_rng(5)
+        pvecs = rng.normal(size=(8, D)).astype(np.float32)
+        db = fleet.primary_db
+        db.qdrant_compat.create_collection(
+            "rc", {"size": D, "distance": "Cosine"})
+        db.qdrant_compat.upsert_points("rc", [
+            {"id": i, "vector": [float(x) for x in pvecs[i]]}
+            for i in range(8)])
+        assert fleet.wait_converged(10.0)
+        for name in fleet.router.replicas():
+            fleet.router.admit_unchecked(name)
+        compat = fleet.router.routed_compat()
+        before = _counter_children("nornicdb_fleet_reads_total")
+        got = compat.search_points("rc", list(pvecs[2]), limit=3)
+        assert got[0]["id"] == 2
+        after = _counter_children("nornicdb_fleet_reads_total")
+        served = sum(after.get((n, "qdrant"), 0)
+                     - before.get((n, "qdrant"), 0)
+                     for n in ("replica-0", "replica-1"))
+        assert served == 1
+        # a write through the routed compat lands on the primary and
+        # replicates out
+        compat.upsert_points("rc", [{"id": 99,
+                                     "vector": [0.25] * D}])
+        assert fleet.wait_converged(10.0)
+        for r in fleet.replicas:
+            assert r.db.qdrant_compat.count_points("rc") == 9
+
+
+class TestFailover:
+    def test_promotion_repoints_writes_and_keeps_reads_correct(
+            self, fleet):
+        vecs = _load(fleet)
+        fleet.admit_all([vecs[1]], k=5)
+        r0, r1 = fleet.replicas
+        # seq-space continuation: the replica's own WAL mirrors the
+        # primary's numbering, the precondition for a clean failover
+        assert r0.db._base.wal.last_seq == r0.standby.applied_seq
+        r0.promote()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and \
+                fleet.primary_db.replicator.role is not Role.STANDBY:
+            time.sleep(0.02)
+        assert fleet.primary_db.replicator.role is Role.STANDBY
+        assert r0.standby.role is Role.PRIMARY
+        assert fleet.router.primary_db is r0.db
+        # writes through the router hit the new primary and stream to
+        # the surviving replica — seq N+1 is ACCEPTED, not dropped
+        newv = np.full(D, 0.3, dtype=np.float32)
+        fleet.router.primary_db.store(
+            "post failover", node_id="pf1",
+            embedding=[float(x) for x in newv])
+        deadline = time.time() + 5.0
+        while time.time() < deadline and \
+                not r1.db.storage.has_node("pf1"):
+            time.sleep(0.05)
+        assert r1.db.storage.has_node("pf1")
+        hits = r1.db.search.vector_search_candidates(newv, k=3,
+                                                     exact=True)
+        assert hits[0][0] == "pf1"  # replica index rebuilt the write
+        # the promoted node left the read rotation
+        picked = {fleet.router.pick_read().name for _ in range(6)}
+        assert picked == {"replica-1"}
+        # no wrong answers during failover: every fleet ledger record
+        # is an explained ladder step-down, never a served mismatch
+        for rec in [r for r in _audit.degrade_snapshot(500)
+                    if r.get("surface") == "fleet"]:
+            assert rec["reason"] in ("replica_lag", "replica_drain")
+
+    def test_deposed_primary_batch_rejected_mid_rebuild(self, fleet):
+        """Fencing edge case: a stale-epoch WAL batch from the deposed
+        primary arrives while the replica's index rebuild is in flight
+        — rejected at the epoch gate, no storage or index mutation."""
+        vecs = _load(fleet, n=6)
+        r1 = fleet.replicas[1]
+        # epoch moved on (a promotion happened elsewhere)
+        assert r1.standby.handle_fence({"epoch": 5})["ok"]
+        applied_before = r1.standby.applied_seq
+        rows_before = len(r1.db.search.vectors)
+        # simulate the in-flight rebuild window
+        orig = r1.rebuild_in_flight
+        r1.rebuild_in_flight = lambda: True
+        try:
+            resp = r1.standby.handle_wal_batch({
+                "epoch": 1,
+                "records": [{"seq": applied_before + 1,
+                             "op": "create_node",
+                             "data": {"id": "neo4j:evil", "labels": [],
+                                      "properties": {"content": "evil"},
+                                      }}],
+            })
+        finally:
+            r1.rebuild_in_flight = orig
+        assert resp["ok"] is False and "fenced" in resp["error"]
+        assert r1.standby.applied_seq == applied_before
+        assert len(r1.db.search.vectors) == rows_before
+        assert not r1.db.storage.has_node("evil")
+
+    def test_epoch_bump_during_coalesced_dispatch(self, fleet):
+        """Fencing edge case: the epoch bumps while batched read
+        dispatches are in flight on the replica — in-flight answers
+        stay parity-correct and post-bump stale-epoch batches are
+        rejected."""
+        vecs = _load(fleet)
+        r0 = fleet.replicas[0]
+        errors = []
+        results = [None] * 8
+        start = threading.Barrier(9)
+
+        def reader(i):
+            try:
+                start.wait(5.0)
+                results[i] = r0.vec_dispatch(
+                    "__service__", vecs[i][None, :], 5)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        def fencer():
+            start.wait(5.0)
+            r0.standby.handle_fence({"epoch": 9})
+
+        threads = [threading.Thread(target=reader, args=(i,))
+                   for i in range(8)] + [threading.Thread(target=fencer)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not errors
+        for i, rows in enumerate(results):
+            assert rows is not None
+            assert rows[0][0][0] == f"d{i}"
+        stale = r0.standby.handle_wal_batch({"epoch": 2, "records": []})
+        assert stale["ok"] is False and "fenced" in stale["error"]
+
+    def test_promotion_reregisters_obs_resources_once(self, fleet):
+        _load(fleet, n=4)
+        r0 = fleet.replicas[0]
+
+        def promote_count():
+            fam = REGISTRY.get("nornicdb_fleet_failover_total")
+            kids = {k: c.value for k, c in fam._children.items()} \
+                if fam else {}
+            return kids.get(("promote",), 0)
+
+        before = promote_count()
+        r0.promote()
+        r0._on_promoted(r0.standby)  # double promotion callback
+        assert promote_count() == before + 1  # transition counted once
+        text = REGISTRY.render()
+        # the node's tagged series appear exactly once per family
+        line = ('nornicdb_index_rows{family="brute",'
+                'index="service:neo4j@replica-0"}')
+        assert text.count(line) == 1
+
+
+class TestWirePlaneFleet:
+    def test_plane_routes_reads_across_replicas(self, fleet):
+        import grpc
+
+        from nornicdb_tpu.api.proto import qdrant_pb2 as q
+        from nornicdb_tpu.api.wire_plane import WirePlane
+
+        rng = np.random.default_rng(11)
+        pvecs = rng.normal(size=(16, D)).astype(np.float32)
+        db = fleet.primary_db
+        db.qdrant_compat.create_collection(
+            "wp", {"size": D, "distance": "Cosine"})
+        db.qdrant_compat.upsert_points("wp", [
+            {"id": i, "vector": [float(x) for x in pvecs[i]],
+             "payload": {"i": i}} for i in range(16)])
+        assert fleet.wait_converged(10.0)
+        fleet.admit_all([pvecs[0]], k=5)
+        before = _counter_children("nornicdb_fleet_reads_total")
+        plane = WirePlane(db, workers=2, mode="thread",
+                          fleet=fleet.router).start()
+        try:
+            ch = grpc.insecure_channel(plane.grpc_address)
+            stub = ch.unary_unary(
+                "/qdrant.Points/Search",
+                request_serializer=lambda r: r.SerializeToString(),
+                response_deserializer=q.SearchResponse.FromString)
+            for i in range(6):
+                resp = stub(q.SearchPoints(
+                    collection_name="wp",
+                    vector=[float(x) for x in pvecs[i]], limit=3))
+                assert int(resp.result[0].id.num) == i
+            ch.close()
+        finally:
+            plane.stop()
+        after = _counter_children("nornicdb_fleet_reads_total")
+        served = sum(after.get((n, "vec"), 0) - before.get((n, "vec"), 0)
+                     for n in ("replica-0", "replica-1"))
+        assert served == 6

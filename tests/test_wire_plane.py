@@ -531,6 +531,47 @@ def thread_plane():
 
 
 class TestWirePlaneThread:
+    def test_qdrant_hot_shape_rides_op_vec(self, thread_plane):
+        """ISSUE 12 satellite: the qdrant Search hot shape (cosine, no
+        filter, no vector echo) posts its raw embedding onto the ring
+        (OP_VEC) instead of a pickled OP_CALL — and a filtered search
+        still rides the full-fidelity OP_CALL path."""
+        from nornicdb_tpu.api.proto import qdrant_pb2 as q
+        from nornicdb_tpu.obs.metrics import REGISTRY
+
+        db, plane = thread_plane
+
+        def vec_rides():
+            fam = REGISTRY.get("nornicdb_broker_requests_total")
+            kids = {k: c.value for k, c in fam._children.items()} \
+                if fam else {}
+            return kids.get(("vec",), 0)
+
+        target = db.storage.get_node("p6")
+        before = vec_rides()
+        sr = q.SearchPoints(collection_name="wires",
+                            vector=list(target.embedding), limit=5)
+        resp = _grpc_call(plane.grpc_address, "/qdrant.Points/Search",
+                          sr, q.SearchResponse)
+        assert vec_rides() == before + 1  # hot shape rode the ring
+        # answer parity vs the full-fidelity path (tie-aware exact)
+        direct = db.qdrant_compat.search_points(
+            "wires", list(target.embedding), limit=5)
+        assert _audit.ShadowAuditor.parity_of(
+            [(int(p.id.num), float(p.score)) for p in resp.result],
+            [(int(d["id"]), float(d["score"])) for d in direct],
+            k=5, exact=True) == 1.0
+        # a filtered search is NOT the hot shape: OP_CALL serves it
+        before = vec_rides()
+        fr = q.SearchPoints(collection_name="wires",
+                            vector=list(target.embedding), limit=5)
+        cond = fr.filter.must.add()
+        cond.has_id.has_id.add().num = 6
+        resp2 = _grpc_call(plane.grpc_address, "/qdrant.Points/Search",
+                           fr, q.SearchResponse)
+        assert vec_rides() == before
+        assert [int(p.id.num) for p in resp2.result] == [6]
+
     def test_search_rank_identical_to_direct_compat(self, thread_plane):
         from nornicdb_tpu.api.proto import qdrant_pb2 as q
 
@@ -557,8 +598,9 @@ class TestWirePlaneThread:
         queries = [db.storage.get_node(f"p{i}").embedding
                    for i in range(0, 24, 2)]
         want = [
-            [int(d["id"]) for d in db.qdrant_compat.search_points(
-                "wires", list(v), limit=4)]
+            [(int(d["id"]), float(d["score"]))
+             for d in db.qdrant_compat.search_points(
+                 "wires", list(v), limit=4)]
             for v in queries
         ]
         results = [None] * len(queries)
@@ -574,7 +616,8 @@ class TestWirePlaneThread:
                 resp = stub(q.SearchPoints(
                     collection_name="wires", vector=list(queries[i]),
                     limit=4))
-                results[i] = [int(p.id.num) for p in resp.result]
+                results[i] = [(int(p.id.num), float(p.score))
+                              for p in resp.result]
             except Exception as exc:  # noqa: BLE001
                 errors.append(exc)
             finally:
@@ -587,7 +630,15 @@ class TestWirePlaneThread:
         for t in threads:
             t.join(timeout=60)
         assert not errors
-        assert results == want
+        # tie-aware exact parity (the ISSUE 11 contract): a coalesced
+        # padded-batch dispatch (and the ISSUE 12 OP_VEC fast path)
+        # may permute ids WITHIN an exact score tie vs the b=1
+        # search_points reference — same scores, same membership at
+        # every score level is the exact-tier contract
+        for got, ref in zip(results, want):
+            assert got is not None
+            assert _audit.ShadowAuditor.parity_of(
+                got, ref, k=4, exact=True) == 1.0, (got, ref)
 
     def test_served_tier_attribution_crosses_the_boundary(
             self, thread_plane):
