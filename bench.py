@@ -494,15 +494,19 @@ def _compact_summary(result):
                                            "shadow_parity",
                                            "statistical"),
         },
-        # read fleet (ISSUE 12), packed [fleet_read_qps, read_scaling,
-        # replica_parity, drain_on_breach] — the driver tail window is
-        # 2000 chars, so the summary carries the headline quad in the
-        # array form the surfaces/qdrant_floor entries already use
+        # read fleet (ISSUE 12/13), packed [fleet_read_qps,
+        # read_scaling, replica_parity, drain_on_breach,
+        # trace_completeness] — the driver tail window is 2000 chars,
+        # so the summary carries the sentinel-gated headline set in
+        # the array form the surfaces/qdrant_floor entries use
+        # (apply-delay p50/p99 per node rides the full artifact's
+        # fleet.apply_delay block)
         "fleet": [
             g(result, "fleet", "fleet_read_qps"),
             g(result, "fleet", "read_scaling"),
             g(result, "fleet", "replica_parity"),
             g(result, "fleet", "drain", "breached_drained"),
+            g(result, "fleet", "trace_completeness"),
         ],
         "surfaces": surfaces,
         # what grpc-python can physically do on this box with this
@@ -1294,6 +1298,52 @@ def _sweep_brief(doc):
              "knee_offered_qps", "queue_collapse_detected")}
 
 
+def _fleet_trace_completeness(fleet, qpool, k: int,
+                              probes: int = 32) -> float:
+    """Fraction of traced ring-routed reads whose span tree carries
+    the full plane-side chain (ring.claim -> plane.coalesce ->
+    device.dispatch) grafted back across the broker seam (ISSUE 13).
+    Runs the REAL BrokerClient/DispatchBroker OP_VEC path (thread
+    mode) over the fleet router — the same seam the wire plane's
+    frontend workers serve through."""
+    from nornicdb_tpu import obs as _obs
+    from nornicdb_tpu.api.wire_plane import (
+        BrokerSearch,
+        resolve_vec_dispatch,
+    )
+    from nornicdb_tpu.search.broker import BrokerClient, DispatchBroker
+
+    def local_fn(key, queries, kk):
+        return resolve_vec_dispatch(fleet.router.primary_db, key,
+                                    queries, kk)
+
+    def vec_dispatch(key, queries, kk):
+        return fleet.router.vec_dispatch(key, queries, kk, local_fn)
+
+    broker = DispatchBroker(vec_dispatch, targets={},
+                            n_workers=1, slots=8).start()
+    client = None
+    try:
+        client = BrokerClient(
+            broker.client_spec(0, cross_process=False))
+        search = BrokerSearch(client)
+        need = ("ring.claim", "plane.coalesce", "device.dispatch")
+        complete = 0
+        for i in range(probes):
+            with _obs.trace("wire", method="bench.fleet_trace",
+                            transport="bench") as root:
+                search.vector_search_candidates(
+                    qpool[i % len(qpool)], k=k)
+            names = root.span_names()
+            if all(n in names for n in need):
+                complete += 1
+        return round(complete / max(probes, 1), 4)
+    finally:
+        if client is not None:
+            client.close()
+        broker.stop()
+
+
 def _bench_fleet(tiny: bool = False):
     """Read-fleet stage (ISSUE 12): an in-process 1-primary/2-replica
     topology over real loopback WAL streaming. Measures (1) READ
@@ -1402,6 +1452,39 @@ def _bench_fleet(tiny: bool = False):
                         if drained_at else None),
         }
 
+        # per-record replication latency (ISSUE 13): the burst above
+        # streamed through the WAL plane, so both replicas observed
+        # nornicdb_replication_apply_delay_seconds — report p50/p99 in
+        # ms per node ("lag 400 ops" -> "p99 replay delay N ms")
+        from nornicdb_tpu.obs.metrics import REGISTRY as _REG
+        delay_fam = _REG.get("nornicdb_replication_apply_delay_seconds")
+        apply_delay = {}
+        for key, child in (delay_fam.children().items()
+                           if delay_fam else ()):
+            snap = child.snapshot()
+            if not snap["count"]:
+                continue
+            apply_delay[key[0]] = {
+                "count": snap["count"],
+                "p50_ms": round((child.quantile(0.5) or 0.0) * 1e3, 3),
+                "p99_ms": round((child.quantile(0.99) or 0.0) * 1e3, 3),
+            }
+        out["apply_delay"] = apply_delay
+        out["apply_delay_p99_ms"] = (
+            max(d["p99_ms"] for d in apply_delay.values())
+            if apply_delay else None)
+
+        # cross-process trace completeness (ISSUE 13): traced reads
+        # through the broker ring (thread-mode DispatchBroker over the
+        # fleet router — the same OP_VEC seam the wire plane serves
+        # through) must come back with the FULL plane-side span chain
+        # grafted into the live root. Fraction of requests whose trace
+        # carries ring.claim + plane.coalesce + device.dispatch; the
+        # sentinel gates this ABSOLUTELY at 1.0 — a broken propagation
+        # seam is wrong, not slow.
+        out["trace_completeness"] = _fleet_trace_completeness(
+            fleet, qpool, k, probes=16 if tiny else 32)
+
         # drain-on-breach: push replica-0 past the lag threshold via an
         # inflated primary watermark; the router must stop routing to
         # it (ledger reason replica_lag) and re-admit once healed
@@ -1430,6 +1513,18 @@ def _bench_fleet(tiny: bool = False):
             r0.standby.primary_last_seq = r0.standby.applied_seq
         time.sleep(fleet.router._check_interval_s * 2)
         out_drain["recovered"] = r0.name in pick_names()
+        # the incident timeline must replay this drain->recover as
+        # ORDERED records (ISSUE 13): one drain, then one admit for
+        # the same node, ascending seq
+        from nornicdb_tpu.obs import events as _fleet_events
+        evs = [e for e in _fleet_events.event_snapshot(limit=200)
+               if e.get("node") == r0.name
+               and e["kind"] in ("drain", "admit")]
+        drain_seqs = [e["seq"] for e in evs if e["kind"] == "drain"]
+        admit_seqs = [e["seq"] for e in evs if e["kind"] == "admit"]
+        out_drain["events_ordered"] = bool(
+            drain_seqs and admit_seqs
+            and min(drain_seqs) < max(admit_seqs))
         out["drain"] = out_drain
         return out
     except Exception as exc:  # noqa: BLE001 — stage isolation

@@ -47,6 +47,8 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import events as _events
+from nornicdb_tpu.obs import tracing as _tracing
 from nornicdb_tpu.obs.metrics import REGISTRY
 
 _READS_C = REGISTRY.counter(
@@ -163,6 +165,10 @@ class FleetRouter:
         if not ok:
             _audit.record_degrade("fleet", "replica", "primary",
                                   "replica_drain", index=name)
+        else:
+            _events.record_event("admit", node=name, surface="fleet",
+                                 reason="parity_gate",
+                                 detail={"parity": round(worst, 4)})
         return worst
 
     def admit_unchecked(self, name: str) -> None:
@@ -246,8 +252,12 @@ class FleetRouter:
                             else "replica_drain")
             _audit.record_degrade("fleet", "replica", "primary",
                                   ledger_reason, index=name)
+            _events.record_event("drain", node=name, surface="fleet",
+                                 reason=reason)
             _ADMITTED_G.labels(name).set(0.0)
         elif transition_up:
+            _events.record_event("admit", node=name, surface="fleet",
+                                 reason="recovered")
             _ADMITTED_G.labels(name).set(1.0 if admitted else 0.0)
         return ready
 
@@ -289,6 +299,9 @@ class FleetRouter:
         if child is None:
             child = self._count_cache[key] = _READS_C.labels(name, surface)
         child.inc(n)
+        # stamp the chosen node on the active trace (ISSUE 13): a
+        # fleet-routed read's span answers "which replica served this"
+        _tracing.annotate(fleet_node=name)
         tier = _audit.last_served()
         if tier:
             tkey = ("t", name, tier)
@@ -307,24 +320,34 @@ class FleetRouter:
                 st["checked_at"] = time.time()
                 _audit.record_degrade("fleet", "replica", "primary",
                                       "replica_drain", index=name)
+                _events.record_event("drain", node=name,
+                                     surface="fleet",
+                                     reason=f"error:{name}")
 
     def vec_dispatch(self, key: str, queries, k: int, local_fn):
         """Coalesced vector dispatch (the WirePlane/broker OP_VEC
         contract): serve the batch from a ready replica, fall back to
-        the local (primary) dispatch on drain or error."""
+        the local (primary) dispatch on drain or error. The chosen
+        node is noted on the dispatching thread
+        (``audit.consume_fleet_node``) so the broker stamps it onto
+        every rider's response and span records (ISSUE 13)."""
         replica = self.pick_read(need_vec=True)
         if replica is None:
+            _audit.note_fleet_node("primary")
             return local_fn(key, queries, k)
         try:
             out = replica.vec_dispatch(key, queries, k)
         except KeyError:
             # capability miss (unknown dispatch key / remote handle):
             # serve locally, never drain a healthy replica over it
+            _audit.note_fleet_node("primary")
             return local_fn(key, queries, k)
         except Exception:  # noqa: BLE001 — degrade, never fail the read
             self._drain_error(replica.name)
+            _audit.note_fleet_node("primary")
             return local_fn(key, queries, k)
         self._note_served(replica.name, "vec", n=len(queries))
+        _audit.note_fleet_node(replica.name)
         return out
 
     def routed_search(self):
@@ -347,6 +370,8 @@ class FleetRouter:
                 st["admitted"] = False
                 st["drain"] = f"promoted:{replica.name}"
         _ADMITTED_G.labels(replica.name).set(0.0)
+        _events.record_event("failover", node=replica.name,
+                             surface="fleet", reason="router_repointed")
 
 
 class RoutedSearch:
@@ -457,13 +482,20 @@ class RemoteReplica:
         import json as _json
         import urllib.request
 
+        headers = {"Content-Type": "application/json",
+                   **({"Authorization": self.auth} if self.auth
+                      else {})}
+        # cross-node trace propagation (ISSUE 13): the replica's HTTP
+        # server opens its root under OUR trace id, so a fleet-routed
+        # read is ONE trace across hosts
+        packed = _tracing.pack_context(_tracing.trace_context())
+        if packed:
+            headers[_tracing.TRACE_HEADER] = packed
         req = urllib.request.Request(
             self.base_url + path, method=method,
             data=(None if payload is None
                   else _json.dumps(payload).encode("utf-8")),
-            headers={"Content-Type": "application/json",
-                     **({"Authorization": self.auth} if self.auth
-                        else {})})
+            headers=headers)
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return resp.status, _json.loads(resp.read() or b"{}")
 

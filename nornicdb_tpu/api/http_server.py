@@ -371,9 +371,19 @@ class HttpServer:
                     outer._stream_bifrost(self)
                     return
                 t0 = time.perf_counter()
+                # cross-node trace propagation (ISSUE 13): a request
+                # forwarded by the fleet router (RemoteReplica) carries
+                # its originating trace context in X-Nornic-Trace — the
+                # root opened here joins that trace instead of minting
+                # a new id, so one fleet-routed read is ONE trace
+                tctx = obs.unpack_context(
+                    self.headers.get(obs.TRACE_HEADER, ""))
                 try:
-                    with obs.trace("wire", method=f"{method} {path}",
-                                   transport="http"):
+                    # propagated_trace opens a plain root when no
+                    # context came across — one call site, both cases
+                    with obs.propagated_trace(
+                            "wire", tctx, method=f"{method} {path}",
+                            transport="http"):
                         self._handle(method)
                 finally:
                     # finally: a handler that raises (client hung up
@@ -1369,6 +1379,32 @@ class HttpServer:
             doc = dict(obs.degrade_summary())
             doc["degrades"] = obs.degrade_snapshot(limit=limit)
             return 200, doc
+
+        if action == "events" and method == "GET":
+            # the unified incident timeline (ISSUE 13): degrades,
+            # drains/admits, failovers, quarantines and SLO breaches
+            # in one causally-ordered, trace-id-linked stream
+            limit = 100
+            if len(segments) > 2 and segments[2].isdigit():
+                limit = int(segments[2])  # /admin/events/<limit>
+            doc = dict(obs.event_summary())
+            doc["events"] = obs.event_snapshot(limit=limit)
+            return 200, doc
+
+        if action == "fleet" and method == "GET":
+            if len(segments) > 2 and segments[2] == "state":
+                # this node's registry snapshot in the JSON-safe wire
+                # shape — the scrape endpoint remote fleet aggregators
+                # pull (obs.fleet.http_state_source)
+                from nornicdb_tpu.obs import fleet as _fleet
+                from nornicdb_tpu.obs.metrics import dump_state
+
+                return 200, {"state": _fleet.state_to_jsonable(
+                    dump_state())}
+            # the fleet telemetry aggregator (ISSUE 13): merged
+            # worker/plane/replica truth — lag in ops AND seconds,
+            # tier mix, failovers, source health, incident rollup
+            return 200, obs.fleet_summary()
 
         if action == "slo":
             engine = obs.get_slo_engine()

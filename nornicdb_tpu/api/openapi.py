@@ -73,6 +73,28 @@ def openapi_spec() -> Dict[str, Any]:
                     "by_reason": {"type": "object"},
                     "degrades": {"type": "array",
                                  "items": {"type": "object"}}}})},
+            "/admin/events": {"get": op(
+                "Unified incident timeline: causally-ordered, "
+                "trace-id-linked degrade/drain/admit/failover/"
+                "quarantine/SLO-breach events (admin)", "ops",
+                response={"type": "object", "properties": {
+                    "recorded": {"type": "integer"},
+                    "capacity": {"type": "integer"},
+                    "by_kind": {"type": "object"},
+                    "events": {"type": "array",
+                               "items": {"type": "object"}}}})},
+            "/admin/fleet": {"get": op(
+                "Fleet telemetry aggregator: merged worker/plane/"
+                "replica registries — per-node lag (ops AND "
+                "apply-delay seconds), tier mix, failovers, source "
+                "health (admin)", "ops",
+                response={"type": "object", "properties": {
+                    "sources": {"type": "object"},
+                    "workers": {"type": "number"},
+                    "replicas": {"type": "object"},
+                    "failovers": {"type": "object"},
+                    "tiers": {"type": "object"},
+                    "events": {"type": "object"}}})},
             "/openapi.json": {"get": op("This document", "ops")},
             "/debug/profile": {"post": op(
                 "Profile one Cypher statement (admin)", "ops",

@@ -99,6 +99,23 @@ def _map_remote(exc: BrokerRemoteError):
     return exc
 
 
+def _graft_vec_spans(doc: Dict[str, Any], k: int) -> None:
+    """Graft an OP_VEC response's plane-side span records into the
+    live trace (ring.claim -> plane.coalesce -> device.dispatch with
+    original timing), falling back to the single leader-stamped
+    interval when the rider posted without a trace context. Also
+    stamps the fleet node the router chose (ISSUE 13)."""
+    spans = doc.get("spans")
+    if spans:
+        for sd in spans:
+            obs.attach_span_tree(sd)
+    else:
+        obs.attach_span("broker.dispatch", doc["t0"], doc["t1"],
+                        surface="broker", batch=doc["batch"], k=k)
+    if doc.get("node"):
+        obs.annotate(fleet_node=doc["node"])
+
+
 class BrokerCompat:
     """Worker-side stand-in for QdrantCompat: every method forwards as
     a generic broker op to the real compat on the device plane, where
@@ -132,6 +149,11 @@ class BrokerCompat:
         if self._client.cross_process:
             for rec in meta.get("degrades", ()):
                 _audit.replay_degrade(rec)
+        # plane-side span tree (ISSUE 13): graft it so this worker's
+        # /admin/traces shows the op's full plane story under the
+        # ingress root — same trace id on both sides of the ring
+        for sd in meta.get("spans", ()):
+            obs.attach_span_tree(sd)
         obs.record_stage("broker", "coalesce_wait",
                          doc["t0"] - doc["t_post"])
         obs.record_stage("broker", "apply", doc["t1"] - doc["t0"])
@@ -183,8 +205,7 @@ class BrokerSearch:
         obs.record_stage("broker", "device_dispatch",
                          doc["t1"] - doc["t0"])
         obs.record_stage("broker", "merge", now - doc["t1"])
-        obs.attach_span("broker.dispatch", doc["t0"], doc["t1"],
-                        surface="broker", batch=doc["batch"], k=k)
+        _graft_vec_spans(doc, k)
         _audit.set_last_served(doc.get("tier"))
         return doc["hits"]
 
@@ -198,9 +219,12 @@ class BrokerSearch:
                 "device plane unavailable (broker timeout)")
         except BrokerRemoteError as exc:
             raise _map_remote(exc) from None
+        meta = doc.get("meta") or {}
         if self._client.cross_process:
-            for rec in (doc.get("meta") or {}).get("degrades", ()):
+            for rec in meta.get("degrades", ()):
                 _audit.replay_degrade(rec)
+        for sd in meta.get("spans", ()):
+            obs.attach_span_tree(sd)
         return doc["result"]
 
     def search(self, **kwargs):
@@ -340,6 +364,7 @@ def _worker_servicers():
                              doc["t0"] - doc["t_post"])
             obs.record_stage("broker", "device_dispatch",
                              doc["t1"] - doc["t0"])
+            _graft_vec_spans(doc, limit + offset)
             _audit.set_last_served(doc.get("tier"))
             got = self.compat._client.call(
                 "plane", "qdrant_points_brief", brief["collection"],
@@ -423,6 +448,13 @@ class _WorkerHttpServer:
                                                ttl_seconds=300.0)
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        if self._client.cross_process:
+            # the device plane as a fleet-telemetry source (ISSUE 13):
+            # this worker's /admin/fleet merges its own registry with
+            # the plane's. Thread mode shares ONE registry — a source
+            # there would double-count every shared counter.
+            obs.register_fleet_source(
+                "plane", lambda: self.db.plane_call("metrics_state"))
 
     # -- route bodies --------------------------------------------------
 
@@ -446,18 +478,76 @@ class _WorkerHttpServer:
             self._search_wire.put(key, (gen, data))
         return status, data
 
-    def _metrics(self) -> str:
+    def _metrics(self, accept: str = "") -> Tuple[str, str]:
+        """(content_type, body). Content-negotiated like the main
+        server's /metrics: an OpenMetrics Accept gets the exemplar-
+        carrying exposition — including the PLANE's bucket exemplars,
+        which ride the merged dump_state (ISSUE 13 satellite: they
+        were silently dropped from worker scrapes before)."""
+        from nornicdb_tpu.api.http_server import _accepts_openmetrics
         from nornicdb_tpu.obs.metrics import REGISTRY, render_merged
 
+        om = _accepts_openmetrics(accept)
+        ctype = (REGISTRY.OPENMETRICS_CONTENT_TYPE if om
+                 else "text/plain; version=0.0.4")
         if not self._client.cross_process:
             # thread-mode workers share the plane's process registry:
             # the shared series are already here exactly once
-            return REGISTRY.render()
+            return ctype, (REGISTRY.render_openmetrics() if om
+                           else REGISTRY.render())
         try:
             remote = self.db.plane_call("metrics_state")
         except Exception:  # noqa: BLE001 — scrape must not fail
             remote = []
-        return render_merged([remote] if remote else [])
+        return ctype, render_merged([remote] if remote else [],
+                                    openmetrics=om)
+
+    def _admin_check(self, headers) -> None:
+        """Admin routes served WORKER-locally still authorize on the
+        plane (the authenticator lives there); raises the plane's
+        HTTPError-equivalent through the broker on denial."""
+        self.db.plane_call("admin_check",
+                           headers.get("Authorization", ""))
+
+    def _admin_traces(self, path: str) -> Dict[str, Any]:
+        """This worker's own trace ring — the ingress roots with the
+        plane-side spans grafted (a forwarded /admin/traces would show
+        the PLANE's ring, not this worker's wire->ring chains)."""
+        if path.endswith("/slowest"):
+            return {"slow_ms": obs.TRACES.slow_ms,
+                    "recorded": obs.TRACES.recorded,
+                    "worker": self.worker_id,
+                    "traces": obs.TRACES.slowest(limit=10)}
+        return {"slow_ms": obs.TRACES.slow_ms,
+                "recorded": obs.TRACES.recorded,
+                "worker": self.worker_id,
+                "traces": obs.TRACES.snapshot(limit=50)}
+
+    def _admin_events(self, path: str) -> Dict[str, Any]:
+        """Unified incident timeline, merged across the process seam:
+        this worker's journal (broker-replayed degrades) plus the
+        plane's (drains, failovers, quarantines), ordered causally —
+        by timestamp, seq tie-break — with per-record origin."""
+        limit = 100
+        tail = path.rsplit("/", 1)[-1]
+        if tail.isdigit():
+            limit = int(tail)
+        local = [{**rec, "origin": f"worker-{self.worker_id}"}
+                 for rec in obs.event_snapshot(limit=limit)]
+        doc = dict(obs.event_summary())
+        if self._client.cross_process:
+            try:
+                remote = self.db.plane_call("events_state", limit)
+                local += [{**rec, "origin": "plane"}
+                          for rec in remote.get("events", ())]
+                doc["plane"] = {k: remote.get(k)
+                                for k in ("recorded", "by_kind")}
+            except Exception:  # noqa: BLE001 — local timeline still serves
+                doc["plane"] = "unreachable"
+        local.sort(key=lambda r: (r.get("ts", 0.0), r.get("seq", 0)))
+        doc["worker"] = self.worker_id
+        doc["events"] = local[-limit:]
+        return doc
 
     def _readyz(self) -> Tuple[int, Dict[str, Any]]:
         try:
@@ -510,9 +600,37 @@ class _WorkerHttpServer:
                                           data)
                         return
                     if method == "GET" and path == "/metrics":
+                        ctype, body = outer._metrics(
+                            self.headers.get("Accept", ""))
+                        self._reply_bytes(200, ctype, body.encode())
+                        return
+                    if method == "GET" and (
+                            path == "/admin/traces"
+                            or path == "/admin/traces/slowest"):
+                        # worker-LOCAL: the ingress traces live here
+                        outer._admin_check(self.headers)
                         self._reply_bytes(
-                            200, "text/plain; version=0.0.4",
-                            outer._metrics().encode())
+                            200, "application/json",
+                            json.dumps(outer._admin_traces(path),
+                                       default=str).encode())
+                        return
+                    if method == "GET" and (
+                            path == "/admin/events"
+                            or path.startswith("/admin/events/")):
+                        outer._admin_check(self.headers)
+                        self._reply_bytes(
+                            200, "application/json",
+                            json.dumps(outer._admin_events(path),
+                                       default=str).encode())
+                        return
+                    if method == "GET" and path == "/admin/fleet":
+                        # merged local+plane view via the aggregator
+                        # (the plane source registered at worker boot)
+                        outer._admin_check(self.headers)
+                        self._reply_bytes(
+                            200, "application/json",
+                            json.dumps(obs.fleet_summary(),
+                                       default=str).encode())
                         return
                     if method == "GET" and path == "/readyz":
                         status, payload = outer._readyz()
@@ -527,8 +645,15 @@ class _WorkerHttpServer:
                         method, self.path, body, self.headers)
                     self._reply_bytes(status, ctype, data)
                 except Exception as e:  # noqa: BLE001 — boundary
+                    # a plane-side auth denial keeps its 401/403
+                    # through the ring (BrokerRemoteError carries the
+                    # remote HTTPError status); everything else stays
+                    # the transient 503 it always was
+                    status = getattr(e, "status", None)
+                    if status not in (401, 403):
+                        status = 503
                     self._reply_bytes(
-                        503, "application/json",
+                        status, "application/json",
                         json.dumps({"errors": [{
                             "code": "Neo.TransientError.General."
                                     "WirePlane",
@@ -560,6 +685,8 @@ class _WorkerHttpServer:
         return self
 
     def stop(self) -> None:
+        if self._client.cross_process:
+            obs.unregister_fleet_source("plane")
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -731,6 +858,25 @@ class _PlaneOps:
         from nornicdb_tpu.obs.metrics import dump_state
 
         return dump_state()
+
+    def events_state(self, limit: int = 100):
+        """The plane's incident-timeline slice for a worker's merged
+        ``/admin/events`` view (ISSUE 13)."""
+        doc = dict(obs.event_summary())
+        doc["events"] = obs.event_snapshot(limit=int(limit))
+        return doc
+
+    def admin_check(self, auth: str = "") -> bool:
+        """Authorize a worker-local admin route on the plane (the
+        authenticator lives here); raises the HTTPError — carrying its
+        401/403 status — back through the ring on denial."""
+        http = self._plane.parent_http
+        username = http.authenticate(
+            {"Authorization": auth} if auth else {})
+        from nornicdb_tpu.auth import ADMIN
+
+        http.authorize(username, "system", ADMIN)
+        return True
 
     # -- qdrant OP_VEC fast path (ISSUE 12 satellite) ------------------
 

@@ -48,6 +48,8 @@ from nornicdb_tpu.obs.metrics import (
 )
 from nornicdb_tpu.obs import audit  # noqa: F401 — registers tier families
 from nornicdb_tpu.obs import cost  # noqa: F401 — registers cost counters
+from nornicdb_tpu.obs import events  # noqa: F401 — registers event counter
+from nornicdb_tpu.obs import fleet  # noqa: F401 — registers sources gauge
 from nornicdb_tpu.obs import resources  # noqa: F401 — registers collector
 from nornicdb_tpu.obs import slo  # noqa: F401 — registers collector
 from nornicdb_tpu.obs import stages  # noqa: F401 — registers stage family
@@ -63,27 +65,45 @@ from nornicdb_tpu.obs.audit import (
     tier_mix,
 )
 from nornicdb_tpu.obs.cost import cost_summary, record_query_cost
+from nornicdb_tpu.obs.events import (
+    event_snapshot,
+    event_summary,
+    record_event,
+)
+from nornicdb_tpu.obs.fleet import (
+    fleet_summary,
+    register_source as register_fleet_source,
+    unregister_source as unregister_fleet_source,
+)
 from nornicdb_tpu.obs.resources import register as register_resource
 from nornicdb_tpu.obs.resources import snapshot as resource_snapshot
 from nornicdb_tpu.obs.slo import SloEngine
 from nornicdb_tpu.obs.slo import get_engine as get_slo_engine
 from nornicdb_tpu.obs.stages import record_stage, stage_summary
 from nornicdb_tpu.obs.tracing import (
+    TRACE_HEADER,
     TRACES,
     Span,
     TraceBuffer,
     annotate,
     attach_span,
+    attach_span_tree,
     current_span,
     current_trace_id,
+    export_span,
+    pack_context,
+    propagated_trace,
     span,
     trace,
+    trace_context,
+    unpack_context,
 )
 
 __all__ = [
     "LATENCY_BUCKETS",
     "REGISTRY",
     "SIZE_BUCKETS",
+    "TRACE_HEADER",
     "TRACES",
     "Counter",
     "Gauge",
@@ -94,6 +114,7 @@ __all__ = [
     "TraceBuffer",
     "annotate",
     "attach_span",
+    "attach_span_tree",
     "audit",
     "audit_summary",
     "compile_universe",
@@ -104,17 +125,27 @@ __all__ = [
     "degrade_snapshot",
     "degrade_summary",
     "enabled",
+    "event_snapshot",
+    "event_summary",
+    "events",
     "exemplars_enabled",
+    "export_span",
+    "fleet",
+    "fleet_summary",
     "get_registry",
     "get_slo_engine",
     "latency_summary",
     "maybe_sample",
+    "pack_context",
     "parity_breaches",
+    "propagated_trace",
     "record_degrade",
     "record_dispatch",
+    "record_event",
     "record_query_cost",
     "record_served",
     "record_stage",
+    "register_fleet_source",
     "register_resource",
     "resource_snapshot",
     "resources",
@@ -127,4 +158,7 @@ __all__ = [
     "tier_allowed",
     "tier_mix",
     "trace",
+    "trace_context",
+    "unpack_context",
+    "unregister_fleet_source",
 ]

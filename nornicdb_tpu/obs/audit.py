@@ -63,6 +63,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from nornicdb_tpu.obs import events as _events
 from nornicdb_tpu.obs import metrics as _m
 from nornicdb_tpu.obs.metrics import LATENCY_BUCKETS, REGISTRY
 from nornicdb_tpu.obs.tracing import annotate, attach_span, current_trace_id
@@ -355,6 +356,27 @@ def last_served() -> Optional[str]:
     return getattr(_tls, "last_served", None)
 
 
+# -- the fleet-node channel (ISSUE 13) ---------------------------------------
+#
+# Same discipline as the batch-tier channel: the FleetRouter knows which
+# replica served a coalesced dispatch, the broker (running the dispatch
+# on its pool thread) needs the verdict to stamp the riders' span
+# records and response docs — a note in a thread-local, read-and-clear
+# by the dispatcher after the call.
+
+
+def note_fleet_node(node: str) -> None:
+    """Called by the fleet router when a replica served this thread's
+    dispatch (``primary`` on local fallback)."""
+    _tls.fleet_node = node
+
+
+def consume_fleet_node() -> Optional[str]:
+    node = getattr(_tls, "fleet_node", None)
+    _tls.fleet_node = None
+    return node
+
+
 # ---------------------------------------------------------------------------
 # unified degrade ledger
 # ---------------------------------------------------------------------------
@@ -440,6 +462,12 @@ def record_degrade(surface: str, from_tier: str, to_tier: str,
     # answers "why was this served from a lower rung" on its own
     attach_span("degrade", now, now, surface=surface,
                 from_tier=from_tier, to_tier=to_tier, reason=r)
+    # and into the unified incident timeline (ISSUE 13) — trace-linked
+    # through the same (possibly propagated) trace id
+    _events.record_event("degrade", node=index, surface=surface,
+                         reason=r, trace_id=tid,
+                         detail={"from_tier": from_tier,
+                                 "to_tier": to_tier})
 
 
 class _DegradeCollector:
@@ -470,7 +498,15 @@ def replay_degrade(rec: Dict[str, Any]) -> None:
     record relayed from the device plane to THIS process's ledger ring
     (marked ``via: broker``). The counter is NOT re-incremented — the
     worker's /metrics aggregation already carries the shared plane's
-    ``nornicdb_degrade_total`` exactly once."""
+    ``nornicdb_degrade_total`` exactly once. The record's ``trace_id``
+    — stamped plane-side under the PROPAGATED context (ISSUE 13) — is
+    kept, so a broker-crossing degrade joins its trace in this
+    worker's ledger exactly like a local one. The incident-timeline
+    event is NOT re-recorded either: the plane's ``record_degrade``
+    already journaled it, and the worker's merged ``/admin/events``
+    view carries the plane journal — a second record here would
+    double-count the one incident (same exactly-once discipline as
+    the counter)."""
     if not _m.enabled():
         return
     LEDGER.record({**rec, "via": "broker"})
@@ -825,15 +861,31 @@ class ShadowAuditor:
         if self.quarantine_enabled():
             if len(win) >= self.min_samples() and ratio < floor - 1e-9:
                 with self._lock:
+                    # timeline records the step-down TRANSITION only,
+                    # not every sample that extends an open quarantine
+                    fresh_block = self._blocked_until.get(tier, 0.0) \
+                        <= time.time()
                     self._blocked_until[tier] = (
                         time.time() + self.quarantine_s())
+                if fresh_block:
+                    _events.record_event(
+                        "quarantine", surface=surface, node=tier,
+                        reason="parity_breach",
+                        trace_id=item.get("trace_id"),
+                        detail={"ratio": round(ratio, 4),
+                                "floor": floor})
             elif ratio >= floor - 1e-9:
                 # the rolling window recovered: the breach has cleared,
                 # so the quarantine lifts immediately (probation-window
                 # samples wrote the recovery; don't serve degraded for
                 # the rest of the block)
                 with self._lock:
-                    self._blocked_until.pop(tier, None)
+                    lifted = self._blocked_until.pop(tier, None)
+                if lifted is not None:
+                    _events.record_event(
+                        "quarantine_lift", surface=surface, node=tier,
+                        reason="parity_recovered",
+                        detail={"ratio": round(ratio, 4)})
 
     def _dump_mismatch(self, item: Dict[str, Any],
                        host_ids: List[Any], parity: float,

@@ -1,0 +1,223 @@
+"""Fleet telemetry aggregator: one merged view over many registries.
+
+ISSUE 13. The multi-worker wire plane (PR 11) taught each WORKER to
+merge the shared device plane's metrics into its own scrape, and the
+read fleet (PR 12) put per-node gauges in one shared registry for
+in-process topologies — but there was no single surface that answers
+"what is the FLEET doing" across processes and hosts. This module is
+that surface: named telemetry *sources* (each a zero-arg callable
+returning an ``obs.metrics.dump_state`` snapshot — the broker's
+``metrics_state`` plane op, a remote node's ``GET /admin/fleet/state``,
+or any custom feed) merge with the local registry under the exact
+``render_merged`` discipline (counters/histograms sum, remote gauges
+win) and serve:
+
+- ``GET /admin/fleet`` — the summary: per-source health, wire worker
+  count, per-replica lag/apply-delay truth (``lag_ops`` AND the
+  ISSUE 13 ``nornicdb_replication_apply_delay_seconds`` p50/p99 in
+  milliseconds — seconds-not-ops), failover counts, the merged
+  served-tier mix, and the local incident-timeline rollup;
+- ``GET /admin/fleet/state`` — this node's ``dump_state`` in a
+  JSON-safe shape, the scrape endpoint remote aggregators pull.
+
+A failing source reports an error string in the summary and
+contributes nothing — a dead replica can never break the admin
+surface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nornicdb_tpu.obs import events as _events
+from nornicdb_tpu.obs import metrics as _m
+from nornicdb_tpu.obs.metrics import REGISTRY, dump_state, merge_states
+
+_lock = threading.Lock()
+_sources: Dict[str, Callable[[], List[Dict]]] = {}
+
+REGISTRY.gauge(
+    "nornicdb_fleet_sources",
+    "Remote telemetry sources registered with the fleet aggregator",
+    fn=lambda: float(len(_sources)))
+
+
+def register_source(name: str, fn: Callable[[], List[Dict]]) -> None:
+    """Register one remote telemetry source. ``fn`` returns a
+    ``dump_state``-shaped list (or raises — the summary then carries
+    the error). Re-registering a name replaces the prior source."""
+    with _lock:
+        _sources[str(name)] = fn
+
+
+def unregister_source(name: str) -> None:
+    with _lock:
+        _sources.pop(str(name), None)
+
+
+def http_state_source(base_url: str, timeout_s: float = 2.0,
+                      auth: Optional[str] = None
+                      ) -> Callable[[], List[Dict]]:
+    """Source over a remote node's ``GET /admin/fleet/state`` —
+    the multi-host feed (RemoteReplica topologies)."""
+    url = base_url.rstrip("/") + "/admin/fleet/state"
+
+    def fetch() -> List[Dict]:
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            url, headers={"Authorization": auth} if auth else {})
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            doc = json.loads(resp.read() or b"{}")
+        return state_from_jsonable(doc.get("state") or [])
+
+    return fetch
+
+
+# -- JSON-safe transport shape ----------------------------------------------
+#
+# dump_state children are keyed by label-value TUPLES — fine over the
+# broker's pickle, not representable as JSON object keys. The HTTP
+# transport flattens children to [labels, value] pairs.
+
+
+def state_to_jsonable(state: List[Dict]) -> List[Dict]:
+    out: List[Dict] = []
+    for fam in state:
+        out.append({
+            "name": fam["name"], "kind": fam["kind"],
+            "help": fam["help"], "labels": list(fam["labels"]),
+            "children": [[list(key), value]
+                         for key, value in fam["children"].items()],
+        })
+    return out
+
+
+def state_from_jsonable(doc: List[Dict]) -> List[Dict]:
+    out: List[Dict] = []
+    for fam in doc:
+        children: Dict[Tuple[str, ...], Any] = {}
+        for key, value in fam.get("children", ()):
+            if isinstance(value, dict) and value.get("exemplars"):
+                value = {**value,
+                         "exemplars": [tuple(e) if e else None
+                                       for e in value["exemplars"]]}
+            children[tuple(key)] = value
+        out.append({"name": fam["name"], "kind": fam["kind"],
+                    "help": fam.get("help", ""),
+                    "labels": tuple(fam.get("labels", ())),
+                    "children": children})
+    return out
+
+
+# -- aggregation ------------------------------------------------------------
+
+
+def fleet_state(registry=None) -> Tuple[Dict[str, Dict], Dict[str, str]]:
+    """(merged family map, per-source status). The local registry is
+    always one side of the merge; each registered source contributes
+    its snapshot or an error entry."""
+    reg = registry if registry is not None else REGISTRY
+    with _lock:
+        sources = dict(_sources)
+    remote_states: List[List[Dict]] = []
+    status: Dict[str, str] = {}
+    for name, fn in sources.items():
+        try:
+            state = fn()
+            remote_states.append(state or [])
+            status[name] = "ok"
+        except Exception as exc:  # noqa: BLE001 — summary must render
+            status[name] = f"error: {type(exc).__name__}: {exc}"[:200]
+    return merge_states(dump_state(reg), remote_states), status
+
+
+def render_fleet(registry=None,
+                 openmetrics: bool = False) -> str:
+    """Merged Prometheus exposition across every source — one scrape
+    for the whole fleet."""
+    merged, _status = fleet_state(registry)
+    return _m.render_state(merged, openmetrics=openmetrics)
+
+
+def _quantile_from_snapshot(snap: Dict[str, Any],
+                            q: float) -> Optional[float]:
+    """Bucket-interpolated quantile over a merged histogram snapshot
+    (same math as Histogram.quantile, but over the wire shape)."""
+    total = snap.get("count", 0)
+    if not total:
+        return None
+    bounds = snap["buckets"]
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(snap["counts"]):
+        prev = cum
+        cum += c
+        if cum >= rank:
+            if i >= len(bounds):
+                return bounds[-1]
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            if c == 0:
+                return hi
+            return lo + (hi - lo) * (rank - prev) / c
+    return bounds[-1] if bounds else None
+
+
+def _children(merged: Dict[str, Dict], name: str) -> Dict:
+    fam = merged.get(name)
+    return fam["children"] if fam else {}
+
+
+def fleet_summary(registry=None) -> Dict[str, Any]:
+    """The ``GET /admin/fleet`` payload: one JSON answer to "what is
+    the fleet doing", derived from the merged state."""
+    merged, status = fleet_state(registry)
+    replicas: Dict[str, Dict[str, Any]] = {}
+    for key, v in _children(merged, "nornicdb_replica_lag_ops").items():
+        replicas.setdefault(key[0], {})["lag_ops"] = v
+    for key, v in _children(merged,
+                            "nornicdb_replica_applied_seq").items():
+        replicas.setdefault(key[0], {})["applied_seq"] = v
+    for key, v in _children(merged,
+                            "nornicdb_replica_catching_up").items():
+        replicas.setdefault(key[0], {})["catching_up"] = bool(v)
+    for key, v in _children(merged, "nornicdb_replica_admitted").items():
+        replicas.setdefault(key[0], {})["admitted"] = bool(v)
+    # seconds-not-ops (ISSUE 13): per-node replication apply delay —
+    # "lag 400 ops" becomes "p99 replay delay 38 ms"
+    for key, snap in _children(
+            merged, "nornicdb_replication_apply_delay_seconds").items():
+        if not isinstance(snap, dict) or not snap.get("count"):
+            continue
+        node = replicas.setdefault(key[0], {})
+        node["apply_delay_ms"] = {
+            "count": snap["count"],
+            "p50": _ms(_quantile_from_snapshot(snap, 0.5)),
+            "p99": _ms(_quantile_from_snapshot(snap, 0.99)),
+        }
+    failovers = {key[0]: v for key, v in
+                 _children(merged, "nornicdb_fleet_failover_total").items()
+                 if v}
+    tiers: Dict[str, Dict[str, float]] = {}
+    for key, v in _children(merged, "nornicdb_served_tier_total").items():
+        if v:
+            tiers.setdefault(key[0], {})[key[1]] = v
+    workers = None
+    for _key, v in _children(merged, "nornicdb_wire_workers").items():
+        workers = v
+    return {
+        "sources": status,
+        "families": len(merged),
+        "workers": workers,
+        "replicas": replicas,
+        "failovers": failovers,
+        "tiers": tiers,
+        "events": _events.event_summary(),
+    }
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1e3, 3)

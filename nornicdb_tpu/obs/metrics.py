@@ -569,7 +569,15 @@ def dump_state(registry: Optional[Registry] = None) -> List[Dict]:
         children: Dict[Tuple[str, ...], object] = {}
         for key, child in fam.children().items():
             if fam.kind == "histogram":
-                children[key] = child.snapshot()
+                snap = child.snapshot()
+                # exemplars ride the snapshot so a worker's OpenMetrics
+                # scrape can still join a shared-plane p99 bucket to a
+                # trace — without this the plane's bucket exemplars are
+                # silently dropped at the merge (ISSUE 13 satellite)
+                ex = child.exemplars()
+                if any(e is not None for e in ex):
+                    snap = {**snap, "exemplars": ex}
+                children[key] = snap
             else:
                 children[key] = float(child.value)
         out.append({"name": fam.name, "kind": fam.kind, "help": fam.help,
@@ -578,24 +586,15 @@ def dump_state(registry: Optional[Registry] = None) -> List[Dict]:
     return out
 
 
-def render_merged(remote_states: Sequence[List[Dict]],
-                  registry: Optional[Registry] = None,
-                  extra_gauges: Optional[Dict[str, float]] = None) -> str:
-    """Classic Prometheus exposition of the LOCAL registry merged with
-    remote ``dump_state`` snapshots. Merge discipline (the "exactly
-    once" contract of the multi-worker wire plane):
-
-    - counters and histograms SUM per label tuple — a family the
-      worker registered at import but never observed contributes 0, so
-      the shared plane's series appear once with the true value;
-    - gauges: the remote (shared-plane) value wins on a label-tuple
-      conflict — index memory/freshness/compile-universe gauges are
-      owned by the device plane, a worker-local zero must not mask
-      them — and union otherwise.
-    """
-    reg = registry if registry is not None else REGISTRY
+def merge_states(local_state: List[Dict],
+                 remote_states: Sequence[List[Dict]]) -> Dict[str, Dict]:
+    """Merge ``dump_state`` snapshots under the multi-worker "exactly
+    once" contract (counters/histograms SUM per label tuple, remote
+    gauges win on conflict, union otherwise). Shared by
+    :func:`render_merged` (a worker's /metrics scrape) and the fleet
+    telemetry aggregator (obs/fleet.py)."""
     merged: Dict[str, Dict] = {}
-    for fam_state in dump_state(reg):
+    for fam_state in local_state:
         merged[fam_state["name"]] = {
             **fam_state, "children": dict(fam_state["children"])}
     for state in remote_states:
@@ -620,36 +619,117 @@ def render_merged(remote_states: Sequence[List[Dict]],
                             "counts": [a + b for a, b in
                                        zip(lv["counts"], rv["counts"])],
                             "sum": lv["sum"] + rv["sum"],
-                            "count": lv["count"] + rv["count"]}
+                            "count": lv["count"] + rv["count"],
+                            "exemplars": _merge_exemplars(
+                                lv.get("exemplars"),
+                                rv.get("exemplars"),
+                                len(lv["counts"])),
+                        }
                     else:
                         mine["children"][key] = rv
+    return merged
+
+
+def _merge_exemplars(a, b, n: int):
+    """Per-bucket newest-wins exemplar merge; None when neither side
+    tagged anything (keeps the merged snapshot lean)."""
+    if not a and not b:
+        return None
+    out = []
+    for i in range(n):
+        ea = a[i] if a and i < len(a) else None
+        eb = b[i] if b and i < len(b) else None
+        if ea is not None and eb is not None:
+            out.append(ea if ea[2] >= eb[2] else eb)
+        else:
+            out.append(ea if ea is not None else eb)
+    return out
+
+
+def render_merged(remote_states: Sequence[List[Dict]],
+                  registry: Optional[Registry] = None,
+                  extra_gauges: Optional[Dict[str, float]] = None,
+                  openmetrics: bool = False) -> str:
+    """Prometheus exposition of the LOCAL registry merged with remote
+    ``dump_state`` snapshots. Merge discipline (the "exactly once"
+    contract of the multi-worker wire plane):
+
+    - counters and histograms SUM per label tuple — a family the
+      worker registered at import but never observed contributes 0, so
+      the shared plane's series appear once with the true value;
+    - gauges: the remote (shared-plane) value wins on a label-tuple
+      conflict — index memory/freshness/compile-universe gauges are
+      owned by the device plane, a worker-local zero must not mask
+      them — and union otherwise.
+
+    ``openmetrics=True`` renders the OpenMetrics 1.0 exposition
+    instead (counter TYPE sans ``_total``, ``# EOF``, and bucket
+    exemplars — newest wins per bucket across the merged sides), so a
+    worker scrape under content negotiation keeps the shared plane's
+    trace-id exemplar joins (ISSUE 13 satellite).
+    """
+    reg = registry if registry is not None else REGISTRY
+    merged = merge_states(dump_state(reg), remote_states)
+    return render_state(merged, extra_gauges=extra_gauges,
+                        openmetrics=openmetrics)
+
+
+def render_state(merged: Dict[str, Dict],
+                 extra_gauges: Optional[Dict[str, float]] = None,
+                 openmetrics: bool = False) -> str:
+    """Render a merged family map (:func:`merge_states`) as the classic
+    or OpenMetrics text exposition."""
     out: List[str] = []
     for name in sorted(merged):
         fam = merged[name]
-        out.append(f"# HELP {name} {fam['help']}")
-        out.append(f"# TYPE {name} {fam['kind']}")
         label_names = tuple(fam["labels"])
+        if openmetrics and fam["kind"] == "counter":
+            base = name[:-6] if name.endswith("_total") else name
+            out.append(f"# TYPE {base} counter")
+            if fam["help"]:
+                out.append(f"# HELP {base} {fam['help']}")
+        else:
+            if openmetrics:
+                out.append(f"# TYPE {name} {fam['kind']}")
+                if fam["help"]:
+                    out.append(f"# HELP {name} {fam['help']}")
+            else:
+                out.append(f"# HELP {name} {fam['help']}")
+                out.append(f"# TYPE {name} {fam['kind']}")
         for key in sorted(fam["children"]):
             val = fam["children"][key]
             if fam["kind"] == "histogram":
+                exemplars = val.get("exemplars") if openmetrics else None
                 cum = 0
-                for bound, c in zip(val["buckets"], val["counts"]):
-                    cum += c
-                    lbl = _fmt_labels(label_names, key,
-                                      ("le", _fmt_float(bound)))
-                    out.append(f"{name}_bucket{lbl} {cum}")
-                cum += val["counts"][-1]
-                lbl = _fmt_labels(label_names, key, ("le", "+Inf"))
-                out.append(f"{name}_bucket{lbl} {cum}")
-                base = _fmt_labels(label_names, key)
-                out.append(f"{name}_sum{base} {_fmt_float(val['sum'])}")
-                out.append(f"{name}_count{base} {val['count']}")
+                bounds = list(val["buckets"]) + [None]  # None = +Inf
+                for i, bound in enumerate(bounds):
+                    cum += val["counts"][i]
+                    if openmetrics:
+                        le = ("+Inf" if bound is None
+                              else repr(float(bound)))
+                    else:
+                        le = ("+Inf" if bound is None
+                              else _fmt_float(bound))
+                    lbl = _fmt_labels(label_names, key, ("le", le))
+                    line = f"{name}_bucket{lbl} {cum}"
+                    ex = (exemplars[i] if exemplars
+                          and i < len(exemplars) else None)
+                    if ex is not None:
+                        tid, v, ts = ex
+                        line += (f' # {{trace_id="{_escape_label(tid)}"}}'
+                                 f" {_fmt_float(v)} {ts:.3f}")
+                    out.append(line)
+                base_l = _fmt_labels(label_names, key)
+                out.append(f"{name}_sum{base_l} {_fmt_float(val['sum'])}")
+                out.append(f"{name}_count{base_l} {val['count']}")
             else:
                 lbl = _fmt_labels(label_names, key)
                 out.append(f"{name}{lbl} {_fmt_float(val)}")
     for name, value in sorted((extra_gauges or {}).items()):
         out.append(f"# TYPE {name} gauge")
         out.append(f"{name} {_fmt_float(value)}")
+    if openmetrics:
+        out.append("# EOF")
     return "\n".join(out) + "\n"
 
 

@@ -292,6 +292,7 @@ class SloEngine:
         after the fact. ``extra`` appends caller records (the shadow
         auditor's self-contained parity repro rides here)."""
         from nornicdb_tpu.obs import audit as _audit
+        from nornicdb_tpu.obs import events as _events
         from nornicdb_tpu.obs import resources as _resources
         from nornicdb_tpu.obs import stages as _stages
         from nornicdb_tpu.obs.dispatch import compile_universe
@@ -321,6 +322,11 @@ class SloEngine:
              "summary": _audit.degrade_summary(),
              "ring": _audit.degrade_snapshot(limit=50)},
             {"kind": "parity", "summary": _audit.audit_summary()},
+            # the unified incident timeline (ISSUE 13): drains,
+            # failovers, quarantines and degrades in causal order,
+            # trace-linked — the breach's backstory in one stream
+            {"kind": "events", "summary": _events.event_summary(),
+             "ring": _events.event_snapshot(limit=100)},
         ]
         for rec in (extra or []):
             lines.append(rec)
@@ -332,6 +338,10 @@ class SloEngine:
                 f.write(json.dumps(line, default=str) + "\n")
         os.replace(tmp, path)
         self.dumps.append(path)
+        if reason.startswith("slo_breach"):
+            # an automatic breach dump IS an incident: timeline it
+            _events.record_event("slo_breach", reason=reason,
+                                 detail={"path": path})
         return path
 
 

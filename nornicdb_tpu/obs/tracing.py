@@ -14,6 +14,20 @@ bounded ring holding the most recent requests slower than
 ``NORNICDB_OBS_SLOW_MS`` (default 0: every request qualifies, the ring
 bound keeps memory flat). The HTTP admin surface exposes it at
 ``/admin/traces``.
+
+Cross-process propagation (ISSUE 13): a trace minted in a wire worker
+must not die at the shared-memory ring or an HTTP hop to a replica.
+:func:`trace_context` captures the active trace as a compact dict,
+:func:`pack_context`/:func:`unpack_context` move it over a wire seam
+(a few bytes in a broker slot header, or the ``X-Nornic-Trace`` HTTP
+header), and :func:`propagated_trace` opens a root span on the REMOTE
+side bound to the propagated trace id instead of minting a new one —
+so degrade records, exemplars and ring entries produced over there
+join the originating request's trace. The remote side exports its
+span tree (:func:`export_span`) in the response and the originating
+side grafts it (:func:`attach_span_tree`) into the live root, so
+``/admin/traces`` on the ingress worker shows the full
+wire -> ring -> coalesce -> device.dispatch -> merge chain.
 """
 
 from __future__ import annotations
@@ -21,6 +35,7 @@ from __future__ import annotations
 import contextvars
 import itertools
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -157,20 +172,26 @@ def current_span() -> Optional[Span]:
 
 
 class _ActiveSpan:
-    """Context manager binding a span as the contextvar current."""
+    """Context manager binding a span as the contextvar current.
 
-    __slots__ = ("span", "_token", "_root", "_tid_token")
+    ``tid`` pins a PROPAGATED trace id (minted in another process) on a
+    root span instead of minting a fresh one — the cross-process
+    propagation path (:func:`propagated_trace`)."""
 
-    def __init__(self, span: Span, root: bool) -> None:
+    __slots__ = ("span", "_token", "_root", "_tid_token", "_tid")
+
+    def __init__(self, span: Span, root: bool,
+                 tid: Optional[str] = None) -> None:
         self.span = span
         self._root = root
         self._token = None
         self._tid_token = None
+        self._tid = tid
 
     def __enter__(self) -> Span:
         self._token = _current.set(self.span)
         if self._root:
-            self.span.trace_id = _new_trace_id()
+            self.span.trace_id = self._tid or _new_trace_id()
             self._tid_token = _current_tid.set(self.span.trace_id)
         return self.span
 
@@ -242,6 +263,127 @@ def annotate(**attrs: Any) -> None:
     cur = _current.get()
     if cur is not None:
         cur.attrs.update(attrs)
+
+
+# -- cross-process trace propagation (ISSUE 13) ------------------------------
+
+# the HTTP header carrying a packed trace context across node hops
+# (FleetRouter -> RemoteReplica; any reverse proxy can forward it)
+TRACE_HEADER = "X-Nornic-Trace"
+
+
+def trace_context() -> Optional[Dict[str, str]]:
+    """The active trace as a compact propagation dict
+    (``{"trace_id", "surface", "span"}``), or None outside any trace.
+    Cheap: two contextvar reads + one small dict — safe on the
+    per-request wire path (no trace -> no allocation beyond the gets).
+    """
+    tid = _current_tid.get()
+    if tid is None:
+        return None
+    ctx: Dict[str, str] = {"trace_id": tid}
+    cur = _current.get()
+    if cur is not None:
+        ctx["span"] = cur.name
+        surface = cur.attrs.get("surface") or cur.attrs.get("transport")
+        if surface:
+            ctx["surface"] = str(surface)
+    return ctx
+
+
+def pack_context(ctx: Optional[Dict[str, str]]) -> str:
+    """``trace_id|surface|span`` — the one wire format for both the
+    broker ring slots and the ``X-Nornic-Trace`` HTTP header."""
+    if not ctx or not ctx.get("trace_id"):
+        return ""
+    return "|".join((ctx.get("trace_id", ""), ctx.get("surface", ""),
+                     ctx.get("span", "")))
+
+
+_TID_RE = re.compile(r"^[0-9a-fA-F]{8,64}$")
+_FIELD_RE = re.compile(r"^[\w.:/-]{1,64}$")
+
+
+def unpack_context(packed: Optional[str]) -> Optional[Dict[str, str]]:
+    """Inverse of :func:`pack_context`; None on empty/garbage input
+    (a missing or malformed context degrades to an unlinked local
+    trace, never an error). Fields are charset-validated — the HTTP
+    header is client-reachable, and an arbitrary string must not land
+    in span attrs shown on the admin surface: trace ids must look like
+    the hex ids this process mints, surface/span names like code-
+    chosen identifiers."""
+    if not packed:
+        return None
+    parts = (str(packed).split("|") + ["", ""])[:3]
+    if not _TID_RE.match(parts[0]):
+        return None
+    ctx = {"trace_id": parts[0].lower()}
+    if parts[1] and _FIELD_RE.match(parts[1]):
+        ctx["surface"] = parts[1]
+    if parts[2] and _FIELD_RE.match(parts[2]):
+        ctx["span"] = parts[2]
+    return ctx
+
+
+def propagated_trace(name: str, ctx: Optional[Dict[str, str]],
+                     **attrs: Any):
+    """Open a root span bound to a PROPAGATED trace context: the span
+    records into this process's ring like any root (so the device
+    plane's own ``/admin/traces`` shows plane-side chains), but carries
+    the ORIGINATING request's trace id — degrade records, exemplar
+    tags and child spans opened under it all join that trace. Falls
+    back to a normal :func:`trace` root when no context came across
+    the seam."""
+    if not _m.enabled():
+        return _NULL
+    if not ctx or not ctx.get("trace_id"):
+        return _ActiveSpan(Span(name, **attrs), root=True)
+    span = Span(name, remote=True, **attrs)
+    if ctx.get("span"):
+        span.attrs.setdefault("parent_span", ctx["span"])
+    if ctx.get("surface"):
+        span.attrs.setdefault("origin_surface", ctx["surface"])
+    return _ActiveSpan(span, root=True, tid=ctx["trace_id"])
+
+
+def export_span(span: Span) -> Dict[str, Any]:
+    """Wire-shape export (raw ``t0``/``t1`` floats, not the rendered
+    ``to_dict``) so a remote side can graft the tree with original
+    timing intact."""
+    return {
+        "name": span.name,
+        "t0": span.t0,
+        "t1": span.t1 if span.t1 is not None else time.time(),
+        "attrs": dict(span.attrs),
+        "children": [export_span(c) for c in span.children],
+    }
+
+
+def _span_from_export(doc: Dict[str, Any]) -> Span:
+    t0 = float(doc.get("t0", 0.0) or 0.0)
+    span = Span(str(doc.get("name", "remote")), t0=t0)
+    span.attrs.update(doc.get("attrs") or {})
+    span.t1 = float(doc.get("t1", t0) or t0)
+    for child in doc.get("children", ()) or ():
+        span.children.append(_span_from_export(child))
+    return span
+
+
+def attach_span_tree(doc: Optional[Dict[str, Any]]) -> None:
+    """Graft an exported remote span tree into the current trace —
+    the worker-side half of the ring/HTTP propagation: the plane's
+    ``ring.claim``/``plane.coalesce``/``device.dispatch`` spans land
+    as children of the live root. No-op without an active trace or
+    on malformed input (propagation must never fail a request)."""
+    if not _m.enabled() or not doc:
+        return
+    parent = _current.get()
+    if parent is None:
+        return
+    try:
+        parent.children.append(_span_from_export(doc))
+    except (TypeError, ValueError):
+        pass
 
 
 # exemplar wiring: histograms ask "what trace is observing right now?"

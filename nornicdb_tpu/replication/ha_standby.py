@@ -80,8 +80,11 @@ class HAPrimary(Replicator):
         # that same lock via on_logged — so stream order always matches seq
         # order even with concurrent appliers (a post-hoc read of
         # wal.last_seq could tag two interleaved writes with the same seq
-        # and the standby would silently drop one).
-        rec: Dict[str, Any] = {"op": op, "data": data}
+        # and the standby would silently drop one). ``ts`` is the primary
+        # append instant: replicas difference it against their apply time
+        # into nornicdb_replication_apply_delay_seconds (ISSUE 13).
+        rec: Dict[str, Any] = {"op": op, "data": data,
+                               "ts": round(time.time(), 6)}
 
         if self.config.sync == "quorum":
             rec["seq"] = self.engine.apply_op(op, data)
@@ -210,7 +213,7 @@ class HAPrimary(Replicator):
             pass
         records = [
             {"seq": rec.get("seq", 0), "op": rec["op"],
-             "data": rec.get("data", {})}
+             "data": rec.get("data", {}), "ts": rec.get("ts", 0)}
             for rec in self.engine.wal.iter_records(from_seq=from_seq)
         ]
         last_seq = records[-1]["seq"] if records else from_seq
@@ -306,14 +309,16 @@ class HAStandby(Replicator):
             getattr(self.engine, op)(*decode_op_args(op, data))
 
     def _apply_record(self, op: str, data: Dict[str, Any],
-                      seq: int = 0) -> None:
+                      seq: int = 0, ts: float = 0.0) -> None:
         """One streamed/caught-up record -> the engine. ``seq`` is the
-        PRIMARY's sequence number for the record (0 = unsequenced).
-        Indirection so subclasses can change apply semantics
-        fleet-wide: read replicas apply AND log under the primary's
-        seq — WALEngine.apply_and_log(seq=...) — keeping their local
-        WAL seq-aligned for promotion/rejoin even when they joined
-        mid-history."""
+        PRIMARY's sequence number for the record (0 = unsequenced),
+        ``ts`` the primary's append timestamp (0 = unknown — a record
+        from an older primary). Indirection so subclasses can change
+        apply semantics fleet-wide: read replicas apply AND log under
+        the primary's seq — WALEngine.apply_and_log(seq=...) — keeping
+        their local WAL seq-aligned for promotion/rejoin even when
+        they joined mid-history, and observe the append->apply delay
+        into nornicdb_replication_apply_delay_seconds (ISSUE 13)."""
         self.engine.apply_record(op, data)
 
     @property
@@ -343,12 +348,14 @@ class HAStandby(Replicator):
             max_seq = max(max_seq, seq)
             with self._lock:
                 if seq <= 0:
-                    self._apply_record(rec["op"], rec["data"])
+                    self._apply_record(rec["op"], rec["data"],
+                                       ts=rec.get("ts", 0.0))
                     continue
                 if seq <= self.applied_seq or seq in self._reorder_buf:
                     continue  # duplicate batch overlap
                 if seq == self.applied_seq + 1:
-                    self._apply_record(rec["op"], rec["data"], seq=seq)
+                    self._apply_record(rec["op"], rec["data"], seq=seq,
+                                       ts=rec.get("ts", 0.0))
                     self.applied_seq = seq
                     self._drain_reorder_buf_locked()
                 else:
@@ -372,7 +379,8 @@ class HAStandby(Replicator):
         while self.applied_seq + 1 in self._reorder_buf:
             nxt = self._reorder_buf.pop(self.applied_seq + 1)
             self._apply_record(nxt["op"], nxt["data"],
-                               seq=self.applied_seq + 1)
+                               seq=self.applied_seq + 1,
+                               ts=nxt.get("ts", 0.0))
             self.applied_seq += 1
 
     def handle_heartbeat(self, msg: ClusterMessage) -> ClusterMessage:
@@ -535,7 +543,8 @@ class HAStandby(Replicator):
                     if 0 < seq <= self.applied_seq:
                         continue
                     self._apply_record(rec["op"], rec["data"],
-                                       seq=max(seq, 0))
+                                       seq=max(seq, 0),
+                                       ts=rec.get("ts", 0.0))
                     n += 1
                     if seq > 0:
                         self.applied_seq = max(self.applied_seq, seq)

@@ -55,7 +55,8 @@ import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from nornicdb_tpu.obs.metrics import REGISTRY
+from nornicdb_tpu.obs import events as _events
+from nornicdb_tpu.obs.metrics import LATENCY_BUCKETS, REGISTRY
 from nornicdb_tpu.replication.ha_standby import HAStandby
 from nornicdb_tpu.replication.replicator import ReplicationConfig
 from nornicdb_tpu.storage.types import Edge, Node
@@ -64,6 +65,24 @@ _LAG_G = REGISTRY.gauge(
     "nornicdb_replica_lag_ops",
     "WAL operations between the primary's last_seq and this replica's "
     "applied watermark", labels=("node",))
+# replication latency in SECONDS, not ops (ISSUE 13): every streamed
+# record carries the primary's append timestamp; the replica observes
+# append->apply delay per record — "lag 400 ops" becomes "p99 replay
+# delay 38 ms". Catch-up replays of old history are excluded (a
+# mid-history joiner's day-old records are bootstrap, not steady-state
+# replication latency).
+_APPLY_DELAY_H = REGISTRY.histogram(
+    "nornicdb_replication_apply_delay_seconds",
+    "Per-record delay between primary WAL append and replica apply "
+    "(streamed records; catch-up bootstrap excluded)",
+    labels=("node",), buckets=LATENCY_BUCKETS)
+# where replica-side replay time goes, per record: the listener
+# fan-out (cache invalidation, columnar catalog) vs the search-index
+# apply (brute changelog, BM25, CAGRA triggers)
+_REPLAY_H = REGISTRY.histogram(
+    "nornicdb_replica_replay_seconds",
+    "Replica replay fan-out time per applied record, by stage",
+    labels=("node", "stage"), buckets=LATENCY_BUCKETS)
 _APPLIED_G = REGISTRY.gauge(
     "nornicdb_replica_applied_seq",
     "Last WAL seq this replica has applied", labels=("node",))
@@ -135,7 +154,7 @@ class FleetStandby(HAStandby):
         self._catching = 0
         self._catch_lock = threading.Lock()
 
-    def _apply_record(self, op, data, seq: int = 0):
+    def _apply_record(self, op, data, seq: int = 0, ts: float = 0.0):
         # apply AND log UNDER THE PRIMARY'S SEQ (WALEngine.apply_and_log
         # with seq pinned): the replica's own WAL mirrors the primary's
         # numbering record-for-record even when this replica joined
@@ -148,6 +167,13 @@ class FleetStandby(HAStandby):
         # the true watermark, and this node can serve wal_sync
         # catch-ups itself once promoted.
         self.engine.apply_and_log(op, data, seq=seq if seq > 0 else None)
+        if ts and not self.catching_up:
+            # per-record replication latency (ISSUE 13): primary
+            # append -> replica apply, streamed records only — catch-up
+            # bootstrap replays old history whose age is join depth,
+            # not replication health
+            _APPLY_DELAY_H.labels(self.config.node_id).observe(
+                max(0.0, time.time() - ts))
 
     def _apply_snapshot(self, state, snap_seq: int) -> int:
         # base impl applies through apply_record, so the replica's
@@ -185,6 +211,10 @@ class FleetStandby(HAStandby):
                                                 max(seqs))
         else:
             _FAILOVER_C.labels("fence_rejected").inc()
+            _events.record_event(
+                "fence_rejected", node=self.config.node_id,
+                surface="fleet",
+                reason=f"stale_epoch:{msg.get('epoch', 0)}")
         return r
 
     # -- lag truth -------------------------------------------------------
@@ -316,32 +346,46 @@ class ReadReplica:
         events and index mutations the write produced on the primary.
         Replicated embeddings ride the node dict, so ``index_node``
         lands them straight in the device indexes (brute changelog,
-        BM25, CAGRA rebuild triggers — the standard freshness paths)."""
+        BM25, CAGRA rebuild triggers — the standard freshness paths).
+        Per-stage replay timing (ISSUE 13) splits each record's cost
+        into the listener fan-out vs the search-index apply
+        (nornicdb_replica_replay_seconds{node,stage}) — the seconds
+        behind the apply-delay histogram's tail."""
         listeners = self.db._listenable._each()
         svc = self.db._search
         if op in ("create_node", "update_node"):
             node = self._logical_node(data)
             if node is None:
                 return
+            t0 = time.perf_counter()
             for listener in listeners:
                 try:
                     listener.on_node_upsert(node)
                 except Exception:  # noqa: BLE001 — listener isolation
                     pass
+            t1 = time.perf_counter()
             if svc is not None:
                 svc.index_node(node)
+                _REPLAY_H.labels(self.name, "index").observe(
+                    time.perf_counter() - t1)
+            _REPLAY_H.labels(self.name, "listeners").observe(t1 - t0)
         elif op == "delete_node":
             nid = str(data.get("id", ""))
             if not nid.startswith(self._prefix):
                 return
             nid = nid[len(self._prefix):]
+            t0 = time.perf_counter()
             for listener in listeners:
                 try:
                     listener.on_node_delete(nid)
                 except Exception:  # noqa: BLE001
                     pass
+            t1 = time.perf_counter()
             if svc is not None:
                 svc.remove_node(nid)
+                _REPLAY_H.labels(self.name, "index").observe(
+                    time.perf_counter() - t1)
+            _REPLAY_H.labels(self.name, "listeners").observe(t1 - t0)
         elif op in ("create_edge", "update_edge"):
             edge = self._logical_edge(data)
             if edge is None:
@@ -441,6 +485,8 @@ class ReadReplica:
         if not self._promoted_once:
             self._promoted_once = True
             _FAILOVER_C.labels("promote").inc()
+            _events.record_event("failover", node=self.name,
+                                 surface="fleet", reason="promote")
         self._register_resources()
         if self.on_promote is not None:
             try:

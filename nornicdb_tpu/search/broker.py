@@ -70,6 +70,7 @@ from nornicdb_tpu.obs import (
     record_dispatch,
 )
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import tracing as _tracing
 from nornicdb_tpu.search.microbatch import pow2_bucket
 
 # pre-register the ring's dispatch kind so the compile-universe
@@ -342,19 +343,31 @@ class BrokerClient:
                    timeout_s: Optional[float] = None) -> Dict[str, Any]:
         """Raw-embedding coalesced search: one rider of a cross-worker
         batched device dispatch. Returns ``{"hits", "tier", "t_claim",
-        "t0", "t1", "batch", "t_post"}``."""
+        "t0", "t1", "batch", "t_post"}`` plus plane-side ``spans`` when
+        the rider posted under an active trace (ISSUE 13): the slot
+        carries a compact trace context behind the key, the plane's
+        child spans ride the response back, and the worker grafts them
+        so the ingress trace shows the full chain."""
         vec = np.ascontiguousarray(vec, dtype=np.float32)
         kb = key.encode("utf-8")
-        payload = (struct.pack("<HI", len(kb), vec.shape[0]) + kb
-                   + vec.tobytes())
+        tb = _tracing.pack_context(_tracing.trace_context()) \
+            .encode("utf-8")
+        payload = (struct.pack("<HHI", len(kb), len(tb), vec.shape[0])
+                   + kb + tb + vec.tobytes())
         return self._roundtrip(OP_VEC, payload, k, timeout_s)
 
     def call(self, target: str, method: str, *args,
              timeout_s: Optional[float] = None, **kwargs) -> Dict[str, Any]:
         """Generic op on a device-plane target. Returns ``{"result",
         "meta", timing...}``; remote exceptions re-raise as
-        :class:`BrokerRemoteError`."""
-        payload = pickle.dumps((target, method, args, kwargs), protocol=5)
+        :class:`BrokerRemoteError`. The active trace context rides the
+        pickled tuple, so the plane executes the op under a PROPAGATED
+        trace — degrade records minted over there carry this rider's
+        trace id, and the plane-side span tree comes back in
+        ``meta["spans"]``."""
+        payload = pickle.dumps(
+            (target, method, args, kwargs, _tracing.trace_context()),
+            protocol=5)
         return self._roundtrip(OP_CALL, payload, 0, timeout_s)
 
     def _roundtrip(self, op: int, payload: bytes, k: int,
@@ -561,11 +574,18 @@ class DispatchBroker:
             hdr = _read_hdr(self._buf, off)
             op, req_len, k = hdr[1], hdr[5], hdr[7]
             if op == OP_VEC:
-                head = struct.unpack_from("<HI", self._buf, off + _HDR_SIZE)
-                key_len, dims = head
-                key = bytes(self._buf[off + _HDR_SIZE + 6:
-                                      off + _HDR_SIZE + 6 + key_len]
+                head = struct.unpack_from("<HHI", self._buf,
+                                          off + _HDR_SIZE)
+                key_len, ctx_len, dims = head
+                base = off + _HDR_SIZE + 8
+                key = bytes(self._buf[base:base + key_len]
                             ).decode("utf-8")
+                ctx = None
+                if ctx_len:
+                    ctx = _tracing.unpack_context(bytes(
+                        self._buf[base + key_len:
+                                  base + key_len + ctx_len]
+                    ).decode("utf-8", errors="replace"))
                 with self._busy_lock:
                     if self._vec_busy.get(key):
                         # leader/rider: a dispatch for this key is in
@@ -579,8 +599,8 @@ class DispatchBroker:
                     # round — claiming it here would orphan the slot
                     continue
                 item = {"off": off, "k": k, "dims": dims,
-                        "vec_off": off + _HDR_SIZE + 6 + key_len,
-                        "t_post": hdr[8], "worker": w}
+                        "vec_off": base + key_len + ctx_len,
+                        "t_post": hdr[8], "worker": w, "ctx": ctx}
                 group.append((w, s, item))
             else:
                 req = bytes(self._buf[off + _HDR_SIZE:
@@ -647,9 +667,26 @@ class DispatchBroker:
                 queries = np.concatenate([queries, pad], axis=0)
             t0 = time.time()
             _audit.consume_batch_tier()
-            results = self._vec_dispatch(key, queries, k_max)
+            _audit.consume_fleet_node()
+            # the LEADER's trace context (first rider that carried one)
+            # binds the plane-side dispatch: degrade records and spans
+            # minted inside join the leader's trace — the MicroBatcher
+            # precedent (the leader's dispatch story is the batch's)
+            lead_ctx = next((item["ctx"] for _w, _s, item in group
+                             if item.get("ctx")), None)
+            if lead_ctx is not None:
+                with _tracing.propagated_trace(
+                        "broker.vec", lead_ctx, key=key, batch=b,
+                        surface="broker"):
+                    results = self._vec_dispatch(key, queries, k_max)
+            else:
+                results = self._vec_dispatch(key, queries, k_max)
             t1 = time.time()
             tier = _audit.consume_batch_tier()
+            # fleet-routed reads stamp the chosen node (ISSUE 13): the
+            # FleetRouter notes which replica served this thread's
+            # dispatch; the stamp rides every rider's response
+            node = _audit.consume_fleet_node()
             record_dispatch("broker_vec", bucket, k_max, t1 - t0)
             # rider-accurate tier attribution (ISSUE 10) for the ring
             # path: the direct batched dispatch bypasses a MicroBatcher
@@ -663,6 +700,11 @@ class DispatchBroker:
                 k = item["k"]
                 doc = {"hits": list(hits[:k] if k < k_max else hits),
                        "tier": tier}
+                if node:
+                    doc["node"] = node
+                if item.get("ctx"):
+                    doc["spans"] = _vec_span_docs(
+                        item["t_post"], t_claim, t0, t1, b, tier, node)
                 self._respond(item["off"], hdr, 1, doc, t_claim, t0, t1,
                               b, item["worker"])
         except Exception as exc:  # noqa: BLE001 — poison isolation
@@ -678,11 +720,27 @@ class DispatchBroker:
                     kb = pow2_bucket(max(item["k"], 1))
                     t0 = time.time()
                     _audit.consume_batch_tier()
-                    res = self._vec_dispatch(key, np.array(q1), kb)[0]
+                    _audit.consume_fleet_node()
+                    if item.get("ctx") is not None:
+                        with _tracing.propagated_trace(
+                                "broker.vec", item["ctx"], key=key,
+                                batch=1, surface="broker"):
+                            res = self._vec_dispatch(
+                                key, np.array(q1), kb)[0]
+                    else:
+                        res = self._vec_dispatch(key, np.array(q1),
+                                                 kb)[0]
                     t1 = time.time()
                     tier = _audit.consume_batch_tier()
+                    node = _audit.consume_fleet_node()
                     _audit.record_served("vector", tier or "host")
                     doc = {"hits": list(res[:item["k"]]), "tier": tier}
+                    if node:
+                        doc["node"] = node
+                    if item.get("ctx"):
+                        doc["spans"] = _vec_span_docs(
+                            item["t_post"], t_claim, t0, t1, 1, tier,
+                            node)
                     self._respond(item["off"], hdr, 1, doc, t_claim,
                                   t0, t1, 1, item["worker"])
                 except Exception as single:  # noqa: BLE001
@@ -700,24 +758,65 @@ class DispatchBroker:
         off = item["off"]
         hdr = _read_hdr(self._buf, off)
         try:
-            target_name, method, args, kwargs = pickle.loads(item["req"])
+            req = pickle.loads(item["req"])
+            target_name, method, args, kwargs = req[:4]
+            ctx = req[4] if len(req) > 4 else None
             obj = self._targets[target_name]
             fn = obj
             for part in method.split("."):
                 fn = getattr(fn, part)
             t0 = time.time()
             _audit.set_last_served(None)
+            pspan = None
             with _audit.collect_degrades() as degrades:
-                result = fn(*args, **kwargs)
+                if ctx is not None:
+                    # PROPAGATED trace (ISSUE 13): the op executes
+                    # under the rider's trace id, so degrade records
+                    # minted here carry it across the boundary, and
+                    # plane-side child spans export back in meta
+                    with _tracing.propagated_trace(
+                            "plane.call", ctx, target=target_name,
+                            op=method, surface="broker") as pspan:
+                        result = fn(*args, **kwargs)
+                else:
+                    result = fn(*args, **kwargs)
             t1 = time.time()
             meta = {"tier": _audit.last_served(),
                     "degrades": list(degrades)}
+            if isinstance(pspan, _tracing.Span):
+                # telemetry disabled plane-side returns a _NullSpan —
+                # serve untraced rather than fail the op on export
+                meta["spans"] = [_tracing.export_span(pspan)]
             self._respond(off, hdr, 1, {"result": result, "meta": meta},
                           t_claim, t0, t1, 1, item["worker"])
         except Exception as exc:  # noqa: BLE001 — delivered per-request
             _ERRS_C.labels("call_error").inc()
             self._respond(off, hdr, 0, _remote_error_doc(exc), t_claim,
                           time.time(), time.time(), 1, item["worker"])
+
+
+def _vec_span_docs(t_post: float, t_claim: float, t0: float, t1: float,
+                   batch: int, tier: Optional[str],
+                   node: Optional[str]) -> List[Dict[str, Any]]:
+    """Plane-side span records for ONE OP_VEC rider — the exported
+    tree the worker grafts into its live trace so `/admin/traces` on
+    the ingress worker shows the full wire -> ring -> coalesce ->
+    device.dispatch chain with original timing."""
+    dispatch_attrs: Dict[str, Any] = {"surface": "broker",
+                                      "batch": batch,
+                                      "kind": "broker_vec"}
+    if tier:
+        dispatch_attrs["tier"] = tier
+    if node:
+        dispatch_attrs["fleet_node"] = node
+    return [
+        {"name": "ring.claim", "t0": t_post, "t1": t_claim,
+         "attrs": {"surface": "broker"}, "children": []},
+        {"name": "plane.coalesce", "t0": t_claim, "t1": t0,
+         "attrs": {"surface": "broker"}, "children": []},
+        {"name": "device.dispatch", "t0": t0, "t1": t1,
+         "attrs": dispatch_attrs, "children": []},
+    ]
 
 
 def _remote_error_doc(exc: Exception) -> Tuple[str, str, int]:

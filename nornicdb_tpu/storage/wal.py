@@ -202,7 +202,13 @@ class WAL:
                 self._seq = seq
             else:
                 self._seq += 1
-            rec = {"seq": self._seq, "op": op, "data": data}
+            # the primary append timestamp rides every record so a
+            # replica can observe per-record replication latency in
+            # SECONDS (nornicdb_replication_apply_delay_seconds,
+            # ISSUE 13) — wal_sync catch-ups ship it alongside seq.
+            # Replay ignores unknown keys, so old logs stay readable.
+            rec = {"seq": self._seq, "op": op, "data": data,
+                   "ts": round(time.time(), 6)}
             payload = self._encode(rec)
             frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
             self._ensure_segment(len(frame))

@@ -117,6 +117,14 @@ CHECKS: Dict[str, Tuple] = {
     # admitted on a wrong answer is a correctness bug, not noise.
     "fleet_read_qps": ("qps", 0.5),
     "replica_parity": ("quality", 1.0, 0.0),
+    # cross-process trace propagation (round r13+): the fraction of
+    # traced ring-routed reads whose span tree carries the full
+    # plane-side chain. Gates ABSOLUTELY at 1.0 from the first round
+    # it appears — a broken propagation seam is wrong, not slow (the
+    # fleet_read_qps floor above is the companion guard that the
+    # instrumented wire path stays inside the ≤2x+1ms overhead
+    # budget tests pin).
+    "trace_completeness": ("quality", 1.0, 0.0),
 }
 
 
@@ -217,9 +225,11 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     if isinstance(fl, list):
         out["fleet_read_qps"] = _num(fl[0]) if len(fl) > 0 else None
         out["replica_parity"] = _num(fl[2]) if len(fl) > 2 else None
+        out["trace_completeness"] = _num(fl[4]) if len(fl) > 4 else None
     else:
         out["fleet_read_qps"] = _num(fl.get("fleet_read_qps"))
         out["replica_parity"] = _num(fl.get("replica_parity"))
+        out["trace_completeness"] = _num(fl.get("trace_completeness"))
     surfaces = doc.get("surfaces") or {}
     for name in ("bolt", "neo4j_http", "graphql", "rest_search",
                  "qdrant_grpc"):

@@ -35,6 +35,8 @@ import sys
 #   grep -rn "REGISTRY\.\(counter\|histogram\|gauge\)(" nornicdb_tpu
 IMPORT_TIME_MODULES = (
     "nornicdb_tpu.obs",            # dispatch, stages, cost families
+    "nornicdb_tpu.obs.events",     # incident-timeline counter (ISSUE 13)
+    "nornicdb_tpu.obs.fleet",      # fleet-aggregator sources gauge
     "nornicdb_tpu.search.microbatch",
     "nornicdb_tpu.search.broker",  # wire-plane broker families (ISSUE 11)
     "nornicdb_tpu.search.service",
@@ -110,6 +112,14 @@ def tier_vocabulary():
     return sorted(audit.ALL_TIERS), sorted(audit.REASONS)
 
 
+def event_kinds():
+    """Incident-timeline event kinds (obs/events.py, ISSUE 13) — the
+    /admin/events vocabulary the catalog must carry."""
+    from nornicdb_tpu.obs import events
+
+    return sorted(events.KINDS)
+
+
 def missing_terms(doc_text: str, names) -> list:
     """Vocabulary values (dispatch kinds, tier labels, degrade
     reasons) with no word-boundary mention in the catalog."""
@@ -141,11 +151,16 @@ def main(argv=None) -> int:
     # label and normalized degrade reason must be documented
     kinds = declared_dispatch_kinds()
     tiers, reasons = tier_vocabulary()
+    events = event_kinds()
     missing_kinds = missing_terms(doc_text, kinds)
     missing_tiers = missing_terms(doc_text, tiers)
     missing_reasons = missing_terms(doc_text, reasons)
+    # ISSUE 13: the incident-timeline kinds are catalog contract too —
+    # an undocumented /admin/events kind fails the lint like an
+    # undocumented tier or reason
+    missing_events = missing_terms(doc_text, events)
     drift = bool(missing or missing_kinds or missing_tiers
-                 or missing_reasons)
+                 or missing_reasons or missing_events)
     verdict = {
         "catalog_lint": True,
         "doc": os.path.relpath(doc_path, repo),
@@ -153,10 +168,12 @@ def main(argv=None) -> int:
         "dispatch_kinds": len(kinds),
         "tiers": len(tiers),
         "reasons": len(reasons),
+        "event_kinds": len(events),
         "missing": missing,
         "missing_kinds": missing_kinds,
         "missing_tiers": missing_tiers,
         "missing_reasons": missing_reasons,
+        "missing_events": missing_events,
         "verdict": "drift" if drift else "pass",
     }
     print(json.dumps(verdict))

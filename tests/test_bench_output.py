@@ -122,8 +122,14 @@ def _fake_result():
                   "replay_lag": {"burst_ops": 1500,
                                  "peak_lag_ops": 447,
                                  "drain_s": 1.09},
+                  "apply_delay": {"replica-0": {"count": 900,
+                                                "p50_ms": 7.2,
+                                                "p99_ms": 38.0}},
+                  "apply_delay_p99_ms": 38.0,
+                  "trace_completeness": 1.0,
                   "drain": {"breached_drained": True,
-                            "ledger_reason": True, "recovered": True}},
+                            "ledger_reason": True, "recovered": True,
+                            "events_ordered": True}},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -184,10 +190,13 @@ class TestCompactSummary:
                               "chain_conc_device_qps": 3100.0,
                               "traverse_rank_qps_b16": 13000.0,
                               "compile_buckets": 7}
-        # read fleet (ISSUE 12), packed [qps, scaling, parity, drain]:
-        # router read rate, scaling vs single node, the parity-gated-
-        # admission verdict (sentinel absolute floor 1.0), drain flag
-        assert s["fleet"] == [2600.0, 0.49, 1.0, True]
+        # read fleet (ISSUE 12/13), packed [qps, scaling, parity,
+        # drain, trace_completeness]: router read rate, scaling vs
+        # single node, the parity-gated-admission verdict (sentinel
+        # absolute floor 1.0), drain flag, and the cross-process
+        # trace-completeness fraction (sentinel absolute floor 1.0;
+        # apply-delay p50/p99 rides the full artifact)
+        assert s["fleet"] == [2600.0, 0.49, 1.0, True, 1.0]
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -508,11 +517,27 @@ class TestBenchDryRunArtifactSchema:
         assert drain["breached_drained"] is True
         assert drain["ledger_reason"] is True
         assert drain["recovered"] is True
-        # the summary packs [qps, scaling, parity, drain] for the
-        # sentinel (tail-window economy)
+        # fleet truth (ISSUE 13): the drain->recover round trip must
+        # land in the incident timeline as ordered records
+        assert drain["events_ordered"] is True
+        # per-record replication latency in SECONDS: the write burst
+        # streamed through the WAL plane, so both replicas carry
+        # non-empty apply-delay histograms
+        assert len(fl["apply_delay"]) == 2, fl["apply_delay"]
+        for node_delay in fl["apply_delay"].values():
+            assert node_delay["count"] > 0
+            assert node_delay["p99_ms"] >= node_delay["p50_ms"] >= 0
+        assert fl["apply_delay_p99_ms"] is not None
+        # cross-process trace propagation: every traced ring-routed
+        # read carried the full plane-side chain (absolute 1.0 —
+        # a broken seam is wrong, not slow)
+        assert fl["trace_completeness"] == 1.0
+        # the summary packs [qps, scaling, parity, drain,
+        # trace_completeness] for the sentinel (tail-window economy)
         assert summary["fleet"][0] == fl["fleet_read_qps"]
         assert summary["fleet"][2] == 1.0
         assert summary["fleet"][3] is True
+        assert summary["fleet"][4] == 1.0
 
 
 class TestTpuProofDryRun:
