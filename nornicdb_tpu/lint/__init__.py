@@ -1,0 +1,226 @@
+"""nornic-lint: AST-driven invariant suite over the whole package.
+
+The reference engine keeps a 255k-LoC concurrent codebase honest with
+the race detector and 397 test files; this port grew the same class of
+hand-enforced invariants — pow2 compile buckets, snapshot version
+re-checks, the normalized degrade vocabulary, lock-guarded freshness
+counters, ~51 env knobs — but until ISSUE 14 only the metrics catalog
+was machine-checked. ``nornicdb_tpu.lint`` turns the rest into a static
+gate wired into tier-1 (``scripts/nornic_lint.py``; default-suite test
+in ``tests/test_lint.py``).
+
+Five passes (see each module's docstring for rules):
+
+- ``jit-hygiene``       host syncs / env reads / unbucketed dispatch
+                        shapes in jit-traced code (jit_hygiene.py)
+- ``lock-discipline``   single-writer heuristic: attributes written
+                        under ``with self._lock`` must never be written
+                        outside it (lock_discipline.py)
+- ``degrade-contract``  ``record_degrade`` reason vocabulary + per-
+                        module post-dispatch version re-checks
+                        (degrade_contract.py)
+- ``env-knob-catalog``  every NORNICDB_* read documented; per-request
+                        env reads on registered hot paths flagged
+                        (env_catalog.py)
+- ``metrics-catalog``   the pre-existing scripts/check_metrics_catalog
+                        drift lint, folded in (metrics_catalog.py)
+
+Grandfathered findings live in a committed baseline
+(``scripts/nornic_lint_baseline.json``) keyed by line-stable
+fingerprints; ``--update-baseline`` regenerates it. Inline escape
+hatches (``# lint: unguarded-ok`` and friends) suppress individual
+findings at the source line — see docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, asdict
+from typing import Dict, List, Optional, Sequence
+
+__all__ = [
+    "Finding",
+    "PASSES",
+    "pass_names",
+    "run_passes",
+    "load_baseline",
+    "save_baseline",
+    "apply_baseline",
+    "DEFAULT_BASELINE",
+]
+
+DEFAULT_BASELINE = os.path.join("scripts", "nornic_lint_baseline.json")
+
+
+@dataclass
+class Finding:
+    """One lint violation.
+
+    ``fingerprint`` deliberately excludes the line number: baselined
+    findings must survive unrelated edits above them. ``detail`` is the
+    stable discriminator inside a context (attribute name, knob name,
+    offending call text).
+    """
+
+    pass_name: str
+    rule: str
+    path: str  # repo-relative
+    line: int
+    context: str = ""  # dotted qualname of the enclosing def/class
+    detail: str = ""
+    message: str = ""
+
+    def fingerprint(self) -> str:
+        return "|".join(
+            (self.pass_name, self.rule, self.path, self.context,
+             self.detail))
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+    def render(self) -> str:
+        ctx = f" [{self.context}]" if self.context else ""
+        return (f"{self.path}:{self.line}:{ctx} {self.rule}: "
+                f"{self.message}")
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+
+def _load_passes():
+    from nornicdb_tpu.lint import (
+        degrade_contract,
+        env_catalog,
+        jit_hygiene,
+        lock_discipline,
+        metrics_catalog,
+    )
+
+    return {
+        "jit-hygiene": jit_hygiene,
+        "lock-discipline": lock_discipline,
+        "degrade-contract": degrade_contract,
+        "env-knob-catalog": env_catalog,
+        "metrics-catalog": metrics_catalog,
+    }
+
+
+class _PassRegistry:
+    """Lazy pass table: importing ``nornicdb_tpu.lint`` must stay cheap
+    (the metrics pass imports the serving modules on *run*, not on
+    import)."""
+
+    def __init__(self):
+        self._passes = None
+
+    def _table(self):
+        if self._passes is None:
+            self._passes = _load_passes()
+        return self._passes
+
+    def names(self) -> List[str]:
+        return list(self._table().keys())
+
+    def get(self, name: str):
+        return self._table()[name]
+
+    def items(self):
+        return self._table().items()
+
+
+PASSES = _PassRegistry()
+
+
+def pass_names() -> List[str]:
+    return PASSES.names()
+
+
+def pass_descriptions() -> Dict[str, str]:
+    """First docstring line of each pass module — ``--list-passes``."""
+    out = {}
+    for name, mod in PASSES.items():
+        doc = (mod.__doc__ or "").strip().splitlines()
+        out[name] = doc[0] if doc else ""
+    return out
+
+
+def run_passes(
+    root: str,
+    passes: Optional[Sequence[str]] = None,
+    tree=None,
+) -> List[Finding]:
+    """Run the selected passes (default: all) over the package rooted
+    at ``root`` and return raw findings — baseline not yet applied,
+    escape hatches already honored (suppression is a property of the
+    source, not of the run)."""
+    from nornicdb_tpu.lint.astutil import load_package
+
+    selected = list(passes) if passes else pass_names()
+    unknown = [p for p in selected if p not in pass_names()]
+    if unknown:
+        raise ValueError(f"unknown lint pass(es): {unknown}; "
+                         f"known: {pass_names()}")
+    if tree is None:
+        tree = load_package(root)
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(PASSES.get(name).run(tree))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> grandfathered count. Missing file = empty
+    baseline (a fresh checkout lints strictly)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(
+    path: str,
+    findings: Sequence[Finding],
+    extra: Optional[Dict[str, int]] = None,
+) -> Dict:
+    """Write the baseline for ``findings``; ``extra`` carries
+    fingerprint counts to preserve verbatim (a subset-pass CLI update
+    keeps the unselected passes' grandfathered entries through it)."""
+    counts: Dict[str, int] = dict(extra or {})
+    for f in findings:
+        fp = f.fingerprint()
+        counts[fp] = counts.get(fp, 0) + 1
+    data = {
+        "version": 1,
+        "generated_by": "scripts/nornic_lint.py --update-baseline",
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return data
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> List[Finding]:
+    """Findings NOT covered by the baseline. Counted per fingerprint:
+    a second violation with the same fingerprint (new unguarded write
+    of the same attribute in the same method) is fresh even though the
+    first is grandfathered."""
+    budget = dict(baseline)
+    fresh: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            fresh.append(f)
+    return fresh

@@ -1,0 +1,395 @@
+"""Shared AST plumbing for the lint passes.
+
+One parse of the package per run: ``load_package`` returns a
+``PackageTree`` of ``ModuleInfo`` (ast + source lines + parent links);
+passes walk it read-only. Helpers here encode the repo idioms the
+passes share — what counts as an env read, what counts as a jit
+wrapper, how escape-hatch comments suppress a finding, and qualname
+computation for line-stable fingerprints.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+PACKAGE = "nornicdb_tpu"
+
+# directories never linted (generated protobuf stubs, vendored UI)
+_SKIP_PARTS = ("__pycache__",)
+_SKIP_SUFFIXES = ("_pb2.py", "_pb2_grpc.py")
+
+
+@dataclass
+class ModuleInfo:
+    rel: str                 # repo-relative path, forward slashes
+    path: str                # absolute path
+    tree: ast.Module
+    lines: List[str]         # raw source lines (no trailing newline)
+
+    @property
+    def modname(self) -> str:
+        """Dotted module name: nornicdb_tpu/search/cagra.py ->
+        nornicdb_tpu.search.cagra"""
+        mod = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        mod = mod.replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+
+@dataclass
+class PackageTree:
+    root: str                       # repo root
+    modules: Dict[str, ModuleInfo]  # rel -> info
+
+    def by_modname(self, modname: str) -> Optional[ModuleInfo]:
+        for m in self.modules.values():
+            if m.modname == modname:
+                return m
+        return None
+
+
+def _link_parents(tree: ast.Module) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._lint_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_lint_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing_function(node: ast.AST):
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted path of enclosing defs/classes, innermost last. Stable
+    under unrelated edits — the fingerprint context."""
+    parts: List[str] = []
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(anc.name)
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        parts.insert(0, node.name)
+    return ".".join(reversed(parts))
+
+
+def load_package(root: str, package: str = PACKAGE) -> PackageTree:
+    modules: Dict[str, ModuleInfo] = {}
+    pkg_root = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d not in _SKIP_PARTS]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            if any(fn.endswith(s) for s in _SKIP_SUFFIXES):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError:
+                # a file the interpreter can't parse fails tier-1 long
+                # before the lint does; skip rather than crash the run
+                continue
+            _link_parents(tree)
+            modules[rel] = ModuleInfo(
+                rel=rel, path=path, tree=tree,
+                lines=src.splitlines())
+    return PackageTree(root=root, modules=modules)
+
+
+def parse_sources(root: str, sources: Dict[str, str]) -> PackageTree:
+    """A tree from in-memory {rel: source} mappings — the test-fixture
+    entry point (tests/test_lint.py lints snippets in isolation)."""
+    modules: Dict[str, ModuleInfo] = {}
+    for rel, src in sources.items():
+        tree = ast.parse(src, filename=rel)
+        _link_parents(tree)
+        modules[rel] = ModuleInfo(
+            rel=rel, path=os.path.join(root, rel), tree=tree,
+            lines=src.splitlines())
+    return PackageTree(root=root, modules=modules)
+
+
+def parse_single(root: str, rel: str, src: str) -> PackageTree:
+    """One-module convenience wrapper over :func:`parse_sources`."""
+    return parse_sources(root, {rel: src})
+
+
+# ---------------------------------------------------------------------------
+# escape hatches
+# ---------------------------------------------------------------------------
+
+_HATCH_RE = re.compile(r"#\s*lint:\s*([a-z0-9_,\- ]+)")
+
+
+def suppressed(mod: ModuleInfo, lineno: int, token: str) -> bool:
+    """True when the source line (or the line above — multi-line calls
+    put the directive where it fits) carries ``# lint: <token>``."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(mod.lines):
+            m = _HATCH_RE.search(mod.lines[ln - 1])
+            if m and token in [t.strip()
+                               for t in m.group(1).split(",")]:
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# source rendering
+# ---------------------------------------------------------------------------
+
+def src(mod: ModuleInfo, node: ast.AST) -> str:
+    try:
+        return ast.get_source_segment(
+            "\n".join(mod.lines), node) or ast.dump(node)
+    except Exception:
+        return ast.dump(node)
+
+
+def short_src(mod: ModuleInfo, node: ast.AST, limit: int = 80) -> str:
+    text = " ".join(src(mod, node).split())
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+
+# ---------------------------------------------------------------------------
+# name-chain helpers
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression: ``os.environ.get`` ->
+    "os.environ.get"; non-name parts render as empty segments."""
+    if isinstance(node, ast.Attribute):
+        return f"{dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return dotted(node.func)
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted(call.func)
+
+
+# ---------------------------------------------------------------------------
+# env-read detection (shared by jit-hygiene and env-knob-catalog)
+# ---------------------------------------------------------------------------
+
+_ENV_HELPER_RE = re.compile(r"(^|\.)_?env_(int|float|str|bool|s|ms)$")
+_KNOB_RE = re.compile(r"^NORNICDB_[A-Z0-9_]+$")
+
+
+def is_env_read_call(call: ast.Call) -> bool:
+    """Call that reads the process environment: ``os.environ.get``,
+    ``os.getenv``, ``os.environ.setdefault``, or one of the repo's
+    ``_env_int``-style helpers."""
+    name = call_name(call)
+    if not name:
+        return False
+    if name.endswith("environ.get") or name.endswith(
+            "environ.setdefault"):
+        return True
+    if name.endswith("getenv"):
+        return True
+    if _ENV_HELPER_RE.search(name):
+        return True
+    return False
+
+
+def is_env_read_node(node: ast.AST) -> bool:
+    """Any env-read expression: the calls above, ``os.environ[...]``
+    subscripts, and ``"X" in os.environ`` membership tests."""
+    if isinstance(node, ast.Call):
+        return is_env_read_call(node)
+    if isinstance(node, ast.Subscript):
+        # ctx matters: os.environ["X"] = v is a WRITE — cataloguing it
+        # as a read (or flagging it on a hot path) misdiagnoses
+        return isinstance(node.ctx, ast.Load) \
+            and dotted(node.value).endswith("environ")
+    if isinstance(node, ast.Compare):
+        return any(
+            dotted(c).endswith("environ") for c in node.comparators)
+    return False
+
+
+_SHORT_KNOB_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+# config.py's prefix-adding helpers: env_bool("HYBRID_FUSED") reads
+# NORNICDB_HYBRID_FUSED. The leading-underscore variants (audit's
+# _env_float, broker's _env_int) take FULL names.
+_PREFIXING_HELPERS = ("env_str", "env_bool", "env_int", "env_float")
+
+
+def knob_literal(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """The NORNICDB_* knob a read targets, when statically knowable.
+
+    Handles literal first args, module-level str-constant indirection
+    (``ENV_VAR = "NORNICDB_X"; os.environ.get(ENV_VAR)``),
+    subscript/membership forms, and config.py's prefix-adding helpers
+    (``env_bool("HYBRID_FUSED")`` -> NORNICDB_HYBRID_FUSED). Fully
+    dynamic names (``ENV_PREFIX + name`` inside config.py itself)
+    return None — that generic plumbing is catalogued via the config
+    schema, not per-site.
+    """
+    candidates: List[ast.AST] = []
+    prefixing = False
+    if isinstance(node, ast.Call):
+        prefixing = dotted(node.func).split(".")[-1] \
+            in _PREFIXING_HELPERS
+        candidates = list(node.args[:1]) + [
+            kw.value for kw in node.keywords
+            if kw.arg in ("key", "name")]
+    elif isinstance(node, ast.Subscript):
+        candidates = [node.slice]
+    elif isinstance(node, ast.Compare):
+        candidates = [node.left]
+    for cand in candidates:
+        val: Optional[str] = None
+        if isinstance(cand, ast.Constant) and isinstance(
+                cand.value, str):
+            val = cand.value
+        elif isinstance(cand, ast.Name):
+            val = module_str_constant(mod, cand.id)
+        if val is None:
+            continue
+        if _KNOB_RE.match(val):
+            return val
+        if prefixing and _SHORT_KNOB_RE.match(val):
+            return "NORNICDB_" + val
+    return None
+
+
+def module_str_constant(mod: ModuleInfo, name: str) -> Optional[str]:
+    """Value of a module-level ``NAME = "literal"`` assignment."""
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    if isinstance(stmt.value, ast.Constant) and \
+                            isinstance(stmt.value.value, str):
+                        return stmt.value.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# jit detection (shared by jit-hygiene and degrade-contract)
+# ---------------------------------------------------------------------------
+
+def _is_jit_name(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` imported from jax."""
+    name = dotted(node)
+    return name == "jit" or name.endswith(".jit")
+
+
+def _is_jit_factory(node: ast.AST) -> bool:
+    """Expression that evaluates to a jit transform:
+    ``jax.jit`` itself or ``functools.partial(jax.jit, ...)``."""
+    if _is_jit_name(node):
+        return True
+    if isinstance(node, ast.Call):
+        fname = call_name(node)
+        if fname.endswith("partial") and node.args \
+                and _is_jit_name(node.args[0]):
+            return True
+    return False
+
+
+_SHARD_WRAP_RE = re.compile(r"(^|[._])shard_map$")
+
+
+def traced_function_names(mod: ModuleInfo) -> Dict[str, ast.AST]:
+    """Module-local functions that run under jax tracing.
+
+    Seeds: defs decorated with ``jax.jit`` / ``functools.partial(
+    jax.jit, ...)``; defs wrapped by assignment (``X = jax.jit(f)`` or
+    ``X = functools.partial(jax.jit, ...)(f)``); first args of
+    ``*shard_map`` wrapping calls. The closure is taken over the
+    module-local call graph: anything a traced function calls is traced
+    during trace. Returns name -> def node (includes the *wrapper*
+    assignment names so call sites can be recognized).
+    """
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    traced: Dict[str, ast.AST] = {}
+
+    def mark(name: str, node: Optional[ast.AST] = None) -> None:
+        if name not in traced:
+            traced[name] = node if node is not None \
+                else defs.get(name, ast.Pass())
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_factory(dec):
+                    mark(node.name, node)
+        elif isinstance(node, ast.Assign):
+            val = node.value
+            if isinstance(val, ast.Call):
+                wrapped: Optional[str] = None
+                if _is_jit_factory(val.func) or _SHARD_WRAP_RE.search(
+                        call_name(val)):
+                    for arg in val.args:
+                        if isinstance(arg, ast.Name) \
+                                and arg.id in defs:
+                            wrapped = arg.id
+                            break
+                if wrapped:
+                    mark(wrapped)
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            # the wrapper name is a traced entry point
+                            # at call sites, but has no body of its own
+                            mark(tgt.id, defs.get(wrapped))
+        elif isinstance(node, ast.Call):
+            # fn passed into a shard_map/scan/while_loop combinator
+            # inside any traced body is handled by the closure below;
+            # top-level shard_map wrapping outside Assign:
+            if _SHARD_WRAP_RE.search(call_name(node)):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name) and arg.id in defs:
+                        mark(arg.id)
+
+    # closure over the module-local call graph
+    changed = True
+    while changed:
+        changed = False
+        for name in list(traced):
+            fdef = defs.get(name)
+            if fdef is None or isinstance(fdef, ast.Pass):
+                continue
+            for node in ast.walk(fdef):
+                if isinstance(node, ast.Call):
+                    callee = call_name(node)
+                    if callee in defs and callee not in traced:
+                        traced[callee] = defs[callee]
+                        changed = True
+                # nested defs inside a traced body are traced
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node.name not in traced:
+                    traced[node.name] = node
+                    changed = True
+    return traced

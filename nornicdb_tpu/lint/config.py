@@ -1,0 +1,92 @@
+"""Declarative registries for the lint passes (the
+``IMPORT_TIME_MODULES`` precedent: facts about the codebase the AST
+cannot cheaply infer live here, reviewed like code).
+
+Keep these lists in sync when adding serving paths — a module that
+installs version-keyed device snapshots belongs in
+``SNAPSHOT_MODULES``; a function that runs once per query (not once
+per batch) belongs in ``HOT_PATHS``.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# degrade-contract: modules that install version-keyed device snapshots.
+# Each must contain at least one function that calls a jit-traced
+# program AND re-checks a version/mutation/generation counter after the
+# dispatch (the PR 2/4/6/8/9 freshness discipline: a write landing
+# mid-dispatch throws the device answer away, never serves it).
+# ---------------------------------------------------------------------------
+SNAPSHOT_MODULES = {
+    "nornicdb_tpu.search.cagra": (
+        "CagraIndex._resolve",       # live-stale filter vs built_mutations
+        "CagraIndex._delta_block",   # changelog marker vs mutations
+    ),
+    "nornicdb_tpu.search.device_bm25": (
+        "DeviceBM25.delta_block",
+        "DeviceBM25.refresh_alive",
+    ),
+    "nornicdb_tpu.search.device_quant": (
+        "QuantizedBrutePlane.search_batch",  # built_compactions re-check
+    ),
+    "nornicdb_tpu.search.hybrid_fused": (
+        "FusedHybrid._walk_context",  # live brute mutations after delta
+        "FusedHybrid._graph_rows",
+    ),
+    "nornicdb_tpu.query.device_graph": (
+        "DeviceGraphPlane._chain_batch",  # catalog.version post-dispatch
+        "DeviceGraphPlane.traverse_rank",
+    ),
+}
+
+# tokens that count as a freshness counter in a post-dispatch re-check
+VERSION_TOKENS = ("version", "mutation", "generation", "build_seq",
+                  "built_mutations", "compaction", "gen")
+
+# ---------------------------------------------------------------------------
+# env-knob-catalog: per-REQUEST functions (run once per query/message,
+# not once per coalesced batch or per process). An os.environ read here
+# costs ~1 us — 2-8% of the 50 us host chain path (PR 9's measurement).
+# Batch-leader and init/build functions deliberately stay off this
+# list: their env reads amortize over the whole batch / process.
+# Entries are (module-relative path, dotted qualname prefix).
+# ---------------------------------------------------------------------------
+HOT_PATHS = (
+    # vector/hybrid serving front door — once per query
+    ("nornicdb_tpu/search/service.py", "SearchService.search"),
+    # per-rider coalescer paths (leader-side _run/_run_batch reads
+    # amortize over the whole batch; these run per query)
+    ("nornicdb_tpu/search/microbatch.py", "MicroBatcher.search"),
+    ("nornicdb_tpu/search/microbatch.py", "BatchCoalescer.submit"),
+    # per-query device-plane gates (the 50 us host chain path)
+    ("nornicdb_tpu/query/device_graph.py",
+     "DeviceGraphPlane.maybe_device"),
+    ("nornicdb_tpu/query/device_graph.py",
+     "DeviceGraphPlane.chain_topk"),
+    # single-query search fronts
+    ("nornicdb_tpu/search/vector_index.py", "BruteForceIndex.search"),
+    ("nornicdb_tpu/search/cagra.py", "CagraIndex.search"),
+    # wire-plane per-rider path (ring post/claim runs per request)
+    ("nornicdb_tpu/search/broker.py", "BrokerClient.vec_search"),
+    ("nornicdb_tpu/search/broker.py", "BrokerClient.call"),
+    # fleet read routing — once per read
+    ("nornicdb_tpu/api/fleet_router.py", "FleetRouter.pick_read"),
+    ("nornicdb_tpu/api/fleet_router.py", "RoutedSearch.search"),
+)
+
+# ---------------------------------------------------------------------------
+# jit-hygiene: dispatch-recording calls whose bucket argument must come
+# from the pow2 helpers (the compile-universe key; a raw batch size
+# here means a recompile per distinct shape).
+# ---------------------------------------------------------------------------
+DISPATCH_RECORDERS = ("record_dispatch",)
+POW2_HELPERS = ("pow2_bucket",)
+
+# ---------------------------------------------------------------------------
+# escape-hatch tokens per pass (document new ones in
+# docs/static_analysis.md)
+# ---------------------------------------------------------------------------
+HATCH_LOCK = "unguarded-ok"
+HATCH_JIT = "jit-ok"
+HATCH_ENV = "env-ok"
+HATCH_DEGRADE = "degrade-ok"

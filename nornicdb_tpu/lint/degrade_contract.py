@@ -1,0 +1,341 @@
+"""Degrade contract: reason vocabulary + post-dispatch version
+re-checks.
+
+Rules
+-----
+``unknown-degrade-reason``
+    A ``record_degrade(...)`` whose reason literal is not in
+    ``obs.audit``'s vocabulary (``REASONS`` or a ``_LEGACY_REASONS``
+    alias). The vocabulary is parsed from ``obs/audit.py``'s AST — the
+    lint never imports the serving stack. Reasons that flow through a
+    local wrapper (``_ledger(from_tier, reason)``) are resolved one
+    call level up: the wrapper's call sites are checked at the
+    corresponding argument position.
+``dynamic-degrade-reason``
+    A reason argument the lint cannot resolve to a literal (computed
+    strings, attribute loads). Baseline or rewrite — every reason the
+    ledger emits must be auditable against the documented vocabulary.
+``missing-version-recheck``
+    A module registered in ``config.SNAPSHOT_MODULES`` (it installs
+    version-keyed device snapshots) has no function that compares a
+    version/mutation/generation counter *after* calling a jit-traced
+    program. That re-check is the freshness contract every device
+    serving path carries: a write landing mid-dispatch must throw the
+    device answer away.
+
+Escape hatch: ``# lint: degrade-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nornicdb_tpu.lint import Finding
+from nornicdb_tpu.lint import config as cfg
+from nornicdb_tpu.lint.astutil import (
+    ModuleInfo,
+    PackageTree,
+    call_name,
+    enclosing_function,
+    qualname,
+    short_src,
+    suppressed,
+)
+
+PASS = "degrade-contract"
+
+_AUDIT_REL = "nornicdb_tpu/obs/audit.py"
+# record_degrade(surface, from_tier, to_tier, reason, ...)
+_REASON_POS = 3
+
+
+def vocabulary(tree: PackageTree) -> Set[str]:
+    """REASONS tuple values + legacy alias keys, parsed statically
+    from obs/audit.py."""
+    mod = tree.modules.get(_AUDIT_REL)
+    vocab: Set[str] = set()
+    if mod is None:
+        return vocab
+    for node in mod.tree.body:
+        tgt_names = []
+        if isinstance(node, ast.Assign):
+            tgt_names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            tgt_names = [node.target.id]
+            value = node.value
+        else:
+            continue
+        if "REASONS" in tgt_names and isinstance(
+                value, (ast.Tuple, ast.List)):
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str):
+                    vocab.add(elt.value)
+        if "_LEGACY_REASONS" in tgt_names and isinstance(
+                value, ast.Dict):
+            for key in value.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                        key.value, str):
+                    vocab.add(key.value)
+    return vocab
+
+
+def _reason_arg(call: ast.Call, pos: int) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "reason":
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _param_index(fdef, name: str) -> Optional[int]:
+    params = [a.arg for a in fdef.args.args]
+    if params and params[0] == "self":
+        params = params[1:]
+    return params.index(name) if name in params else None
+
+
+def _literal_values(expr: ast.AST) -> Optional[List[str]]:
+    """All string literals an expression can evaluate to, or None if
+    any branch is non-literal. Handles the conditional-reason idiom:
+    ``r = "replica_lag" if cond else "replica_drain"``."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, ast.IfExp):
+        a = _literal_values(expr.body)
+        b = _literal_values(expr.orelse)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def _resolve_local_literals(
+    fdef: ast.AST, name: str,
+) -> Optional[List[str]]:
+    """Literal values a local name is assigned within ``fdef`` — None
+    when any assignment is unresolvable (or there are none)."""
+    vals: List[str] = []
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            v = _literal_values(node.value)
+            if v is None:
+                return None
+            vals.extend(v)
+    return vals or None
+
+
+def _check_reason(
+    mod: ModuleInfo,
+    call: ast.Call,
+    arg: Optional[ast.AST],
+    vocab: Set[str],
+    findings: List[Finding],
+    wrappers: Dict[Tuple[str, str], int],
+) -> None:
+    """Validate one resolved reason argument; register wrapper params
+    for one level of call-site propagation."""
+    fdef = enclosing_function(call)
+    ctx = qualname(fdef) if fdef is not None else ""
+    if arg is None:
+        return
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        # hatch honored on the literal's line OR the call line — a
+        # multi-line call puts the directive where it fits
+        if arg.value not in vocab \
+                and not suppressed(mod, arg.lineno, cfg.HATCH_DEGRADE) \
+                and not suppressed(mod, call.lineno,
+                                   cfg.HATCH_DEGRADE):
+            findings.append(Finding(
+                pass_name=PASS, rule="unknown-degrade-reason",
+                path=mod.rel, line=arg.lineno, context=ctx,
+                detail=arg.value,
+                message=(f"degrade reason {arg.value!r} is not in "
+                         f"audit.normalize_reason's vocabulary")))
+        return
+    if isinstance(arg, ast.Name) and fdef is not None:
+        idx = _param_index(fdef, arg.id)
+        if idx is not None:
+            # wrapper: validate literals at this function's call sites
+            wrappers[(mod.rel, fdef.name)] = idx
+            return
+        vals = _resolve_local_literals(fdef, arg.id)
+        if vals is not None:
+            for v in vals:
+                if v not in vocab \
+                        and not suppressed(mod, arg.lineno,
+                                           cfg.HATCH_DEGRADE) \
+                        and not suppressed(mod, call.lineno,
+                                           cfg.HATCH_DEGRADE):
+                    findings.append(Finding(
+                        pass_name=PASS,
+                        rule="unknown-degrade-reason",
+                        path=mod.rel, line=arg.lineno, context=ctx,
+                        detail=v,
+                        message=(f"degrade reason {v!r} (via local "
+                                 f"{arg.id}) is not in the "
+                                 f"vocabulary")))
+            return
+    if not suppressed(mod, call.lineno, cfg.HATCH_DEGRADE):
+        findings.append(Finding(
+            pass_name=PASS, rule="dynamic-degrade-reason",
+            path=mod.rel, line=call.lineno, context=ctx,
+            detail=short_src(mod, arg),
+            message=(f"degrade reason {short_src(mod, arg)!r} cannot "
+                     f"be resolved to a vocabulary literal")))
+
+
+def _check_wrapper_sites(
+    tree: PackageTree,
+    wrappers: Dict[Tuple[str, str], int],
+    vocab: Set[str],
+    findings: List[Finding],
+) -> None:
+    # wrappers resolve module-locally: two modules may each define a
+    # ``_ledger`` with different signatures (hybrid_fused's method vs
+    # device_graph's module function) — cross-module matching by bare
+    # name would check the wrong argument position
+    for mod in tree.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            simple = call_name(node).split(".")[-1]
+            idx = wrappers.get((mod.rel, simple))
+            if idx is None:
+                continue
+            arg: Optional[ast.AST] = None
+            for kw in node.keywords:
+                if kw.arg == "reason":
+                    arg = kw.value
+            if arg is None and len(node.args) > idx:
+                arg = node.args[idx]
+            if arg is None:
+                continue
+            fdef = enclosing_function(node)
+            ctx = qualname(fdef) if fdef is not None else ""
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                if arg.value not in vocab \
+                        and not suppressed(mod, arg.lineno,
+                                           cfg.HATCH_DEGRADE) \
+                        and not suppressed(mod, node.lineno,
+                                           cfg.HATCH_DEGRADE):
+                    findings.append(Finding(
+                        pass_name=PASS,
+                        rule="unknown-degrade-reason",
+                        path=mod.rel, line=arg.lineno, context=ctx,
+                        detail=arg.value,
+                        message=(f"degrade reason {arg.value!r} "
+                                 f"(via {simple}) is not in the "
+                                 f"vocabulary")))
+            elif isinstance(arg, ast.Name) and fdef is not None \
+                    and (_param_index(fdef, arg.id) is not None
+                         or _resolve_local_literals(fdef, arg.id)
+                         is not None):
+                # param: two levels of indirection — give up quietly;
+                # local literals: check each against the vocabulary
+                for v in (_resolve_local_literals(fdef, arg.id)
+                          or []):
+                    if v not in vocab and not suppressed(
+                            mod, node.lineno, cfg.HATCH_DEGRADE):
+                        findings.append(Finding(
+                            pass_name=PASS,
+                            rule="unknown-degrade-reason",
+                            path=mod.rel, line=node.lineno,
+                            context=ctx, detail=v,
+                            message=(f"degrade reason {v!r} (via "
+                                     f"{simple}) is not in the "
+                                     f"vocabulary")))
+            elif not suppressed(mod, node.lineno, cfg.HATCH_DEGRADE):
+                findings.append(Finding(
+                    pass_name=PASS, rule="dynamic-degrade-reason",
+                    path=mod.rel, line=node.lineno, context=ctx,
+                    detail=short_src(mod, arg),
+                    message=(f"degrade reason {short_src(mod, arg)!r} "
+                             f"(via {simple}) cannot be resolved to "
+                             f"a vocabulary literal")))
+
+
+# ---------------------------------------------------------------------------
+# missing-version-recheck
+# ---------------------------------------------------------------------------
+
+def _mentions_version_token(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Constant) and isinstance(
+                sub.value, str):
+            name = sub.value
+        if name and any(tok in name.lower()
+                        for tok in cfg.VERSION_TOKENS):
+            return True
+    return False
+
+
+def _recheck_carriers(mod: ModuleInfo) -> Dict[str, bool]:
+    """qualname -> "contains a version-token Compare" for every
+    function in the module."""
+    out: Dict[str, bool] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        has = any(
+            isinstance(sub, ast.Compare)
+            and _mentions_version_token(sub)
+            for sub in ast.walk(node))
+        q = qualname(node)
+        out[q] = out.get(q, False) or has
+    return out
+
+
+def run(tree: PackageTree) -> List[Finding]:
+    findings: List[Finding] = []
+    vocab = vocabulary(tree)
+    wrappers: Dict[Tuple[str, str], int] = {}
+    if vocab:
+        for mod in tree.modules.values():
+            if mod.rel == _AUDIT_REL:
+                continue  # the vocabulary's own module defines it
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and call_name(
+                        node).split(".")[-1] == "record_degrade":
+                    _check_reason(
+                        mod, node, _reason_arg(node, _REASON_POS),
+                        vocab, findings, wrappers)
+        _check_wrapper_sites(tree, wrappers, vocab, findings)
+    for modname, carriers in cfg.SNAPSHOT_MODULES.items():
+        mod = tree.by_modname(modname)
+        if mod is None:
+            findings.append(Finding(
+                pass_name=PASS, rule="missing-version-recheck",
+                path=modname.replace(".", "/") + ".py", line=1,
+                detail=modname,
+                message=(f"{modname} is registered in SNAPSHOT_MODULES"
+                         f" but does not exist — update the registry "
+                         f"in nornicdb_tpu/lint/config.py")))
+            continue
+        present = _recheck_carriers(mod)
+        for carrier in carriers:
+            if present.get(carrier, False):
+                continue
+            findings.append(Finding(
+                pass_name=PASS, rule="missing-version-recheck",
+                path=mod.rel, line=1, context=carrier,
+                detail=f"{modname}:{carrier}",
+                message=(f"{carrier} is the registered post-dispatch "
+                         f"freshness re-check for {modname} but "
+                         f"{'has lost its version-counter compare' if carrier in present else 'does not exist'}"
+                         f" — restore the re-check or update "
+                         f"SNAPSHOT_MODULES in lint/config.py")))
+    return findings

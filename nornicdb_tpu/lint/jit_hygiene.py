@@ -1,0 +1,251 @@
+"""JIT hygiene: host syncs, env reads and unbucketed dispatch shapes in
+jax-traced code.
+
+Rules
+-----
+``host-sync-item``
+    ``x.item()`` inside a traced body — a device->host transfer (and a
+    trace error on an actual tracer). The repo's contract is that
+    results cross the boundary once, in the dispatcher, never inside
+    the compiled program.
+``host-sync-coercion``
+    ``float(x)`` / ``int(x)`` / ``bool(x)`` on a non-static expression
+    inside a traced body. Static-looking args (shape/ndim/dtype/len
+    arithmetic, literals) are exempt — those fold at trace time.
+``host-sync-numpy``
+    ``np.asarray(...)`` / ``np.array(...)`` inside a traced body: a
+    silent device sync when handed a tracer. Static shape math through
+    numpy is fine and recognized via the same exemption.
+``env-read-in-jit``
+    ``os.environ`` / ``os.getenv`` (or an ``_env_*`` helper) inside a
+    traced body — a host call baked into trace, re-read never.
+``unbucketed-dispatch``
+    A ``record_dispatch(kind, bucket, ...)`` whose bucket argument
+    provably bypasses ``pow2_bucket`` (a raw ``len()``/``.shape``
+    expression or a local assigned from one). The (kind, bucket) pair
+    keys the compile-universe accounting; raw sizes there mean a
+    recompile per distinct shape. Snapshot/attribute lookups are
+    trusted — capacities are bucketed at build.
+
+Escape hatch: ``# lint: jit-ok`` on (or one line above) the flagged
+line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from nornicdb_tpu.lint import Finding
+from nornicdb_tpu.lint import config as cfg
+from nornicdb_tpu.lint.astutil import (
+    ModuleInfo,
+    PackageTree,
+    call_name,
+    dotted,
+    enclosing_function,
+    is_env_read_node,
+    qualname,
+    short_src,
+    suppressed,
+    traced_function_names,
+)
+
+PASS = "jit-hygiene"
+
+_NUMPY_ROOTS = ("np", "numpy", "onp")
+_NUMPY_SYNC_ATTRS = ("asarray", "array")
+_COERCIONS = ("float", "int", "bool")
+
+
+def _static_names(fdef: ast.AST) -> Set[str]:
+    """Local names provably bound to trace-static values: assigned
+    (only) from shape/len/literal expressions, including tuple
+    unpacking from ``.shape`` (``b, d = x.shape``)."""
+    static: Set[str] = set()
+    tainted: Set[str] = set()
+    for _ in range(2):  # two passes: let b = a + 1 see a's verdict
+        for node in ast.walk(fdef):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Tuple, ast.List)):
+                    names = [e.id for e in tgt.elts
+                             if isinstance(e, ast.Name)]
+                    if _expr_static(node.value, static) \
+                            and len(names) == len(tgt.elts):
+                        static.update(n for n in names
+                                      if n not in tainted)
+                    else:
+                        tainted.update(names)
+                        static.difference_update(names)
+                elif isinstance(tgt, ast.Name):
+                    if _expr_static(node.value, static):
+                        if tgt.id not in tainted:
+                            static.add(tgt.id)
+                    else:
+                        tainted.add(tgt.id)
+                        static.discard(tgt.id)
+    return static
+
+
+def _expr_static(node: ast.AST, static_names: Set[str]) -> bool:
+    """Expression that folds at trace time: literals, shape/ndim/
+    dtype/len arithmetic, and names already proven static."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in static_names
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "dtype", "itemsize"):
+            return True
+        if isinstance(sub, ast.Call):
+            fname = call_name(sub)
+            if fname == "len" or fname.endswith(".bit_length"):
+                return True
+        if isinstance(sub, ast.Name) and sub.id in static_names:
+            return True
+    return False
+
+
+def _numpy_sync_call(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute) \
+            and func.attr in _NUMPY_SYNC_ATTRS:
+        root = dotted(func.value)
+        return root in _NUMPY_ROOTS
+    return False
+
+
+def _check_traced_body(
+    mod: ModuleInfo, fdef: ast.AST, findings: List[Finding],
+    seen: Set[int],
+) -> None:
+    ctx = qualname(fdef)
+    static = _static_names(fdef)
+    for node in ast.walk(fdef):
+        if id(node) in seen:
+            continue
+        rule = None
+        detail = ""
+        if isinstance(node, ast.Call):
+            fname = call_name(node)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                rule, detail = "host-sync-item", short_src(mod, node)
+            elif fname in _COERCIONS and len(node.args) == 1 \
+                    and not _expr_static(node.args[0], static):
+                rule = "host-sync-coercion"
+                detail = short_src(mod, node)
+            elif _numpy_sync_call(node) and node.args \
+                    and not _expr_static(node.args[0], static):
+                rule = "host-sync-numpy"
+                detail = short_src(mod, node)
+        if rule is None and is_env_read_node(node):
+            rule, detail = "env-read-in-jit", short_src(mod, node)
+        if rule is not None:
+            seen.add(id(node))
+            if suppressed(mod, node.lineno, cfg.HATCH_JIT):
+                continue
+            findings.append(Finding(
+                pass_name=PASS, rule=rule, path=mod.rel,
+                line=node.lineno, context=ctx, detail=detail,
+                message=f"{detail} in jit-traced code"))
+
+
+# ---------------------------------------------------------------------------
+# unbucketed-dispatch
+# ---------------------------------------------------------------------------
+
+def _expr_mentions(node: ast.AST, names) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and call_name(sub) in names:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+    return False
+
+
+def _raw_size_expr(node: ast.AST) -> bool:
+    """Provably a raw (unbucketed) size: built from len()/.shape
+    without a pow2 helper anywhere in the expression."""
+    if _expr_mentions(node, cfg.POW2_HELPERS):
+        return False
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        # a pow2 literal IS a bucket (the b=1 poison-isolation
+        # replays); any other literal is exactly the hazard
+        v = node.value
+        return not (v > 0 and (v & (v - 1)) == 0)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and call_name(sub) == "len":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return True
+    return False
+
+
+def _local_assignments(
+    fdef: ast.AST,
+) -> Dict[str, List[ast.AST]]:
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.setdefault(tgt.id, []).append(node.value)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name):
+            out.setdefault(node.target.id, []).append(node.value)
+    return out
+
+
+def _check_dispatch_buckets(
+    mod: ModuleInfo, findings: List[Finding],
+) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = call_name(node)
+        if fname.split(".")[-1] not in cfg.DISPATCH_RECORDERS:
+            continue
+        if len(node.args) < 2:
+            continue
+        bucket = node.args[1]
+        bad = False
+        if _raw_size_expr(bucket):
+            bad = True
+        elif isinstance(bucket, ast.Name):
+            fdef = enclosing_function(node)
+            if fdef is not None:
+                assigns = _local_assignments(fdef).get(bucket.id, [])
+                if assigns and all(_raw_size_expr(a)
+                                   for a in assigns):
+                    bad = True
+        if bad and not suppressed(mod, node.lineno, cfg.HATCH_JIT):
+            fdef = enclosing_function(node)
+            findings.append(Finding(
+                pass_name=PASS, rule="unbucketed-dispatch",
+                path=mod.rel, line=node.lineno,
+                context=qualname(fdef) if fdef is not None else "",
+                detail=short_src(mod, bucket),
+                message=(f"dispatch bucket {short_src(mod, bucket)!r} "
+                         f"bypasses pow2_bucket — every distinct "
+                         f"shape is its own XLA compile")))
+
+
+def run(tree: PackageTree) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in tree.modules.values():
+        traced = traced_function_names(mod)
+        seen: Set[int] = set()
+        # dedupe: a def reachable under several traced names is
+        # checked once (seen carries node ids across bodies)
+        checked: Set[int] = set()
+        for fdef in traced.values():
+            if isinstance(fdef, ast.Pass) or id(fdef) in checked:
+                continue
+            checked.add(id(fdef))
+            _check_traced_body(mod, fdef, findings, seen)
+        _check_dispatch_buckets(mod, findings)
+    return findings

@@ -1,0 +1,169 @@
+"""Lock discipline: the single-writer heuristic over ``with self._lock``
+sites.
+
+Rule ``unguarded-write``: within one class, an instance attribute that
+is ever written under a ``with self.<lock>:`` block must never be
+written outside one. This is exactly the invariant the ~526 existing
+lock sites enforce by convention (freshness counters, snapshot maps,
+changelogs): one writer discipline, guarded reads optional.
+
+What counts as holding the lock:
+
+- lexically inside a ``with`` whose context expression is a ``self``
+  attribute (or local name) containing "lock", "cv", "cond" or
+  "mutex" — ``with self._lock:``, ``with self._inflight_lock:``,
+  multi-item withs included;
+- the enclosing method's name ends in ``_locked`` (the repo convention
+  for "caller holds the lock");
+- the write is in ``__init__`` / ``__new__`` / ``__del__`` /
+  ``close``-like teardown (object not yet / no longer shared).
+
+Escape hatch: ``# lint: unguarded-ok`` on (or one line above) the
+write — for deliberate racy-but-benign writes (monotonic hint flags,
+cached gate bits). Say why in the surrounding comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from nornicdb_tpu.lint import Finding
+from nornicdb_tpu.lint import config as cfg
+from nornicdb_tpu.lint.astutil import (
+    ModuleInfo,
+    PackageTree,
+    ancestors,
+    dotted,
+    qualname,
+    suppressed,
+)
+
+PASS = "lock-discipline"
+
+_LOCK_NAME_RE = re.compile(r"lock|cv\b|cond|mutex", re.IGNORECASE)
+# methods where unguarded writes are constructor/teardown-safe
+_EXEMPT_METHODS = ("__init__", "__new__", "__del__", "__exit__",
+                   "close", "shutdown", "stop")
+_LOCKED_SUFFIX = "_locked"
+
+
+def _is_lock_ctx(expr: ast.AST) -> bool:
+    """True for ``self._lock`` / bare ``lock``-ish names, including
+    ``self._lock.acquire_timeout()``-style wrapped managers."""
+    name = dotted(expr)
+    if not name:
+        return False
+    last = name.split(".")[-1]
+    if last in ("acquire", "read_lock", "write_lock"):
+        segs = name.split(".")
+        last = segs[-2] if len(segs) > 1 else last
+    return bool(_LOCK_NAME_RE.search(last))
+
+
+def _under_lock(node: ast.AST) -> bool:
+    """Lexically inside a ``with <lock>:`` block. Method-name
+    conventions (``*_locked``, ``__init__``) are handled separately as
+    *exempt* — they neither establish an attribute as lock-guarded nor
+    get flagged."""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if _is_lock_ctx(item.context_expr):
+                    return True
+    return False
+
+
+def _method_of(node: ast.AST) -> Optional[str]:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc.name
+    return None
+
+
+@dataclass
+class _Write:
+    attr: str
+    line: int
+    guarded: bool
+    exempt: bool   # __init__-class method
+    node: ast.AST
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _class_writes(cls: ast.ClassDef) -> List[_Write]:
+    writes: List[_Write] = []
+    for node in ast.walk(cls):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+            # tuple unpacking: a, self.x = ...
+            flat: List[ast.AST] = []
+            for t in targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    flat.extend(t.elts)
+                else:
+                    flat.append(t)
+            targets = flat
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for tgt in targets:
+            attr = _self_attr_target(tgt)
+            if attr is None:
+                continue
+            # writes inside a NESTED class belong to that class
+            owner = None
+            for anc in ancestors(node):
+                if isinstance(anc, ast.ClassDef):
+                    owner = anc
+                    break
+            if owner is not cls:
+                continue
+            method = _method_of(node) or ""
+            writes.append(_Write(
+                attr=attr, line=node.lineno,
+                guarded=_under_lock(node),
+                exempt=method in _EXEMPT_METHODS
+                or method.endswith(_LOCKED_SUFFIX),
+                node=node))
+    return writes
+
+
+def run(tree: PackageTree) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in tree.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            writes = _class_writes(node)
+            guarded_attrs = {w.attr for w in writes if w.guarded
+                             and not w.exempt}
+            if not guarded_attrs:
+                continue
+            for w in writes:
+                if w.attr not in guarded_attrs or w.guarded \
+                        or w.exempt:
+                    continue
+                if suppressed(mod, w.line, cfg.HATCH_LOCK):
+                    continue
+                findings.append(Finding(
+                    pass_name=PASS, rule="unguarded-write",
+                    path=mod.rel, line=w.line,
+                    context=f"{node.name}."
+                            f"{_method_of(w.node) or '<class>'}",
+                    detail=w.attr,
+                    message=(f"self.{w.attr} is written under "
+                             f"{node.name}'s lock elsewhere but "
+                             f"unguarded here (single-writer "
+                             f"discipline)")))
+    return findings

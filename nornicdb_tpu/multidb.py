@@ -96,7 +96,7 @@ class LimitedEngine(NamespacedEngine):
         self._bytes: Optional[int] = None  # lazy initial scan
         self._bytes_lock = threading.Lock()
 
-    def _current_bytes(self) -> int:
+    def _current_bytes_locked(self) -> int:
         if self._bytes is None:
             total = 0
             for n in self.all_nodes():
@@ -109,7 +109,7 @@ class LimitedEngine(NamespacedEngine):
     def _check_bytes(self, obj) -> int:
         size = entity_size(obj)
         with self._bytes_lock:
-            current = self._current_bytes()
+            current = self._current_bytes_locked()
             if current + size > self._limits.max_bytes:
                 raise DatabaseLimitExceeded(
                     f"would exceed max_bytes limit (current: {current} "
@@ -172,7 +172,7 @@ class LimitedEngine(NamespacedEngine):
     def current_bytes(self) -> int:
         """Exact tracked storage size (enforcement.go:244)."""
         with self._bytes_lock:
-            return self._current_bytes()
+            return self._current_bytes_locked()
 
 
 class ConnectionTracker:

@@ -459,7 +459,10 @@ class DeviceGraphPlane:
             return True  # demand exists; the batcher decides the rest
         tick = self._gate_tick = (self._gate_tick + 1) & 0xFF
         if tick == 0 or self._forced is None:
-            self._forced = os.environ.get(
+            # THE amortized read the env-knob lint's hot-path rule
+            # points at: refreshed every 256 calls, staleness is a
+            # routing hint only (see _forced above)
+            self._forced = os.environ.get(  # lint: env-ok
                 "NORNICDB_GRAPH_DEVICE", "auto") == "on"
         return self._forced
 

@@ -159,7 +159,7 @@ class RaftNode(Replicator):
                 continue
             if r.get("term", 0) > term:
                 with self._lock:
-                    self._step_down(r["term"])
+                    self._step_down_locked(r["term"])
                 return
             if r.get("vote_granted"):
                 votes += 1
@@ -186,7 +186,7 @@ class RaftNode(Replicator):
         if self.role is Role.PRIMARY:
             self._heartbeat()
 
-    def _step_down(self, term: int) -> None:
+    def _step_down_locked(self, term: int) -> None:
         """Caller holds the lock. ``voted_for`` is cleared ONLY when the
         term actually increases: a candidate demoted at an equal term must
         keep its vote record or it could grant a second vote in the same
@@ -234,7 +234,7 @@ class RaftNode(Replicator):
                 continue
             if r.get("term", 0) > term:
                 with self._lock:
-                    self._step_down(r["term"])
+                    self._step_down_locked(r["term"])
                 return
             with self._lock:
                 if r.get("ok"):
@@ -290,7 +290,7 @@ class RaftNode(Replicator):
             if term < self.term:
                 return {"term": self.term, "vote_granted": False}
             if term > self.term:
-                self._step_down(term)
+                self._step_down_locked(term)
             up_to_date = (
                 msg.get("last_log_term", 0),
                 msg.get("last_log_index", 0),
@@ -313,7 +313,7 @@ class RaftNode(Replicator):
             if term < self.term:
                 return {"term": self.term, "ok": False}
             if term > self.term or self._state is not Role.STANDBY:
-                self._step_down(term)
+                self._step_down_locked(term)
             self.term = term
             self.leader_id = msg.get("leader")
             self._last_leader_contact = time.monotonic()

@@ -654,8 +654,12 @@ class CagraIndex:
             try:
                 self.build()  # _build_locked no-ops if already fresh
             finally:
-                self._rebuilding = False
-                self._rebuild_started = 0.0
+                # same lock as the set in _kick_background_rebuild: an
+                # unguarded clear can interleave with a concurrent
+                # kick's read-then-set and double-start a rebuild
+                with self._rebuild_flag_lock:
+                    self._rebuilding = False
+                    self._rebuild_started = 0.0
 
         t = threading.Thread(target=run, name="cagra-rebuild", daemon=True)
         t.start()

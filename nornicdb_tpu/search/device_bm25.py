@@ -341,8 +341,11 @@ class DeviceBM25:
             try:
                 self.build()
             finally:
-                self._rebuilding = False
-                self._rebuild_started = 0.0
+                # same lock as the set above: an unguarded clear can
+                # interleave with a concurrent kick's read-then-set
+                with self._rebuild_flag_lock:
+                    self._rebuilding = False
+                    self._rebuild_started = 0.0
 
         t = threading.Thread(target=run, name="device-bm25-rebuild",
                              daemon=True)

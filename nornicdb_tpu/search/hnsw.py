@@ -384,9 +384,9 @@ class HNSWIndex:
                        if (n_seed == 0 or i < n_seed)
                        else bulk_ef)
                 i += len(batch)
-                self._build_wave(batch, efc=efc)
+                self._build_wave_locked(batch, efc=efc)
 
-    def _build_wave(self, batch: Sequence[Tuple[str, Sequence[float]]],
+    def _build_wave_locked(self, batch: Sequence[Tuple[str, Sequence[float]]],
                     efc: Optional[int] = None) -> None:
         # intra-wave duplicate ids: keep the last occurrence (add()'s
         # overwrite order); without this, two alive slots share one id

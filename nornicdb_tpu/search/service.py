@@ -254,6 +254,18 @@ class SearchService:
         wrapper: a graph swap does NOT rebuild the wrapper."""
         from nornicdb_tpu.config import env_bool, env_int
 
+        # the whole resolve runs under the service RLock: the eviction
+        # checks and the re-wrap race load_indexes (which swaps the
+        # index objects and clears _fused under the same lock) — an
+        # unguarded re-wrap here could briefly resurrect a wrapper over
+        # a discarded corpus and double-build under concurrent searches
+        with self._lock:
+            f = self._ensure_fused_locked(env_bool, env_int)
+        if f is None or not f.ensure():
+            return None  # first build runs in background; host serves
+        return f
+
+    def _ensure_fused_locked(self, env_bool, env_int):
         if not env_bool("HYBRID_FUSED", True):
             self._fused = None
             return None
@@ -313,8 +325,6 @@ class SearchService:
                 register_resource(
                     "cagra", f"{self.resource_name}:hybrid_walk",
                     f.cagra)
-        if not f.ensure():
-            return None  # first build runs in background; host serves
         return f
 
     def _fused_hybrid_trio(self, query, qv, overfetch, weights):
@@ -469,7 +479,7 @@ class SearchService:
             if self.hnsw is not None:
                 self.hnsw.remove(node_id)
                 if self.hnsw.should_rebuild():
-                    self._rebuild_hnsw()
+                    self._rebuild_hnsw_locked()
             self.stats.indexed_docs = len(self.bm25)
             self.stats.indexed_vectors = len(self.vectors)
         self._clear_result_cache()
@@ -687,12 +697,12 @@ class SearchService:
             # device-graph tier: the CagraIndex manages its own rebuild
             # cadence after the first build (mutation-churn threshold)
             if self.cagra is None:
-                self._rebuild_cagra()
+                self._rebuild_cagra_locked()
             return
         if self.hnsw is None:
-            self._rebuild_hnsw()
+            self._rebuild_hnsw_locked()
 
-    def _rebuild_cagra(self) -> None:
+    def _rebuild_cagra_locked(self) -> None:
         """Build the device-resident graph over the live brute index.
         Config-gated (NORNICDB_VECTOR_ANN_QUALITY=cagra); the service
         threshold is the build gate, so min_n only keeps the index
@@ -738,7 +748,7 @@ class SearchService:
         self.stats.cagra_builds += 1
         self.stats.strategy = "cagra"
 
-    def _rebuild_hnsw(self) -> None:
+    def _rebuild_hnsw_locked(self) -> None:
         """(Re)build HNSW from the brute index, BM25 seeds first."""
         items = []
         matrix, valid, ext_ids = self.vectors.snapshot()
@@ -907,7 +917,11 @@ class SearchService:
         # hit can't serve timings from a prior diag run forever.
         from nornicdb_tpu.config import env_bool
 
-        diag = env_bool("TPU_SEARCH_DIAG")
+        # deliberate per-query env read: the toggle must take effect on
+        # the NEXT search (pinned by test_aux_cmds diag tests), and the
+        # ~1 us read is noise against the ms-scale hybrid search it
+        # gates — unlike the 50 us chain path the hot-path rule guards
+        diag = env_bool("TPU_SEARCH_DIAG")  # lint: env-ok
         if not diag and self.stats.last_timings:
             self.stats.last_timings = {}  # never serve stale timings
         # explicit query embeddings are unhashable request-local state;

@@ -112,7 +112,7 @@ class BruteForceIndex:
         n = np.linalg.norm(v)
         return v / n if n > 1e-12 else v
 
-    def _ensure_capacity(self, needed: int, dims: int) -> None:
+    def _ensure_capacity_locked(self, needed: int, dims: int) -> None:
         if self.dims is None:
             self.dims = dims
         if dims != self.dims:
@@ -143,7 +143,7 @@ class BruteForceIndex:
                 self.mutations += 1
                 self._log_change_locked(ext_id)
                 return
-            self._ensure_capacity(self._count + (0 if self._free else 1), v.shape[0])
+            self._ensure_capacity_locked(self._count + (0 if self._free else 1), v.shape[0])
             if self._free:
                 slot = self._free.pop()
             else:
@@ -357,7 +357,7 @@ class BruteForceIndex:
 
     # -- search -----------------------------------------------------------
 
-    def _device_arrays(self):
+    def _device_arrays_locked(self):
         if self._dirty or self._dev_matrix is None:
             self._dev_matrix = jnp.asarray(self._matrix)
             self._dev_valid = jnp.asarray(self._valid)
@@ -403,7 +403,7 @@ class BruteForceIndex:
         with self._lock:
             if self._n_alive == 0 or self._matrix is None:
                 return None
-            m, valid = self._device_arrays()
+            m, valid = self._device_arrays_locked()
             cached = self._view_ids_cache
             if cached is None or cached[0] != self.mutations:
                 cached = (self.mutations, list(self._ext_ids))
@@ -542,7 +542,7 @@ class BruteForceIndex:
                 return self._search_host(
                     np.asarray(queries, np.float32), self._matrix,
                     self._valid, self._ext_ids, k_eff)
-            m, valid = self._device_arrays()
+            m, valid = self._device_arrays_locked()
             ext_ids = list(self._ext_ids)
         q = l2_normalize(jnp.asarray(queries, dtype=jnp.float32))
         if _use_pallas():
@@ -611,7 +611,7 @@ class BruteForceIndex:
         n = len(ids)
         if n == 0:
             return idx
-        idx._ensure_capacity(n, matrix.shape[1])
+        idx._ensure_capacity_locked(n, matrix.shape[1])
         idx._matrix[:n] = matrix
         idx._valid[:n] = True
         for i in range(n):

@@ -211,7 +211,7 @@ class WAL:
                    "ts": round(time.time(), 6)}
             payload = self._encode(rec)
             frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
-            self._ensure_segment(len(frame))
+            self._ensure_segment_locked(len(frame))
             self._fh.write(frame)
             self._fh_size += len(frame)
             if self.sync_every_write:
@@ -248,7 +248,7 @@ class WAL:
             if seq > self._seq:
                 self._seq = seq
 
-    def _ensure_segment(self, incoming: int) -> None:
+    def _ensure_segment_locked(self, incoming: int) -> None:
         if self._fh is not None and self._fh_size + incoming <= self.max_segment_bytes:
             return
         if self._fh is not None:

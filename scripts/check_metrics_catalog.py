@@ -1,17 +1,10 @@
 """Metric-catalog drift lint: every import-time metric family must be
 documented.
 
-``docs/observability.md`` is the operator-facing catalog of the
-``nornicdb_*`` metric families; nothing enforced it, so a new family
-could ship undocumented (two did, before this lint). This tool imports
-every module that registers metric families at import time, then fails
-when a family in the process registry has no mention in the catalog.
-
-Scope is deliberately import-time registration: lazily-created families
-(per-request server counters, WireCache instances) only exist under
-traffic, so the lint covers exactly the set a fresh process exposes at
-first scrape. Doc references may use brace shorthand —
-``wire_cache_{hits,misses}_total`` — which is expanded before matching.
+Since ISSUE 14 this is a thin shim over
+``nornicdb_tpu.lint.metrics_catalog`` — the same checks run as the
+``metrics-catalog`` pass of ``scripts/nornic_lint.py``. The CLI,
+entry-point names and verdict shape here are unchanged:
 
 Usage:
     python scripts/check_metrics_catalog.py          # exit 1 on drift
@@ -23,162 +16,27 @@ adding an undocumented metric family fails CI here first.
 
 from __future__ import annotations
 
-import argparse
-import importlib
-import json
 import os
-import re
 import sys
 
-# modules that register metric families at import time (module-level
-# REGISTRY.counter/histogram/gauge calls). Keep in sync by grepping:
-#   grep -rn "REGISTRY\.\(counter\|histogram\|gauge\)(" nornicdb_tpu
-IMPORT_TIME_MODULES = (
-    "nornicdb_tpu.obs",            # dispatch, stages, cost families
-    "nornicdb_tpu.obs.events",     # incident-timeline counter (ISSUE 13)
-    "nornicdb_tpu.obs.fleet",      # fleet-aggregator sources gauge
-    "nornicdb_tpu.search.microbatch",
-    "nornicdb_tpu.search.broker",  # wire-plane broker families (ISSUE 11)
-    "nornicdb_tpu.search.service",
-    "nornicdb_tpu.search.cagra",
-    "nornicdb_tpu.search.device_bm25",
-    "nornicdb_tpu.search.device_quant",
-    "nornicdb_tpu.search.hybrid_fused",
-    "nornicdb_tpu.query.device_graph",
-    "nornicdb_tpu.storage.wal",
-    "nornicdb_tpu.api.bolt",
-    "nornicdb_tpu.api.http_server",
-    "nornicdb_tpu.api.qdrant_official_grpc",
-    "nornicdb_tpu.api.fleet_router",       # read-fleet router (ISSUE 12)
-    "nornicdb_tpu.replication.read_fleet",  # replica lag/failover gauges
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from nornicdb_tpu.lint.metrics_catalog import (  # noqa: E402,F401
+    IMPORT_TIME_MODULES,
+    _PREFIX,
+    _documented,
+    _expand_braces,
+    build_verdict,
+    declared_dispatch_kinds,
+    event_kinds,
+    main,
+    missing_from_catalog,
+    missing_terms,
+    registered_families,
+    tier_vocabulary,
 )
-
-_PREFIX = "nornicdb_"
-
-
-def _expand_braces(text: str) -> str:
-    """Expand one level of ``name_{a,b,c}_suffix`` doc shorthand into
-    the literal metric names so the substring match sees them."""
-    pattern = re.compile(r"(\w*)\{([\w,]+)\}(\w*)")
-    out = [text]
-    for m in pattern.finditer(text):
-        head, alts, tail = m.group(1), m.group(2), m.group(3)
-        for alt in alts.split(","):
-            out.append(f"{head}{alt}{tail}")
-    return "\n".join(out)
-
-
-def registered_families():
-    from nornicdb_tpu.obs import REGISTRY
-
-    for mod in IMPORT_TIME_MODULES:
-        importlib.import_module(mod)
-    return sorted(f.name for f in REGISTRY.families())
-
-
-def _documented(expanded: str, name: str) -> bool:
-    # word-boundary match: a plain substring test would let e.g. a
-    # new nornicdb_stage_seconds family ride inside the documented
-    # nornicdb_request_stage_seconds — the exact drift class this
-    # lint exists to catch (underscores are word chars, so \b only
-    # matches at the full-name edges)
-    return re.search(rf"\b{re.escape(name)}\b", expanded) is not None
-
-
-def missing_from_catalog(doc_text: str, families) -> list:
-    expanded = _expand_braces(doc_text)
-    missing = []
-    for name in families:
-        short = name[len(_PREFIX):] if name.startswith(_PREFIX) else name
-        if not _documented(expanded, short) \
-                and not _documented(expanded, name):
-            missing.append(name)
-    return missing
-
-
-def declared_dispatch_kinds():
-    """Dispatch kinds announced via obs.declare_kind at import time —
-    the compile-cache vocabulary the docs must carry."""
-    from nornicdb_tpu.obs.dispatch import bucket_counts
-
-    return sorted(bucket_counts().keys())
-
-
-def tier_vocabulary():
-    """(canonical tier names, normalized degrade reasons) from the
-    serving-truth taxonomy (obs/audit.py)."""
-    from nornicdb_tpu.obs import audit
-
-    return sorted(audit.ALL_TIERS), sorted(audit.REASONS)
-
-
-def event_kinds():
-    """Incident-timeline event kinds (obs/events.py, ISSUE 13) — the
-    /admin/events vocabulary the catalog must carry."""
-    from nornicdb_tpu.obs import events
-
-    return sorted(events.KINDS)
-
-
-def missing_terms(doc_text: str, names) -> list:
-    """Vocabulary values (dispatch kinds, tier labels, degrade
-    reasons) with no word-boundary mention in the catalog."""
-    expanded = _expand_braces(doc_text)
-    return [n for n in names if not _documented(expanded, n)]
-
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--doc", default=None,
-                    help="catalog path (default: docs/observability.md "
-                         "next to this repo)")
-    ap.add_argument("--list", action="store_true",
-                    help="print the import-time families and exit")
-    args = ap.parse_args(argv)
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, repo)
-    families = registered_families()
-    if args.list:
-        print(json.dumps(families, indent=1))
-        return 0
-    doc_path = args.doc or os.path.join(repo, "docs", "observability.md")
-    with open(doc_path, encoding="utf-8") as f:
-        doc_text = f.read()
-    missing = missing_from_catalog(doc_text, families)
-    # ISSUE 10: the serving-truth vocabularies are part of the catalog
-    # contract too — every declared dispatch kind, canonical tier
-    # label and normalized degrade reason must be documented
-    kinds = declared_dispatch_kinds()
-    tiers, reasons = tier_vocabulary()
-    events = event_kinds()
-    missing_kinds = missing_terms(doc_text, kinds)
-    missing_tiers = missing_terms(doc_text, tiers)
-    missing_reasons = missing_terms(doc_text, reasons)
-    # ISSUE 13: the incident-timeline kinds are catalog contract too —
-    # an undocumented /admin/events kind fails the lint like an
-    # undocumented tier or reason
-    missing_events = missing_terms(doc_text, events)
-    drift = bool(missing or missing_kinds or missing_tiers
-                 or missing_reasons or missing_events)
-    verdict = {
-        "catalog_lint": True,
-        "doc": os.path.relpath(doc_path, repo),
-        "families": len(families),
-        "dispatch_kinds": len(kinds),
-        "tiers": len(tiers),
-        "reasons": len(reasons),
-        "event_kinds": len(events),
-        "missing": missing,
-        "missing_kinds": missing_kinds,
-        "missing_tiers": missing_tiers,
-        "missing_reasons": missing_reasons,
-        "missing_events": missing_events,
-        "verdict": "drift" if drift else "pass",
-    }
-    print(json.dumps(verdict))
-    return 1 if drift else 0
-
 
 if __name__ == "__main__":
     sys.exit(main())

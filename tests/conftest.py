@@ -25,3 +25,35 @@ if _REPO_ROOT not in sys.path:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import faulthandler  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _deadlock_watchdog():
+    """Env-gated faulthandler deadlock watchdog (ISSUE 14).
+
+    A lock-discipline regression that slips past the static lint shows
+    up at runtime as a silent deadlock — and tier-1 then burns its
+    whole 870 s timeout with no diagnostics. With
+    ``NORNICDB_TEST_WATCHDOG_S=<seconds>`` set, any single test
+    exceeding the budget dumps ALL thread stacks to stderr (the lock
+    holder is in the dump) and, unless
+    ``NORNICDB_TEST_WATCHDOG_EXIT=0``, exits the process so the run
+    fails fast instead of hanging. Off by default: the timer is armed
+    per test and cancelled on teardown, costing nothing when the env
+    is unset."""
+    budget = os.environ.get("NORNICDB_TEST_WATCHDOG_S")
+    if not budget:
+        yield
+        return
+    exit_on_dump = os.environ.get(
+        "NORNICDB_TEST_WATCHDOG_EXIT", "1") != "0"
+    faulthandler.dump_traceback_later(
+        float(budget), exit=exit_on_dump)
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()
