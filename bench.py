@@ -493,6 +493,21 @@ def _compact_summary(result):
             "shadow_parity_statistical": g(result, "load",
                                            "shadow_parity",
                                            "statistical"),
+            # admission-control overload contract (ISSUE 15), packed
+            # [p99_at_1p2x_ms, goodput_at_1p2x, shed_fraction_1p2x,
+            # unacked_with_shed_1p2x, p99_bound_ratio_1p2x,
+            # goodput_ratio_1p2x] — the fleet-pack precedent: the
+            # driver tail window is 2000 chars, so the summary carries
+            # the sentinel-gated set in array form
+            "overload": [
+                g(result, "load", "overload", "p99_at_1p2x_ms"),
+                g(result, "load", "overload", "goodput_at_1p2x"),
+                g(result, "load", "overload", "shed_fraction_1p2x"),
+                g(result, "load", "overload",
+                  "unacked_with_shed_1p2x"),
+                g(result, "load", "overload", "p99_bound_ratio_1p2x"),
+                g(result, "load", "overload", "goodput_ratio_1p2x"),
+            ],
         },
         # read fleet (ISSUE 12/13), packed [fleet_read_qps,
         # read_scaling, replica_parity, drain_on_breach,
@@ -1189,6 +1204,100 @@ def _estimate_knee(points):
     }
 
 
+def _shed_counts():
+    """Flat snapshot of the admission counters the overload sweep
+    brackets: total sheds + deadline misses (ISSUE 15)."""
+    from nornicdb_tpu.obs import REGISTRY
+
+    out = {"shed": 0.0, "deadline_miss": 0.0}
+    fam = REGISTRY.get("nornicdb_shed_total")
+    if fam is not None:
+        out["shed"] = sum(c.value for c in fam.children().values())
+    fam = REGISTRY.get("nornicdb_deadline_miss_total")
+    if fam is not None:
+        out["deadline_miss"] = sum(c.value
+                                   for c in fam.children().values())
+    return out
+
+
+def _overload_sweep(factory, knee_qps, knee_offered_qps, knee_p99_ms,
+                    duration_s: float, max_arrivals: int,
+                    multipliers=(1.2, 1.5), ratios: bool = True):
+    """The admission-control acceptance measurement (ISSUE 15): drive
+    the surface PAST its measured knee (1.2x / 1.5x the knee's offered
+    rate) and record what the scheduler does about it — p99-at-load of
+    the SERVED stream, goodput (successful completions/s), the shed
+    fraction (server-side counter bracket), and unacknowledged drops
+    (arrivals that got neither an answer nor an honest error). The
+    ROADMAP acceptance story: p99 stays bounded (vs 74x blow-up
+    unmanaged), goodput holds ~knee, and every unserved query got an
+    explicit 429/RESOURCE_EXHAUSTED."""
+    import asyncio
+
+    from nornicdb_tpu.api.grpc_server import GrpcServer
+
+    base = knee_offered_qps or knee_qps
+    doc = {"knee_qps": knee_qps, "knee_offered_qps": knee_offered_qps,
+           "p99_at_knee_ms": knee_p99_ms, "points": {}}
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        loop.set_exception_handler(GrpcServer._quiet_poller_eagain)
+        send, aclose = await factory()
+        try:
+            for _ in range(3):
+                try:
+                    await send()
+                except Exception:  # noqa: BLE001 — warmup only
+                    pass
+            for j, mult in enumerate(multipliers):
+                before = _shed_counts()
+                pt = await _open_loop_point(
+                    send, max(base * mult, 5.0), duration_s,
+                    seed=91 + j, max_arrivals=max_arrivals)
+                after = _shed_counts()
+                shed = after["shed"] - before["shed"]
+                offered = max(pt["offered"], 1)
+                pt["multiplier"] = mult
+                pt["shed"] = shed
+                pt["shed_fraction"] = round(shed / offered, 4)
+                pt["deadline_misses"] = (after["deadline_miss"]
+                                         - before["deadline_miss"])
+                # goodput IS achieved_qps: completions exclude errors
+                pt["goodput_qps"] = pt["achieved_qps"]
+                pt["unacked"] = pt["timed_out"]
+                doc["points"][f"{mult:g}"] = pt
+        finally:
+            await aclose()
+
+    asyncio.run(run())
+    p12 = doc["points"].get("1.2") or {}
+    doc["p99_at_1p2x_ms"] = p12.get("p99_ms")
+    doc["goodput_at_1p2x"] = p12.get("goodput_qps")
+    doc["shed_fraction_1p2x"] = p12.get("shed_fraction")
+    # the honest-backpressure invariant: shed > 0 must imply ZERO
+    # unacknowledged drops (every unserved query got an explicit
+    # 429/RESOURCE_EXHAUSTED — timeouts are silent drops)
+    doc["unacked_with_shed_1p2x"] = (
+        p12.get("unacked", 0) if (p12.get("shed") or 0) > 0 else 0)
+    # the ABSOLUTE acceptance ratios (sentinel bounds: p99 at 1.2x
+    # within 5x the at-knee p99, goodput >= 0.9x knee) only carry
+    # meaning at full scale: tiny dry-run windows (0.25s) are pure
+    # measurement noise, so they emit None there and the sentinel
+    # skips (the relative p99/goodput gates still ride the dry run)
+    if ratios and p12.get("p99_ms") and knee_p99_ms:
+        doc["p99_bound_ratio_1p2x"] = round(
+            p12["p99_ms"] / knee_p99_ms, 3)
+    else:
+        doc["p99_bound_ratio_1p2x"] = None
+    if ratios and p12.get("goodput_qps") and knee_qps:
+        doc["goodput_ratio_1p2x"] = round(
+            p12["goodput_qps"] / knee_qps, 4)
+    else:
+        doc["goodput_ratio_1p2x"] = None
+    return doc
+
+
 def _tier_fractions(before, after):
     """Served-tier mix of one window: fraction of the window's served
     queries per ``surface:tier`` key (obs.audit.tier_counts deltas)."""
@@ -1682,6 +1791,22 @@ def _bench_load(tiny: bool = False, n_people: "int | None" = None,
             http_factory_for(http.port), multipliers, duration_s,
             calib_s, calib_conc, max_arrivals, explicit_rates,
             point_probe=_audit.tier_counts)
+
+        # overload acceptance sweep (ISSUE 15): drive the gRPC surface
+        # at 1.2x and 1.5x its measured knee and record p99-at-load,
+        # shed fraction, goodput and unacknowledged drops — the
+        # admission actuator's sentinel-gated contract
+        g_sweep = out["surfaces"].get("qdrant_grpc_search") or {}
+        if g_sweep.get("knee_qps"):
+            out["overload"] = _overload_sweep(
+                grpc_factory_for(grpc_srv.address),
+                g_sweep.get("knee_qps"),
+                g_sweep.get("knee_offered_qps"),
+                g_sweep.get("p99_at_load_ms"),
+                duration_s, max_arrivals, ratios=not tiny)
+            from nornicdb_tpu import admission as _admission
+
+            out["scheduler"] = _admission.scheduler_summary()
 
         # multi-worker wire-plane sweep (ISSUE 11): the SAME open-loop
         # harness against NORNICDB_WIRE_WORKERS ∈ {1, 2, 4} frontends.

@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from nornicdb_tpu import admission as _adm
 from nornicdb_tpu import obs
 
 # tier-mix truth for search wire-cache hits (ISSUE 10): cached child —
@@ -53,6 +54,39 @@ class ReuseportThreadingHTTPServer(ThreadingHTTPServer):
 _HTTP_H = obs.REGISTRY.histogram(
     "nornicdb_http_request_seconds",
     "HTTP request latency by route family", labels=("route",))
+
+
+# routes admission control never sheds: probes, observability and
+# admin surfaces must stay reachable on an overloaded node — shedding
+# /readyz or /admin/scheduler would blind the operator exactly when
+# the scheduler is acting (ISSUE 15)
+_SHED_EXEMPT = ("health", "readyz", "metrics", "admin", "auth",
+                "status", "openapi.json", "swagger", "docs", "browser",
+                "bifrost", "")
+
+
+# qdrant point READ sub-routes: POST /collections/<c>/points/<tail> is
+# a read for these tails (mirrors the gRPC _shed_lane_of split: only
+# point WRITES ride the background lane)
+_POINT_READ_TAILS = ("search", "query", "scroll", "count", "recommend",
+                     "retrieve")
+
+
+def _shed_lane_for(method: str, path: str) -> Optional[str]:
+    """Admission lane of one HTTP request, or None when the route is
+    exempt from shedding. Writes (PUT/DELETE, bulk point upserts and
+    point delete/payload ops) ride the background lane — under
+    pressure they shed before reads; the qdrant point READ endpoints
+    (search/query/scroll/count/recommend) stay interactive."""
+    seg = path.split("/", 2)[1] if path.startswith("/") else path
+    if seg in _SHED_EXEMPT:
+        return None
+    if method in ("PUT", "DELETE"):
+        return _adm.LANE_BACKGROUND
+    if method == "POST" and "/points" in path \
+            and path.rsplit("/", 1)[-1] not in _POINT_READ_TAILS:
+        return _adm.LANE_BACKGROUND
+    return _adm.LANE_INTERACTIVE
 
 
 def _route_family(path: str) -> str:
@@ -378,24 +412,62 @@ class HttpServer:
                 # a new id, so one fleet-routed read is ONE trace
                 tctx = obs.unpack_context(
                     self.headers.get(obs.TRACE_HEADER, ""))
+                # deadline budget minted at ingress (ISSUE 15): the
+                # client's X-Nornic-Deadline-Ms when present, else the
+                # surface default derived from the SLO objective; the
+                # route's admission lane binds the scope so per-lane
+                # accounting matches the shed verdict
+                dl, explicit = _adm.parse_deadline_header(
+                    self.headers.get(_adm.DEADLINE_HEADER), "http")
+                lane = _shed_lane_for(method, path)
                 try:
                     # propagated_trace opens a plain root when no
                     # context came across — one call site, both cases
                     with obs.propagated_trace(
                             "wire", tctx, method=f"{method} {path}",
                             transport="http"):
-                        self._handle(method)
+                        obs.annotate(deadline_ms=round(
+                            (dl - time.time()) * 1e3, 1))
+                        with _adm.request_scope("http", dl,
+                                                lane_name=lane,
+                                                explicit=explicit):
+                            self._handle(method, lane)
                 finally:
                     # finally: a handler that raises (client hung up
                     # mid-write) is exactly the request p99 wants
                     _HTTP_H.labels(_route_family(path)).observe(
                         time.perf_counter() - t0)
 
-            def _handle(self, method: str) -> None:
+            def _reply_shed(self, e) -> None:
+                outer.metrics.inc("http_errors_total")
+                self._reply(
+                    429,
+                    {"errors": [{
+                        "code": "Neo.TransientError.Request."
+                                "ResourceExhausted",
+                        "message": str(e)}]},
+                    extra_headers={"Retry-After": str(
+                        max(1, int(round(e.retry_after_s))))})
+
+            def _handle(self, method: str,
+                        lane: Optional[str]) -> None:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                if method == "POST" and self.path in ("/nornicdb/search",
-                                                      "/graphql"):
+                # admission verdict (ISSUE 15): work routes pass the
+                # controller before any storage/device work; a shed is
+                # an honest 429 with Retry-After from the lane's drain
+                # rate — never a silent queue entry. The wire-cached
+                # byte routes below check INSIDE their helpers, after
+                # the cache probe: a byte-fresh hit is never shed.
+                cached_route = (method == "POST" and self.path in
+                                ("/nornicdb/search", "/graphql"))
+                if lane is not None and not cached_route:
+                    try:
+                        _adm.check("http", lane)
+                    except _adm.ShedError as e:
+                        self._reply_shed(e)
+                        return
+                if cached_route:
                     # response-bytes wire cache (same pattern as the
                     # qdrant gRPC Search): identical request bytes
                     # against unchanged state skip execution, hit
@@ -410,6 +482,18 @@ class HttpServer:
                         outer.metrics.inc("http_errors_total")
                         self._reply(e.status, {"errors": [
                             {"code": e.code, "message": e.message}]})
+                        return
+                    except _adm.ShedError as e:
+                        # miss-path shed from inside the cached-byte
+                        # helper (hits never reach the controller)
+                        self._reply_shed(e)
+                        return
+                    except _adm.DeadlineExceeded as e:
+                        outer.metrics.inc("http_errors_total")
+                        self._reply(504, {"errors": [
+                            {"code": "Neo.TransientError.Request."
+                                     "DeadlineExceeded",
+                             "message": str(e)}]})
                         return
                     except Exception as e:  # noqa: BLE001
                         outer.metrics.inc("http_errors_total")
@@ -432,6 +516,15 @@ class HttpServer:
                     self._reply(e.status, {"errors": [
                         {"code": e.code, "message": e.message}]})
                     return
+                except _adm.DeadlineExceeded as e:
+                    # budget expired in queue: honest 504 fail-fast
+                    # (the ledger/journal record is the batcher's)
+                    outer.metrics.inc("http_errors_total")
+                    self._reply(504, {"errors": [
+                        {"code": "Neo.TransientError.Request."
+                                 "DeadlineExceeded",
+                         "message": str(e)}]})
+                    return
                 except Exception as e:  # noqa: BLE001 — surface boundary
                     outer.metrics.inc("http_errors_total")
                     self._reply(500, {"errors": [
@@ -440,7 +533,9 @@ class HttpServer:
                     return
                 self._reply(status, payload)
 
-            def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+            def _reply(self, status: int, payload: Dict[str, Any],
+                       extra_headers: Optional[Dict[str, str]] = None
+                       ) -> None:
                 if isinstance(payload, _NegotiatedText):
                     ctype = payload.content_type
                     data = payload.encode()
@@ -464,6 +559,8 @@ class HttpServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -907,6 +1004,10 @@ class HttpServer:
             self.metrics.inc("search_requests_total")
             _SEARCH_CACHED_SERVED.inc()
             return hit[1]
+        # admission verdict AFTER the cache probe (ISSUE 15): a
+        # byte-fresh hit is pure goodput and is never shed — only a
+        # MISS (real device/storage work) passes the controller
+        _adm.check("http", _adm.lane())
         status, payload = self.route("POST", "/nornicdb/search", body,
                                      headers)
         if status != 200:
@@ -926,6 +1027,8 @@ class HttpServer:
         hit = self._graphql_wire.get(key)
         if hit is not None and hit[0] == gen:
             return hit[1]
+        # miss-only admission verdict: cache hits are never shed
+        _adm.check("http", _adm.lane())
         status, payload = self.route("POST", "/graphql", body, headers)
         if status != 200:
             raise HTTPError(status, "Neo.ClientError.Request.Invalid",
@@ -1361,6 +1464,9 @@ class HttpServer:
                 # answered (tier mix) and the shadow-parity state
                 "tiers": obs.tier_mix(),
                 "parity": obs.audit_summary(),
+                # the admission actuator's verdict + lane state
+                # (ISSUE 15): same block /admin/scheduler serves
+                "scheduler": _adm.scheduler_summary(),
                 "rate_limiter_clients":
                     self.rate_limiter.tracked_clients(),
             }
@@ -1368,6 +1474,12 @@ class HttpServer:
             if svc is not None:
                 doc["microbatch"] = svc.microbatch_stats()
             return 200, doc
+
+        if action == "scheduler" and method == "GET":
+            # the admission-control actuator (ISSUE 15): per-lane
+            # queue/in-flight depth + drain rates, deadline-miss
+            # counters, shed totals and the current admission verdict
+            return 200, _adm.scheduler_summary()
 
         if action == "degrades" and method == "GET":
             # the unified degrade ledger (ISSUE 10): structured

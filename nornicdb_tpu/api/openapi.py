@@ -83,6 +83,19 @@ def openapi_spec() -> Dict[str, Any]:
                     "by_kind": {"type": "object"},
                     "events": {"type": "array",
                                "items": {"type": "object"}}}})},
+            "/admin/scheduler": {"get": op(
+                "Admission-control actuator state: per-lane in-flight "
+                "depth + drain rates, deadline-miss counters, shed "
+                "totals and the current admission posture (admin)",
+                "ops",
+                response={"type": "object", "properties": {
+                    "posture": {"type": "string",
+                                "enum": ["admit", "degrade", "shed",
+                                         "shed_hard"]},
+                    "lanes": {"type": "object"},
+                    "deadline": {"type": "object"},
+                    "shed": {"type": "object"},
+                    "limits": {"type": "object"}}})},
             "/admin/fleet": {"get": op(
                 "Fleet telemetry aggregator: merged worker/plane/"
                 "replica registries — per-node lag (ops AND "

@@ -602,8 +602,16 @@ class QdrantCompat:
         merged into one ``upsert_points`` apply per collection (one
         validation pass, one index touch, ONE cache-generation bump for
         the whole convoy). Semantics match upsert_points — on a merged
-        batch the caller's ack still covers exactly its own points."""
-        return self._upsert_coalescer.submit((name, list(points)))
+        batch the caller's ack still covers exactly its own points.
+
+        Bulk upsert convoys ride the BACKGROUND admission lane
+        (ISSUE 15: interactive > replay > background): under pressure
+        a multi-lane backlog seals interactive searches first, and the
+        admission controller sheds convoys before reads."""
+        from nornicdb_tpu import admission as _adm
+
+        with _adm.lane_scope(_adm.LANE_BACKGROUND):
+            return self._upsert_coalescer.submit((name, list(points)))
 
     def _apply_upsert_batch(self, items):
         """Coalescer batch apply: merge per collection, ack per item.

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import grpc
 
+from nornicdb_tpu import admission as _adm
 from nornicdb_tpu import obs
 from nornicdb_tpu.api.proto import qdrant_pb2 as q
 from nornicdb_tpu.api.qdrant import QdrantError, _match_filter
@@ -237,7 +238,29 @@ def _with_vectors(msg, field: str = "with_vectors") -> bool:
 def grpc_status_of(e: Exception) -> grpc.StatusCode:
     if isinstance(e, QdrantError) and getattr(e, "status", 400) == 404:
         return grpc.StatusCode.NOT_FOUND
+    if getattr(e, "status", 400) == 503:
+        return grpc.StatusCode.UNAVAILABLE
     return grpc.StatusCode.INVALID_ARGUMENT
+
+
+# methods that perform admitted WORK (device dispatch, storage scans,
+# merged applies) and therefore pass through admission control; cheap
+# metadata reads are never shed. Resolved once per handler BUILD —
+# the per-request path pays one `is not None` check (ISSUE 15).
+_SHED_METHODS = ("Search", "Query", "Hybrid", "Upsert", "Scroll",
+                 "Recommend", "Count", "Delete", "SetPayload")
+
+
+def _shed_lane_of(method: str) -> Optional[str]:
+    tail = method.rsplit("/", 1)[-1]
+    if not any(tail.startswith(m) for m in _SHED_METHODS):
+        return None
+    # bulk upsert convoys and point deletes ride the BACKGROUND lane
+    # (ISSUE 15: interactive > replay > background rebuild/bulk upsert
+    # convoy) — under pressure, writes shed before reads
+    if tail.startswith(("Upsert", "Delete", "SetPayload")):
+        return _adm.LANE_BACKGROUND
+    return _adm.LANE_INTERACTIVE
 
 
 # -- aio handler plumbing (shared with api/grpc_server.py) ----------------
@@ -315,6 +338,11 @@ def aio_unary_raw(
         return _serialize_timed(out)
 
     latency = _GRPC_H.labels(method or "unknown")
+    # admission pre-gate (ISSUE 15): which lane this method sheds on,
+    # resolved once per handler build. None = never shed (cheap
+    # metadata reads); cache HITS are served even under overload —
+    # a hit costs nothing and is pure goodput.
+    shed_lane = _shed_lane_of(method) if method else None
 
     async def handler(data: bytes, context):
         g = 0
@@ -336,32 +364,82 @@ def aio_unary_raw(
                         return (hit + time_tag + struct.pack(
                             "<d", (time.time() - t0) * scale))
                     return hit
+            # deadline budget minted at ingress (ISSUE 15): the
+            # client's gRPC deadline when one rode the RPC, else the
+            # surface default derived from the SLO objective; visible
+            # on the trace root (acceptance: budget at ingress)
             try:
-                if executor is not None:
-                    # copy_context carries the root span into the
-                    # executor thread, so spans opened by the compute
-                    # (coalesce wait, device dispatch) land in THIS
-                    # request's trace
-                    ctx = contextvars.copy_context()
-                    out = await asyncio.get_running_loop(
-                        ).run_in_executor(executor, ctx.run, serve, data)
-                else:
-                    out = serve(data)
-                if not isinstance(out, bytes):
-                    # over-threshold response: flatten on the
-                    # serializer pool — the loop awaits, it never
-                    # serializes (pinned by the 10MB loop-block test)
-                    ctx = contextvars.copy_context()
-                    out = await asyncio.get_running_loop(
-                        ).run_in_executor(_serializer_pool(), ctx.run,
-                                          _serialize_timed, out)
-            except error_cls as e:
+                budget = context.time_remaining()
+            except Exception:  # noqa: BLE001 — context without deadline API
+                budget = None
+            dl, explicit = _adm.mint_deadline("grpc", budget, now=t0)
+            root.annotate(deadline_ms=round((dl - t0) * 1e3, 1))
+            # the lane the shed verdict resolved also binds the scope,
+            # so per-lane in-flight/drain accounting sees the same
+            # lane the verdict used (a write flood counts as
+            # background pressure, not interactive)
+            with _adm.request_scope("grpc", dl, lane_name=shed_lane,
+                                    explicit=explicit):
+                if shed_lane is not None:
+                    try:
+                        _adm.check("grpc", shed_lane)
+                    except _adm.ShedError as e:
+                        latency.observe(time.time() - t0)
+                        # honest backpressure: RESOURCE_EXHAUSTED with
+                        # retry-pushback metadata derived from the
+                        # lane's drain rate (the gRPC analog of
+                        # HTTP 429 + Retry-After)
+                        await context.abort(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED, str(e),
+                            trailing_metadata=(
+                                ("grpc-retry-pushback-ms",
+                                 str(int(e.retry_after_s * 1e3))),))
+                try:
+                    if executor is not None:
+                        # copy_context carries the root span AND the
+                        # admission context into the executor thread,
+                        # so spans opened by the compute (coalesce
+                        # wait, device dispatch) land in THIS request's
+                        # trace and the batcher sees its budget/lane.
+                        # The executor-queue delay is a measured wait
+                        # observation for the admission controller —
+                        # under overload THIS is where the queue lives.
+                        ctx = contextvars.copy_context()
+                        t_q = time.time()
+
+                        def _serve_queued(data=data, t_q=t_q):
+                            _adm.CONTROLLER.note_wait(
+                                _adm.lane(), time.time() - t_q)
+                            return serve(data)
+
+                        out = await asyncio.get_running_loop(
+                            ).run_in_executor(executor, ctx.run,
+                                              _serve_queued)
+                    else:
+                        out = serve(data)
+                    if not isinstance(out, bytes):
+                        # over-threshold response: flatten on the
+                        # serializer pool — the loop awaits, it never
+                        # serializes (pinned by the 10MB loop-block
+                        # test)
+                        ctx = contextvars.copy_context()
+                        out = await asyncio.get_running_loop(
+                            ).run_in_executor(_serializer_pool(),
+                                              ctx.run,
+                                              _serialize_timed, out)
+                except _adm.DeadlineExceeded as e:
+                    # the budget expired in queue: failed fast, honest
+                    # DEADLINE_EXCEEDED instead of a late answer
+                    latency.observe(time.time() - t0)
+                    await context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+                except error_cls as e:
+                    latency.observe(time.time() - t0)
+                    await context.abort(grpc_status_of(e), str(e))
+                if wire is not None:
+                    wire.put(method, data, g, out)
                 latency.observe(time.time() - t0)
-                await context.abort(grpc_status_of(e), str(e))
-            if wire is not None:
-                wire.put(method, data, g, out)
-            latency.observe(time.time() - t0)
-            return out
+                return out
 
     # no request_deserializer / response_serializer: the server hands us
     # the wire bytes and sends back exactly the bytes we return

@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nornicdb_tpu import admission as _adm
 from nornicdb_tpu import obs
 from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.search.broker import (
@@ -96,6 +97,10 @@ def _map_remote(exc: BrokerRemoteError):
 
     if exc.type_name == "QdrantError":
         return QdrantError(str(exc), status=exc.status)
+    if exc.type_name == "DeadlineExceeded":
+        # the plane shed a budget-expired rider (ISSUE 15): surface it
+        # as the same fail-fast the local batcher would have raised
+        return _adm.DeadlineExceeded(str(exc))
     return exc
 
 
@@ -157,6 +162,9 @@ class BrokerCompat:
         obs.record_stage("broker", "coalesce_wait",
                          doc["t0"] - doc["t_post"])
         obs.record_stage("broker", "apply", doc["t1"] - doc["t0"])
+        # ring post->dispatch interval = this worker's measured queue
+        # wait (ISSUE 15): the shedding verdict's signal
+        _adm.CONTROLLER.note_wait(_adm.lane(), doc["t0"] - doc["t_post"])
         return doc["result"]
 
     def __getattr__(self, name: str):
@@ -205,6 +213,7 @@ class BrokerSearch:
         obs.record_stage("broker", "device_dispatch",
                          doc["t1"] - doc["t0"])
         obs.record_stage("broker", "merge", now - doc["t1"])
+        _adm.CONTROLLER.note_wait(_adm.lane(), doc["t0"] - doc["t_post"])
         _graft_vec_spans(doc, k)
         _audit.set_last_served(doc.get("tier"))
         return doc["hits"]
@@ -364,6 +373,8 @@ def _worker_servicers():
                              doc["t0"] - doc["t_post"])
             obs.record_stage("broker", "device_dispatch",
                              doc["t1"] - doc["t0"])
+            _adm.CONTROLLER.note_wait(_adm.lane(),
+                                      doc["t0"] - doc["t_post"])
             _graft_vec_spans(doc, limit + offset)
             _audit.set_last_served(doc.get("tier"))
             got = self.compat._client.call(
@@ -467,6 +478,9 @@ class _WorkerHttpServer:
         if hit is not None and hit[0] == gen:
             _audit.record_served("hybrid", "cached")
             return 200, hit[1]
+        # miss-only admission verdict (ISSUE 15): a byte-fresh hit is
+        # never shed; only a miss pays the broker round trip
+        _adm.check("http", _adm.lane())
         status, payload = self.db.plane_call(
             "search_payload", body,
             headers.get("Authorization", ""))
@@ -592,6 +606,47 @@ class _WorkerHttpServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 path = self.path.split("?")[0]
+                # ingress deadline + admission verdict (ISSUE 15): the
+                # worker mints the budget like the main server; it
+                # rides the broker ring to the plane in the slot
+                # header. Shedding is worker-local (each frontend sees
+                # its own in-flight pressure).
+                dl, explicit = _adm.parse_deadline_header(
+                    self.headers.get(_adm.DEADLINE_HEADER), "http")
+                from nornicdb_tpu.api.http_server import _shed_lane_for
+
+                lane = _shed_lane_for(method, path)
+                # the wire-cached search route checks admission AFTER
+                # its cache probe (a byte-fresh hit is never shed) —
+                # inside _nornicdb_search; every other work route
+                # checks here, before the broker round trip
+                cached_route = (method == "POST"
+                                and path == "/nornicdb/search")
+                with _adm.request_scope("http", dl, lane_name=lane,
+                                        explicit=explicit):
+                    if lane is not None and not cached_route:
+                        try:
+                            _adm.check("http", lane)
+                        except _adm.ShedError as e:
+                            self._reply_shed(e)
+                            return
+                    self._handle_admitted(method, path, body)
+
+            def _reply_shed(self, e) -> None:
+                data = json.dumps({"errors": [{
+                    "code": "Neo.TransientError.Request."
+                            "ResourceExhausted",
+                    "message": str(e)}]}).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Retry-After", str(
+                    max(1, int(round(e.retry_after_s)))))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _handle_admitted(self, method: str, path: str,
+                                 body: bytes) -> None:
                 try:
                     if method == "POST" and path == "/nornicdb/search":
                         status, data = outer._nornicdb_search(
@@ -644,13 +699,20 @@ class _WorkerHttpServer:
                     status, ctype, data = outer._forward(
                         method, self.path, body, self.headers)
                     self._reply_bytes(status, ctype, data)
+                except _adm.ShedError as e:
+                    # miss-path shed from the cached search route:
+                    # honest 429 with the Retry-After header
+                    self._reply_shed(e)
+                    return
                 except Exception as e:  # noqa: BLE001 — boundary
                     # a plane-side auth denial keeps its 401/403
                     # through the ring (BrokerRemoteError carries the
-                    # remote HTTPError status); everything else stays
-                    # the transient 503 it always was
+                    # remote HTTPError status), a shed keeps its 429
+                    # and a deadline fail-fast its 504 (ISSUE 15);
+                    # everything else stays the transient 503 it
+                    # always was
                     status = getattr(e, "status", None)
-                    if status not in (401, 403):
+                    if status not in (401, 403, 429, 504):
                         status = 503
                     self._reply_bytes(
                         status, "application/json",

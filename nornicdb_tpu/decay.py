@@ -135,7 +135,17 @@ class DecayManager:
 
     def sweep(self, now: Optional[int] = None) -> Tuple[int, int]:
         """Mark below-threshold nodes archived (property flag — the
-        reference archives rather than deletes). Returns (scored, archived)."""
+        reference archives rather than deletes). Returns (scored, archived).
+
+        Runs on the BACKGROUND admission lane (ISSUE 15): a whole-graph
+        scoring sweep must never convoy interactive traffic through the
+        shared write/index machinery."""
+        from nornicdb_tpu import admission as _adm
+
+        with _adm.lane_scope(_adm.LANE_BACKGROUND):
+            return self._sweep_background(now)
+
+    def _sweep_background(self, now: Optional[int]) -> Tuple[int, int]:
         scored = archived = 0
         for node in self.storage.all_nodes():
             s = self.score(node, now)

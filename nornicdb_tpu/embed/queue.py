@@ -122,6 +122,11 @@ class EmbedQueue(MutationListener):
     # -- worker ----------------------------------------------------------
 
     def _run(self) -> None:
+        # background maintenance lane (ISSUE 15): embedding catch-up
+        # work seals behind interactive traffic in shared coalescers
+        from nornicdb_tpu import admission as _adm
+
+        _adm.lane_scope(_adm.LANE_BACKGROUND).__enter__()
         while not self._stop.is_set():
             batch: List[str] = []
             try:

@@ -374,7 +374,19 @@ class HeimdallQC:
     def review_batch(self, storage: Engine,
                      suggestions: List[Suggestion]) -> List[Suggestion]:
         """Returns the approved subset. High-confidence suggestions skip
-        review; the rest are judged in one generation call."""
+        review; the rest are judged in one generation call.
+
+        Runs on the BACKGROUND admission lane (ISSUE 15): inference
+        review (a generation call + storage reads) must never convoy
+        interactive traffic through shared machinery."""
+        from nornicdb_tpu import admission as _adm
+
+        with _adm.lane_scope(_adm.LANE_BACKGROUND):
+            return self._review_batch_background(storage, suggestions)
+
+    def _review_batch_background(
+            self, storage: Engine,
+            suggestions: List[Suggestion]) -> List[Suggestion]:
         self.batches += 1
         self.suggestions_in += len(suggestions)
         skip = [s for s in suggestions

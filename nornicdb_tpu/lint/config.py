@@ -72,6 +72,16 @@ HOT_PATHS = (
     # fleet read routing — once per read
     ("nornicdb_tpu/api/fleet_router.py", "FleetRouter.pick_read"),
     ("nornicdb_tpu/api/fleet_router.py", "RoutedSearch.search"),
+    # admission actuator (ISSUE 15) — deadline mint + verdict run once
+    # per request on every ingress; config is cached at first use and
+    # these must never read the environment
+    ("nornicdb_tpu/admission.py", "AdmissionController.check"),
+    ("nornicdb_tpu/admission.py", "AdmissionController.note_enter"),
+    ("nornicdb_tpu/admission.py", "AdmissionController.note_exit"),
+    ("nornicdb_tpu/admission.py", "mint_deadline"),
+    ("nornicdb_tpu/admission.py", "parse_deadline_header"),
+    ("nornicdb_tpu/admission.py", "record_shed"),
+    ("nornicdb_tpu/admission.py", "lane_rank"),
 )
 
 # ---------------------------------------------------------------------------

@@ -118,12 +118,19 @@ def _resolve_local_literals(
     fdef: ast.AST, name: str,
 ) -> Optional[List[str]]:
     """Literal values a local name is assigned within ``fdef`` — None
-    when any assignment is unresolvable (or there are none)."""
+    when any assignment is unresolvable (or there are none). A bare
+    ``hold = None`` assignment is skipped, not unresolvable: it is the
+    no-degrade arm of the guard idiom ``hold = None; if ...: hold =
+    "quarantine"; ...; if hold is not None: _ledger(..., hold, ...)``
+    (the record call never runs with the None value)."""
     vals: List[str] = []
     for node in ast.walk(fdef):
         if isinstance(node, ast.Assign) and any(
                 isinstance(t, ast.Name) and t.id == name
                 for t in node.targets):
+            if isinstance(node.value, ast.Constant) \
+                    and node.value.value is None:
+                continue
             v = _literal_values(node.value)
             if v is None:
                 return None

@@ -34,6 +34,7 @@ IMPORT_TIME_MODULES = (
     "nornicdb_tpu.obs",            # dispatch, stages, cost families
     "nornicdb_tpu.obs.events",     # incident-timeline counter (ISSUE 13)
     "nornicdb_tpu.obs.fleet",      # fleet-aggregator sources gauge
+    "nornicdb_tpu.admission",      # shed/deadline/lane families (ISSUE 15)
     "nornicdb_tpu.search.microbatch",
     "nornicdb_tpu.search.broker",  # wire-plane broker families (ISSUE 11)
     "nornicdb_tpu.search.service",

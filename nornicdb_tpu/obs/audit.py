@@ -80,6 +80,12 @@ TIER_HOST = "host"
 # (a steady-state wire workload is mostly this); never shadow-audited
 # (the cache generation machinery already guarantees freshness).
 TIER_CACHED = "cached"
+# queries admission control REJECTED (429 / RESOURCE_EXHAUSTED) or
+# failed fast past their deadline budget (ISSUE 15): counted in the
+# tier mix so the under-load serve accounting sums to offered work —
+# a shed query is an answered query (honest backpressure), just not a
+# ranked one. Never shadow-audited; never a ladder rung.
+TIER_SHED = "shed"
 
 # per-surface device ladders, best rung first. These are the ONLY legal
 # `tier` label values — the catalog lint checks each against
@@ -95,7 +101,7 @@ TIERS: Dict[str, Tuple[str, ...]] = {
 }
 
 ALL_TIERS: Tuple[str, ...] = tuple(sorted(
-    {t for tiers in TIERS.values() for t in tiers}))
+    {t for tiers in TIERS.values() for t in tiers} | {TIER_SHED}))
 
 # parity contracts per tier (host is the reference; never audited).
 # Exact tiers must reproduce the host ranking bit-for-bit (rank-parity
@@ -114,7 +120,7 @@ STATISTICAL_FLOORS: Dict[str, float] = {
 
 EXACT_TIERS: Tuple[str, ...] = tuple(sorted(
     t for t in ALL_TIERS
-    if t not in (TIER_HOST, TIER_CACHED)
+    if t not in (TIER_HOST, TIER_CACHED, TIER_SHED)
     and t not in STATISTICAL_FLOORS))
 
 
@@ -153,6 +159,9 @@ REASONS: Tuple[str, ...] = (
     "broker_timeout",      # shared device plane missed the rider deadline
     "replica_lag",         # read replica behind the lag threshold drained
     "replica_drain",       # replica drained: parity/rebuild/unreachable
+    "deadline",            # request budget expired before/while queued
+    "shed",                # admission control rejected the request
+    "admission",           # admission posture forced the tier down
 )
 
 # legacy event label value -> normalized reason. One table so the old
@@ -732,7 +741,14 @@ class ShadowAuditor:
             t.start()
 
     def _run(self) -> None:
+        # lazy: admission imports this module; at worker start the
+        # cycle is long resolved. Shadow replays ride the REPLAY lane
+        # (ISSUE 15) so reference re-executions seal behind interactive
+        # traffic in any coalescer they touch.
+        from nornicdb_tpu import admission as _adm_lane
+
         _tls.in_audit = True
+        _adm_lane.lane_scope(_adm_lane.LANE_REPLAY).__enter__()
         while True:
             self._have_work.wait(timeout=1.0)
             item = None
@@ -1042,6 +1058,33 @@ def sampling_active() -> bool:
 
 def tier_allowed(tier: str) -> bool:
     return AUDITOR.tier_allowed(tier)
+
+
+# -- admission-posture tier forcing (ISSUE 15) --------------------------------
+#
+# The admission controller (nornicdb_tpu/admission.py) degrades along
+# the existing serving ladders BEFORE it rejects work: under a degrade-
+# or-worse posture the expensive device rungs (walk/quant/graph) step
+# down to brute/host exactly like a parity quarantine would, through
+# the same per-ladder gate sites — one registered hook, so audit stays
+# import-light and admission stays optional.
+
+_ADMISSION_GATE: Callable[[str], bool] = lambda tier: True
+
+
+def set_admission_gate(fn: Callable[[str], bool]) -> None:
+    global _ADMISSION_GATE
+    _ADMISSION_GATE = fn
+
+
+def admission_allows(tier: str) -> bool:
+    """True unless the admission posture is holding this tier down its
+    ladder (ledger reason ``admission`` at the gate sites — distinct
+    from the auditor's ``quarantine``)."""
+    try:
+        return _ADMISSION_GATE(tier)
+    except Exception:  # noqa: BLE001 — a broken gate must not fail serving
+        return True
 
 
 def parity_breaches() -> List[Dict[str, Any]]:

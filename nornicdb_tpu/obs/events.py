@@ -57,6 +57,9 @@ KINDS: Tuple[str, ...] = (
     "quarantine",       # the parity auditor stepped a tier down
     "quarantine_lift",  # the quarantined tier recovered
     "slo_breach",       # a breach-triggered flight-recorder dump
+    "shed",             # admission rejected a query (429/exhausted)
+                        # or failed it fast past its deadline budget
+    "posture",          # the admission posture transitioned
 )
 
 _EVENTS_C = REGISTRY.counter(

@@ -328,6 +328,17 @@ class SloEngine:
             {"kind": "events", "summary": _events.event_summary(),
              "ring": _events.event_snapshot(limit=100)},
         ]
+        # the admission actuator's state at breach time (ISSUE 15):
+        # posture, per-lane depth/drain, deadline misses, shed totals —
+        # what the scheduler was DOING about the breach. Lazy import:
+        # slo must stay importable without the actuator.
+        try:
+            from nornicdb_tpu.admission import scheduler_summary
+
+            lines.append({"kind": "scheduler",
+                          "summary": scheduler_summary()})
+        except Exception:  # noqa: BLE001 — dump must never fail on extras
+            pass
         for rec in (extra or []):
             lines.append(rec)
         for trace in TRACES.slowest(limit=20):

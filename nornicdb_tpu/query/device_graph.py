@@ -487,11 +487,18 @@ class DeviceGraphPlane:
             # dispatch; only coalescible concurrency routes on-device
             if self.inflight <= 1:
                 return None
+        hold = None
         if not _audit.tier_allowed(TIER_CHAIN):
             # shadow-parity quarantine: the chain rung steps down to
             # the host executor until the breach clears
+            hold = "quarantine"
+        elif not _audit.admission_allows(TIER_CHAIN):
+            # admission posture (ISSUE 15): overload forces the chain
+            # rung to the host executor to shrink device pressure
+            hold = "admission"
+        if hold is not None:
             _event("degrade_quarantine")
-            _ledger(TIER_CHAIN, "quarantine",
+            _ledger(TIER_CHAIN, hold,
                     {"catalog_version": self.catalog.version})
             return None
         batcher = self._chain_batcher(spec)
@@ -817,9 +824,14 @@ class DeviceGraphPlane:
             # measured on CPU: the fused dispatch beats the host
             # fallback ~2x at b=16 but loses ~4x at b=1
             return None
+        hold = None
         if not _audit.tier_allowed(TIER_RANK):
+            hold = "quarantine"
+        elif not _audit.admission_allows(TIER_RANK):
+            hold = "admission"
+        if hold is not None:
             _event("degrade_quarantine")
-            _ledger(TIER_RANK, "quarantine",
+            _ledger(TIER_RANK, hold,
                     {"catalog_version": self.catalog.version})
             return None
         hops_t = tuple((str(e), str(d)) for e, d in hops)

@@ -350,7 +350,15 @@ class ReadReplica:
         Per-stage replay timing (ISSUE 13) splits each record's cost
         into the listener fan-out vs the search-index apply
         (nornicdb_replica_replay_seconds{node,stage}) — the seconds
-        behind the apply-delay histogram's tail."""
+        behind the apply-delay histogram's tail. The whole fan-out
+        rides the REPLAY admission lane (ISSUE 15): index work it
+        triggers seals behind interactive reads on this replica."""
+        from nornicdb_tpu import admission as _adm
+
+        with _adm.lane_scope(_adm.LANE_REPLAY):
+            self._on_applied_replay(op, data)
+
+    def _on_applied_replay(self, op: str, data: Dict[str, Any]) -> None:
         listeners = self.db._listenable._each()
         svc = self.db._search
         if op in ("create_node", "update_node"):

@@ -71,6 +71,7 @@ from nornicdb_tpu.obs import (
 )
 from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.obs import tracing as _tracing
+from nornicdb_tpu import admission as _adm
 from nornicdb_tpu.search.microbatch import pow2_bucket
 
 # pre-register the ring's dispatch kind so the compile-universe
@@ -86,9 +87,14 @@ OP_VEC, OP_CALL = 1, 2
 RESP_INLINE, RESP_SPILL = 0, 1
 
 # slot header: state, op, ok, resp_kind, seq, req_len, resp_len, k,
-# t_post, t_claim, t0, t1, batch, reserved  (packed little-endian)
-_HDR = struct.Struct("<BBBBIIIIddddII")
-_HDR_SIZE = 64  # header struct is 56 bytes; slots align to 64
+# t_post, t_claim, t0, t1, batch, deadline  (packed little-endian).
+# On a POSTED slot the resp_kind byte carries the rider's priority-LANE
+# code (admission.LANE_CODES) — the response pack overwrites it — and
+# ``deadline`` is the rider's absolute budget (0.0 = none), so both
+# survive the worker -> plane hop without touching the payload
+# (ISSUE 15).
+_HDR = struct.Struct("<BBBBIIIIddddId")
+_HDR_SIZE = 64  # header struct is exactly 64 bytes; slots align to 64
 assert _HDR.size <= _HDR_SIZE
 
 # control block: magic, n_workers, slots_per_worker, slot_bytes (u32 x4)
@@ -274,7 +280,8 @@ class BrokerClient:
             self._free.append(slot)
             self._cond.notify()
 
-    def _post(self, slot: int, op: int, payload: bytes, k: int = 0) -> int:
+    def _post(self, slot: int, op: int, payload: bytes, k: int = 0,
+              deadline: float = 0.0, lane_code: int = 0) -> int:
         lay = self._layout
         if len(payload) > lay.payload_bytes:
             raise ValueError(
@@ -284,8 +291,13 @@ class BrokerClient:
         self._seq += 1
         seq = self._seq & 0xFFFFFFFF
         self._buf[off + _HDR_SIZE:off + _HDR_SIZE + len(payload)] = payload
-        _HDR.pack_into(self._buf, off, ST_FREE, op, 0, RESP_INLINE, seq,
-                       len(payload), 0, k, time.time(), 0.0, 0.0, 0.0, 0, 0)
+        # resp_kind byte carries the LANE code on a posted slot; the
+        # trailing double carries the rider's absolute deadline budget
+        # (0.0 = none) — the plane sheds expired riders at claim and
+        # binds the budget around the dispatch (ISSUE 15)
+        _HDR.pack_into(self._buf, off, ST_FREE, op, 0, lane_code, seq,
+                       len(payload), 0, k, time.time(), 0.0, 0.0, 0.0,
+                       0, deadline)
         # publish LAST: the state byte flips ownership to the broker
         self._buf[off] = ST_POSTED
         _ring_doorbell(self._sock, self._broker_path)
@@ -305,9 +317,8 @@ class BrokerClient:
                     self._tombstoned.add(slot)
                 _ERRS_C.labels("rider_timeout").inc()
                 raise BrokerTimeout(
-                    f"device plane did not answer within "
-                    f"{self.timeout_s:.1f}s (op abandoned, slot "
-                    f"tombstoned)")
+                    "device plane did not answer within the rider "
+                    "deadline (op abandoned, slot tombstoned)")
             try:
                 self._sock.recv(64)
             except socket.timeout:
@@ -356,6 +367,29 @@ class BrokerClient:
                    + kb + tb + vec.tobytes())
         return self._roundtrip(OP_VEC, payload, k, timeout_s)
 
+    def _await_deadline(self, timeout_s: Optional[float],
+                        now: float) -> Tuple[float, Optional[float]]:
+        """(rider await deadline, request deadline or None). The rider
+        timeout consults the REQUEST deadline when one is in context —
+        a generous CLIENT budget is not truncated to the flat
+        ``NORNICDB_WIRE_TIMEOUT_S`` and a tight one is not held open
+        past its own expiry (ISSUE 15; closes the PR 11 headroom
+        note). Only an EXPLICIT budget (gRPC deadline, the deadline
+        header, a programmatic scope) may extend the flat timeout: a
+        server-minted surface default (30s http) must not double the
+        dead-plane detection latency, so defaults clamp to the flat
+        knob while still failing the rider fast if they are tighter.
+        An explicit ``timeout_s`` argument still wins (internal
+        callers: readiness probes, admin ops)."""
+        req_dl = _adm.deadline()
+        if timeout_s is not None:
+            return now + timeout_s, req_dl
+        if req_dl is not None:
+            if _adm.deadline_explicit():
+                return req_dl, req_dl
+            return min(req_dl, now + self.timeout_s), req_dl
+        return now + self.timeout_s, req_dl
+
     def call(self, target: str, method: str, *args,
              timeout_s: Optional[float] = None, **kwargs) -> Dict[str, Any]:
         """Generic op on a device-plane target. Returns ``{"result",
@@ -372,10 +406,21 @@ class BrokerClient:
 
     def _roundtrip(self, op: int, payload: bytes, k: int,
                    timeout_s: Optional[float]) -> Dict[str, Any]:
-        deadline = time.time() + (timeout_s or self.timeout_s)
+        now = time.time()
+        deadline, req_dl = self._await_deadline(timeout_s, now)
+        if req_dl is not None and now >= req_dl:
+            # budget already spent: never post a slot the plane would
+            # claim, dispatch and answer into the void
+            lane_name = _adm.lane()
+            _adm.record_deadline_miss("broker", "ring", lane_name)
+            raise _adm.DeadlineExceeded(
+                "deadline budget expired before ring post")
         slot = self._acquire_slot(deadline)
         try:
-            seq = self._post(slot, op, payload, k=k)
+            seq = self._post(slot, op, payload, k=k,
+                             deadline=req_dl or 0.0,
+                             lane_code=_adm.LANE_CODES.get(
+                                 _adm.lane(), 0))
             hdr = self._await(slot, seq, deadline)
             doc = self._response(slot, hdr)
         except BrokerTimeout:
@@ -600,13 +645,23 @@ class DispatchBroker:
                     continue
                 item = {"off": off, "k": k, "dims": dims,
                         "vec_off": base + key_len + ctx_len,
-                        "t_post": hdr[8], "worker": w, "ctx": ctx}
+                        "t_post": hdr[8], "worker": w, "ctx": ctx,
+                        # ring-carried admission context (ISSUE 15):
+                        # the rider's absolute budget and lane survive
+                        # the worker -> plane hop in the slot header
+                        "deadline": hdr[13] or None,
+                        "lane": _adm.LANE_FROM_CODE.get(
+                            hdr[3], _adm.LANE_INTERACTIVE)}
                 group.append((w, s, item))
             else:
                 req = bytes(self._buf[off + _HDR_SIZE:
                                       off + _HDR_SIZE + req_len])
                 calls.append((w, s, {"off": off, "req": req,
-                                     "t_post": hdr[8], "worker": w}))
+                                     "t_post": hdr[8], "worker": w,
+                                     "deadline": hdr[13] or None,
+                                     "lane": _adm.LANE_FROM_CODE.get(
+                                         hdr[3],
+                                         _adm.LANE_INTERACTIVE)}))
             self._buf[off] = ST_CLAIMED
             claimed += 1
         self._last_round = max(claimed, 1)
@@ -644,10 +699,43 @@ class DispatchBroker:
         _ring_doorbell(
             self._wake, os.path.join(self.sock_dir, f"worker{worker}.sock"))
 
+    def _shed_expired(self, item: dict, t_claim: float) -> None:
+        """Respond to a rider whose budget expired before the plane
+        could dispatch it: an explicit DeadlineExceeded (the worker
+        maps it onto its surface's honest error), recorded under the
+        rider's PROPAGATED trace so the ledger/journal shed record
+        carries the originating trace id (ISSUE 15)."""
+        hdr = _read_hdr(self._buf, item["off"])
+
+        def _record():
+            _adm.record_deadline_miss("broker", "ring", item["lane"])
+
+        if item.get("ctx"):
+            with _tracing.propagated_trace("broker.shed", item["ctx"],
+                                           surface="broker"):
+                _record()
+        else:
+            _record()
+        now = time.time()
+        self._respond(item["off"], hdr, 0,
+                      ("DeadlineExceeded",
+                       "deadline budget expired on the ring", 504),
+                      t_claim, now, now, 1, item["worker"])
+
     def _run_vec_group(self, key: str,
                        group: List[Tuple[int, int, dict]],
                        t_claim: float) -> None:
         try:
+            now = time.time()
+            live = []
+            for w, s, item in group:
+                if item.get("deadline") and now >= item["deadline"]:
+                    self._shed_expired(item, t_claim)
+                else:
+                    live.append((w, s, item))
+            group = live
+            if not group:
+                return
             b = len(group)
             _BATCH_H.observe(b)
             # zero-copy gather off the ring: each rider's embedding is
@@ -674,13 +762,29 @@ class DispatchBroker:
             # precedent (the leader's dispatch story is the batch's)
             lead_ctx = next((item["ctx"] for _w, _s, item in group
                              if item.get("ctx")), None)
-            if lead_ctx is not None:
-                with _tracing.propagated_trace(
-                        "broker.vec", lead_ctx, key=key, batch=b,
-                        surface="broker"):
+            # ring-carried admission context binds the dispatch: the
+            # group's tightest budget and best lane govern any nested
+            # coalescing below the plane entry (ISSUE 15)
+            dls = [item["deadline"] for _w, _s, item in group
+                   if item.get("deadline")]
+            group_dl = min(dls) if dls else None
+            group_lane = min(
+                (item["lane"] for _w, _s, item in group),
+                key=lambda ln: _adm.lane_rank(ln))
+            with _adm.deadline_scope(group_dl), \
+                    _adm.lane_scope(group_lane):
+                if lead_ctx is not None:
+                    attrs = {"key": key, "batch": b,
+                             "surface": "broker", "lane": group_lane}
+                    if group_dl is not None:
+                        attrs["deadline_ms"] = round(
+                            (group_dl - t0) * 1e3, 1)
+                    with _tracing.propagated_trace(
+                            "broker.vec", lead_ctx, **attrs):
+                        results = self._vec_dispatch(key, queries,
+                                                     k_max)
+                else:
                     results = self._vec_dispatch(key, queries, k_max)
-            else:
-                results = self._vec_dispatch(key, queries, k_max)
             t1 = time.time()
             tier = _audit.consume_batch_tier()
             # fleet-routed reads stamp the chosen node (ISSUE 13): the
@@ -704,7 +808,9 @@ class DispatchBroker:
                     doc["node"] = node
                 if item.get("ctx"):
                     doc["spans"] = _vec_span_docs(
-                        item["t_post"], t_claim, t0, t1, b, tier, node)
+                        item["t_post"], t_claim, t0, t1, b, tier, node,
+                        deadline=item.get("deadline"),
+                        lane=item.get("lane"))
                 self._respond(item["off"], hdr, 1, doc, t_claim, t0, t1,
                               b, item["worker"])
         except Exception as exc:  # noqa: BLE001 — poison isolation
@@ -712,6 +818,11 @@ class DispatchBroker:
             # replay each rider alone so only the poisoned request
             # observes its error (MicroBatcher discipline)
             for _w, _s, item in group:
+                if item.get("deadline") \
+                        and time.time() >= item["deadline"]:
+                    # the failed batch consumed this rider's budget
+                    self._shed_expired(item, t_claim)
+                    continue
                 hdr = _read_hdr(self._buf, item["off"])
                 try:
                     q1 = np.frombuffer(
@@ -740,7 +851,8 @@ class DispatchBroker:
                     if item.get("ctx"):
                         doc["spans"] = _vec_span_docs(
                             item["t_post"], t_claim, t0, t1, 1, tier,
-                            node)
+                            node, deadline=item.get("deadline"),
+                            lane=item.get("lane"))
                     self._respond(item["off"], hdr, 1, doc, t_claim,
                                   t0, t1, 1, item["worker"])
                 except Exception as single:  # noqa: BLE001
@@ -761,6 +873,11 @@ class DispatchBroker:
             req = pickle.loads(item["req"])
             target_name, method, args, kwargs = req[:4]
             ctx = req[4] if len(req) > 4 else None
+            if item.get("deadline") and time.time() >= item["deadline"]:
+                # rider budget spent before the op could run (ISSUE 15)
+                item.setdefault("ctx", ctx)
+                self._shed_expired(item, t_claim)
+                return
             obj = self._targets[target_name]
             fn = obj
             for part in method.split("."):
@@ -768,15 +885,25 @@ class DispatchBroker:
             t0 = time.time()
             _audit.set_last_served(None)
             pspan = None
-            with _audit.collect_degrades() as degrades:
+            with _audit.collect_degrades() as degrades, \
+                    _adm.deadline_scope(item.get("deadline")), \
+                    _adm.lane_scope(item.get("lane")
+                                    or _adm.LANE_INTERACTIVE):
+                # the ring-carried admission context binds the op: a
+                # nested MicroBatcher/convoy ride below inherits the
+                # rider's budget and lane (ISSUE 15)
                 if ctx is not None:
                     # PROPAGATED trace (ISSUE 13): the op executes
                     # under the rider's trace id, so degrade records
                     # minted here carry it across the boundary, and
                     # plane-side child spans export back in meta
+                    attrs = {"target": target_name, "op": method,
+                             "surface": "broker"}
+                    if item.get("deadline"):
+                        attrs["deadline_ms"] = round(
+                            (item["deadline"] - t0) * 1e3, 1)
                     with _tracing.propagated_trace(
-                            "plane.call", ctx, target=target_name,
-                            op=method, surface="broker") as pspan:
+                            "plane.call", ctx, **attrs) as pspan:
                         result = fn(*args, **kwargs)
                 else:
                     result = fn(*args, **kwargs)
@@ -797,21 +924,32 @@ class DispatchBroker:
 
 def _vec_span_docs(t_post: float, t_claim: float, t0: float, t1: float,
                    batch: int, tier: Optional[str],
-                   node: Optional[str]) -> List[Dict[str, Any]]:
+                   node: Optional[str],
+                   deadline: Optional[float] = None,
+                   lane: Optional[str] = None) -> List[Dict[str, Any]]:
     """Plane-side span records for ONE OP_VEC rider — the exported
     tree the worker grafts into its live trace so `/admin/traces` on
     the ingress worker shows the full wire -> ring -> coalesce ->
-    device.dispatch chain with original timing."""
+    device.dispatch chain with original timing. The ring.claim span
+    carries the rider's remaining budget AT the ring crossing and the
+    dispatch span its remaining budget AT the dispatch decision
+    (ISSUE 15 acceptance: the deadline is visible at every hop)."""
+    claim_attrs: Dict[str, Any] = {"surface": "broker"}
     dispatch_attrs: Dict[str, Any] = {"surface": "broker",
                                       "batch": batch,
                                       "kind": "broker_vec"}
+    if lane:
+        claim_attrs["lane"] = lane
+    if deadline:
+        claim_attrs["deadline_ms"] = round((deadline - t_post) * 1e3, 1)
+        dispatch_attrs["deadline_ms"] = round((deadline - t0) * 1e3, 1)
     if tier:
         dispatch_attrs["tier"] = tier
     if node:
         dispatch_attrs["fleet_node"] = node
     return [
         {"name": "ring.claim", "t0": t_post, "t1": t_claim,
-         "attrs": {"surface": "broker"}, "children": []},
+         "attrs": claim_attrs, "children": []},
         {"name": "plane.coalesce", "t0": t_claim, "t1": t0,
          "attrs": {"surface": "broker"}, "children": []},
         {"name": "device.dispatch", "t0": t0, "t1": t1,

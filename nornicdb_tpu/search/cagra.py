@@ -651,8 +651,13 @@ class CagraIndex:
         _CAGRA_C.labels("background_rebuild").inc()
 
         def run():
+            from nornicdb_tpu import admission as _adm
+
             try:
-                self.build()  # _build_locked no-ops if already fresh
+                # background maintenance lane (ISSUE 15): any coalescer
+                # ride from this thread seals behind interactive work
+                with _adm.lane_scope(_adm.LANE_BACKGROUND):
+                    self.build()  # _build_locked no-ops if already fresh
             finally:
                 # same lock as the set in _kick_background_rebuild: an
                 # unguarded clear can interleave with a concurrent
@@ -784,11 +789,18 @@ class CagraIndex:
             return self._brute.search_batch(queries, k)
         tier = ("vector_walk_quant" if g.get("quant") is not None
                 else "vector_walk_f32")
+        hold = None
         if not _audit.tier_allowed(tier):
             # shadow-parity quarantine: the walk steps down its ladder
             # to the exact tier until the breach clears
+            hold = "quarantine"
+        elif not _audit.admission_allows(tier):
+            # admission posture (ISSUE 15): overload forces the walk
+            # down to the exact tier to shrink device pressure
+            hold = "admission"
+        if hold is not None:
             _CAGRA_C.labels("exact_fallback_quarantine").inc()
-            self._degrade(tier, "quarantine", g)
+            self._degrade(tier, hold, g)
             return self._brute.search_batch(queries, k)
         p = itopk or self.itopk
         if min(k, g["n"]) > p:

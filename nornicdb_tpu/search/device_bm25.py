@@ -338,8 +338,13 @@ class DeviceBM25:
         _LEX_C.labels("background_rebuild").inc()
 
         def run():
+            from nornicdb_tpu import admission as _adm
+
             try:
-                self.build()
+                # background maintenance lane (ISSUE 15): any coalescer
+                # ride from this thread seals behind interactive work
+                with _adm.lane_scope(_adm.LANE_BACKGROUND):
+                    self.build()
             finally:
                 # same lock as the set above: an unguarded clear can
                 # interleave with a concurrent kick's read-then-set

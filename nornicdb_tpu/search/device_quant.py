@@ -646,8 +646,13 @@ class QuantizedBrutePlane:
         _QUANT_C.labels("background_rebuild").inc()
 
         def run():
+            from nornicdb_tpu import admission as _adm
+
             try:
-                self.build()
+                # background maintenance lane (ISSUE 15): any coalescer
+                # ride from this thread seals behind interactive work
+                with _adm.lane_scope(_adm.LANE_BACKGROUND):
+                    self.build()
             finally:
                 # same lock as the set above: an unguarded clear can
                 # interleave with a concurrent kick's read-then-set
@@ -751,11 +756,18 @@ class QuantizedBrutePlane:
         if snap is None:
             return None
         tier = f"vector_{snap['mode']}"
+        hold = None
         if not _audit.tier_allowed(tier):
             # shadow-parity quarantine: step down to the float32 tier
             # until the breach clears (audit.tier_allowed probation)
+            hold = "quarantine"
+        elif not _audit.admission_allows(tier):
+            # admission posture (ISSUE 15): overload forces the quant
+            # rung down to float32 to shrink device pressure
+            hold = "admission"
+        if hold is not None:
             _QUANT_C.labels("degrade_quarantine").inc()
-            self._degrade(tier, "quarantine", snap)
+            self._degrade(tier, hold, snap)
             return None
         if snap["built_compactions"] != getattr(brute, "compactions", 0):
             # a compaction remapped the slot space: plane slot ids no

@@ -785,12 +785,19 @@ class FusedHybrid:
             # the quant programs are single-shard; sharded snapshots
             # keep the float32 mesh path
             return None
+        hold = None
         if not _audit.tier_allowed(quant_tier(quant_mode())):
             # shadow-parity quarantine: the quantized rung steps down
             # to the float32 tier of the same ladder
+            hold = "quarantine"
+        elif not _audit.admission_allows(quant_tier(quant_mode())):
+            # admission posture (ISSUE 15): overload forces the quant
+            # rung down to float32 to shrink device pressure
+            hold = "admission"
+        if hold is not None:
             _HYB_C.labels("quant_quarantined").inc()
             self._ledger(quant_tier(quant_mode()), TIER_BRUTE_F32,
-                         "quarantine", snap)
+                         hold, snap)
             return None
         brute = self.brute
         plane = getattr(brute, "quant_plane", lambda: None)()
@@ -949,11 +956,18 @@ class FusedHybrid:
         tier = (TIER_WALK_QUANT
                 if snap["shards"] == 1 and g.get("quant") is not None
                 else TIER_WALK_F32)
+        hold = None
         if not _audit.tier_allowed(tier):
             # shadow-parity quarantine: walk steps down its ladder to
             # the brute-fused tier until the breach clears
+            hold = "quarantine"
+        elif not _audit.admission_allows(tier):
+            # admission posture (ISSUE 15): overload forces the walk
+            # down to the brute-fused tier to shrink device pressure
+            hold = "admission"
+        if hold is not None:
             _HYB_C.labels("walk_quarantined").inc()
-            self._ledger(tier, TIER_BRUTE_F32, "quarantine", snap, g)
+            self._ledger(tier, TIER_BRUTE_F32, hold, snap, g)
             return None
         if kq > cagra.itopk:
             # the walk pool only ever holds itopk candidates; a deeper

@@ -32,6 +32,7 @@ from nornicdb_tpu.obs import (
     record_stage,
 )
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu import admission as _adm
 
 # one metric family set shared by every batcher instance (per-collection
 # MicroBatchers, the search service's, the upsert coalescer): the
@@ -46,6 +47,48 @@ _CONVOY_H = REGISTRY.histogram(
     "nornicdb_convoy_batch_size",
     "Coalesced items per merged apply (write convoys)",
     buckets=SIZE_BUCKETS)
+# deadline-aware dispatch (ISSUE 15): batches sealed EARLY — the gather
+# window skipped because a rider's remaining budget would expire inside
+# it — dispatch smaller now instead of convoying toward a miss (pow2
+# buckets absorb the size change: no new compile universe)
+_EARLY_C = REGISTRY.counter(
+    "nornicdb_deadline_early_dispatch_total",
+    "Batches sealed early because a rider's deadline budget was tight",
+    labels=("surface",))
+
+
+def _expire_in_queue(owner, item, msg: str) -> bool:
+    """Caller holds ``owner._cond``: fail one budget-expired item fast
+    if it is still pending (not yet claimed by a leader). Shared by the
+    MicroBatcher/BatchCoalescer wait loops (ISSUE 15)."""
+    try:
+        owner._pending.remove(item)
+    except ValueError:
+        return False  # claimed: it rides out the in-flight batch
+    item.error = _adm.DeadlineExceeded(msg)
+    item.done = True
+    return True
+
+
+def _seal_pending(owner, now: float, msg: str):
+    """Caller holds ``owner._cond``: drop budget-expired items (failed
+    fast, never dispatched) then select the next batch via the shared
+    lane-priority/weighted-share policy (admission.select_batch). The
+    ONE seal implementation both coalescers share (ISSUE 15)."""
+    pending = owner._pending
+    expired = [r for r in pending
+               if r.deadline is not None and now >= r.deadline]
+    if expired:
+        dead = set(map(id, expired))
+        pending = [r for r in pending if id(r) not in dead]
+        owner._pending = pending
+        for r in expired:
+            r.error = _adm.DeadlineExceeded(msg)
+            r.done = True
+        owner._cond.notify_all()
+    batch, rest = _adm.select_batch(pending, owner._max_batch, now)
+    owner._pending = rest
+    return batch
 
 
 def pow2_bucket(n: int) -> int:
@@ -98,18 +141,44 @@ class BatchCoalescer:
 
     def submit(self, value: Any) -> Any:
         t_enq = time.time()
+        # admission context (ISSUE 15): convoy items carry the caller's
+        # lane + deadline budget like MicroBatcher riders — an expired
+        # item fails fast instead of riding a merged apply
+        dl = _adm.deadline()
+        lane = _adm.lane()
+        if dl is not None and t_enq >= dl:
+            _adm.record_deadline_miss(self._surface, "ingress", lane)
+            raise _adm.DeadlineExceeded(
+                f"deadline budget expired before enqueue "
+                f"({self._surface})")
         item = _Item(value)
+        item.deadline, item.lane, item.t_enq = dl, lane, t_enq
         with self._cond:
             self._pending.append(item)
         while True:
             batch: List[_Item] = []
             with self._cond:
                 while not item.done and self._busy:
-                    self._cond.wait(timeout=30.0)
+                    timeout = 30.0
+                    if item.deadline is not None:
+                        timeout = min(
+                            timeout,
+                            max(item.deadline - time.time(), 0.0) + 1e-3)
+                    self._cond.wait(timeout=timeout)
+                    if (not item.done and item.deadline is not None
+                            and time.time() >= item.deadline):
+                        if _expire_in_queue(
+                                self, item,
+                                f"deadline budget expired in convoy "
+                                f"queue ({self._surface})"):
+                            break
+                        continue  # claimed: ride out the convoy
                 if item.done:
                     break
-                batch = self._pending[: self._max_batch]
-                del self._pending[: len(batch)]
+                batch = _seal_pending(
+                    self, time.time(),
+                    f"deadline budget expired in convoy queue "
+                    f"({self._surface})")
                 if not batch:
                     continue  # taken by another leader but not done yet
                 self._busy = True
@@ -129,10 +198,19 @@ class BatchCoalescer:
                          item.apply_t0 - t_enq)
             record_stage(self._surface, "apply",
                          item.apply_t1 - item.apply_t0)
+            record_stage("lane:" + item.lane, "coalesce_wait",
+                         item.apply_t0 - t_enq)
+            _adm.CONTROLLER.note_wait(item.lane, item.apply_t0 - t_enq)
             attach_span("coalesce.wait", t_enq, item.apply_t0,
-                        surface=self._surface, batch=item.batch_size)
+                        surface=self._surface, batch=item.batch_size,
+                        lane=item.lane)
             attach_span("apply", item.apply_t0, item.apply_t1,
                         surface=self._surface, batch=item.batch_size)
+        if isinstance(item.error, _adm.DeadlineExceeded) \
+                and not item.apply_t1:
+            _adm.record_deadline_miss(self._surface, "queued",
+                                      item.lane)
+            raise item.error
         if item.error is not None:
             raise item.error
         return item.result
@@ -169,7 +247,7 @@ class BatchCoalescer:
 
 class _Item:
     __slots__ = ("value", "done", "result", "error", "apply_t0",
-                 "apply_t1", "batch_size")
+                 "apply_t1", "batch_size", "lane", "deadline", "t_enq")
 
     def __init__(self, value: Any):
         self.value = value
@@ -180,11 +258,16 @@ class _Item:
         self.apply_t0 = 0.0
         self.apply_t1 = 0.0
         self.batch_size = 0
+        # admission context captured at enqueue (ISSUE 15)
+        self.lane = _adm.LANE_INTERACTIVE
+        self.deadline: "float | None" = None
+        self.t_enq = 0.0
 
 
 class _Req:
     __slots__ = ("vec", "k", "extra", "done", "result", "error",
-                 "dispatch_t0", "dispatch_t1", "batch_size", "tier")
+                 "dispatch_t0", "dispatch_t1", "batch_size", "tier",
+                 "lane", "deadline", "t_enq", "early")
 
     def __init__(self, vec: np.ndarray, k: int, extra: Any = None):
         self.vec = vec
@@ -201,6 +284,15 @@ class _Req:
         # serving-tier verdict of the batch that answered this request
         # (leader consumes the dispatch path's audit.note_batch_tier)
         self.tier: Any = None
+        # admission context captured at enqueue (ISSUE 15): priority
+        # lane + absolute deadline budget — leaders seal batches in
+        # lane order and fail budget-expired riders fast
+        self.lane = _adm.LANE_INTERACTIVE
+        self.deadline: "float | None" = None
+        self.t_enq = 0.0
+        # the leader skipped the gather window because this rider's (or
+        # a batch-mate's) budget was tight — annotated on the trace
+        self.early = False
 
 
 class MicroBatcher:
@@ -264,34 +356,86 @@ class MicroBatcher:
     def search(self, vec: Sequence[float], k: int,
                extra: Any = None) -> List[Tuple[str, float]]:
         t_enq = time.time()
+        # admission context (ISSUE 15): the deadline budget minted at
+        # ingress and the caller's priority lane ride the request —
+        # a rider ALREADY past budget fails fast before it can occupy
+        # a queue slot, let alone a device one
+        dl = _adm.deadline()
+        lane = _adm.lane()
+        if dl is not None and t_enq >= dl:
+            _adm.record_deadline_miss(self._surface, "ingress", lane)
+            raise _adm.DeadlineExceeded(
+                f"deadline budget expired before enqueue "
+                f"({self._surface})")
         req = _Req(np.asarray(vec, np.float32), k, extra)
+        req.deadline, req.lane, req.t_enq = dl, lane, t_enq
         with self._cond:
             self._pending.append(req)
         while True:
             batch: List[_Req] = []
             with self._cond:
                 while not req.done and self._busy:
-                    self._cond.wait(timeout=30.0)
+                    timeout = 30.0
+                    if req.deadline is not None:
+                        timeout = min(
+                            timeout,
+                            max(req.deadline - time.time(), 0.0) + 1e-3)
+                    self._cond.wait(timeout=timeout)
+                    if (not req.done and req.deadline is not None
+                            and time.time() >= req.deadline):
+                        # budget expired while queued: leave the queue
+                        # instead of riding (and padding) a dispatch
+                        # whose answer nobody will read. A rider a
+                        # leader already claimed is no longer in
+                        # _pending — it rides out the in-flight batch.
+                        if _expire_in_queue(
+                                self, req,
+                                f"deadline budget expired in queue "
+                                f"({self._surface})"):
+                            break
+                        continue
                 if req.done:
                     break
+                if req.deadline is not None \
+                        and time.time() >= req.deadline:
+                    # would-be leader past budget: same fail-fast
+                    if _expire_in_queue(
+                            self, req,
+                            f"deadline budget expired in queue "
+                            f"({self._surface})"):
+                        break
+                    continue
                 # leader candidate: if the service just served a
                 # concurrent batch, give its returning clients one short
-                # window to re-enqueue before sealing this batch
+                # window to re-enqueue before sealing this batch —
+                # UNLESS a pending rider's remaining budget would expire
+                # inside the window: dispatch smaller NOW (the pow2
+                # buckets absorb the size change)
+                early = False
                 if (self._gather_window_s > 0.0
                         and self._last_batch >= 2
                         and len(self._pending)
                         < min(self._last_batch, self._max_batch)):
-                    self._cond.wait(timeout=self._gather_window_s)
-                    if req.done:
-                        break
-                    if self._busy:
-                        continue  # another thread led while we waited
+                    if self._deadline_tight_locked():
+                        early = True
+                    else:
+                        self._cond.wait(timeout=self._gather_window_s)
+                        if req.done:
+                            break
+                        if self._busy:
+                            continue  # another thread led while we waited
                 # idle and our request unserved: lead the next batch
-                batch = self._pending[: self._max_batch]
-                del self._pending[: len(batch)]
+                batch = _seal_pending(
+                    self, time.time(),
+                    f"deadline budget expired in queue "
+                    f"({self._surface})")
                 if not batch:
                     # taken by another leader but not done yet — loop
                     continue
+                if early:
+                    _EARLY_C.labels(self._surface).inc()
+                    for r in batch:
+                        r.early = True
                 _QUEUE_H.observe(len(self._pending))
                 self._busy = True
             try:
@@ -303,11 +447,24 @@ class MicroBatcher:
             if req.done:
                 break
             # our request was queued behind this batch — go again
+        if isinstance(req.error, _adm.DeadlineExceeded) \
+                and not req.dispatch_t1:
+            # failed fast without a dispatch: count the miss + one
+            # ledger/journal shed record in THIS rider's own trace
+            _adm.record_deadline_miss(self._surface, "queued", req.lane)
+            raise req.error
         if req.error is not None:
             self._trace_req(req, t_enq)
             raise req.error
         self._trace_req(req, t_enq)
         return req.result
+
+    def _deadline_tight_locked(self) -> bool:
+        """Any pending rider whose remaining budget would not survive
+        the gather window (with dispatch margin)? Caller holds _cond."""
+        horizon = time.time() + 4.0 * self._gather_window_s
+        return any(r.deadline is not None and r.deadline <= horizon
+                   for r in self._pending)
 
     def _trace_req(self, req: "_Req", t_enq: float) -> None:
         """Graft this request's coalescing story into the active trace
@@ -325,10 +482,30 @@ class MicroBatcher:
         record_stage(self._surface, "device_dispatch",
                      req.dispatch_t1 - req.dispatch_t0)
         record_stage(self._surface, "merge", t_done - req.dispatch_t1)
+        # lane-keyed queue-wait mirror (ISSUE 15): the same coalesce
+        # wait re-recorded under surface "lane:<lane>" so per-lane
+        # queueing is one /admin/telemetry query (bounded: 3 lanes),
+        # and fed to the admission controller as a MEASURED wait
+        # observation — the signal the shedding verdict gates on
+        record_stage("lane:" + req.lane, "coalesce_wait",
+                     req.dispatch_t0 - t_enq)
+        _adm.CONTROLLER.note_wait(req.lane, req.dispatch_t0 - t_enq)
+        wait_attrs: dict = {"surface": self._surface,
+                            "batch": req.batch_size, "lane": req.lane}
+        disp_attrs: dict = {"surface": self._surface,
+                            "batch": req.batch_size, "k": req.k}
+        if req.deadline is not None:
+            # the budget at the dispatch decision (ISSUE 15 acceptance:
+            # a trace shows the deadline at ingress, ring crossing and
+            # dispatch) — remaining ms when the leader sealed us in
+            disp_attrs["deadline_ms"] = round(
+                (req.deadline - req.dispatch_t0) * 1e3, 1)
+        if req.early:
+            disp_attrs["early_dispatch"] = True
         attach_span("coalesce.wait", t_enq, req.dispatch_t0,
-                    surface=self._surface, batch=req.batch_size)
+                    **wait_attrs)
         attach_span("device.dispatch", req.dispatch_t0, req.dispatch_t1,
-                    surface=self._surface, batch=req.batch_size, k=req.k)
+                    **disp_attrs)
         attach_span("merge", req.dispatch_t1, t_done)
         # rider-accurate serving-tier attribution (ISSUE 10): the tier
         # the leader consumed from the dispatch path stamps THIS
@@ -392,6 +569,14 @@ class MicroBatcher:
             # each request as its own single-row batch and deliver
             # errors only to the requests that actually own them
             for r in batch:
+                if r.deadline is not None and time.time() >= r.deadline:
+                    # the failed batch consumed this rider's budget:
+                    # don't burn a b=1 device dispatch on an answer
+                    # nobody will read
+                    r.error = _adm.DeadlineExceeded(
+                        f"deadline budget expired during replay "
+                        f"({self._surface})")
+                    continue
                 try:
                     kb = pow2_bucket(max(r.k, 1))
                     r.dispatch_t0 = time.time()

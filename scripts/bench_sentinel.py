@@ -81,6 +81,19 @@ CHECKS: Dict[str, Tuple] = {
     # gRPC knee — the wire plane must lift BOTH surfaces, so both gate
     "load_knee_qps_rest": ("qps", 0.2),
     "load_p99_at_load_ms": ("latency", 5.0),
+    # admission-control overload contract (round r15+, ISSUE 15): the
+    # served stream's p99 AT 1.2x the knee (relative latency ceiling
+    # vs the trajectory + the ABSOLUTE 5x-of-at-knee bound), goodput
+    # at 1.2x (qps floor vs trajectory + absolute >= 0.9x-of-knee
+    # ratio), and the honest-backpressure invariant: a run that shed
+    # anything may not have a single unacknowledged drop (a timeout is
+    # a silent drop; every unserved query owes an explicit
+    # 429/RESOURCE_EXHAUSTED)
+    "load_p99_at_1p2x_ms": ("latency", 5.0),
+    "load_goodput_at_1p2x": ("qps", 0.2),
+    "load_p99_bound_ratio_1p2x": ("bound", 5.0),
+    "load_goodput_ratio_1p2x": ("quality", 0.9, 0.1),
+    "load_unacked_with_shed_1p2x": ("bound", 0.0),
     # quantization ladder (round r08+): int8-rung serving qps floor
     # once a quant-carrying baseline exists; the WORST rung's recall@10
     # gates ABSOLUTELY from the first round it appears — compression
@@ -210,6 +223,28 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         _g(load, "surfaces", "qdrant_grpc_search", "closed_loop_qps"))
     out["load_closed_loop_qps_rest"] = _num(
         _g(load, "surfaces", "rest_search", "closed_loop_qps"))
+    # admission-control overload contract (round r15+, ISSUE 15): the
+    # summary packs [p99_at_1p2x_ms, goodput_at_1p2x,
+    # shed_fraction_1p2x, unacked_with_shed_1p2x,
+    # p99_bound_ratio_1p2x, goodput_ratio_1p2x] (fleet-pack
+    # precedent); the full artifact carries the named keys
+    ov = load.get("overload") or {}
+    if isinstance(ov, list):
+        pad = ov + [None] * 6
+        out["load_p99_at_1p2x_ms"] = _num(pad[0])
+        out["load_goodput_at_1p2x"] = _num(pad[1])
+        out["load_unacked_with_shed_1p2x"] = _num(pad[3])
+        out["load_p99_bound_ratio_1p2x"] = _num(pad[4])
+        out["load_goodput_ratio_1p2x"] = _num(pad[5])
+    else:
+        out["load_p99_at_1p2x_ms"] = _num(ov.get("p99_at_1p2x_ms"))
+        out["load_goodput_at_1p2x"] = _num(ov.get("goodput_at_1p2x"))
+        out["load_unacked_with_shed_1p2x"] = _num(
+            ov.get("unacked_with_shed_1p2x"))
+        out["load_p99_bound_ratio_1p2x"] = _num(
+            ov.get("p99_bound_ratio_1p2x"))
+        out["load_goodput_ratio_1p2x"] = _num(
+            ov.get("goodput_ratio_1p2x"))
     # shadow-parity verdicts (round r10+): worst rolling device/host
     # parity per contract class from the load stage's sampled audit
     out["shadow_parity_exact"] = _num(
@@ -323,10 +358,12 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
         f = fresh.get(metric)
         b = baseline.get(metric)
         kind = spec[0]
-        # quality floors are ABSOLUTE: they gate from the first round
-        # the metric exists, even before any trajectory run carries it
-        # (qps/growth checks are relative and need both sides)
-        if f is None or (b is None and kind != "quality"):
+        # quality floors and absolute bounds are ABSOLUTE: they gate
+        # from the first round the metric exists, even before any
+        # trajectory run carries it (qps/growth/latency checks are
+        # relative and need both sides)
+        if f is None or (b is None and kind not in ("quality",
+                                                    "bound")):
             skipped.append(metric)
             continue
         if kind == "qps":
@@ -366,6 +403,19 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
                     "metric": metric, "kind": "latency_ceiling",
                     "fresh": f, "baseline": b,
                     "ratio": round(f / b, 3), "tolerance": tol})
+            else:
+                passed.append(metric)
+        elif kind == "bound":
+            # ABSOLUTE ceiling (ISSUE 15): gates from the first round
+            # the metric exists, baseline or not — the admission
+            # contract is absolute ("p99 at 1.2x knee within 5x the
+            # at-knee p99"; "shed > 0 implies zero unacknowledged
+            # drops"), not a trajectory comparison
+            ceiling = overrides.get(metric, spec[1])
+            if f > ceiling + 1e-9:
+                flagged.append({
+                    "metric": metric, "kind": "absolute_bound",
+                    "fresh": f, "bound": ceiling})
             else:
                 passed.append(metric)
     # knee-vs-closed-loop ratio WARNINGS (round r11+): advisory only —

@@ -150,8 +150,10 @@ class TestCompactSummary:
     def test_headline_set_complete_and_small(self):
         line = json.dumps(bench._compact_summary(_fake_result()))
         # the driver keeps the LAST 2000 chars; the summary is the last
-        # line, so < 1800 leaves margin for real-run value widths
-        assert len(line) < 1800, f"summary too long for tail window: {len(line)}"
+        # line, so < 1900 leaves margin for real-run value widths (the
+        # r15 overload pack rides as a 6-element array for exactly
+        # this reason — named keys would blow the window)
+        assert len(line) < 1900, f"summary too long for tail window: {len(line)}"
         s = json.loads(line)
         assert s["summary"] is True
         assert s["metric"] == "ldbc_snb_cypher_geomean"
@@ -439,6 +441,33 @@ class TestBenchDryRunArtifactSchema:
                     for key in mix:
                         assert ":" in key, key
 
+        # admission-control overload sweep (ISSUE 15): 1.2x/1.5x the
+        # measured knee against the gRPC surface — p99-of-served,
+        # goodput, shed fraction (server counter bracket) and the
+        # honest-backpressure invariant must all be present. The
+        # ABSOLUTE acceptance ratios are None in tiny mode (0.25s
+        # windows are noise); the sentinel skips None.
+        ov = load["overload"]
+        assert ov["knee_qps"] == load["surfaces"][
+            "qdrant_grpc_search"]["knee_qps"]
+        assert set(ov["points"]) == {"1.2", "1.5"}
+        for pt in ov["points"].values():
+            assert pt["offered"] > 0
+            assert pt["goodput_qps"] == pt["achieved_qps"]
+            assert pt["shed"] >= 0 and 0 <= pt["shed_fraction"] <= 1
+            assert pt["unacked"] >= 0
+        assert "p99_at_1p2x_ms" in ov
+        assert "goodput_at_1p2x" in ov
+        assert ov["unacked_with_shed_1p2x"] == 0
+        assert ov["p99_bound_ratio_1p2x"] is None  # tiny: no ratios
+        assert ov["goodput_ratio_1p2x"] is None
+        # the scheduler verdict block rides the artifact
+        sched = full["load"]["scheduler"]
+        assert sched["posture"] in ("admit", "degrade", "shed",
+                                    "shed_hard")
+        assert set(sched["lanes"]) == {"interactive", "replay",
+                                       "background"}
+
         # multi-worker wire-plane sweep (ISSUE 11): tiny mode sweeps
         # worker counts {1, 2} (thread mode); each count carries both
         # surfaces' knee brief plus the batch-size distribution
@@ -491,6 +520,15 @@ class TestBenchDryRunArtifactSchema:
         assert set(summary["load"]["wire_knee_qps"]) == {"1", "2"}
         assert summary["load"]["wire_knee_qps"]["2"] is not None
         assert "wire_batch_mean" in summary["load"]
+        # admission overload contract (ISSUE 15): the summary packs
+        # [p99_at_1p2x, goodput_at_1p2x, shed_fraction, unacked,
+        # p99_bound_ratio, goodput_ratio] (ratios None in tiny mode)
+        ovp = summary["load"]["overload"]
+        assert len(ovp) == 6
+        assert ovp[0] is not None  # p99 at 1.2x measured
+        assert ovp[1] is not None  # goodput at 1.2x measured
+        assert ovp[3] == 0         # unacked_with_shed
+        assert ovp[4] is None and ovp[5] is None  # tiny: no ratios
         assert len(lines[-1]) < 2600
 
     def test_fleet_stage_schema(self, dry_run_lines):

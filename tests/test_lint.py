@@ -261,6 +261,39 @@ class TestDegradeContract:
         """)
         assert degrade_contract.run(tree) == []
 
+    def test_none_guard_idiom_resolves(self):
+        """ISSUE 15: the admission-hold pattern — ``hold = None`` plus
+        conditional literal assignments guarded by ``if hold is not
+        None`` — resolves to its literal values (the bare None arm is
+        the no-degrade path, skipped rather than unresolvable)."""
+        tree = _degrade_tree("""
+            from nornicdb_tpu.obs import audit as _audit
+
+            def gate(tier):
+                hold = None
+                if not _audit.tier_allowed(tier):
+                    hold = "underfill"
+                elif not _audit.admission_allows(tier):
+                    hold = "error"
+                if hold is not None:
+                    _audit.record_degrade("vector", tier, "brute", hold)
+        """)
+        assert degrade_contract.run(tree) == []
+
+    def test_none_guard_idiom_still_flags_unknown_literals(self):
+        tree = _degrade_tree("""
+            from nornicdb_tpu.obs import audit as _audit
+
+            def gate(tier):
+                hold = None
+                if tier:
+                    hold = "not_a_reason"
+                if hold is not None:
+                    _audit.record_degrade("vector", tier, "brute", hold)
+        """)
+        assert _rules(degrade_contract.run(tree)) == [
+            "unknown-degrade-reason"]
+
     def test_dynamic_reason_flagged_and_hatch_suppresses(self):
         tree = _degrade_tree("""
             from nornicdb_tpu.obs import audit as _audit

@@ -81,7 +81,11 @@ class TestTaxonomy:
             for t in tiers:
                 assert t in audit.ALL_TIERS
         for t in audit.ALL_TIERS:
-            if t in (audit.TIER_HOST, audit.TIER_CACHED):
+            if t in (audit.TIER_HOST, audit.TIER_CACHED,
+                     audit.TIER_SHED):
+                # host is the reference, cached is generation-fresh,
+                # shed never served an answer (ISSUE 15) — none carry
+                # a parity contract
                 continue
             exact = t in audit.EXACT_TIERS
             stat = t in audit.STATISTICAL_FLOORS
