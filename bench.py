@@ -231,6 +231,13 @@ def main(dry_run: bool = False):
         except Exception as exc:
             result["fleet"] = {
                 "error": f"{type(exc).__name__}: {exc}"[:400]}
+        # multi-process fleet (ISSUE 16): tiny 1-primary/2-subprocess
+        # topology — schema validation for scaling/parity/lag/trace
+        try:
+            result["fleet_proc"] = _bench_fleet_proc(tiny=True)
+        except Exception as exc:
+            result["fleet_proc"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:400]}
         result["tpu_proof"] = {"skipped": "dry-run"}
         print(json.dumps(result))
         sys.stdout.flush()
@@ -287,6 +294,16 @@ def main(dry_run: bool = False):
         result["fleet"] = _bench_fleet()
     except Exception as exc:
         result["fleet"] = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    # multi-process read fleet (ISSUE 16): replica subprocesses behind
+    # the router — out-of-GIL read scaling vs the primary's own HTTP
+    # surface, HTTP-ranked parity, replay lag over remote watermarks,
+    # and cross-process trace completeness (the propagated trace id
+    # must land in the serving child's own ring)
+    try:
+        result["fleet_proc"] = _bench_fleet_proc()
+    except Exception as exc:
+        result["fleet_proc"] = {
+            "error": f"{type(exc).__name__}: {exc}"[:400]}
     # one-shot TPU proof (VERDICT r3 task 3): the first session where
     # the tunnel is up must capture EVERYTHING the TPU claim rests on —
     # compiled (non-interpret) Pallas kernels, batched device kNN, and
@@ -522,6 +539,18 @@ def _compact_summary(result):
             g(result, "fleet", "replica_parity"),
             g(result, "fleet", "drain", "breached_drained"),
             g(result, "fleet", "trace_completeness"),
+        ],
+        # multi-process fleet (ISSUE 16), packed [fleet_read_qps,
+        # read_scaling, replica_parity, trace_completeness, cores] —
+        # cores rides along because the sentinel's scaling floor is
+        # core-aware (out-of-GIL parallelism needs real cores; a
+        # 1-core box gates collapse, not the 1.5x contract)
+        "fleet_proc": [
+            g(result, "fleet_proc", "fleet_read_qps"),
+            g(result, "fleet_proc", "read_scaling"),
+            g(result, "fleet_proc", "replica_parity"),
+            g(result, "fleet_proc", "trace_completeness"),
+            g(result, "fleet_proc", "cores"),
         ],
         "surfaces": surfaces,
         # what grpc-python can physically do on this box with this
@@ -1635,6 +1664,192 @@ def _bench_fleet(tiny: bool = False):
             drain_seqs and admit_seqs
             and min(drain_seqs) < max(admit_seqs))
         out["drain"] = out_drain
+        return out
+    except Exception as exc:  # noqa: BLE001 — stage isolation
+        out["error"] = f"{type(exc).__name__}: {exc}"[:400]
+        return out
+    finally:
+        if fleet is not None:
+            fleet.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_fleet_proc(tiny: bool = False):
+    """Multi-process read-fleet stage (ISSUE 16): 1 in-parent primary
+    + 2 REAL replica subprocesses (WAL streamed over the two-plane
+    socket transport) behind the router's RemoteReplica handles.
+    Measures (1) READ SCALING — closed-loop ``/nornicdb/search``
+    goodput through the fleet router (reads fan out-of-GIL across the
+    replica processes) vs the primary's own HTTP surface alone, with
+    admission sheds (429/503) counted separately, never as served;
+    (2) HTTP PARITY — ranked result ids from each replica's surface vs
+    the primary's surface for the same queries (absolute 1.0: a
+    replica serving different answers is a correctness bug); (3)
+    REPLAY LAG — peak replica lag under a primary write burst and the
+    drain time, observed over the remote /readyz watermark docs; (4)
+    TRACE COMPLETENESS — the fraction of traced routed reads whose
+    trace id shows up as a root span in the serving CHILD's own trace
+    ring (the propagated X-Nornic-Trace context crossed the process
+    boundary). ``cores`` rides the artifact: out-of-GIL scaling needs
+    real cores, so the sentinel's scaling floor is core-aware (a
+    1-core box gates collapse, not parallelism)."""
+    import shutil
+    import tempfile
+    import threading as _threading
+    import urllib.request as _urlreq
+
+    from nornicdb_tpu import obs as _obs
+    from nornicdb_tpu.api.fleet_router import RemoteReplica, ReplicaBusy
+    from nornicdb_tpu.replication.fleet_proc import ProcessReadFleet
+
+    n = 150 if tiny else 2000
+    secs = 0.2 if tiny else 3.0
+    burst = 60 if tiny else 800
+    n_threads = 4 if tiny else 8
+    n_probes = 6 if tiny else 16
+    limit = 10
+    words = ["alpha", "bravo", "charlie", "delta",
+             "echo", "foxtrot", "golf", "hotel"]
+    tmp = tempfile.mkdtemp(prefix="nornic-fleetproc-")
+    out = {"replicas": 2, "n": n, "cores": os.cpu_count() or 1}
+    fleet = None
+    try:
+        fleet = ProcessReadFleet(tmp, n_replicas=2,
+                                 heartbeat_interval=0.05,
+                                 auto_embed=True,
+                                 http_timeout_s=30.0)
+        db = fleet.primary_db
+        for i in range(n):
+            db.store(f"fleet doc {i} about {words[i % 8]} "
+                     f"topic {i % 31}", node_id=f"f{i}")
+        out["converged"] = bool(fleet.wait_converged(120.0))
+        fleet.admit_all_unchecked()
+        pids = sorted(p.pid for p in fleet.procs)
+        out["out_of_process"] = bool(
+            len(set(pids)) == 2 and os.getpid() not in pids)
+
+        # the primary's own HTTP surface through the same keep-alive
+        # client the router uses: the single-process baseline
+        primary = RemoteReplica("primary", fleet.primary_url,
+                                timeout_s=30.0)
+
+        # warm every surface past first-search compile/index-sync
+        # (the first query on a cold node ranks through the fallback
+        # tier — warmup is not optional for the parity gate)
+        for w in range(6):
+            q = {"query": f"warm {w} {words[w]}", "limit": limit}
+            primary.search(q)
+            for rem in fleet.remotes:
+                rem.search(q)
+
+        # HTTP parity: ranked ids, replica surface vs primary surface
+        agree, total = 0, 0
+        for i in range(n_probes):
+            q = {"query": f"{words[i % 8]} topic {i % 31}",
+                 "limit": limit}
+            want = [r["id"] for r in primary.search(q)["results"]]
+            for rem in fleet.remotes:
+                got = [r["id"] for r in rem.search(q)["results"]]
+                agree += int(got == want)
+                total += 1
+        out["replica_parity"] = round(agree / max(total, 1), 4)
+
+        # closed-loop goodput: sheds (429/503 admission verdicts and
+        # all-busy routing) are counted, never served
+        def measure(read_one):
+            ok = [0] * n_threads
+            shed = [0] * n_threads
+            err = [0] * n_threads
+            stop_at = time.time() + secs
+
+            def worker(t):
+                i = 0
+                while time.time() < stop_at:
+                    i += 1
+                    try:
+                        if read_one(t, i) is None:
+                            shed[t] += 1
+                        else:
+                            ok[t] += 1
+                    except ReplicaBusy:
+                        shed[t] += 1
+                    except Exception:  # noqa: BLE001
+                        err[t] += 1
+
+            threads = [_threading.Thread(target=worker, args=(t,))
+                       for t in range(n_threads)]
+            t0 = time.time()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            rate = sum(ok) / max(time.time() - t0, 1e-9)
+            return rate, sum(shed), sum(err)
+
+        single_qps, single_shed, single_err = measure(
+            lambda t, i: primary.search(
+                {"query": f"s{t}x{i} fleet doc", "limit": limit}))
+        fleet_qps, fleet_shed, fleet_err = measure(
+            lambda t, i: fleet.router.http_search(
+                {"query": f"r{t}x{i} fleet doc", "limit": limit}))
+        out["single_read_qps"] = round(single_qps, 1)
+        out["fleet_read_qps"] = round(fleet_qps, 1)
+        out["read_scaling"] = round(
+            fleet_qps / max(single_qps, 1e-9), 3)
+        out["sheds"] = {"single": single_shed, "fleet": fleet_shed}
+        out["errors"] = {"single": single_err, "fleet": fleet_err}
+
+        # replay lag under a primary write burst, observed the way a
+        # real operator would: over the remote /readyz watermark docs
+        t_burst = time.time()
+        for i in range(burst):
+            db.store(f"burst doc {i} {words[i % 8]}",
+                     node_id=f"bp{i}")
+        db._base.wal.flush()
+        target = db._base.wal.last_seq
+        peak_lag, drained_at = 0, None
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            seqs = []
+            for rem in fleet.remotes:
+                rem.ready_reasons()
+                seqs.append(rem.applied_seq() or 0)
+            peak_lag = max(peak_lag, target - min(seqs))
+            if min(seqs) >= target:
+                drained_at = time.time()
+                break
+            time.sleep(0.02)
+        out["replay_lag"] = {
+            "burst_ops": burst,
+            "peak_lag_ops": int(peak_lag),
+            "drain_s": (round(drained_at - t_burst, 3)
+                        if drained_at else None),
+        }
+
+        # cross-process trace completeness: every traced routed read's
+        # trace id must be adopted as a ROOT span by the serving child
+        # (checked in that child's own /admin/traces ring, right after
+        # the read so ring churn can't evict it)
+        found, probed = 0, 0
+        for i in range(n_probes):
+            with _obs.trace("fleet-proc-read") as span:
+                doc = fleet.router.http_search(
+                    {"query": f"t{i} {words[i % 8]} doc",
+                     "limit": limit})
+                tid = span.trace_id
+            if doc is None:
+                continue  # shed: nothing was served, nothing to trace
+            probed += 1
+            for proc in fleet.procs:
+                with _urlreq.urlopen(proc.base_url + "/admin/traces",
+                                     timeout=10) as resp:
+                    body = json.loads(resp.read())
+                if any(t.get("trace_id") == tid
+                       for t in body.get("traces", [])):
+                    found += 1
+                    break
+        out["trace_completeness"] = (
+            round(found / probed, 4) if probed else None)
         return out
     except Exception as exc:  # noqa: BLE001 — stage isolation
         out["error"] = f"{type(exc).__name__}: {exc}"[:400]

@@ -214,6 +214,11 @@ def _load_cfg() -> Dict[str, Any]:
         "burn_shed": env_float("ADMIT_BURN_SHED", 14.4),
         # posture recompute cadence (the per-request check reads cache)
         "interval_s": env_float("ADMIT_INTERVAL_MS", 100.0) / 1e3,
+        # fleet posture sharing (ISSUE 16): a peer-published posture
+        # older than this is ignored (and may be overwritten in the
+        # ring control block) — bounds how long a dead node's overload
+        # signal can pin the fleet
+        "fleet_posture_ttl_s": env_float("FLEET_POSTURE_TTL_S", 5.0),
     }
 
 
@@ -562,6 +567,15 @@ class AdmissionController:
         self.sheds = 0
         self._burn_fast = 0.0
         self._eff_max_wait = 0.05
+        # fleet posture sharing (ISSUE 16): the publisher pushes the
+        # LOCAL posture out (ring control block, metrics gauge); each
+        # source returns a peer-observed (level, age_s). The effective
+        # posture is max(local, freshest-remote) — hooks survive
+        # reset() because they encode topology, not load state.
+        self.posture_local = "admit"
+        self.posture_source = "local"
+        self._posture_publisher: Optional[Any] = None
+        self._posture_sources: List[Any] = []
 
     def reset(self) -> None:
         with self._lock:
@@ -577,6 +591,67 @@ class AdmissionController:
             self.sheds = 0
             self._burn_fast = 0.0
             self._eff_max_wait = cfg()["max_wait_s"]
+            self.posture_local = "admit"
+            self.posture_source = "local"
+            # publisher/sources deliberately survive: topology wiring
+
+    # -- fleet posture sharing (ISSUE 16) ------------------------------
+
+    def set_posture_publisher(self, fn: Optional[Any]) -> None:
+        """``fn(level:int)`` is called with the LOCAL posture level on
+        every posture evaluation (never the fleet-merged one — a node
+        must not echo a peer's overload back at the fleet)."""
+        with self._lock:
+            self._posture_publisher = fn
+
+    def add_posture_source(self, fn: Any) -> None:
+        """Register ``fn() -> (level:int, age_s:float) | None`` —
+        a peer-observed posture (the broker-ring control word, the
+        fleet aggregator's remote gauge sweep). Idempotent per
+        callable identity."""
+        with self._lock:
+            if fn not in self._posture_sources:
+                self._posture_sources.append(fn)
+
+    def remove_posture_source(self, fn: Any) -> None:
+        with self._lock:
+            try:
+                self._posture_sources.remove(fn)
+            except ValueError:
+                pass
+
+    def clear_posture_publisher(self, fn: Any = None) -> None:
+        """Drop the publisher — only if it is ``fn`` when one is given
+        (a stopping ring endpoint must not unhook a replacement)."""
+        with self._lock:
+            if fn is None or self._posture_publisher == fn:
+                self._posture_publisher = None
+
+    def _merge_fleet_posture(self, local_level: int,
+                             ttl_s: float) -> Tuple[int, str]:
+        """(effective level, source tag): the max of the local verdict
+        and every FRESH peer-published level. Failing sources
+        contribute nothing — posture must never fail a request."""
+        with self._lock:
+            pub = self._posture_publisher
+            sources = list(self._posture_sources)
+        if pub is not None:
+            try:
+                pub(local_level)
+            except Exception:  # noqa: BLE001 — publish is best-effort
+                pass
+        eff, src = local_level, "local"
+        for fn in sources:
+            try:
+                res = fn()
+            except Exception:  # noqa: BLE001 — a dead peer feed is not overload
+                continue
+            if not res:
+                continue
+            level, age = res
+            if age <= ttl_s and int(level) > eff:
+                eff, src = int(level), "fleet"
+        return eff, src
 
     # -- accounting ----------------------------------------------------
 
@@ -710,19 +785,30 @@ class AdmissionController:
             posture = "shed"
         if est_wait > max_wait * 4 or it_in > c["max_queue"] * 2:
             posture = "shed_hard"
+        # fleet merge (ISSUE 16): publish the local verdict, then let a
+        # FRESH peer-published posture tighten (never loosen) it — the
+        # whole fleet sheds together instead of funneling the load one
+        # worker at a time into the overloaded one
+        local_posture = posture
+        eff_level, src = self._merge_fleet_posture(
+            POSTURES.index(posture), c["fleet_posture_ttl_s"])
+        posture = POSTURES[min(eff_level, len(POSTURES) - 1)]
         with self._lock:
             self._eff_max_wait = max_wait
             self._burn_fast = burn
+            self.posture_local = local_posture
+            self.posture_source = src
             if posture != self.posture:
                 prev, self.posture = self.posture, posture
                 self.posture_since = time.time()
             else:
                 prev = None
         if prev is not None:
-            _POSTURE_G.set(float(POSTURES.index(posture)))
+            _POSTURE_G.set(float(POSTURES.index(local_posture)))
             _events.record_event(
                 "posture", reason=posture,
-                detail={"from": prev, "burn_fast": round(burn, 2),
+                detail={"from": prev, "source": src,
+                        "burn_fast": round(burn, 2),
                         "interactive_inflight": it_in,
                         "est_wait_ms": (round(est_wait * 1e3, 1)
                                         if est_wait != float("inf")
@@ -816,9 +902,16 @@ class AdmissionController:
                 sheds[":".join(key)] = child.value
         return {
             "posture": self.posture,
+            "posture_local": self.posture_local,
+            "posture_source": self.posture_source,
             "posture_since": round(self.posture_since, 3),
             "burn_fast": round(self._burn_fast, 3),
             "shed_enabled": c["shed_enabled"],
+            "fleet": {
+                "publisher": self._posture_publisher is not None,
+                "sources": len(self._posture_sources),
+                "ttl_s": c["fleet_posture_ttl_s"],
+            },
             "lanes": lanes,
             "deadline": {
                 "defaults_ms": {k: round(v * 1e3, 1)
@@ -852,11 +945,16 @@ def retry_after_s(lane_name: str = LANE_INTERACTIVE) -> float:
 
 
 def _collect() -> None:
-    # scrape-time lane gauges (PR 5 collector discipline)
+    # scrape-time lane gauges (PR 5 collector discipline). The posture
+    # gauge carries the LOCAL posture — it is the cross-node
+    # propagation carrier (obs/fleet.py sweeps it off peer state
+    # dumps), so publishing the fleet-merged value would echo a peer's
+    # overload back at the fleet forever.
     with CONTROLLER._lock:
         for ln in LANES:
             _LANE_IN_G.labels(ln).set(
                 float(CONTROLLER._inflight.get(ln, 0)))
+        _POSTURE_G.set(float(POSTURES.index(CONTROLLER.posture_local)))
 
 
 REGISTRY.add_collector(_collect)

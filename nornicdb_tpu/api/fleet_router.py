@@ -34,8 +34,12 @@ its writes funnel to the one primary.
 
 Read consistency is *bounded staleness*: a replica may trail the
 primary by at most the lag threshold, and drains rather than serve
-staler answers. Read-your-writes callers must read the primary
-(docs/replication.md).
+staler answers. Read-your-writes callers use leader leases (ISSUE 16):
+``refresh_leases`` grants a time-bounded lease
+(``NORNICDB_FLEET_LEASE_MS``) to every replica proven at the primary's
+watermark, and ``pick_fresh`` routes to a lease holder with only a
+local watermark read — no per-read replica round-trip; when no lease
+holds, the caller reads the primary (docs/replication.md runbook).
 """
 
 from __future__ import annotations
@@ -67,6 +71,15 @@ _ADMITTED_G = REGISTRY.gauge(
     "nornicdb_replica_admitted",
     "1 while a replica is admitted and in the read rotation",
     labels=("node",))
+_LEASE_G = REGISTRY.gauge(
+    "nornicdb_fleet_lease_active",
+    "1 while a replica holds an unexpired leader lease at the "
+    "primary's watermark (read-your-writes routing)",
+    labels=("node",))
+_LEASE_READS_C = REGISTRY.counter(
+    "nornicdb_fleet_lease_reads_total",
+    "Read-your-writes reads served by a lease-holding replica without "
+    "a primary round-trip", labels=("node",))
 
 # QdrantCompat read surface; writes (upserts, deletes, collection DDL,
 # alias updates, snapshots) always hit the primary
@@ -76,12 +89,21 @@ _READ_COMPAT = frozenset({
 })
 
 
+class ReplicaBusy(RuntimeError):
+    """A replica answered 429/503: alive, admission-shedding or
+    momentarily not ready. Routing tries the next node — a busy
+    verdict must never open a drain episode (ISSUE 16: admission
+    posture and drain bookkeeping are separate control loops)."""
+
+
 class FleetRouter:
     """Round-robin read routing over admitted+ready replicas, primary
     fallback, drain bookkeeping, and the promotion pivot."""
 
     def __init__(self, primary_db, check_interval_s: float = 0.05,
                  max_lag_ops: Optional[int] = None):
+        from nornicdb_tpu.config import env_float
+
         self.primary_db = primary_db
         self._check_interval_s = check_interval_s
         self._max_lag_ops = max_lag_ops  # None -> env per check
@@ -95,6 +117,14 @@ class FleetRouter:
         # materialized counter children — the read hot path must not
         # pay a labels() probe per query (audit.py precedent)
         self._count_cache: Dict[Any, Any] = {}
+        # leader leases (ISSUE 16): name -> {"watermark", "expires"}.
+        # Knobs read ONCE here — pick_fresh is per-request and must not
+        # touch the environment (lint HOT_PATHS discipline).
+        self._lease_s = env_float("FLEET_LEASE_MS", 400.0) / 1e3
+        self._lease_refresh_s = env_float(
+            "FLEET_LEASE_REFRESH_MS", 100.0) / 1e3
+        self._leases: Dict[str, Dict[str, float]] = {}
+        self._lease_refreshed_at = 0.0
 
     # -- membership ------------------------------------------------------
 
@@ -116,8 +146,11 @@ class FleetRouter:
         with self._lock:
             self._replicas.pop(name, None)
             self._state.pop(name, None)
+            had_lease = self._leases.pop(name, None) is not None
             if name in self._order:
                 self._order.remove(name)
+        if had_lease:
+            _LEASE_G.labels(name).set(0.0)
 
     def replicas(self) -> List[str]:
         with self._lock:
@@ -226,20 +259,17 @@ class FleetRouter:
             reason = f"unreachable:{name}:{type(exc).__name__}"
         ready = reason is None
 
-        def _key(r):
-            # stable identity of a drain reason: replica_lag embeds the
-            # LIVE lag value ("replica_lag:r0(517/512)"), so comparing
-            # full strings would re-record a "transition" every check
-            # while the lag drifts — one sustained drain, one record
-            return None if r is None else r.split("(", 1)[0]
-
         with self._lock:
             # state transition under the lock so two racing reads can
             # never double-record the same drain in the ledger
             prev = st.get("drain")
             if st["checked_at"] > now:
                 return st["ready"]  # a racer already re-checked
-            transition_down = not ready and _key(prev) != _key(reason)
+            # one drain EPISODE, one record: the reason may drift while
+            # the replica stays down (replica_lag embeds the live lag
+            # value; a killed subprocess goes error -> unreachable),
+            # but only the healthy->drained edge is ledgered
+            transition_down = not ready and prev is None
             transition_up = ready and prev is not None
             st["drain"] = reason
             st["ready"] = ready
@@ -261,11 +291,13 @@ class FleetRouter:
             _ADMITTED_G.labels(name).set(1.0 if admitted else 0.0)
         return ready
 
-    def pick_read(self, need_vec: bool = False):
+    def pick_read(self, need_vec: bool = False, need_db: bool = False):
         """The replica the next read should hit, or None (serve from
         the primary). Round-robin over admitted+ready replicas;
         ``need_vec`` skips handles without an in-process raw-embedding
-        dispatch (RemoteReplica) instead of draining them."""
+        dispatch (RemoteReplica) instead of draining them, ``need_db``
+        skips handles without an in-process DB facade (the routed
+        search/compat facades call straight into ``replica.db``)."""
         with self._lock:
             order = list(self._order)
             start = self._rr
@@ -280,6 +312,8 @@ class FleetRouter:
                 continue
             if need_vec and not getattr(replica, "supports_vec", True):
                 continue
+            if need_db and getattr(replica, "db", None) is None:
+                continue
             if st.get("drain") == "replica_parity":
                 continue
             if self._check_ready(name, replica, st):
@@ -290,6 +324,166 @@ class FleetRouter:
         """Admission/drain snapshot per replica (admin surface, bench)."""
         with self._lock:
             return {name: dict(st) for name, st in self._state.items()}
+
+    # -- leader leases (ISSUE 16) ----------------------------------------
+
+    def _primary_watermark(self) -> int:
+        """The primary's WAL last_seq (local read — the router runs in
+        the primary's process), or -1 when the primary has no WAL."""
+        try:
+            return int(self.primary_db._base.wal.last_seq)
+        except Exception:  # noqa: BLE001 — non-WAL primary
+            return -1
+
+    def _applied_seq_of(self, replica) -> Optional[int]:
+        """A replica's applied watermark: in-process handles read their
+        standby directly; remote handles answer from their last /readyz
+        watermark doc (refreshed by the probe the lease cadence pays)."""
+        st = getattr(replica, "standby", None)
+        if st is not None:
+            return int(st.applied_seq)
+        fn = getattr(replica, "applied_seq", None)
+        if callable(fn):
+            try:
+                seq = fn()
+                return None if seq is None else int(seq)
+            except Exception:  # noqa: BLE001
+                return None
+        return None
+
+    def refresh_leases(self) -> Dict[str, bool]:
+        """Grant/renew a lease to every admitted+ready replica whose
+        applied watermark has reached the primary's current last_seq;
+        revoke holders that fell behind. One refresh probes each
+        replica once — the round-trip the per-read lease check then
+        avoids. Transitions (grant after no lease, lapse after a live
+        one) journal exactly once."""
+        now = time.time()
+        wm = self._primary_watermark()
+        if wm < 0:
+            return {}
+        with self._lock:
+            items = [(n, self._replicas[n], self._state[n])
+                     for n in self._order if n in self._replicas]
+        verdicts: Dict[str, bool] = {}
+        for name, replica, st in items:
+            holds = False
+            if st["admitted"] and self._check_ready(name, replica, st):
+                applied = self._applied_seq_of(replica)
+                holds = applied is not None and applied >= wm
+            with self._lock:
+                prev = self._leases.get(name)
+                had = prev is not None and prev["expires"] > now
+                if holds:
+                    self._leases[name] = {"watermark": float(wm),
+                                          "expires": now + self._lease_s}
+                else:
+                    self._leases.pop(name, None)
+            verdicts[name] = holds
+            if holds and not had:
+                _LEASE_G.labels(name).set(1.0)
+                _events.record_event(
+                    "lease_grant", node=name, surface="fleet",
+                    reason="at_watermark", detail={"watermark": wm})
+            elif had and not holds:
+                _LEASE_G.labels(name).set(0.0)
+                _events.record_event(
+                    "lease_lapse", node=name, surface="fleet",
+                    reason="behind_watermark", detail={"watermark": wm})
+        return verdicts
+
+    def lease_state(self) -> Dict[str, Dict[str, float]]:
+        """Live lease table (admin surface, tests); expired entries are
+        reported but not pruned — pruning is refresh_leases' job."""
+        with self._lock:
+            return {n: dict(v) for n, v in self._leases.items()}
+
+    def pick_fresh(self):
+        """Read-your-writes routing: a replica holding an unexpired
+        lease at (or past) the primary's CURRENT watermark, or None
+        (the caller must read the primary). The per-read cost is a
+        local watermark read + the lease-table lookup — no replica
+        round-trip; the probe that proved the replica's watermark was
+        paid once by refresh_leases on its own cadence. A write that
+        landed after the grant moves the watermark past the lease and
+        invalidates it naturally."""
+        now = time.time()
+        for attempt in (0, 1):
+            wm = self._primary_watermark()
+            with self._lock:
+                order = list(self._order)
+                start = self._rr
+                self._rr += 1
+            n = len(order)
+            for i in range(n):
+                name = order[(start + i) % n]
+                with self._lock:
+                    lease = self._leases.get(name)
+                    replica = self._replicas.get(name)
+                    st = self._state.get(name)
+                if (replica is None or st is None
+                        or not st["admitted"]):
+                    continue
+                if lease is None or lease["expires"] <= now:
+                    continue
+                if wm >= 0 and lease["watermark"] < wm:
+                    continue  # a newer write outran the lease
+                if self._check_ready(name, replica, st):
+                    key = ("l", name)
+                    child = self._count_cache.get(key)
+                    if child is None:
+                        child = self._count_cache[key] = \
+                            _LEASE_READS_C.labels(name)
+                    child.inc()
+                    return replica
+            # miss: refresh at most once per refresh window, then retry
+            if attempt == 0 and \
+                    now - self._lease_refreshed_at >= self._lease_refresh_s:
+                self._lease_refreshed_at = now
+                self.refresh_leases()
+                continue
+            break
+        return None
+
+    # -- HTTP-level read dispatch (multi-process fleets) ------------------
+
+    def http_search(self, payload: Dict[str, Any],
+                    read_your_writes: bool = False):
+        """Fleet-routed ``POST /nornicdb/search`` over remote node
+        handles (out-of-GIL serving). Returns the response doc, or
+        None when no remote replica can serve (the caller reads the
+        primary). ``read_your_writes`` restricts routing to
+        lease-holding replicas at the primary's watermark."""
+        if read_your_writes:
+            replica = self.pick_fresh()
+            if replica is None or getattr(replica, "search", None) is None:
+                return None
+            candidates = [replica]
+        else:
+            # on a busy (shedding) node, try the next one — up to one
+            # full rotation; a busy verdict never drains
+            with self._lock:
+                n = len(self._order)
+            candidates = []
+            for _ in range(max(n, 1)):
+                r = self.pick_read()
+                if r is None or any(r is c for c in candidates):
+                    break
+                candidates.append(r)
+        for replica in candidates:
+            search = getattr(replica, "search", None)
+            if search is None:
+                return None  # in-process handle: use routed_search()
+            try:
+                doc = search(payload)
+            except ReplicaBusy:
+                continue  # admission shed, not a failure
+            except Exception:  # noqa: BLE001 — degrade, never fail
+                self._drain_error(replica.name)
+                return None
+            self._note_served(replica.name, "http")
+            return doc
+        return None
 
     # -- read dispatch ---------------------------------------------------
 
@@ -369,6 +563,14 @@ class FleetRouter:
             if st is not None:
                 st["admitted"] = False
                 st["drain"] = f"promoted:{replica.name}"
+            # leases were granted against the OLD primary's watermark;
+            # none of them may serve read-your-writes under the new one
+            lapsed = list(self._leases)
+            self._leases.clear()
+        for name in lapsed:
+            _LEASE_G.labels(name).set(0.0)
+            _events.record_event("lease_lapse", node=name,
+                                 surface="fleet", reason="failover")
         _ADMITTED_G.labels(replica.name).set(0.0)
         _events.record_event("failover", node=replica.name,
                              surface="fleet", reason="router_repointed")
@@ -386,7 +588,7 @@ class RoutedSearch:
         return self._router.primary_db.search
 
     def search(self, **kwargs):
-        r = self._router.pick_read()
+        r = self._router.pick_read(need_db=True)
         if r is not None:
             try:
                 out = r.db.search.search(**kwargs)
@@ -399,7 +601,7 @@ class RoutedSearch:
     def vector_search_candidates(self, query_vec, k: int = 10,
                                  exact: bool = False,
                                  lexical_doc_ids=None):
-        r = self._router.pick_read()
+        r = self._router.pick_read(need_db=True)
         if r is not None:
             try:
                 out = r.db.search.vector_search_candidates(
@@ -435,7 +637,7 @@ class RoutedCompat:
         router = self._router
 
         def routed(*args, **kwargs):
-            r = router.pick_read()
+            r = router.pick_read(need_db=True)
             if r is not None:
                 try:
                     out = getattr(r.db.qdrant_compat, name)(
@@ -457,30 +659,82 @@ class RoutedCompat:
 
 
 class RemoteReplica:
-    """A replica on another host, addressed over its REST surface:
-    ``/readyz`` is the health signal (the replica's own lag/catch-up/
-    rebuild verdict — exactly what a load balancer would probe), and
-    the qdrant/native read routes serve the reads the router sends.
-    Raw-embedding coalesced dispatch (``vec_dispatch``) is an
-    in-process capability; the router's vec path simply skips remote
-    handles (KeyError -> primary fallback)."""
+    """A replica on another host — the real multi-process node handle
+    (ISSUE 16): ``/readyz`` is the health signal AND the watermark
+    probe (its ``replica`` doc carries applied_seq/epoch/lag for the
+    router's lease grants), ``/nornicdb/search`` serves the reads the
+    router sends (out-of-GIL), and ``/admin/fleet/state`` feeds the
+    fleet telemetry aggregator. Raw-embedding coalesced dispatch
+    (``vec_dispatch``) stays an in-process capability; the router's vec
+    path simply skips remote handles (KeyError -> primary fallback)."""
 
     # no in-process raw-embedding ring: the router's vec path skips
     # remote handles (pick_read(need_vec=True)) instead of draining
     supports_vec = False
+    # no in-process DB facade: the routed search/compat facades skip
+    # remote handles (pick_read(need_db=True)); HTTP-level reads route
+    # through FleetRouter.http_search instead
+    db = None
 
     def __init__(self, name: str, base_url: str, timeout_s: float = 2.0,
                  auth: Optional[str] = None):
+        import threading as _threading
+        from urllib.parse import urlsplit
+
         self.name = str(name)
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.auth = auth
         self.closed = False
+        parts = urlsplit(self.base_url)
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or 80
+        # persistent keep-alive connections, one per router thread: a
+        # fresh urllib connection per read costs the TCP handshake AND
+        # a ~40ms Nagle/delayed-ACK stall (the POST goes out as two
+        # writes — headers, then body — and the body segment waits out
+        # the server's delayed ACK). Measured: ~58ms -> ~3ms per
+        # routed read on loopback.
+        self._tls = _threading.local()
+        # last /readyz watermark doc — refreshed by every ready probe,
+        # consumed by applied_seq()/lag_ops() (lease grants, convergence
+        # waits) without a second round-trip
+        self._watermark: Dict[str, Any] = {}
+
+    def _conn(self):
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            import http.client
+            import socket as _socket
+
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self.timeout_s)
+            conn.connect()
+            conn.sock.setsockopt(_socket.IPPROTO_TCP,
+                                 _socket.TCP_NODELAY, 1)
+            self._tls.conn = conn
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._tls, "conn", None)
+        self._tls.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def close(self) -> None:
+        self.closed = True
+        self._drop_conn()
 
     def _request(self, method: str, path: str,
                  payload: Optional[Dict[str, Any]] = None):
+        """One round-trip on this thread's keep-alive connection.
+        Returns ``(status, doc)`` for EVERY HTTP status (no exception
+        on 4xx/5xx — /readyz 503 bodies carry the watermark doc);
+        raises only on transport failure."""
         import json as _json
-        import urllib.request
 
         headers = {"Content-Type": "application/json",
                    **({"Authorization": self.auth} if self.auth
@@ -491,34 +745,84 @@ class RemoteReplica:
         packed = _tracing.pack_context(_tracing.trace_context())
         if packed:
             headers[_tracing.TRACE_HEADER] = packed
-        req = urllib.request.Request(
-            self.base_url + path, method=method,
-            data=(None if payload is None
-                  else _json.dumps(payload).encode("utf-8")),
-            headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            return resp.status, _json.loads(resp.read() or b"{}")
+        body = (None if payload is None
+                else _json.dumps(payload).encode("utf-8"))
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()  # drain fully so keep-alive reuses
+                status = resp.status
+                break
+            except Exception:  # noqa: BLE001
+                # a server-side idle close surfaces as BadStatusLine /
+                # ECONNRESET on a REUSED connection: retry once on a
+                # fresh one (all fleet routes are idempotent reads); a
+                # genuinely dead node raises through
+                self._drop_conn()
+                if attempt:
+                    raise
+        return status, _json.loads(data or b"{}")
 
     def ready_reasons(self, max_lag_ops: Optional[int] = None
                       ) -> List[str]:
-        import urllib.error
-
         try:
             status, doc = self._request("GET", "/readyz")
-        except urllib.error.HTTPError as e:
-            import json as _json
-
-            try:
-                doc = _json.loads(e.read() or b"{}")
-            except Exception:  # noqa: BLE001
-                doc = {}
-            return list(doc.get("reasons")
-                        or [f"degraded:{self.name}({e.code})"])
         except Exception as exc:  # noqa: BLE001
             return [f"unreachable:{self.name}:{type(exc).__name__}"]
+        self._note_watermark(doc)
         if status != 200:
-            return list(doc.get("reasons") or [f"degraded:{self.name}"])
+            return list(doc.get("reasons")
+                        or [f"degraded:{self.name}({status})"])
         return []
+
+    def _note_watermark(self, doc: Dict[str, Any]) -> None:
+        rep = doc.get("replica") if isinstance(doc, dict) else None
+        if isinstance(rep, dict):
+            self._watermark = rep
+
+    def applied_seq(self) -> Optional[int]:
+        """Applied watermark from the node's /readyz replica doc —
+        probing first so a lease grant never trusts a stale cache."""
+        self.ready_reasons()
+        seq = self._watermark.get("applied_seq")
+        return None if seq is None else int(seq)
+
+    def lag_ops(self) -> Optional[int]:
+        lag = self._watermark.get("lag_ops")
+        return None if lag is None else int(lag)
+
+    def epoch(self) -> Optional[int]:
+        if "epoch" not in self._watermark:
+            self.ready_reasons()  # fresh handle: probe before answering
+        ep = self._watermark.get("epoch")
+        return None if ep is None else int(ep)
+
+    def search(self, payload: Dict[str, Any]):
+        """POST /nornicdb/search on the remote node — the real read
+        path of the multi-process fleet (served out of this process's
+        GIL, trace context propagated via X-Nornic-Trace). Returns the
+        response doc; raises on transport/HTTP errors (the router
+        drains on that)."""
+        status, doc = self._request("POST", "/nornicdb/search", payload)
+        if status in (429, 503):
+            raise ReplicaBusy(
+                f"replica {self.name} search -> {status}")
+        if status >= 400:
+            raise RuntimeError(
+                f"replica {self.name} search -> {status}")
+        return doc
+
+    def state(self):
+        """GET /admin/fleet/state — the jsonable metric state the fleet
+        aggregator merges (obs/fleet.http_state_source uses the same
+        route)."""
+        status, doc = self._request("GET", "/admin/fleet/state")
+        if status >= 400:
+            raise RuntimeError(
+                f"replica {self.name} state -> {status}")
+        return doc
 
     def rebuild_in_flight(self) -> bool:
         return False  # folded into the remote /readyz verdict

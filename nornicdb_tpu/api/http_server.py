@@ -789,6 +789,7 @@ class HttpServer:
         # endpoint) stops sending it reads instead of letting it serve
         # answers staler than the documented bound
         fleet = getattr(self.db, "fleet_node", None)
+        replica_doc: Optional[Dict[str, Any]] = None
         if fleet is not None:
             checks["replica"] = 1
             checks["replica_not_ready"] = 0
@@ -802,6 +803,22 @@ class HttpServer:
                 # keep taking reads it can no longer prove fresh
                 checks["replica_not_ready"] += 1
                 reasons.append("replica_state_unknown")
+            # watermark truth for remote probers (ISSUE 16): the fleet
+            # router's lease grants and lag checks read this node's
+            # applied seq/epoch off the same probe that carries the
+            # ready verdict — no second round-trip
+            st = getattr(fleet, "standby", None)
+            if st is not None:
+                try:
+                    replica_doc = {
+                        "node": fleet.name,
+                        "applied_seq": int(st.applied_seq),
+                        "lag_ops": int(st.lag_ops()),
+                        "epoch": int(st.epoch),
+                        "catching_up": bool(st.catching_up),
+                    }
+                except Exception:  # noqa: BLE001 — probe stays best-effort
+                    replica_doc = None
         # keep the SLO sample ring warm from the probe cadence (the
         # engine is scrape-driven; kubelet-style periodic readiness
         # probes give it a steady clock even with /metrics unscraped)
@@ -810,9 +827,15 @@ class HttpServer:
         except Exception:
             pass
         if reasons:
-            return 503, {"status": "degraded", "reasons": sorted(reasons),
-                         "checks": checks}
-        return 200, {"status": "ready", "checks": checks}
+            doc = {"status": "degraded", "reasons": sorted(reasons),
+                   "checks": checks}
+            if replica_doc is not None:
+                doc["replica"] = replica_doc
+            return 503, doc
+        doc = {"status": "ready", "checks": checks}
+        if replica_doc is not None:
+            doc["replica"] = replica_doc
+        return 200, doc
 
     def _debug_profile(self, payload: Dict[str, Any]) -> Tuple[int, Any]:
         """Run one Cypher statement under cProfile; return wall time and

@@ -767,6 +767,10 @@ class WireWorker:
         self.spec = spec
         self.worker_id = int(spec["worker_id"])
         self.client = BrokerClient(spec["broker"])
+        # fleet admission posture (ISSUE 16): this worker's controller
+        # publishes into / reads back the ring posture word — one
+        # overloaded worker tightens every frontend's verdict
+        self.client.bind_admission()
         self.worker_db = _WorkerDB(self.client)
         self.grpc = None
         self.http = None
@@ -1043,6 +1047,9 @@ class WirePlane:
                      "db": db, "plane": self._plane_ops},
             n_workers=self.workers, slot_bytes=slot_bytes)
         self._timeout_s = timeout_s
+        # the device plane's controller shares the same posture word
+        # as the wire workers (ISSUE 16)
+        self.broker.bind_admission()
         obs.register_resource("queue", "broker", self.broker)
         # write-generation mirrors: worker wire caches validate against
         # shared memory instead of a broker round trip

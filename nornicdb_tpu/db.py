@@ -266,7 +266,17 @@ class DB:
         from nornicdb_tpu.replication.replicator import decode_op_args
         from nornicdb_tpu.storage.wal_engine import WALEngine
 
-        transport = ClusterTransport(cfg.node_id, cfg.listen)
+        if getattr(cfg, "data_listen", None) is not None:
+            # two-plane endpoint (ISSUE 16): heartbeats/fences on the
+            # control channel, WAL batches and snapshot ships on a
+            # separate bulk socket so replication volume never delays
+            # failure detection
+            from nornicdb_tpu.replication.transport import DualPlaneTransport
+
+            transport = DualPlaneTransport(
+                cfg.node_id, cfg.listen, cfg.data_listen)
+        else:
+            transport = ClusterTransport(cfg.node_id, cfg.listen)
         transport.start()
         self._cluster_transport = transport
         if cfg.mode == "multi_region":

@@ -72,6 +72,18 @@ HOT_PATHS = (
     # fleet read routing — once per read
     ("nornicdb_tpu/api/fleet_router.py", "FleetRouter.pick_read"),
     ("nornicdb_tpu/api/fleet_router.py", "RoutedSearch.search"),
+    # multi-process fleet hot paths (ISSUE 16) — http_search routes
+    # once per read; _request runs once per remote hop; the frame
+    # codecs run once per streamed WAL message. Lease/posture knobs
+    # are read once at __init__ and cached.
+    ("nornicdb_tpu/api/fleet_router.py", "FleetRouter.http_search"),
+    ("nornicdb_tpu/api/fleet_router.py", "FleetRouter.pick_fresh"),
+    ("nornicdb_tpu/api/fleet_router.py", "RemoteReplica._request"),
+    ("nornicdb_tpu/api/fleet_router.py", "RemoteReplica.search"),
+    ("nornicdb_tpu/replication/transport.py", "read_frame"),
+    ("nornicdb_tpu/replication/transport.py", "write_frame"),
+    ("nornicdb_tpu/replication/transport.py",
+     "DualPlaneTransport.request"),
     # admission actuator (ISSUE 15) — deadline mint + verdict run once
     # per request on every ingress; config is cached at first use and
     # these must never read the environment

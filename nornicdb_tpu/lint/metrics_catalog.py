@@ -49,6 +49,12 @@ IMPORT_TIME_MODULES = (
     "nornicdb_tpu.api.qdrant_official_grpc",
     "nornicdb_tpu.api.fleet_router",       # read-fleet router (ISSUE 12)
     "nornicdb_tpu.replication.read_fleet",  # replica lag/failover gauges
+    # ISSUE 16: the multi-process fleet modules register no families of
+    # their own *today*, but they carry the streaming/posture hot paths
+    # — importing them here means any family they grow is caught by
+    # this lint the moment it appears, not when the docs drift.
+    "nornicdb_tpu.replication.transport",   # dual-plane WAL streaming
+    "nornicdb_tpu.replication.fleet_proc",  # subprocess replica fleet
 )
 
 _PREFIX = "nornicdb_"

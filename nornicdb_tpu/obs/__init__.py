@@ -72,6 +72,7 @@ from nornicdb_tpu.obs.events import (
 )
 from nornicdb_tpu.obs.fleet import (
     fleet_summary,
+    http_state_source,
     register_source as register_fleet_source,
     unregister_source as unregister_fleet_source,
 )
@@ -132,6 +133,7 @@ __all__ = [
     "export_span",
     "fleet",
     "fleet_summary",
+    "http_state_source",
     "get_registry",
     "get_slo_engine",
     "latency_summary",

@@ -60,6 +60,9 @@ KINDS: Tuple[str, ...] = (
     "shed",             # admission rejected a query (429/exhausted)
                         # or failed it fast past its deadline budget
     "posture",          # the admission posture transitioned
+    "lease_grant",      # a replica at the primary watermark was leased
+                        # for read-your-writes routing (ISSUE 16)
+    "lease_lapse",      # a leader lease expired or was revoked
 )
 
 _EVENTS_C = REGISTRY.counter(

@@ -28,6 +28,7 @@ surface.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from nornicdb_tpu.obs import events as _events
@@ -110,6 +111,93 @@ def state_from_jsonable(doc: List[Dict]) -> List[Dict]:
                     "labels": tuple(fam.get("labels", ())),
                     "children": children})
     return out
+
+
+# -- fleet admission posture (ISSUE 16) -------------------------------------
+#
+# Cross-NODE posture propagation rides the telemetry the aggregator
+# already pulls: every node's ``nornicdb_admission_posture`` gauge
+# carries its LOCAL posture; the sweep below takes the max across every
+# registered source and feeds it to the local AdmissionController as a
+# posture source — an overloaded replica tightens the primary's
+# admission verdict (and vice versa) without a new control protocol.
+
+_plock = threading.Lock()
+_pstate: Dict[str, Any] = {"level": 0, "at": 0.0, "busy": False}
+
+
+def _sweep_remote_posture() -> int:
+    """Max peer posture level across every source's state dump. Slow
+    (remote HTTP fetches) — never called on a request path directly;
+    see :func:`remote_posture`."""
+    with _lock:
+        sources = dict(_sources)
+    level = 0
+    for _name, fn in sources.items():
+        try:
+            state = fn() or []
+        except Exception:  # noqa: BLE001 — a dead peer is not overload
+            continue
+        for fam in state:
+            if fam.get("name") != "nornicdb_admission_posture":
+                continue
+            for v in fam.get("children", {}).values():
+                try:
+                    level = max(level, int(float(v)))
+                except (TypeError, ValueError):
+                    pass
+    with _plock:
+        _pstate["level"] = level
+        _pstate["at"] = time.time()
+        _pstate["busy"] = False
+    return level
+
+
+def refresh_remote_posture() -> Tuple[int, float]:
+    """Synchronous sweep (tests pin propagation with this; admin
+    surfaces may too): (max peer level, age 0)."""
+    with _plock:
+        _pstate["busy"] = True
+    return _sweep_remote_posture(), 0.0
+
+
+def remote_posture(ttl_s: float = 5.0) -> Optional[Tuple[int, float]]:
+    """(max peer posture level, age_s) from the last sweep — the
+    AdmissionController posture-source shape. NON-BLOCKING: a stale
+    cache kicks one background sweep and returns the stale value (whose
+    age the controller's TTL check then ignores); the request path
+    never waits on a peer's HTTP surface."""
+    now = time.time()
+    kick = False
+    with _plock:
+        at = _pstate["at"]
+        if (now - at) > ttl_s and not _pstate["busy"]:
+            _pstate["busy"] = True
+            kick = True
+        level = _pstate["level"]
+    if kick:
+        threading.Thread(target=_sweep_remote_posture, daemon=True,
+                         name="fleet-posture").start()
+    if at <= 0.0:
+        return None
+    return int(level), now - at
+
+
+def posture_source(ttl_s: Optional[float] = None
+                   ) -> Callable[[], Optional[Tuple[int, float]]]:
+    """A posture source over the aggregator, for
+    ``admission.CONTROLLER.add_posture_source``. ``ttl_s`` defaults to
+    the controller's own ``NORNICDB_FLEET_POSTURE_TTL_S``."""
+
+    def source() -> Optional[Tuple[int, float]]:
+        t = ttl_s
+        if t is None:
+            from nornicdb_tpu import admission
+
+            t = admission.cfg()["fleet_posture_ttl_s"]
+        return remote_posture(t)
+
+    return source
 
 
 # -- aggregation ------------------------------------------------------------

@@ -545,6 +545,42 @@ def _normalize_date_str(s: str) -> str:
     return s
 
 
+def _normalize_iso(s: str) -> str:
+    """Rewrite ISO-8601 variants pre-3.11 ``fromisoformat`` rejects.
+
+    Python 3.10's C parser wants exactly 3 or 6 fractional digits, a
+    ``+HH:MM`` offset with the colon, and no ``Z`` suffix. Neo4j (and
+    wire payloads) emit ``Z``, nanosecond fractions, and colon-less
+    offsets — normalize those to the strict form before retrying.
+    """
+    s = s.strip()
+    if s and s[-1] in "zZ":
+        s = s[:-1] + "+00:00"
+    tz = ""
+    m = re.search(r"([+-]\d{2}):?(\d{2})$", s)
+    if m:
+        tz = f"{m.group(1)}:{m.group(2)}"
+        s = s[: m.start()]
+    fm = re.search(r"\.(\d+)$", s)
+    if fm:
+        s = s[: fm.start()] + "." + (fm.group(1) + "000000")[:6]
+    return s + tz
+
+
+def _iso_time(s: str) -> _dt.time:
+    try:
+        return _dt.time.fromisoformat(s)
+    except ValueError:
+        return _dt.time.fromisoformat(_normalize_iso(s))
+
+
+def _iso_datetime(s: str) -> _dt.datetime:
+    try:
+        return _dt.datetime.fromisoformat(s)
+    except ValueError:
+        return _dt.datetime.fromisoformat(_normalize_iso(s))
+
+
 def make_localtime(value: Any = None) -> Optional[CypherLocalTime]:
     if value is None:
         return CypherLocalTime(_dt.datetime.now().time())
@@ -556,7 +592,7 @@ def make_localtime(value: Any = None) -> Optional[CypherLocalTime]:
         return CypherLocalTime(value._dt.time())
     if isinstance(value, str):
         try:
-            return CypherLocalTime(_dt.time.fromisoformat(value))
+            return CypherLocalTime(_iso_time(value))
         except ValueError:
             raise CypherRuntimeError(f"invalid localtime {value!r}")
     if isinstance(value, dict):
@@ -588,7 +624,7 @@ def make_time(value: Any = None) -> Optional[CypherTime]:
         return CypherTime(value._dt.time().replace(tzinfo=_dt.timezone.utc))
     if isinstance(value, str):
         try:
-            return CypherTime(_dt.time.fromisoformat(value.replace("Z", "+00:00")))
+            return CypherTime(_iso_time(value.replace("Z", "+00:00")))
         except ValueError:
             raise CypherRuntimeError(f"invalid time {value!r}")
     if isinstance(value, dict):
@@ -611,7 +647,7 @@ def make_localdatetime(value: Any = None) -> Optional[CypherLocalDateTime]:
             _dt.datetime.combine(value._dt, _dt.time()))
     if isinstance(value, str):
         try:
-            return CypherLocalDateTime(_dt.datetime.fromisoformat(value))
+            return CypherLocalDateTime(_iso_datetime(value))
         except ValueError:
             raise CypherRuntimeError(f"invalid localdatetime {value!r}")
     if isinstance(value, dict):
@@ -649,7 +685,7 @@ def make_datetime(value: Any = None) -> Optional[CypherDateTime]:
     if isinstance(value, str):
         try:
             return CypherDateTime(
-                _dt.datetime.fromisoformat(value.replace("Z", "+00:00")))
+                _iso_datetime(value.replace("Z", "+00:00")))
         except ValueError:
             raise CypherRuntimeError(f"invalid datetime {value!r}")
     if isinstance(value, dict):

@@ -13,7 +13,11 @@ sync, multi-chip search fan-out) rides XLA collectives over ICI/DCN —
 see nornicdb_tpu.parallel.mesh (sharded kNN psum/all_gather paths).
 """
 
-from nornicdb_tpu.replication.transport import ClusterTransport, ClusterMessage
+from nornicdb_tpu.replication.transport import (
+    ClusterMessage,
+    ClusterTransport,
+    DualPlaneTransport,
+)
 from nornicdb_tpu.replication.replicator import (
     NotPrimaryError,
     ReplicationConfig,
@@ -45,6 +49,7 @@ from nornicdb_tpu.replication.multi_region import (
 __all__ = [
     "ClusterMessage",
     "ClusterTransport",
+    "DualPlaneTransport",
     "FleetStandby",
     "HAPrimary",
     "HAStandby",

@@ -17,6 +17,7 @@ Epoch rules:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -266,7 +267,18 @@ class HAStandby(Replicator):
         self.config = config
         self.primary_addr = primary_addr
         self.on_promote = on_promote
-        self.epoch = 1
+        # fencing epoch: persisted across restarts when config.epoch_path
+        # is set (ISSUE 16). A replica that restarts at epoch 1 after a
+        # failover bumped the fleet to epoch 2 would accept the deposed
+        # primary's stream — loading the persisted epoch closes that
+        # window, and together with the seq-aligned local WAL lets the
+        # restarted replica resume without a full re-bootstrap.
+        self.epoch = self._load_epoch()
+        # first boot writes the initial epoch too: the file's existence
+        # is the restart contract (resume_epoch in the fleet ready doc)
+        if getattr(config, "epoch_path", None) \
+                and not os.path.exists(config.epoch_path):
+            self._persist_epoch(self.epoch)
         self.applied_seq = 0
         # records received ahead of the watermark, held until the gap fills
         # (strict in-order apply: an older write applied after a newer one
@@ -294,6 +306,42 @@ class HAStandby(Replicator):
             t = threading.Thread(target=self._monitor_loop, daemon=True,
                                  name="ha-monitor")
             t.start()
+
+    # -- epoch persistence (ISSUE 16) ------------------------------------
+
+    def _load_epoch(self) -> int:
+        path = getattr(self.config, "epoch_path", None)
+        if not path:
+            return 1
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return max(1, int(f.read().strip() or 1))
+        except (OSError, ValueError):
+            return 1
+
+    def _persist_epoch(self, epoch: int) -> None:
+        """Atomic (tmp+rename) epoch write — a torn file read back as
+        garbage would reset a restarted replica to epoch 1."""
+        path = getattr(self.config, "epoch_path", None)
+        if not path:
+            return
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(str(epoch))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            pass  # persistence is best-effort; the live epoch still holds
+
+    def _set_epoch_locked(self, epoch: int) -> None:
+        """Single choke point for epoch advances (caller holds _lock):
+        updates the live value and rewrites the persisted copy only on
+        actual change."""
+        if epoch > self.epoch:
+            self.epoch = epoch
+            self._persist_epoch(epoch)
 
     # -- replicator ------------------------------------------------------
 
@@ -332,7 +380,7 @@ class HAStandby(Replicator):
         with self._lock:
             if msg.get("epoch", 0) < self.epoch:
                 return {"ok": False, "error": "fenced: stale epoch"}
-            self.epoch = max(self.epoch, msg.get("epoch", 0))
+            self._set_epoch_locked(msg.get("epoch", 0))
             self._last_heartbeat = time.monotonic()
         # Strict in-order apply. quorum mode broadcasts each record
         # independently, so batches from concurrent writers can arrive
@@ -387,14 +435,14 @@ class HAStandby(Replicator):
         with self._lock:
             if msg.get("epoch", 0) < self.epoch:
                 return {"ok": False, "error": "fenced: stale epoch"}
-            self.epoch = max(self.epoch, msg.get("epoch", 0))
+            self._set_epoch_locked(msg.get("epoch", 0))
             self._last_heartbeat = time.monotonic()
             return {"ok": True, "applied_seq": self.applied_seq}
 
     def handle_fence(self, msg: ClusterMessage) -> ClusterMessage:
         with self._lock:
             if msg.get("epoch", 0) > self.epoch:
-                self.epoch = msg["epoch"]
+                self._set_epoch_locked(msg["epoch"])
                 self._role = Role.STANDBY
                 return {"ok": True}
         return {"ok": False, "error": "stale fence epoch"}
@@ -421,7 +469,7 @@ class HAStandby(Replicator):
         with self._lock:
             if self._role is Role.PRIMARY:
                 return
-            self.epoch += 1
+            self._set_epoch_locked(self.epoch + 1)
             self._role = Role.PRIMARY
             epoch = self.epoch
         # replicate onward to the other replicas; the deposed primary's
@@ -454,7 +502,7 @@ class HAStandby(Replicator):
             if r.get("stepped_down"):
                 with self._lock:
                     self._role = Role.STANDBY
-                    self.epoch = max(self.epoch, primary.epoch)
+                    self._set_epoch_locked(primary.epoch)
                     self._as_primary = None
             return r
 

@@ -264,6 +264,8 @@ class ReadReplica:
         listen: Tuple[str, int] = ("127.0.0.1", 0),
         on_promote: Optional[Callable[["ReadReplica"], None]] = None,
     ):
+        import os
+
         from nornicdb_tpu.db import DB
 
         self.name = str(name)
@@ -278,6 +280,14 @@ class ReadReplica:
             failover_timeout=failover_timeout,
             standby_cls=FleetStandby,
             on_promote=self._on_promoted,
+            # two-plane streaming (ISSUE 16): bulk WAL batches/snapshot
+            # ships ride a second socket so they never head-of-line
+            # block heartbeats or fences on the control channel
+            data_listen=("127.0.0.1", 0),
+            # fencing epoch survives restarts: together with the
+            # seq-aligned local WAL this makes a replica restart a
+            # tail-pull, not a re-bootstrap
+            epoch_path=os.path.join(data_dir, "standby.epoch"),
         )
         self.db = DB(data_dir, engine="python", auto_embed=False,
                      database=database, replication=cfg)
@@ -565,6 +575,10 @@ class ReadFleet:
                 sync=sync, peers=[r.addr for r in self.replicas],
                 heartbeat_interval=heartbeat_interval,
                 failover_timeout=failover_timeout,
+                # two-plane: wal_sync catch-up pulls (potentially a full
+                # snapshot ship) land on the bulk endpoint, away from
+                # the fence/heartbeat channel
+                data_listen=("127.0.0.1", 0),
             )
             self.primary_db = DB(
                 os.path.join(base_dir, "primary"), engine="python",

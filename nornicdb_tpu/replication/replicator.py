@@ -62,6 +62,17 @@ class ReplicationConfig:
     standby_cls: Optional[Any] = None
     primary_cls: Optional[Any] = None
     on_promote: Optional[Any] = None
+    # two-plane transport (ISSUE 16): when set, the node binds a second
+    # bulk data-plane endpoint at this address and WAL batches/snapshot
+    # ships ride it, keeping heartbeats and fences on the control
+    # channel. None keeps the stock single-plane ClusterTransport.
+    data_listen: Optional[Tuple[str, int]] = None
+    # standby epoch persistence (ISSUE 16): when set, the standby loads
+    # its fencing epoch from this file at construction and rewrites it
+    # on every epoch change, so a restarted replica resumes at its
+    # persisted epoch + local WAL watermark instead of re-bootstrapping
+    # at epoch 1 (where a stale primary could feed it a fenced stream).
+    epoch_path: Optional[str] = None
 
 
 class Replicator:

@@ -26,6 +26,13 @@ MessageHandler = Callable[[ClusterMessage], Optional[ClusterMessage]]
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
 
+# two-plane partition (ISSUE 16; reference: SURVEY §2.8 — Raft/HA control
+# on host TCP, bulk index/WAL sync on the data plane): message types in
+# this set ride the control channel, everything else (wal_batch, wal_sync
+# and its snapshot payloads) rides the bulk data channel so a multi-MB
+# snapshot ship can never head-of-line-block a heartbeat or fence.
+CONTROL_TYPES = frozenset({"heartbeat", "fence", "plane_info"})
+
 
 class TransportError(ConnectionError):
     pass
@@ -219,3 +226,137 @@ class ClusterTransport:
         for t in threads:
             t.join(timeout + 1.0)
         return results
+
+
+class DualPlaneTransport:
+    """Two-plane cluster endpoint: control and bulk data on separate
+    channels (ISSUE 16).
+
+    The control plane carries the small latency-critical messages —
+    heartbeats, epochs, fencing — while WAL batches and snapshot ships
+    go over a second TCP endpoint, so replication bulk can saturate its
+    socket without delaying failure detection. Peers are still addressed
+    by a single (control) address: the data-plane address is discovered
+    over the control channel via a built-in ``plane_info`` exchange and
+    cached. A peer that answers ``plane_info`` with an error (an older
+    single-plane :class:`ClusterTransport`) degrades gracefully — bulk
+    falls back to its control address.
+
+    API-compatible with :class:`ClusterTransport` (``register_handler``
+    / ``request`` / ``broadcast`` / ``addr``), so HAPrimary/HAStandby
+    work unchanged on either.
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        listen_addr: Tuple[str, int] = ("127.0.0.1", 0),
+        data_listen_addr: Tuple[str, int] = ("127.0.0.1", 0),
+        ssl_server: Optional[ssl.SSLContext] = None,
+        ssl_client: Optional[ssl.SSLContext] = None,
+    ):
+        self.node_id = node_id
+        self.control = ClusterTransport(
+            node_id, listen_addr, ssl_server, ssl_client)
+        self.data = ClusterTransport(
+            node_id, data_listen_addr, ssl_server, ssl_client)
+        self._peer_data: Dict[Tuple[str, int], Tuple[str, int]] = {}
+        self._peer_lock = threading.Lock()
+        for t in (self.control, self.data):
+            t.register_handler("plane_info", self._handle_plane_info)
+
+    def _handle_plane_info(self, msg: ClusterMessage) -> ClusterMessage:
+        return {
+            "ok": True,
+            "control_addr": list(self.control.addr),
+            "data_addr": list(self.data.addr),
+        }
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        """The node's advertised address — the control endpoint. Peers
+        configured with this address reach both planes (data-plane addr
+        is exchanged over it)."""
+        return self.control.addr
+
+    @property
+    def data_addr(self) -> Tuple[str, int]:
+        return self.data.addr
+
+    def start(self) -> None:
+        self.control.start()
+        self.data.start()
+
+    def close(self) -> None:
+        self.control.close()
+        self.data.close()
+
+    def register_handler(self, msg_type: str, fn: MessageHandler) -> None:
+        # handlers go on BOTH planes: routing of *outgoing* traffic is
+        # what creates the split; an older single-plane peer that sends
+        # bulk to our control address must still be served.
+        self.control.register_handler(msg_type, fn)
+        self.data.register_handler(msg_type, fn)
+
+    def _resolve_data(self, addr: Tuple[str, int],
+                      timeout: float) -> Tuple[str, int]:
+        """Map a peer's control address to its data-plane address via a
+        cached ``plane_info`` exchange; single-plane peers map to their
+        own (control) address."""
+        key = tuple(addr)
+        with self._peer_lock:
+            hit = self._peer_data.get(key)
+        if hit is not None:
+            return hit
+        mapped = key
+        try:
+            resp = self.control.request(
+                key, {"type": "plane_info"}, timeout=timeout)
+            if resp.get("ok") and resp.get("data_addr"):
+                mapped = tuple(resp["data_addr"])  # type: ignore[assignment]
+        except TransportError:
+            return key  # unreachable: do not cache, retry next send
+        with self._peer_lock:
+            self._peer_data[key] = mapped
+        return mapped
+
+    def forget_peer(self, addr: Tuple[str, int]) -> None:
+        """Drop the cached data-plane mapping (peer restarted on a new
+        port)."""
+        with self._peer_lock:
+            self._peer_data.pop(tuple(addr), None)
+
+    def request(
+        self,
+        addr: Tuple[str, int],
+        msg: ClusterMessage,
+        timeout: float = 5.0,
+    ) -> ClusterMessage:
+        if msg.get("type") in CONTROL_TYPES:
+            return self.control.request(addr, msg, timeout)
+        data_addr = self._resolve_data(tuple(addr), timeout)
+        try:
+            return self.data.request(data_addr, msg, timeout)
+        except TransportError:
+            # peer may have restarted with a new data port — re-resolve
+            # once through the (stable) control address before giving up
+            self.forget_peer(addr)
+            fresh = self._resolve_data(tuple(addr), timeout)
+            if fresh == data_addr:
+                raise
+            return self.data.request(fresh, msg, timeout)
+
+    def broadcast(
+        self,
+        addrs: list,
+        msg: ClusterMessage,
+        timeout: float = 5.0,
+    ) -> Dict[Tuple[str, int], Optional[ClusterMessage]]:
+        """Plane-routed fan-out. Results are keyed by the *configured*
+        (control) addresses so callers' quorum accounting is unchanged."""
+        if msg.get("type") in CONTROL_TYPES:
+            return self.control.broadcast(addrs, msg, timeout)
+        mapping = {tuple(a): self._resolve_data(tuple(a), timeout)
+                   for a in addrs}
+        raw = self.data.broadcast(list(mapping.values()), msg, timeout)
+        return {ctrl: raw.get(data) for ctrl, data in mapping.items()}

@@ -98,13 +98,40 @@ _HDR_SIZE = 64  # header struct is exactly 64 bytes; slots align to 64
 assert _HDR.size <= _HDR_SIZE
 
 # control block: magic, n_workers, slots_per_worker, slot_bytes (u32 x4)
-# then qdrant_gen (u64 @16), search_gen (u64 @24), broker_alive (u8 @32)
+# then qdrant_gen (u64 @16), search_gen (u64 @24), broker_alive (u8 @32),
+# admission posture level (u8 @40) + its write timestamp (f64 @48) —
+# the fleet-wide posture word (ISSUE 16)
 _CTRL = struct.Struct("<IIII")
 _CTRL_SIZE = 64
 _MAGIC = 0x4E57_4252  # "NWBR"
 _OFF_QDRANT_GEN = 16
 _OFF_SEARCH_GEN = 24
 _OFF_ALIVE = 32
+_OFF_POSTURE = 40
+_OFF_POSTURE_TS = 48
+
+
+def _read_posture_word(buf) -> Tuple[int, float]:
+    """(posture level, write timestamp) from a ring control block. A
+    torn read across the two fields is harmless — the posture word is
+    advisory and self-heals within one publish cadence."""
+    (ts,) = struct.unpack_from("<d", buf, _OFF_POSTURE_TS)
+    return int(buf[_OFF_POSTURE]), float(ts)
+
+
+def _write_posture_word(buf, level: int, ttl_s: float) -> bool:
+    """Publish one process's LOCAL admission posture into the shared
+    control block: write-if-more-severe-or-stale. A severe posture any
+    ring member published sticks until it ages past ``ttl_s`` — a
+    healthy worker cannot clear a peer's overload signal early, and a
+    dead worker's stale signal cannot pin the fleet shed forever."""
+    now = time.time()
+    cur, ts = _read_posture_word(buf)
+    if level >= cur or (now - ts) > ttl_s:
+        struct.pack_into("<d", buf, _OFF_POSTURE_TS, now)
+        buf[_OFF_POSTURE] = max(0, min(255, int(level)))
+        return True
+    return False
 
 _BATCH_H = REGISTRY.histogram(
     "nornicdb_broker_batch_size",
@@ -249,6 +276,34 @@ class BrokerClient:
 
     def broker_alive(self) -> bool:
         return self._buf[_OFF_ALIVE] == 1
+
+    # -- fleet posture word (ISSUE 16) ---------------------------------
+
+    def ring_posture(self) -> Tuple[int, float]:
+        """(posture level, age in seconds) of the shared posture word —
+        the AdmissionController posture-source shape."""
+        level, ts = _read_posture_word(self._buf)
+        return level, max(0.0, time.time() - ts)
+
+    def publish_posture(self, level: int,
+                        ttl_s: Optional[float] = None) -> bool:
+        if ttl_s is None:
+            ttl_s = _adm.cfg()["fleet_posture_ttl_s"]
+        return _write_posture_word(self._buf, level, ttl_s)
+
+    def bind_admission(self) -> None:
+        """Wire this process's AdmissionController to the ring posture
+        word: every local posture evaluation publishes into the control
+        block (write-if-more-severe-or-stale), and every refresh reads
+        the word back as a fleet posture source — one overloaded wire
+        worker tightens EVERY worker's admission verdict within a
+        publish cadence."""
+        _adm.CONTROLLER.set_posture_publisher(self.publish_posture)
+        _adm.CONTROLLER.add_posture_source(self.ring_posture)
+
+    def unbind_admission(self) -> None:
+        _adm.CONTROLLER.clear_posture_publisher(self.publish_posture)
+        _adm.CONTROLLER.remove_posture_source(self.ring_posture)
 
     # -- slot lifecycle ------------------------------------------------
 
@@ -436,6 +491,7 @@ class BrokerClient:
         return doc
 
     def close(self) -> None:
+        self.unbind_admission()
         try:
             self._sock.close()
         finally:
@@ -518,6 +574,30 @@ class DispatchBroker:
         self._buf[_OFF_SEARCH_GEN:_OFF_SEARCH_GEN + 8] = \
             int(gen).to_bytes(8, "little")
 
+    # -- fleet posture word (ISSUE 16) ---------------------------------
+
+    def ring_posture(self) -> Tuple[int, float]:
+        """(posture level, age seconds) — see BrokerClient.ring_posture."""
+        level, ts = _read_posture_word(self._buf)
+        return level, max(0.0, time.time() - ts)
+
+    def publish_posture(self, level: int,
+                        ttl_s: Optional[float] = None) -> bool:
+        if ttl_s is None:
+            ttl_s = _adm.cfg()["fleet_posture_ttl_s"]
+        return _write_posture_word(self._buf, level, ttl_s)
+
+    def bind_admission(self) -> None:
+        """Parent-side mirror of BrokerClient.bind_admission: the device
+        plane's controller publishes/consumes the same posture word as
+        the wire workers."""
+        _adm.CONTROLLER.set_posture_publisher(self.publish_posture)
+        _adm.CONTROLLER.add_posture_source(self.ring_posture)
+
+    def unbind_admission(self) -> None:
+        _adm.CONTROLLER.clear_posture_publisher(self.publish_posture)
+        _adm.CONTROLLER.remove_posture_source(self.ring_posture)
+
     # -- lifecycle -----------------------------------------------------
 
     def client_spec(self, worker_id: int,
@@ -537,6 +617,7 @@ class DispatchBroker:
 
     def stop(self) -> None:
         self._run = False
+        self.unbind_admission()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -138,6 +138,22 @@ CHECKS: Dict[str, Tuple] = {
     # instrumented wire path stays inside the ≤2x+1ms overhead
     # budget tests pin).
     "trace_completeness": ("quality", 1.0, 0.0),
+    # multi-process read fleet (round r16+): replica subprocesses
+    # behind the router. fleet_proc_read_qps is qps-class vs the
+    # trajectory baseline; parity and trace completeness carry the
+    # same ABSOLUTE 1.0 contracts as the in-process fleet (a replica
+    # serving a different ranking, or a trace id that fails to cross
+    # the process boundary, is a bug — not noise). fleet_read_scaling
+    # is the out-of-GIL contract: ABSOLUTE floor 1.5 wherever the box
+    # has >= 2 cores to express parallelism; on a 1-core box two
+    # processes time-share one core and cannot scale past ~1.0, so
+    # the check degrades to a collapse guard (floor 0.6) — the
+    # companion fleet_proc_cores metric carries the box's verdict
+    # in-artifact, so the verdict is reproducible from the file alone.
+    "fleet_proc_read_qps": ("qps", 0.5),
+    "fleet_read_scaling": ("scaling", 1.5, 0.6),
+    "fleet_proc_parity": ("quality", 1.0, 0.0),
+    "fleet_proc_trace_completeness": ("quality", 1.0, 0.0),
 }
 
 
@@ -265,6 +281,24 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         out["fleet_read_qps"] = _num(fl.get("fleet_read_qps"))
         out["replica_parity"] = _num(fl.get("replica_parity"))
         out["trace_completeness"] = _num(fl.get("trace_completeness"))
+    # multi-process fleet (round r16+): the summary packs [qps,
+    # scaling, parity, trace_completeness, cores]; the full artifact
+    # carries the named keys under "fleet_proc"
+    fp = doc.get("fleet_proc") or {}
+    if isinstance(fp, list):
+        pad = fp + [None] * 5
+        out["fleet_proc_read_qps"] = _num(pad[0])
+        out["fleet_read_scaling"] = _num(pad[1])
+        out["fleet_proc_parity"] = _num(pad[2])
+        out["fleet_proc_trace_completeness"] = _num(pad[3])
+        out["fleet_proc_cores"] = _num(pad[4])
+    else:
+        out["fleet_proc_read_qps"] = _num(fp.get("fleet_read_qps"))
+        out["fleet_read_scaling"] = _num(fp.get("read_scaling"))
+        out["fleet_proc_parity"] = _num(fp.get("replica_parity"))
+        out["fleet_proc_trace_completeness"] = _num(
+            fp.get("trace_completeness"))
+        out["fleet_proc_cores"] = _num(fp.get("cores"))
     surfaces = doc.get("surfaces") or {}
     for name in ("bolt", "neo4j_http", "graphql", "rest_search",
                  "qdrant_grpc"):
@@ -363,7 +397,8 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
         # trajectory run carries it (qps/growth/latency checks are
         # relative and need both sides)
         if f is None or (b is None and kind not in ("quality",
-                                                    "bound")):
+                                                    "bound",
+                                                    "scaling")):
             skipped.append(metric)
             continue
         if kind == "qps":
@@ -403,6 +438,23 @@ def compare(fresh: Dict[str, float], baseline: Dict[str, float],
                     "metric": metric, "kind": "latency_ceiling",
                     "fresh": f, "baseline": b,
                     "ratio": round(f / b, 3), "tolerance": tol})
+            else:
+                passed.append(metric)
+        elif kind == "scaling":
+            # core-aware ABSOLUTE floor (ISSUE 16): the multi-core
+            # floor is the out-of-GIL contract; one core cannot
+            # express process parallelism, so the single-core floor
+            # only catches routing collapse. The core count rides the
+            # SAME artifact (fleet_proc_cores), so the verdict never
+            # depends on the box the sentinel happens to run on.
+            multi_floor, solo_floor = spec[1], spec[2]
+            cores = fresh.get("fleet_proc_cores") or 1
+            floor = overrides.get(
+                metric, multi_floor if cores >= 2 else solo_floor)
+            if f < floor:
+                flagged.append({
+                    "metric": metric, "kind": "scaling_floor",
+                    "fresh": f, "floor": floor, "cores": int(cores)})
             else:
                 passed.append(metric)
         elif kind == "bound":

@@ -130,6 +130,18 @@ def _fake_result():
                   "drain": {"breached_drained": True,
                             "ledger_reason": True, "recovered": True,
                             "events_ordered": True}},
+        "fleet_proc": {"replicas": 2, "n": 2000, "cores": 8,
+                       "converged": True, "out_of_process": True,
+                       "replica_parity": 1.0,
+                       "single_read_qps": 210.0,
+                       "fleet_read_qps": 390.0,
+                       "read_scaling": 1.857,
+                       "sheds": {"single": 0, "fleet": 3},
+                       "errors": {"single": 0, "fleet": 0},
+                       "replay_lag": {"burst_ops": 800,
+                                      "peak_lag_ops": 310,
+                                      "drain_s": 2.4},
+                       "trace_completeness": 1.0},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -199,6 +211,13 @@ class TestCompactSummary:
         # trace-completeness fraction (sentinel absolute floor 1.0;
         # apply-delay p50/p99 rides the full artifact)
         assert s["fleet"] == [2600.0, 0.49, 1.0, True, 1.0]
+        # multi-process fleet (ISSUE 16), packed [qps, scaling,
+        # parity, trace_completeness, cores]: out-of-GIL goodput
+        # through the router vs the primary's own HTTP surface, the
+        # HTTP-ranked parity verdict (sentinel absolute floor 1.0),
+        # the cross-process trace fraction (absolute 1.0), and the
+        # core count the sentinel's scaling floor keys on
+        assert s["fleet_proc"] == [390.0, 1.857, 1.0, 1.0, 8]
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -577,6 +596,38 @@ class TestBenchDryRunArtifactSchema:
         assert summary["fleet"][3] is True
         assert summary["fleet"][4] == 1.0
 
+    def test_fleet_proc_stage_schema(self, dry_run_lines):
+        """Multi-process fleet stage (ISSUE 16): the tiny topology must
+        spawn REAL replica subprocesses, converge over the two-plane
+        stream, serve rank-identical answers over HTTP, measure both
+        goodput rates with sheds accounted, drain the write burst, and
+        carry every propagated trace id into a child's ring — in
+        every dry run."""
+        full = json.loads(dry_run_lines[0])
+        summary = json.loads(dry_run_lines[-1])
+        fp = full["fleet_proc"]
+        assert "error" not in fp, fp
+        assert fp["replicas"] == 2
+        assert fp["cores"] >= 1
+        assert fp["converged"] is True
+        assert fp["out_of_process"] is True  # real pids, not threads
+        assert fp["replica_parity"] == 1.0  # exact-contract floor
+        assert fp["single_read_qps"] > 0
+        assert fp["fleet_read_qps"] > 0
+        assert fp["read_scaling"] > 0
+        assert fp["errors"] == {"single": 0, "fleet": 0}
+        lag = fp["replay_lag"]
+        assert lag["burst_ops"] > 0
+        assert lag["peak_lag_ops"] >= 0
+        assert lag["drain_s"] is not None and lag["drain_s"] >= 0
+        assert fp["trace_completeness"] == 1.0
+        # the summary packs [qps, scaling, parity, trace, cores]
+        assert summary["fleet_proc"][0] == fp["fleet_read_qps"]
+        assert summary["fleet_proc"][1] == fp["read_scaling"]
+        assert summary["fleet_proc"][2] == 1.0
+        assert summary["fleet_proc"][3] == 1.0
+        assert summary["fleet_proc"][4] == fp["cores"]
+
 
 class TestTpuProofDryRun:
     """VERDICT r4 #6: _bench_tpu_proof had never executed anywhere.
@@ -762,6 +813,53 @@ class TestBenchSentinelGate:
                                       ["--baseline", str(base)])
         assert rc == 0
         assert docs[0]["warnings"] == []
+
+    def test_fleet_scaling_floor_is_core_aware(self, tmp_path):
+        """ISSUE 16: the out-of-GIL read-scaling floor (1.5 absolute)
+        binds wherever the box has >= 2 cores to express process
+        parallelism; a 1-core box time-shares one core across the
+        replica subprocesses, so only the collapse guard (0.6) gates
+        there. The core count rides the SAME artifact, so the verdict
+        is reproducible from the file alone."""
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps(
+            {"sentinel_baseline": True,
+             "metrics": {"fleet_proc_read_qps": 300.0}}))
+
+        def fp(scaling, cores):
+            return json.dumps({"fleet_proc": {
+                "fleet_read_qps": 300.0, "read_scaling": scaling,
+                "replica_parity": 1.0, "trace_completeness": 1.0,
+                "cores": cores}})
+
+        # multi-core box below the 1.5 contract -> flagged
+        rc, docs = self._run_sentinel(fp(1.1, 8),
+                                      ["--baseline", str(base)])
+        assert rc == 1
+        flags = {f["metric"]: f for f in docs[0]["flagged"]}
+        assert flags["fleet_read_scaling"]["kind"] == "scaling_floor"
+        assert flags["fleet_read_scaling"]["floor"] == 1.5
+        assert flags["fleet_read_scaling"]["cores"] == 8
+        # the same scaling on a 1-core box passes (no parallelism to
+        # demand) — the collapse guard is the only floor there
+        rc, docs = self._run_sentinel(fp(1.1, 1),
+                                      ["--baseline", str(base)])
+        assert rc == 0
+        assert "fleet_read_scaling" in docs[0]["passed"]
+        # routing collapse is flagged on ANY box
+        rc, docs = self._run_sentinel(fp(0.3, 1),
+                                      ["--baseline", str(base)])
+        assert rc == 1
+        flags = {f["metric"]: f for f in docs[0]["flagged"]}
+        assert flags["fleet_read_scaling"]["floor"] == 0.6
+        # contract met on a multi-core box passes
+        rc, docs = self._run_sentinel(fp(1.9, 8),
+                                      ["--baseline", str(base)])
+        assert rc == 0
+        assert "fleet_read_scaling" in docs[0]["passed"]
+        # the parity/trace contracts gate absolutely alongside
+        assert "fleet_proc_parity" in docs[0]["passed"]
+        assert "fleet_proc_trace_completeness" in docs[0]["passed"]
 
     def test_walk_recall_gates_absolutely_without_baseline(
             self, tmp_path):

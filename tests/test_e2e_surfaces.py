@@ -285,11 +285,23 @@ class TestFiveSurfaceParity:
     # box scales the gate down proportionally instead of flaking it
     # (round 5: qdrant 681 vs 1,000 on a green tree under suite
     # contention). NORNICDB_E2E_FLOOR_SCALE still overrides explicitly.
+    #
+    # The cache-served HTTP surfaces (rest_search / graphql /
+    # neo4j_http hit the response byte cache on this repeated-request
+    # workload) barely slow down with box speed, while the JSON spin
+    # scales linearly — on a slow box their pre-cache-era floors scaled
+    # >10x under the measured rate and the 10x self-check below rightly
+    # called the gate toothless. Their nominals are tuned to the
+    # cached-path rate class (a 0.28-scale box still measures rest
+    # 6.5k / graphql 5.7k / neo4j 2.4k, so these keep >4x gate margin
+    # there and more everywhere faster); losing the cache REMAINS
+    # catchable — it is exactly the order-of-magnitude drop the floors
+    # exist for.
     NOMINAL_FLOORS = {
         "bolt": 1200.0,
-        "neo4j_http": 900.0,
-        "graphql": 1200.0,
-        "rest_search": 1500.0,
+        "neo4j_http": 1400.0,
+        "graphql": 3500.0,
+        "rest_search": 4000.0,
         "qdrant_grpc": 1000.0,
     }
 
