@@ -120,6 +120,7 @@ _DEVICE_STAGES = {
     "ann_cagra": (lambda: {"cagra": _bench_ann_cagra()}, 900.0),
     "hybrid": (lambda: _bench_hybrid(), 900.0),
     "quant": (lambda: _bench_quant(), 900.0),
+    "tiered": (lambda: _bench_tiered(), 900.0),
     "tpu_proof": (lambda: _run_tpu_proof_stage(), 900.0),
 }
 
@@ -211,6 +212,11 @@ def main(dry_run: bool = False):
             result["quant"] = {
                 "error": f"{type(exc).__name__}: {exc}"[:400]}
         try:
+            result["tiered"] = _bench_tiered(tiny=True)
+        except Exception as exc:
+            result["tiered"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:400]}
+        try:
             result["surfaces"] = _bench_surfaces(n_people=80, secs=0.3,
                                                  warmup_s=0.1)
         except Exception as exc:
@@ -265,6 +271,12 @@ def main(dry_run: bool = False):
     # claim the sentinel holds to an absolute recall floor)
     result["quant"] = _stage_subprocess(
         "quant", _DEVICE_STAGES["quant"][1])
+    # tiered vector storage (ISSUE 17): cluster-routed PQ slabs with
+    # demand paging — serving recall/qps at the default residency, the
+    # beyond-HBM capacity ratio, and the forced-cold exact-parity
+    # contract the sentinel holds to the absolute 1.0 floor
+    result["tiered"] = _stage_subprocess(
+        "tiered", _DEVICE_STAGES["tiered"][1])
     # five-surface e2e throughput (reference: testing/e2e/README.md —
     # bolt 2,489 / neo4j-http 4,082 / graphql 3,200 / REST search
     # 10,296 / qdrant-grpc 29,331 ops/s on a 16-way dev box). Pure
@@ -438,17 +450,34 @@ def _compact_summary(result):
                                "walk_recall10"),
             "crossover_n": g(result, "hybrid", "walk", "crossover_n"),
         },
-        # quantization ladder (quant stage): int8-rung qps at the
-        # serving batch, the WORST rung's recall@10 (the sentinel's
-        # absolute floor), and PQ's measured compression ratio
-        "quant": {
-            "quant_qps_b16": g(result, "quant", "quant_qps_b16"),
-            "quant_recall10": g(result, "quant", "quant_recall10"),
-            "compression_ratio": g(result, "quant",
-                                   "compression_ratio"),
-            "speedup_int8_vs_f32": g(result, "quant",
-                                     "speedup_int8_vs_f32"),
-        },
+        # quantization ladder (quant stage), packed [qps_b16,
+        # recall10, compression_ratio, speedup_int8_vs_f32]
+        # (fleet-pack precedent, repacked in r17 to keep the summary
+        # inside the tail window): int8-rung qps at the serving batch,
+        # the WORST rung's recall@10 (the sentinel's absolute floor),
+        # and PQ's measured compression ratio
+        "quant": [
+            g(result, "quant", "quant_qps_b16"),
+            g(result, "quant", "quant_recall10"),
+            g(result, "quant", "compression_ratio"),
+            g(result, "quant", "speedup_int8_vs_f32"),
+        ],
+        # tiered vector storage (ISSUE 17), packed [recall10, qps_b16,
+        # capacity_ratio, cold_parity, cold_records, pages_per_s]
+        # (fleet-pack precedent — named keys would blow the tail
+        # window): serving recall at the default residency (sentinel
+        # absolute floor 0.95), qps at the serving batch, the
+        # beyond-HBM capacity multiple, the forced-cold exact-parity
+        # contract (absolute 1.0) with its ledger evidence, and
+        # host->device paging throughput
+        "tiered": [
+            g(result, "tiered", "tiered_recall10"),
+            g(result, "tiered", "tiered_qps_b16"),
+            g(result, "tiered", "tiered_capacity_ratio"),
+            g(result, "tiered", "cold", "parity"),
+            g(result, "tiered", "cold", "ledger_records"),
+            g(result, "tiered", "paging", "pages_per_s"),
+        ],
         # device graph plane (ISSUE 9): row parity across the device
         # LDBC fast paths (sentinel absolute floor 1.0), the coalesced
         # concurrent chain comparison, the fused traverse-rank rate,
@@ -2801,6 +2830,151 @@ def _bench_quant(tiny: bool = False):
             "speedup_int8_vs_f32": (
                 round(modes["int8"]["qps_b16"] / f32_qps, 2)
                 if f32_qps else None),
+        }
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+
+def _bench_tiered(tiny: bool = False):
+    """Tiered vector storage (ISSUE 17): cluster-routed PQ slabs with
+    demand paging — the beyond-HBM capacity rung. Four claims ride the
+    artifact: ``tiered_recall10`` (cluster-probe serving quality, the
+    sentinel's absolute 0.95 floor), ``tiered_qps_b16`` (serving rate
+    at the batch-16 shape), ``tiered_capacity_ratio`` (device bytes vs
+    an all-device float32 plane — the >= 4x capacity claim), and the
+    forced-cold contract: with one resident slab, every query is still
+    RANK-IDENTICAL to exact (cold partitions host-scan exactly) with
+    exactly one ``tiered_cold`` ledger record per batch."""
+    import jax
+
+    from nornicdb_tpu.obs import audit as _audit
+    from nornicdb_tpu.search.tiered_store import TieredStore
+    from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+    n, d, parts = (1_200, 32, 4) if tiny else (50_000, 64, 32)
+    nq = 16 if tiny else 64
+    secs = 0.15 if tiny else 1.2
+    k, batch = 10, 16
+    env = {"NORNICDB_VECTOR_TIERED": "1",
+           "NORNICDB_TIERED_MIN_N": "64",
+           "NORNICDB_TIERED_INLINE_BUILD": "1",
+           "NORNICDB_TIERED_PARTS": str(parts),
+           "NORNICDB_TIERED_NPROBE": str(max(4, parts // 2)),
+           "NORNICDB_VECTOR_QUANT": "off"}
+    saved = {key: os.environ.get(key) for key in env}
+    os.environ.update(env)
+    try:
+        rng = np.random.default_rng(17)
+        centers = max(8, n // 400)
+        cent = (rng.standard_normal((centers, d)) * 2.0).astype(
+            np.float32)
+        vecs = (cent[rng.integers(0, centers, n)]
+                + rng.standard_normal((n, d)).astype(np.float32))
+        idx = BruteForceIndex()
+        idx.add_batch([(f"d{i}", vecs[i]) for i in range(n)])
+        q = (cent[rng.integers(0, centers, nq)]
+             + rng.standard_normal((nq, d))).astype(np.float32)
+        exact = idx.search_batch(q, k, exact=True)
+        exact_ids = [[e for e, _ in hits] for hits in exact]
+
+        # -- all-resident serving through the index ladder ------------
+        t0 = time.perf_counter()
+        got = idx.search_batch(q, k)  # builds the plane inline + warms
+        build_s = time.perf_counter() - t0
+        recall10 = sum(
+            len({e for e, _ in hits} & set(want)) / max(len(want), 1)
+            for hits, want in zip(got, exact_ids)) / nq
+        qb = q[:batch]
+        idx.search_batch(qb, k)
+        times = []
+        t0 = time.perf_counter()
+        m = 0
+        while True:
+            t1 = time.perf_counter()
+            idx.search_batch(qb, k)
+            times.append(time.perf_counter() - t1)
+            m += batch
+            if time.perf_counter() - t0 > secs:
+                break
+        qps = m / (time.perf_counter() - t0)
+        stats = idx.resource_stats()
+        res_ms = np.asarray(times) * 1e3
+
+        # -- LRU paging round-trip throughput -------------------------
+        # one resident slab: every promotion is a full evict+promote
+        # round trip through the disk spill store
+        cold_store = TieredStore(
+            idx, nprobe=parts, parts=parts, resident_max=1,
+            min_pool=1 << 20, min_n=64, build_inline=True,
+            rebuild_stale_frac=1e9)
+        cold_store.build()
+        pids = list(range(parts)) * (2 if tiny else 1)
+        t0 = time.perf_counter()
+        for pid in pids:
+            cold_store.promote_inline([pid])
+        page_s = time.perf_counter() - t0
+        pages_per_s = len(pids) / max(page_s, 1e-9)
+
+        # -- forced-cold contract: exact parity + one record/batch ----
+        before = _audit.LEDGER.by_reason().get("tiered_cold", 0)
+        cold_batches = 2 if tiny else 4
+        good = total = 0
+        cold_times = []
+        for i in range(cold_batches):
+            # the previous batch queued cold partitions for background
+            # promotion; wait the pager out so a mid-batch residency
+            # swap can't race this batch's dispatch
+            deadline = time.time() + 30.0
+            while cold_store._paging and time.time() < deadline:
+                time.sleep(0.01)
+            lo = (i * batch) % max(nq - batch, 1)
+            qc = q[lo: lo + batch]
+            t1 = time.perf_counter()
+            got_c = cold_store.search_batch(qc, k)
+            cold_times.append(time.perf_counter() - t1)
+            if got_c is None:
+                total += len(qc)  # a degrade scores as zero parity
+                continue
+            for hits, want in zip(got_c, exact_ids[lo: lo + batch]):
+                total += 1
+                if [e for e, _ in hits] == want:
+                    good += 1
+        records = _audit.LEDGER.by_reason().get("tiered_cold", 0) \
+            - before
+        cold_ms = np.asarray(cold_times) * 1e3
+        cold_store.store.close()
+
+        return {
+            "n": n, "dims": d, "parts": parts, "k": k, "batch": batch,
+            "backend": jax.devices()[0].platform,
+            "build_s": round(build_s, 2),
+            "tiered_recall10": round(recall10, 4),
+            "tiered_qps_b16": round(qps, 1),
+            "tiered_capacity_ratio": stats.get("tiered_capacity_ratio"),
+            "tiered_device_bytes": stats.get("tiered_device_bytes"),
+            "disk_bytes": stats.get("disk_bytes"),
+            "latency_ms": {
+                "resident_p50": round(float(np.percentile(res_ms, 50)),
+                                      3),
+                "resident_p99": round(float(np.percentile(res_ms, 99)),
+                                      3),
+                "cold_p50": round(float(np.percentile(cold_ms, 50)), 3),
+                "cold_p99": round(float(np.percentile(cold_ms, 99)), 3),
+            },
+            "cold": {
+                "parity": round(good / max(total, 1), 4),
+                "ledger_records": records,
+                "batches": cold_batches,
+            },
+            "paging": {
+                "pages_per_s": round(pages_per_s, 1),
+                "promotions": cold_store.promotions,
+                "evictions": cold_store.evictions,
+            },
         }
     finally:
         for key, val in saved.items():

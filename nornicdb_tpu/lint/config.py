@@ -37,6 +37,9 @@ SNAPSHOT_MODULES = {
         "DeviceGraphPlane._chain_batch",  # catalog.version post-dispatch
         "DeviceGraphPlane.traverse_rank",
     ),
+    "nornicdb_tpu.search.tiered_store": (
+        "TieredStore.search_batch",  # residency_gen re-check after ADC
+    ),
 }
 
 # tokens that count as a freshness counter in a post-dispatch re-check
@@ -84,6 +87,11 @@ HOT_PATHS = (
     ("nornicdb_tpu/replication/transport.py", "write_frame"),
     ("nornicdb_tpu/replication/transport.py",
      "DualPlaneTransport.request"),
+    # tiered plane (ISSUE 17) — route scores centroids once per query
+    # batch member; pool sizing runs per dispatch. Build/paging knobs
+    # are read once at plane construction and cached.
+    ("nornicdb_tpu/search/tiered_store.py", "TieredStore.route"),
+    ("nornicdb_tpu/search/tiered_store.py", "TieredStore.pool_for"),
     # admission actuator (ISSUE 15) — deadline mint + verdict run once
     # per request on every ingress; config is cached at first use and
     # these must never read the environment

@@ -41,6 +41,7 @@ IMPORT_TIME_MODULES = (
     "nornicdb_tpu.search.cagra",
     "nornicdb_tpu.search.device_bm25",
     "nornicdb_tpu.search.device_quant",
+    "nornicdb_tpu.search.tiered_store",  # tiered paging events (ISSUE 17)
     "nornicdb_tpu.search.hybrid_fused",
     "nornicdb_tpu.query.device_graph",
     "nornicdb_tpu.storage.wal",

@@ -91,8 +91,9 @@ TIER_SHED = "shed"
 # `tier` label values — the catalog lint checks each against
 # docs/observability.md.
 TIERS: Dict[str, Tuple[str, ...]] = {
-    "vector": ("vector_walk_quant", "vector_walk_f32", "vector_int8",
-               "vector_pq", "vector_brute_f32", TIER_HOST, TIER_CACHED),
+    "vector": ("vector_walk_quant", "vector_walk_f32", "vector_tiered",
+               "vector_int8", "vector_pq", "vector_brute_f32",
+               TIER_HOST, TIER_CACHED),
     "hybrid": ("hybrid_walk_quant", "hybrid_walk_f32",
                "hybrid_brute_int8", "hybrid_brute_pq",
                "hybrid_brute_f32", TIER_HOST, TIER_CACHED),
@@ -110,6 +111,7 @@ ALL_TIERS: Tuple[str, ...] = tuple(sorted(
 STATISTICAL_FLOORS: Dict[str, float] = {
     "vector_walk_quant": 0.95,
     "vector_walk_f32": 0.95,
+    "vector_tiered": 0.95,
     "vector_int8": 0.95,
     "vector_pq": 0.95,
     "hybrid_walk_quant": 0.95,
@@ -162,6 +164,8 @@ REASONS: Tuple[str, ...] = (
     "deadline",            # request budget expired before/while queued
     "shed",                # admission control rejected the request
     "admission",           # admission posture forced the tier down
+    "tiered_cold",         # probe hit a non-resident partition: host scan
+    "paging_race",         # residency churned while a dispatch was in flight
 )
 
 # legacy event label value -> normalized reason. One table so the old
@@ -199,6 +203,9 @@ _LEGACY_REASONS: Dict[str, str] = {
     "exact_fallback_quarantine": "quarantine",
     # device_bm25_events_total
     "host_fallback_pending": "pending_build",
+    # tiered_events_total
+    "degrade_paging_race": "paging_race",
+    "cold_scan": "tiered_cold",
     # device_graph_events_total
     "degrade_stale": "stale_snapshot",
     "degrade_exactness": "exactness",

@@ -111,6 +111,15 @@ def price_pq_adc(b: int, rows: int, m: int, n_codes: int,
     return flops, bytes_
 
 
+def price_tiered_route(b: int, parts: int, d: int) -> Tuple[float, float]:
+    """(flops, bytes) of the host-side cluster routing matmul: one
+    [b,d]x[d,parts] centroid scoring per batch — the tiny price that
+    buys skipping every unprobed partition's codes entirely."""
+    flops = 2.0 * b * parts * d
+    bytes_ = _F32 * (parts * d + b * d + b * parts)
+    return flops, bytes_
+
+
 def price_rerank(b: int, pool: int, d: int) -> Tuple[float, float]:
     """(flops, bytes) of the exact rerank over a gathered candidate
     pool: one [b,d]x[d,pool] float32 matmul over rows gathered from the
@@ -136,6 +145,24 @@ def price_walk_quant(b: int, d: int, iters: int, width: int,
     bytes_ = b * (n_seeds * d  # int8 seed rows
                   + iters * (m * head_dims + keep * d  # int8 gathers
                              + _F32 * m))  # adjacency/scale columns
+    return flops, bytes_
+
+
+def price_walk_pq(b: int, d: int, iters: int, width: int, degree: int,
+                  itopk: int, m: int, n_codes: int,
+                  n_seeds: int = 1024) -> Tuple[float, float]:
+    """(flops, bytes) of one PQ CAGRA walk (ISSUE 17 satellite): one
+    per-query ADC table einsum ([m, n_codes] dots of d/m dims), then
+    the seed round and each iteration's ``width*degree`` candidates
+    cost ``m`` uint8 code gathers + table adds apiece; the host exact
+    rerank of the pool is priced separately (``price_rerank``)."""
+    cand = float(iters * width * degree)
+    d_sub = d / max(m, 1)
+    flops = b * (2.0 * m * n_codes * d_sub  # ADC tables
+                 + (n_seeds + cand) * m  # table-lookup adds
+                 + iters * itopk * 2.0)  # pool maintenance
+    bytes_ = b * (m * (n_seeds + cand)  # uint8 code gathers
+                  + _F32 * (m * n_codes + cand))  # tables + adjacency
     return flops, bytes_
 
 

@@ -56,6 +56,10 @@ _INDEX_GAUGES: Tuple[Tuple[str, str], ...] = (
     ("nornicdb_index_rebuild_backlog_seconds", "rebuild_backlog_s"),
     ("nornicdb_index_quant_device_bytes", "quant_device_bytes"),
     ("nornicdb_index_compression_ratio", "compression_ratio"),
+    ("nornicdb_index_partitions", "partitions"),
+    ("nornicdb_index_resident_partitions", "resident_partitions"),
+    ("nornicdb_index_tiered_device_bytes", "tiered_device_bytes"),
+    ("nornicdb_index_disk_bytes", "disk_bytes"),
 )
 
 _HELP = {
@@ -81,6 +85,14 @@ _HELP = {
         "Device bytes of the index's quantized (int8/PQ) plane",
     "nornicdb_index_compression_ratio":
         "float32 bytes replaced / quantized device bytes",
+    "nornicdb_index_partitions":
+        "k-means partitions in the tiered plane's corpus layout",
+    "nornicdb_index_resident_partitions":
+        "Partitions currently holding a device slab (LRU residency)",
+    "nornicdb_index_tiered_device_bytes":
+        "Device bytes of the tiered plane's resident PQ slabs",
+    "nornicdb_index_disk_bytes":
+        "On-disk bytes of the cold partition spill store",
 }
 
 _lock = threading.Lock()

@@ -555,16 +555,19 @@ class CagraIndex:
                 quantize_graph_base,
             )
 
+            # None = a PQ-mode gap (indivisible dims, too few rows to
+            # train honest codebooks): the f32 graph serves instead
             quant = quantize_graph_base(mat)
-            quant["rot_dev"] = jnp.asarray(quant["rot"])
-            # keep 3/4 of each expansion past the head prefilter:
-            # measured (8k x 64d clustered, CPU) recall@10 0.93 at 1/2,
-            # 0.98 at 3/4, 1.00 unpruned — 3/4 clears the 0.95 sentinel
-            # floor with margin while still dropping a quarter of the
-            # full-row gathers
-            quant["keep"] = max(8, env_int(
-                "QUANT_WALK_KEEP",
-                (3 * self.search_width * self.degree) // 4))
+            if quant is not None and quant["mode"] == "int8":
+                quant["rot_dev"] = jnp.asarray(quant["rot"])
+                # keep 3/4 of each expansion past the head prefilter:
+                # measured (8k x 64d clustered, CPU) recall@10 0.93 at
+                # 1/2, 0.98 at 3/4, 1.00 unpruned — 3/4 clears the
+                # 0.95 sentinel floor with margin while still dropping
+                # a quarter of the full-row gathers
+                quant["keep"] = max(8, env_int(
+                    "QUANT_WALK_KEEP",
+                    (3 * self.search_width * self.degree) // 4))
         graph: Dict[str, Any] = {
             "n": n,
             "shards": s,
@@ -725,7 +728,10 @@ class CagraIndex:
                 # quantized base: float32 rows live HOST-side (rerank
                 # gather source); HBM holds codes+head+scale+rotation
                 host_extra += f32_base
-                for key in ("codes", "codes_head", "scale", "rot_dev"):
+                keys = (("codes", "codebooks")
+                        if quant["mode"] == "pq"
+                        else ("codes", "codes_head", "scale", "rot_dev"))
+                for key in keys:
                     quant_b += int(
                         getattr(quant[key], "nbytes", 0) or 0)
                 dev_b += quant_b
@@ -803,6 +809,12 @@ class CagraIndex:
             self._degrade(tier, hold, g)
             return self._brute.search_batch(queries, k)
         p = itopk or self.itopk
+        quant0 = g.get("quant")
+        if quant0 is not None and quant0["mode"] == "pq" and itopk is None:
+            # PQ ADC carries reconstruction noise the int8 rung doesn't:
+            # widen the beam 4x (still pow2) so the exact host rerank of
+            # the pool recovers the true top-k despite noisy navigation
+            p = min(4 * p, 1024)
         if min(k, g["n"]) > p:
             # the pool can only ever hold itopk candidates — a deeper
             # request silently truncated would differ from the brute and
@@ -845,10 +857,16 @@ class CagraIndex:
         if _cost.pricing_enabled():
             quant = g.get("quant")
             if quant is not None:
-                flops, byts = _cost.price_walk_quant(
-                    bb, int(queries.shape[1]), n_iters, w, self.degree,
-                    p, quant["head_dims"], quant["keep"],
-                    n_seeds=self.n_seeds)
+                if quant["mode"] == "pq":
+                    flops, byts = _cost.price_walk_pq(
+                        bb, int(queries.shape[1]), n_iters, w,
+                        self.degree, p, quant["pq_m"],
+                        quant["pq_codes"], n_seeds=self.n_seeds)
+                else:
+                    flops, byts = _cost.price_walk_quant(
+                        bb, int(queries.shape[1]), n_iters, w,
+                        self.degree, p, quant["head_dims"],
+                        quant["keep"], n_seeds=self.n_seeds)
                 rf, rb = _cost.price_rerank(bb, p,
                                             int(queries.shape[1]))
                 flops, byts = flops + rf, byts + rb
@@ -947,15 +965,27 @@ class CagraIndex:
         is exactly re-scored against the host float32 rows before the
         final top-k — approximate scores rank the pool, never an
         answer. Shapes match the float32 walk's (scores, row ids)."""
-        from nornicdb_tpu.search.device_quant import _quant_walk
+        from nornicdb_tpu.search.device_quant import (
+            _pq_walk,
+            _quant_walk,
+        )
 
         q = g["quant"]
-        qp = qn @ q["rot_dev"]  # orthogonal: norms/dots preserved
-        s, i = _quant_walk(
-            qp, q["codes"], q["codes_head"], q["scale"], g["adj"],
-            g["validf"], k=p, iters=n_iters, width=w, itopk=p,
-            hash_bits=self.hash_bits, n_seeds=self.n_seeds,
-            keep=q["keep"])
+        if q["mode"] == "pq":
+            # PQ rung (ISSUE 17 satellite): codes-only ADC walk in the
+            # original basis — M bytes per row in HBM, exact rerank of
+            # the whole pool below is identical to the int8 path
+            s, i = _pq_walk(
+                qn, q["codes"], q["codebooks"], g["adj"], g["validf"],
+                k=p, iters=n_iters, width=w, itopk=p,
+                hash_bits=self.hash_bits, n_seeds=self.n_seeds)
+        else:
+            qp = qn @ q["rot_dev"]  # orthogonal: norms/dots preserved
+            s, i = _quant_walk(
+                qp, q["codes"], q["codes_head"], q["scale"], g["adj"],
+                g["validf"], k=p, iters=n_iters, width=w, itopk=p,
+                hash_bits=self.hash_bits, n_seeds=self.n_seeds,
+                keep=q["keep"])
         s_h, i_h = np.asarray(s), np.asarray(i)
         qh = np.asarray(qn)
         gathered = g["matrix"][i_h]  # host f32 [B, itopk, D]

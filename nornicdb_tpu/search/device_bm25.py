@@ -86,8 +86,8 @@ def bm25_dense_scores(
     urow: jnp.ndarray,  # [P] int32 unique-term row per entry
     sel: jnp.ndarray,  # [B, U] f32 idf-weighted term-selection matrix
     post_doc: jnp.ndarray,  # [Pcap] int32 doc row per posting
-    post_tf: jnp.ndarray,  # [Pcap] f32 term frequency per posting
-    doc_len: jnp.ndarray,  # [C] f32
+    post_tf: jnp.ndarray,  # [Pcap] f32 OR uint16 term freq per posting
+    doc_len: jnp.ndarray,  # [C] f32 OR uint16
     alive_f: jnp.ndarray,  # [C] f32 {0,1}
     avgdl: jnp.ndarray,  # scalar f32
 ) -> jnp.ndarray:
@@ -105,9 +105,13 @@ def bm25_dense_scores(
     positive, so `score > 0` IS the touched-by-a-query-term mask."""
     u = sel.shape[1]
     c = doc_len.shape[0]
+    # cast AFTER the gather: tf and doc-len are integer counts, so the
+    # quantized (uint16) CSR columns are exactly lossless below 65536 —
+    # HBM holds 2-byte columns, the Okapi arithmetic stays float32
+    # bit-identical (PR 8 headroom; f32 columns pass through unchanged)
     d = post_doc[ptr]
-    tf = post_tf[ptr]
-    dl = doc_len[d]
+    tf = post_tf[ptr].astype(jnp.float32)
+    dl = doc_len[d].astype(jnp.float32)
     tf_norm = tf * (K1 + 1.0) / (tf + K1 * (1.0 - B + B * dl / avgdl))
     # padding entries carry urow == U and land in a discarded overflow
     # row, so they can never corrupt a real (term, doc) cell
@@ -192,10 +196,20 @@ class DeviceBM25:
         min_n: int = 256,
         rebuild_stale_frac: float = 0.1,
         build_inline: bool = True,
+        quant_cols: Optional[bool] = None,
     ):
         self.bm25 = bm25
         self.n_shards = max(1, n_shards)
         self.min_n = min_n
+        if quant_cols is None:
+            # captured once at construction (init-time env read, PR 14
+            # hot-path contract): store the tf/doc-len CSR columns as
+            # uint16 — exactly lossless for integer counts below 65536;
+            # a corpus exceeding that falls back to f32 per column
+            from nornicdb_tpu.config import env_bool
+
+            quant_cols = env_bool("BM25_QUANT", True)
+        self.quant_cols = bool(quant_cols)
         self.rebuild_stale_frac = rebuild_stale_frac
         # build_inline=False defers even the first build to a background
         # thread (read-path wiring: the host index serves until the
@@ -295,6 +309,21 @@ class DeviceBM25:
             slot_all[sh * c_local: sh * c_local + cnt] = \
                 base["slots"][lo:hi]
 
+        # quantized CSR columns (PR 8 headroom): tf and doc-len are
+        # integer counts, so uint16 storage is EXACTLY lossless below
+        # 65536 (the kernel casts to f32 after the gather; idf stays
+        # exact from the host plan's live-df counters). A column whose
+        # max clears the range keeps f32 — degrade is per column and
+        # the score arithmetic is bit-identical either way.
+        tf_dtype = np.float32
+        dl_dtype = np.float32
+        if self.quant_cols:
+            if not pt_all.size or float(pt_all.max()) < 65536.0:
+                tf_dtype = np.uint16
+            if not doc_len_all.size or float(doc_len_all.max()) < 65536.0:
+                dl_dtype = np.uint16
+            if tf_dtype is np.uint16 or dl_dtype is np.uint16:
+                _LEX_C.labels("quant_cols").inc()
         snap = {
             "n": n,
             "shards": s_n,
@@ -303,14 +332,16 @@ class DeviceBM25:
             "vocab": base["vocab"],
             "off_sh": off_sh,
             "post_doc": jnp.asarray(pd_all.reshape(-1)),
-            "post_tf": jnp.asarray(pt_all.reshape(-1)),
-            "doc_len": jnp.asarray(doc_len_all),
+            "post_tf": jnp.asarray(pt_all.reshape(-1).astype(tf_dtype)),
+            "doc_len": jnp.asarray(doc_len_all.astype(dl_dtype)),
             "alive_np": alive_all,
             "alive": jnp.asarray(alive_all),
             "alive_gen": gen,
             "row_ids": row_ids_all,
             "slots": slot_all,
             "built_gen": gen,
+            "cols_quant": 1.0 if (tf_dtype is np.uint16
+                                  or dl_dtype is np.uint16) else 0.0,
         }
         if s_n > 1 and len(jax.devices()) >= s_n:
             # place the snapshot on the mesh ONCE (cagra discipline): a
@@ -386,6 +417,7 @@ class DeviceBM25:
             "snapshot_n": snap["n"] if snap else 0,
             "shards": snap["shards"] if snap else 0,
             "builds": self.builds,
+            "cols_quant": snap.get("cols_quant", 0.0) if snap else 0.0,
         }
 
     def resource_stats(self) -> Dict[str, Any]:

@@ -352,6 +352,30 @@ def _walk_fused_single_q(ptr, urow, sel, post_doc, post_tf, doc_len,
     return ls, lgrow, vs, vi
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "kq", "iters", "width", "itopk", "hash_bits", "n_seeds"))
+def _walk_fused_single_pq(ptr, urow, sel, post_doc, post_tf, doc_len,
+                          alive_f, l2g, avgdl, qn, codes, codebooks,
+                          gadj, gvalidf, kq, iters, width, itopk,
+                          hash_bits, n_seeds):
+    """Walk tier over a PQ graph base (ISSUE 17 satellite): the
+    codes-only ADC walk replaces the int8 two-stage walk inside the
+    same compiled program — HBM holds M bytes per graph row. The pool
+    rides out for the exact host rerank and the host re-fuse replaces
+    the device fuse, exactly as in :func:`_walk_fused_single_q`."""
+    from nornicdb_tpu.search.device_quant import _walk_body_pq
+
+    ls, _lid, lgrow = _lex_parts_impl(ptr, urow, sel, post_doc,
+                                      post_tf, doc_len, alive_f, l2g,
+                                      avgdl, jnp.int32(0), kq=kq)
+    vs, vi = _walk_body_pq(qn, codes, codebooks, gadj, gvalidf,
+                           itopk, iters, width, itopk, hash_bits,
+                           n_seeds)
+    ls = _pad_cols(ls, kq, NEG_INF)
+    lgrow = _pad_cols(lgrow, kq, 0)
+    return ls, lgrow, vs, vi
+
+
 # ---------------------------------------------------------------------------
 # the walk tier: CAGRA greedy walk instead of the brute matmul
 # ---------------------------------------------------------------------------
@@ -1020,7 +1044,23 @@ class FusedHybrid:
                        n_seeds=wctx["n_seeds"])
         quant = g.get("quant") if snap["shards"] == 1 else None
         t0 = time.time()
-        if quant is not None:
+        if quant is not None and quant["mode"] == "pq":
+            # PQ graph base (ISSUE 17): the codes-only ADC walk runs
+            # inside the same compiled program; exact pool rerank and
+            # host re-fuse below are shared with the int8 path
+            q_statics = dict(statics)
+            del q_statics["rrf_k"]
+            # 4x pool (matches cagra's PQ widening): ADC reconstruction
+            # noise needs a wider pool for the exact rerank to recover
+            q_statics["itopk"] = min(4 * q_statics["itopk"], 1024)
+            kp = q_statics["itopk"]
+            ls, li, vs, vi = _walk_fused_single_pq(
+                *lex_base, wctx["l2g"], jnp.float32(avgdl), qn,
+                quant["codes"], quant["codebooks"],
+                g["adj"], g["validf"], **q_statics)
+            lgrow = li
+            fs = fpos = None
+        elif quant is not None:
             # quantized graph base: the two-stage int8 walk runs inside
             # the same compiled program; the pool is exact-reranked
             # below from the HOST-resident float32 rows, and the host
@@ -1072,12 +1112,19 @@ class FusedHybrid:
         record_dispatch(kind, pow2_bucket(b), kp, t1 - t0)
         _HYB_C.labels("walk_dispatch").inc()
         if quant is not None:
-            d_model = int(quant["codes"].shape[1])
-            vf, vb = _cost.price_walk_quant(
-                pow2_bucket(b), d_model, wctx["iters"], wctx["width"],
-                int(g["adj"].shape[1]), wctx["itopk"],
-                quant["head_dims"], quant["keep"],
-                n_seeds=wctx["n_seeds"])
+            d_model = int(qn.shape[1])
+            if quant["mode"] == "pq":
+                vf, vb = _cost.price_walk_pq(
+                    pow2_bucket(b), d_model, wctx["iters"],
+                    wctx["width"], int(g["adj"].shape[1]),
+                    kp, quant["pq_m"], quant["pq_codes"],
+                    n_seeds=wctx["n_seeds"])
+            else:
+                vf, vb = _cost.price_walk_quant(
+                    pow2_bucket(b), d_model, wctx["iters"],
+                    wctx["width"], int(g["adj"].shape[1]),
+                    wctx["itopk"], quant["head_dims"], quant["keep"],
+                    n_seeds=wctx["n_seeds"])
             rf, rb = _cost.price_rerank(pow2_bucket(b), kp, d_model)
             self._record_cost(kind, b, snap,
                               vec_flops_bytes=(vf + rf, vb + rb))

@@ -819,6 +819,23 @@ class SearchService:
             hnsw = self.hnsw
             cagra = self.cagra
         if not exact:
+            if lexical_doc_ids \
+                    and hasattr(self.vectors, "_tiered_search_batch"):
+                # beyond-HBM tier (ISSUE 17): hybrid lexical+semantic
+                # cluster routing — the BM25 top docs bias the probe
+                # set toward partitions the lexical half already ranked.
+                # Direct (un-coalesced) call: probe hints are per-query
+                # and cannot ride a shared micro-batch. None = plane
+                # off/cold/degraded; fall through to the ladder below.
+                out = self.vectors._tiered_search_batch(
+                    np.asarray([query_vec], dtype=np.float32), k,
+                    lex_hints=[list(lexical_doc_ids)])
+                if out is not None:
+                    _STRATEGY_C.labels("tiered_route").inc()
+                    tier = _audit.consume_batch_tier()
+                    _audit.record_served("vector",
+                                         tier or "vector_tiered")
+                    return out[0]
             if cagra is not None:
                 # device graph walk, micro-batched: concurrent b=1
                 # queries coalesce into one pow2-bucketed walk dispatch

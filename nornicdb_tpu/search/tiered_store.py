@@ -1,0 +1,764 @@
+"""Tiered vector storage: cluster-routed demand paging beyond HBM.
+
+PR 8's quantization ladder shrank the device bytes per vector; this
+module shrinks the *fraction of the corpus* that has to be device-
+resident at all — the reference's VectorFileStore + IVF-HNSW cluster
+routing discipline (SURVEY §2.3), the standard capacity escape hatch of
+the GPU graph-vector-search taxonomy:
+
+- **Partitioning**: the corpus is clustered by the shared seeded
+  device k-means (``ops.kmeans.kmeans_fit`` — the same implementation
+  the IVF backends and PQ sampling train through), one partition per
+  centroid. Every partition spills to the disk partition store
+  (``storage/partition_store.py``) at build time: slots, ext ids,
+  float32 rows and PQ codes.
+- **Residency ladder**: HBM holds PQ codes for at most
+  ``resident_max`` partitions, laid out in FIXED device slabs (one
+  pow2-padded slab per resident partition) so residency churn never
+  changes a compiled shape. Float32 exact-rerank rows stay in host RAM
+  (the ``BruteForceIndex`` matrix is the pinned source of truth,
+  served through ``rows_for_slots`` gathers). Cold partitions live on
+  disk until the background pager promotes them.
+- **Routing**: each query scores the partition centroids (one small
+  host matmul) plus an optional lexical bonus for partitions holding
+  the query's BM25 top docs — the reference's hybrid lexical+semantic
+  cluster probing — and touches its best ``nprobe`` partitions.
+  Resident probes run as ONE masked ADC dispatch over the slab array;
+  non-resident probes are answered by an exact host side-scan of those
+  partitions' current rows and recorded as a ``tiered_cold`` degrade
+  (the ladder is tiered -> quant -> f32 -> host: a cold partition
+  costs latency, never a wrong answer) while the pager promotes them
+  under the background admission lane with per-job cost accounting.
+- **Freshness** (the PR 2/4/6/8 discipline): the plane is a
+  mutation-generation snapshot of its brute index. Compaction remaps,
+  changelog overruns, mid-rerank races and mid-dispatch residency
+  churn (a promotion/eviction landing while a batch is in flight —
+  the ``residency_gen`` re-check) all degrade to the next rung; adds
+  and updates ride the changelog into an exact side-scan; deletes are
+  live-filtered at the rerank gather.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nornicdb_tpu.obs import REGISTRY, declare_kind, record_dispatch
+from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import cost as _cost
+from nornicdb_tpu.ops.kmeans import kmeans_fit
+from nornicdb_tpu.ops.similarity import NEG_INF, l2_normalize, pad_dim
+from nornicdb_tpu.search.device_quant import (
+    _pq_adc_scores,
+    encode_pq,
+    train_pq,
+)
+from nornicdb_tpu.search.microbatch import pow2_bucket
+from nornicdb_tpu.storage.partition_store import PartitionStore
+
+# tiered-plane lifecycle, residency churn and per-search freshness
+# decisions — same observability contract as the quant/cagra tiers
+_TIERED_C = REGISTRY.counter(
+    "nornicdb_tiered_events_total",
+    "Tiered plane lifecycle, partition paging and freshness decisions",
+    labels=("event",))
+
+declare_kind("tiered_adc")
+declare_kind("tiered_rerank")
+
+# globally unique plane build sequence (GIL-atomic), mirroring
+# device_quant._BUILD_SEQ
+_BUILD_SEQ = itertools.count(1)
+
+
+def tiered_enabled() -> bool:
+    """NORNICDB_VECTOR_TIERED=1 turns the tiered plane on; default off
+    (the quant/f32 rungs serve)."""
+    from nornicdb_tpu.config import env_bool
+
+    return env_bool("VECTOR_TIERED", False)
+
+
+def tiered_min_n() -> int:
+    """Corpus floor below which the tiered plane never engages — small
+    corpora fit device-resident through the quant/f32 rungs already."""
+    from nornicdb_tpu.config import env_int
+
+    return max(1, env_int("TIERED_MIN_N", 4096))
+
+
+# ---------------------------------------------------------------------------
+# the masked slab ADC dispatch
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _tiered_topk_impl(qn, codes_t, codebooks, valid, sel, k):
+    """Partition-masked ADC top-k over the resident slab array.
+
+    ``codes_t`` is ``[M, R*S]`` uint8 (R fixed slabs of S padded slots
+    each), ``sel`` is the per-query ``[B, R]`` probe mask. Scores are
+    computed over the WHOLE slab (one compiled shape regardless of
+    which partitions are probed or resident) and masked to each query's
+    selected slabs — routing changes data, never the program."""
+    scores = _pq_adc_scores(qn, codes_t, codebooks)  # [B, R*S]
+    s = valid.shape[0] // sel.shape[1]
+    mask = jnp.repeat(sel, s, axis=1) & valid[None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    return jax.lax.top_k(scores, k)
+
+
+# ---------------------------------------------------------------------------
+# the serving plane
+# ---------------------------------------------------------------------------
+
+
+class TieredStore:
+    """Cluster-partitioned tiered serving plane over a
+    ``BruteForceIndex``.
+
+    Device: PQ codes of the resident partitions (fixed slab layout).
+    Host: the brute index's float32 matrix (exact rerank + cold scan
+    source). Disk: every partition's payload, read back by the
+    background pager. All knobs are captured at construction — the
+    per-request path never reads the environment (PR 14 contract).
+    """
+
+    def __init__(
+        self,
+        brute,
+        nprobe: int = 8,
+        parts: int = 0,
+        resident_max: int = 0,
+        part_rows: int = 4096,
+        lex_bonus: float = 0.15,
+        min_n: Optional[int] = None,
+        rebuild_stale_frac: float = 0.1,
+        build_inline: bool = False,
+        pq_m: Optional[int] = None,
+        pq_codes: int = 256,
+        overfetch: int = 8,
+        min_pool: int = 128,
+        root_dir: Optional[str] = None,
+    ):
+        self.brute = brute
+        self.nprobe = max(1, nprobe)
+        self.parts = max(0, parts)  # 0 = auto from part_rows
+        self.resident_max = max(0, resident_max)  # 0 = all resident
+        self.part_rows = max(256, part_rows)
+        self.lex_bonus = float(lex_bonus)
+        self.min_n = tiered_min_n() if min_n is None else max(1, min_n)
+        self.rebuild_stale_frac = rebuild_stale_frac
+        self.build_inline = build_inline
+        self.pq_m = pq_m
+        self.pq_codes = pq_codes
+        self.overfetch = max(1, overfetch)
+        self.min_pool = max(1, min_pool)
+        self.store = PartitionStore(root_dir)
+        self._snap: Optional[Dict[str, Any]] = None
+        self._build_lock = threading.Lock()
+        self._rebuilding = False
+        self._rebuild_started = 0.0
+        self._rebuild_flag_lock = threading.Lock()
+        # residency state lock: resident map, slab tables and the
+        # residency generation move together under it
+        self._res_lock = threading.Lock()
+        self._page_pending: Set[int] = set()
+        self._paging = False
+        self._page_lock = threading.Lock()
+        self.builds = 0
+        self.promotions = 0
+        self.evictions = 0
+        self.cold_scans = 0
+
+    # -- build ------------------------------------------------------------
+
+    def _pq_m_for(self, d: int) -> int:
+        m = self.pq_m or max(4, min(64, d // 4))
+        while m > 1 and d % m != 0:
+            m -= 1
+        return max(1, m)
+
+    def _n_parts_for(self, n_alive: int) -> int:
+        if self.parts:
+            return max(2, self.parts)
+        return max(2, min(128, n_alive // self.part_rows))
+
+    def build(self) -> bool:
+        with self._build_lock:
+            return self._build_locked()
+
+    def _build_locked(self) -> bool:
+        brute = self.brute
+        mutations = getattr(brute, "mutations", 0)
+        snap = self._snap
+        if snap is not None and snap["built_mutations"] == mutations:
+            return True  # raced another builder; already fresh
+        matrix, valid, ext_ids = brute.snapshot()
+        n_alive = int(valid.sum())
+        if n_alive < self.min_n:
+            self._snap = None
+            return False
+        cap, d = matrix.shape
+        k_parts = self._n_parts_for(n_alive)
+        # the shared seeded k-means partitioner (cosine; rows are
+        # stored normalized) — same implementation as the IVF backends
+        res = kmeans_fit(matrix, k=k_parts, valid=valid, seed=0)
+        assign = res.assignments  # [cap] int32, -1 for dead/pad slots
+        k_parts = res.centroids.shape[0]
+        part_slots: List[np.ndarray] = []
+        for pid in range(k_parts):
+            part_slots.append(
+                np.nonzero(assign == pid)[0].astype(np.int64))
+        max_rows = max((len(s) for s in part_slots), default=1)
+        slab_rows = pad_dim(max(max_rows, 1))
+        r_slabs = (min(k_parts, self.resident_max)
+                   if self.resident_max else k_parts)
+        m = self._pq_m_for(d)
+        live_rows = matrix[valid] if n_alive < cap else matrix
+        codebooks = train_pq(live_rows, m, self.pq_codes)
+        codes_all = encode_pq(matrix, codebooks)  # [cap, M]
+        # lexical routing table: ext id -> owning partition
+        pid_of_ext: Dict[str, int] = {}
+        for pid, slots in enumerate(part_slots):
+            for s in slots:
+                eid = ext_ids[int(s)]
+                if eid is not None:
+                    pid_of_ext[eid] = pid
+        # spill EVERY partition to disk (the cold tier; promotion and
+        # crash recovery both read from here)
+        for pid, slots in enumerate(part_slots):
+            self.store.save_partition(
+                pid, slots,
+                [ext_ids[int(s)] or "" for s in slots],
+                matrix[slots], codes_all[slots])
+        snap = {
+            "capacity": cap,
+            "dims": d,
+            "rows": n_alive,
+            "parts": k_parts,
+            "slab_rows": slab_rows,
+            "r_slabs": r_slabs,
+            "pq_m": m,
+            "pq_codes": self.pq_codes,
+            "codebooks": jnp.asarray(codebooks),
+            "centroids": np.asarray(res.centroids, dtype=np.float32),
+            "part_slots": part_slots,
+            "pid_of_ext": pid_of_ext,
+            "built_mutations": mutations,
+            "built_compactions": getattr(brute, "compactions", 0),
+            "build_seq": next(_BUILD_SEQ),
+            # residency state (guarded by _res_lock after publish)
+            "resident": {},
+            "slab_pid": [-1] * r_slabs,
+            "slab_slots": np.full((r_slabs, slab_rows), -1,
+                                  dtype=np.int64),
+            "lru": [],
+            "residency_gen": 0,
+        }
+        codes_slab = np.zeros((r_slabs * slab_rows, m), dtype=np.uint8)
+        slab_valid = np.zeros((r_slabs * slab_rows,), dtype=bool)
+        # initial residency: largest partitions first — they carry the
+        # most probe mass until real traffic reorders the LRU
+        order = sorted(range(k_parts),
+                       key=lambda p: -len(part_slots[p]))[:r_slabs]
+        for slab_idx, pid in enumerate(order):
+            slots = part_slots[pid]
+            n_p = len(slots)
+            lo = slab_idx * slab_rows
+            codes_slab[lo: lo + n_p] = codes_all[slots]
+            slab_valid[lo: lo + n_p] = True
+            snap["slab_slots"][slab_idx, :n_p] = slots
+            snap["resident"][pid] = slab_idx
+            snap["slab_pid"][slab_idx] = pid
+            snap["lru"].append(pid)
+        snap["codes_t"] = jnp.asarray(
+            np.ascontiguousarray(codes_slab.T))  # [M, R*S]
+        snap["slab_valid"] = jnp.asarray(slab_valid)
+        snap["device_bytes"] = (
+            r_slabs * slab_rows * m  # uint8 slab codes
+            + r_slabs * slab_rows  # slab validity
+            + int(snap["codebooks"].nbytes))
+        self._snap = snap
+        self.builds += 1
+        _TIERED_C.labels("build").inc()
+        return True
+
+    def _kick_background_rebuild(self) -> None:
+        with self._rebuild_flag_lock:
+            if self._rebuilding:
+                return
+            self._rebuilding = True
+            self._rebuild_started = time.time()
+        _TIERED_C.labels("background_rebuild").inc()
+
+        def run():
+            from nornicdb_tpu import admission as _adm
+
+            try:
+                # background maintenance lane (ISSUE 15): any coalescer
+                # ride from this thread seals behind interactive work
+                with _adm.lane_scope(_adm.LANE_BACKGROUND):
+                    self.build()
+            finally:
+                # same lock as the set above: an unguarded clear can
+                # interleave with a concurrent kick's read-then-set
+                with self._rebuild_flag_lock:
+                    self._rebuilding = False
+                    self._rebuild_started = 0.0
+
+        t = threading.Thread(target=run, name="tiered-rebuild",
+                             daemon=True)
+        t.start()
+
+    def ensure(self) -> Optional[Dict[str, Any]]:
+        """Current plane snapshot under the background-rebuild policy,
+        or None while a lower rung must serve."""
+        snap = self._snap
+        mutations = getattr(self.brute, "mutations", 0)
+        if snap is not None:
+            churn = mutations - snap["built_mutations"]
+            if churn > self.rebuild_stale_frac * max(snap["rows"], 1):
+                self._kick_background_rebuild()
+            return snap
+        if not self.build_inline:
+            self._kick_background_rebuild()
+            return self._snap
+        self.build()
+        return self._snap
+
+    @property
+    def plane_built(self) -> bool:
+        return self._snap is not None
+
+    # -- residency / paging -----------------------------------------------
+
+    def _install_partition_locked(self, snap: Dict[str, Any],
+                                  pid: int) -> bool:
+        """Promote one partition into a device slab (res_lock held).
+        Picks a free slab or evicts the LRU partition. Returns False
+        when the payload cannot be read back (the partition simply
+        stays cold — host scan keeps answering)."""
+        if pid in snap["resident"]:
+            return True
+        payload = self.store.load_partition(pid)
+        if payload is None:
+            _TIERED_C.labels("promote_miss").inc()
+            return False
+        slab_rows = snap["slab_rows"]
+        slab_idx = None
+        for i, owner in enumerate(snap["slab_pid"]):
+            if owner < 0:
+                slab_idx = i
+                break
+        if slab_idx is None:
+            victim = snap["lru"].pop(0)
+            slab_idx = snap["resident"].pop(victim)
+            self.evictions += 1
+            _TIERED_C.labels("evict").inc()
+        lo = slab_idx * slab_rows
+        n_p = len(payload["slots"])
+        codes = np.zeros((slab_rows, snap["pq_m"]), dtype=np.uint8)
+        codes[:n_p] = payload["codes"]
+        vmask = np.zeros((slab_rows,), dtype=bool)
+        vmask[:n_p] = True
+        # functional device update: the old arrays stay immutable under
+        # any in-flight dispatch; the swap below is what the
+        # residency_gen re-check observes
+        snap["codes_t"] = snap["codes_t"].at[:, lo: lo + slab_rows].set(
+            jnp.asarray(np.ascontiguousarray(codes.T)))
+        snap["slab_valid"] = snap["slab_valid"] \
+            .at[lo: lo + slab_rows].set(jnp.asarray(vmask))
+        snap["slab_slots"][slab_idx] = -1
+        snap["slab_slots"][slab_idx, :n_p] = payload["slots"]
+        snap["resident"][pid] = slab_idx
+        snap["slab_pid"][slab_idx] = pid
+        snap["lru"].append(pid)
+        snap["residency_gen"] += 1
+        self.promotions += 1
+        _TIERED_C.labels("promote").inc()
+        # per-job paging cost (PR 7 accounting): bytes = the slab codes
+        # written to device + the payload read from disk; one "query"
+        # per page job so bytes-per-job aggregates cleanly
+        _cost.record_query_cost(
+            "tiered_page", _cost.cost_name(self.brute), 1, 0.0,
+            float(slab_rows * snap["pq_m"]
+                  + payload["rows"].nbytes + payload["codes"].nbytes))
+        return True
+
+    def promote_inline(self, pids: Sequence[int]) -> int:
+        """Synchronous promotion (tests / warmup): returns how many
+        partitions were installed."""
+        snap = self._snap
+        if snap is None:
+            return 0
+        done = 0
+        with self._res_lock:
+            for pid in pids:
+                if 0 <= pid < snap["parts"] \
+                        and self._install_partition_locked(snap, pid):
+                    done += 1
+        return done
+
+    def _kick_promote(self, pids: Sequence[int]) -> None:
+        """Queue cold partitions for background promotion; one pager
+        thread drains the pending set under the background lane."""
+        with self._page_lock:
+            self._page_pending.update(int(p) for p in pids)
+            if self._paging or not self._page_pending:
+                return
+            self._paging = True
+
+        def run():
+            from nornicdb_tpu import admission as _adm
+
+            try:
+                with _adm.lane_scope(_adm.LANE_BACKGROUND):
+                    while True:
+                        with self._page_lock:
+                            if not self._page_pending:
+                                self._paging = False
+                                return
+                            pid = self._page_pending.pop()
+                        self.promote_inline([pid])
+            except BaseException:
+                with self._page_lock:
+                    self._paging = False
+                raise
+
+        t = threading.Thread(target=run, name="tiered-pager",
+                             daemon=True)
+        t.start()
+
+    # -- routing ----------------------------------------------------------
+
+    def route(
+        self,
+        qn: np.ndarray,
+        snap: Dict[str, Any],
+        lex_hints: Optional[Sequence[Optional[Sequence[str]]]] = None,
+    ) -> np.ndarray:
+        """Per-query probe set [B, nprobe]: hybrid lexical+semantic
+        cluster scoring. Semantic = query-centroid cosine; lexical =
+        a flat bonus for partitions owning the query's BM25 top docs
+        (the reference's IVF-HNSW hybrid probe selection). Host-side
+        and environment-free — this runs once per request."""
+        scores = qn @ snap["centroids"].T  # [B, K]
+        if lex_hints is not None:
+            pid_of_ext = snap["pid_of_ext"]
+            for i, hints in enumerate(lex_hints):
+                if not hints or i >= scores.shape[0]:
+                    continue
+                for eid in hints:
+                    pid = pid_of_ext.get(eid)
+                    if pid is not None:
+                        scores[i, pid] += self.lex_bonus
+        nprobe = min(self.nprobe, snap["parts"])
+        probe = np.argpartition(-scores, nprobe - 1,
+                                axis=1)[:, :nprobe]
+        # deterministic probe order (score desc, pid asc) so tests and
+        # the cold-scan accounting are stable
+        row_scores = np.take_along_axis(scores, probe, axis=1)
+        order = np.lexsort((probe, -row_scores), axis=1)
+        return np.take_along_axis(probe, order, axis=1)
+
+    def pool_for(self, k: int, snap: Dict[str, Any]) -> int:
+        """ADC rerank pool width: max(overfetch*k, min_pool) with the
+        PQ capacity floor (same rationale as QuantizedBrutePlane —
+        ADC rank noise grows with slab capacity and codebook
+        coarseness), clamped to the slab capacity."""
+        slab_cap = snap["r_slabs"] * snap["slab_rows"]
+        floor = max(k * self.overfetch, self.min_pool,
+                    slab_cap // min(snap["pq_codes"], 256))
+        return min(pow2_bucket(floor), slab_cap)
+
+    # -- accounting -------------------------------------------------------
+
+    def resource_stats_extra(self) -> Dict[str, Any]:
+        """The tiered keys BruteForceIndex.resource_stats merges:
+        partition/residency census, the device slab footprint, the
+        disk spill footprint and the effective-capacity ratio vs the
+        all-device float32 baseline."""
+        snap = self._snap
+        if snap is None:
+            return {"partitions": 0, "resident_partitions": 0,
+                    "tiered_device_bytes": 0,
+                    "disk_bytes": self.store.disk_bytes()}
+        with self._res_lock:
+            resident = len(snap["resident"])
+        f32_b = snap["capacity"] * snap["dims"] * 4
+        dev_b = snap["device_bytes"]
+        return {
+            "partitions": snap["parts"],
+            "resident_partitions": resident,
+            "tiered_device_bytes": dev_b,
+            "disk_bytes": self.store.disk_bytes(),
+            "tiered_capacity_ratio": round(f32_b / max(dev_b, 1), 3),
+            "promotions": self.promotions,
+            "evictions": self.evictions,
+            "cold_scans": self.cold_scans,
+        }
+
+    # -- serving ----------------------------------------------------------
+
+    def search_batch(
+        self,
+        queries: np.ndarray,
+        k: int = 10,
+        lex_hints: Optional[Sequence[Optional[Sequence[str]]]] = None,
+    ) -> Optional[List[List[Tuple[str, float]]]]:
+        """Cluster-routed coarse-then-exact batched search, or None
+        when a lower rung must serve this batch. Resident probes run
+        one masked ADC dispatch + exact host rerank; cold probes are
+        host-scanned exactly (one ``tiered_cold`` ledger record per
+        batch) and queued for background promotion. Every answered
+        path is exact-rescored and live-filtered."""
+        brute = self.brute
+        snap = self.ensure()
+        if snap is None:
+            return None
+        tier = "vector_tiered"
+        hold = None
+        if not _audit.tier_allowed(tier):
+            # shadow-parity quarantine: step down to the quant/f32
+            # rungs until the breach clears
+            hold = "quarantine"
+        elif not _audit.admission_allows(tier):
+            # admission posture (ISSUE 15): overload forces the
+            # capacity rung down to shrink paging + device pressure
+            hold = "admission"
+        if hold is not None:
+            _TIERED_C.labels("degrade_quarantine").inc()
+            self._degrade(tier, hold, snap)
+            return None
+        if snap["built_compactions"] != getattr(brute, "compactions", 0):
+            # a compaction remapped the slot space: slab slot ids no
+            # longer address the live matrix
+            _TIERED_C.labels("degrade_compaction").inc()
+            self._degrade(tier, "compaction", snap)
+            self._kick_background_rebuild()
+            return None
+        delta = brute.changed_since(snap["built_mutations"])
+        if delta is None:
+            _TIERED_C.labels("degrade_changelog").inc()
+            self._degrade(tier, "changelog_overrun", snap)
+            self._kick_background_rebuild()
+            return None
+        n_alive = len(brute)
+        if n_alive == 0:
+            return [[] for _ in range(len(queries))]
+        k_eff = min(k, n_alive)
+        b = len(queries)
+        bb = pow2_bucket(max(b, 1))
+        pool = self.pool_for(k, snap)
+        queries = np.asarray(queries, dtype=np.float32)
+        if bb != b:
+            queries = np.concatenate(
+                [queries,
+                 np.broadcast_to(queries[:1],
+                                 (bb - b,) + queries.shape[1:])],
+                axis=0)
+        qn = np.asarray(l2_normalize(jnp.asarray(queries)))
+        probe = self.route(qn[:b], snap, lex_hints)
+        if _cost.pricing_enabled():
+            flops, byts = _cost.price_tiered_route(
+                bb, snap["parts"], snap["dims"])
+            _cost.record_query_cost(
+                "tiered_route", _cost.cost_name(brute), b, flops, byts)
+
+        # capture a CONSISTENT residency view under one lock hold: the
+        # probe mask, the slab->slot table copy and the generation all
+        # describe the same residency state
+        r_slabs = snap["r_slabs"]
+        cold_need: List[Set[int]] = [set() for _ in range(b)]
+        sel = np.zeros((bb, r_slabs), dtype=bool)
+        with self._res_lock:
+            gen0 = snap["residency_gen"]
+            codes_t = snap["codes_t"]
+            slab_valid = snap["slab_valid"]
+            slab_slots = snap["slab_slots"].copy()
+            resident = dict(snap["resident"])
+            for i in range(b):
+                for pid in probe[i]:
+                    slab = resident.get(int(pid))
+                    if slab is None:
+                        cold_need[i].add(int(pid))
+                    else:
+                        sel[i, slab] = True
+            # LRU touch for probed resident partitions
+            touched = {int(p) for row in probe for p in row
+                       if int(p) in resident}
+            if touched:
+                snap["lru"] = ([p for p in snap["lru"]
+                                if p not in touched]
+                               + [p for p in snap["lru"]
+                                  if p in touched])
+
+        s = slots = None
+        if sel.any():
+            t0 = time.time()
+            s, cells = _tiered_topk_impl(
+                jnp.asarray(qn), codes_t, snap["codebooks"],
+                slab_valid, jnp.asarray(sel), k=pool)
+            # force inside the timed window (async dispatch)
+            s, cells = np.asarray(s), np.asarray(cells)
+            record_dispatch("tiered_adc", bb, pool, time.time() - t0)
+            if _cost.pricing_enabled():
+                flops, byts = _cost.price_pq_adc(
+                    bb, r_slabs * snap["slab_rows"], snap["pq_m"],
+                    snap["pq_codes"], snap["dims"] // snap["pq_m"])
+                _cost.record_query_cost(
+                    "tiered_adc", _cost.cost_name(brute), b, flops,
+                    byts)
+            # mid-page eviction race: a promotion/eviction that landed
+            # while the dispatch was in flight invalidates the
+            # captured residency view — degrade, never mis-join
+            with self._res_lock:
+                raced = snap["residency_gen"] != gen0
+            if raced:
+                _TIERED_C.labels("degrade_paging_race").inc()
+                self._degrade(tier, "paging_race", snap)
+                return None
+            s = s[:b]
+            flat = slab_slots.reshape(-1)
+            slots = flat[np.asarray(cells)[:b]]
+            slots[s < 0.5 * NEG_INF] = -1
+
+        # exact rerank of the resident pool against the CURRENT host
+        # float32 rows (one lock hold; compaction-checked)
+        exact_u = inv = None
+        uniq = np.asarray([], dtype=np.int64)
+        alive_u: np.ndarray = np.asarray([], dtype=bool)
+        ids_u: List[Optional[str]] = []
+        if slots is not None:
+            uniq = np.unique(slots[slots >= 0])
+            if uniq.size:
+                got = brute.rows_for_slots(
+                    uniq, expect_compactions=snap["built_compactions"])
+                if got is None:
+                    _TIERED_C.labels("degrade_rerank_race").inc()
+                    self._degrade(tier, "rerank_race", snap)
+                    return None
+                rows_u, alive_u, ids_u = got
+                if _cost.pricing_enabled():
+                    flops, byts = _cost.price_rerank(
+                        bb, pool, snap["dims"])
+                    _cost.record_query_cost(
+                        "tiered_rerank", _cost.cost_name(brute), b,
+                        flops, byts)
+                t0 = time.time()
+                exact_u = qn[:b] @ rows_u.T
+                inv = np.searchsorted(uniq, np.clip(slots, 0, None))
+                record_dispatch("tiered_rerank", bb, pool,
+                                time.time() - t0)
+
+        # cold partitions: exact host side-scan of their CURRENT rows,
+        # one ledger record per batch, promotion queued in background
+        cold_pids = sorted({p for need in cold_need for p in need})
+        cold_scores = cold_pid_of = cold_ids = cold_alive = None
+        cold_slots = np.asarray([], dtype=np.int64)
+        if cold_pids:
+            self.cold_scans += 1
+            _TIERED_C.labels("cold_scan").inc()
+            # the ONE structured record for this batch's cold probes:
+            # those partitions served through the host-scan rung
+            _audit.record_degrade(
+                "vector", tier, _audit.TIER_HOST, "tiered_cold",
+                index=_cost.cost_name(brute),
+                versions={"built_mutations": snap["built_mutations"],
+                          "built_compactions":
+                              snap["built_compactions"],
+                          "build_seq": snap["build_seq"],
+                          "residency_gen": gen0})
+            cold_slots = np.concatenate(
+                [snap["part_slots"][p] for p in cold_pids])
+            cold_pid_of = np.concatenate(
+                [np.full(len(snap["part_slots"][p]), p,
+                         dtype=np.int64) for p in cold_pids])
+            got = brute.rows_for_slots(
+                cold_slots,
+                expect_compactions=snap["built_compactions"])
+            if got is None:
+                _TIERED_C.labels("degrade_rerank_race").inc()
+                self._degrade(tier, "rerank_race", snap)
+                return None
+            cold_rows, cold_alive, cold_ids = got
+            cold_scores = qn[:b] @ cold_rows.T
+            if _cost.pricing_enabled():
+                flops, byts = _cost.price_rerank(
+                    bb, len(cold_slots), snap["dims"])
+                _cost.record_query_cost(
+                    "tiered_cold_scan", _cost.cost_name(brute), b,
+                    flops, byts)
+            self._kick_promote(cold_pids)
+
+        # exact delta side-scan (read-your-writes: adds/updates since
+        # the build; deletes are live-filtered below)
+        d_scores = None
+        d_ids: List[str] = []
+        if delta:
+            d_ids, d_mat = brute.delta_vectors(delta)
+            if d_ids:
+                d_scores = qn[:b] @ d_mat.T
+        d_set = set(d_ids)
+
+        out: List[List[Tuple[str, float]]] = []
+        for r in range(b):
+            # eid -> (exact score, slot for lower-slot-first tie order
+            # matching the float32 path)
+            cand: Dict[str, Tuple[float, int]] = {}
+            if exact_u is not None and s is not None:
+                for c in range(s.shape[1]):
+                    if s[r, c] < 0.5 * NEG_INF or slots[r, c] < 0:
+                        continue
+                    j = int(inv[r, c])
+                    eid = ids_u[j]
+                    if eid is None or not alive_u[j] or eid in d_set:
+                        continue  # tombstoned / delta supersedes
+                    cand[eid] = (float(exact_u[r, j]), int(uniq[j]))
+            if cold_scores is not None:
+                need = cold_need[r]
+                for j in range(len(cold_slots)):
+                    if int(cold_pid_of[j]) not in need:
+                        continue
+                    eid = cold_ids[j]
+                    if eid is None or eid == "" or not cold_alive[j] \
+                            or eid in d_set:
+                        continue
+                    cand[eid] = (float(cold_scores[r, j]),
+                                 int(cold_slots[j]))
+            for jd, eid in enumerate(d_ids):
+                cand[eid] = (float(d_scores[r, jd]),
+                             snap["capacity"] + jd)
+            ranked = sorted(cand.items(),
+                            key=lambda kv: (-kv[1][0], kv[1][1]))
+            out.append([(eid, sc) for eid, (sc, _) in ranked[:k_eff]])
+        if any(len(hits) < min(k_eff, n_alive) for hits in out):
+            # clustered deletes (or a probe set that ran dry) can leave
+            # a query short — serve those batches on a lower rung
+            _TIERED_C.labels("degrade_underfill").inc()
+            self._degrade(tier, "underfill", snap)
+            return None
+        _TIERED_C.labels("dispatch").inc()
+        if d_ids:
+            _TIERED_C.labels("delta_merge").inc()
+        _audit.note_batch_tier(tier)
+        return out
+
+    def _degrade(self, tier: str, reason: str, snap) -> None:
+        """One structured ledger record for a tiered -> lower-rung step
+        (the per-module event label stays as the alias)."""
+        _audit.record_degrade(
+            "vector", tier, "vector_brute_f32", reason,
+            index=_cost.cost_name(self.brute),
+            versions={"built_mutations": snap.get("built_mutations"),
+                      "built_compactions": snap.get("built_compactions"),
+                      "build_seq": snap.get("build_seq"),
+                      "residency_gen": snap.get("residency_gen")})

@@ -85,6 +85,12 @@ class BruteForceIndex:
         # clears the quant floor — HBM then holds int8/PQ codes while
         # this host matrix stays the float32 source of truth
         self._quant = None
+        # tiered serving plane (search/tiered_store.py), created lazily
+        # when NORNICDB_VECTOR_TIERED is on and the corpus clears the
+        # tiered floor — HBM then holds PQ slabs for the RESIDENT
+        # partitions only; cold partitions spill to disk and this host
+        # matrix serves exact reranks + cold side-scans
+        self._tiered = None
 
     def __len__(self) -> int:
         return self._n_alive
@@ -191,6 +197,7 @@ class BruteForceIndex:
                     getattr(self._dev_valid, "nbytes", 0) or 0)
             used = max(self._count, 1)
             quant = self._quant
+            tiered = self._tiered
             stats = {
                 "rows": self._n_alive,
                 "capacity": self._capacity,
@@ -208,6 +215,8 @@ class BruteForceIndex:
             # outside the index lock: the plane takes no brute locks in
             # resource_stats_extra, but keep lock ordering trivial
             stats.update(quant.resource_stats_extra())
+        if tiered is not None:
+            stats.update(tiered.resource_stats_extra())
         return stats
 
     def changed_since(self, seq: int) -> Optional[List[str]]:
@@ -478,6 +487,76 @@ class BruteForceIndex:
                     self._quant = plane
         return plane
 
+    def tiered_plane(self):
+        """The lazily-created tiered serving plane when
+        NORNICDB_VECTOR_TIERED is on and the corpus clears the tiered
+        floor, else None. ONE plane per index: one partition layout,
+        one residency LRU, one disk spill store. All NORNICDB_TIERED_*
+        knobs are read HERE, once, at plane creation — the per-request
+        path (route/search_batch) is environment-free by the PR 14
+        hot-path contract."""
+        from nornicdb_tpu.search.tiered_store import (
+            tiered_enabled,
+            tiered_min_n,
+        )
+
+        if not tiered_enabled() or self._n_alive < tiered_min_n():
+            return None
+        plane = self._tiered
+        if plane is None:
+            from nornicdb_tpu.config import (
+                env_bool,
+                env_float,
+                env_int,
+                env_str,
+            )
+            from nornicdb_tpu.search.tiered_store import TieredStore
+
+            with self._lock:
+                plane = self._tiered
+                if plane is None:
+                    plane = TieredStore(
+                        self,
+                        nprobe=max(1, env_int("TIERED_NPROBE", 8)),
+                        parts=max(0, env_int("TIERED_PARTS", 0)),
+                        resident_max=max(
+                            0, env_int("TIERED_RESIDENT", 0)),
+                        part_rows=max(
+                            256, env_int("TIERED_PART_ROWS", 4096)),
+                        lex_bonus=env_float("TIERED_LEX_BONUS", 0.15),
+                        build_inline=env_bool("TIERED_INLINE_BUILD",
+                                              False),
+                        overfetch=max(
+                            1, env_int("TIERED_OVERFETCH", 8)),
+                        min_pool=max(
+                            1, env_int("TIERED_MIN_POOL", 128)),
+                        root_dir=env_str("TIERED_DIR", "") or None)
+                    self._tiered = plane
+        return plane
+
+    def _tiered_search_batch(self, queries, k, lex_hints=None):
+        """Tiered cluster-routed serving (tiered_store.py) when
+        NORNICDB_VECTOR_TIERED is on and the corpus clears the tiered
+        floor. None = the quant/float32 rungs serve this batch — the
+        ladder is tiered -> quant -> f32 -> host, never a wrong
+        answer. Fail-open like the quant plane."""
+        plane = self.tiered_plane()
+        if plane is None:
+            return None
+        try:
+            return plane.search_batch(
+                np.asarray(queries, dtype=np.float32), k,
+                lex_hints=lex_hints)
+        except Exception:  # noqa: BLE001 — degrade, never fail
+            from nornicdb_tpu.obs import audit as _audit
+            from nornicdb_tpu.search.tiered_store import _TIERED_C
+
+            _TIERED_C.labels("degrade_error").inc()
+            _audit.record_degrade(
+                "vector", "vector_tiered", "vector_brute_f32",
+                "error", index=_cost.cost_name(self))
+            return None
+
     def _quant_search_batch(self, queries, k):
         """Quantized coarse-then-exact serving (device_quant.py) when
         NORNICDB_VECTOR_QUANT is set and the corpus clears the quant
@@ -516,6 +595,11 @@ class BruteForceIndex:
         from nornicdb_tpu.obs import audit as _audit
 
         if not exact:
+            # capacity rung first (beyond-HBM corpora), then the
+            # device-resident quant rung
+            out = self._tiered_search_batch(queries, k)
+            if out is not None:
+                return out
             out = self._quant_search_batch(queries, k)
             if out is not None:
                 return out

@@ -30,6 +30,7 @@ from nornicdb_tpu.storage.schema import (  # noqa: F401
     ReceiptLedger,
     SchemaManager,
 )
+from nornicdb_tpu.storage.partition_store import PartitionStore  # noqa: F401
 
 
 def make_persistent_engine(data_dir: str, sync_every_write: bool = False,

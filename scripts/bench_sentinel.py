@@ -99,6 +99,14 @@ CHECKS: Dict[str, Tuple] = {
     # gates ABSOLUTELY from the first round it appears — compression
     # paid for with ranking quality is a regression, not a win
     "quant_qps_b16": ("qps", 0.5),
+    # tiered vector storage (round r17+, ISSUE 17): serving qps floor
+    # once a tiered-carrying baseline exists; cluster-probe recall@10
+    # gates ABSOLUTELY from the first round it appears (capacity paid
+    # for with ranking quality is a regression, not a win), and the
+    # forced-cold parity gates ABSOLUTELY at the exact-contract floor
+    # 1.0 — a cold partition is served by an exact host scan, so
+    # anything below 1.0 is a wrong answer, not noise
+    "tiered_qps_b16": ("qps", 0.5),
     # device graph plane (round r09+): coalesced-chain and fused
     # traverse-rank qps floors once a graph-carrying baseline exists;
     # row PARITY gates ABSOLUTELY from the first round it appears —
@@ -112,6 +120,8 @@ CHECKS: Dict[str, Tuple] = {
     "hybrid_rank_parity": ("quality", 0.98, 0.02),
     "hybrid_walk_recall10": ("quality", 0.95, 0.02),
     "quant_recall10": ("quality", 0.95, 0.02),
+    "tiered_recall10": ("quality", 0.95, 0.02),
+    "tiered_cold_parity": ("quality", 1.0, 0.0),
     "hybrid_compile_buckets": ("growth", 2),
     # shadow-parity auditor (round r10+): the load stage's worst
     # rolling device/host parity per contract class. Exact tiers must
@@ -198,11 +208,31 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     out["hybrid_walk_recall10"] = _num(
         hyb.get("walk_recall10") if is_summary
         else _g(hyb, "walk", "walk_recall10"))
-    # quant stage keys are identical in both shapes (the summary's
-    # "quant" block carries the full result's headline trio verbatim)
+    # quant stage (r17+ summaries pack [qps_b16, recall10,
+    # compression_ratio, speedup_int8_vs_f32]; earlier summaries and
+    # the full artifact carry named keys — both shapes extract)
     quant = doc.get("quant") or {}
-    out["quant_qps_b16"] = _num(quant.get("quant_qps_b16"))
-    out["quant_recall10"] = _num(quant.get("quant_recall10"))
+    if isinstance(quant, list):
+        pad = quant + [None] * 4
+        out["quant_qps_b16"] = _num(pad[0])
+        out["quant_recall10"] = _num(pad[1])
+    else:
+        out["quant_qps_b16"] = _num(quant.get("quant_qps_b16"))
+        out["quant_recall10"] = _num(quant.get("quant_recall10"))
+    # tiered stage (round r17+): the summary packs [recall10, qps_b16,
+    # capacity_ratio, cold_parity, cold_records, pages_per_s]
+    # (fleet-pack precedent); the full artifact carries named keys
+    # with forced-cold parity nested under "cold"
+    tiered = doc.get("tiered") or {}
+    if isinstance(tiered, list):
+        pad = tiered + [None] * 6
+        out["tiered_recall10"] = _num(pad[0])
+        out["tiered_qps_b16"] = _num(pad[1])
+        out["tiered_cold_parity"] = _num(pad[3])
+    else:
+        out["tiered_qps_b16"] = _num(tiered.get("tiered_qps_b16"))
+        out["tiered_recall10"] = _num(tiered.get("tiered_recall10"))
+        out["tiered_cold_parity"] = _num(_g(tiered, "cold", "parity"))
     out["pagerank_speedup"] = _num(
         doc.get("pagerank_speedup_vs_numpy") if is_summary
         else _g(doc, "northstar", "pagerank_device", "speedup_vs_numpy"))

@@ -115,6 +115,20 @@ def _fake_result():
                   "quant_recall10": 0.97,
                   "compression_ratio": 14.2,
                   "speedup_int8_vs_f32": 1.18},
+        "tiered": {"n": 50_000, "dims": 64, "parts": 32, "k": 10,
+                   "batch": 16, "backend": "cpu", "build_s": 2.1,
+                   "tiered_recall10": 0.97,
+                   "tiered_qps_b16": 180.0,
+                   "tiered_capacity_ratio": 8.2,
+                   "tiered_device_bytes": 800_000,
+                   "disk_bytes": 12_000_000,
+                   "latency_ms": {"resident_p50": 4.0,
+                                  "resident_p99": 9.0,
+                                  "cold_p50": 40.0, "cold_p99": 80.0},
+                   "cold": {"parity": 1.0, "ledger_records": 4,
+                            "batches": 4},
+                   "paging": {"pages_per_s": 40.0, "promotions": 64,
+                              "evictions": 62}},
         "fleet": {"replicas": 2, "n": 4000, "dims": 64,
                   "converged": True, "replica_parity": 1.0,
                   "admitted": 2, "single_read_qps": 5300.0,
@@ -191,12 +205,18 @@ class TestCompactSummary:
                                "walk_qps_b16": 250.0,
                                "walk_recall10": 0.96,
                                "crossover_n": 100_000}
-        # quantization ladder (ISSUE 8 trio): int8-rung qps, worst-rung
-        # recall (the sentinel's 0.95 absolute floor), PQ compression
-        assert s["quant"] == {"quant_qps_b16": 260.0,
-                              "quant_recall10": 0.97,
-                              "compression_ratio": 14.2,
-                              "speedup_int8_vs_f32": 1.18}
+        # quantization ladder (ISSUE 8 trio), packed [qps_b16,
+        # recall10, compression_ratio, speedup_int8_vs_f32]: int8-rung
+        # qps, worst-rung recall (the sentinel's 0.95 absolute floor),
+        # PQ compression
+        assert s["quant"] == [260.0, 0.97, 14.2, 1.18]
+        # tiered vector storage (ISSUE 17), packed [recall10, qps_b16,
+        # capacity_ratio, cold_parity, cold_records, pages_per_s]:
+        # recall through the paged plane (sentinel absolute 0.95),
+        # serving rate, the beyond-HBM capacity multiple, the
+        # forced-cold parity verdict (absolute 1.0) with its honest
+        # ledger-record count, and paging throughput
+        assert s["tiered"] == [0.97, 180.0, 8.2, 1.0, 4, 40.0]
         # device graph plane (ISSUE 9): parity flag the sentinel holds
         # to 1.0, the coalesced-chain comparison, traverse-rank rate,
         # and the graph compile-bucket count behind the growth cap
@@ -233,7 +253,8 @@ class TestCompactSummary:
         assert s["knn"]["b1_qps"] is None
         assert s["cagra"]["qps_at_recall95"] is None
         assert s["hybrid"]["fused_qps_b16"] is None
-        assert s["quant"]["quant_recall10"] is None
+        assert s["quant"] == [None] * 4
+        assert s["tiered"] == [None] * 6
         assert s["graph"]["device_parity"] is None
         assert s["latency_ms"] == {}
         assert s["tpu_proof"] is None
@@ -291,7 +312,7 @@ class TestBenchDryRunArtifactSchema:
 
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
                     "knn", "northstar", "ann", "hybrid", "quant",
-                    "surfaces", "telemetry", "load", "fleet",
+                    "tiered", "surfaces", "telemetry", "load", "fleet",
                     "tpu_proof")
 
     def test_dry_run_artifact_schema(self, dry_run_lines):
@@ -389,6 +410,24 @@ class TestBenchDryRunArtifactSchema:
         assert qu["quant_recall10"] >= 0.95
         assert qu["compression_ratio"] >= 4.0
         assert qu["backend"] == "cpu"
+
+        # the tiered storage plane (ISSUE 17): recall through the
+        # cluster-routed paged plane holds the floor even at toy
+        # sizes, forced-cold serving stays rank-identical to the
+        # resident answer (with the honest ledger records behind it),
+        # and the capacity multiple + paging throughput are measured
+        ti = full["tiered"]
+        assert ti["tiered_recall10"] >= 0.95
+        assert ti["tiered_qps_b16"] > 0
+        assert ti["tiered_capacity_ratio"] > 1.0
+        assert ti["tiered_device_bytes"] > 0
+        assert ti["disk_bytes"] > 0
+        assert ti["cold"]["parity"] == 1.0
+        assert ti["cold"]["ledger_records"] >= 1
+        assert ti["paging"]["pages_per_s"] > 0
+        assert ti["latency_ms"]["resident_p50"] > 0
+        assert ti["latency_ms"]["cold_p50"] > 0
+        assert ti["backend"] == "cpu"
 
         # every surface measured, and the new framework-floor fields
         surf = full["surfaces"]
@@ -699,6 +738,8 @@ class TestBenchSentinelGate:
                        "hybrid_rank_parity", "hybrid_compile_buckets",
                        "hybrid_walk_qps_b16", "hybrid_walk_recall10",
                        "quant_qps_b16", "quant_recall10",
+                       "tiered_qps_b16", "tiered_recall10",
+                       "tiered_cold_parity",
                        "surface_qdrant_grpc_qps", "load_knee_qps",
                        "load_knee_qps_rest", "load_p99_at_load_ms"):
             assert metric in saved["metrics"], metric
@@ -914,6 +955,40 @@ class TestBenchSentinelGate:
             fresh_ok, ["--baseline", str(base)])
         assert rc == 0
         assert "quant_recall10" in docs[0]["passed"]
+
+    def test_tiered_floors_gate_absolutely_without_baseline(
+            self, tmp_path):
+        """ISSUE 17: the tiered plane lands in round r17 — its recall
+        floor (0.95) and forced-cold parity floor (1.0) are ABSOLUTE
+        and must gate even against a trajectory that predates the
+        metrics, while the tiered qps floor stays relative and skips
+        without a baseline."""
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps({
+            "sentinel_baseline": True,
+            "metrics": {"cypher_geomean": 100.0}}))
+        fresh = json.dumps({
+            "summary": True, "value": 100.0,
+            "tiered": [0.91, 150.0, 8.0, 0.5, 4, 40.0]})
+        rc, docs = self._run_sentinel(
+            fresh, ["--baseline", str(base)])
+        assert rc == 1
+        flagged = {f["metric"] for f in docs[0]["flagged"]}
+        assert "tiered_recall10" in flagged
+        assert "tiered_cold_parity" in flagged
+        assert "tiered_qps_b16" in docs[0]["skipped"]
+        # the full-artifact shape (named keys, parity under "cold")
+        # extracts identically and passes at/above the floors
+        fresh_ok = json.dumps({
+            "summary": True, "value": 100.0,
+            "tiered": {"tiered_qps_b16": 150.0,
+                       "tiered_recall10": 0.97,
+                       "cold": {"parity": 1.0}}})
+        rc, docs = self._run_sentinel(
+            fresh_ok, ["--baseline", str(base)])
+        assert rc == 0
+        assert "tiered_recall10" in docs[0]["passed"]
+        assert "tiered_cold_parity" in docs[0]["passed"]
 
     def test_sentinel_passes_real_trajectory_files(self):
         """The checked-in BENCH_r0*.json trajectory gates cleanly: the

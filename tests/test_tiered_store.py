@@ -1,0 +1,515 @@
+"""Tiered vector storage (ISSUE 17): cluster-routed demand paging.
+
+The acceptance gates, per the issue's satellite list:
+
+- **residency freshness ladder**: a query probing a cold partition is
+  answered by an exact host side-scan with exactly ONE ``tiered_cold``
+  ledger record per batch; a promotion/eviction landing mid-dispatch
+  degrades with ``paging_race``; deletes live-filter at the rerank
+  gather and post-build adds/updates ride the changelog side-scan —
+  tiered -> quant -> f32 -> host, never a wrong answer.
+- **LRU residency round-trip**: promotions fill free slabs first, then
+  evict the least-recently-probed partition; the evicted partition
+  promotes back from the disk spill store.
+- **capacity**: device bytes hold PQ codes only — the effective
+  capacity ratio vs an all-device float32 plane clears 4x.
+- **satellite rungs**: device-BM25 tf/doc-len columns quantize to
+  uint16 losslessly; the CAGRA graph base serves a PQ codes-only walk
+  with exact host rerank.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu.obs import REGISTRY
+from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.search import tiered_store as ts_mod
+from nornicdb_tpu.search.tiered_store import TieredStore
+from nornicdb_tpu.search.vector_index import BruteForceIndex
+
+D = 32
+
+
+def _counter(name, event):
+    text = REGISTRY.render()
+    needle = f'{name}{{event="{event}"}} '
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.split()[-1])
+    return 0.0
+
+
+def _tiered_counter(event):
+    return _counter("nornicdb_tiered_events_total", event)
+
+
+def _reason_count(reason):
+    return _audit.LEDGER.by_reason().get(reason, 0)
+
+
+def _index(n=1024, d=D, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((8, d)).astype(np.float32) * 3
+    vecs = (centers[rng.integers(0, 8, n)]
+            + rng.standard_normal((n, d)).astype(np.float32))
+    idx = BruteForceIndex(dims=d)
+    idx.add_batch([(f"e{i}", vecs[i]) for i in range(n)])
+    return idx, vecs.astype(np.float32), rng
+
+
+def _ids(hits):
+    return [h for h, _ in hits]
+
+
+def _recall(got, want, k):
+    return np.mean([
+        len(set(_ids(a)[:k]) & set(_ids(b)[:k])) / max(min(k, len(b)), 1)
+        for a, b in zip(got, want)])
+
+
+def _store(idx, **kw):
+    kw.setdefault("build_inline", True)
+    kw.setdefault("min_n", 64)
+    kw.setdefault("parts", 8)
+    kw.setdefault("nprobe", 8)
+    kw.setdefault("rebuild_stale_frac", 1e9)  # tests drive rebuilds
+    return TieredStore(idx, **kw)
+
+
+# ---------------------------------------------------------------------------
+# build + routing + recall
+# ---------------------------------------------------------------------------
+
+
+class TestBuildAndServe:
+    def test_build_gates_on_min_n(self):
+        idx, _, _ = _index(100)
+        store = _store(idx, min_n=256)
+        assert not store.build()
+        assert store._snap is None
+        assert store.search_batch(np.zeros((1, D), np.float32)) is None
+
+    def test_all_resident_recall(self):
+        idx, vecs, rng = _index(1024, seed=1)
+        store = _store(idx)
+        assert store.build()
+        q = (vecs[rng.integers(0, 1024, 8)]
+             + 0.05 * rng.standard_normal((8, D))).astype(np.float32)
+        got = store.search_batch(q, 10)
+        # the batch stamped its tier for the strategy machine (consume
+        # BEFORE the exact reference call below stamps its own)
+        assert _audit.consume_batch_tier() == "vector_tiered"
+        want = idx.search_batch(q, 10, exact=True)
+        assert got is not None
+        assert _recall(got, want, 10) >= 0.95
+
+    def test_scores_are_exact_rerank_values(self):
+        idx, vecs, rng = _index(512, seed=2)
+        store = _store(idx, parts=4, nprobe=4)
+        assert store.build()
+        q = vecs[7:8]
+        got = store.search_batch(q, 5)
+        want = idx.search_batch(q, 5, exact=True)
+        for (ge, gs), (we, ws) in zip(got[0], want[0]):
+            assert ge == we
+            assert gs == pytest.approx(ws, abs=1e-5)
+
+    def test_route_lex_bonus_steers_probes(self):
+        idx, vecs, _ = _index(1024, seed=3)
+        store = _store(idx, nprobe=2)
+        assert store.build()
+        snap = store._snap
+        qn = vecs[:1] / np.linalg.norm(vecs[:1])
+        base = store.route(qn, snap)
+        # bonus an ext id owned by a partition outside the base probes:
+        # it must enter the probe set
+        outside = [p for p in range(snap["parts"])
+                   if p not in set(base[0])]
+        if not outside:
+            pytest.skip("probe set already covers all partitions")
+        pid = outside[0]
+        eid = None
+        for e, p in snap["pid_of_ext"].items():
+            if p == pid:
+                eid = e
+                break
+        boosted = store.route(qn, snap, lex_hints=[[eid]])
+        assert pid in set(boosted[0])
+
+    def test_capacity_ratio_clears_4x(self):
+        idx, _, _ = _index(4096, d=64, seed=4)
+        store = _store(idx, resident_max=2)
+        assert store.build()
+        stats = store.resource_stats_extra()
+        assert stats["partitions"] == 8
+        assert stats["resident_partitions"] == 2
+        assert stats["tiered_capacity_ratio"] >= 4.0
+        assert stats["disk_bytes"] > 0
+        store.store.close()
+
+
+# ---------------------------------------------------------------------------
+# cold partitions: exact host side-scan + one ledger record
+# ---------------------------------------------------------------------------
+
+
+class TestColdScan:
+    def test_forced_cold_parity_and_one_record(self):
+        idx, vecs, rng = _index(1024, seed=5)
+        # one resident slab; a pool covering the whole slab makes the
+        # resident half exact too -> full-batch rank parity
+        store = _store(idx, resident_max=1, min_pool=4096)
+        assert store.build()
+        q = (vecs[rng.integers(0, 1024, 4)]
+             + 0.05 * rng.standard_normal((4, D))).astype(np.float32)
+        before_rec = _reason_count("tiered_cold")
+        before_evt = _tiered_counter("cold_scan")
+        got = store.search_batch(q, 10)
+        want = idx.search_batch(q, 10, exact=True)
+        assert got is not None
+        assert [_ids(r) for r in got] == [_ids(r) for r in want]
+        # exactly ONE structured record for the whole batch
+        assert _reason_count("tiered_cold") == before_rec + 1
+        assert _tiered_counter("cold_scan") == before_evt + 1
+        assert store.cold_scans == 1
+
+    def test_cold_probe_kicks_background_promotion(self):
+        idx, vecs, _ = _index(1024, seed=6)
+        store = _store(idx, resident_max=2)
+        assert store.build()
+        assert store.resource_stats_extra()["resident_partitions"] == 2
+        q = vecs[:2]
+        assert store.search_batch(q, 10) is not None
+        # the pager promotes the probed cold partitions off-thread
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if store.promotions > 0:
+                break
+            time.sleep(0.05)
+        assert store.promotions > 0
+        assert store.resource_stats_extra()["resident_partitions"] == 2
+
+    def test_promote_miss_stays_cold(self):
+        idx, vecs, _ = _index(1024, seed=7)
+        store = _store(idx, resident_max=2)
+        assert store.build()
+        cold = [p for p in range(8) if p not in store._snap["resident"]]
+        store.store.delete_partition(cold[0])
+        before = _tiered_counter("promote_miss")
+        assert store.promote_inline([cold[0]]) == 0
+        assert _tiered_counter("promote_miss") == before + 1
+        # the partition still answers exactly through the host scan
+        got = store.search_batch(vecs[:1], 10)
+        want = idx.search_batch(vecs[:1], 10, exact=True)
+        assert got is not None
+        assert _recall(got, want, 10) >= 0.95
+
+
+# ---------------------------------------------------------------------------
+# LRU residency round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestLRURoundTrip:
+    def test_promote_evict_promote_back(self):
+        idx, _, _ = _index(1024, seed=8)
+        store = _store(idx, resident_max=2)
+        assert store.build()
+        snap = store._snap
+        resident0 = list(snap["lru"])
+        assert len(resident0) == 2
+        cold = [p for p in range(8) if p not in snap["resident"]]
+        gen0 = snap["residency_gen"]
+        # promotion with full slabs evicts the LRU head
+        victim = resident0[0]
+        assert store.promote_inline([cold[0]]) == 1
+        assert cold[0] in snap["resident"]
+        assert victim not in snap["resident"]
+        assert store.evictions == 1
+        assert snap["residency_gen"] == gen0 + 1
+        # the evicted partition pages back in from the disk store
+        assert store.promote_inline([victim]) == 1
+        assert victim in snap["resident"]
+        assert store.evictions == 2
+        assert snap["residency_gen"] == gen0 + 2
+        # slab bookkeeping stays a bijection
+        owners = [p for p in snap["slab_pid"] if p >= 0]
+        assert sorted(owners) == sorted(snap["resident"].keys())
+        for pid, slab in snap["resident"].items():
+            assert snap["slab_pid"][slab] == pid
+
+    def test_probes_refresh_lru_order(self):
+        idx, vecs, _ = _index(1024, seed=9)
+        store = _store(idx, resident_max=2, nprobe=1)
+        assert store.build()
+        snap = store._snap
+        head = snap["lru"][0]
+        # a query routed at the LRU head's own centroid touches it
+        qn = snap["centroids"][head][None, :]
+        assert store.search_batch(qn, 5) is not None
+        assert snap["lru"][-1] == head
+
+
+# ---------------------------------------------------------------------------
+# freshness ladder: races, deletes, updates, churn
+# ---------------------------------------------------------------------------
+
+
+class TestFreshness:
+    def test_mid_page_eviction_race_degrades(self, monkeypatch):
+        idx, vecs, _ = _index(1024, seed=10)
+        store = _store(idx)
+        assert store.build()
+        real = ts_mod._tiered_topk_impl
+
+        def racing(*a, **kw):
+            out = real(*a, **kw)
+            # a promotion/eviction lands while the dispatch is in
+            # flight: the captured residency view is now stale
+            with store._res_lock:
+                store._snap["residency_gen"] += 1
+            return out
+
+        monkeypatch.setattr(ts_mod, "_tiered_topk_impl", racing)
+        before = _tiered_counter("degrade_paging_race")
+        before_rec = _reason_count("paging_race")
+        assert store.search_batch(vecs[:2], 10) is None
+        assert _tiered_counter("degrade_paging_race") == before + 1
+        assert _reason_count("paging_race") == before_rec + 1
+
+    def test_delete_live_filters(self):
+        idx, vecs, _ = _index(512, seed=11)
+        store = _store(idx, parts=4, nprobe=4)
+        assert store.build()
+        q = vecs[3:4]
+        top = _ids(store.search_batch(q, 5)[0])[0]
+        idx.remove(top)
+        got = store.search_batch(q, 5)
+        want = idx.search_batch(q, 5, exact=True)
+        assert got is not None
+        assert top not in _ids(got[0])
+        assert _ids(got[0]) == _ids(want[0])
+
+    def test_update_rides_the_changelog(self):
+        idx, vecs, rng = _index(512, seed=12)
+        store = _store(idx, parts=4, nprobe=4)
+        assert store.build()
+        q = rng.standard_normal((1, D)).astype(np.float32)
+        target = (q[0] / np.linalg.norm(q[0])).astype(np.float32)
+        idx.add("e3", target)  # in-place UPDATE after the build
+        got = store.search_batch(q, 5)
+        want = idx.search_batch(q, 5, exact=True)
+        assert got is not None
+        assert _ids(got[0])[0] == "e3"
+        assert _ids(got[0]) == _ids(want[0])
+
+    def test_new_add_rides_the_changelog(self):
+        idx, vecs, rng = _index(512, seed=13)
+        store = _store(idx, parts=4, nprobe=4)
+        assert store.build()
+        q = rng.standard_normal((1, D)).astype(np.float32)
+        target = (q[0] / np.linalg.norm(q[0])).astype(np.float32)
+        idx.add("fresh", target)
+        got = store.search_batch(q, 5)
+        assert got is not None
+        assert _ids(got[0])[0] == "fresh"
+
+    def test_compaction_degrades(self):
+        idx, vecs, _ = _index(512, seed=14)
+        store = _store(idx, parts=4)
+        assert store.build()
+        for i in range(200):
+            idx.remove(f"e{i}")
+        assert idx.compact()
+        before = _tiered_counter("degrade_compaction")
+        assert store.search_batch(vecs[300:301], 5) is None
+        assert _tiered_counter("degrade_compaction") == before + 1
+
+    def test_changelog_overrun_degrades(self):
+        idx, vecs, rng = _index(300, d=8, seed=15)
+        store = _store(idx, parts=2)
+        assert store.build()
+        cap = idx.changelog_cap()
+        for i in range(cap + 10):
+            idx.add(f"e{i % 300}", rng.standard_normal(8))
+        before = _tiered_counter("degrade_changelog")
+        assert store.search_batch(
+            vecs[:1].astype(np.float32), 5) is None
+        assert _tiered_counter("degrade_changelog") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# strategy-machine wiring (NORNICDB_VECTOR_TIERED)
+# ---------------------------------------------------------------------------
+
+
+class TestIndexWiring:
+    def test_env_gated_ladder_serves_and_fails_open(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_VECTOR_TIERED", "1")
+        monkeypatch.setenv("NORNICDB_TIERED_MIN_N", "64")
+        monkeypatch.setenv("NORNICDB_TIERED_INLINE_BUILD", "1")
+        monkeypatch.setenv("NORNICDB_TIERED_PARTS", "8")
+        idx, vecs, rng = _index(1024, seed=16)
+        q = (vecs[rng.integers(0, 1024, 4)]
+             + 0.05 * rng.standard_normal((4, D))).astype(np.float32)
+        served = idx.search_batch(q, 10)
+        exact = idx.search_batch(q, 10, exact=True)
+        assert idx._tiered is not None
+        assert _recall(served, exact, 10) >= 0.95
+
+        # a plane exception degrades to the float32 tier transparently
+        def boom(*a, **k):
+            raise RuntimeError("injected")
+
+        monkeypatch.setattr(idx._tiered, "search_batch", boom)
+        before = _tiered_counter("degrade_error")
+        served = idx.search_batch(q, 10)
+        assert [_ids(r) for r in served] == [_ids(r) for r in exact]
+        assert _tiered_counter("degrade_error") == before + 1
+
+    def test_exact_bypasses_tiered(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_VECTOR_TIERED", "1")
+        monkeypatch.setenv("NORNICDB_TIERED_MIN_N", "64")
+        monkeypatch.setenv("NORNICDB_TIERED_INLINE_BUILD", "1")
+        idx, vecs, _ = _index(256, seed=17)
+
+        def boom(*a, **k):  # must never be reached
+            raise AssertionError("exact=True reached the tiered plane")
+
+        idx.search_batch(vecs[:1], 5)  # builds the plane lazily
+        if idx._tiered is not None:
+            monkeypatch.setattr(idx._tiered, "search_batch", boom)
+        got = idx.search_batch(vecs[:1], 5, exact=True)
+        assert _ids(got[0])[0] == "e0"
+
+
+# ---------------------------------------------------------------------------
+# disk partition store
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionStore:
+    def test_round_trip_and_torn_read(self, tmp_path):
+        from nornicdb_tpu.storage.partition_store import PartitionStore
+
+        st = PartitionStore(str(tmp_path))
+        slots = np.asarray([3, 9, 11], dtype=np.int64)
+        rows = np.ones((3, 4), dtype=np.float32)
+        codes = np.asarray([[1, 2], [3, 4], [5, 6]], dtype=np.uint8)
+        st.save_partition(0, slots, ["a", "b", "c"], rows, codes)
+        got = st.load_partition(0)
+        np.testing.assert_array_equal(got["slots"], slots)
+        assert list(got["ext_ids"]) == ["a", "b", "c"]
+        np.testing.assert_array_equal(got["rows"], rows)
+        np.testing.assert_array_equal(got["codes"], codes)
+        assert st.disk_bytes() > 0
+        # a torn/corrupt file reads as a miss, never an exception
+        with open(st._path(0), "wb") as fh:
+            fh.write(b"not-an-npz")
+        assert st.load_partition(0) is None
+        assert st.load_partition(99) is None
+        st.delete_partition(0)
+        assert not st.has_partition(0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: device-BM25 uint16 tf/doc-len columns
+# ---------------------------------------------------------------------------
+
+
+class TestBM25QuantCols:
+    def _corpus(self, n=400, seed=20):
+        from nornicdb_tpu.search.bm25 import BM25Index
+
+        rng = np.random.default_rng(seed)
+        words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta",
+                 "eta", "theta", "iota", "kappa"]
+        bm = BM25Index()
+        for i in range(n):
+            toks = [words[j] for j in rng.integers(0, len(words), 12)]
+            bm.index(f"d{i}", " ".join(toks))
+        return bm
+
+    def test_uint16_columns_host_parity(self):
+        import jax.numpy as jnp
+
+        from nornicdb_tpu.search.device_bm25 import DeviceBM25
+
+        bm = self._corpus()
+        dev = DeviceBM25(bm, min_n=64, quant_cols=True)
+        dev.build()
+        snap = dev._snap
+        assert snap["post_tf"].dtype == jnp.uint16
+        assert snap["doc_len"].dtype == jnp.uint16
+        assert snap["cols_quant"] == 1.0
+        host = bm.search("alpha beta", 10)
+        got = dev.search("alpha beta", 10)
+        assert _ids(host) == _ids(got)
+        for (_, hs), (_, gs) in zip(host, got):
+            assert gs == pytest.approx(hs, abs=1e-4)
+
+    def test_quant_cols_off_keeps_f32(self):
+        import jax.numpy as jnp
+
+        from nornicdb_tpu.search.device_bm25 import DeviceBM25
+
+        bm = self._corpus(seed=21)
+        dev = DeviceBM25(bm, min_n=64, quant_cols=False)
+        dev.build()
+        snap = dev._snap
+        assert snap["post_tf"].dtype == jnp.float32
+        assert snap["doc_len"].dtype == jnp.float32
+        assert snap["cols_quant"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# satellite: PQ rung for the CAGRA graph base
+# ---------------------------------------------------------------------------
+
+
+class TestGraphPQRung:
+    def test_pq_walk_recall_and_footprint(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "pq")
+        from nornicdb_tpu.search.cagra import CagraIndex
+
+        rng = np.random.default_rng(22)
+        n, d = 4096, 64
+        centers = rng.standard_normal((16, d)).astype(np.float32)
+        vecs = (centers[rng.integers(0, 16, n)]
+                + 0.25 * rng.standard_normal((n, d))).astype(np.float32)
+        idx = BruteForceIndex(dims=d)
+        idx.add_batch([(f"v{i}", vecs[i]) for i in range(n)])
+        cag = CagraIndex(dims=d, min_n=256, brute=idx)
+        assert cag.build()
+        quant = cag._graph["quant"]
+        assert quant is not None and quant["mode"] == "pq"
+        q = (vecs[rng.integers(0, n, 8)]
+             + 0.05 * rng.standard_normal((8, d))).astype(np.float32)
+        got = cag.search_batch(q, 10)
+        want = idx.search_batch(q, 10, exact=True)
+        assert _recall(got, want, 10) >= 0.95
+        stats = cag.resource_stats()
+        assert stats["compression_ratio"] >= 4.0
+
+    def test_pq_gap_serves_f32_graph(self, monkeypatch):
+        """Too few rows to train honest codebooks: the graph build
+        keeps the float32 base instead of a bad PQ one — a degrade,
+        never a wrong answer."""
+        monkeypatch.setenv("NORNICDB_VECTOR_QUANT", "pq")
+        from nornicdb_tpu.search.cagra import CagraIndex
+        from nornicdb_tpu.search.device_quant import quantize_graph_base
+
+        rng = np.random.default_rng(23)
+        rows = rng.standard_normal((512, D)).astype(np.float32)
+        assert quantize_graph_base(rows, mode="pq") is None
+        idx = BruteForceIndex(dims=D)
+        idx.add_batch([(f"v{i}", rows[i]) for i in range(512)])
+        cag = CagraIndex(dims=D, min_n=256, brute=idx)
+        assert cag.build()
+        assert cag._graph["quant"] is None  # f32 rung serves
+        got = cag.search_batch(rows[:2], 5)
+        assert _ids(got[0])[0] == "v0"
