@@ -62,6 +62,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from nornicdb_tpu.obs import audit as _audit
 from nornicdb_tpu.obs import events as _events
 from nornicdb_tpu.obs import metrics as _m
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.obs.metrics import REGISTRY
 from nornicdb_tpu.obs.tracing import annotate, current_trace_id
 
@@ -507,6 +508,10 @@ def record_shed(surface: str, lane_name: str, reason: str,
         rec["trace_id"] = tid
     if retry_after_s:
         rec["retry_after_s"] = round(retry_after_s, 3)
+    tenant = _tenant.current_tenant()
+    if tenant:
+        rec["tenant"] = tenant
+    _tenant.record_shed(surface, reason)
     _audit.LEDGER.record(rec)
     _events.record_event("shed", surface=surface, reason=reason,
                          trace_id=tid,
@@ -964,3 +969,9 @@ REGISTRY.add_collector(_collect)
 # admission_allows; registering here makes the admission posture a
 # first-class rung-forcing input beside the parity quarantine
 _audit.set_admission_gate(CONTROLLER.tier_gate)
+
+# the noisy-neighbor detector (obs/tenant.py) arms only while the
+# posture is >= degrade — it reads the level through this provider so
+# the tenant layer never imports the actuator
+_tenant.set_posture_provider(
+    lambda: POSTURES.index(CONTROLLER.posture))

@@ -24,6 +24,7 @@ from urllib.parse import parse_qs, urlparse
 
 from nornicdb_tpu import admission as _adm
 from nornicdb_tpu import obs
+from nornicdb_tpu.obs import tenant as _tenant
 
 # tier-mix truth for search wire-cache hits (ISSUE 10): cached child —
 # the response-bytes hit path must not pay a labels() probe per request
@@ -420,14 +421,39 @@ class HttpServer:
                 dl, explicit = _adm.parse_deadline_header(
                     self.headers.get(_adm.DEADLINE_HEADER), "http")
                 lane = _shed_lane_for(method, path)
+                # tenant resolution (ISSUE 18): explicit X-Nornic-Tenant
+                # header > tenant propagated in the trace context > the
+                # multidb namespace (/db/{name}/... routes name their
+                # DB; everything else is the server's default database)
+                segs = [s for s in path.split("/") if s]
+                if len(segs) > 1 and segs[0] == "db":
+                    namespace = segs[1]
+                elif len(segs) > 1 and segs[0] == "collections":
+                    # qdrant routes derive the tenant from the
+                    # collection BEFORE admission, so a shed verdict
+                    # is attributed to the right tenant (the deeper
+                    # alias-resolving refine still runs on admitted
+                    # requests)
+                    namespace = (_tenant.tenant_for_collection(segs[1])
+                                 or outer.default_database)
+                else:
+                    namespace = outer.default_database
+                ten, ten_explicit = _tenant.resolve(
+                    self.headers.get(_tenant.TENANT_HEADER), tctx,
+                    namespace)
                 try:
                     # propagated_trace opens a plain root when no
                     # context came across — one call site, both cases
-                    with obs.propagated_trace(
-                            "wire", tctx, method=f"{method} {path}",
-                            transport="http"):
-                        obs.annotate(deadline_ms=round(
-                            (dl - time.time()) * 1e3, 1))
+                    with _tenant.tenant_scope(ten,
+                                              explicit=ten_explicit), \
+                            obs.propagated_trace(
+                                "wire", tctx,
+                                method=f"{method} {path}",
+                                transport="http"):
+                        obs.annotate(
+                            deadline_ms=round(
+                                (dl - time.time()) * 1e3, 1),
+                            tenant=_tenant.current_tenant())
                         with _adm.request_scope("http", dl,
                                                 lane_name=lane,
                                                 explicit=explicit):
@@ -1010,6 +1036,9 @@ class HttpServer:
                          for row in r.rows],
                 "stats": r.stats.to_dict() if hasattr(r.stats, "to_dict") else {},
             })
+        # the cypher tx path has no audit serve chokepoint — the
+        # per-tenant request still counts, once per tx (ISSUE 18)
+        _tenant.record_served("http", "host")
         return {"results": results, "errors": errors}
 
     def _search_response_bytes(self, body: bytes, headers) -> bytes:
@@ -1026,6 +1055,9 @@ class HttpServer:
         if hit is not None and hit[0] == gen:
             self.metrics.inc("search_requests_total")
             _SEARCH_CACHED_SERVED.inc()
+            # the pre-bound child skips record_served; per-tenant
+            # attribution still counts the hit (ISSUE 18)
+            _tenant.record_served("hybrid", "cached")
             return hit[1]
         # admission verdict AFTER the cache probe (ISSUE 15): a
         # byte-fresh hit is pure goodput and is never shed — only a
@@ -1299,6 +1331,10 @@ class HttpServer:
                 action = segments[3] if len(segments) > 3 else ""
                 if method == "PUT" and not action:
                     n = q.upsert_points(name, payload.get("points", []))
+                    # write path has no audit serve chokepoint — the
+                    # per-tenant request (and its rate window) still
+                    # counts the bulk upsert (ISSUE 18)
+                    _tenant.record_served("qdrant", "host")
                     return ok({"operation_id": n, "status": "completed"})
                 if method == "POST" and not action:
                     return ok(q.retrieve_points(
@@ -1492,11 +1528,24 @@ class HttpServer:
                 "scheduler": _adm.scheduler_summary(),
                 "rate_limiter_clients":
                     self.rate_limiter.tracked_clients(),
+                # per-tenant truth (ISSUE 18): top-K by cost with the
+                # attribution-completeness and noisy-neighbor state
+                "tenants": obs.tenants_summary(),
             }
             svc = self.db._search  # no index build from a telemetry read
             if svc is not None:
                 doc["microbatch"] = svc.microbatch_stats()
             return 200, doc
+
+        if action == "tenants" and method == "GET":
+            # per-tenant rollup (ISSUE 18): requests/qps/p99/tier mix/
+            # sheds/degrades + the cumulative cost meter, top-K by
+            # cost, with attribution completeness and the
+            # noisy-neighbor detector's window state
+            top = None
+            if len(segments) > 2 and segments[2].isdigit():
+                top = int(segments[2])  # /admin/tenants/<top>
+            return 200, obs.tenants_summary(top=top)
 
         if action == "scheduler" and method == "GET":
             # the admission-control actuator (ISSUE 15): per-lane

@@ -27,6 +27,8 @@ from nornicdb_tpu.errors import NotFoundError
 from nornicdb_tpu.obs import annotate as _obs_annotate
 from nornicdb_tpu.obs import attach_span as _obs_attach_span
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import cost as _cost
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.search.vector_index import BruteForceIndex
 from nornicdb_tpu.storage.types import Node, now_ms
 
@@ -275,8 +277,16 @@ class QdrantCompat:
 
     def resolve(self, name: str) -> str:
         """Alias -> collection name (identity when not an alias).
-        Point/read operations accept aliases, like upstream qdrant."""
-        return self._alias_map().get(name, name)
+        Point/read operations accept aliases, like upstream qdrant.
+
+        Every point/read op funnels through here, so this is also the
+        tenant-refinement chokepoint (ISSUE 18): a request that arrived
+        without an explicit tenant (header/metadata) derives one from
+        the collection->tenant mapping — an explicit tenant always
+        wins (refine never overrides it)."""
+        resolved = self._alias_map().get(name, name)
+        _tenant.refine(_tenant.tenant_for_collection(resolved))
+        return resolved
 
     def update_aliases(self, actions: Sequence[Dict[str, Any]]) -> bool:
         """Atomic batch of alias ops. Each action is one of:
@@ -591,6 +601,14 @@ class QdrantCompat:
                 n += 1
         if n:
             self._invalidate_raw(name)
+            # write-path pricing (ISSUE 18): bulk upserts were unpriced
+            # — a flooding tenant looked free to the cost meter. Under
+            # a convoy the coalescer's batch mix splits this across the
+            # merged riders by tenant.
+            if _cost.pricing_enabled() and want:
+                flops, bytes_ = _cost.price_upsert(n, want)
+                _cost.record_query_cost("upsert", f"qdrant:{name}",
+                                        n, flops, bytes_)
         return n
 
     # -- microbatched point ops (gRPC serving path) ----------------------

@@ -41,6 +41,7 @@ import grpc
 
 from nornicdb_tpu import admission as _adm
 from nornicdb_tpu import obs
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.api.proto import qdrant_pb2 as q
 from nornicdb_tpu.api.qdrant import QdrantError, _match_filter
 
@@ -302,6 +303,7 @@ def aio_unary_raw(
     exceptions map to gRPC status via :func:`grpc_status_of`."""
     time_tag = scale = None
     cached_served = None
+    cached_surf = None
     if wire is not None:
         tagged = _fresh_time_tag(resp_cls)
         if tagged is not None:
@@ -318,6 +320,7 @@ def aio_unary_raw(
             # striped inc, no labels() probe.
             surf = "hybrid" if method.endswith("/Hybrid") else "vector"
             cached_served = obs.audit.served_counter(surf, "cached")
+            cached_surf = surf
 
     # the offload threshold is resolved ONCE per handler build (server
     # construction), not per response: a per-query os.environ read on
@@ -347,10 +350,23 @@ def aio_unary_raw(
     async def handler(data: bytes, context):
         g = 0
         t0 = time.time()
+        # tenant resolution (ISSUE 18): explicit x-nornic-tenant
+        # metadata, else the namespace default — a non-explicit tenant
+        # is refined by the qdrant collection->tenant mapping once the
+        # op resolves its collection (the contextvar cell crosses the
+        # executor hop with copy_context below)
+        try:
+            md = dict(context.invocation_metadata() or ())
+            ten_hdr = md.get(_tenant.GRPC_METADATA_KEY)
+        except Exception:  # noqa: BLE001 — metadata API absent in tests
+            ten_hdr = None
+        ten, ten_explicit = _tenant.resolve(ten_hdr, None, None)
         # root span per RPC: grpc.aio runs each handler in its own
         # asyncio task (own contextvar context), so concurrent RPCs
         # never share a current-span slot
-        with obs.trace("wire", method=method, transport="grpc") as root:
+        with _tenant.tenant_scope(ten, explicit=ten_explicit), \
+                obs.trace("wire", method=method,
+                          transport="grpc") as root:
             if wire is not None:
                 g = gen()
                 hit = wire.get(method, data, g)
@@ -359,6 +375,12 @@ def aio_unary_raw(
                     if cached_served is not None:
                         root.annotate(served_by="cached")
                         cached_served.inc()
+                        # the plane-wide counter above bypasses
+                        # audit.record_served, so the per-tenant side
+                        # records here — a cache hit is still this
+                        # tenant's request (attribution completeness)
+                        _tenant.record_served(cached_surf, "cached",
+                                              seconds=time.time() - t0)
                     latency.observe(time.time() - t0)
                     if time_tag is not None:
                         return (hit + time_tag + struct.pack(

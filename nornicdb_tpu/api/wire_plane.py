@@ -56,6 +56,7 @@ import numpy as np
 from nornicdb_tpu import admission as _adm
 from nornicdb_tpu import obs
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.search.broker import (
     BrokerClient,
     BrokerRemoteError,
@@ -563,6 +564,29 @@ class _WorkerHttpServer:
         doc["events"] = local[-limit:]
         return doc
 
+    def _admin_tenants(self, path: str) -> Dict[str, Any]:
+        """Per-tenant rollup over the MERGED registry view (ISSUE 18):
+        this worker's own series plus the shared device plane's,
+        exactly once — the same merge discipline as /metrics. Thread
+        mode shares one registry, so the local dump already holds the
+        whole truth."""
+        from nornicdb_tpu.obs.metrics import dump_state, merge_states
+
+        top = None
+        tail = path.rsplit("/", 1)[-1]
+        if tail.isdigit():
+            top = int(tail)
+        remotes: List[Any] = []
+        if self._client.cross_process:
+            try:
+                remotes = [self.db.plane_call("metrics_state")]
+            except Exception:  # noqa: BLE001 — local view still serves
+                remotes = []
+        merged = merge_states(dump_state(), remotes)
+        doc = _tenant.tenants_summary(state=merged, top=top)
+        doc["worker"] = self.worker_id
+        return doc
+
     def _readyz(self) -> Tuple[int, Dict[str, Any]]:
         try:
             status, payload = self.db.plane_call("readyz")
@@ -622,8 +646,21 @@ class _WorkerHttpServer:
                 # checks here, before the broker round trip
                 cached_route = (method == "POST"
                                 and path == "/nornicdb/search")
-                with _adm.request_scope("http", dl, lane_name=lane,
-                                        explicit=explicit):
+                # tenant identity resolved at THIS ingress (ISSUE 18):
+                # header first, multidb path namespace as fallback —
+                # shed verdicts and cached serves attribute here, and
+                # the identity rides the broker ring in the slot
+                # header's packed trace context for plane-side work
+                segs = [s for s in path.split("/") if s]
+                namespace = (segs[1]
+                             if len(segs) > 1 and segs[0] == "db"
+                             else None)
+                ten, ten_explicit = _tenant.resolve(
+                    self.headers.get(_tenant.TENANT_HEADER), None,
+                    namespace)
+                with _tenant.tenant_scope(ten, explicit=ten_explicit), \
+                        _adm.request_scope("http", dl, lane_name=lane,
+                                           explicit=explicit):
                     if lane is not None and not cached_route:
                         try:
                             _adm.check("http", lane)
@@ -685,6 +722,16 @@ class _WorkerHttpServer:
                         self._reply_bytes(
                             200, "application/json",
                             json.dumps(obs.fleet_summary(),
+                                       default=str).encode())
+                        return
+                    if method == "GET" and (
+                            path == "/admin/tenants"
+                            or path.startswith("/admin/tenants/")):
+                        # merged local+plane per-tenant rollup
+                        outer._admin_check(self.headers)
+                        self._reply_bytes(
+                            200, "application/json",
+                            json.dumps(outer._admin_tenants(path),
                                        default=str).encode())
                         return
                     if method == "GET" and path == "/readyz":

@@ -102,6 +102,37 @@ HOT_PATHS = (
     ("nornicdb_tpu/admission.py", "parse_deadline_header"),
     ("nornicdb_tpu/admission.py", "record_shed"),
     ("nornicdb_tpu/admission.py", "lane_rank"),
+    # tenant attribution (ISSUE 18) — resolution, refinement and the
+    # per-request recording hooks run once per query on every ingress;
+    # config is cached at first use and these must never read the
+    # environment
+    ("nornicdb_tpu/obs/tenant.py", "resolve"),
+    ("nornicdb_tpu/obs/tenant.py", "refine"),
+    ("nornicdb_tpu/obs/tenant.py", "current_label"),
+    ("nornicdb_tpu/obs/tenant.py", "record_served"),
+    ("nornicdb_tpu/obs/tenant.py", "record_cost"),
+    ("nornicdb_tpu/obs/tenant.py", "_admit"),
+)
+
+# ---------------------------------------------------------------------------
+# tenant-families (ISSUE 18): every metric family carrying a ``tenant``
+# label must be declared here. The label is the cardinality hazard —
+# each family below rides the obs/tenant.py cardinality-capped registry
+# (fold past NORNICDB_TENANT_MAX into ``__other__``); a tenant label on
+# any OTHER family bypasses that cap and can blow up the scrape. The
+# metrics-catalog pass fails on a registered-but-undeclared family
+# (undeclared-tenant-family) and on a declared-but-gone entry
+# (stale-tenant-family).
+# ---------------------------------------------------------------------------
+TENANT_FAMILIES = (
+    "nornicdb_tenant_requests_total",
+    "nornicdb_tenant_request_seconds",
+    "nornicdb_tenant_served_tier_total",
+    "nornicdb_tenant_degrade_total",
+    "nornicdb_tenant_shed_total",
+    "nornicdb_tenant_cost_flops_total",
+    "nornicdb_tenant_cost_bytes_total",
+    "nornicdb_tenant_cost_queries_total",
 )
 
 # ---------------------------------------------------------------------------

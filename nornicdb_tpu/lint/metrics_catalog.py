@@ -56,6 +56,7 @@ IMPORT_TIME_MODULES = (
     # this lint the moment it appears, not when the docs drift.
     "nornicdb_tpu.replication.transport",   # dual-plane WAL streaming
     "nornicdb_tpu.replication.fleet_proc",  # subprocess replica fleet
+    "nornicdb_tpu.obs.tenant",  # per-tenant attribution (ISSUE 18)
 )
 
 _PREFIX = "nornicdb_"
@@ -134,6 +135,26 @@ def missing_terms(doc_text: str, names) -> list:
     return [n for n in names if not _documented(expanded, n)]
 
 
+def tenant_family_drift():
+    """(undeclared, stale) — ISSUE 18. A ``tenant`` label is a
+    cardinality hazard: every family carrying one must ride the
+    capped obs/tenant.py label registry and be declared in
+    ``lint.config.TENANT_FAMILIES``. Undeclared = registered family
+    with a tenant label but no declaration (the hazard); stale =
+    declared name no longer registered (dead declaration)."""
+    from nornicdb_tpu.lint.config import TENANT_FAMILIES
+    from nornicdb_tpu.obs import REGISTRY
+
+    for mod in IMPORT_TIME_MODULES:
+        importlib.import_module(mod)
+    carrying = sorted(f.name for f in REGISTRY.families()
+                      if "tenant" in f.label_names)
+    declared = set(TENANT_FAMILIES)
+    undeclared = [n for n in carrying if n not in declared]
+    stale = sorted(declared - set(carrying))
+    return undeclared, stale
+
+
 def build_verdict(doc_path: str, repo: str) -> dict:
     """The drift verdict — one dict, shape shared by the standalone
     CLI and the framework pass."""
@@ -154,8 +175,12 @@ def build_verdict(doc_path: str, repo: str) -> dict:
     # an undocumented /admin/events kind fails the lint like an
     # undocumented tier or reason
     missing_events = missing_terms(doc_text, events)
+    # ISSUE 18: tenant-labeled families must be declared in
+    # lint.config.TENANT_FAMILIES (the cardinality-cap contract)
+    undeclared_tenant, stale_tenant = tenant_family_drift()
     drift = bool(missing or missing_kinds or missing_tiers
-                 or missing_reasons or missing_events)
+                 or missing_reasons or missing_events
+                 or undeclared_tenant or stale_tenant)
     return {
         "catalog_lint": True,
         "doc": os.path.relpath(doc_path, repo),
@@ -169,6 +194,8 @@ def build_verdict(doc_path: str, repo: str) -> dict:
         "missing_tiers": missing_tiers,
         "missing_reasons": missing_reasons,
         "missing_events": missing_events,
+        "undeclared_tenant": undeclared_tenant,
+        "stale_tenant": stale_tenant,
         "verdict": "drift" if drift else "pass",
     }
 
@@ -234,4 +261,20 @@ def run(tree) -> List:
                 detail=name,
                 message=msg.format(name)
                 + " in docs/observability.md"))
+    # tenant-label declarations anchor to the registry file, not the
+    # docs — the fix is an edit to lint/config.py
+    cfg_rel = "nornicdb_tpu/lint/config.py"
+    for name in verdict["undeclared_tenant"]:
+        findings.append(Finding(
+            pass_name=PASS, rule="undeclared-tenant-family",
+            path=cfg_rel, line=1, detail=name,
+            message=f"metric family {name} carries a tenant label but "
+                    "is not declared in TENANT_FAMILIES "
+                    "(cardinality-cap contract, ISSUE 18)"))
+    for name in verdict["stale_tenant"]:
+        findings.append(Finding(
+            pass_name=PASS, rule="stale-tenant-family",
+            path=cfg_rel, line=1, detail=name,
+            message=f"TENANT_FAMILIES declares {name} but no such "
+                    "family is registered"))
     return findings

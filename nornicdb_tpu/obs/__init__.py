@@ -53,6 +53,7 @@ from nornicdb_tpu.obs import fleet  # noqa: F401 — registers sources gauge
 from nornicdb_tpu.obs import resources  # noqa: F401 — registers collector
 from nornicdb_tpu.obs import slo  # noqa: F401 — registers collector
 from nornicdb_tpu.obs import stages  # noqa: F401 — registers stage family
+from nornicdb_tpu.obs import tenant  # noqa: F401 — registers tenant families
 from nornicdb_tpu.obs.audit import (
     audit_summary,
     degrade_snapshot,
@@ -81,6 +82,12 @@ from nornicdb_tpu.obs.resources import snapshot as resource_snapshot
 from nornicdb_tpu.obs.slo import SloEngine
 from nornicdb_tpu.obs.slo import get_engine as get_slo_engine
 from nornicdb_tpu.obs.stages import record_stage, stage_summary
+from nornicdb_tpu.obs.tenant import (
+    TENANT_HEADER,
+    current_tenant,
+    tenant_scope,
+    tenants_summary,
+)
 from nornicdb_tpu.obs.tracing import (
     TRACE_HEADER,
     TRACES,
@@ -157,6 +164,11 @@ __all__ = [
     "span",
     "stage_summary",
     "stages",
+    "TENANT_HEADER",
+    "current_tenant",
+    "tenant",
+    "tenant_scope",
+    "tenants_summary",
     "tier_allowed",
     "tier_mix",
     "trace",

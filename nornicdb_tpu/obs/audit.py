@@ -65,6 +65,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from nornicdb_tpu.obs import events as _events
 from nornicdb_tpu.obs import metrics as _m
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.obs.metrics import LATENCY_BUCKETS, REGISTRY
 from nornicdb_tpu.obs.tracing import annotate, attach_span, current_trace_id
 
@@ -281,6 +282,10 @@ def record_served(surface: str, tier: str, seconds: Optional[float] = None,
     _SERVED_C.labels(surface, tier).inc(n)
     if seconds is not None:
         _SERVED_H.labels(surface, tier).observe(seconds)
+    # the per-tenant side rides the same chokepoint (ISSUE 18): under
+    # an active batch mix the n serves distribute across the riders'
+    # tenants, else the current context's tenant takes them
+    _tenant.record_served(surface, tier, seconds=seconds, n=n)
     annotate(served_by=tier)
 
 
@@ -466,6 +471,10 @@ def record_degrade(surface: str, from_tier: str, to_tier: str,
     tid = current_trace_id()
     if tid is not None:
         rec["trace_id"] = tid
+    tenant = _tenant.current_tenant()
+    if tenant:
+        rec["tenant"] = tenant
+    _tenant.record_degrade(surface, r)
     LEDGER.record(rec)
     # a broker op capture in flight on this thread (ISSUE 11): the
     # record also ships back to the frontend worker that owns the

@@ -37,6 +37,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Tuple
 
 from nornicdb_tpu.obs import metrics as _m
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.obs.metrics import REGISTRY, Registry
 
 _F32 = 4  # bytes
@@ -209,6 +210,17 @@ def price_traverse_rank(b: int, frontier: int, d: int,
     return flops, bytes_
 
 
+def price_upsert(n_points: int, d: int) -> Tuple[float, float]:
+    """(flops, bytes) of one bulk vector upsert (ISSUE 18): the
+    normalize pass (~2 flops/dim) over ``n_points`` rows plus the rows
+    moved host->device twice (staging + index append). Write traffic
+    was unpriced before this; a bulk-upserting tenant looked free to
+    the cost meter while monopolizing the device."""
+    flops = 2.0 * n_points * d
+    bytes_ = 2.0 * _F32 * n_points * d
+    return flops, bytes_
+
+
 def price_bm25(b: int, nnz: int, unique_terms: int,
                rows: int) -> Tuple[float, float]:
     """(flops, bytes) of one device-BM25 scoring dispatch: tf/idf math +
@@ -237,6 +249,10 @@ def record_query_cost(kind: str, index: str, queries: int,
     _FLOPS_C.labels(kind, index).inc(flops)
     _BYTES_C.labels(kind, index).inc(bytes_)
     _QUERIES_C.labels(kind, index).inc(queries)
+    # per-tenant metering (ISSUE 18): under an active batch mix the
+    # padded-dispatch cost splits across riders by tenant (the
+    # leader->rider channel); else the current context's tenant pays
+    _tenant.record_cost(queries, flops, bytes_)
 
 
 def cost_summary(registry: Optional[Registry] = None

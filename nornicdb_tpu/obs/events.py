@@ -45,6 +45,16 @@ from nornicdb_tpu.obs import metrics as _m
 from nornicdb_tpu.obs.metrics import REGISTRY
 from nornicdb_tpu.obs.tracing import current_trace_id
 
+# tenant stamping (ISSUE 18): obs/tenant.py registers its resolver so
+# every journal event carries the active request's tenant — this
+# module stays importable without the tenant layer.
+_tenant_provider = None
+
+
+def set_tenant_provider(fn) -> None:
+    global _tenant_provider
+    _tenant_provider = fn
+
 # the documented event-kind vocabulary — scripts/check_metrics_catalog
 # lints each value against docs/observability.md (tier/reason
 # precedent, ISSUE 10)
@@ -63,6 +73,9 @@ KINDS: Tuple[str, ...] = (
     "lease_grant",      # a replica at the primary watermark was leased
                         # for read-your-writes routing (ISSUE 16)
     "lease_lapse",      # a leader lease expired or was revoked
+    "noisy_neighbor",   # one tenant held over the cost-share threshold
+                        # of the rolling window while posture >= degrade
+                        # (advisory, ISSUE 18 — no actuation)
 )
 
 _EVENTS_C = REGISTRY.counter(
@@ -115,6 +128,10 @@ class EventJournal:
             rec["reason"] = str(reason)
         if trace_id:
             rec["trace_id"] = str(trace_id)
+        if _tenant_provider is not None:
+            tenant = _tenant_provider()
+            if tenant:
+                rec["tenant"] = str(tenant)
         if detail:
             rec["detail"] = dict(detail)
         with self._lock:

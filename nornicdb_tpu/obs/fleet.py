@@ -296,6 +296,11 @@ def fleet_summary(registry=None) -> Dict[str, Any]:
     workers = None
     for _key, v in _children(merged, "nornicdb_wire_workers").items():
         workers = v
+    # per-tenant truth over the SAME merged state (ISSUE 18): the
+    # fleet view answers "which tenant is doing this to us" with the
+    # identical exactly-once merge discipline as the series above
+    from nornicdb_tpu.obs import tenant as _tenant
+
     return {
         "sources": status,
         "families": len(merged),
@@ -303,6 +308,7 @@ def fleet_summary(registry=None) -> Dict[str, Any]:
         "replicas": replicas,
         "failovers": failovers,
         "tiers": tiers,
+        "tenants": _tenant.tenants_summary(state=merged),
         "events": _events.event_summary(),
     }
 

@@ -339,6 +339,16 @@ class SloEngine:
                           "summary": scheduler_summary()})
         except Exception:  # noqa: BLE001 — dump must never fail on extras
             pass
+        # who was doing it to us (ISSUE 18): the per-tenant rollup —
+        # top-K by cost, sheds, p99 — plus the noisy-neighbor
+        # detector's window at breach time. Same lazy discipline.
+        try:
+            from nornicdb_tpu.obs.tenant import tenants_summary
+
+            lines.append({"kind": "tenants",
+                          "summary": tenants_summary()})
+        except Exception:  # noqa: BLE001 — dump must never fail on extras
+            pass
         for rec in (extra or []):
             lines.append(rec)
         for trace in TRACES.slowest(limit=20):

@@ -271,13 +271,24 @@ def annotate(**attrs: Any) -> None:
 # (FleetRouter -> RemoteReplica; any reverse proxy can forward it)
 TRACE_HEADER = "X-Nornic-Trace"
 
+# tenant propagation rides the trace context (ISSUE 18): obs/tenant.py
+# registers its resolver here so trace_context() carries the tenant
+# across the ring slot header and the X-Nornic-Trace hop WITHOUT this
+# module importing the tenant layer.
+_tenant_provider = None
+
+
+def set_tenant_provider(fn) -> None:
+    global _tenant_provider
+    _tenant_provider = fn
+
 
 def trace_context() -> Optional[Dict[str, str]]:
     """The active trace as a compact propagation dict
-    (``{"trace_id", "surface", "span"}``), or None outside any trace.
-    Cheap: two contextvar reads + one small dict — safe on the
-    per-request wire path (no trace -> no allocation beyond the gets).
-    """
+    (``{"trace_id", "surface", "span"[, "tenant"]}``), or None outside
+    any trace. Cheap: two contextvar reads + one small dict — safe on
+    the per-request wire path (no trace -> no allocation beyond the
+    gets)."""
     tid = _current_tid.get()
     if tid is None:
         return None
@@ -288,20 +299,32 @@ def trace_context() -> Optional[Dict[str, str]]:
         surface = cur.attrs.get("surface") or cur.attrs.get("transport")
         if surface:
             ctx["surface"] = str(surface)
+    if _tenant_provider is not None:
+        tenant = _tenant_provider()
+        if tenant:
+            ctx["tenant"] = str(tenant)
     return ctx
 
 
 def pack_context(ctx: Optional[Dict[str, str]]) -> str:
-    """``trace_id|surface|span`` — the one wire format for both the
-    broker ring slots and the ``X-Nornic-Trace`` HTTP header."""
+    """``trace_id|surface|span[|tenant]`` — the one wire format for
+    both the broker ring slots and the ``X-Nornic-Trace`` HTTP header.
+    The tenant field is appended only when present, so pre-18 peers
+    (which split to 3) keep parsing the prefix unchanged."""
     if not ctx or not ctx.get("trace_id"):
         return ""
-    return "|".join((ctx.get("trace_id", ""), ctx.get("surface", ""),
-                     ctx.get("span", "")))
+    fields = [ctx.get("trace_id", ""), ctx.get("surface", ""),
+              ctx.get("span", "")]
+    if ctx.get("tenant"):
+        fields.append(ctx["tenant"])
+    return "|".join(fields)
 
 
 _TID_RE = re.compile(r"^[0-9a-fA-F]{8,64}$")
 _FIELD_RE = re.compile(r"^[\w.:/-]{1,64}$")
+# tenant names: header-reachable, so tighter than span fields (no
+# slash/colon — must match obs.tenant's label charset)
+_TENANT_RE = re.compile(r"^[\w.-]{1,64}$")
 
 
 def unpack_context(packed: Optional[str]) -> Optional[Dict[str, str]]:
@@ -314,7 +337,7 @@ def unpack_context(packed: Optional[str]) -> Optional[Dict[str, str]]:
     chosen identifiers."""
     if not packed:
         return None
-    parts = (str(packed).split("|") + ["", ""])[:3]
+    parts = (str(packed).split("|") + ["", "", ""])[:4]
     if not _TID_RE.match(parts[0]):
         return None
     ctx = {"trace_id": parts[0].lower()}
@@ -322,6 +345,8 @@ def unpack_context(packed: Optional[str]) -> Optional[Dict[str, str]]:
         ctx["surface"] = parts[1]
     if parts[2] and _FIELD_RE.match(parts[2]):
         ctx["span"] = parts[2]
+    if parts[3] and _TENANT_RE.match(parts[3]):
+        ctx["tenant"] = parts[3]
     return ctx
 
 

@@ -70,6 +70,7 @@ from nornicdb_tpu.obs import (
     record_dispatch,
 )
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.obs import tracing as _tracing
 from nornicdb_tpu import admission as _adm
 from nornicdb_tpu.search.microbatch import pow2_bucket
@@ -454,8 +455,17 @@ class BrokerClient:
         trace — degrade records minted over there carry this rider's
         trace id, and the plane-side span tree comes back in
         ``meta["spans"]``."""
+        ctx = _tracing.trace_context()
+        if ctx is None:
+            # no active trace (worker HTTP frontends don't root one)
+            # — the tenant identity still crosses the ring so the
+            # plane-side serve attributes to the rider, not
+            # __unattributed__ (ISSUE 18)
+            t = _tenant.current_tenant()
+            if t:
+                ctx = {"tenant": t}
         payload = pickle.dumps(
-            (target, method, args, kwargs, _tracing.trace_context()),
+            (target, method, args, kwargs, ctx),
             protocol=5)
         return self._roundtrip(OP_CALL, payload, 0, timeout_s)
 
@@ -789,7 +799,11 @@ class DispatchBroker:
         hdr = _read_hdr(self._buf, item["off"])
 
         def _record():
-            _adm.record_deadline_miss("broker", "ring", item["lane"])
+            # the rider's propagated tenant binds the shed verdict
+            # (ISSUE 18): the per-tenant shed/served counters on the
+            # shared plane attribute to the flooder, not __other__
+            with _tenant.scope_from_context(item.get("ctx")):
+                _adm.record_deadline_miss("broker", "ring", item["lane"])
 
         if item.get("ctx"):
             with _tracing.propagated_trace("broker.shed", item["ctx"],
@@ -852,33 +866,41 @@ class DispatchBroker:
             group_lane = min(
                 (item["lane"] for _w, _s, item in group),
                 key=lambda ln: _adm.lane_rank(ln))
-            with _adm.deadline_scope(group_dl), \
-                    _adm.lane_scope(group_lane):
-                if lead_ctx is not None:
-                    attrs = {"key": key, "batch": b,
-                             "surface": "broker", "lane": group_lane}
-                    if group_dl is not None:
-                        attrs["deadline_ms"] = round(
-                            (group_dl - t0) * 1e3, 1)
-                    with _tracing.propagated_trace(
-                            "broker.vec", lead_ctx, **attrs):
-                        results = self._vec_dispatch(key, queries,
-                                                     k_max)
-                else:
-                    results = self._vec_dispatch(key, queries, k_max)
-            t1 = time.time()
-            tier = _audit.consume_batch_tier()
-            # fleet-routed reads stamp the chosen node (ISSUE 13): the
-            # FleetRouter notes which replica served this thread's
-            # dispatch; the stamp rides every rider's response
-            node = _audit.consume_fleet_node()
-            record_dispatch("broker_vec", bucket, k_max, t1 - t0)
-            # rider-accurate tier attribution (ISSUE 10) for the ring
-            # path: the direct batched dispatch bypasses a MicroBatcher
-            # so the broker, as the standing leader, records one serve
-            # per rider on the shared plane — each worker's merged
-            # scrape then carries the tier mix exactly once
-            _audit.record_served("vector", tier or "host", n=b)
+            # the riders' tenant mix (propagated in each slot's packed
+            # trace ctx) binds the dispatch AND the serve recording:
+            # padded-dispatch cost splits across riders by tenant and
+            # the n=b serve distributes the same way (ISSUE 18)
+            rider_tenants = [(item.get("ctx") or {}).get("tenant")
+                             for _w, _s, item in group]
+            with _tenant.batch_scope(rider_tenants):
+                with _adm.deadline_scope(group_dl), \
+                        _adm.lane_scope(group_lane):
+                    if lead_ctx is not None:
+                        attrs = {"key": key, "batch": b,
+                                 "surface": "broker", "lane": group_lane}
+                        if group_dl is not None:
+                            attrs["deadline_ms"] = round(
+                                (group_dl - t0) * 1e3, 1)
+                        with _tracing.propagated_trace(
+                                "broker.vec", lead_ctx, **attrs):
+                            results = self._vec_dispatch(key, queries,
+                                                         k_max)
+                    else:
+                        results = self._vec_dispatch(key, queries, k_max)
+                t1 = time.time()
+                tier = _audit.consume_batch_tier()
+                # fleet-routed reads stamp the chosen node (ISSUE 13):
+                # the FleetRouter notes which replica served this
+                # thread's dispatch; the stamp rides every response
+                node = _audit.consume_fleet_node()
+                record_dispatch("broker_vec", bucket, k_max, t1 - t0)
+                # rider-accurate tier attribution (ISSUE 10) for the
+                # ring path: the direct batched dispatch bypasses a
+                # MicroBatcher so the broker, as the standing leader,
+                # records one serve per rider on the shared plane —
+                # each worker's merged scrape then carries the tier
+                # mix exactly once
+                _audit.record_served("vector", tier or "host", n=b)
             for idx, (_w, _s, item) in enumerate(group):
                 hdr = _read_hdr(self._buf, item["off"])
                 hits = results[idx]
@@ -913,19 +935,21 @@ class DispatchBroker:
                     t0 = time.time()
                     _audit.consume_batch_tier()
                     _audit.consume_fleet_node()
-                    if item.get("ctx") is not None:
-                        with _tracing.propagated_trace(
-                                "broker.vec", item["ctx"], key=key,
-                                batch=1, surface="broker"):
-                            res = self._vec_dispatch(
-                                key, np.array(q1), kb)[0]
-                    else:
-                        res = self._vec_dispatch(key, np.array(q1),
-                                                 kb)[0]
-                    t1 = time.time()
-                    tier = _audit.consume_batch_tier()
-                    node = _audit.consume_fleet_node()
-                    _audit.record_served("vector", tier or "host")
+                    with _tenant.batch_scope(
+                            [(item.get("ctx") or {}).get("tenant")]):
+                        if item.get("ctx") is not None:
+                            with _tracing.propagated_trace(
+                                    "broker.vec", item["ctx"], key=key,
+                                    batch=1, surface="broker"):
+                                res = self._vec_dispatch(
+                                    key, np.array(q1), kb)[0]
+                        else:
+                            res = self._vec_dispatch(key, np.array(q1),
+                                                     kb)[0]
+                        t1 = time.time()
+                        tier = _audit.consume_batch_tier()
+                        node = _audit.consume_fleet_node()
+                        _audit.record_served("vector", tier or "host")
                     doc = {"hits": list(res[:item["k"]]), "tier": tier}
                     if node:
                         doc["node"] = node
@@ -969,15 +993,19 @@ class DispatchBroker:
             with _audit.collect_degrades() as degrades, \
                     _adm.deadline_scope(item.get("deadline")), \
                     _adm.lane_scope(item.get("lane")
-                                    or _adm.LANE_INTERACTIVE):
+                                    or _adm.LANE_INTERACTIVE), \
+                    _tenant.scope_from_context(ctx):
                 # the ring-carried admission context binds the op: a
                 # nested MicroBatcher/convoy ride below inherits the
                 # rider's budget and lane (ISSUE 15)
-                if ctx is not None:
+                if ctx is not None and ctx.get("trace_id"):
                     # PROPAGATED trace (ISSUE 13): the op executes
                     # under the rider's trace id, so degrade records
                     # minted here carry it across the boundary, and
-                    # plane-side child spans export back in meta
+                    # plane-side child spans export back in meta. A
+                    # tenant-only ctx (untraced rider) binds the scope
+                    # above but must NOT mint spans — untraced in,
+                    # untraced out
                     attrs = {"target": target_name, "op": method,
                              "surface": "broker"}
                     if item.get("deadline"):

@@ -32,6 +32,7 @@ from nornicdb_tpu.obs import (
     record_stage,
 )
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu import admission as _adm
 
 # one metric family set shared by every batcher instance (per-collection
@@ -153,6 +154,7 @@ class BatchCoalescer:
                 f"({self._surface})")
         item = _Item(value)
         item.deadline, item.lane, item.t_enq = dl, lane, t_enq
+        item.tenant = _tenant.current_tenant()
         with self._cond:
             self._pending.append(item)
         while True:
@@ -224,7 +226,10 @@ class BatchCoalescer:
             item.apply_t0 = t0
             item.batch_size = len(batch)
         try:
-            results = self._apply_batch([i.value for i in batch])
+            # the riders' tenant mix binds around the merged apply so
+            # any cost/serve recorded inside splits per tenant (18)
+            with _tenant.batch_scope([i.tenant for i in batch]):
+                results = self._apply_batch([i.value for i in batch])
             for item, res in zip(batch, results):
                 item.result = res
         except Exception as exc:  # noqa: BLE001 — delivered per-request
@@ -236,7 +241,8 @@ class BatchCoalescer:
                 # request(s) observe the error
                 for item in batch:
                     try:
-                        item.result = self._apply_single(item.value)
+                        with _tenant.batch_scope([item.tenant]):
+                            item.result = self._apply_single(item.value)
                     except Exception as single_exc:  # noqa: BLE001
                         item.error = single_exc
         t1 = time.time()
@@ -247,7 +253,8 @@ class BatchCoalescer:
 
 class _Item:
     __slots__ = ("value", "done", "result", "error", "apply_t0",
-                 "apply_t1", "batch_size", "lane", "deadline", "t_enq")
+                 "apply_t1", "batch_size", "lane", "deadline", "t_enq",
+                 "tenant")
 
     def __init__(self, value: Any):
         self.value = value
@@ -262,12 +269,15 @@ class _Item:
         self.lane = _adm.LANE_INTERACTIVE
         self.deadline: "float | None" = None
         self.t_enq = 0.0
+        # tenant captured at enqueue (ISSUE 18): the convoy leader
+        # binds the batch's tenant mix so merged-apply cost splits
+        self.tenant: "str | None" = None
 
 
 class _Req:
     __slots__ = ("vec", "k", "extra", "done", "result", "error",
                  "dispatch_t0", "dispatch_t1", "batch_size", "tier",
-                 "lane", "deadline", "t_enq", "early")
+                 "lane", "deadline", "t_enq", "early", "tenant")
 
     def __init__(self, vec: np.ndarray, k: int, extra: Any = None):
         self.vec = vec
@@ -293,6 +303,9 @@ class _Req:
         # the leader skipped the gather window because this rider's (or
         # a batch-mate's) budget was tight — annotated on the trace
         self.early = False
+        # tenant captured at enqueue (ISSUE 18): the batch leader binds
+        # the riders' mix so the padded-dispatch cost splits per tenant
+        self.tenant: "str | None" = None
 
 
 class MicroBatcher:
@@ -369,6 +382,7 @@ class MicroBatcher:
                 f"({self._surface})")
         req = _Req(np.asarray(vec, np.float32), k, extra)
         req.deadline, req.lane, req.t_enq = dl, lane, t_enq
+        req.tenant = _tenant.current_tenant()
         with self._cond:
             self._pending.append(req)
         while True:
@@ -545,13 +559,16 @@ class MicroBatcher:
                 queries = np.concatenate([queries, pad], axis=0)
             t0 = time.time()
             _audit.consume_batch_tier()  # clear any stale leader note
-            if self._pass_extras:
-                # pad extras like the query rows: repeat request 0's
-                extras = [r.extra for r in batch]
-                extras += [batch[0].extra] * (bucket - b)
-                results = self._search_batch(queries, k_max, extras)
-            else:
-                results = self._search_batch(queries, k_max)
+            # bind the riders' tenant mix around the dispatch (18): the
+            # padded program's cost splits across riders by tenant
+            with _tenant.batch_scope([r.tenant for r in batch]):
+                if self._pass_extras:
+                    # pad extras like the query rows: repeat request 0's
+                    extras = [r.extra for r in batch]
+                    extras += [batch[0].extra] * (bucket - b)
+                    results = self._search_batch(queries, k_max, extras)
+                else:
+                    results = self._search_batch(queries, k_max)
             t1 = time.time()
             tier = _audit.consume_batch_tier()
             record_dispatch("microbatch", bucket, k_max, t1 - t0)
@@ -582,10 +599,12 @@ class MicroBatcher:
                     r.dispatch_t0 = time.time()
                     q1 = np.asarray(r.vec, np.float32)[None, :]
                     _audit.consume_batch_tier()
-                    if self._pass_extras:
-                        res = self._search_batch(q1, kb, [r.extra])[0]
-                    else:
-                        res = self._search_batch(q1, kb)[0]
+                    with _tenant.batch_scope([r.tenant]):
+                        if self._pass_extras:
+                            res = self._search_batch(q1, kb,
+                                                     [r.extra])[0]
+                        else:
+                            res = self._search_batch(q1, kb)[0]
                     r.tier = _audit.consume_batch_tier()
                     r.dispatch_t1 = time.time()
                     r.batch_size = 1

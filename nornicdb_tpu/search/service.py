@@ -20,6 +20,7 @@ import numpy as np
 
 from nornicdb_tpu.obs import REGISTRY, attach_span
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.search.bm25 import BM25Index, tokenize
 from nornicdb_tpu.search.hnsw import HNSWIndex
 from nornicdb_tpu.search.rrf import rrf_fuse
@@ -953,6 +954,9 @@ class SearchService:
             if cached is not None:
                 self.stats.cache_hits += 1
                 _HYBRID_CACHED_SERVED.inc()
+                # pre-bound child skips record_served; the per-tenant
+                # request still counts the hit (ISSUE 18)
+                _tenant.record_served("hybrid", "cached")
                 return cached
             gen_at_miss = self._result_cache.generation
         timings: Dict[str, float] = {}

@@ -164,6 +164,15 @@ CHECKS: Dict[str, Tuple] = {
     "fleet_read_scaling": ("scaling", 1.5, 0.6),
     "fleet_proc_parity": ("quality", 1.0, 0.0),
     "fleet_proc_trace_completeness": ("quality", 1.0, 0.0),
+    # tenant truth (round r18+, ISSUE 18): attribution completeness
+    # over the multi-tenant overload window gates ABSOLUTELY at 1.0 —
+    # a request served without a tenant identity is an attribution
+    # seam, not noise. The flooding tenant must own >= 0.5 of the
+    # measured dispatch cost (the write-path pricing + batch-mix
+    # split working end-to-end); below that the cost meter is
+    # misattributing the overload.
+    "tenant_attribution": ("quality", 1.0, 0.0),
+    "tenant_flood_cost_share": ("quality", 0.5, 0.5),
 }
 
 
@@ -329,6 +338,21 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         out["fleet_proc_trace_completeness"] = _num(
             fp.get("trace_completeness"))
         out["fleet_proc_cores"] = _num(fp.get("cores"))
+    # tenant truth (round r18+): the summary packs [attribution,
+    # flood_cost_share, noisy_events, flood_vs_knee]; the full
+    # artifact carries the named keys under "tenants"
+    tn = doc.get("tenants") or {}
+    if isinstance(tn, list):
+        pad = tn + [None] * 4
+        out["tenant_attribution"] = _num(pad[0])
+        out["tenant_flood_cost_share"] = _num(pad[1])
+        out["tenant_noisy_events"] = _num(pad[2])
+    else:
+        out["tenant_attribution"] = _num(tn.get("tenant_attribution"))
+        out["tenant_flood_cost_share"] = _num(
+            tn.get("flood_cost_share"))
+        out["tenant_noisy_events"] = _num(
+            tn.get("noisy_neighbor_events"))
     surfaces = doc.get("surfaces") or {}
     for name in ("bolt", "neo4j_http", "graphql", "rest_search",
                  "qdrant_grpc"):

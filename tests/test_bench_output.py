@@ -156,6 +156,22 @@ def _fake_result():
                                       "peak_lag_ops": 310,
                                       "drain_s": 2.4},
                        "trace_completeness": 1.0},
+        "tenants": {"tenants_total": 10, "knee_upserts_per_s": 80.0,
+                    "flood": {"collection": "bulk_flood",
+                              "target_multiple": 2.0,
+                              "upserts_per_s": 40.0, "shed": 60,
+                              "offered_vs_knee": 2.1},
+                    "interactive": {"readers": 9,
+                                    "reads_per_s": 3000.0,
+                                    "errors": 0},
+                    "tenant_attribution": 1.0,
+                    "flood_cost_share": 0.61,
+                    "noisy_neighbor_events": 1,
+                    "noisy_neighbor_advisory": {
+                        "tenant": "bulk_flood", "cost_share": 0.6,
+                        "posture_level": 1},
+                    "requests_by_tenant": {"bulk_flood": 84.0},
+                    "admin_tenants": {"known": 10, "top": []}},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -238,6 +254,11 @@ class TestCompactSummary:
         # the cross-process trace fraction (absolute 1.0), and the
         # core count the sentinel's scaling floor keys on
         assert s["fleet_proc"] == [390.0, 1.857, 1.0, 1.0, 8]
+        # tenant truth (ISSUE 18), packed [attribution_completeness,
+        # flood_cost_share, noisy_neighbor_events, flood_vs_knee]:
+        # the sentinel gates attribution ABSOLUTELY at 1.0 and the
+        # flooder's cost share at the 0.5 floor
+        assert s["tenants"] == [1.0, 0.61, 1, 2.1]
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -313,7 +334,7 @@ class TestBenchDryRunArtifactSchema:
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
                     "knn", "northstar", "ann", "hybrid", "quant",
                     "tiered", "surfaces", "telemetry", "load", "fleet",
-                    "tpu_proof")
+                    "tenants", "tpu_proof")
 
     def test_dry_run_artifact_schema(self, dry_run_lines):
         lines = dry_run_lines
@@ -666,6 +687,47 @@ class TestBenchDryRunArtifactSchema:
         assert summary["fleet_proc"][2] == 1.0
         assert summary["fleet_proc"][3] == 1.0
         assert summary["fleet_proc"][4] == fp["cores"]
+
+    def test_tenants_stage_schema(self, dry_run_lines):
+        """Multi-tenant overload stage (ISSUE 18): one tenant floods
+        bulk upserts through the collection->tenant mapping while nine
+        interactive tenants read under explicit headers. Attribution
+        completeness must hit the ABSOLUTE 1.0 contract, the flooder
+        must own >= 0.5 of the measured dispatch cost, the rollup must
+        surface it at /admin/tenants, and the noisy-neighbor advisory
+        must land in the journal — in every dry run."""
+        full = json.loads(dry_run_lines[0])
+        summary = json.loads(dry_run_lines[-1])
+        tn = full["tenants"]
+        assert "error" not in tn, tn
+        assert tn["tenants_total"] == 10
+        assert tn["knee_upserts_per_s"] > 0
+        assert tn["flood"]["collection"] == "bulk_flood"
+        assert tn["flood"]["offered_vs_knee"] > 1.0
+        assert tn["interactive"]["readers"] == 9
+        assert tn["interactive"]["reads_per_s"] > 0
+        assert tn["tenant_attribution"] == 1.0  # absolute contract
+        assert tn["flood_cost_share"] >= 0.5
+        assert tn["noisy_neighbor_events"] >= 1
+        adv = tn["noisy_neighbor_advisory"]
+        assert adv["tenant"] == "bulk_flood"
+        assert adv["posture_level"] >= 1
+        assert adv["cost_share"] >= 0.5
+        assert "bulk_flood" in tn["requests_by_tenant"]
+        # the rollup ranks by cumulative flops across the whole bench
+        # process, so earlier direct-library stages (no tenant scope)
+        # may outrank the stage's tenants — the contract is that the
+        # flooder is VISIBLE at /admin/tenants with a cost row, not
+        # that it tops a process-lifetime leaderboard
+        top = tn["admin_tenants"]["top"]
+        flood_rows = [t for t in top if t["tenant"] == "bulk_flood"]
+        assert flood_rows and flood_rows[0]["requests"] > 0
+        assert flood_rows[0]["cost_share"] is not None
+        # the summary packs [attribution, cost_share, events, vs_knee]
+        assert summary["tenants"][0] == 1.0
+        assert summary["tenants"][1] == tn["flood_cost_share"]
+        assert summary["tenants"][2] >= 1
+        assert summary["tenants"][3] == tn["flood"]["offered_vs_knee"]
 
 
 class TestTpuProofDryRun:

@@ -1,0 +1,555 @@
+"""Tenant truth (ISSUE 18): end-to-end per-tenant attribution.
+
+- resolution order at the ingress (header > propagated context >
+  multidb namespace > default) and the qdrant collection mapping;
+- the cardinality-capped label registry (fold past NORNICDB_TENANT_MAX
+  into ``__other__``);
+- contextvar propagation across the executor hop and the 4-field
+  ``X-Nornic-Trace`` wire format (satellite 2 regression pin, via the
+  FleetRouter RemoteReplica path);
+- the leader->rider batch-mix cost split;
+- worker/plane boundary: a 2-worker thread WirePlane serves
+  /admin/tenants with per-tenant counters merged exactly once;
+- ledger/journal/shed records carry the tenant stamp and the
+  noisy-neighbor detector emits its advisory event.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import nornicdb_tpu
+from nornicdb_tpu import admission as _adm
+from nornicdb_tpu import obs
+from nornicdb_tpu.obs import audit, events, tenant, tracing
+from nornicdb_tpu.obs.metrics import REGISTRY
+
+D = 16
+
+
+def _child(name, key):
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    c = fam.children().get(tuple(key))
+    return float(c.value) if c is not None else 0.0
+
+
+def _requests_for(tenant_name):
+    """Sum of nornicdb_tenant_requests_total across surfaces."""
+    fam = REGISTRY.get("nornicdb_tenant_requests_total")
+    if fam is None:
+        return 0.0
+    return sum(float(c.value) for k, c in fam.children().items()
+               if k[0] == tenant_name)
+
+
+def _mk_db(n=12):
+    import os
+
+    os.environ.setdefault("NORNICDB_TPU_EMBEDDER", "hash")
+    db = nornicdb_tpu.open(auto_embed=False)
+    emb = db._embedder
+    for i in range(n):
+        db.store(f"person{i} topic{i % 3}", node_id=f"p{i}",
+                 labels=["Person"],
+                 properties={"name": f"person{i}"},
+                 embedding=emb.embed(f"person{i} topic{i % 3}"))
+    db.flush()
+    return db
+
+
+# ---------------------------------------------------------------------------
+# resolution order
+# ---------------------------------------------------------------------------
+
+
+class TestResolution:
+    def test_header_wins_over_namespace(self):
+        assert tenant.resolve("acme", None, "movies") == ("acme", True)
+
+    def test_propagated_context_is_explicit(self):
+        ctx = {"trace_id": "feedface00000001", "tenant": "acme"}
+        assert tenant.resolve(None, ctx, "movies") == ("acme", True)
+
+    def test_namespace_fallback_is_implicit(self):
+        assert tenant.resolve(None, None, "movies") == ("movies", False)
+
+    def test_default_when_nothing(self):
+        assert tenant.resolve(None, None, None) == \
+            (tenant.DEFAULT_TENANT, False)
+
+    def test_malformed_header_falls_through(self):
+        # the header is client-reachable: it becomes a metric label
+        # and an admin-surface string, so the charset is validated
+        assert tenant.resolve("a b", None, "movies") == \
+            ("movies", False)
+        assert tenant.resolve("x" * 65, None, None) == \
+            (tenant.DEFAULT_TENANT, False)
+
+    def test_collection_mapping(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_TENANT_COLLECTIONS",
+                           "shared_docs:acme")
+        tenant.reload()
+        try:
+            assert tenant.tenant_for_collection("shared_docs") == "acme"
+            assert tenant.tenant_for_collection("beta__docs") == "beta"
+            assert tenant.tenant_for_collection("plain") == "plain"
+            assert tenant.tenant_for_collection("") is None
+        finally:
+            monkeypatch.undo()
+            tenant.reload()
+
+    def test_explicit_scope_resists_refine(self):
+        with tenant.tenant_scope("acme", explicit=True):
+            tenant.refine("derived")
+            assert tenant.current_tenant() == "acme"
+        with tenant.tenant_scope(None):
+            tenant.refine("derived")
+            assert tenant.current_tenant() == "derived"
+
+
+# ---------------------------------------------------------------------------
+# contextvar propagation + the 4-field wire format (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestPropagation:
+    def test_refine_visible_across_executor_hop(self):
+        """The cell is ONE shared mutable object: a refine() inside a
+        copy_context()-run executor thread (the MicroBatcher hop) is
+        visible at the ingress scope."""
+        with tenant.tenant_scope(None):
+            ctx = contextvars.copy_context()
+            ctx.run(tenant.refine, "late-bound")
+            assert tenant.current_tenant() == "late-bound"
+
+    def test_pack_context_carries_tenant(self):
+        ctx = {"trace_id": "feedface00000001", "surface": "http",
+               "span": "wire", "tenant": "acme"}
+        packed = tracing.pack_context(ctx)
+        assert packed == "feedface00000001|http|wire|acme"
+        assert tracing.unpack_context(packed) == ctx
+
+    def test_three_field_header_still_parses(self):
+        # pre-ISSUE-18 peers pack 3 fields; the tenant field is only
+        # appended when present, so old<->new interop holds both ways
+        ctx = tracing.unpack_context("feedface00000001|http|wire")
+        assert ctx == {"trace_id": "feedface00000001",
+                       "surface": "http", "span": "wire"}
+        assert "tenant" not in tracing.pack_context(ctx)
+
+    def test_malformed_tenant_field_dropped(self):
+        ctx = tracing.unpack_context("feedface00000001|http|wire|a b")
+        assert ctx is not None and "tenant" not in ctx
+
+    def test_trace_context_reads_tenant_provider(self):
+        with tenant.tenant_scope("acme", explicit=True), \
+                obs.trace("wire", transport="http"):
+            assert tracing.trace_context()["tenant"] == "acme"
+
+    def test_fleet_router_hop_propagates_tenant(self):
+        """Satellite 2 regression pin: a fleet-routed read reaches the
+        remote node's HTTP server with the caller's tenant riding
+        X-Nornic-Trace — the remote attributes its serve to the SAME
+        tenant, not to its own namespace default."""
+        from nornicdb_tpu.api.fleet_router import RemoteReplica
+        from nornicdb_tpu.api.http_server import HttpServer
+
+        db = _mk_db()
+        srv = HttpServer(db, port=0).start()
+        try:
+            replica = RemoteReplica(
+                "n1", f"http://127.0.0.1:{srv.port}")
+            before = _requests_for("hop-tenant")
+            with tenant.tenant_scope("hop-tenant", explicit=True), \
+                    obs.trace("wire", transport="http"):
+                doc = replica.search({"query": "person1 topic1",
+                                      "limit": 2})
+            assert doc.get("results") is not None
+            assert _requests_for("hop-tenant") > before
+        finally:
+            srv.stop()
+            db.close()
+
+
+# ---------------------------------------------------------------------------
+# cardinality cap
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryCap:
+    def test_folding_past_cap(self, monkeypatch):
+        monkeypatch.setenv("NORNICDB_TENANT_MAX", "2")
+        tenant.reload()
+        try:
+            fold0 = _child("nornicdb_tenant_folded_total", ())
+            for i in range(4):
+                with tenant.tenant_scope(f"cap-t{i}", explicit=True):
+                    tenant.record_served("http", "host")
+            known = tenant.known_tenants()
+            assert len(known) == 2
+            assert known == ["cap-t0", "cap-t1"]
+            # the two over-cap tenants folded into __other__
+            assert _child("nornicdb_tenant_folded_total",
+                          ()) == fold0 + 2
+            assert _child("nornicdb_tenant_requests_total",
+                          (tenant.OTHER_TENANT, "http")) >= 2
+            # known names stay stable: a repeat does NOT fold
+            with tenant.tenant_scope("cap-t1", explicit=True):
+                tenant.record_served("http", "host")
+            assert _child("nornicdb_tenant_folded_total",
+                          ()) == fold0 + 2
+        finally:
+            monkeypatch.undo()
+            tenant.reload()
+
+
+# ---------------------------------------------------------------------------
+# leader->rider batch-mix split
+# ---------------------------------------------------------------------------
+
+
+class TestBatchMix:
+    def test_cost_splits_across_riders_by_tenant(self):
+        fa = _child("nornicdb_tenant_cost_flops_total", ("mix-a",))
+        fb = _child("nornicdb_tenant_cost_flops_total", ("mix-b",))
+        with tenant.batch_scope(["mix-a", "mix-a", "mix-b"]):
+            tenant.record_cost(queries=3, flops=300.0, bytes_=30.0)
+        assert _child("nornicdb_tenant_cost_flops_total",
+                      ("mix-a",)) == pytest.approx(fa + 200.0)
+        assert _child("nornicdb_tenant_cost_flops_total",
+                      ("mix-b",)) == pytest.approx(fb + 100.0)
+
+    def test_serves_distribute_and_scope_nests(self):
+        ra = _child("nornicdb_tenant_requests_total", ("mix-a", "vector"))
+        with tenant.batch_scope(["mix-a", "mix-b"]):
+            with tenant.batch_scope(["mix-a"]):
+                tenant.record_served("vector", "host", n=1)
+            # inner scope restored: the outer mix splits again
+            tenant.record_served("vector", "host", n=2)
+        assert _child("nornicdb_tenant_requests_total",
+                      ("mix-a", "vector")) == pytest.approx(ra + 2.0)
+
+    def test_unattributed_rider_counts_as_unattributed(self):
+        u0 = _child("nornicdb_tenant_requests_total",
+                    (tenant.UNATTRIBUTED, "vector"))
+        with tenant.batch_scope([None, "mix-a"]):
+            tenant.record_served("vector", "host", n=2)
+        assert _child("nornicdb_tenant_requests_total",
+                      (tenant.UNATTRIBUTED, "vector")) == \
+            pytest.approx(u0 + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# multidb namespace -> tenant at the HTTP ingress (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestHttpIngress:
+    @pytest.fixture()
+    def server(self):
+        from nornicdb_tpu.api.http_server import HttpServer
+        from nornicdb_tpu.multidb import DatabaseManager
+        from nornicdb_tpu.storage import MemoryEngine
+
+        db = _mk_db()
+        mgr = DatabaseManager(MemoryEngine())
+        mgr.create_database("movies", if_not_exists=True)
+        srv = HttpServer(db, port=0, database_manager=mgr).start()
+        yield db, srv
+        srv.stop()
+        db.close()
+
+    def _post(self, port, path, doc, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read())
+
+    def test_default_database_is_the_fallback_tenant(self, server):
+        db, srv = server
+        # no header, non-multidb path: the server's default database
+        # namespace is the implicit tenant
+        before = _requests_for(srv.default_database)
+        status, doc = self._post(srv.port, "/nornicdb/search",
+                                 {"query": "person1", "limit": 2})
+        assert status == 200
+        assert _requests_for(srv.default_database) > before
+
+    def test_namespace_route_names_the_tenant(self, server):
+        db, srv = server
+        before = _requests_for("movies")
+        status, doc = self._post(
+            srv.port, "/db/movies/tx/commit",
+            {"statements": [{"statement": "RETURN 1"}]})
+        assert status == 200
+        assert _requests_for("movies") > before
+
+    def test_header_overrides_namespace(self, server):
+        db, srv = server
+        before_h = _requests_for("hdr-tenant")
+        before_ns = _requests_for("movies")
+        status, doc = self._post(
+            srv.port, "/db/movies/tx/commit",
+            {"statements": [{"statement": "RETURN 1"}]},
+            headers={tenant.TENANT_HEADER: "hdr-tenant"})
+        assert status == 200
+        assert _requests_for("hdr-tenant") > before_h
+        assert _requests_for("movies") == before_ns
+
+    def test_admin_tenants_rollup(self, server):
+        db, srv = server
+        self._post(srv.port, "/nornicdb/search",
+                   {"query": "person2", "limit": 2},
+                   headers={tenant.TENANT_HEADER: "rollup-t"})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/admin/tenants",
+                timeout=15) as r:
+            doc = json.loads(r.read())
+        assert doc["cap"] >= 1 and doc["total"] >= 1
+        names = [t["tenant"] for t in doc["tenants"]]
+        assert "rollup-t" in names
+        row = doc["tenants"][names.index("rollup-t")]
+        assert row["requests"] > 0 and "cost" in row
+        assert "noisy_neighbor" in doc
+        # the same block rides /admin/telemetry
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/admin/telemetry",
+                timeout=15) as r:
+            tdoc = json.loads(r.read())
+        assert "tenants" in tdoc
+
+
+# ---------------------------------------------------------------------------
+# worker/plane boundary: merged exactly once (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestWirePlaneMerge:
+    def test_two_worker_scrape_merges_tenant_counters_once(self):
+        from nornicdb_tpu.api.wire_plane import WirePlane
+
+        db = _mk_db()
+        plane = WirePlane(db, workers=2, mode="thread").start()
+        try:
+            body = json.dumps({"query": "person1 topic1",
+                               "limit": 2}).encode()
+            sent = 3
+            for _ in range(sent):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{plane.http_port}"
+                    "/nornicdb/search", data=body,
+                    headers={"Content-Type": "application/json",
+                             tenant.TENANT_HEADER: "plane-t"})
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    assert r.status == 200
+            # worker-served /admin/tenants over the merged view: the
+            # tenant appears exactly once with the exact request count
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{plane.http_port}"
+                    "/admin/tenants", timeout=15) as r:
+                doc = json.loads(r.read())
+            rows = [t for t in doc["tenants"]
+                    if t["tenant"] == "plane-t"]
+            assert len(rows) == 1
+            # merged exactly once: the rollup equals the registry's
+            # own ground truth (a double merge would double it), and
+            # every one of the posted requests was attributed
+            assert rows[0]["requests"] == \
+                pytest.approx(_requests_for("plane-t"))
+            assert rows[0]["requests"] >= sent
+            # the scrape shows the family exactly once too
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{plane.http_port}/metrics",
+                    timeout=15) as r:
+                text = r.read().decode()
+            assert text.count(
+                "# TYPE nornicdb_tenant_requests_total") == 1
+        finally:
+            plane.stop()
+            db.close()
+
+
+# ---------------------------------------------------------------------------
+# tenant stamps on ledger / journal / shed + the detector
+# ---------------------------------------------------------------------------
+
+
+class TestStampsAndDetector:
+    def test_degrade_record_carries_tenant(self):
+        with tenant.tenant_scope("stamp-t", explicit=True):
+            audit.record_degrade("vector", "device_ann",
+                                 "vector_brute_f32", "fallback")
+        recs = [r for r in audit.LEDGER.snapshot(limit=10)
+                if r.get("tenant") == "stamp-t"]
+        assert recs and recs[-1]["surface"] == "vector"
+
+    def test_shed_counts_per_tenant_and_stamps(self):
+        before = _child("nornicdb_tenant_shed_total",
+                        ("shed-t", "http", "shed"))
+        with tenant.tenant_scope("shed-t", explicit=True):
+            _adm.record_shed("http", "interactive", "shed",
+                             retry_after_s=0.5)
+        assert _child("nornicdb_tenant_shed_total",
+                      ("shed-t", "http", "shed")) == before + 1
+        recs = [r for r in audit.LEDGER.snapshot(limit=10)
+                if r.get("tenant") == "shed-t"]
+        assert recs and recs[-1]["to_tier"] == audit.TIER_SHED
+
+    def test_journal_events_stamp_tenant(self):
+        with tenant.tenant_scope("ev-t", explicit=True):
+            events.record_event("degrade", surface="vector",
+                                reason="fallback")
+        evs = [e for e in events.event_snapshot(limit=20)
+               if e.get("tenant") == "ev-t"]
+        assert evs and evs[-1]["kind"] == "degrade"
+
+    def test_noisy_neighbor_advisory_event(self):
+        tenant.DETECTOR.reset()
+        saved = tenant._posture_provider
+        tenant.set_posture_provider(lambda: 1)  # degrade posture
+        try:
+            flops = tenant.cfg()["noisy_min_flops"] * 2
+            with tenant.tenant_scope("flood-t", explicit=True):
+                tenant.record_cost(queries=1, flops=flops, bytes_=0.0)
+            evs = [e for e in events.event_snapshot(limit=20)
+                   if e["kind"] == "noisy_neighbor"]
+            assert evs, "no advisory event emitted"
+            ev = evs[-1]
+            assert ev["detail"]["tenant"] == "flood-t"
+            assert ev["detail"]["cost_share"] >= \
+                tenant.cfg()["noisy_share"]
+            emitted = tenant.DETECTOR.emitted
+            # cooldown: an immediate repeat does not double-journal
+            with tenant.tenant_scope("flood-t", explicit=True):
+                tenant.record_cost(queries=1, flops=flops, bytes_=0.0)
+            assert tenant.DETECTOR.emitted == emitted
+        finally:
+            tenant.set_posture_provider(saved)
+            tenant.DETECTOR.reset()
+
+    def test_admit_posture_never_accuses(self):
+        tenant.DETECTOR.reset()
+        saved = tenant._posture_provider
+        tenant.set_posture_provider(lambda: 0)  # healthy
+        before = tenant.DETECTOR.emitted
+        try:
+            flops = tenant.cfg()["noisy_min_flops"] * 2
+            with tenant.tenant_scope("quiet-t", explicit=True):
+                tenant.record_cost(queries=1, flops=flops, bytes_=0.0)
+            assert tenant.DETECTOR.emitted == before
+        finally:
+            tenant.set_posture_provider(saved)
+            tenant.DETECTOR.reset()
+
+
+# ---------------------------------------------------------------------------
+# summary math
+# ---------------------------------------------------------------------------
+
+
+class TestSummary:
+    def test_attribution_completeness_math(self):
+        state = {"nornicdb_tenant_requests_total": {
+            "name": "nornicdb_tenant_requests_total",
+            "kind": "counter", "help": "", "labels": ("tenant",
+                                                      "surface"),
+            "children": {("acme", "http"): 3.0,
+                         (tenant.UNATTRIBUTED, "http"): 1.0}}}
+        assert tenant.attribution_completeness(state) == \
+            pytest.approx(0.75)
+        assert tenant.attribution_completeness({}) is None
+
+    def test_summary_top_k_orders_by_cost(self):
+        state = {
+            "nornicdb_tenant_requests_total": {
+                "name": "nornicdb_tenant_requests_total",
+                "kind": "counter", "help": "",
+                "labels": ("tenant", "surface"),
+                "children": {("a", "http"): 5.0, ("b", "http"): 1.0}},
+            "nornicdb_tenant_cost_flops_total": {
+                "name": "nornicdb_tenant_cost_flops_total",
+                "kind": "counter", "help": "", "labels": ("tenant",),
+                "children": {("a",): 10.0, ("b",): 90.0}},
+        }
+        doc = tenant.tenants_summary(state=state, top=1)
+        assert [t["tenant"] for t in doc["tenants"]] == ["b"]
+        assert doc["tenants"][0]["cost_share"] == pytest.approx(0.9)
+        assert doc["total"] == 2  # both tenants known, one shown
+        assert doc["merged"] is True  # state passed -> flagged merged
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: the TENANT_FAMILIES lint rule (nornic-lint
+# metrics-catalog pass)
+# ---------------------------------------------------------------------------
+
+
+class TestTenantFamilyLintRule:
+    def test_live_registry_has_no_drift(self):
+        """Every registered tenant-labeled family is declared and
+        every declaration is live — the committed-tree contract."""
+        from nornicdb_tpu.lint.metrics_catalog import tenant_family_drift
+
+        undeclared, stale = tenant_family_drift()
+        assert undeclared == []
+        assert stale == []
+
+    def test_undeclared_tenant_family_is_flagged(self):
+        """A new family that sneaks a ``tenant`` label past the
+        declaration registry is the exact hazard the rule exists
+        for — pin that it drifts."""
+        from nornicdb_tpu.lint.metrics_catalog import tenant_family_drift
+
+        REGISTRY.counter(
+            "nornicdb_tenant_lintfixture_total",
+            "fixture", labels=("tenant",))
+        try:
+            undeclared, _ = tenant_family_drift()
+            assert "nornicdb_tenant_lintfixture_total" in undeclared
+        finally:
+            REGISTRY._families.pop(
+                "nornicdb_tenant_lintfixture_total", None)
+
+    def test_stale_declaration_is_flagged(self, monkeypatch):
+        from nornicdb_tpu.lint import config as lint_config
+        from nornicdb_tpu.lint.metrics_catalog import tenant_family_drift
+
+        monkeypatch.setattr(
+            lint_config, "TENANT_FAMILIES",
+            lint_config.TENANT_FAMILIES + ("nornicdb_tenant_gone_total",))
+        _, stale = tenant_family_drift()
+        assert stale == ["nornicdb_tenant_gone_total"]
+
+    def test_pass_emits_findings_anchored_to_config(self, monkeypatch):
+        """The framework pass turns drift into findings the CLI
+        surfaces, anchored at lint/config.py (the file to edit)."""
+        from nornicdb_tpu.lint import config as lint_config
+        from nornicdb_tpu.lint import metrics_catalog as mc
+        from nornicdb_tpu.lint.astutil import PackageTree
+
+        declared = lint_config.TENANT_FAMILIES
+        assert declared, "registry must not be empty"
+        monkeypatch.setattr(
+            lint_config, "TENANT_FAMILIES", declared[1:])
+        import os
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(nornicdb_tpu.__file__)))
+        tree = PackageTree(root=repo, modules={})
+        findings = mc.run(tree)
+        rules = {(f.rule, f.detail) for f in findings}
+        assert ("undeclared-tenant-family", declared[0]) in rules
+        anchored = [f for f in findings
+                    if f.rule == "undeclared-tenant-family"]
+        assert all(f.path == "nornicdb_tpu/lint/config.py"
+                   for f in anchored)
