@@ -121,6 +121,7 @@ _DEVICE_STAGES = {
     "hybrid": (lambda: _bench_hybrid(), 900.0),
     "quant": (lambda: _bench_quant(), 900.0),
     "tiered": (lambda: _bench_tiered(), 900.0),
+    "background": (lambda: _bench_background(), 900.0),
     "tpu_proof": (lambda: _run_tpu_proof_stage(), 900.0),
 }
 
@@ -252,10 +253,20 @@ def main(dry_run: bool = False):
         except Exception as exc:
             result["tenants"] = {
                 "error": f"{type(exc).__name__}: {exc}"[:400]}
+        # background plane (ISSUE 19): tiny host-vs-device decay +
+        # link-prediction parity, priced job evidence, and the forked
+        # no-convoy probe — LAST among dry-run stages, because the
+        # convoy guard demotes this process to the idle scheduling
+        # class and the restore is best-effort
+        try:
+            result["background"] = _bench_background(tiny=True)
+        except Exception as exc:
+            result["background"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:400]}
         result["tpu_proof"] = {"skipped": "dry-run"}
         print(json.dumps(result))
         sys.stdout.flush()
-        print(json.dumps(_compact_summary(result)))
+        print(_dump_summary(_compact_summary(result)))
         return
     # device-touching stages run subprocess-isolated under deadlines (a
     # mid-run tunnel drop blocks forever otherwise); the accelerator
@@ -333,6 +344,13 @@ def main(dry_run: bool = False):
     except Exception as exc:
         result["tenants"] = {
             "error": f"{type(exc).__name__}: {exc}"[:400]}
+    # background plane (ISSUE 19): host-vs-device decay sweep and
+    # link-prediction throughput at N=100k, exact-parity verdicts, the
+    # per-job cost-counter evidence, and the no-convoy guard — runs
+    # subprocess-isolated both for the device watchdog AND because the
+    # guard demotes its own process to the idle scheduling class
+    result["background"] = _stage_subprocess(
+        "background", _DEVICE_STAGES["background"][1])
     # one-shot TPU proof (VERDICT r3 task 3): the first session where
     # the tunnel is up must capture EVERYTHING the TPU claim rests on —
     # compiled (non-interpret) Pallas kernels, batched device kNN, and
@@ -344,7 +362,7 @@ def main(dry_run: bool = False):
     # truncation because the headline printed first
     print(json.dumps(result))
     sys.stdout.flush()
-    print(json.dumps(_compact_summary(result)))
+    print(_dump_summary(_compact_summary(result)))
 
 
 # the telemetry series whose p50/p95/p99 ride the compact summary (one
@@ -378,6 +396,12 @@ def _bench_telemetry():
         }
     except Exception as exc:  # noqa: BLE001 — artifact must always emit
         return {"error": f"{type(exc).__name__}: {exc}"[:400]}
+
+
+def _dump_summary(doc):
+    # the driver keeps only the LAST 2000 chars of output; compact
+    # separators buy ~150 chars of headroom over json.dumps defaults
+    return json.dumps(doc, separators=(",", ":"))
 
 
 def _compact_summary(result):
@@ -607,6 +631,14 @@ def _compact_summary(result):
             g(result, "tenants", "flood_cost_share"),
             g(result, "tenants", "noisy_neighbor_events"),
             g(result, "tenants", "flood", "offered_vs_knee"),
+        ],
+        # background plane (ISSUE 19), packed [sweep_speedup, parity,
+        # convoy_ok] — the sentinel gates the first at the 0.5 qps
+        # floor and parity/convoy ABSOLUTELY at 1.0
+        "background": [
+            g(result, "background", "background_sweep_speedup"),
+            g(result, "background", "background_parity"),
+            g(result, "background", "background_convoy_ok"),
         ],
         "surfaces": surfaces,
         # what grpc-python can physically do on this box with this
@@ -2407,6 +2439,286 @@ def _bench_tenants(tiny: bool = False):
     return out
 
 
+def _bench_background(tiny: bool = False):
+    """Device-resident background plane (ISSUE 19): the decay sweep and
+    link-prediction loops that used to walk the graph one node at a
+    time in Python, re-run as vmapped device programs over the
+    per-etype delta snapshots — host-vs-device wall clock at N>=100k,
+    exact-parity verdicts, per-job cost-counter evidence, and the
+    no-convoy guard (interactive p99 from a forked replica probe must
+    stay inside 2x solo p99 + 1ms while a sweep runs)."""
+    import multiprocessing as _mp
+    import random as _random
+    import threading as _threading
+
+    import numpy as np
+
+    from nornicdb_tpu import linkpredict as _lp
+    from nornicdb_tpu.background.device_plane import (
+        BackgroundDevicePlane, demote_to_background_priority)
+    from nornicdb_tpu.decay import DecayManager
+    from nornicdb_tpu.obs.metrics import REGISTRY as _REG
+    from nornicdb_tpu.query.columnar import ColumnarCatalog
+    from nornicdb_tpu.storage import Edge, MemoryEngine, Node, now_ms
+
+    n = 2_000 if tiny else 100_000
+    n_edges = 3 * n
+    n_seeds = 64 if tiny else 256
+    day = 86_400_000
+    now = now_ms()
+    out = {"n": n, "edges": n_edges, "seeds": n_seeds}
+
+    def build_engine():
+        eng = MemoryEngine()
+        r = _random.Random(19)
+        for i in range(n):
+            eng.create_node(Node(
+                id=f"n{i}", labels=["T"],
+                properties={"importance": r.random()},
+                created_at=now - r.randrange(0, 80 * day)))
+        for j in range(n_edges):
+            eng.create_edge(Edge(
+                id=f"e{j}", type=("KNOWS", "LIKES")[j % 2],
+                start_node=f"n{r.randrange(n)}",
+                end_node=f"n{r.randrange(n)}"))
+        return eng
+
+    def mk_decay(eng):
+        dm = DecayManager(eng, archive_threshold=0.45)
+        r = _random.Random(7)
+        for i in range(0, n, 3):
+            dm.record_access(f"n{i}", at_ms=now - r.randrange(0, 40 * day))
+        return dm
+
+    def _kind_delta(name, before):
+        fam = _REG.get(name)
+        cur = {}
+        for key, child in (fam.children() if fam else {}).items():
+            cur[key[0]] = cur.get(key[0], 0.0) + child.value
+        return cur, {k: v - before.get(k, 0.0) for k, v in cur.items()}
+
+    prev_sched = None
+    try:
+        # two bit-identical graphs: the host engine runs the replaced
+        # per-node Python loops, the device engine runs the plane
+        eng_dev = build_engine()
+        eng_host = build_engine()
+        dm_dev = mk_decay(eng_dev)
+        dm_host = mk_decay(eng_host)
+        cat_dev = ColumnarCatalog(eng_dev)
+        plane = BackgroundDevicePlane(eng_dev, cat_dev, decay=dm_dev)
+
+        flops0, _ = _kind_delta("nornicdb_query_cost_flops_total", {})
+        queries0, _ = _kind_delta("nornicdb_query_cost_queries_total", {})
+
+        # -- decay: verdict parity on sweep 1 (cold), timing on sweep 2
+        # (warm compile, kalman initialized on both sides) -------------
+        res_dev = dm_dev.sweep(now)
+        res_host = dm_host.sweep(now)
+
+        def archived_parity():
+            flags_host = {nd.id: bool(nd.properties.get("_archived"))
+                          for nd in eng_host.all_nodes()}
+            same = sum(1 for nd in eng_dev.all_nodes()
+                       if flags_host.get(nd.id)
+                       == bool(nd.properties.get("_archived")))
+            return same / max(1, n)
+
+        parity1 = archived_parity() * (1.0 if res_dev == res_host else 0.0)
+        t0 = time.perf_counter()
+        res_dev2 = dm_dev.sweep(now + day)
+        t_decay_dev = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res_host2 = dm_host.sweep(now + day)
+        t_decay_host = time.perf_counter() - t0
+        parity2 = archived_parity() * (
+            1.0 if res_dev2 == res_host2 else 0.0)
+        decay_parity = min(parity1, parity2)
+        decay_speedup = t_decay_host / max(1e-9, t_decay_dev)
+        out["decay"] = {
+            "host_s": round(t_decay_host, 4),
+            "device_s": round(t_decay_dev, 4),
+            "speedup": round(decay_speedup, 2),
+            "parity": decay_parity,
+            "scored_archived_sweep1": list(res_dev),
+            "scored_archived_sweep2": list(res_dev2),
+            "device_dispatches": plane.dispatches,
+        }
+
+        # -- link prediction: device batch vs the cached-snapshot host
+        # loop (parity oracle + secondary baseline) and the replaced
+        # per-seed rebuild loop (the seed code's cost model) ----------
+        seeds = [f"n{i}" for i in range(n_seeds)]
+        plane.linkpredict_topk(seeds, method="adamic_adar", limit=10)
+        t0 = time.perf_counter()
+        got = plane.linkpredict_topk(seeds, method="adamic_adar", limit=10)
+        t_lp_dev = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        want = {s: _lp.predict_links(eng_dev, s, method="adamic_adar",
+                                     limit=10, catalog=cat_dev)
+                for s in seeds}
+        t_lp_cached = time.perf_counter() - t0
+        lp_parity = (sum(1 for s in seeds if got[s] == want[s])
+                     / max(1, len(seeds)))
+        # the replaced loop rebuilt the adjacency snapshot per seed;
+        # sample it (full at tiny sizes) and extrapolate
+        sample = seeds if tiny else seeds[:4]
+        t0 = time.perf_counter()
+        for s in sample:
+            _lp.predict_links(eng_dev, s, method="adamic_adar", limit=10)
+        t_lp_uncached = ((time.perf_counter() - t0) / len(sample)
+                         * len(seeds))
+        lp_speedup = t_lp_uncached / max(1e-9, t_lp_dev)
+        out["linkpredict"] = {
+            "method": "adamic_adar",
+            "device_s": round(t_lp_dev, 4),
+            "host_cached_s": round(t_lp_cached, 4),
+            "host_uncached_est_s": round(t_lp_uncached, 3),
+            "uncached_sampled_seeds": len(sample),
+            "speedup_vs_replaced_loop": round(lp_speedup, 1),
+            "speedup_vs_cached_host": round(
+                t_lp_cached / max(1e-9, t_lp_dev), 2),
+            "device_qps": round(len(seeds) / max(1e-9, t_lp_dev), 1),
+            "parity": lp_parity,
+        }
+
+        # -- fastrp: on-device matmul chain over the same CSR ---------
+        from nornicdb_tpu.ops.fastrp import fastrp_embeddings
+        dim = 32 if tiny else 64
+        plane.fastrp(dim=dim)
+        t0 = time.perf_counter()
+        ids, emb = plane.fastrp(dim=dim)
+        t_rp_dev = time.perf_counter() - t0
+        snap = plane._union_snapshot()
+        pairs_src = np.repeat(
+            np.arange(snap["n"], dtype=np.int32),
+            snap["indptr"][1:] - snap["indptr"][:-1])
+        pairs_dst = snap["nbr"]
+        half = pairs_src < pairs_dst
+        loops = pairs_src == pairs_dst
+        t0 = time.perf_counter()
+        emb_host = fastrp_embeddings(
+            snap["n"],
+            np.concatenate([pairs_src[half], pairs_src[loops]]),
+            np.concatenate([pairs_dst[half], pairs_dst[loops]]),
+            dim=dim)
+        t_rp_host = time.perf_counter() - t0
+        # isolated nodes embed to the zero vector on both sides; cosine
+        # parity is only defined over the connected rows
+        live = (np.linalg.norm(emb, axis=1) > 1e-9) & (
+            np.linalg.norm(emb_host, axis=1) > 1e-9)
+        cos = np.sum(emb[live] * emb_host[live], axis=1)
+        out["fastrp"] = {
+            "dim": dim,
+            "device_s": round(t_rp_dev, 4),
+            "host_s": round(t_rp_host, 4),
+            "speedup": round(t_rp_host / max(1e-9, t_rp_dev), 2),
+            "cos_min": round(float(cos.min()), 6) if cos.size else None,
+            "isolated": int((~live).sum()),
+        }
+
+        # -- per-job pricing evidence: the background kinds must have
+        # moved the cost counters -------------------------------------
+        _, flops_d = _kind_delta("nornicdb_query_cost_flops_total",
+                                 flops0)
+        _, queries_d = _kind_delta("nornicdb_query_cost_queries_total",
+                                   queries0)
+        out["cost"] = {
+            "flops_by_kind": {
+                k: round(v, 1) for k, v in flops_d.items()
+                if k.startswith("bg_")},
+            "queries_by_kind": {
+                k: round(v, 1) for k, v in queries_d.items()
+                if k.startswith("bg_")},
+            "priced": all(
+                flops_d.get(k, 0.0) > 0 and queries_d.get(k, 0.0) > 0
+                for k in ("bg_decay_sweep", "bg_linkpredict",
+                          "bg_fastrp")),
+        }
+
+        # -- no-convoy guard: interactive probe in a forked replica
+        # process (the multi-process fleet's serving shape) while the
+        # primary, self-demoted to the idle scheduling class, runs
+        # back-to-back sweeps. Gate: during-p99 <= 2x solo-p99 + 1ms.
+        ctx = _mp.get_context("fork")
+        start_evt = ctx.Event()
+        parent_c, child_c = ctx.Pipe()
+        iters = 120 if tiny else 400
+        k_warm = iters // 4
+        probe_ids = max(1, n // 20)
+
+        def _probe(conn, start):
+            def run(k):
+                lats = []
+                for i in range(k):
+                    t0 = time.perf_counter()
+                    _lp.predict_links(eng_dev, f"n{(i * 37) % probe_ids}",
+                                      limit=10, catalog=cat_dev)
+                    lats.append(time.perf_counter() - t0)
+                return [float(x) for x in np.percentile(
+                    np.array(lats) * 1e3, [50, 99])]
+            run(max(20, k_warm))
+            conn.send(run(iters))
+            start.wait()
+            time.sleep(0.1)
+            conn.send(run(iters))
+            conn.close()
+
+        # warm the host adjacency snapshot pre-fork so the child never
+        # pays the build, and never touches jax at all
+        _lp.predict_links(eng_dev, "n0", limit=10, catalog=cat_dev)
+        proc = ctx.Process(target=_probe, args=(child_c, start_evt))
+        proc.start()
+        solo = parent_c.recv()
+        prev_sched = demote_to_background_priority()
+        start_evt.set()
+        got_during = []
+        waiter = _threading.Thread(
+            target=lambda: got_during.append(parent_c.recv()))
+        waiter.start()
+        sweeps = 0
+        deadline = time.monotonic() + 120.0
+        while waiter.is_alive() and time.monotonic() < deadline:
+            plane.decay_sweep(now + 2 * day)
+            plane.linkpredict_topk(seeds, method="adamic_adar", limit=10)
+            sweeps += 1
+            waiter.join(timeout=0.001)
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.terminate()
+        if not got_during:
+            raise RuntimeError("convoy probe child never reported")
+        during = got_during[0]
+        budget_ms = 2 * solo[1] + 1.0
+        within = bool(during[1] <= budget_ms)
+        out["convoy"] = {
+            "mode": "forked_replica_probe",
+            "bg_sched": ("SCHED_IDLE" if prev_sched is not None
+                         else "nice19_or_unshaped"),
+            "probe": "predict_links cached-snapshot limit=10",
+            "solo_p50_ms": round(solo[0], 3),
+            "solo_p99_ms": round(solo[1], 3),
+            "during_p50_ms": round(during[0], 3),
+            "during_p99_ms": round(during[1], 3),
+            "budget_ms": round(budget_ms, 3),
+            "within_budget": within,
+            "sweeps_during": sweeps,
+        }
+        out["background_parity"] = min(decay_parity, lp_parity)
+        out["background_sweep_speedup"] = round(
+            min(decay_speedup, lp_speedup), 2)
+        out["background_convoy_ok"] = 1.0 if within else 0.0
+    except Exception as exc:  # noqa: BLE001 — stage must always emit
+        out["error"] = f"{type(exc).__name__}: {exc}"[:400]
+    finally:
+        if prev_sched is not None:
+            try:
+                os.sched_setscheduler(0, prev_sched[0], os.sched_param(0))
+            except OSError:
+                pass
+    return out
+
+
 def _bench_northstar():
     """BASELINE.json north-star configs the headline doesn't cover:
 
@@ -3756,5 +4068,6 @@ if __name__ == "__main__":
         }
         print(json.dumps(err))
         sys.stdout.flush()
-        print(json.dumps({**_compact_summary(err), "error": err["error"]}))
+        print(_dump_summary(
+            {**_compact_summary(err), "error": err["error"]}))
         sys.exit(0)

@@ -1,0 +1,8 @@
+"""Device-resident background plane (ISSUE 19): decay, link
+prediction, FastRP and inference candidate generation as background-
+lane device jobs over per-etype delta snapshots."""
+
+from nornicdb_tpu.background.device_plane import (  # noqa: F401
+    BackgroundDevicePlane,
+    bg_device_mode,
+)

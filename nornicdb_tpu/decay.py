@@ -78,6 +78,10 @@ class DecayManager:
         self._state: Dict[str, _NodeState] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # ISSUE 19: a BackgroundDevicePlane attaches itself here; when
+        # present, sweep() runs as ONE vmapped device pass (host loop
+        # stays the fallback for every degrade)
+        self.device_plane = None
 
     # -- access tracking ---------------------------------------------------
 
@@ -139,9 +143,17 @@ class DecayManager:
 
         Runs on the BACKGROUND admission lane (ISSUE 15): a whole-graph
         scoring sweep must never convoy interactive traffic through the
-        shared write/index machinery."""
+        shared write/index machinery. With a device plane attached
+        (ISSUE 19) the sweep is one vectorized score-and-promote pass;
+        any guard trip inside the plane returns None and the host loop
+        below serves — verdict parity is the plane's contract."""
         from nornicdb_tpu import admission as _adm
 
+        plane = self.device_plane
+        if plane is not None:
+            res = plane.decay_sweep(now)
+            if res is not None:
+                return res
         with _adm.lane_scope(_adm.LANE_BACKGROUND):
             return self._sweep_background(now)
 

@@ -57,6 +57,10 @@ class InferenceEngine:
         self._cooldown: Dict[Tuple[str, str], float] = {}
         self._lock = threading.Lock()
         self.created_count = 0
+        # ISSUE 19: a BackgroundDevicePlane attaches itself here; when
+        # present, on_store_batch rides its background-lane candidate
+        # generation instead of per-node interactive-path searches
+        self.device_plane = None
 
     # -- cooldown (reference: cooldown.go) --------------------------------
 
@@ -124,6 +128,61 @@ class InferenceEngine:
             if self._create(sug):
                 suggestions.append(sug)
         return suggestions
+
+    def on_store_batch(self, nodes: List[Node]) -> Dict[str, List[Suggestion]]:
+        """Batched similarity inference (ISSUE 19): candidate generation
+        for the WHOLE batch of newly stored nodes rides the background
+        device plane — one background-lane pass through the existing
+        quantized ANN tiers — then each node runs the same
+        threshold/cooldown/QC/create pipeline as :meth:`on_store`.
+        Parity with the per-node path holds by construction (same
+        search service, same filters); without a plane, or when the
+        plane degrades, the per-node path serves."""
+        if self.search is None:
+            return {n.id: [] for n in nodes}
+        plane = self.device_plane
+        per_node: Dict[str, List[List[float]]] = {}
+        items: List[Tuple[str, List[float]]] = []
+        for node in nodes:
+            if node.chunk_embeddings:
+                qvs = list(node.chunk_embeddings)
+            elif node.embedding is not None:
+                qvs = [node.embedding]
+            else:
+                qvs = []
+            per_node[node.id] = qvs
+            for j, qv in enumerate(qvs):
+                items.append((f"{node.id}\x00{j}", qv))
+        cands = None
+        if plane is not None and items:
+            cands = plane.infer_candidates(
+                items, k=self.max_links_per_store * 3)
+        if cands is None:
+            return {n.id: self.on_store(n) for n in nodes}
+        out: Dict[str, List[Suggestion]] = {}
+        for node in nodes:
+            best: Dict[str, float] = {}
+            for j in range(len(per_node[node.id])):
+                for nid, score in cands.get(f"{node.id}\x00{j}", []):
+                    if nid == node.id:
+                        continue
+                    if score > best.get(nid, -1.0):
+                        best[nid] = score
+            candidates: List[Suggestion] = []
+            for nid, score in sorted(best.items(), key=lambda kv: -kv[1]):
+                if len(candidates) >= self.max_links_per_store:
+                    break
+                if score < self.similarity_threshold:
+                    continue
+                if self._on_cooldown(node.id, nid) \
+                        or self._already_linked(node.id, nid):
+                    continue
+                candidates.append(Suggestion(
+                    node.id, nid, SIMILAR_TO, float(score), "similarity"))
+            if self.qc is not None and candidates:
+                candidates = self.qc.review_batch(self.storage, candidates)
+            out[node.id] = [s for s in candidates if self._create(s)]
+        return out
 
     # -- on access: co-access links (reference: OnAccess :778) --------------
 

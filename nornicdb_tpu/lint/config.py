@@ -40,6 +40,11 @@ SNAPSHOT_MODULES = {
     "nornicdb_tpu.search.tiered_store": (
         "TieredStore.search_batch",  # residency_gen re-check after ADC
     ),
+    "nornicdb_tpu.background.device_plane": (
+        "BackgroundDevicePlane.decay_sweep",      # catalog.version
+        "BackgroundDevicePlane.linkpredict_topk",  # etype_versions
+        "BackgroundDevicePlane.fastrp",            # etype_versions
+    ),
 }
 
 # tokens that count as a freshness counter in a post-dispatch re-check

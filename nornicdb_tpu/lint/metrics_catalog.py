@@ -57,6 +57,9 @@ IMPORT_TIME_MODULES = (
     "nornicdb_tpu.replication.transport",   # dual-plane WAL streaming
     "nornicdb_tpu.replication.fleet_proc",  # subprocess replica fleet
     "nornicdb_tpu.obs.tenant",  # per-tenant attribution (ISSUE 18)
+    # ISSUE 19: background device plane — jobs counter + bg_* dispatch
+    # kinds registered at import
+    "nornicdb_tpu.background.device_plane",
 )
 
 _PREFIX = "nornicdb_"

@@ -100,6 +100,10 @@ TIERS: Dict[str, Tuple[str, ...]] = {
                "hybrid_brute_f32", TIER_HOST, TIER_CACHED),
     "graph": ("graph_chain_device", "graph_traverse_rank_device",
               TIER_HOST),
+    # ISSUE 19: background device plane (decay / link prediction /
+    # FastRP) — no statistical floor, so the exact contract (1.0)
+    # applies: every guard trip degrades to host, never a wrong answer
+    "background": ("background_device", TIER_HOST),
 }
 
 ALL_TIERS: Tuple[str, ...] = tuple(sorted(

@@ -221,6 +221,43 @@ def price_upsert(n_points: int, d: int) -> Tuple[float, float]:
     return flops, bytes_
 
 
+def price_decay_sweep(m: int) -> Tuple[float, float]:
+    """(flops, bytes) of one background decay sweep dispatch
+    (background/device_plane.py): ~10 elementwise ops per node over
+    seven f32 input columns and three output columns, priced at the
+    padded bucket ``m``."""
+    flops = 10.0 * m
+    bytes_ = _F32 * 10.0 * m
+    return flops, bytes_
+
+
+def price_linkpredict(b: int, f1: int, f2: int,
+                      kp: int) -> Tuple[float, float]:
+    """(flops, bytes) of one background link-prediction dispatch: per
+    seed, the ``f1*f2`` two-hop candidate expansion, the sort over the
+    expansion (``W*log2(W)`` compares), the segment reduction, and the
+    top-``kp`` selection; bytes are the int32/f32 gather traffic over
+    the expansion."""
+    import math
+
+    w = float(f1 * f2)
+    lg = math.log2(max(w, 2.0))
+    flops = b * (w * lg + 4.0 * w + 2.0 * kp)
+    bytes_ = 4.0 * b * (f1 + 3.0 * w + 2.0 * kp)
+    return flops, bytes_
+
+
+def price_fastrp(n: int, edges: int, dim: int,
+                 iters: int) -> Tuple[float, float]:
+    """(flops, bytes) of one background FastRP dispatch: ``iters``
+    neighbor-mean propagations (one ``dim``-wide segment-sum over both
+    edge directions apiece) plus the per-iteration row normalization
+    over ``n`` rows."""
+    flops = iters * (2.0 * 2.0 * edges * dim + 5.0 * n * dim)
+    bytes_ = _F32 * iters * (2.0 * edges * dim + 3.0 * n * dim)
+    return flops, bytes_
+
+
 def price_bm25(b: int, nnz: int, unique_terms: int,
                rows: int) -> Tuple[float, float]:
     """(flops, bytes) of one device-BM25 scoring dispatch: tf/idf math +

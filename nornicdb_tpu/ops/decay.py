@@ -1,0 +1,96 @@
+"""Vectorized decay scoring: one device pass over the columnar
+access/age/importance state replaces N per-node ``score()`` calls.
+
+Reference semantics: nornicdb_tpu/decay.py (pkg/decay lineage). Per
+node the host computes ``recency = 0.5^(age/half_life)``,
+``frequency = 1 - exp(-accesses/10)``, a weighted sum with the
+importance prior, then a scalar Kalman update
+(nornicdb_tpu/filters.py). All of it is elementwise, so the whole
+sweep is one fused program; the Kalman recurrence is replicated here
+EXACTLY (same branch structure, same constants) so a device sweep and
+a host sweep walk the same state machine — only f32-vs-f64 rounding
+differs, which the caller resolves by re-scoring the verdict-boundary
+band in f64 on the host (background/device_plane.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=8)
+def _decay_fn(w_recency: float, w_frequency: float, w_importance: float,
+              q: float, r: float):
+    """Compiled sweep for one weight/noise configuration (the manager's
+    weights are fixed at construction, so this caches one program)."""
+
+    @jax.jit
+    def run(age_ms: jnp.ndarray,       # [m] f32
+            half_life: jnp.ndarray,    # [m] f32
+            accesses: jnp.ndarray,     # [m] f32
+            importance: jnp.ndarray,   # [m] f32
+            est: jnp.ndarray,          # [m] f32 Kalman estimate
+            err: jnp.ndarray,          # [m] f32 Kalman error
+            init: jnp.ndarray):        # [m] bool Kalman initialized
+        recency = jnp.exp2(-age_ms / half_life)
+        frequency = 1.0 - jnp.exp(-accesses / 10.0)
+        raw = (w_recency * recency + w_frequency * frequency
+               + w_importance * importance)
+        # KalmanFilter.update, elementwise (filters.py:update)
+        err1 = err + q
+        gain = err1 / (err1 + r)
+        est_u = est + gain * (raw - est)
+        err_u = err1 * (1.0 - gain)
+        score = jnp.where(init, est_u, raw)
+        new_est = jnp.where(init, est_u, raw)
+        new_err = jnp.where(init, err_u, err)
+        return score, new_est, new_err
+
+    return run
+
+
+def decay_scores(
+    age_ms: np.ndarray, half_life: np.ndarray, accesses: np.ndarray,
+    importance: np.ndarray, est: np.ndarray, err: np.ndarray,
+    init: np.ndarray, weights: Tuple[float, float, float],
+    process_noise: float, measurement_noise: float,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One dispatch over the whole sweep's columns; returns (score,
+    new_kalman_estimate, new_kalman_error) as host f32 arrays."""
+    fn = _decay_fn(float(weights[0]), float(weights[1]),
+                   float(weights[2]), float(process_noise),
+                   float(measurement_noise))
+    s, e, v = fn(jnp.asarray(age_ms, jnp.float32),
+                 jnp.asarray(half_life, jnp.float32),
+                 jnp.asarray(accesses, jnp.float32),
+                 jnp.asarray(importance, jnp.float32),
+                 jnp.asarray(est, jnp.float32),
+                 jnp.asarray(err, jnp.float32),
+                 jnp.asarray(init))
+    return np.asarray(s), np.asarray(e), np.asarray(v)
+
+
+def decay_score_host_f64(age_ms: float, half_life: float,
+                         accesses: float, importance: float,
+                         est: float, err: float, init: bool,
+                         weights: Tuple[float, float, float],
+                         q: float, r: float) -> float:
+    """f64 reference for ONE node from the same pre-sweep state — the
+    device plane's boundary-band re-check. Pure: does not advance any
+    live KalmanFilter (decay.score() would mutate it a second time)."""
+    import math
+
+    recency = math.pow(0.5, age_ms / half_life)
+    frequency = 1.0 - math.exp(-accesses / 10.0)
+    raw = (weights[0] * recency + weights[1] * frequency
+           + weights[2] * importance)
+    if not init:
+        return raw
+    err1 = err + q
+    gain = err1 / (err1 + r)
+    return est + gain * (raw - est)

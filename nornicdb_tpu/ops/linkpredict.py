@@ -1,0 +1,140 @@
+"""Device link prediction: masked sparse 2-hop expansion + weighted
+segment reduction + top-k, one compiled program per pow2 bucket.
+
+Reference semantics: nornicdb_tpu/linkpredict.py (pkg/cypher/
+linkprediction.go lineage). A seed's candidates are its 2-hop
+neighborhood; the score of pair ``(u, v)`` is ``sum_z w(z)`` over
+common neighbors ``z`` — ``w`` encodes the scorer (common-neighbors:
+1, Adamic–Adar: 1/ln(deg z), resource-allocation: 1/deg z). The host
+loop intersects Python sets per candidate pair; here the whole batch
+runs as one dispatch over a CSR snapshot:
+
+1. gather the sorted 1-hop row of each seed (width ``f1``, sentinel
+   ``n`` pads);
+2. expand to the full 2-hop multiset (width ``f1*f2`` — COMPLETE
+   coverage; the dispatch is refused, not truncated, when the bucket
+   would overflow), carrying ``w(mid)`` per element;
+3. sort by candidate id, segment the runs, and segment-sum the
+   weights — one score per distinct candidate;
+4. mask sentinels, the seed itself, and existing neighbors (a
+   searchsorted membership probe against the sorted 1-hop row);
+5. ``lax.top_k`` the masked scores.
+
+Exactness: common-neighbors scores are small-integer sums in f32
+(exact below 2^24). Weighted scorers accumulate f32 rounding, so the
+caller re-scores the kept candidates exactly on the host and degrades
+when an excluded candidate could reach the cut (see
+background/device_plane.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=64)
+def _lp_topk_fn(f1: int, f2: int, kp: int):
+    """Compiled batched link-prediction top-k for the pow2 bucket
+    ``(f1, f2, kp)``: per-seed 1-hop width f1, per-mid fanout f2,
+    kept candidates kp."""
+
+    @jax.jit
+    def run(seeds: jnp.ndarray,    # [b] int32, -1 pads
+            indptr: jnp.ndarray,   # [n+1] int32 CSR row starts
+            nbr: jnp.ndarray,      # [E] int32, sorted within each row
+            w: jnp.ndarray,        # [n] f32 per-mid weight
+            n: jnp.ndarray):       # () int32 sentinel / node count
+        W = f1 * f2
+
+        def one(s):
+            valid_seed = s >= 0
+            sc = jnp.where(valid_seed, s, 0)
+            start1 = indptr[sc]
+            deg1 = indptr[sc + 1] - start1
+            j = jnp.arange(f1, dtype=jnp.int32)
+            take1 = valid_seed & (j < deg1)
+            # sorted row + sentinel pads stays sorted: row values < n
+            h1 = jnp.where(take1, nbr[jnp.clip(start1 + j, 0,
+                                               nbr.shape[0] - 1)], n)
+            # 2-hop expansion: mid = h1[j]; every neighbor of mid is a
+            # candidate scored by w[mid]
+            midc = jnp.where(take1, h1, 0)
+            start2 = indptr[midc]
+            deg2 = indptr[midc + 1] - start2
+            ll = jnp.arange(f2, dtype=jnp.int32)
+            take2 = take1[:, None] & (ll[None, :] < deg2[:, None])
+            flat_idx = jnp.clip(start2[:, None] + ll[None, :], 0,
+                                nbr.shape[0] - 1)
+            cand = jnp.where(take2, nbr[flat_idx], n).reshape(W)
+            wt = jnp.where(take2, w[midc][:, None],
+                           jnp.float32(0.0)).reshape(W)
+            # group equal candidates: sort by id, flag run heads,
+            # segment-sum the weights per run
+            cand_s, wt_s = jax.lax.sort((cand, wt), num_keys=1)
+            first = jnp.concatenate([
+                jnp.ones((1,), bool), cand_s[1:] != cand_s[:-1]])
+            run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+            scores = jax.ops.segment_sum(
+                wt_s, run_id, num_segments=W, indices_are_sorted=True)
+            cand_of = jax.ops.segment_max(
+                cand_s, run_id, num_segments=W, indices_are_sorted=True)
+            n_runs = run_id[-1] + 1
+            slot = jnp.arange(W, dtype=jnp.int32)
+            live = slot < n_runs
+            # mask sentinels, the seed, and existing 1-hop neighbors
+            pos = jnp.searchsorted(h1, cand_of).astype(jnp.int32)
+            in_hop1 = h1[jnp.clip(pos, 0, f1 - 1)] == cand_of
+            keep = (live & (cand_of < n) & (cand_of != s)
+                    & jnp.logical_not(in_hop1))
+            masked = jnp.where(keep, scores, -jnp.inf)
+            vals, idx = jax.lax.top_k(masked, kp)
+            sel = cand_of[idx]
+            distinct = jnp.sum(keep.astype(jnp.int32))
+            return vals, sel, distinct
+
+        return jax.vmap(one)(seeds)
+
+    return run
+
+
+def degree_weights(method: str, indptr: np.ndarray) -> np.ndarray:
+    """Per-mid weight column for the scorer ``method``, computed on
+    the host in f64 then narrowed to f32 (one column per snapshot, not
+    per call). A common neighbor always has degree >= 2, so the
+    Adamic–Adar log is never <= 0 where it matters; degree<=1 rows get
+    weight 0 (they contribute no pairs anyway)."""
+    deg = (indptr[1:] - indptr[:-1]).astype(np.float64)
+    if method == "common_neighbors":
+        w = np.ones_like(deg)
+    elif method == "adamic_adar":
+        with np.errstate(divide="ignore"):
+            w = np.where(deg > 1.0, 1.0 / np.log(np.maximum(deg, 2.0)),
+                         0.0)
+    elif method == "resource_allocation":
+        with np.errstate(divide="ignore"):
+            w = np.where(deg > 0.0, 1.0 / np.maximum(deg, 1.0), 0.0)
+    else:
+        raise ValueError(f"unsupported device scorer: {method}")
+    return w.astype(np.float32)
+
+
+def linkpredict_topk(
+    seeds: np.ndarray,      # [b] int32 (-1 pads allowed)
+    indptr,                 # device or host [n+1] int32
+    nbr,                    # device or host [E] int32 (row-sorted)
+    w,                      # device or host [n] f32
+    n: int,
+    f1: int, f2: int, kp: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One dispatch: per-seed top-``kp`` (scores, candidates) plus the
+    exact distinct-candidate count (the caller's coverage guard)."""
+    fn = _lp_topk_fn(f1, f2, kp)
+    vals, sel, distinct = fn(
+        jnp.asarray(seeds, jnp.int32), indptr, nbr, w,
+        jnp.int32(n))
+    return np.asarray(vals), np.asarray(sel), np.asarray(distinct)

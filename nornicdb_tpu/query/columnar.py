@@ -257,6 +257,16 @@ class ColumnarCatalog:
         self._storage = storage
         self._lock = threading.Lock()
         self._version = 0
+        # Per-etype delta generations (ISSUE 19). `_version` stales on
+        # EVERY write; background device jobs that only consume one
+        # edge-type's slice key their snapshots on
+        # ``(struct_gen, etype_gen[etype])`` instead, so a write to
+        # etype A leaves etype B's device snapshot live. `_struct_gen`
+        # moves on anything that changes the node axis or is not a pure
+        # edge append (invalidate, node creates); `_etype_gen[et]`
+        # moves only on edge appends of that type.
+        self._struct_gen = 0
+        self._etype_gen: Dict[str, int] = {}
         self._reset_locked()
 
     def _reset_locked(self) -> None:
@@ -287,6 +297,21 @@ class ColumnarCatalog:
     def version(self) -> int:
         return self._version
 
+    def etype_version(self, etype: str) -> Tuple[int, int]:
+        """Delta-snapshot key for one edge type: ``(struct_gen,
+        etype_gen)``. Unchanged by writes to OTHER edge types, so a
+        consumer keyed on this tuple survives unrelated edge appends
+        (the whole-catalog :attr:`version` moves on every write)."""
+        with self._lock:
+            return (self._struct_gen, self._etype_gen.get(etype, 0))
+
+    def etype_versions(self, etypes) -> Tuple[Tuple[int, int], ...]:
+        """One consistent read of several etype keys (single lock
+        acquisition — no torn tuple across a racing write)."""
+        with self._lock:
+            return tuple((self._struct_gen, self._etype_gen.get(et, 0))
+                         for et in etypes)
+
     @property
     def storage(self) -> Engine:
         return self._storage
@@ -316,6 +341,10 @@ class ColumnarCatalog:
     def invalidate(self) -> None:
         with self._lock:
             self._version += 1
+            # updates/deletes are not attributable to one etype: every
+            # per-etype delta key moves with the structural generation
+            self._struct_gen += 1
+            self._etype_gen.clear()
             self._reset_locked()
 
     # -- create deltas ----------------------------------------------------
@@ -330,6 +359,10 @@ class ColumnarCatalog:
     def apply_node_created(self, node: Node) -> None:
         with self._lock:
             self._version += 1
+            # the node axis grew: every etype's CSR indptr length moves,
+            # so the structural generation (shared by all etype keys)
+            # bumps rather than each per-etype generation
+            self._struct_gen += 1
             # mid-axis/incidence candidate sets are label-dependent and
             # cheap to rebuild; the maintained views below extend instead
             self._mid_axis.clear()
@@ -401,6 +434,8 @@ class ColumnarCatalog:
         with self._lock:
             self._version += 1
             et = edge.type
+            # pure edge append: only THIS etype's delta generation moves
+            self._etype_gen[et] = self._etype_gen.get(et, 0) + 1
             # per-etype drop of the (non-maintained) incidence caches
             for key in [k for k in self._mid_axis if k[0] == et]:
                 self._mid_axis.pop(key)

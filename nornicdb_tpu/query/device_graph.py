@@ -325,18 +325,35 @@ class DeviceGraphPlane:
     # -- snapshot bookkeeping ---------------------------------------------
 
     def _get_snap(self, key) -> Optional[Dict[str, Any]]:
-        v = self.catalog.version
+        """Fetch a live snapshot. Snapshots carrying per-etype delta
+        keys (``etv``/``etypes``, ISSUE 19) stay live across writes to
+        UNRELATED edge types; legacy whole-catalog snapshots compare
+        the global version as before."""
         with self._lock:
             snap = self._snaps.get(key)
-        if snap is not None and snap.get("version") == v:
+        if snap is None:
+            return None
+        etypes = snap.get("etypes")
+        if etypes is not None:
+            if snap.get("etv") == self.catalog.etype_versions(etypes):
+                return snap
+            return None
+        if snap.get("version") == self.catalog.version:
             return snap
         return None
 
     def _put_snap(self, key, snap: Dict[str, Any]) -> bool:
         """Install ``snap`` iff the catalog hasn't moved past its
         version (a build that raced a write must not resurrect a stale
-        snapshot — same rule as the catalog's own caches)."""
-        if self.catalog.version != snap.get("version"):
+        snapshot — same rule as the catalog's own caches). Per-etype
+        snapshots compare their delta key, so an unrelated-etype write
+        landing mid-build does not waste the build."""
+        etypes = snap.get("etypes")
+        if etypes is not None:
+            fresh = self.catalog.etype_versions(etypes) == snap.get("etv")
+        else:
+            fresh = self.catalog.version == snap.get("version")
+        if not fresh:
             _event("snapshot_raced")
             return False
         with self._lock:
@@ -384,6 +401,12 @@ class DeviceGraphPlane:
          term_label) = spec
         cat = self.catalog
         v0 = cat.version
+        # per-etype delta key (ISSUE 19): the program reads only these
+        # two etypes' CSRs plus node-axis structures, and every
+        # node-axis change moves the structural generation inside the
+        # tuple — so writes to OTHER etypes leave this snapshot live
+        etypes = (etype1, etype2)
+        etv0 = cat.etype_versions(etypes)
         jax = _jx()
         jnp = jax.numpy
         try:
@@ -396,14 +419,16 @@ class DeviceGraphPlane:
             if sa is None or len(order1) != len(far_raw):
                 # non-numeric order prop / torn build: record the
                 # verdict so repeat reads don't re-probe until a write
-                self._put_snap(key, {"version": v0, "ok": False})
+                self._put_snap(key, {"version": v0, "etypes": etypes,
+                                     "etv": etv0, "ok": False})
                 return None
             if (len(sa.nbr) > self.MAX_ENTRIES
                     or len(far_raw) > self.MAX_ENTRIES
                     or len(sa.nbr) == 0 or len(far_raw) == 0
                     or np.isnan(sa.keys).any()):
                 # empty structures answer trivially on the host path
-                self._put_snap(key, {"version": v0, "ok": False})
+                self._put_snap(key, {"version": v0, "etypes": etypes,
+                                     "etv": etv0, "ok": False})
                 return None
             far1 = far_raw[order1]
             # dense DESC rank with ties SHARING a rank: the device merge
@@ -419,6 +444,8 @@ class DeviceGraphPlane:
                 return None  # raced a node create; next read rebuilds
             snap = {
                 "version": v0,
+                "etypes": etypes,
+                "etv": etv0,
                 "ok": True,
                 "n": n,
                 "s": len(sa.nbr),
@@ -582,11 +609,14 @@ class DeviceGraphPlane:
                 KIND_CHAIN, _cost.cost_name(self), len(items), flops, byts)
         self.dispatches += 1
         # freshness: a write that landed during the dispatch window
-        # invalidated the snapshot under us — the host path must serve
-        if self.catalog.version != snap["version"]:
+        # invalidated the snapshot under us — the host path must serve.
+        # Per-etype delta key: only writes touching THIS program's
+        # etypes (or the node axis) stale it; unrelated edge appends
+        # during the dispatch window are fine (ISSUE 19).
+        if self.catalog.etype_versions(snap["etypes"]) != snap["etv"]:
             _event("degrade_stale")
             _ledger(TIER_CHAIN, "stale_snapshot",
-                    {"snapshot_version": snap["version"],
+                    {"snapshot_etv": snap["etv"],
                      "catalog_version": self.catalog.version})
             return none_all
         out = []
@@ -763,6 +793,10 @@ class DeviceGraphPlane:
             snap = None  # index moved: rebuild the row->slot join
         cat = self.catalog
         v0 = cat.version
+        # per-etype delta key (ISSUE 19): the fused program touches
+        # only the hop etypes' CSRs and the node axis
+        etypes = tuple(et for et, _d in hops)
+        etv0 = cat.etype_versions(etypes)
         jax = _jx()
         jnp = jax.numpy
         try:
@@ -784,6 +818,8 @@ class DeviceGraphPlane:
             return None
         snap = {
             "version": v0,
+            "etypes": etypes,
+            "etv": etv0,
             "mutations": mutations,
             "n": n,
             "hops": [
@@ -897,11 +933,13 @@ class DeviceGraphPlane:
                 KIND_RANK, _cost.cost_name(self), len(anchors), flops,
                 byts)
         self.dispatches += 1
-        if self.catalog.version != snap["version"] \
+        # per-etype recheck (ISSUE 19): only hop-etype writes or
+        # node-axis changes during the dispatch window stale this
+        if self.catalog.etype_versions(snap["etypes"]) != snap["etv"] \
                 or index.view_meta() != (snap["mutations"], _comp):
             _event("degrade_stale")
             _ledger(TIER_RANK, "stale_snapshot",
-                    {"snapshot_version": snap["version"],
+                    {"snapshot_etv": snap["etv"],
                      "catalog_version": self.catalog.version})
             return None
         out: List[List[Tuple[int, float]]] = []

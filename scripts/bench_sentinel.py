@@ -173,6 +173,21 @@ CHECKS: Dict[str, Tuple] = {
     # misattributing the overload.
     "tenant_attribution": ("quality", 1.0, 0.0),
     "tenant_flood_cost_share": ("quality", 0.5, 0.5),
+    # background plane (round r19+, ISSUE 19): the device decay sweep
+    # and link-prediction batch vs the per-node host loops they
+    # replace. background_sweep_speedup is qps-class vs the trajectory
+    # baseline (the ISSUE's >= 3x acceptance is the artifact's
+    # headline; the sentinel floor catches regression, not the first
+    # landing). Parity gates ABSOLUTELY at 1.0 — the plane's contract
+    # is that a degrade means the host answers, never that the device
+    # answers differently. The convoy flag is the no-convoy guard's
+    # verdict (interactive p99 from the forked replica probe within
+    # 2x solo p99 + 1ms while sweeps run) and gates ABSOLUTELY: a
+    # background plane that convoys the interactive lane is a
+    # regression whatever the speedup says.
+    "background_sweep_speedup": ("qps", 0.5),
+    "background_parity": ("quality", 1.0, 0.0),
+    "background_convoy_ok": ("quality", 1.0, 0.0),
 }
 
 
@@ -353,6 +368,21 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
             tn.get("flood_cost_share"))
         out["tenant_noisy_events"] = _num(
             tn.get("noisy_neighbor_events"))
+    # background plane (round r19+): the summary packs
+    # [sweep_speedup, parity, convoy_ok]; the full artifact carries
+    # the named keys under "background"
+    bg = doc.get("background") or {}
+    if isinstance(bg, list):
+        pad = bg + [None] * 3
+        out["background_sweep_speedup"] = _num(pad[0])
+        out["background_parity"] = _num(pad[1])
+        out["background_convoy_ok"] = _num(pad[2])
+    else:
+        out["background_sweep_speedup"] = _num(
+            bg.get("background_sweep_speedup"))
+        out["background_parity"] = _num(bg.get("background_parity"))
+        out["background_convoy_ok"] = _num(
+            bg.get("background_convoy_ok"))
     surfaces = doc.get("surfaces") or {}
     for name in ("bolt", "neo4j_http", "graphql", "rest_search",
                  "qdrant_grpc"):

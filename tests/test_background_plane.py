@@ -1,0 +1,321 @@
+"""Device-resident background plane (ISSUE 19).
+
+The plane's contract has three legs, and each gets pinned here:
+
+- **Parity**: decay verdicts, link-prediction rankings, and FastRP
+  directions produced by the device programs are identical to the
+  per-node host loops they replace (exact for decay/linkpredict,
+  cosine-bounded for FastRP's f32 matmul chain).
+- **Degrade, never diverge**: every guard trip — a write during the
+  dispatch window, a padded expansion past the refusal ceiling, the
+  env kill-switch — lands on the host path with a structured ledger
+  record. A degraded answer is the host answer, not a stale one.
+- **Per-etype delta snapshots**: a write to etype A must not
+  invalidate etype B's cached device slice — that is the whole point
+  of keying snapshots on ``etype_versions`` instead of the global
+  catalog version.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from nornicdb_tpu import linkpredict as lp
+from nornicdb_tpu.background import device_plane as dp
+from nornicdb_tpu.background.device_plane import BackgroundDevicePlane
+from nornicdb_tpu.decay import DecayManager
+from nornicdb_tpu.obs import audit as audit
+from nornicdb_tpu.query.columnar import ColumnarCatalog
+from nornicdb_tpu.storage import Edge, MemoryEngine, Node, now_ms
+
+N = 300
+E = 1_200
+DAY = 86_400_000
+NOW = now_ms()
+
+
+def _build_engine(seed: int = 7) -> MemoryEngine:
+    rng = random.Random(seed)
+    eng = MemoryEngine()
+    for i in range(N):
+        eng.create_node(Node(
+            id=f"n{i}", labels=["T"],
+            properties={"importance": rng.random()},
+            created_at=NOW - rng.randrange(0, 80 * DAY)))
+    for j in range(E):
+        eng.create_edge(Edge(
+            id=f"e{j}", type=("KNOWS", "LIKES", "FOLLOWS")[j % 3],
+            start_node=f"n{rng.randrange(N)}",
+            end_node=f"n{rng.randrange(N)}"))
+    return eng
+
+
+def _mk_decay(eng: MemoryEngine) -> DecayManager:
+    dm = DecayManager(eng, archive_threshold=0.45)
+    rng = random.Random(3)
+    for i in range(0, N, 3):
+        dm.record_access(f"n{i}", at_ms=NOW - rng.randrange(0, 40 * DAY))
+    return dm
+
+
+@pytest.fixture()
+def plane_env():
+    eng = _build_engine()
+    cat = ColumnarCatalog(eng)
+    plane = BackgroundDevicePlane(eng, cat)
+    return eng, cat, plane
+
+
+class TestLinkpredictParity:
+    @pytest.mark.parametrize(
+        "method",
+        ["common_neighbors", "adamic_adar", "resource_allocation"])
+    def test_topk_matches_host_exactly(self, plane_env, method):
+        eng, _cat, plane = plane_env
+        seeds = [f"n{i}" for i in range(48)] + ["missing-node"]
+        got = plane.linkpredict_topk(seeds, method=method, limit=10)
+        assert got is not None
+        for s in seeds:
+            want = lp.predict_links(eng, s, method=method, limit=10)
+            assert got[s] == want, (method, s)
+
+    def test_unknown_seed_yields_empty(self, plane_env):
+        _eng, _cat, plane = plane_env
+        got = plane.linkpredict_topk(["nope"], limit=5)
+        assert got == {"nope": []}
+
+    def test_overflow_refusal_degrades_to_host(self, plane_env,
+                                               monkeypatch):
+        """A seed whose padded expansion exceeds the refusal ceiling
+        must be answered by the host scorer (same ranking), with an
+        ``overflow`` ledger record — never a truncated device
+        answer."""
+        eng, _cat, plane = plane_env
+        monkeypatch.setattr(dp, "_MAX_EXPANSION", 64)
+        seeds = [f"n{i}" for i in range(16)]
+        with audit.collect_degrades() as recs:
+            got = plane.linkpredict_topk(seeds, limit=10)
+        assert got is not None
+        for s in seeds:
+            assert got[s] == lp.predict_links(eng, s, limit=10), s
+        reasons = {r["reason"] for r in recs}
+        assert "overflow" in reasons
+
+    def test_mode_off_returns_none(self, plane_env, monkeypatch):
+        _eng, _cat, plane = plane_env
+        monkeypatch.setenv("NORNICDB_BG_DEVICE", "off")
+        assert plane.linkpredict_topk(["n0"], limit=5) is None
+
+
+class TestDecayParity:
+    def test_dual_engine_verdict_parity(self):
+        """Two bit-identical graphs, one swept by the device plane and
+        one by the host loop: (scored, archived) tuples, the archived
+        node sets, and the written-back Kalman states must agree —
+        across a cold sweep AND a warm second sweep a day later."""
+        eng_dev = _build_engine()
+        eng_host = _build_engine()
+        dm_dev = _mk_decay(eng_dev)
+        dm_host = _mk_decay(eng_host)
+        cat = ColumnarCatalog(eng_dev)
+        plane = BackgroundDevicePlane(eng_dev, cat, decay=dm_dev)
+
+        assert dm_dev.sweep(NOW) == dm_host.sweep(NOW)
+        assert plane.dispatches == 1
+
+        def archived(eng):
+            return sorted(n.id for n in eng.all_nodes()
+                          if n.properties.get("_archived"))
+
+        assert archived(eng_dev) == archived(eng_host)
+        for nid in list(dm_host._state)[:50]:
+            kh = dm_host._state[nid].kalman
+            kd = dm_dev._state[nid].kalman
+            assert kh.initialized == kd.initialized
+            assert abs(kh.estimate - kd.estimate) < 1e-5, nid
+
+        assert dm_dev.sweep(NOW + DAY) == dm_host.sweep(NOW + DAY)
+        assert archived(eng_dev) == archived(eng_host)
+        assert plane.dispatches == 2
+
+    def test_mid_sweep_write_degrades_to_host(self, monkeypatch):
+        """A catalog write landing inside the dispatch window trips the
+        post-dispatch version recheck: the plane refuses its own
+        result (``stale_snapshot`` ledger record) and the host loop
+        serves the sweep — verdicts still land."""
+        eng = _build_engine()
+        dm = _mk_decay(eng)
+        cat = ColumnarCatalog(eng)
+        plane = BackgroundDevicePlane(eng, cat, decay=dm)
+        from nornicdb_tpu.ops import decay as od
+
+        real = od.decay_scores
+
+        def racing(*args, **kwargs):
+            out = real(*args, **kwargs)
+            node = Node(id="racer", labels=["T"], properties={})
+            eng.create_node(node)
+            cat.apply_node_created(node)
+            return out
+
+        monkeypatch.setattr(od, "decay_scores", racing)
+        with audit.collect_degrades() as recs:
+            res = dm.sweep(NOW)
+        assert res[0] >= N  # host loop served the full graph
+        reasons = {r["reason"] for r in recs}
+        assert "stale_snapshot" in reasons
+        stale = [r for r in recs if r["reason"] == "stale_snapshot"][0]
+        assert stale["from_tier"] == dp.TIER_BACKGROUND
+        assert stale["to_tier"] == "host"
+        # the host sweep saw the racing write (N+1 nodes scored — it
+        # ran AFTER the write, which is the whole point of degrading)
+        # and its verdicts match a clean host-only engine's
+        eng2 = _build_engine()
+        dm2 = _mk_decay(eng2)
+        scored2, archived2 = dm2.sweep(NOW)
+        assert res == (scored2 + 1, archived2)
+
+    def test_archive_writes_fresh_copies(self):
+        """Archival must go through fresh ``storage.get_node`` copies:
+        a property written AFTER the catalog snapshot was built
+        survives the sweep's archive write-back."""
+        eng = _build_engine()
+        dm = _mk_decay(eng)
+        cat = ColumnarCatalog(eng)
+        BackgroundDevicePlane(eng, cat, decay=dm)
+        # find a node the sweep will archive, mutate it post-build
+        probe_eng = _build_engine()
+        probe_dm = _mk_decay(probe_eng)
+        probe_dm.sweep(NOW)
+        victim = next(n.id for n in probe_eng.all_nodes()
+                      if n.properties.get("_archived"))
+        node = eng.get_node(victim)
+        node.properties["post_snapshot_field"] = "survives"
+        eng.update_node(node)
+        dm.sweep(NOW)
+        after = eng.get_node(victim)
+        assert after.properties.get("_archived") is True
+        assert after.properties.get("post_snapshot_field") == "survives"
+
+    def test_mode_off_uses_host_loop(self, monkeypatch):
+        eng = _build_engine()
+        dm = _mk_decay(eng)
+        cat = ColumnarCatalog(eng)
+        plane = BackgroundDevicePlane(eng, cat, decay=dm)
+        monkeypatch.setenv("NORNICDB_BG_DEVICE", "off")
+        res = dm.sweep(NOW)
+        assert res[0] == N
+        assert plane.dispatches == 0
+
+
+class TestFastRP:
+    def test_embeddings_match_host_directions(self, plane_env):
+        from nornicdb_tpu.ops.fastrp import fastrp_embeddings
+
+        _eng, _cat, plane = plane_env
+        ids, emb = plane.fastrp(dim=32)
+        assert emb.shape == (N, 32)
+        snap = plane._union_snapshot()
+        src = np.repeat(np.arange(snap["n"], dtype=np.int32),
+                        snap["indptr"][1:] - snap["indptr"][:-1])
+        dst = snap["nbr"]
+        half, loops = src < dst, src == dst
+        emb_host = fastrp_embeddings(
+            snap["n"],
+            np.concatenate([src[half], src[loops]]),
+            np.concatenate([dst[half], dst[loops]]), dim=32)
+        live = (np.linalg.norm(emb, axis=1) > 1e-9) & (
+            np.linalg.norm(emb_host, axis=1) > 1e-9)
+        cos = np.sum(emb[live] * emb_host[live], axis=1)
+        assert cos.size > 0 and cos.min() > 0.999
+
+
+class TestPerEtypeDeltas:
+    def test_etype_a_write_leaves_etype_b_snapshot_live(self,
+                                                        plane_env):
+        """The acceptance clause: an etype-A edge write bumps only A's
+        delta generation — B's cached device slice is reused by object
+        identity, and link prediction over the union stays exact."""
+        eng, cat, plane = plane_env
+        plane.linkpredict_topk(["n0"], limit=5)  # populate caches
+        sl_likes = plane._etype_slice("LIKES")
+        v_likes = cat.etype_version("LIKES")
+        e = Edge(id="late-edge", type="KNOWS",
+                 start_node="n0", end_node="n5")
+        eng.create_edge(e)
+        cat.apply_edge_created(e)
+        assert cat.etype_version("LIKES") == v_likes
+        assert plane._etype_slice("LIKES") is sl_likes  # cache hit
+        # KNOWS' slice was invalidated and rebuilt with the new edge
+        n_knows = sum(1 for ed in eng.all_edges() if ed.type == "KNOWS")
+        assert len(plane._etype_slice("KNOWS")["src"]) == n_knows
+        got = plane.linkpredict_topk(["n0", "n5"], limit=5)
+        for s in ("n0", "n5"):
+            assert got[s] == lp.predict_links(eng, s, limit=5), s
+
+    def test_adjacency_snapshot_cached_per_version(self, plane_env):
+        eng, cat, _plane = plane_env
+        s1 = lp.adjacency_snapshot(eng, cat)
+        assert lp.adjacency_snapshot(eng, cat) is s1
+        e = Edge(id="bump", type="LIKES", start_node="n1",
+                 end_node="n7")
+        eng.create_edge(e)
+        cat.apply_edge_created(e)
+        assert lp.adjacency_snapshot(eng, cat) is not s1
+
+
+class TestCostAccounting:
+    def test_background_jobs_move_cost_counters(self, plane_env):
+        from nornicdb_tpu.obs.metrics import REGISTRY
+
+        def kinds(name):
+            fam = REGISTRY.get(name)
+            out = {}
+            for key, child in (fam.children() if fam else {}).items():
+                out[key[0]] = out.get(key[0], 0.0) + child.value
+            return out
+
+        eng, cat, plane = plane_env
+        dm = _mk_decay(eng)
+        plane.decay = dm
+        dm.device_plane = plane
+        before = kinds("nornicdb_query_cost_flops_total")
+        qbefore = kinds("nornicdb_query_cost_queries_total")
+        dm.sweep(NOW)
+        plane.linkpredict_topk([f"n{i}" for i in range(16)], limit=10)
+        plane.fastrp(dim=32)
+        after = kinds("nornicdb_query_cost_flops_total")
+        qafter = kinds("nornicdb_query_cost_queries_total")
+        for kind in (dp.KIND_DECAY, dp.KIND_LINKPREDICT, dp.KIND_FASTRP):
+            assert after.get(kind, 0) > before.get(kind, 0), kind
+            assert qafter.get(kind, 0) > qbefore.get(kind, 0), kind
+
+
+class TestInferenceBatch:
+    def test_on_store_batch_matches_per_node_path(self):
+        from nornicdb_tpu.inference import InferenceEngine
+        from nornicdb_tpu.search.service import SearchService
+
+        eng = MemoryEngine()
+        svc = SearchService(eng)
+        for i in range(40):
+            v = np.random.default_rng(i).normal(size=16)
+            v = (v / np.linalg.norm(v)).tolist()
+            node = Node(id=f"m{i}", labels=["M"], properties={},
+                        embedding=v)
+            eng.create_node(node)
+            svc.index_node(node)
+        cat = ColumnarCatalog(eng)
+        inf = InferenceEngine(eng, search_service=svc,
+                              similarity_threshold=0.1)
+        plane = BackgroundDevicePlane(eng, cat, inference=inf)
+        assert inf.device_plane is plane
+        fresh = [eng.get_node(f"m{i}") for i in range(6)]
+        got = inf.on_store_batch(fresh)
+        assert set(got) == {f"m{i}" for i in range(6)}
+        for nid, suggestions in got.items():
+            for s in suggestions:
+                assert s.from_id == nid or s.to_id == nid

@@ -172,6 +172,25 @@ def _fake_result():
                         "posture_level": 1},
                     "requests_by_tenant": {"bulk_flood": 84.0},
                     "admin_tenants": {"known": 10, "top": []}},
+        "background": {"n": 2000, "edges": 6000, "seeds": 64,
+                       "decay": {"host_s": 0.04, "device_s": 0.008,
+                                 "speedup": 5.1, "parity": 1.0,
+                                 "device_dispatches": 2},
+                       "linkpredict": {"device_s": 0.007,
+                                       "host_uncached_est_s": 1.0,
+                                       "speedup_vs_replaced_loop": 147.0,
+                                       "device_qps": 9300.0,
+                                       "parity": 1.0},
+                       "fastrp": {"dim": 32, "cos_min": 0.9997},
+                       "cost": {"priced": True},
+                       "convoy": {"solo_p99_ms": 0.2,
+                                  "during_p99_ms": 0.17,
+                                  "budget_ms": 1.4,
+                                  "within_budget": True,
+                                  "sweeps_during": 5},
+                       "background_parity": 1.0,
+                       "background_sweep_speedup": 5.1,
+                       "background_convoy_ok": 1.0},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -190,7 +209,9 @@ def _fake_result():
 
 class TestCompactSummary:
     def test_headline_set_complete_and_small(self):
-        line = json.dumps(bench._compact_summary(_fake_result()))
+        # measure the line exactly as bench emits it (compact
+        # separators — _dump_summary)
+        line = bench._dump_summary(bench._compact_summary(_fake_result()))
         # the driver keeps the LAST 2000 chars; the summary is the last
         # line, so < 1900 leaves margin for real-run value widths (the
         # r15 overload pack rides as a 6-element array for exactly
@@ -259,6 +280,10 @@ class TestCompactSummary:
         # the sentinel gates attribution ABSOLUTELY at 1.0 and the
         # flooder's cost share at the 0.5 floor
         assert s["tenants"] == [1.0, 0.61, 1, 2.1]
+        # background plane (ISSUE 19), packed [sweep_speedup, parity,
+        # convoy_ok]: the sentinel gates the speedup at the 0.5 qps
+        # floor and parity/convoy ABSOLUTELY at 1.0
+        assert s["background"] == [5.1, 1.0, 1.0]
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -334,7 +359,7 @@ class TestBenchDryRunArtifactSchema:
     REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "cypher",
                     "knn", "northstar", "ann", "hybrid", "quant",
                     "tiered", "surfaces", "telemetry", "load", "fleet",
-                    "tenants", "tpu_proof")
+                    "tenants", "background", "tpu_proof")
 
     def test_dry_run_artifact_schema(self, dry_run_lines):
         lines = dry_run_lines
@@ -728,6 +753,42 @@ class TestBenchDryRunArtifactSchema:
         assert summary["tenants"][1] == tn["flood_cost_share"]
         assert summary["tenants"][2] >= 1
         assert summary["tenants"][3] == tn["flood"]["offered_vs_knee"]
+
+    def test_background_stage_schema(self, dry_run_lines):
+        """Background plane stage (ISSUE 19): device decay sweep and
+        link-prediction batch vs the replaced per-node host loops,
+        verdict parity at the ABSOLUTE 1.0 contract, per-job pricing
+        evidence in the cost counters, and the no-convoy guard (the
+        forked replica probe's p99 inside 2x solo + 1ms while sweeps
+        run) — in every dry run."""
+        full = json.loads(dry_run_lines[0])
+        summary = json.loads(dry_run_lines[-1])
+        bg = full["background"]
+        assert "error" not in bg, bg
+        assert bg["n"] == 2000
+        assert bg["decay"]["parity"] == 1.0  # absolute contract
+        assert bg["decay"]["device_dispatches"] >= 2
+        assert bg["decay"]["host_s"] > 0 and bg["decay"]["device_s"] > 0
+        lpb = bg["linkpredict"]
+        assert lpb["parity"] == 1.0  # absolute contract
+        assert lpb["speedup_vs_replaced_loop"] > 1.0
+        assert lpb["device_qps"] > 0
+        assert bg["fastrp"]["cos_min"] > 0.999
+        assert bg["cost"]["priced"] is True
+        for kind in ("bg_decay_sweep", "bg_linkpredict", "bg_fastrp"):
+            assert bg["cost"]["flops_by_kind"][kind] > 0, kind
+        cv = bg["convoy"]
+        assert cv["mode"] == "forked_replica_probe"
+        assert cv["sweeps_during"] >= 1
+        assert cv["during_p99_ms"] <= cv["budget_ms"]
+        assert cv["within_budget"] is True
+        assert bg["background_parity"] == 1.0
+        assert bg["background_convoy_ok"] == 1.0
+        # the summary packs [sweep_speedup, parity, convoy_ok] for the
+        # sentinel (tail-window economy; named detail rides the full
+        # artifact)
+        assert summary["background"] == [
+            bg["background_sweep_speedup"], 1.0, 1.0]
 
 
 class TestTpuProofDryRun:
