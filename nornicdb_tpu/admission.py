@@ -163,7 +163,8 @@ _cfg: Optional[Dict[str, Any]] = None
 
 
 def _load_cfg() -> Dict[str, Any]:
-    from nornicdb_tpu.config import env_float, env_int, env_str
+    from nornicdb_tpu.config import (env_bool, env_float, env_int,
+                                     env_str)
 
     factor = env_float("DEADLINE_SLO_FACTOR", 120.0)
     default_ms = env_float("DEADLINE_DEFAULT_MS", 0.0)
@@ -220,6 +221,17 @@ def _load_cfg() -> Dict[str, Any]:
         # ring control block) — bounds how long a dead node's overload
         # signal can pin the fleet
         "fleet_posture_ttl_s": env_float("FLEET_POSTURE_TTL_S", 5.0),
+        # cost-aware admission (ISSUE 20): at posture >= degrade a
+        # query whose CALIBRATED predicted dispatch cost exceeds its
+        # remaining deadline budget sheds up front (reason
+        # ``admission_cost``) instead of occupying a device slot. The
+        # gate only actuates on confident models (obs/device.py
+        # abstains below its min-sample floor) — below confidence the
+        # posture controller stays queue-wait-only, never a guess.
+        "cost_gate_enabled": env_bool("ADMISSION_COST_GATE", True),
+        # predicted_ms must exceed slack x remaining_ms to shed: > 1.0
+        # sheds only clearly-doomed queries, < 1.0 sheds speculatively
+        "cost_gate_slack": env_float("ADMISSION_COST_SLACK", 1.0),
     }
 
 
@@ -863,6 +875,42 @@ class AdmissionController:
         ra = self.retry_after_s(ln)
         record_shed(surface, ln, "shed", retry_after_s=ra)
         raise ShedError(surface, ln, ra)
+
+    def cost_check(self, surface: str, kind: str, bucket: int = 1,
+                   lane_name: Optional[str] = None,
+                   now: Optional[float] = None) -> Optional[float]:
+        """Cost-aware admission (ISSUE 20): while posture >= degrade,
+        shed a query whose CALIBRATED predicted dispatch milliseconds
+        exceed its remaining deadline budget — up front, before it
+        occupies a queue or device slot (reason ``admission_cost``,
+        exactly-once ledger+journal via record_shed). Confidence-gated:
+        obs/device.py abstains below its min-sample floor, and this
+        gate then does nothing (queue-wait-only, never a guess).
+        Returns the predicted ms when a confident model admitted the
+        query, else None. Per-request hot path: cached config + one
+        model-dict read, no env access."""
+        c = cfg()
+        if not c["shed_enabled"] or not c["cost_gate_enabled"]:
+            return None
+        t = time.time() if now is None else now
+        rem = remaining(now=t)
+        if rem is None:
+            return None
+        if self.refresh(now=t) == "admit":
+            return None
+        from nornicdb_tpu.obs import device as _device
+
+        pred_ms = _device.predict_ms(kind, bucket)
+        if pred_ms is None:
+            return None
+        if pred_ms <= max(rem, 0.0) * 1e3 * c["cost_gate_slack"]:
+            return pred_ms
+        ln = lane_name if lane_name is not None else _ctx_lane.get()
+        with self._lock:
+            self.sheds += 1
+        ra = self.retry_after_s(ln)
+        record_shed(surface, ln, "admission_cost", retry_after_s=ra)
+        raise ShedError(surface, ln, ra, reason="admission_cost")
 
     # -- tier forcing (degrade-first actuation) ------------------------
 

@@ -798,6 +798,20 @@ class HttpServer:
                 checks["changelogs_near_overrun"] += 1
                 reasons.append(
                     f"changelog_near_overrun:{name}({depth}/{cap})")
+        # device-memory ledger (ISSUE 20): shape-derived gauges vs the
+        # backend's own live-buffer accounting — sustained drift past
+        # the bound means bytes the accounting cannot name (a leak, or
+        # an unregistered resident slab); either way this node's
+        # capacity story is wrong and an operator must look
+        try:
+            mem = obs.device.reconcile()
+            checks["device_mem_leak"] = int(bool(mem["leak_suspected"]))
+            if mem["leak_suspected"]:
+                reasons.append(
+                    f"device_mem_drift:{mem['drift_bytes']}"
+                    f">{mem['bound_bytes']}")
+        except Exception:
+            pass
         # shadow-parity breaches (ISSUE 10): a tier whose device/host
         # parity sits below its documented floor must rotate this node
         # out of traffic — serving fast wrong answers is not ready
@@ -1531,6 +1545,10 @@ class HttpServer:
                 # per-tenant truth (ISSUE 18): top-K by cost with the
                 # attribution-completeness and noisy-neighbor state
                 "tenants": obs.tenants_summary(),
+                # device truth (ISSUE 20): measured per-kind roofline
+                # (effective FLOPs/s, bytes/s, padding efficiency), the
+                # calibrated compile split and the memory ledger
+                "device": obs.device_summary(),
             }
             svc = self.db._search  # no index build from a telemetry read
             if svc is not None:
@@ -1552,6 +1570,14 @@ class HttpServer:
             # queue/in-flight depth + drain rates, deadline-miss
             # counters, shed totals and the current admission verdict
             return 200, _adm.scheduler_summary()
+
+        if action == "device" and method == "GET":
+            # device truth (ISSUE 20): the calibration roofline per
+            # dispatch kind (measured seconds joined against analytic
+            # FLOPs/bytes), per-bucket service-time models with the
+            # compile/execute split, unexpected-recompile count, and
+            # the device-memory ledger reconciliation
+            return 200, obs.device_summary()
 
         if action == "degrades" and method == "GET":
             # the unified degrade ledger (ISSUE 10): structured

@@ -117,6 +117,17 @@ HOT_PATHS = (
     ("nornicdb_tpu/obs/tenant.py", "record_served"),
     ("nornicdb_tpu/obs/tenant.py", "record_cost"),
     ("nornicdb_tpu/obs/tenant.py", "_admit"),
+    # device-truth calibration (ISSUE 20) — the cost gate runs once
+    # per request on the microbatch ingress; predict_ms and the
+    # per-dispatch observers run on every dispatch/record. Config is
+    # cached at first use (device.cfg / admission.cfg); none of these
+    # may read the environment.
+    ("nornicdb_tpu/admission.py", "AdmissionController.cost_check"),
+    ("nornicdb_tpu/obs/device.py", "predict_ms"),
+    ("nornicdb_tpu/obs/device.py", "observe_dispatch"),
+    ("nornicdb_tpu/obs/device.py", "note_cost"),
+    ("nornicdb_tpu/obs/device.py", "maybe_sync"),
+    ("nornicdb_tpu/obs/tenant.py", "record_device_seconds"),
 )
 
 # ---------------------------------------------------------------------------
@@ -138,6 +149,9 @@ TENANT_FAMILIES = (
     "nornicdb_tenant_cost_flops_total",
     "nornicdb_tenant_cost_bytes_total",
     "nornicdb_tenant_cost_queries_total",
+    # measured device wall seconds (ISSUE 20): the bill in time, not
+    # just analytic FLOPs
+    "nornicdb_tenant_device_seconds_total",
 )
 
 # ---------------------------------------------------------------------------

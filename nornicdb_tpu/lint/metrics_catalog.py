@@ -60,6 +60,9 @@ IMPORT_TIME_MODULES = (
     # ISSUE 19: background device plane — jobs counter + bg_* dispatch
     # kinds registered at import
     "nornicdb_tpu.background.device_plane",
+    # ISSUE 20: device-truth calibration plane — compile split,
+    # roofline gauges, recompile counter, memory-ledger families
+    "nornicdb_tpu.obs.device",
 )
 
 _PREFIX = "nornicdb_"

@@ -48,6 +48,7 @@ from nornicdb_tpu.obs.metrics import (
 )
 from nornicdb_tpu.obs import audit  # noqa: F401 — registers tier families
 from nornicdb_tpu.obs import cost  # noqa: F401 — registers cost counters
+from nornicdb_tpu.obs import device  # noqa: F401 — registers calibration
 from nornicdb_tpu.obs import events  # noqa: F401 — registers event counter
 from nornicdb_tpu.obs import fleet  # noqa: F401 — registers sources gauge
 from nornicdb_tpu.obs import resources  # noqa: F401 — registers collector
@@ -66,6 +67,11 @@ from nornicdb_tpu.obs.audit import (
     tier_mix,
 )
 from nornicdb_tpu.obs.cost import cost_summary, record_query_cost
+from nornicdb_tpu.obs.device import (
+    calibration_summary,
+    device_summary,
+    predict_ms,
+)
 from nornicdb_tpu.obs.events import (
     event_snapshot,
     event_summary,
@@ -125,9 +131,13 @@ __all__ = [
     "attach_span_tree",
     "audit",
     "audit_summary",
+    "calibration_summary",
     "compile_universe",
     "cost",
     "cost_summary",
+    "device",
+    "device_summary",
+    "predict_ms",
     "current_span",
     "current_trace_id",
     "degrade_snapshot",

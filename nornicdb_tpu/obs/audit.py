@@ -169,6 +169,8 @@ REASONS: Tuple[str, ...] = (
     "deadline",            # request budget expired before/while queued
     "shed",                # admission control rejected the request
     "admission",           # admission posture forced the tier down
+    "admission_cost",      # calibrated predicted cost exceeded the
+                           # remaining deadline budget (ISSUE 20)
     "tiered_cold",         # probe hit a non-resident partition: host scan
     "paging_race",         # residency churned while a dispatch was in flight
 )

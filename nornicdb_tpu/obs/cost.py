@@ -277,6 +277,17 @@ def cost_name(obj: Any) -> str:
     return getattr(obj, "_obs_resource_name", None) or "unregistered"
 
 
+def set_observer(fn) -> None:
+    """Register the per-record cost observer (obs/device.py, ISSUE 20):
+    called as ``fn(kind, queries, flops, bytes_)`` so calibration can
+    join analytic cost against measured dispatch seconds."""
+    global _observer
+    _observer = fn
+
+
+_observer = None
+
+
 def record_query_cost(kind: str, index: str, queries: int,
                       flops: float, bytes_: float) -> None:
     """Record one priced dispatch. ``queries`` is the REAL batch size
@@ -290,6 +301,9 @@ def record_query_cost(kind: str, index: str, queries: int,
     # padded-dispatch cost splits across riders by tenant (the
     # leader->rider channel); else the current context's tenant pays
     _tenant.record_cost(queries, flops, bytes_)
+    obs_fn = _observer
+    if obs_fn is not None:
+        obs_fn(kind, queries, flops, bytes_)
 
 
 def cost_summary(registry: Optional[Registry] = None

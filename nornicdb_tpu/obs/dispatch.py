@@ -14,12 +14,17 @@ growth instead of mystery latency spikes.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from nornicdb_tpu.obs import metrics as _m
 from nornicdb_tpu.obs.metrics import REGISTRY
 
 _lock = threading.Lock()
+# the device-truth calibration plane (obs/device.py, ISSUE 20)
+# registers itself here; every recorded dispatch is forwarded. Held as
+# a module global (not an import) so this module stays importable
+# first in the obs package.
+_observer: Optional[Callable[[str, int, int, float, bool], None]] = None
 # (kind, b, k) -> {"dispatches": int, "first_call_s": float,
 #                  "total_s": float}
 _shapes: Dict[Tuple[str, int, int], Dict[str, Any]] = {}
@@ -41,8 +46,18 @@ _LATENCY_H = REGISTRY.histogram(
     labels=("kind",))
 _FIRST_G = REGISTRY.gauge(
     "nornicdb_device_first_call_seconds",
-    "Wall time of the first (compiling) call per bucket",
+    "Wall time of the first call per bucket: compile AND execute "
+    "conflated (the calibrated split is nornicdb_device_compile_seconds)",
     labels=("kind", "b", "k"))
+
+
+def set_observer(
+        fn: Optional[Callable[[str, int, int, float, bool], None]]) -> None:
+    """Register the per-dispatch observer (obs/device.py): called as
+    ``fn(kind, b, k, seconds, first)`` after this module's own
+    recording, outside its lock."""
+    global _observer
+    _observer = fn
 
 
 def declare_kind(kind: str) -> None:
@@ -74,6 +89,9 @@ def record_dispatch(kind: str, b: int, k: int, seconds: float) -> None:
     if first:
         _COMPILE_C.labels(kind).inc()
         _FIRST_G.labels(kind, b, k).set(seconds)
+    obs_fn = _observer
+    if obs_fn is not None:
+        obs_fn(kind, int(b), int(k), seconds, first)
 
 
 def compile_universe() -> List[Dict[str, Any]]:

@@ -76,6 +76,8 @@ KINDS: Tuple[str, ...] = (
     "noisy_neighbor",   # one tenant held over the cost-share threshold
                         # of the rolling window while posture >= degrade
                         # (advisory, ISSUE 18 — no actuation)
+    "recompile",        # a compile observed after the dispatch kind was
+                        # warm: bucket churn at serve time (ISSUE 20)
 )
 
 _EVENTS_C = REGISTRY.counter(

@@ -349,6 +349,17 @@ class SloEngine:
                           "summary": tenants_summary()})
         except Exception:  # noqa: BLE001 — dump must never fail on extras
             pass
+        # what the DEVICE was doing (ISSUE 20): the calibrated roofline
+        # per dispatch kind + the memory-ledger verdict at breach time
+        # — was the breach compute-bound, bandwidth-bound, padding
+        # waste, or a capacity story gone wrong. Same lazy discipline.
+        try:
+            from nornicdb_tpu.obs.device import device_summary
+
+            lines.append({"kind": "device",
+                          "summary": device_summary()})
+        except Exception:  # noqa: BLE001 — dump must never fail on extras
+            pass
         for rec in (extra or []):
             lines.append(rec)
         for trace in TRACES.slowest(limit=20):

@@ -348,6 +348,12 @@ _T_QUERIES_C = REGISTRY.counter(
     "nornicdb_tenant_cost_queries_total",
     "Priced queries attributed per tenant (real pre-pad counts)",
     labels=("tenant",))
+_T_DEVICE_S_C = REGISTRY.counter(
+    "nornicdb_tenant_device_seconds_total",
+    "MEASURED device dispatch wall seconds attributed per tenant "
+    "(ISSUE 20: metering in seconds, not just analytic FLOPs; batched "
+    "dispatches split wall time across riders by tenant)",
+    labels=("tenant",))
 
 
 # ---------------------------------------------------------------------------
@@ -461,6 +467,22 @@ def record_cost(queries: float, flops: float, bytes_: float) -> None:
     _T_BYTES_C.labels(t).inc(bytes_)
     _T_QUERIES_C.labels(t).inc(queries)
     DETECTOR.note(t, flops)
+
+
+def record_device_seconds(seconds: float) -> None:
+    """Per-tenant side of the measured dispatch bracket (ISSUE 20):
+    split one dispatch's wall seconds across the active batch mix by
+    rider count — the bill in device time, not analytic FLOPs. Outside
+    a mix the current context's tenant pays whole."""
+    if not _m.enabled():
+        return
+    mix = getattr(_tls, "batch_mix", None)
+    if mix:
+        total = sum(mix.values()) or 1
+        for t, c in mix.items():
+            _T_DEVICE_S_C.labels(t).inc(seconds * c / total)
+        return
+    _T_DEVICE_S_C.labels(current_label()).inc(seconds)
 
 
 # ---------------------------------------------------------------------------
@@ -713,7 +735,9 @@ def tenants_summary(state: Optional[Dict[str, Dict]] = None,
     for name, field in (("nornicdb_tenant_cost_flops_total", "flops"),
                         ("nornicdb_tenant_cost_bytes_total", "bytes"),
                         ("nornicdb_tenant_cost_queries_total",
-                         "queries")):
+                         "queries"),
+                        ("nornicdb_tenant_device_seconds_total",
+                         "device_seconds")):
         for key, v in _fam_children(state, name).items():
             d = doc(key[0]).setdefault("cost", {})
             d[field] = d.get(field, 0.0) + v

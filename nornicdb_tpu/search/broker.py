@@ -70,6 +70,7 @@ from nornicdb_tpu.obs import (
     record_dispatch,
 )
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import device as _device
 from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu.obs import tracing as _tracing
 from nornicdb_tpu import admission as _adm
@@ -873,8 +874,15 @@ class DispatchBroker:
             rider_tenants = [(item.get("ctx") or {}).get("tenant")
                              for _w, _s, item in group]
             with _tenant.batch_scope(rider_tenants):
+                # ISSUE 20: cost priced below this seam credits the
+                # broker_vec serving kind, and the sampled bracket pins
+                # t1 to device completion before record_dispatch
                 with _adm.deadline_scope(group_dl), \
-                        _adm.lane_scope(group_lane):
+                        _adm.lane_scope(group_lane), \
+                        _device.dispatch_scope("broker_vec"):
+                    # the plane prices the PADDED batch; the padding-
+                    # efficiency join needs the real rider count
+                    _device.note_real_rows(float(b))
                     if lead_ctx is not None:
                         attrs = {"key": key, "batch": b,
                                  "surface": "broker", "lane": group_lane}
@@ -887,6 +895,7 @@ class DispatchBroker:
                                                          k_max)
                     else:
                         results = self._vec_dispatch(key, queries, k_max)
+                    _device.maybe_sync(results)
                 t1 = time.time()
                 tier = _audit.consume_batch_tier()
                 # fleet-routed reads stamp the chosen node (ISSUE 13):

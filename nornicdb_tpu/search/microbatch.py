@@ -32,6 +32,7 @@ from nornicdb_tpu.obs import (
     record_stage,
 )
 from nornicdb_tpu.obs import audit as _audit
+from nornicdb_tpu.obs import device as _device
 from nornicdb_tpu.obs import tenant as _tenant
 from nornicdb_tpu import admission as _adm
 
@@ -380,6 +381,19 @@ class MicroBatcher:
             raise _adm.DeadlineExceeded(
                 f"deadline budget expired before enqueue "
                 f"({self._surface})")
+        # cost-aware admission (ISSUE 20): at posture >= degrade, a
+        # rider whose CALIBRATED predicted dispatch cost exceeds its
+        # remaining budget sheds here (reason ``admission_cost``) —
+        # before taking a queue slot it cannot convert into an answer.
+        # Predicts at the bucket the next batch will likely compile to;
+        # an unconfident model abstains and admission stays
+        # queue-wait-only.
+        if dl is not None:
+            _adm.CONTROLLER.cost_check(
+                self._surface, "microbatch",
+                pow2_bucket(max(min(self._last_batch, self._max_batch),
+                                1)),
+                lane, now=t_enq)
         req = _Req(np.asarray(vec, np.float32), k, extra)
         req.deadline, req.lane, req.t_enq = dl, lane, t_enq
         req.tenant = _tenant.current_tenant()
@@ -560,18 +574,29 @@ class MicroBatcher:
             t0 = time.time()
             _audit.consume_batch_tier()  # clear any stale leader note
             # bind the riders' tenant mix around the dispatch (18): the
-            # padded program's cost splits across riders by tenant
+            # padded program's cost splits across riders by tenant, and
+            # (ISSUE 20) the dispatch scope credits inner-plane pricing
+            # to this serving kind while the sampled bracket pins t1 to
+            # device completion — the measured wall seconds then split
+            # across the same rider mix
             with _tenant.batch_scope([r.tenant for r in batch]):
-                if self._pass_extras:
-                    # pad extras like the query rows: repeat request 0's
-                    extras = [r.extra for r in batch]
-                    extras += [batch[0].extra] * (bucket - b)
-                    results = self._search_batch(queries, k_max, extras)
-                else:
-                    results = self._search_batch(queries, k_max)
-            t1 = time.time()
-            tier = _audit.consume_batch_tier()
-            record_dispatch("microbatch", bucket, k_max, t1 - t0)
+                with _device.dispatch_scope("microbatch"):
+                    # the inner plane prices the PADDED array; the
+                    # padding-efficiency join needs the rider count
+                    _device.note_real_rows(float(b))
+                    if self._pass_extras:
+                        # pad extras like the query rows: repeat
+                        # request 0's
+                        extras = [r.extra for r in batch]
+                        extras += [batch[0].extra] * (bucket - b)
+                        results = self._search_batch(queries, k_max,
+                                                     extras)
+                    else:
+                        results = self._search_batch(queries, k_max)
+                    _device.maybe_sync(results)
+                    t1 = time.time()
+                tier = _audit.consume_batch_tier()
+                record_dispatch("microbatch", bucket, k_max, t1 - t0)
             for r, res in zip(batch, results):
                 r.dispatch_t0, r.dispatch_t1 = t0, t1
                 r.batch_size = b
@@ -600,16 +625,18 @@ class MicroBatcher:
                     q1 = np.asarray(r.vec, np.float32)[None, :]
                     _audit.consume_batch_tier()
                     with _tenant.batch_scope([r.tenant]):
-                        if self._pass_extras:
-                            res = self._search_batch(q1, kb,
-                                                     [r.extra])[0]
-                        else:
-                            res = self._search_batch(q1, kb)[0]
-                    r.tier = _audit.consume_batch_tier()
-                    r.dispatch_t1 = time.time()
-                    r.batch_size = 1
-                    record_dispatch("microbatch", 1, kb,
-                                    r.dispatch_t1 - r.dispatch_t0)
+                        with _device.dispatch_scope("microbatch"):
+                            if self._pass_extras:
+                                res = self._search_batch(q1, kb,
+                                                         [r.extra])[0]
+                            else:
+                                res = self._search_batch(q1, kb)[0]
+                            _device.maybe_sync(res)
+                            r.dispatch_t1 = time.time()
+                        r.tier = _audit.consume_batch_tier()
+                        r.batch_size = 1
+                        record_dispatch("microbatch", 1, kb,
+                                        r.dispatch_t1 - r.dispatch_t0)
                     if self._truncate:
                         r.result = res[: r.k] if r.k < kb else res
                     else:

@@ -188,6 +188,23 @@ CHECKS: Dict[str, Tuple] = {
     "background_sweep_speedup": ("qps", 0.5),
     "background_parity": ("quality", 1.0, 0.0),
     "background_convoy_ok": ("quality", 1.0, 0.0),
+    # device-truth calibration (round r20+, ISSUE 20): coverage is the
+    # contract that EVERY kind the stage served carries effective
+    # FLOPs/s + padding efficiency — gates ABSOLUTELY at 1.0 from the
+    # first round it appears (a served-but-uncalibrated kind means the
+    # measurement seam or the cost join silently dropped it, not
+    # noise). pred_ratio_ok is the model-accuracy band: calibrated
+    # predict_ms within 3x of a freshly measured pass per kind (the
+    # companion raw p50 ratio is bounded too — an admission gate fed a
+    # 3x-off model sheds the wrong queries). mem_drift_ok holds the
+    # ledger-vs-backend reconciliation inside the 64 MiB detector
+    # bound, and exactly_once is the admission_cost shed contract:
+    # every refusal lands ONE ledger record and ONE journal event.
+    "calibration_coverage": ("quality", 1.0, 0.0),
+    "device_pred_ratio_ok": ("quality", 1.0, 0.0),
+    "device_pred_ratio_p50": ("bound", 3.0),
+    "device_mem_drift_ok": ("quality", 1.0, 0.0),
+    "device_cost_shed_exactly_once": ("quality", 1.0, 0.0),
 }
 
 
@@ -383,6 +400,26 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         out["background_parity"] = _num(bg.get("background_parity"))
         out["background_convoy_ok"] = _num(
             bg.get("background_convoy_ok"))
+    # device truth (round r20+): the summary packs
+    # [calibration_coverage, pred_ratio_p50, pred_ratio_ok,
+    # mem_drift_ok, cost_shed_exactly_once, mem_drift_bytes]; the
+    # full artifact carries the named keys under "device_truth"
+    dt = doc.get("device_truth") or {}
+    if isinstance(dt, list):
+        pad = dt + [None] * 6
+        out["calibration_coverage"] = _num(pad[0])
+        out["device_pred_ratio_p50"] = _num(pad[1])
+        out["device_pred_ratio_ok"] = _num(pad[2])
+        out["device_mem_drift_ok"] = _num(pad[3])
+        out["device_cost_shed_exactly_once"] = _num(pad[4])
+    else:
+        out["calibration_coverage"] = _num(
+            dt.get("calibration_coverage"))
+        out["device_pred_ratio_p50"] = _num(dt.get("pred_ratio_p50"))
+        out["device_pred_ratio_ok"] = _num(dt.get("pred_ratio_ok"))
+        out["device_mem_drift_ok"] = _num(dt.get("mem_drift_ok"))
+        out["device_cost_shed_exactly_once"] = _num(
+            _g(dt, "cost_gate", "exactly_once"))
     surfaces = doc.get("surfaces") or {}
     for name in ("bolt", "neo4j_http", "graphql", "rest_search",
                  "qdrant_grpc"):

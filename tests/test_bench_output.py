@@ -191,6 +191,34 @@ def _fake_result():
                        "background_parity": 1.0,
                        "background_sweep_speedup": 5.1,
                        "background_convoy_ok": 1.0},
+        "device_truth": {"backend": {"platform": "cpu",
+                                     "device_kind": "cpu",
+                                     "device_count": 1,
+                                     "host_cores": 8,
+                                     "hbm_bytes": None},
+                         "calibration_coverage": 1.0,
+                         "served_kinds": ["cagra_walk", "microbatch"],
+                         "calibrated_kinds": ["cagra_walk",
+                                              "microbatch"],
+                         "unexpected_recompiles": 0,
+                         "kinds": {},
+                         "pred_ratio": {"microbatch": 0.9,
+                                        "cagra_walk": 1.1},
+                         "pred_ratio_p50": 1.0,
+                         "pred_ratio_ok": 1.0,
+                         "memory": {"ledger_bytes": 0,
+                                    "backend_bytes": 130_000,
+                                    "drift_bytes": 130_000,
+                                    "bound_bytes": 67_108_864,
+                                    "window_s": 60.0,
+                                    "sustained_s": 0.0,
+                                    "leak_suspected": False},
+                         "mem_drift_ok": 1.0,
+                         "cost_gate": {"pred_ms": 1.4, "attempts": 3,
+                                       "sheds": 3,
+                                       "ledger_records": 3,
+                                       "journal_events": 3,
+                                       "exactly_once": 1.0}},
         "surfaces": {name: {"ops_per_s": 2000.0, "vs_baseline": 0.5}
                      for name in bench._SURFACE_BASELINES},
         "telemetry": {
@@ -284,6 +312,12 @@ class TestCompactSummary:
         # convoy_ok]: the sentinel gates the speedup at the 0.5 qps
         # floor and parity/convoy ABSOLUTELY at 1.0
         assert s["background"] == [5.1, 1.0, 1.0]
+        # device truth (ISSUE 20), packed [calibration_coverage,
+        # pred_ratio_p50, pred_ratio_ok, mem_drift_ok, exactly_once,
+        # drift_bytes]: the sentinel gates coverage, the ratio band,
+        # the memory verdict and the shed evidence ABSOLUTELY at 1.0
+        # and the p50 ratio at the 3x bound
+        assert s["device_truth"] == [1.0, 1.0, 1.0, 1.0, 1.0, 130_000]
         assert s["pagerank_speedup_vs_numpy"] == 1.2
         assert s["tpu_proof"] == "skipped"
         # latency percentiles ride the summary per headline surface
@@ -789,6 +823,67 @@ class TestBenchDryRunArtifactSchema:
         # artifact)
         assert summary["background"] == [
             bg["background_sweep_speedup"], 1.0, 1.0]
+
+    def test_device_truth_stage_schema(self, dry_run_lines):
+        """Device-truth stage (ISSUE 20): the timing bracket samples
+        every dispatch over a two-kind serve (coalesced microbatch +
+        self-aligned cagra_walk), the calibration join must cover both
+        at the ABSOLUTE 1.0 contract, the predicted-vs-measured ratio
+        must land inside the 3x band, the memory ledger must reconcile
+        inside the drift bound, and the cost gate must shed with the
+        exactly-once ledger+journal evidence — in every dry run."""
+        full = json.loads(dry_run_lines[0])
+        summary = json.loads(dry_run_lines[-1])
+        dt = full["device_truth"]
+        assert "error" not in dt, dt
+        # self-describing artifact: the box's device identity
+        be = dt["backend"]
+        assert be["platform"]
+        assert "device_kind" in be
+        assert be["device_count"] >= 1
+        assert be["host_cores"] >= 1
+        assert "hbm_bytes" in be  # None on backends with no budget
+        # calibration: both served kinds joined against analytic cost
+        assert dt["calibration_coverage"] == 1.0  # absolute contract
+        assert set(dt["served_kinds"]) == {"cagra_walk", "microbatch"}
+        assert dt["calibrated_kinds"] == dt["served_kinds"]
+        assert dt["unexpected_recompiles"] == 0
+        for kind in ("cagra_walk", "microbatch"):
+            kd = dt["kinds"][kind]
+            assert kd["dispatches"] > 0
+            assert kd["eff_flops_per_s"] > 0
+            assert kd["eff_bytes_per_s"] > 0
+            assert 0 < kd["padding_efficiency"] <= 1.0
+            assert kd["compile_s_est"] >= 0
+            assert kd["execute_s"] > 0
+        # prediction honesty: measured wall time within 3x of the
+        # model both ways (a model that can't place a dispatch within
+        # 3x has no business gating admission)
+        assert set(dt["pred_ratio"]) == {"cagra_walk", "microbatch"}
+        assert dt["pred_ratio_p50"] is not None
+        assert dt["pred_ratio_ok"] == 1.0
+        # memory ledger reconciles inside the drift bound
+        mem = dt["memory"]
+        assert mem["bound_bytes"] > 0
+        assert mem["leak_suspected"] is False
+        assert dt["mem_drift_ok"] == 1.0
+        # cost gate: every shed left exactly one ledger record and
+        # one journal event with reason admission_cost
+        cg = dt["cost_gate"]
+        assert cg["pred_ms"] is not None and cg["pred_ms"] > 0
+        assert cg["sheds"] >= 1
+        assert cg["ledger_records"] == cg["sheds"]
+        assert cg["journal_events"] == cg["sheds"]
+        assert cg["exactly_once"] == 1.0
+        # the summary packs [coverage, ratio_p50, ratio_ok,
+        # mem_drift_ok, exactly_once, drift_bytes] for the sentinel
+        pack = summary["device_truth"]
+        assert pack[0] == 1.0
+        assert pack[1] == dt["pred_ratio_p50"]
+        assert pack[2] == 1.0
+        assert pack[3] == 1.0
+        assert pack[4] == 1.0
+        assert pack[5] == mem["drift_bytes"]
 
 
 class TestTpuProofDryRun:
